@@ -1,0 +1,12 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A from-scratch re-design of the capability surface of Tendermint Core
+(reference: /root/reference, v0.27.0): BFT consensus, ABCI application
+interface, mempool, fast sync, evidence, WAL + crash recovery, validator
+signing, RPC, light client, and tooling — with the vote/commit Ed25519
+verification hot path (reference: types/validator_set.go:345-371,
+types/vote_set.go:189) executed as a vectorized JAX/TPU batch kernel
+instead of a serial per-signature loop.
+"""
+
+__version__ = "0.1.0"

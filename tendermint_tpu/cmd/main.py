@@ -1,0 +1,19 @@
+"""CLI entry point (reference: cmd/tendermint/main.go). Commands land in
+later milestones; `version` works from day one."""
+
+import sys
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    from tendermint_tpu import __version__
+
+    if not argv or argv[0] in ("version", "--version", "-v"):
+        print(f"tendermint-tpu {__version__}")
+        return 0
+    print(f"unknown command {argv[0]!r}; available: version", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

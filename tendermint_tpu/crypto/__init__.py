@@ -1,0 +1,20 @@
+"""Crypto layer: keys, hashing, merkle trees, and the batch-verify engine."""
+
+from .keys import (  # noqa: F401
+    PrivKey,
+    PrivKeyEd25519,
+    PubKey,
+    PubKeyEd25519,
+    pubkey_from_bytes,
+    pubkey_to_bytes,
+    privkey_from_bytes,
+    privkey_to_bytes,
+)
+from .batch import (  # noqa: F401
+    BatchVerifier,
+    CPUBatchVerifier,
+    backends,
+    batch_verify,
+    new_batch_verifier,
+    set_default_backend,
+)

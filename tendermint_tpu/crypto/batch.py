@@ -1,0 +1,135 @@
+"""BatchVerifier — the pluggable bulk-verification engine (the north star).
+
+The reference verifies every vote/commit signature serially
+(types/validator_set.go:345-371, types/vote_set.go:189 →
+crypto/ed25519/ed25519.go:151-157). Here every bulk call site —
+ValidatorSet.verify_commit, fast-sync block validation, VoteSet batching —
+routes through this registry instead, and per-item validity masks come back
+(mixed valid/invalid batches are first-class; no all-or-nothing batch
+equations).
+
+Backends:
+  "cpu"  — per-signature verify via OpenSSL (always available; baseline)
+  "jax"  — vectorized Ed25519 verify (decompress → SHA-512 → double
+           scalar mult) under vmap/jit; shards across every visible device
+           with shard_map when more than one is present.
+
+Select with set_default_backend() or the TM_TPU_CRYPTO_BACKEND env var.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Sequence, Tuple
+
+Triple = Tuple[bytes, bytes, bytes]  # (message, signature, pubkey)
+
+
+class BatchVerifier:
+    """Accumulate (msg, sig, pubkey) triples, then verify all at once."""
+
+    def __init__(self):
+        self._items: List[Triple] = []
+
+    def add(self, msg: bytes, sig: bytes, pubkey: bytes) -> None:
+        self._items.append((msg, sig, pubkey))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> List[bool]:
+        """Returns one validity flag per added triple, in add order."""
+        raise NotImplementedError
+
+    def verify_all(self) -> bool:
+        return all(self.verify())
+
+
+class CPUBatchVerifier(BatchVerifier):
+    """Serial per-signature verification — the reference semantics."""
+
+    def verify(self) -> List[bool]:
+        from .keys import PubKeyEd25519
+
+        out = []
+        for msg, sig, pk in self._items:
+            try:
+                out.append(PubKeyEd25519(pk).verify_bytes(msg, sig))
+            except ValueError:
+                out.append(False)
+        return out
+
+
+_registry: dict[str, Callable[[], BatchVerifier]] = {}
+_default_lock = threading.Lock()
+_default_name: str | None = None
+
+
+def register_backend(name: str, factory: Callable[[], BatchVerifier]) -> None:
+    _registry[name] = factory
+
+
+def backends() -> List[str]:
+    return sorted(_registry)
+
+
+def set_default_backend(name: str) -> None:
+    global _default_name
+    if name not in _registry:
+        raise KeyError(f"unknown batch-verify backend {name!r}; have {backends()}")
+    with _default_lock:
+        _default_name = name
+
+
+def default_backend_name() -> str:
+    global _default_name
+    with _default_lock:
+        if _default_name is None:
+            env = os.environ.get("TM_TPU_CRYPTO_BACKEND")
+            if env and env in _registry:
+                _default_name = env
+            elif "jax" in _registry:
+                _default_name = "jax"
+            else:
+                _default_name = "cpu"
+        return _default_name
+
+
+def new_batch_verifier(name: str | None = None) -> BatchVerifier:
+    if name is None:
+        name = default_backend_name()
+    try:
+        factory = _registry[name]
+    except KeyError:
+        raise KeyError(f"unknown batch-verify backend {name!r}; have {backends()}")
+    return factory()
+
+
+def batch_verify(
+    triples: Sequence[Triple], backend: str | None = None
+) -> List[bool]:
+    bv = new_batch_verifier(backend)
+    for msg, sig, pk in triples:
+        bv.add(msg, sig, pk)
+    return bv.verify()
+
+
+register_backend("cpu", CPUBatchVerifier)
+
+
+def _register_jax_backend():
+    """Deferred so importing tendermint_tpu.crypto never forces jax init."""
+    try:
+        from .jaxed25519.verify import JAXBatchVerifier
+    except ImportError as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "jax batch-verify backend unavailable, falling back to cpu: %s", e
+        )
+        return
+    register_backend("jax", JAXBatchVerifier)
+
+
+_register_jax_backend()

@@ -1,0 +1,18 @@
+"""SHA256 / truncated SHA256-20 hashing.
+
+Capability parity with the reference's crypto/tmhash/hash.go: full 32-byte
+SHA256 plus the 20-byte truncated variant used for addresses.
+"""
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+
+
+def sum(data: bytes) -> bytes:  # noqa: A001 - mirrors reference naming
+    return hashlib.sha256(data).digest()
+
+
+def sum_truncated(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()[:TRUNCATED_SIZE]

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU platform so multi-chip sharding
+paths (shard_map over a Mesh) are exercised without TPU hardware. Must be
+set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

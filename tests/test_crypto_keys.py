@@ -1,0 +1,63 @@
+import pytest
+
+from tendermint_tpu.crypto import keys, tmhash
+from tendermint_tpu.crypto.batch import batch_verify
+
+
+def test_sign_verify_roundtrip():
+    sk = keys.PrivKeyEd25519.generate()
+    pk = sk.pub_key()
+    msg = b"hello tendermint tpu"
+    sig = sk.sign(msg)
+    assert len(sig) == keys.ED25519_SIGNATURE_SIZE
+    assert pk.verify_bytes(msg, sig)
+    assert not pk.verify_bytes(msg + b"!", sig)
+    assert not pk.verify_bytes(msg, b"\x00" * 64)
+
+
+def test_privkey_layout_seed_pubkey():
+    sk = keys.PrivKeyEd25519.generate()
+    assert len(sk.bytes()) == 64
+    # last 32 bytes are the pubkey, as in the reference (crypto/ed25519/ed25519.go)
+    assert sk.bytes()[32:] == sk.pub_key().bytes()
+    sk2 = keys.PrivKeyEd25519.from_seed(sk.seed())
+    assert sk2 == sk
+
+
+def test_deterministic_from_secret():
+    a = keys.PrivKeyEd25519.gen_from_secret(b"secret")
+    b = keys.PrivKeyEd25519.gen_from_secret(b"secret")
+    c = keys.PrivKeyEd25519.gen_from_secret(b"other")
+    assert a == b and a != c
+
+
+def test_address_is_sha256_20():
+    sk = keys.PrivKeyEd25519.generate()
+    pk = sk.pub_key()
+    assert pk.address() == tmhash.sum_truncated(pk.bytes())
+    assert len(pk.address()) == 20
+
+
+def test_key_serialization_roundtrip():
+    sk = keys.PrivKeyEd25519.generate()
+    assert keys.privkey_from_bytes(keys.privkey_to_bytes(sk)) == sk
+    pk = sk.pub_key()
+    assert keys.pubkey_from_bytes(keys.pubkey_to_bytes(pk)) == pk
+    with pytest.raises(ValueError):
+        keys.pubkey_from_bytes(b"\xff" + b"\x00" * 32)
+
+
+def test_cpu_batch_verify_mixed_validity():
+    triples = []
+    want = []
+    for i in range(10):
+        sk = keys.PrivKeyEd25519.generate()
+        msg = f"msg-{i}".encode()
+        sig = sk.sign(msg)
+        if i % 3 == 0:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]  # corrupt
+            want.append(False)
+        else:
+            want.append(True)
+        triples.append((msg, sig, sk.pub_key().bytes()))
+    assert batch_verify(triples, backend="cpu") == want

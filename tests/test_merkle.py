@@ -1,0 +1,85 @@
+import hashlib
+
+from tendermint_tpu.crypto import merkle
+
+
+def test_empty_and_single():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    h1 = merkle.hash_from_byte_slices([b"a"])
+    assert h1 == merkle.leaf_hash(b"a")
+
+
+def test_root_changes_with_content_and_order():
+    items = [b"a", b"b", b"c", b"d", b"e"]
+    r1 = merkle.hash_from_byte_slices(items)
+    r2 = merkle.hash_from_byte_slices(list(reversed(items)))
+    r3 = merkle.hash_from_byte_slices(items[:-1])
+    assert len({bytes(r) for r in (r1, r2, r3)}) == 3
+
+
+def test_proofs_verify():
+    for n in (1, 2, 3, 5, 8, 13):
+        items = [f"item-{i}".encode() for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, p in enumerate(proofs):
+            assert p.total == n and p.index == i
+            assert p.verify(root, items[i]), (n, i)
+            assert not p.verify(root, items[i] + b"x")
+            if n > 1:
+                other = items[(i + 1) % n]
+                assert not p.verify(root, other)
+
+
+def test_proof_rejects_wrong_root():
+    items = [b"a", b"b", b"c"]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    bad_root = bytes(32)
+    assert not proofs[0].verify(bad_root, items[0])
+
+
+def test_hash_from_map_deterministic():
+    m1 = {"b": b"2", "a": b"1"}
+    m2 = {"a": b"1", "b": b"2"}
+    assert merkle.hash_from_map(m1) == merkle.hash_from_map(m2)
+    assert merkle.hash_from_map(m1) != merkle.hash_from_map({"a": b"1"})
+
+
+def test_privkey_tampered_pubkey_half_rejected():
+    # belongs with key tests but exercises load-time consistency
+    from tendermint_tpu.crypto import keys
+
+    sk = keys.PrivKeyEd25519.generate()
+    tampered = sk.bytes()[:32] + b"\x01" * 32
+    import pytest
+
+    with pytest.raises(ValueError):
+        keys.privkey_from_bytes(bytes([keys.TYPE_ED25519]) + tampered)
+
+
+def test_simple_value_op_chain():
+    import hashlib
+
+    # leaf = uvarint-len(key) || key || uvarint-len(sha256(value)) || hash
+    key, value = b"balance", b"42"
+    vhash = hashlib.sha256(value).digest()
+    kv = merkle._encode_lenprefixed(key) + merkle._encode_lenprefixed(vhash)
+    leaves = [kv, b"other-leaf"]
+    root, proofs = merkle.proofs_from_byte_slices(leaves)
+    op = merkle.SimpleValueOp(key=key, proof=proofs[0])
+    ops = merkle.ProofOperators([op])
+    assert ops.verify_value(root, [key], value)
+    assert not ops.verify_value(root, [key], b"43")          # wrong value
+    assert not ops.verify_value(bytes(32), [key], value)     # wrong root
+    assert not ops.verify_value(root, [b"bogus"], value)     # wrong keypath
+    assert not ops.verify_value(root, [], value)             # empty keypath
+    # leftover keypath keys must fail
+    assert not ops.verify_value(root, [b"extra", key], value)
+
+
+def test_uvarint_lenprefix():
+    assert merkle._encode_lenprefixed(b"") == b"\x00"
+    assert merkle._encode_lenprefixed(b"a") == b"\x01a"
+    big = b"x" * 300
+    enc = merkle._encode_lenprefixed(big)
+    assert enc[0] == (300 & 0x7F) | 0x80 and enc[1] == 300 >> 7
