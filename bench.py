@@ -1,0 +1,72 @@
+"""Benchmark: the north-star hot path — VerifyCommit at 10k validators.
+
+BASELINE.json config 5: "10k-validator mega-commit VerifyCommit on TPU,
+mixed valid/invalid sigs". Baseline stand-in for the reference's serial Go
+ed25519 path (types/validator_set.go:345-371): a serial OpenSSL
+verify loop (measured on a subset, extrapolated linearly — per-signature
+cost is constant).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+vs_baseline > 1 means faster than the serial baseline.
+"""
+
+import json
+import secrets
+import sys
+import time
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    from tendermint_tpu.crypto import keys
+    from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
+
+    # build a synthetic 10k-validator commit: distinct keys, vote-sized
+    # messages (~110B canonical sign-bytes), ~1% corrupted signatures
+    sks = [keys.PrivKeyEd25519.generate() for _ in range(min(n, 2000))]
+    msgs, sigs, pks, want = [], [], [], []
+    for i in range(n):
+        sk = sks[i % len(sks)]
+        msg = secrets.token_bytes(110)
+        sig = sk.sign(msg)
+        if i % 100 == 37:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+            want.append(False)
+        else:
+            want.append(True)
+        msgs.append(msg)
+        sigs.append(sig)
+        pks.append(sk.pub_key().bytes())
+
+    # serial CPU baseline (subset of 300, extrapolated)
+    sub = 300
+    t0 = time.perf_counter()
+    for i in range(sub):
+        keys.PubKeyEd25519(pks[i]).verify_bytes(msgs[i], sigs[i])
+    serial_ms = (time.perf_counter() - t0) / sub * n * 1000
+
+    # TPU batch path: one warmup (compile), then timed runs
+    got = verify_batch(msgs, sigs, pks)
+    assert got == want, "TPU verify mask mismatch vs expected"
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        verify_batch(msgs, sigs, pks)
+        times.append((time.perf_counter() - t0) * 1000)
+    batch_ms = min(times)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"verify_commit_{n}_sigs_wall_ms",
+                "value": round(batch_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(serial_ms / batch_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+"""ABCI: the application interface, clients, servers, and example apps."""
+
+from .types import (  # noqa: F401
+    Application,
+    BaseApplication,
+    CODE_TYPE_OK,
+)

@@ -1,0 +1,201 @@
+"""ABCI clients: in-process and socket (reference abci/client/).
+
+The local client (reference local_client.go) serializes calls with a
+mutex and invokes the Application directly. The socket client speaks the
+msgpack-framed protocol of abci/server.py for out-of-process apps —
+the PROCESS BOUNDARY from the reference's call stacks (SURVEY §3.1).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional
+
+import msgpack
+
+from . import types as abci
+from .codec import REQUEST_CODECS, RESPONSE_CODECS
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class Client:
+    """Synchronous ABCI client interface. The async pipelining of the
+    reference's socket client maps to deliver_tx_async buffering."""
+
+    def echo(self, msg: str) -> str:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        raise NotImplementedError
+
+    def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption:
+        raise NotImplementedError
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        raise NotImplementedError
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        raise NotImplementedError
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        raise NotImplementedError
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        raise NotImplementedError
+
+    def deliver_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
+        raise NotImplementedError
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        raise NotImplementedError
+
+    def commit(self) -> abci.ResponseCommit:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalClient(Client):
+    def __init__(self, app: abci.Application, lock: Optional[threading.Lock] = None):
+        self.app = app
+        # one shared lock across the 3 connections, like local_client.go
+        self._lock = lock or threading.Lock()
+
+    def echo(self, msg):
+        return msg
+
+    def flush(self):
+        pass
+
+    def info(self, req):
+        with self._lock:
+            return self.app.info(req)
+
+    def set_option(self, req):
+        with self._lock:
+            return self.app.set_option(req)
+
+    def query(self, req):
+        with self._lock:
+            return self.app.query(req)
+
+    def check_tx(self, tx):
+        with self._lock:
+            return self.app.check_tx(tx)
+
+    def init_chain(self, req):
+        with self._lock:
+            return self.app.init_chain(req)
+
+    def begin_block(self, req):
+        with self._lock:
+            return self.app.begin_block(req)
+
+    def deliver_tx(self, tx):
+        with self._lock:
+            return self.app.deliver_tx(tx)
+
+    def end_block(self, req):
+        with self._lock:
+            return self.app.end_block(req)
+
+    def commit(self):
+        with self._lock:
+            return self.app.commit()
+
+
+class SocketClient(Client):
+    """Length-prefixed msgpack frames over TCP or unix socket."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        self.address = address
+        self._lock = threading.Lock()
+        self._sock = _dial(address, timeout)
+        self._rfile = self._sock.makefile("rb")
+
+    def _call(self, method: str, payload):
+        with self._lock:
+            frame = msgpack.packb([method, payload], use_bin_type=True)
+            self._sock.sendall(struct.pack(">I", len(frame)) + frame)
+            hdr = self._rfile.read(4)
+            if len(hdr) < 4:
+                raise ABCIClientError("connection closed")
+            (n,) = struct.unpack(">I", hdr)
+            data = self._rfile.read(n)
+            if len(data) < n:
+                raise ABCIClientError("truncated response")
+            kind, body = msgpack.unpackb(data, raw=False)
+            if kind == "exception":
+                raise ABCIClientError(f"app exception: {body}")
+            if kind != method:
+                raise ABCIClientError(f"response {kind!r} for request {method!r}")
+            return body
+
+    def echo(self, msg):
+        return self._call("echo", msg)
+
+    def flush(self):
+        self._call("flush", None)
+
+    def info(self, req):
+        return RESPONSE_CODECS["info"].decode(self._call("info", REQUEST_CODECS["info"].encode(req)))
+
+    def set_option(self, req):
+        return RESPONSE_CODECS["set_option"].decode(
+            self._call("set_option", REQUEST_CODECS["set_option"].encode(req))
+        )
+
+    def query(self, req):
+        return RESPONSE_CODECS["query"].decode(self._call("query", REQUEST_CODECS["query"].encode(req)))
+
+    def check_tx(self, tx):
+        return RESPONSE_CODECS["check_tx"].decode(self._call("check_tx", tx))
+
+    def init_chain(self, req):
+        return RESPONSE_CODECS["init_chain"].decode(
+            self._call("init_chain", REQUEST_CODECS["init_chain"].encode(req))
+        )
+
+    def begin_block(self, req):
+        return RESPONSE_CODECS["begin_block"].decode(
+            self._call("begin_block", REQUEST_CODECS["begin_block"].encode(req))
+        )
+
+    def deliver_tx(self, tx):
+        return RESPONSE_CODECS["deliver_tx"].decode(self._call("deliver_tx", tx))
+
+    def end_block(self, req):
+        return RESPONSE_CODECS["end_block"].decode(
+            self._call("end_block", REQUEST_CODECS["end_block"].encode(req))
+        )
+
+    def commit(self):
+        return RESPONSE_CODECS["commit"].decode(self._call("commit", None))
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _dial(address: str, timeout: float) -> socket.socket:
+    if address.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout)
+        s.connect(address[len("unix://") :])
+    else:
+        host, _, port = address.replace("tcp://", "").rpartition(":")
+        s = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    s.settimeout(None)
+    return s

@@ -1,0 +1,189 @@
+"""Structural (de)serialization of ABCI messages for the socket/grpc wire.
+
+Replaces the reference's generated protobuf codecs (abci/types/types.pb.go).
+Every message is a fixed-order list; see types.serde for the convention.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from . import types as abci
+
+
+def _kvpairs_obj(tags):
+    return [[t.key, t.value] for t in tags]
+
+
+def _kvpairs_from(o):
+    return [abci.KVPair(key=t[0], value=t[1]) for t in o]
+
+
+def _params_obj(p):
+    if p is None:
+        return None
+    return [
+        [p.block_size.max_bytes, p.block_size.max_gas] if p.block_size else None,
+        [p.evidence.max_age] if p.evidence else None,
+    ]
+
+
+def _params_from(o):
+    if o is None:
+        return None
+    return abci.ConsensusParamUpdates(
+        block_size=abci.BlockSizeParams(o[0][0], o[0][1]) if o[0] else None,
+        evidence=abci.EvidenceParams(o[1][0]) if o[1] else None,
+    )
+
+
+def _proof_obj(p):
+    """Merkle SimpleProof carried over the wire (None passes through)."""
+    if p is None:
+        return None
+    from ..types import serde
+
+    return serde.proof_obj(p)
+
+
+def _proof_from(o):
+    if o is None:
+        return None
+    from ..types import serde
+
+    return serde.proof_from(o)
+
+
+def _valupdates_obj(vs):
+    return [[v.pub_key, v.power] for v in vs]
+
+
+def _valupdates_from(o):
+    return [abci.ValidatorUpdate(pub_key=v[0], power=v[1]) for v in o]
+
+
+def _header_obj(h):
+    if h is None:
+        return None
+    from ..types import serde
+
+    return serde.header_obj(h)
+
+
+def _header_from(o):
+    if o is None:
+        return None
+    from ..types import serde
+
+    return serde.header_from(o)
+
+
+@dataclass
+class Codec:
+    encode: Callable
+    decode: Callable
+
+
+REQUEST_CODECS = {
+    "info": Codec(lambda r: [r.version], lambda o: abci.RequestInfo(version=o[0])),
+    "set_option": Codec(lambda r: [r.key, r.value], lambda o: abci.RequestSetOption(*o)),
+    "query": Codec(
+        lambda r: [r.data, r.path, r.height, r.prove],
+        lambda o: abci.RequestQuery(data=o[0], path=o[1], height=o[2], prove=o[3]),
+    ),
+    "init_chain": Codec(
+        lambda r: [
+            r.time,
+            r.chain_id,
+            _params_obj(r.consensus_params),
+            _valupdates_obj(r.validators),
+            r.app_state_bytes,
+        ],
+        lambda o: abci.RequestInitChain(
+            time=o[0],
+            chain_id=o[1],
+            consensus_params=_params_from(o[2]),
+            validators=_valupdates_from(o[3]),
+            app_state_bytes=o[4],
+        ),
+    ),
+    "begin_block": Codec(
+        lambda r: [
+            r.hash,
+            _header_obj(r.header),
+            [r.last_commit_info.round, [list(v) for v in r.last_commit_info.votes]],
+            [
+                [e.type, e.validator_address, e.validator_power, e.height, e.time, e.total_voting_power]
+                for e in r.byzantine_validators
+            ],
+        ],
+        lambda o: abci.RequestBeginBlock(
+            hash=o[0],
+            header=_header_from(o[1]),
+            last_commit_info=abci.LastCommitInfo(round=o[2][0], votes=[tuple(v) for v in o[2][1]]),
+            byzantine_validators=[
+                abci.Evidence(
+                    type=e[0],
+                    validator_address=e[1],
+                    validator_power=e[2],
+                    height=e[3],
+                    time=e[4],
+                    total_voting_power=e[5],
+                )
+                for e in o[3]
+            ],
+        ),
+    ),
+    "end_block": Codec(lambda r: [r.height], lambda o: abci.RequestEndBlock(height=o[0])),
+}
+
+RESPONSE_CODECS = {
+    "info": Codec(
+        lambda r: [r.data, r.version, r.last_block_height, r.last_block_app_hash],
+        lambda o: abci.ResponseInfo(
+            data=o[0], version=o[1], last_block_height=o[2], last_block_app_hash=o[3]
+        ),
+    ),
+    "set_option": Codec(lambda r: [r.code, r.log], lambda o: abci.ResponseSetOption(code=o[0], log=o[1])),
+    "query": Codec(
+        lambda r: [r.code, r.log, r.info, r.index, r.key, r.value, _proof_obj(r.proof), r.height],
+        lambda o: abci.ResponseQuery(
+            code=o[0], log=o[1], info=o[2], index=o[3], key=o[4], value=o[5],
+            proof=_proof_from(o[6]), height=o[7]
+        ),
+    ),
+    "check_tx": Codec(
+        lambda r: [r.code, r.data, r.log, r.info, r.gas_wanted, r.gas_used, _kvpairs_obj(r.tags)],
+        lambda o: abci.ResponseCheckTx(
+            code=o[0], data=o[1], log=o[2], info=o[3], gas_wanted=o[4], gas_used=o[5],
+            tags=_kvpairs_from(o[6]),
+        ),
+    ),
+    "init_chain": Codec(
+        lambda r: [_params_obj(r.consensus_params), _valupdates_obj(r.validators)],
+        lambda o: abci.ResponseInitChain(
+            consensus_params=_params_from(o[0]), validators=_valupdates_from(o[1])
+        ),
+    ),
+    "begin_block": Codec(
+        lambda r: [_kvpairs_obj(r.tags)],
+        lambda o: abci.ResponseBeginBlock(tags=_kvpairs_from(o[0])),
+    ),
+    "deliver_tx": Codec(
+        lambda r: [r.code, r.data, r.log, r.info, r.gas_wanted, r.gas_used, _kvpairs_obj(r.tags)],
+        lambda o: abci.ResponseDeliverTx(
+            code=o[0], data=o[1], log=o[2], info=o[3], gas_wanted=o[4], gas_used=o[5],
+            tags=_kvpairs_from(o[6]),
+        ),
+    ),
+    "end_block": Codec(
+        lambda r: [_valupdates_obj(r.validator_updates), _params_obj(r.consensus_param_updates), _kvpairs_obj(r.tags)],
+        lambda o: abci.ResponseEndBlock(
+            validator_updates=_valupdates_from(o[0]),
+            consensus_param_updates=_params_from(o[1]),
+            tags=_kvpairs_from(o[2]),
+        ),
+    ),
+    "commit": Codec(lambda r: [r.data], lambda o: abci.ResponseCommit(data=o[0])),
+}

@@ -1,0 +1,72 @@
+"""counter — serial-nonce test app (reference abci/example/counter/counter.go).
+
+With serial=on, tx N must be the big-endian encoding of N; CheckTx and
+DeliverTx enforce monotonicity — the standard app for mempool ordering and
+replay tests.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .. import types as abci
+
+
+class CounterApplication(abci.Application):
+    def __init__(self, serial: bool = False):
+        self.serial = serial
+        self.tx_count = 0
+        self.hash_count = 0
+
+    def info(self, req):
+        return abci.ResponseInfo(
+            data=f"{{\"hashes\":{self.hash_count},\"txs\":{self.tx_count}}}",
+            last_block_height=self.hash_count,
+            last_block_app_hash=self._app_hash(),
+        )
+
+    def set_option(self, req):
+        if req.key == "serial":
+            self.serial = req.value == "on"
+            return abci.ResponseSetOption(code=0)
+        return abci.ResponseSetOption(code=1, log=f"unknown option {req.key}")
+
+    def _parse(self, tx: bytes):
+        if len(tx) > 8:
+            return None
+        return int.from_bytes(tx, "big")
+
+    def check_tx(self, tx: bytes):
+        if self.serial:
+            v = self._parse(tx)
+            if v is None:
+                return abci.ResponseCheckTx(code=1, log="tx too long")
+            if v < self.tx_count:
+                return abci.ResponseCheckTx(code=2, log=f"nonce {v} < {self.tx_count}")
+        return abci.ResponseCheckTx(code=0)
+
+    def deliver_tx(self, tx: bytes):
+        if self.serial:
+            v = self._parse(tx)
+            if v is None:
+                return abci.ResponseDeliverTx(code=1, log="tx too long")
+            if v != self.tx_count:
+                return abci.ResponseDeliverTx(code=2, log=f"nonce {v} != {self.tx_count}")
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=0)
+
+    def _app_hash(self) -> bytes:
+        if self.tx_count == 0:
+            return b""
+        return struct.pack(">Q", self.tx_count)
+
+    def commit(self):
+        self.hash_count += 1
+        return abci.ResponseCommit(data=self._app_hash())
+
+    def query(self, req):
+        if req.path == "tx":
+            return abci.ResponseQuery(code=0, value=str(self.tx_count).encode())
+        if req.path == "hash":
+            return abci.ResponseQuery(code=0, value=str(self.hash_count).encode())
+        return abci.ResponseQuery(code=1, log=f"unknown query path {req.path}")

@@ -1,0 +1,138 @@
+"""kvstore — the standard test/bench application
+(reference abci/example/kvstore/kvstore.go + persistent_kvstore.go).
+
+Txs are "key=value" (or raw bytes stored under themselves). State is a
+merkle-ized kv map; commit returns the app hash. The persistent variant
+survives restarts and accepts validator-update txs "val:pubkeyhex!power".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Dict, Optional
+
+from ...crypto import merkle
+from ...libs.db import DB, MemDB
+from .. import types as abci
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self, db: Optional[DB] = None):
+        self.db = db or MemDB()
+        self.size = 0
+        self.height = 0
+        self.app_hash = b""
+        self._load_state()
+
+    def _load_state(self):
+        raw = self.db.get(b"__state__")
+        if raw:
+            o = json.loads(raw.decode())
+            self.size, self.height = o["size"], o["height"]
+            self.app_hash = bytes.fromhex(o["app_hash"])
+
+    def _save_state(self):
+        self.db.set(
+            b"__state__",
+            json.dumps(
+                {"size": self.size, "height": self.height, "app_hash": self.app_hash.hex()}
+            ).encode(),
+        )
+
+    def info(self, req):
+        return abci.ResponseInfo(
+            data=json.dumps({"size": self.size}),
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self.app_hash,
+        )
+
+    def deliver_tx(self, tx: bytes):
+        if b"=" in tx:
+            key, value = tx.split(b"=", 1)
+        else:
+            key, value = tx, tx
+        self.db.set(b"kv:" + key, value)
+        self.size += 1
+        tags = [
+            abci.KVPair(key=b"app.key", value=key),
+            abci.KVPair(key=b"app.creator", value=b"kvstore"),
+        ]
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, tags=tags)
+
+    def check_tx(self, tx: bytes):
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+    def commit(self):
+        self.height += 1
+        # app hash: merkle root over sorted kv pairs + size (cheap, deterministic)
+        items = [k + b"\x00" + v for k, v in self.db.iterator(b"kv:", b"kv;")]
+        root = merkle.hash_from_byte_slices(items)
+        self.app_hash = root + struct.pack(">Q", self.size)
+        self._save_state()
+        return abci.ResponseCommit(data=self.app_hash)
+
+    def query(self, req):
+        if req.path == "/store" or req.path == "":
+            value = self.db.get(b"kv:" + req.data)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK,
+                key=req.data,
+                value=value or b"",
+                log="exists" if value is not None else "does not exist",
+                height=self.height,
+            )
+        return abci.ResponseQuery(code=1, log=f"unknown query path {req.path}")
+
+
+class PersistentKVStoreApplication(KVStoreApplication):
+    """Adds validator updates via "val:<pubkeyhex>!<power>" txs
+    (reference persistent_kvstore.go)."""
+
+    VAL_PREFIX = b"val:"
+
+    def __init__(self, db: DB):
+        super().__init__(db)
+        self._val_updates: list = []
+
+    def init_chain(self, req):
+        for v in req.validators:
+            self._set_validator(v)
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req):
+        self._val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, tx: bytes):
+        if tx.startswith(self.VAL_PREFIX):
+            body = tx[len(self.VAL_PREFIX) :]
+            try:
+                pk_hex, power_s = body.split(b"!", 1)
+                update = abci.ValidatorUpdate(
+                    pub_key=bytes.fromhex(pk_hex.decode()), power=int(power_s)
+                )
+            except (ValueError, UnicodeDecodeError) as e:
+                return abci.ResponseDeliverTx(code=1, log=f"bad val tx: {e}")
+            self._set_validator(update)
+            self._val_updates.append(update)
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        return super().deliver_tx(tx)
+
+    def end_block(self, req):
+        return abci.ResponseEndBlock(validator_updates=list(self._val_updates))
+
+    def _set_validator(self, v: abci.ValidatorUpdate):
+        key = b"valset:" + v.pub_key
+        if v.power == 0:
+            self.db.delete(key)
+        else:
+            self.db.set(key, struct.pack(">q", v.power))
+
+    def validators(self):
+        out = []
+        for k, v in self.db.iterator(b"valset:", b"valset;"):
+            out.append(abci.ValidatorUpdate(pub_key=k[len(b"valset:") :], power=struct.unpack(">q", v)[0]))
+        return out

@@ -1,0 +1,105 @@
+"""ABCI socket server — serve an Application out-of-process
+(reference abci/server/socket_server.go). Frames are 4-byte length-prefixed
+msgpack [method, payload]; requests are handled serially per connection
+(the app-side mutex semantics of the reference)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+import msgpack
+
+from ..libs.service import BaseService
+from . import types as abci
+from .codec import REQUEST_CODECS, RESPONSE_CODECS
+
+
+class ABCIServer(BaseService):
+    def __init__(self, address: str, app: abci.Application):
+        super().__init__("ABCIServer")
+        self.address = address
+        self.app = app
+        self._listener = None
+        self._threads = []
+        self._app_lock = threading.Lock()
+
+    def on_start(self):
+        if self.address.startswith("unix://"):
+            path = self.address[len("unix://") :]
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(path)
+        else:
+            host, _, port = self.address.replace("tcp://", "").rpartition(":")
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host or "127.0.0.1", int(port)))
+        self._listener.listen(8)
+        t = threading.Thread(target=self._accept_loop, daemon=True, name="abci-accept")
+        t.start()
+        self._threads.append(t)
+
+    def local_port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def on_stop(self):
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._quit.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True, name="abci-conn"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket):
+        rfile = conn.makefile("rb")
+        try:
+            while not self._quit.is_set():
+                hdr = rfile.read(4)
+                if len(hdr) < 4:
+                    return
+                (n,) = struct.unpack(">I", hdr)
+                data = rfile.read(n)
+                if len(data) < n:
+                    return
+                method, payload = msgpack.unpackb(data, raw=False)
+                try:
+                    resp = self._dispatch(method, payload)
+                    out = msgpack.packb([method, resp], use_bin_type=True)
+                except Exception as e:  # surfaced to client as error frame
+                    out = msgpack.packb(["exception", str(e)], use_bin_type=True)
+                conn.sendall(struct.pack(">I", len(out)) + out)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, method: str, payload):
+        app = self.app
+        with self._app_lock:
+            if method == "echo":
+                return payload
+            if method == "flush":
+                return None
+            if method == "check_tx":
+                return RESPONSE_CODECS["check_tx"].encode(app.check_tx(payload))
+            if method == "deliver_tx":
+                return RESPONSE_CODECS["deliver_tx"].encode(app.deliver_tx(payload))
+            if method == "commit":
+                return RESPONSE_CODECS["commit"].encode(app.commit())
+            if method in REQUEST_CODECS:
+                req = REQUEST_CODECS[method].decode(payload)
+                resp = getattr(app, method)(req)
+                return RESPONSE_CODECS[method].encode(resp)
+            raise ValueError(f"unknown ABCI method {method!r}")

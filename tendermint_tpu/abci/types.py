@@ -1,0 +1,216 @@
+"""ABCI — the application interface (reference abci/types/application.go:11-26).
+
+Request/response types as dataclasses (replacing the generated protobuf
+types.pb.go); the wire codec for socket/grpc connections is msgpack-framed
+(see abci/server.py, abci/client.py). Method set is the v0.27 surface:
+Echo/Flush/Info/SetOption/Query + CheckTx + InitChain/BeginBlock/DeliverTx/
+EndBlock/Commit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+CODE_TYPE_OK = 0
+
+
+@dataclass
+class KVPair:
+    key: bytes
+    value: bytes
+
+
+@dataclass
+class RequestInfo:
+    version: str = ""
+
+
+@dataclass
+class ResponseInfo:
+    data: str = ""
+    version: str = ""
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class RequestSetOption:
+    key: str = ""
+    value: str = ""
+
+
+@dataclass
+class ResponseSetOption:
+    code: int = 0
+    log: str = ""
+
+
+@dataclass
+class RequestQuery:
+    data: bytes = b""
+    path: str = ""
+    height: int = 0
+    prove: bool = False
+
+
+@dataclass
+class ResponseQuery:
+    code: int = 0
+    log: str = ""
+    info: str = ""
+    index: int = 0
+    key: bytes = b""
+    value: bytes = b""
+    proof: Optional[object] = None
+    height: int = 0
+
+
+@dataclass
+class ValidatorUpdate:
+    pub_key: bytes  # type-tagged pubkey bytes (crypto.pubkey_to_bytes)
+    power: int
+
+
+@dataclass
+class BlockSizeParams:
+    max_bytes: int = 0
+    max_gas: int = 0
+
+
+@dataclass
+class EvidenceParams:
+    max_age: int = 0
+
+
+@dataclass
+class ConsensusParamUpdates:
+    block_size: Optional[BlockSizeParams] = None
+    evidence: Optional[EvidenceParams] = None
+
+
+@dataclass
+class RequestInitChain:
+    time: int = 0
+    chain_id: str = ""
+    consensus_params: Optional[ConsensusParamUpdates] = None
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+
+
+@dataclass
+class ResponseInitChain:
+    consensus_params: Optional[ConsensusParamUpdates] = None
+    validators: List[ValidatorUpdate] = field(default_factory=list)
+
+
+@dataclass
+class Evidence:
+    type: str = ""
+    validator_address: bytes = b""
+    validator_power: int = 0
+    height: int = 0
+    time: int = 0
+    total_voting_power: int = 0
+
+
+@dataclass
+class LastCommitInfo:
+    round: int = 0
+    # (address, power, signed_last_block)
+    votes: List[tuple] = field(default_factory=list)
+
+
+@dataclass
+class RequestBeginBlock:
+    hash: bytes = b""
+    header: Optional[object] = None  # types.Header (structural)
+    last_commit_info: LastCommitInfo = field(default_factory=LastCommitInfo)
+    byzantine_validators: List[Evidence] = field(default_factory=list)
+
+
+@dataclass
+class ResponseBeginBlock:
+    tags: List[KVPair] = field(default_factory=list)
+
+
+@dataclass
+class ResponseCheckTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    tags: List[KVPair] = field(default_factory=list)
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class ResponseDeliverTx:
+    code: int = 0
+    data: bytes = b""
+    log: str = ""
+    info: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    tags: List[KVPair] = field(default_factory=list)
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class RequestEndBlock:
+    height: int = 0
+
+
+@dataclass
+class ResponseEndBlock:
+    validator_updates: List[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: Optional[ConsensusParamUpdates] = None
+    tags: List[KVPair] = field(default_factory=list)
+
+
+@dataclass
+class ResponseCommit:
+    data: bytes = b""  # app hash
+
+
+class Application:
+    """The interface apps implement (reference abci/types/application.go).
+    BaseApplication provides no-op defaults."""
+
+    def info(self, req: RequestInfo) -> ResponseInfo:
+        return ResponseInfo()
+
+    def set_option(self, req: RequestSetOption) -> ResponseSetOption:
+        return ResponseSetOption()
+
+    def query(self, req: RequestQuery) -> ResponseQuery:
+        return ResponseQuery(code=CODE_TYPE_OK)
+
+    def check_tx(self, tx: bytes) -> ResponseCheckTx:
+        return ResponseCheckTx(code=CODE_TYPE_OK)
+
+    def init_chain(self, req: RequestInitChain) -> ResponseInitChain:
+        return ResponseInitChain()
+
+    def begin_block(self, req: RequestBeginBlock) -> ResponseBeginBlock:
+        return ResponseBeginBlock()
+
+    def deliver_tx(self, tx: bytes) -> ResponseDeliverTx:
+        return ResponseDeliverTx(code=CODE_TYPE_OK)
+
+    def end_block(self, req: RequestEndBlock) -> ResponseEndBlock:
+        return ResponseEndBlock()
+
+    def commit(self) -> ResponseCommit:
+        return ResponseCommit()
+
+
+BaseApplication = Application

@@ -1,0 +1,285 @@
+"""GF(2^255-19) arithmetic on TPU: 20 x 13-bit limbs in int32, batch-last.
+
+Design notes (this is the arithmetic core of the batch-verify north star,
+replacing the serial per-signature loop at reference
+crypto/ed25519/ed25519.go:151-157):
+
+- A field element is an int32 array of shape (20, B): limb i holds bits
+  [13i, 13i+13). Batch B is the LAST axis so it maps onto the TPU's
+  128-wide vector lanes; limb position is the sublane axis.
+- 13-bit limbs keep schoolbook products in int32: 20 partial products of
+  <= (2^13.3)^2 sum to < 2^31 with ~15% headroom. The working invariant
+  after every op is |limb| <= LIMB_BOUND (~2^13.3, small negatives allowed
+  from subtraction borrows); exact canonical form only exists after
+  freeze().
+- Carries are PARALLEL rounds (shift/mask/roll over the limb axis), not a
+  sequential 20-step chain — 4 rounds bound limbs back under LIMB_BOUND
+  from any conv output. 2^260 overflow folds back multiplied by 608
+  (2^260 = 32 * (2^255-19) + 608*... precisely: 2^260 mod p = 608), and a
+  *negative* top carry folds the same way, which adds a multiple of p —
+  value mod p is preserved in both directions.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ref
+from .pack import BITS, MASK, NLIMB, int_to_limbs
+
+# bound on |limb| maintained between ops (see module docstring)
+LIMB_BOUND = MASK + 1216 + 2  # 8191 + fold residue; conv stays in int32
+
+
+@lru_cache(maxsize=None)
+def _const_np(v: int):
+    # cache numpy, not device arrays: device constants created inside a jit
+    # trace are tracers and must never leak across traces
+    return int_to_limbs(v, NLIMB)[:, None]
+
+
+def const_fe(v: int) -> jnp.ndarray:
+    """Python int -> (20, 1) limb constant (broadcasts over batch)."""
+    return jnp.asarray(_const_np(v % ref.P), dtype=jnp.int32)
+
+
+def _cached_const(v: int):
+    return const_fe(v)
+
+
+def zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+def _carry_round(v):
+    """One parallel carry round within 20 limbs; top carry folds via 608."""
+    r = v >> BITS
+    m = v & MASK
+    m = m.at[1:].add(r[:-1])
+    m = m.at[0].add(608 * r[19])
+    return m
+
+
+def _reduce_conv(c):
+    """39-coefficient conv output -> 20 bounded limbs (fold + carries)."""
+    # round 1 over 39 coeffs, then fold positions >= 20 (x608)
+    r = c >> BITS
+    m = c & MASK
+    pad = [(0, 0)] * (c.ndim - 1)
+    full = jnp.pad(m, [(0, 1)] + pad) + jnp.pad(r, [(1, 0)] + pad)
+    v = full[:NLIMB] + 608 * full[NLIMB:]
+    for _ in range(3):
+        v = _carry_round(v)
+    return v
+
+
+def mul(a, b):
+    """Field multiply. Inputs with |limb| <= LIMB_BOUND; output likewise.
+
+    Schoolbook conv as padded shifts + a balanced tree sum — keeps the
+    whole product chain elementwise/fusible (dynamic-update-slice chains
+    defeat XLA fusion and were ~10x slower on TPU).
+    """
+    pad = [(0, 0)] * (max(a.ndim, b.ndim) - 1)
+    terms = [
+        jnp.pad(a[i] * b, [(i, NLIMB - 1 - i)] + pad) for i in range(NLIMB)
+    ]
+    while len(terms) > 1:
+        terms = [
+            terms[j] + terms[j + 1] if j + 1 < len(terms) else terms[j]
+            for j in range(0, len(terms), 2)
+        ]
+    return _reduce_conv(terms[0])
+
+
+def square(a):
+    """a^2 — exploits conv symmetry: c[k] = sum_{i<j, i+j=k} 2 a_i a_j
+    + (a_{k/2})^2, roughly halving the multiplies."""
+    a2 = a + a
+    pad = [(0, 0)] * (a.ndim - 1)
+    terms = []
+    for i in range(NLIMB):
+        # diagonal term once, cross terms with doubled operand for j > i
+        row = a[i] * jnp.concatenate(
+            [a[i : i + 1], a2[i + 1 :]], axis=0
+        )  # (NLIMB - i, B)
+        terms.append(jnp.pad(row, [(2 * i, NLIMB - 1 - i)] + pad))
+    while len(terms) > 1:
+        terms = [
+            terms[j] + terms[j + 1] if j + 1 < len(terms) else terms[j]
+            for j in range(0, len(terms), 2)
+        ]
+    return _reduce_conv(terms[0])
+
+
+def add(a, b):
+    return _carry_round(a + b)
+
+
+def sub(a, b):
+    return _carry_round(a - b)
+
+
+def neg(a):
+    return _carry_round(-a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small positive constant (k < 2^17)."""
+    v = a * jnp.int32(k)
+    for _ in range(3):
+        v = _carry_round(v)
+    return v
+
+
+def select(mask, a, b):
+    """Per-batch-item select: mask (B,) bool -> where(mask, a, b)."""
+    return jnp.where(mask[None, :], a, b)
+
+
+def _pow2k(x, k: int):
+    return jax.lax.fori_loop(0, k, lambda _, v: square(v), x)
+
+
+def _pow_chain_250(z):
+    """z^(2^250 - 1) — shared prefix of the inversion/sqrt chains."""
+    z2 = square(z)  # 2
+    t = square(z2)  # 4
+    t = square(t)  # 8
+    z9 = mul(t, z)  # 9
+    z11 = mul(z9, z2)  # 11
+    t = square(z11)  # 22
+    z_5_0 = mul(t, z9)  # 2^5 - 1
+    t = _pow2k(z_5_0, 5)
+    z_10_0 = mul(t, z_5_0)  # 2^10 - 1
+    t = _pow2k(z_10_0, 10)
+    z_20_0 = mul(t, z_10_0)  # 2^20 - 1
+    t = _pow2k(z_20_0, 20)
+    z_40_0 = mul(t, z_20_0)  # 2^40 - 1
+    t = _pow2k(z_40_0, 10)
+    z_50_0 = mul(t, z_10_0)  # 2^50 - 1
+    t = _pow2k(z_50_0, 50)
+    z_100_0 = mul(t, z_50_0)  # 2^100 - 1
+    t = _pow2k(z_100_0, 100)
+    z_200_0 = mul(t, z_100_0)  # 2^200 - 1
+    t = _pow2k(z_200_0, 50)
+    z_250_0 = mul(t, z_50_0)  # 2^250 - 1
+    return z_250_0, z11
+
+
+def invert(z):
+    """z^(p-2) = z^(2^255 - 21)."""
+    z_250_0, z11 = _pow_chain_250(z)
+    t = _pow2k(z_250_0, 5)
+    return mul(t, z11)
+
+
+def pow22523(z):
+    """z^((p-5)/8) = z^(2^252 - 3)."""
+    z_250_0, _ = _pow_chain_250(z)
+    t = _pow2k(z_250_0, 2)
+    return mul(t, z)
+
+
+# --- canonical form --------------------------------------------------------
+
+
+def _seq_carry(v):
+    """Exact sequential carry chain; returns (limbs in [0, 2^13), carry_out).
+
+    Works for signed inputs (arithmetic shift keeps value invariant).
+    """
+    outs = []
+    carry = jnp.zeros(v.shape[1:], dtype=jnp.int32)
+    for i in range(v.shape[0]):
+        t = v[i] + carry
+        carry = t >> BITS
+        outs.append(t & MASK)
+    return jnp.stack(outs), carry
+
+
+def _cond_sub(v, const_limbs):
+    """v - const if that's >= 0, else v. Both canonical 20-limb."""
+    t = v - const_limbs
+    outs = []
+    borrow = jnp.zeros(v.shape[1:], dtype=jnp.int32)
+    for i in range(NLIMB):
+        x = t[i] + borrow
+        borrow = x >> BITS
+        outs.append(x & MASK)
+    t_norm = jnp.stack(outs)
+    underflow = borrow < 0
+    return jnp.where(underflow[None, :], v, t_norm)
+
+
+def _p_multiples():
+    # trailing extra 1*p covers the value in [32p, 32p+608) edge after folding
+    return [const_fe_raw(k * ref.P) for k in (16, 8, 4, 2, 1, 1)]
+
+
+def const_fe_raw(v: int) -> jnp.ndarray:
+    """Like const_fe but without mod-p reduction (for p multiples)."""
+    return jnp.asarray(_const_np_raw(v), dtype=jnp.int32)
+
+
+@lru_cache(maxsize=None)
+def _const_np_raw(v: int):
+    return int_to_limbs(v, NLIMB)[:, None]
+
+
+def freeze(a):
+    """Fully canonical limbs in [0, p). Sequential — use once per encode."""
+    v = a
+    for _ in range(2):
+        limbs, carry = _seq_carry(v)
+        v = limbs.at[0].add(608 * carry)
+    limbs, carry = _seq_carry(v)  # carry is 0 now; value < 32p
+    v = limbs
+    for m in _p_multiples():
+        v = _cond_sub(v, m)
+    return v
+
+
+def is_zero_frozen(a_frozen):
+    return jnp.all(a_frozen == 0, axis=0)
+
+
+def eq_mod_p(a, b):
+    """a == b (mod p), arbitrary representations."""
+    return is_zero_frozen(freeze(sub(a, b)))
+
+
+def parity_frozen(a_frozen):
+    return a_frozen[0] & 1
+
+
+# --- square root (point decompression) ------------------------------------
+
+
+def sqrt_ratio(u, v):
+    """x with v*x^2 == u, per RFC 8032 §5.1.3. Returns (x, ok)."""
+    v2 = square(v)
+    v3 = mul(v2, v)
+    v7 = mul(square(v3), v)
+    t = pow22523(mul(u, v7))
+    x = mul(mul(u, v3), t)
+    vxx = mul(v, square(x))
+    ok_plus = eq_mod_p(vxx, u)
+    ok_minus = eq_mod_p(vxx, neg(u))
+    sqrt_m1 = _cached_const(ref.SQRT_M1)
+    x = select(ok_minus, mul(x, sqrt_m1), x)
+    return x, ok_plus | ok_minus
+
+
+# --- host conversion helpers (tests/debug) ---------------------------------
+
+
+def to_int(a) -> int:
+    """Single element (20,) or (20,1) -> python int (value, not mod p)."""
+    arr = np.asarray(a).reshape(NLIMB, -1)
+    assert arr.shape[1] == 1
+    return sum(int(arr[i, 0]) << (BITS * i) for i in range(NLIMB))

@@ -1,0 +1,116 @@
+"""Host-side (numpy) packing: bytes <-> 13-bit limbs, SHA-512 padding.
+
+The device kernel wants batch-last layouts — field elements are (20, B)
+int32 limb arrays (batch rides the TPU's 128-wide lanes), SHA-512 message
+words are (NB, 16, 2, B) uint32 (hi, lo) pairs. Everything here is
+vectorized numpy; no per-item Python loops on the hot path.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+BITS = 13
+MASK = (1 << BITS) - 1
+NLIMB = 20  # 260 bits >= field/scalar width
+
+
+def int_to_limbs(v: int, n: int = NLIMB) -> np.ndarray:
+    out = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        out[i] = v & MASK
+        v >>= BITS
+    if v:
+        raise ValueError("value does not fit in limbs")
+    return out
+
+
+def limbs_to_int(limbs) -> int:
+    v = 0
+    for i, l in enumerate(np.asarray(limbs).tolist()):
+        v += int(l) << (BITS * i)
+    return v
+
+
+def bytes_to_limbs_batch(arr: np.ndarray, nlimb: int = NLIMB) -> np.ndarray:
+    """(B, nbytes) uint8 little-endian -> (nlimb, B) int32 13-bit limbs."""
+    b, nbytes = arr.shape
+    bits = np.unpackbits(arr, axis=1, bitorder="little")  # (B, nbytes*8)
+    want = nlimb * BITS
+    if bits.shape[1] < want:
+        bits = np.pad(bits, ((0, 0), (0, want - bits.shape[1])))
+    bits = bits[:, :want].reshape(b, nlimb, BITS)
+    weights = (1 << np.arange(BITS)).astype(np.int32)
+    limbs = (bits.astype(np.int32) * weights).sum(axis=2)  # (B, nlimb)
+    return np.ascontiguousarray(limbs.T.astype(np.int32))
+
+
+def lt_const_le_batch(arr: np.ndarray, const: int) -> np.ndarray:
+    """Vectorized `little-endian-bytes < const` -> bool (B,)."""
+    b, nbytes = arr.shape
+    cb = np.frombuffer(const.to_bytes(nbytes, "little"), dtype=np.uint8)
+    # compare from most significant byte down
+    a_be = arr[:, ::-1].astype(np.int16)
+    c_be = cb[::-1].astype(np.int16)
+    diff = a_be - c_be  # (B, nbytes)
+    neq = diff != 0
+    first = np.argmax(neq, axis=1)  # first differing byte from MSB
+    any_neq = neq.any(axis=1)
+    picked = diff[np.arange(b), first]
+    return np.where(any_neq, picked < 0, False)
+
+
+def split_signatures(sigs: np.ndarray):
+    """(B, 64) uint8 -> (R_y (20,B), R_sign (B,), S limbs (20,B), s_lt_l (B,))."""
+    from . import ref
+
+    r = np.ascontiguousarray(sigs[:, :32])
+    s = np.ascontiguousarray(sigs[:, 32:])
+    sign = (r[:, 31] >> 7).astype(np.int32)
+    r_masked = r.copy()
+    r_masked[:, 31] &= 0x7F
+    r_y = bytes_to_limbs_batch(r_masked)
+    s_limbs = bytes_to_limbs_batch(s)
+    s_ok = lt_const_le_batch(s, ref.L)
+    return r_y, sign, s_limbs, s_ok
+
+
+def split_pubkeys(pks: np.ndarray):
+    """(B, 32) uint8 -> (A_y limbs (20,B), A_sign (B,))."""
+    sign = (pks[:, 31] >> 7).astype(np.int32)
+    masked = pks.copy()
+    masked[:, 31] &= 0x7F
+    return bytes_to_limbs_batch(masked), sign
+
+
+def sha512_pad_batch(prefixes: np.ndarray, msgs: list[bytes]):
+    """Build padded SHA-512 input blocks for SHA512(prefix || msg) per item.
+
+    prefixes: (B, 64) uint8 (R || A). Returns (words, nblocks):
+    words (NB, 16, 2, B) uint32 (hi, lo) pairs where NB is the batch-max
+    block count, and nblocks (B,) int32 — each item's own padded block
+    count. The device compression loop runs NB blocks but only applies
+    updates for block j < nblocks[i], so mixed message lengths hash
+    correctly in one bucket.
+    """
+    b = prefixes.shape[0]
+    maxlen = max((len(m) for m in msgs), default=0)
+    nb = (64 + maxlen + 17 + 127) // 128  # 0x80 byte + 128-bit length field
+    buf = np.zeros((b, nb * 128), dtype=np.uint8)
+    buf[:, :64] = prefixes
+    nblocks = np.zeros(b, dtype=np.int32)
+    for i, m in enumerate(msgs):
+        if m:
+            buf[i, 64 : 64 + len(m)] = np.frombuffer(m, dtype=np.uint8)
+        mlen = 64 + len(m)
+        buf[i, mlen] = 0x80
+        inb = (mlen + 17 + 127) // 128
+        nblocks[i] = inb
+        bitlen = mlen * 8
+        end = inb * 128
+        buf[i, end - 16 : end] = np.frombuffer(bitlen.to_bytes(16, "big"), dtype=np.uint8)
+    words = buf.reshape(b, nb, 16, 8).astype(np.uint32)
+    hi = (words[..., 0] << 24) | (words[..., 1] << 16) | (words[..., 2] << 8) | words[..., 3]
+    lo = (words[..., 4] << 24) | (words[..., 5] << 16) | (words[..., 6] << 8) | words[..., 7]
+    out = np.stack([hi, lo], axis=-1)  # (B, NB, 16, 2)
+    return np.ascontiguousarray(out.transpose(1, 2, 3, 0)), nblocks

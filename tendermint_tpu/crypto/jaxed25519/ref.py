@@ -1,0 +1,173 @@
+"""Pure-Python Ed25519 reference (RFC 8032 math, Go-compatible verify).
+
+Host-side big-int implementation used for (a) precomputing the fixed-base
+window tables consumed by the JAX kernel, and (b) an independent test oracle
+for the device implementation. Verification semantics match the reference's
+forked golang.org/x/crypto/ed25519 (crypto/ed25519/ed25519.go:151-157):
+reject S >= L, decompress A (mod-p interpretation of the y bytes, no
+canonicity requirement), recompute R' = [S]B - [k]A and byte-compare the
+canonical encoding of R' against the signature's R half.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+# extended homogeneous coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z
+IDENTITY = (0, 1, 1, 0)
+
+
+def _recover_x(y: int, sign: int):
+    """x from y per RFC 8032 §5.1.3. Returns None if no square root."""
+    y %= P
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    x = (u * pow(v, 3, P) * pow(u * pow(v, 7, P), (P - 5) // 8, P)) % P
+    vxx = (v * x * x) % P
+    if vxx == u:
+        pass
+    elif vxx == (-u) % P:
+        x = (x * SQRT_M1) % P
+    else:
+        return None
+    if x == 0 and sign == 1:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+def decompress(data: bytes):
+    """32-byte encoding -> extended point, or None. Top bit is the x sign;
+    the remaining 255 bits are y interpreted mod P (Go accepts y >= P)."""
+    if len(data) != 32:
+        return None
+    y = int.from_bytes(data, "little")
+    sign = y >> 255
+    y &= (1 << 255) - 1
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    y %= P
+    return (x, y, 1, (x * y) % P)
+
+
+def compress(pt) -> bytes:
+    X, Y, Z, _ = pt
+    zinv = pow(Z, P - 2, P)
+    x = (X * zinv) % P
+    y = (Y * zinv) % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def add(p, q):
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = ((Y1 - X1) * (Y2 - X2)) % P
+    b = ((Y1 + X1) * (Y2 + X2)) % P
+    c = (T1 * D2 * T2) % P
+    d = (2 * Z1 * Z2) % P
+    e, f, g, h = (b - a) % P, (d - c) % P, (d + c) % P, (b + a) % P
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def double(p):
+    X1, Y1, Z1, _ = p
+    a = (X1 * X1) % P
+    b = (Y1 * Y1) % P
+    c = (2 * Z1 * Z1) % P
+    h = (a + b) % P
+    e = (h - (X1 + Y1) * (X1 + Y1)) % P
+    g = (a - b) % P
+    f = (c + g) % P
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def negate(p):
+    X, Y, Z, T = p
+    return ((-X) % P, Y, Z, (-T) % P)
+
+
+def scalar_mult(k: int, p):
+    q = IDENTITY
+    while k > 0:
+        if k & 1:
+            q = add(q, p)
+        p = double(p)
+        k >>= 1
+    return q
+
+
+def equal(p, q) -> bool:
+    X1, Y1, Z1, _ = p
+    X2, Y2, Z2, _ = q
+    return (X1 * Z2 - X2 * Z1) % P == 0 and (Y1 * Z2 - Y2 * Z1) % P == 0
+
+
+@lru_cache(maxsize=1)
+def base_point():
+    by = (4 * pow(5, P - 2, P)) % P
+    bx = _recover_x(by, 0)
+    return (bx, by, 1, (bx * by) % P)
+
+
+def to_affine(p):
+    X, Y, Z, _ = p
+    zinv = pow(Z, P - 2, P)
+    return (X * zinv) % P, (Y * zinv) % P
+
+
+def niels(p):
+    """Affine precomputed form (y+x, y-x, 2*d*x*y) for mixed additions."""
+    x, y = to_affine(p)
+    return ((y + x) % P, (y - x) % P, (D2 * x * y) % P)
+
+
+NIELS_IDENTITY = (1, 1, 0)
+
+
+@lru_cache(maxsize=1)
+def base_table():
+    """table[i][j] = niels([j * 16^i]B) for i in 0..63, j in 0..15.
+
+    Lets the device compute [S]B as 64 mixed additions with no doublings:
+    S = sum(e_i * 16^i), [S]B = sum([e_i * 16^i]B).
+    """
+    table = []
+    row_base = base_point()  # [16^i]B
+    for _ in range(64):
+        row = [NIELS_IDENTITY]
+        acc = IDENTITY
+        for _ in range(15):
+            acc = add(acc, row_base)
+            row.append(niels(acc))
+        table.append(row)
+        for _ in range(4):
+            row_base = double(row_base)
+    return table
+
+
+def verify(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Go-compatible single verify (test oracle only — the production CPU
+    path is OpenSSL via crypto.keys; the production batch path is JAX)."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    s = int.from_bytes(s_bytes, "little")
+    if s >= L:
+        return False
+    a = decompress(pubkey)
+    if a is None:
+        return False
+    k = int.from_bytes(
+        hashlib.sha512(r_bytes + pubkey + msg).digest(), "little"
+    ) % L
+    rp = add(scalar_mult(s, base_point()), scalar_mult(k, negate(a)))
+    return compress(rp) == r_bytes

@@ -1,0 +1,198 @@
+"""Batched SHA-512 on device (uint32 hi/lo pairs; no native u64 on TPU).
+
+Computes k = SHA512(R || A || M) for every signature in the batch, entirely
+on device, so the hash never bottlenecks the verify pipeline on the host.
+Words are (hi, lo) uint32 pairs; 64-bit adds use an unsigned-compare carry;
+rotations recombine across the pair. Message layout from pack.sha512_pad_batch:
+(NB, 16, 2, B) with per-item active block counts for mixed-length batches.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from math import isqrt
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+MASK64 = (1 << 64) - 1
+
+
+def _icbrt(n: int) -> int:
+    x = int(round(n ** (1 / 3)))
+    while x * x * x > n:
+        x -= 1
+    while (x + 1) ** 3 <= n:
+        x += 1
+    return x
+
+
+@lru_cache(maxsize=1)
+def _constants():
+    """K[0..79] and H0[0..7] as (n, 2) uint32 numpy (hi, lo)."""
+    primes = []
+    c = 2
+    while len(primes) < 80:
+        if all(c % q for q in primes if q * q <= c):
+            primes.append(c)
+        c += 1
+    k = [(_icbrt(p << 192) & MASK64) for p in primes]
+    h0 = [(isqrt(p << 128) & MASK64) for p in primes[:8]]
+    to_pairs = lambda xs: np.array(
+        [[(v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF] for v in xs], dtype=np.uint32
+    )
+    return to_pairs(k), to_pairs(h0)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl
+    carry = (lo < al).astype(U32)
+    hi = ah + bh + carry
+    return hi, lo
+
+
+def _rotr64(h, l, n):
+    n %= 64
+    if n == 0:
+        return h, l
+    if n == 32:
+        return l, h
+    if n < 32:
+        nh = (h >> n) | (l << (32 - n))
+        nl = (l >> n) | (h << (32 - n))
+    else:
+        m = n - 32
+        nh = (l >> m) | (h << (32 - m))
+        nl = (h >> m) | (l << (32 - m))
+    return nh, nl
+
+
+def _shr64(h, l, n):
+    if n < 32:
+        return h >> n, (l >> n) | (h << (32 - n))
+    return jnp.zeros_like(h), h >> (n - 32)
+
+
+def _small_sigma0(h, l):
+    ah, al = _rotr64(h, l, 1)
+    bh, bl = _rotr64(h, l, 8)
+    ch, cl = _shr64(h, l, 7)
+    return ah ^ bh ^ ch, al ^ bl ^ cl
+
+
+def _small_sigma1(h, l):
+    ah, al = _rotr64(h, l, 19)
+    bh, bl = _rotr64(h, l, 61)
+    ch, cl = _shr64(h, l, 6)
+    return ah ^ bh ^ ch, al ^ bl ^ cl
+
+
+def _big_sigma0(h, l):
+    ah, al = _rotr64(h, l, 28)
+    bh, bl = _rotr64(h, l, 34)
+    ch, cl = _rotr64(h, l, 39)
+    return ah ^ bh ^ ch, al ^ bl ^ cl
+
+
+def _big_sigma1(h, l):
+    ah, al = _rotr64(h, l, 14)
+    bh, bl = _rotr64(h, l, 18)
+    ch, cl = _rotr64(h, l, 41)
+    return ah ^ bh ^ ch, al ^ bl ^ cl
+
+
+def _compress_block(state, block, k_const):
+    """state (8, 2, B); block (16, 2, B) -> new state."""
+    bdim = block.shape[-1]
+    w = jnp.zeros((80, 2, bdim), dtype=U32)
+    w = w.at[:16].set(block)
+
+    def sched(i, w):
+        w2h, w2l = _small_sigma1(w[i - 2, 0], w[i - 2, 1])
+        w15h, w15l = _small_sigma0(w[i - 15, 0], w[i - 15, 1])
+        h, l = _add64(w[i - 16, 0], w[i - 16, 1], w2h, w2l)
+        h, l = _add64(h, l, w[i - 7, 0], w[i - 7, 1])
+        h, l = _add64(h, l, w15h, w15l)
+        return w.at[i].set(jnp.stack([h, l]))
+
+    w = jax.lax.fori_loop(16, 80, sched, w)
+
+    def rnd(i, regs):
+        a_h, a_l, b_h, b_l, c_h, c_l, d_h, d_l, e_h, e_l, f_h, f_l, g_h, g_l, hh, hl = regs
+        s1h, s1l = _big_sigma1(e_h, e_l)
+        chh = (e_h & f_h) ^ (~e_h & g_h)
+        chl = (e_l & f_l) ^ (~e_l & g_l)
+        t1h, t1l = _add64(hh, hl, s1h, s1l)
+        t1h, t1l = _add64(t1h, t1l, chh, chl)
+        t1h, t1l = _add64(t1h, t1l, k_const[i, 0], k_const[i, 1])
+        t1h, t1l = _add64(t1h, t1l, w[i, 0], w[i, 1])
+        s0h, s0l = _big_sigma0(a_h, a_l)
+        majh = (a_h & b_h) ^ (a_h & c_h) ^ (b_h & c_h)
+        majl = (a_l & b_l) ^ (a_l & c_l) ^ (b_l & c_l)
+        t2h, t2l = _add64(s0h, s0l, majh, majl)
+        ne_h, ne_l = _add64(d_h, d_l, t1h, t1l)
+        na_h, na_l = _add64(t1h, t1l, t2h, t2l)
+        return (na_h, na_l, a_h, a_l, b_h, b_l, c_h, c_l, ne_h, ne_l, e_h, e_l, f_h, f_l, g_h, g_l)
+
+    regs = tuple(state[i // 2, i % 2] for i in range(16))
+    regs = jax.lax.fori_loop(0, 80, rnd, regs)
+    out = []
+    for i in range(8):
+        h, l = _add64(state[i, 0], state[i, 1], regs[2 * i], regs[2 * i + 1])
+        out.append(jnp.stack([h, l]))
+    return jnp.stack(out)
+
+
+def sha512_batch(words, nblocks):
+    """words (NB, 16, 2, B) uint32, nblocks (B,) int32 -> digest (8, 2, B).
+
+    Runs all NB blocks; block j only updates items with j < nblocks[i].
+    """
+    k_np, h0_np = _constants()
+    k_const = jnp.asarray(k_np)
+    bdim = words.shape[-1]
+    state = jnp.broadcast_to(jnp.asarray(h0_np)[:, :, None], (8, 2, bdim))
+    # tie to the (possibly mesh-sharded) input so loop carries are varying
+    # over the shard_map axis — constants alone are "unvarying" and fail
+    # the scan carry check inside shard_map
+    state = state ^ (words[0, 0, 0] * jnp.uint32(0))
+    nb = words.shape[0]
+    for j in range(nb):
+        new_state = _compress_block(state, words[j], k_const)
+        active = (j < nblocks)[None, None, :]
+        state = jnp.where(active, new_state, state)
+    return state
+
+
+def digest_to_scalar_limbs(digest):
+    """(8, 2, B) uint32 big-endian words -> 40 x 13-bit limbs of the
+    little-endian 512-bit integer (RFC 8032 interpretation)."""
+    # bytes little-endian: byte index 8*w + (7 - b) for word w, BE byte b.
+    # Build the 512-bit little-endian integer's bit stream from the words:
+    # word w contributes bits [64w, 64w+64) as the byte-reversed u64.
+    bdim = digest.shape[-1]
+    # byte k of word w (little-endian within word) = byte (7-k) of BE pair
+    # stream byte k of word w (k=0 first) is the BE word's most-significant
+    # byte first: k 0..3 from hi (MSB down), k 4..7 from lo
+    bytes_per_word = []
+    for w in range(8):
+        hi = digest[w, 0]
+        lo = digest[w, 1]
+        for k in range(8):
+            src, off = (hi, 3 - k) if k < 4 else (lo, 7 - k)
+            bytes_per_word.append((src >> (8 * off)) & 0xFF)
+    allbytes = jnp.stack(bytes_per_word).astype(jnp.int32)  # (64, B) LE bytes
+    # 64 bytes -> 40 limbs of 13 bits: limb i = bits [13i, 13i+13)
+    limbs = []
+    for i in range(40):
+        bit = 13 * i
+        byi, sh = bit // 8, bit % 8
+        v = allbytes[byi] >> sh
+        if byi + 1 < 64:
+            v = v | (allbytes[byi + 1] << (8 - sh))
+        if byi + 2 < 64 and 8 - sh + 8 < 13 + 8:
+            v = v | (allbytes[byi + 2] << (16 - sh))
+        limbs.append(v & 0x1FFF)
+    return jnp.stack(limbs)  # (40, B)

@@ -1,0 +1,119 @@
+"""Thread-safe bit array (reference: libs/common/bit_array.go).
+
+Used for vote bitmaps in VoteSet and the consensus gossip protocol's
+has-vote tracking. numpy-backed so large validator sets stay cheap.
+"""
+
+from __future__ import annotations
+
+import secrets
+import threading
+
+import numpy as np
+
+
+class BitArray:
+    def __init__(self, bits: int):
+        if bits < 0:
+            raise ValueError("negative size")
+        self.bits = bits
+        self._elems = np.zeros(bits, dtype=bool)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_bools(cls, bools) -> "BitArray":
+        ba = cls(len(bools))
+        ba._elems[:] = bools
+        return ba
+
+    def size(self) -> int:
+        return self.bits
+
+    def get_index(self, i: int) -> bool:
+        with self._lock:
+            if i >= self.bits or i < 0:
+                return False
+            return bool(self._elems[i])
+
+    def set_index(self, i: int, v: bool) -> bool:
+        with self._lock:
+            if i >= self.bits or i < 0:
+                return False
+            self._elems[i] = v
+            return True
+
+    def copy(self) -> "BitArray":
+        with self._lock:
+            ba = BitArray(self.bits)
+            ba._elems = self._elems.copy()
+            return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        with self._lock:
+            n = max(self.bits, other.bits)
+            ba = BitArray(n)
+            ba._elems[: self.bits] = self._elems
+            ba._elems[: other.bits] |= other._elems
+            return ba
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        with self._lock:
+            n = min(self.bits, other.bits)
+            ba = BitArray(n)
+            ba._elems = self._elems[:n] & other._elems[:n]
+            return ba
+
+    def not_(self) -> "BitArray":
+        with self._lock:
+            ba = BitArray(self.bits)
+            ba._elems = ~self._elems
+            return ba
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other."""
+        with self._lock:
+            ba = BitArray(self.bits)
+            n = min(self.bits, other.bits)
+            ba._elems = self._elems.copy()
+            ba._elems[:n] &= ~other._elems[:n]
+            return ba
+
+    def is_empty(self) -> bool:
+        with self._lock:
+            return not self._elems.any()
+
+    def is_full(self) -> bool:
+        with self._lock:
+            return self.bits > 0 and bool(self._elems.all())
+
+    def num_true(self) -> int:
+        with self._lock:
+            return int(self._elems.sum())
+
+    def pick_random(self):
+        """Random set bit index, or None (reference BitArray.PickRandom)."""
+        with self._lock:
+            idxs = np.flatnonzero(self._elems)
+            if len(idxs) == 0:
+                return None
+            return int(idxs[secrets.randbelow(len(idxs))])
+
+    def to_bytes(self) -> bytes:
+        with self._lock:
+            return np.packbits(self._elems, bitorder="little").tobytes()
+
+    @classmethod
+    def from_bytes_size(cls, data: bytes, bits: int) -> "BitArray":
+        ba = cls(bits)
+        arr = np.unpackbits(np.frombuffer(data, dtype=np.uint8), bitorder="little")
+        ba._elems[:] = arr[:bits]
+        return ba
+
+    def __eq__(self, other):
+        if not isinstance(other, BitArray):
+            return NotImplemented
+        return self.bits == other.bits and bool((self._elems == other._elems).all())
+
+    def __repr__(self):
+        s = "".join("x" if b else "_" for b in self._elems[:64])
+        return f"BA{{{self.bits}:{s}}}"

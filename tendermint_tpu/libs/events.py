@@ -1,0 +1,183 @@
+"""In-process pubsub with query-language subscriptions.
+
+Replaces the reference's libs/pubsub (+ its PEG query parser,
+libs/pubsub/query/query.peg.go) and libs/events. Events carry string
+tags; subscribers filter with a small query language:
+
+    tm.event = 'NewBlock' AND tx.height > 5
+
+supporting =, <, <=, >, >=, CONTAINS over tag values, combined with AND.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+class QueryError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"\s*(?:(?P<op>=|<=|>=|<|>|CONTAINS)|(?P<and>AND)\b|(?P<key>[\w.\-]+)|'(?P<str>[^']*)')"
+)
+
+
+@dataclass(frozen=True)
+class _Condition:
+    key: str
+    op: str
+    value: str
+
+    def matches(self, tags: Dict[str, str]) -> bool:
+        if self.key not in tags:
+            return False
+        have = tags[self.key]
+        if self.op == "=":
+            return have == self.value
+        if self.op == "CONTAINS":
+            return self.value in have
+        # numeric comparisons
+        try:
+            a, b = float(have), float(self.value)
+        except ValueError:
+            return False
+        return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[self.op]
+
+
+class Query:
+    """Parsed conjunctive tag query (reference libs/pubsub/query)."""
+
+    def __init__(self, s: str):
+        self.raw = s.strip()
+        self.conditions: List[_Condition] = []
+        if self.raw:
+            self._parse(self.raw)
+
+    def _parse(self, s: str) -> None:
+        # split on AND only outside single-quoted values ("x = 'A AND B'"
+        # is one condition): an AND is a separator iff an even number of
+        # quotes follows it
+        parts = re.split(r"\bAND\b(?=(?:[^']*'[^']*')*[^']*$)", s)
+        for part in parts:
+            part = part.strip()
+            m = re.match(
+                r"^(?P<key>[\w.\-]+)\s*(?P<op>=|<=|>=|<|>|CONTAINS)\s*"
+                r"(?:'(?P<qval>[^']*)'|(?P<val>[\w.\-]+))$",
+                part,
+            )
+            if not m:
+                raise QueryError(f"cannot parse query condition {part!r}")
+            self.conditions.append(
+                _Condition(
+                    key=m.group("key"),
+                    op=m.group("op"),
+                    value=m.group("qval") if m.group("qval") is not None else m.group("val"),
+                )
+            )
+
+    def matches(self, tags: Dict[str, str]) -> bool:
+        return all(c.matches(tags) for c in self.conditions)
+
+    def __eq__(self, other):
+        return isinstance(other, Query) and self.raw == other.raw
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def __str__(self):
+        return self.raw
+
+
+@dataclass
+class Message:
+    data: object
+    tags: Dict[str, str] = field(default_factory=dict)
+
+
+class Subscription:
+    """Buffered subscription; read with get()/poll() or drain via callback."""
+
+    def __init__(self, query: Query, capacity: int = 1024):
+        self.query = query
+        self._buf: List[Message] = []
+        self._cond = threading.Condition()
+        self._cancelled = False
+        self.capacity = capacity
+
+    def publish(self, msg: Message) -> bool:
+        with self._cond:
+            if self._cancelled:
+                return False
+            if len(self._buf) >= self.capacity:
+                return False  # slow subscriber: drop (reference: err/unsubscribe)
+            self._buf.append(msg)
+            self._cond.notify_all()
+            return True
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Message]:
+        with self._cond:
+            if not self._buf:
+                self._cond.wait(timeout)
+            if self._buf:
+                return self._buf.pop(0)
+            return None
+
+    def poll(self) -> Optional[Message]:
+        with self._cond:
+            return self._buf.pop(0) if self._buf else None
+
+    def cancel(self) -> None:
+        with self._cond:
+            self._cancelled = True
+            self._cond.notify_all()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+
+class PubSub:
+    """Tag-filtered pubsub server (reference libs/pubsub/pubsub.go)."""
+
+    def __init__(self):
+        self._subs: Dict[tuple, Subscription] = {}
+        self._lock = threading.Lock()
+
+    def subscribe(self, subscriber: str, query: Query, capacity: int = 1024) -> Subscription:
+        key = (subscriber, str(query))
+        with self._lock:
+            if key in self._subs:
+                raise ValueError(f"already subscribed: {key}")
+            sub = Subscription(query, capacity)
+            self._subs[key] = sub
+            return sub
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        key = (subscriber, str(query))
+        with self._lock:
+            sub = self._subs.pop(key, None)
+        if sub:
+            sub.cancel()
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        with self._lock:
+            keys = [k for k in self._subs if k[0] == subscriber]
+            subs = [self._subs.pop(k) for k in keys]
+        for s in subs:
+            s.cancel()
+
+    def publish(self, data: object, tags: Dict[str, str]) -> None:
+        msg = Message(data, tags)
+        with self._lock:
+            subs = list(self._subs.values())
+        for sub in subs:
+            if sub.query.matches(tags):
+                sub.publish(msg)
+
+    def num_subscriptions(self) -> int:
+        with self._lock:
+            return len(self._subs)

@@ -1,0 +1,69 @@
+"""BaseService lifecycle (reference libs/common/service.go).
+
+start/stop-once semantics with overridable on_start/on_stop, shared by
+reactors, the consensus state machine, the switch, and the node itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+
+class AlreadyStartedError(Exception):
+    pass
+
+
+class AlreadyStoppedError(Exception):
+    pass
+
+
+class BaseService:
+    def __init__(self, name: str, logger: logging.Logger | None = None):
+        self.name = name
+        self.logger = logger or logging.getLogger(name)
+        self._started = False
+        self._stopped = False
+        self._quit = threading.Event()
+        self._lifecycle_lock = threading.Lock()
+
+    def start(self) -> None:
+        with self._lifecycle_lock:
+            if self._started:
+                raise AlreadyStartedError(self.name)
+            if self._stopped:
+                raise AlreadyStoppedError(self.name)
+            self.logger.debug("starting %s", self.name)
+            self.on_start()
+            self._started = True
+
+    def stop(self) -> None:
+        with self._lifecycle_lock:
+            if not self._started or self._stopped:
+                return
+            self.logger.debug("stopping %s", self.name)
+            self._quit.set()
+            self.on_stop()
+            self._stopped = True
+
+    def is_running(self) -> bool:
+        with self._lifecycle_lock:
+            return self._started and not self._stopped
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._quit.wait(timeout)
+
+    def quit_event(self) -> threading.Event:
+        return self._quit
+
+    def on_start(self) -> None:  # override
+        pass
+
+    def on_stop(self) -> None:  # override
+        pass
+
+    def reset(self) -> None:
+        with self._lifecycle_lock:
+            self._started = False
+            self._stopped = False
+            self._quit = threading.Event()

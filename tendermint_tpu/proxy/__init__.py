@@ -1,0 +1,76 @@
+"""AppConns — the three typed app connections (reference proxy/).
+
+multi_app_conn.go:12 wires consensus/mempool/query clients from one
+ClientCreator; local creators share a single mutex like local_client.go.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..abci import types as abci
+from ..abci.client import Client, LocalClient, SocketClient
+
+ClientCreator = Callable[[], Client]
+
+
+def local_client_creator(app: abci.Application) -> ClientCreator:
+    lock = threading.Lock()
+
+    def create() -> Client:
+        return LocalClient(app, lock)
+
+    return create
+
+
+def remote_client_creator(address: str) -> ClientCreator:
+    def create() -> Client:
+        return SocketClient(address)
+
+    return create
+
+
+def default_client_creator(address: str) -> ClientCreator:
+    """kvstore/counter/noop in-proc, else socket address
+    (reference proxy/client.go:65-80)."""
+    if address == "kvstore":
+        from ..abci.example.kvstore import KVStoreApplication
+
+        return local_client_creator(KVStoreApplication())
+    if address == "persistent_kvstore":
+        from ..abci.example.kvstore import PersistentKVStoreApplication
+        from ..libs.db import MemDB
+
+        return local_client_creator(PersistentKVStoreApplication(MemDB()))
+    if address == "counter":
+        from ..abci.example.counter import CounterApplication
+
+        return local_client_creator(CounterApplication())
+    if address == "counter_serial":
+        from ..abci.example.counter import CounterApplication
+
+        return local_client_creator(CounterApplication(serial=True))
+    if address == "noop":
+        return local_client_creator(abci.BaseApplication())
+    return remote_client_creator(address)
+
+
+class AppConns:
+    """consensus + mempool + query connections (proxy/app_conn.go:11-41)."""
+
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: Optional[Client] = None
+        self.mempool: Optional[Client] = None
+        self.query: Optional[Client] = None
+
+    def start(self) -> None:
+        self.consensus = self._creator()
+        self.mempool = self._creator()
+        self.query = self._creator()
+
+    def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query):
+            if c is not None:
+                c.close()

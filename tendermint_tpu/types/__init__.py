@@ -1,0 +1,28 @@
+"""Core data model: blocks, votes, validators, commits, evidence, genesis."""
+
+from .basic import (  # noqa: F401
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    ErrVoteConflictingVotes,
+    PartSetHeader,
+    Proposal,
+    Vote,
+    ZERO_BLOCK_ID,
+    canonical_proposal_sign_bytes,
+    canonical_vote_sign_bytes,
+    now_ns,
+)
+from .block import Block, Commit, Data, EvidenceData, Header  # noqa: F401
+from .evidence import DuplicateVoteEvidence, ErrEvidenceInvalid  # noqa: F401
+from .genesis import ConsensusParams, GenesisDoc, GenesisValidator  # noqa: F401
+from .part_set import Part, PartSet  # noqa: F401
+from .validator_set import (  # noqa: F401
+    ErrInvalidCommit,
+    ErrInvalidCommitSignatures,
+    ErrNotEnoughVotingPower,
+    Validator,
+    ValidatorSet,
+    random_validator_set,
+)
+from .vote_set import ErrVoteInvalid, VoteSet  # noqa: F401

@@ -1,0 +1,212 @@
+"""Block, Header, Data, Commit (reference types/block.go).
+
+Hashes: header hash is a merkle tree over the encoded fields (reference
+Header.Hash :403-426 uses a simple map hasher; we use an ordered field
+list — deterministic and proof-friendly); data/evidence/commit hashes are
+merkle roots over item encodings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional
+
+from .. import codec
+from ..crypto import merkle, tmhash
+from .basic import VOTE_TYPE_PRECOMMIT, BlockID, PartSetHeader, Vote
+
+MAX_BLOCK_SIZE_BYTES = 104857600  # reference types/params.go MaxBlockSizeBytes
+
+
+@dataclass
+class Header:
+    chain_id: str = ""
+    height: int = 0
+    time: int = 0  # unix ns
+    num_txs: int = 0
+    total_txs: int = 0
+    last_block_id: BlockID = dc_field(default_factory=BlockID)
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> Optional[bytes]:
+        """Merkle root over encoded fields; None until validators_hash is
+        populated (reference Header.Hash returns nil likewise)."""
+        if not self.validators_hash:
+            return None
+        fields = [
+            codec.t_string(1, self.chain_id),
+            codec.t_fixed64(1, self.height),
+            codec.t_fixed64(1, self.time),
+            codec.t_fixed64(1, self.num_txs),
+            codec.t_fixed64(1, self.total_txs),
+            self.last_block_id.encode(),
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ]
+        return merkle.hash_from_byte_slices(fields)
+
+    def __str__(self):
+        return f"Header{{{self.chain_id}/{self.height} t:{self.time}}}"
+
+
+@dataclass
+class Data:
+    txs: List[bytes] = dc_field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(self.txs)
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return tmhash.sum(tx)
+
+
+@dataclass
+class Commit:
+    """+2/3 precommits for a block (reference types/block.go:480-490).
+    precommits[i] corresponds to validator i of the set; None = absent."""
+
+    block_id: BlockID
+    precommits: List[Optional[Vote]]
+
+    def height(self) -> int:
+        for v in self.precommits:
+            if v is not None:
+                return v.height
+        return 0
+
+    def round(self) -> int:
+        for v in self.precommits:
+            if v is not None:
+                return v.round
+        return 0
+
+    def size(self) -> int:
+        return len(self.precommits)
+
+    def is_commit(self) -> bool:
+        return len(self.precommits) > 0
+
+    def bit_array(self):
+        from ..libs.bit_array import BitArray
+
+        return BitArray.from_bools([v is not None for v in self.precommits])
+
+    def validate_basic(self) -> None:
+        if self.block_id.is_zero():
+            raise ValueError("commit has zero block id")
+        if not self.precommits:
+            raise ValueError("commit has no precommits")
+        h, r = self.height(), self.round()
+        for v in self.precommits:
+            if v is None:
+                continue
+            if v.type != VOTE_TYPE_PRECOMMIT:
+                raise ValueError("commit contains non-precommit vote")
+            if v.height != h or v.round != r:
+                raise ValueError("commit contains vote from wrong height/round")
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [v.encode() if v is not None else b"" for v in self.precommits]
+        )
+
+    def __str__(self):
+        n = sum(1 for v in self.precommits if v is not None)
+        return f"Commit{{{self.height()}/{self.round()} {n}/{len(self.precommits)} {self.block_id}}}"
+
+
+@dataclass
+class EvidenceData:
+    evidence: list = dc_field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([e.encode() for e in self.evidence])
+
+
+@dataclass
+class Block:
+    header: Header
+    data: Data
+    evidence: EvidenceData
+    last_commit: Optional[Commit]
+
+    @classmethod
+    def make(
+        cls,
+        height: int,
+        txs: List[bytes],
+        last_commit: Optional[Commit],
+        evidence: list,
+    ) -> "Block":
+        """Reference types/block.go MakeBlock — header is only partially
+        filled; fill_header + the proposer complete it."""
+        block = cls(
+            header=Header(height=height, num_txs=len(txs)),
+            data=Data(txs=list(txs)),
+            evidence=EvidenceData(evidence=list(evidence)),
+            last_commit=last_commit,
+        )
+        block.fill_header()
+        return block
+
+    def fill_header(self) -> None:
+        h = self.header
+        if not h.last_commit_hash and self.last_commit is not None:
+            h.last_commit_hash = self.last_commit.hash()
+        if not h.data_hash:
+            h.data_hash = self.data.hash()
+        if not h.evidence_hash:
+            h.evidence_hash = self.evidence.hash()
+
+    def hash(self) -> Optional[bytes]:
+        if self.header is None or self.last_commit is None and self.header.height != 1:
+            return None
+        self.fill_header()
+        return self.header.hash()
+
+    def validate_basic(self) -> None:
+        if self.header.height < 1:
+            raise ValueError(f"invalid block height {self.header.height}")
+        if self.header.height > 1:
+            if self.last_commit is None:
+                raise ValueError("nil last_commit for height > 1")
+            self.last_commit.validate_basic()
+            if self.header.last_commit_hash != self.last_commit.hash():
+                raise ValueError("last_commit_hash mismatch")
+        if self.header.num_txs != len(self.data.txs):
+            raise ValueError("num_txs mismatch")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("data_hash mismatch")
+        if self.header.evidence_hash != self.evidence.hash():
+            raise ValueError("evidence_hash mismatch")
+
+    def encode(self) -> bytes:
+        """Deterministic encoding for PartSet chunking / storage."""
+        from . import serde
+
+        return serde.encode_block(self)
+
+    def __str__(self):
+        return f"Block{{{self.header} txs:{len(self.data.txs)}}}"
+
+
+def make_part_set(block: Block, part_size: int = 65536):
+    from .part_set import PartSet
+
+    return PartSet.from_data(block.encode(), part_size)

@@ -1,0 +1,85 @@
+"""Byzantine-fault evidence (reference types/evidence.go).
+
+DuplicateVoteEvidence: two distinct votes by one validator for the same
+height/round/type — proof of equivocation, slashable via ABCI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import codec
+from ..crypto import PubKey, pubkey_from_bytes, pubkey_to_bytes, tmhash
+from .basic import Vote
+
+MAX_EVIDENCE_AGE = 100000  # heights (reference state/validation.go maxEvidenceAge analogue)
+
+
+class ErrEvidenceInvalid(Exception):
+    pass
+
+
+@dataclass
+class DuplicateVoteEvidence:
+    pub_key: PubKey
+    vote_a: Vote
+    vote_b: Vote
+
+    def height(self) -> int:
+        return self.vote_a.height
+
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+    def index(self) -> int:
+        return self.vote_a.validator_index
+
+    def encode(self) -> bytes:
+        return (
+            codec.t_bytes(1, pubkey_to_bytes(self.pub_key))
+            + codec.t_message(2, self.vote_a.encode())
+            + codec.t_message(3, self.vote_b.encode())
+        )
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.encode())
+
+    def verify(self, chain_id: str) -> None:
+        """Raises ErrEvidenceInvalid unless this is genuine equivocation
+        (reference types/evidence.go DuplicateVoteEvidence.Verify)."""
+        a, b = self.vote_a, self.vote_b
+        if a.height != b.height or a.round != b.round or a.type != b.type:
+            raise ErrEvidenceInvalid("votes from different height/round/type")
+        if a.validator_address != b.validator_address:
+            raise ErrEvidenceInvalid("votes from different validators")
+        if a.validator_address != self.pub_key.address():
+            raise ErrEvidenceInvalid("address does not match pubkey")
+        if a.block_id == b.block_id:
+            raise ErrEvidenceInvalid("votes are for the same block — not equivocation")
+        for v in (a, b):
+            if not v.verify(chain_id, self.pub_key):
+                raise ErrEvidenceInvalid("invalid signature on evidence vote")
+
+    def equal(self, other) -> bool:
+        return isinstance(other, DuplicateVoteEvidence) and self.encode() == other.encode()
+
+    def __str__(self):
+        return f"DuplicateVoteEvidence{{{self.address().hex()[:8]} h:{self.height()}}}"
+
+
+def evidence_to_obj(e):
+    from .serde import vote_obj
+
+    if isinstance(e, DuplicateVoteEvidence):
+        return ["duplicate_vote", pubkey_to_bytes(e.pub_key), vote_obj(e.vote_a), vote_obj(e.vote_b)]
+    raise TypeError(f"unknown evidence type {type(e)}")
+
+
+def evidence_from_obj(o):
+    from .serde import vote_from
+
+    if o[0] == "duplicate_vote":
+        return DuplicateVoteEvidence(
+            pub_key=pubkey_from_bytes(o[1]), vote_a=vote_from(o[2]), vote_b=vote_from(o[3])
+        )
+    raise ValueError(f"unknown evidence kind {o[0]!r}")

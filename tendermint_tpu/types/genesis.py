@@ -1,0 +1,156 @@
+"""Genesis document + consensus params (reference types/genesis.go,
+types/params.go)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional
+
+from ..crypto import PubKey, pubkey_from_bytes, pubkey_to_bytes, tmhash
+from .basic import now_ns
+from .validator_set import Validator
+
+MAX_CHAIN_ID_LEN = 50
+
+
+@dataclass
+class BlockSizeParams:
+    max_bytes: int = 22020096  # 21MB (reference types/params.go:18)
+    max_gas: int = -1
+
+
+@dataclass
+class EvidenceParams:
+    max_age: int = 100000
+
+
+@dataclass
+class ConsensusParams:
+    block_size: BlockSizeParams = dc_field(default_factory=BlockSizeParams)
+    evidence: EvidenceParams = dc_field(default_factory=EvidenceParams)
+
+    def validate(self) -> None:
+        if self.block_size.max_bytes <= 0 or self.block_size.max_bytes > 104857600:
+            raise ValueError(f"invalid max_bytes {self.block_size.max_bytes}")
+        if self.evidence.max_age <= 0:
+            raise ValueError("evidence max_age must be positive")
+
+    def hash(self) -> bytes:
+        return tmhash.sum(
+            json.dumps(
+                {
+                    "block_size": [self.block_size.max_bytes, self.block_size.max_gas],
+                    "evidence": [self.evidence.max_age],
+                },
+                sort_keys=True,
+            ).encode()
+        )
+
+    def update(self, abci_params) -> "ConsensusParams":
+        """Apply ABCI EndBlock param updates (None fields keep current)."""
+        res = ConsensusParams(
+            BlockSizeParams(self.block_size.max_bytes, self.block_size.max_gas),
+            EvidenceParams(self.evidence.max_age),
+        )
+        if abci_params is None:
+            return res
+        if abci_params.block_size is not None:
+            res.block_size.max_bytes = abci_params.block_size.max_bytes
+            res.block_size.max_gas = abci_params.block_size.max_gas
+        if abci_params.evidence is not None:
+            res.evidence.max_age = abci_params.evidence.max_age
+        return res
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKey
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time: int = dc_field(default_factory=now_ns)
+    consensus_params: ConsensusParams = dc_field(default_factory=ConsensusParams)
+    validators: List[GenesisValidator] = dc_field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: Optional[dict] = None
+
+    def validate_and_complete(self) -> None:
+        if not self.chain_id:
+            raise ValueError("genesis doc must include non-empty chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise ValueError(f"chain_id length must be <= {MAX_CHAIN_ID_LEN}")
+        self.consensus_params.validate()
+        for i, v in enumerate(self.validators):
+            if v.power == 0:
+                raise ValueError(f"genesis validator {i} has zero voting power")
+
+    def validator_set_validators(self) -> List[Validator]:
+        return [Validator.new(v.pub_key, v.power) for v in self.validators]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "chain_id": self.chain_id,
+                "genesis_time": self.genesis_time,
+                "consensus_params": {
+                    "block_size": {
+                        "max_bytes": self.consensus_params.block_size.max_bytes,
+                        "max_gas": self.consensus_params.block_size.max_gas,
+                    },
+                    "evidence": {"max_age": self.consensus_params.evidence.max_age},
+                },
+                "validators": [
+                    {
+                        "pub_key": pubkey_to_bytes(v.pub_key).hex(),
+                        "power": v.power,
+                        "name": v.name,
+                    }
+                    for v in self.validators
+                ],
+                "app_hash": self.app_hash.hex(),
+                "app_state": self.app_state,
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, data: str) -> "GenesisDoc":
+        o = json.loads(data)
+        doc = cls(
+            chain_id=o["chain_id"],
+            genesis_time=o.get("genesis_time", 0),
+            consensus_params=ConsensusParams(
+                BlockSizeParams(
+                    o["consensus_params"]["block_size"]["max_bytes"],
+                    o["consensus_params"]["block_size"]["max_gas"],
+                ),
+                EvidenceParams(o["consensus_params"]["evidence"]["max_age"]),
+            ),
+            validators=[
+                GenesisValidator(
+                    pub_key=pubkey_from_bytes(bytes.fromhex(v["pub_key"])),
+                    power=v["power"],
+                    name=v.get("name", ""),
+                )
+                for v in o.get("validators", [])
+            ],
+            app_hash=bytes.fromhex(o.get("app_hash", "")),
+            app_state=o.get("app_state"),
+        )
+        doc.validate_and_complete()
+        return doc
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

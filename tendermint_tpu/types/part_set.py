@@ -1,0 +1,98 @@
+"""PartSet — block chunking for gossip (reference types/part_set.go).
+
+A block's deterministic encoding is split into fixed-size parts; the
+PartSetHeader (total, merkle root) identifies the set, and each Part
+carries a merkle proof so peers can verify chunks independently.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..crypto import merkle
+from ..libs.bit_array import BitArray
+from .basic import PartSetHeader
+
+BLOCK_PART_SIZE = 65536
+
+
+@dataclass
+class Part:
+    index: int
+    bytes: bytes
+    proof: merkle.SimpleProof
+
+    def validate(self, header: PartSetHeader) -> bool:
+        return (
+            self.proof.index == self.index
+            and self.proof.total == header.total
+            and self.proof.verify(header.hash, self.bytes)
+        )
+
+
+class PartSet:
+    def __init__(self, header: PartSetHeader):
+        self._header = header
+        self._parts: List[Optional[Part]] = [None] * header.total
+        self._bit_array = BitArray(header.total)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
+        chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps._parts[i] = Part(index=i, bytes=chunk, proof=proof)
+        ps._bit_array = BitArray.from_bools([True] * len(chunks))
+        ps._count = len(chunks)
+        return ps
+
+    def header(self) -> PartSetHeader:
+        return self._header
+
+    def has_header(self, header: PartSetHeader) -> bool:
+        return self._header == header
+
+    def bit_array(self) -> BitArray:
+        with self._lock:
+            return self._bit_array.copy()
+
+    def total(self) -> int:
+        return self._header.total
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def is_complete(self) -> bool:
+        with self._lock:
+            return self._count == self._header.total
+
+    def add_part(self, part: Part) -> bool:
+        """Returns True if added; raises ValueError on invalid proof."""
+        with self._lock:
+            if part.index >= self._header.total:
+                raise ValueError("part index out of range")
+            if self._parts[part.index] is not None:
+                return False
+            if not part.validate(self._header):
+                raise ValueError("invalid part proof")
+            self._parts[part.index] = part
+            self._bit_array.set_index(part.index, True)
+            self._count += 1
+            return True
+
+    def get_part(self, index: int) -> Optional[Part]:
+        with self._lock:
+            if 0 <= index < len(self._parts):
+                return self._parts[index]
+            return None
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("part set incomplete")
+        return b"".join(p.bytes for p in self._parts)

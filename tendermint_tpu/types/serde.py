@@ -1,0 +1,248 @@
+"""Structural serialization for storage / WAL / p2p (msgpack, list-shaped).
+
+Deterministic: every type encodes as a fixed-order list (never a map), so
+identical values yield identical bytes — required because the block's
+part-set hash commits to these bytes. Distinct from the codec module,
+which produces the minimal canonical encodings used for sign-bytes and
+merkle leaves only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import msgpack
+
+from ..crypto import merkle, pubkey_from_bytes, pubkey_to_bytes
+from .basic import BlockID, PartSetHeader, Proposal, Vote
+from .block import Block, Commit, Data, EvidenceData, Header
+from .part_set import Part
+from .validator_set import Validator, ValidatorSet
+
+
+def pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False, strict_map_key=False)
+
+
+# --- to_obj / from_obj -----------------------------------------------------
+
+
+def psh_obj(p: PartSetHeader):
+    return [p.total, p.hash]
+
+
+def psh_from(o) -> PartSetHeader:
+    return PartSetHeader(total=o[0], hash=o[1])
+
+
+def block_id_obj(b: BlockID):
+    return [b.hash, psh_obj(b.parts_header)]
+
+
+def block_id_from(o) -> BlockID:
+    return BlockID(hash=o[0], parts_header=psh_from(o[1]))
+
+
+def vote_obj(v: Optional[Vote]):
+    if v is None:
+        return None
+    return [
+        v.validator_address,
+        v.validator_index,
+        v.height,
+        v.round,
+        v.timestamp,
+        v.type,
+        block_id_obj(v.block_id),
+        v.signature,
+    ]
+
+
+def vote_from(o) -> Optional[Vote]:
+    if o is None:
+        return None
+    return Vote(
+        validator_address=o[0],
+        validator_index=o[1],
+        height=o[2],
+        round=o[3],
+        timestamp=o[4],
+        type=o[5],
+        block_id=block_id_from(o[6]),
+        signature=o[7],
+    )
+
+
+def proposal_obj(p: Proposal):
+    return [
+        p.height,
+        p.round,
+        psh_obj(p.block_parts_header),
+        p.pol_round,
+        block_id_obj(p.pol_block_id),
+        p.timestamp,
+        p.signature,
+    ]
+
+
+def proposal_from(o) -> Proposal:
+    return Proposal(
+        height=o[0],
+        round=o[1],
+        block_parts_header=psh_from(o[2]),
+        pol_round=o[3],
+        pol_block_id=block_id_from(o[4]),
+        timestamp=o[5],
+        signature=o[6],
+    )
+
+
+def commit_obj(c: Optional[Commit]):
+    if c is None:
+        return None
+    return [block_id_obj(c.block_id), [vote_obj(v) for v in c.precommits]]
+
+
+def commit_from(o) -> Optional[Commit]:
+    if o is None:
+        return None
+    return Commit(block_id=block_id_from(o[0]), precommits=[vote_from(v) for v in o[1]])
+
+
+def header_obj(h: Header):
+    return [
+        h.chain_id,
+        h.height,
+        h.time,
+        h.num_txs,
+        h.total_txs,
+        block_id_obj(h.last_block_id),
+        h.last_commit_hash,
+        h.data_hash,
+        h.validators_hash,
+        h.next_validators_hash,
+        h.consensus_hash,
+        h.app_hash,
+        h.last_results_hash,
+        h.evidence_hash,
+        h.proposer_address,
+    ]
+
+
+def header_from(o) -> Header:
+    return Header(
+        chain_id=o[0],
+        height=o[1],
+        time=o[2],
+        num_txs=o[3],
+        total_txs=o[4],
+        last_block_id=block_id_from(o[5]),
+        last_commit_hash=o[6],
+        data_hash=o[7],
+        validators_hash=o[8],
+        next_validators_hash=o[9],
+        consensus_hash=o[10],
+        app_hash=o[11],
+        last_results_hash=o[12],
+        evidence_hash=o[13],
+        proposer_address=o[14],
+    )
+
+
+def evidence_obj(e):
+    from .evidence import evidence_to_obj
+
+    return evidence_to_obj(e)
+
+
+def block_obj(b: Block):
+    return [
+        header_obj(b.header),
+        [bytes(t) for t in b.data.txs],
+        [evidence_obj(e) for e in b.evidence.evidence],
+        commit_obj(b.last_commit),
+    ]
+
+
+def block_from(o) -> Block:
+    from .evidence import evidence_from_obj
+
+    return Block(
+        header=header_from(o[0]),
+        data=Data(txs=list(o[1])),
+        evidence=EvidenceData(evidence=[evidence_from_obj(e) for e in o[2]]),
+        last_commit=commit_from(o[3]),
+    )
+
+
+def encode_block(b: Block) -> bytes:
+    return pack(block_obj(b))
+
+
+def decode_block(data: bytes) -> Block:
+    return block_from(unpack(data))
+
+
+def encode_vote(v: Vote) -> bytes:
+    return pack(vote_obj(v))
+
+
+def decode_vote(data: bytes) -> Vote:
+    return vote_from(unpack(data))
+
+
+def encode_commit(c: Commit) -> bytes:
+    return pack(commit_obj(c))
+
+
+def decode_commit(data: bytes) -> Commit:
+    return commit_from(unpack(data))
+
+
+def validator_obj(v: Validator):
+    return [v.address, pubkey_to_bytes(v.pub_key), v.voting_power, v.proposer_priority]
+
+
+def validator_from(o) -> Validator:
+    return Validator(
+        address=o[0],
+        pub_key=pubkey_from_bytes(o[1]),
+        voting_power=o[2],
+        proposer_priority=o[3],
+    )
+
+
+def valset_obj(vs: ValidatorSet):
+    prop = vs.proposer.address if vs.proposer else b""
+    return [[validator_obj(v) for v in vs.validators], prop]
+
+
+def valset_from(o) -> ValidatorSet:
+    vs = ValidatorSet.__new__(ValidatorSet)
+    vs.validators = [validator_from(v) for v in o[0]]
+    vs._total = None
+    vs.proposer = None
+    for v in vs.validators:
+        if v.address == o[1]:
+            vs.proposer = v
+    return vs
+
+
+def proof_obj(p: merkle.SimpleProof):
+    return [p.total, p.index, p.leaf_hash, list(p.aunts)]
+
+
+def proof_from(o) -> merkle.SimpleProof:
+    return merkle.SimpleProof(total=o[0], index=o[1], leaf_hash=o[2], aunts=list(o[3]))
+
+
+def part_obj(p: Part):
+    return [p.index, p.bytes, proof_obj(p.proof)]
+
+
+def part_from(o) -> Part:
+    return Part(index=o[0], bytes=o[1], proof=proof_from(o[2]))

@@ -1,0 +1,217 @@
+"""VoteSet — 2/3-quorum vote tallying (reference types/vote_set.go).
+
+North-star call site #2: votes arrive one-per-message on the live path
+(add_vote, latency-shaped — single CPU verify), but bulk ingestion
+(add_votes: reactor catch-up, WAL replay, fast-sync) verifies the whole
+batch in ONE BatchVerifier call before tallying — the TPU path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..crypto import batch
+from ..libs.bit_array import BitArray
+from .basic import BlockID, ErrVoteConflictingVotes, Vote
+from .validator_set import ValidatorSet
+
+
+class ErrVoteInvalid(Exception):
+    pass
+
+
+class VoteSet:
+    def __init__(self, chain_id: str, height: int, round_: int, type_: int, val_set: ValidatorSet):
+        if height == 0:
+            raise ValueError("VoteSet height cannot be 0")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type = type_
+        self.val_set = val_set
+        self._lock = threading.RLock()
+        n = len(val_set)
+        self.votes_bit_array = BitArray(n)
+        self.votes: List[Optional[Vote]] = [None] * n
+        self.sum = 0
+        self.maj23: Optional[BlockID] = None
+        # per-block tallies; block key -> (votes bit array, power sum)
+        self._votes_by_block: Dict[bytes, "_BlockVotes"] = {}
+        # peer id -> block key they claim has 2/3 (reference peerMaj23s)
+        self._peer_maj23s: Dict[str, bytes] = {}
+
+    def size(self) -> int:
+        return len(self.val_set)
+
+    # --- add ---------------------------------------------------------------
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Verify + add one vote. Returns True if it was added (False =
+        benign duplicate). Raises ErrVoteInvalid / ErrVoteConflictingVotes."""
+        with self._lock:
+            self._precheck(vote)
+            _, val = self.val_set.get_by_index(vote.validator_index)
+            conflict = self._conflict_check(vote)
+            if conflict == "dup":
+                return False
+            if not vote.verify(self.chain_id, val.pub_key):
+                raise ErrVoteInvalid(f"invalid signature on {vote}")
+            if conflict is not None:
+                raise ErrVoteConflictingVotes(conflict, vote)
+            self._add_verified(vote, val.voting_power)
+            return True
+
+    def add_votes(self, votes: List[Vote]) -> List[bool]:
+        """Bulk-add: one batched signature verification for all votes
+        (TPU path), then tally. Invalid items raise after the batch."""
+        with self._lock:
+            to_verify = []
+            for vote in votes:
+                self._precheck(vote)
+                _, val = self.val_set.get_by_index(vote.validator_index)
+                to_verify.append((vote, val))
+            bv = batch.new_batch_verifier()
+            for vote, val in to_verify:
+                bv.add(vote.sign_bytes(self.chain_id), vote.signature, val.pub_key.bytes())
+            mask = bv.verify()
+            # reject the ENTIRE batch before mutating any state — one bad
+            # signature must not leave earlier votes half-applied
+            for ok, (vote, _) in zip(mask, to_verify):
+                if not ok:
+                    raise ErrVoteInvalid(f"invalid signature on {vote}")
+            # all signatures valid: apply with the same semantics as N
+            # sequential add_vote calls (conflicts surface as evidence)
+            added = []
+            for vote, val in to_verify:
+                conflict = self._conflict_check(vote)
+                if conflict == "dup":
+                    added.append(False)
+                    continue
+                if conflict is not None:
+                    raise ErrVoteConflictingVotes(conflict, vote)
+                self._add_verified(vote, val.voting_power)
+                added.append(True)
+            return added
+
+    def _precheck(self, vote: Optional[Vote]) -> None:
+        if vote is None:
+            raise ErrVoteInvalid("nil vote")
+        if (vote.height, vote.round, vote.type) != (self.height, self.round, self.type):
+            raise ErrVoteInvalid(
+                f"vote {vote.height}/{vote.round}/{vote.type} does not match "
+                f"VoteSet {self.height}/{self.round}/{self.type}"
+            )
+        idx = vote.validator_index
+        if not 0 <= idx < len(self.val_set):
+            raise ErrVoteInvalid(f"validator index {idx} out of range")
+        addr, _ = self.val_set.get_by_index(idx)
+        if addr != vote.validator_address:
+            raise ErrVoteInvalid("validator address does not match index")
+        if len(vote.signature) != 64:
+            raise ErrVoteInvalid("malformed signature")
+
+    def _conflict_check(self, vote: Vote):
+        """Returns None (new), "dup" (same again), or the existing
+        conflicting Vote."""
+        existing = self.votes[vote.validator_index]
+        if existing is None:
+            # also check block-keyed duplicates (maj23 rollback paths)
+            return None
+        if existing.block_id == vote.block_id:
+            return "dup"
+        return existing
+
+    def _add_verified(self, vote: Vote, power: int) -> None:
+        idx = vote.validator_index
+        self.votes[idx] = vote
+        self.votes_bit_array.set_index(idx, True)
+        self.sum += power
+        key = vote.block_id.key()
+        bv = self._votes_by_block.get(key)
+        if bv is None:
+            bv = _BlockVotes(len(self.val_set))
+            self._votes_by_block[key] = bv
+        bv.add(idx, power)
+        if (
+            self.maj23 is None
+            and 3 * bv.sum > 2 * self.val_set.total_voting_power()
+        ):
+            self.maj23 = vote.block_id
+
+    # --- queries -----------------------------------------------------------
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        with self._lock:
+            return self.votes[idx] if 0 <= idx < len(self.votes) else None
+
+    def get_by_address(self, addr: bytes) -> Optional[Vote]:
+        with self._lock:
+            idx, _ = self.val_set.get_by_address(addr)
+            return self.votes[idx] if idx >= 0 else None
+
+    def has_two_thirds_majority(self) -> bool:
+        with self._lock:
+            return self.maj23 is not None
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        with self._lock:
+            return self.maj23
+
+    def has_two_thirds_any(self) -> bool:
+        with self._lock:
+            return 3 * self.sum > 2 * self.val_set.total_voting_power()
+
+    def has_all(self) -> bool:
+        with self._lock:
+            return self.sum == self.val_set.total_voting_power()
+
+    def bit_array(self) -> BitArray:
+        with self._lock:
+            return self.votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> Optional[BitArray]:
+        with self._lock:
+            bv = self._votes_by_block.get(block_id.key())
+            return bv.bit_array.copy() if bv else None
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """Record a peer's claim that block_id has 2/3 (drives vote-bitmap
+        gossip; reference vote_set.go SetPeerMaj23)."""
+        with self._lock:
+            self._peer_maj23s.setdefault(peer_id, block_id.key())
+
+    def make_commit(self):
+        from .block import Commit
+
+        with self._lock:
+            from .basic import VOTE_TYPE_PRECOMMIT
+
+            if self.type != VOTE_TYPE_PRECOMMIT:
+                raise ValueError("cannot make commit from non-precommit VoteSet")
+            if self.maj23 is None:
+                raise ValueError("cannot make commit: no 2/3 majority")
+            precommits = [
+                v.copy() if v is not None and v.block_id == self.maj23 else None
+                for v in self.votes
+            ]
+            return Commit(block_id=self.maj23, precommits=precommits)
+
+    def __str__(self):
+        return (
+            f"VoteSet{{h:{self.height}/{self.round}/{self.type} "
+            f"{self.votes_bit_array.num_true()}/{len(self.val_set)} sum:{self.sum} maj23:{self.maj23}}}"
+        )
+
+
+class _BlockVotes:
+    __slots__ = ("bit_array", "sum")
+
+    def __init__(self, n: int):
+        self.bit_array = BitArray(n)
+        self.sum = 0
+
+    def add(self, idx: int, power: int) -> None:
+        if not self.bit_array.get_index(idx):
+            self.bit_array.set_index(idx, True)
+            self.sum += power

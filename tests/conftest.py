@@ -1,15 +1,15 @@
 """Test configuration.
 
 Tests run JAX on a virtual 8-device CPU platform so multi-chip sharding
-paths (shard_map over a Mesh) are exercised without TPU hardware. Must be
-set before jax is imported anywhere.
+paths (shard_map over a Mesh) are exercised without TPU hardware.
+
+NOTE: this environment force-registers the `axon` TPU platform via
+sitecustomize (JAX_PLATFORMS=axon is exported and the plugin overrides
+jax_platforms at registration), so env vars are NOT enough — we override
+the jax config itself before any backend initialization.
 """
 
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,139 @@
+"""JAX Ed25519 engine vs pure-python reference vs OpenSSL."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.crypto.jaxed25519 import pack, ref
+
+
+def _keypair():
+    sk = keys.PrivKeyEd25519.generate()
+    return sk, sk.pub_key().bytes()
+
+
+# --- pure-python reference vs OpenSSL --------------------------------------
+
+
+def test_ref_verify_matches_openssl():
+    for i in range(6):
+        sk, pk = _keypair()
+        msg = secrets.token_bytes(10 + 37 * i)
+        sig = sk.sign(msg)
+        assert ref.verify(pk, msg, sig)
+        assert not ref.verify(pk, msg + b"x", sig)
+        bad = bytes([sig[0] ^ 1]) + sig[1:]
+        assert not ref.verify(pk, msg, bad)
+
+
+def test_ref_rejects_high_s():
+    sk, pk = _keypair()
+    msg = b"malleability"
+    sig = sk.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    s_high = s + ref.L
+    if s_high < 2**256:
+        forged = sig[:32] + s_high.to_bytes(32, "little")
+        assert not ref.verify(pk, msg, forged)
+
+
+def test_ref_base_point_order():
+    b = ref.base_point()
+    lb = ref.scalar_mult(ref.L, b)
+    assert ref.equal(lb, ref.IDENTITY)
+
+
+def test_ref_compress_decompress_roundtrip():
+    for _ in range(4):
+        k = secrets.randbelow(ref.L)
+        p = ref.scalar_mult(k, ref.base_point())
+        enc = ref.compress(p)
+        p2 = ref.decompress(enc)
+        assert p2 is not None and ref.equal(p, p2)
+
+
+def test_base_table_correct():
+    table = ref.base_table()
+    # spot-check: row i entry j must be niels([j*16^i]B)
+    for i, j in [(0, 1), (0, 15), (3, 7), (63, 1), (63, 15)]:
+        want = ref.niels(ref.scalar_mult(j * 16**i, ref.base_point()))
+        assert table[i][j] == want
+    assert table[5][0] == ref.NIELS_IDENTITY
+
+
+# --- device kernel ---------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def batch():
+    """Mixed batch: valid sigs, corrupted sig, wrong msg, bad pubkey,
+    zero sig, high-S forgery, long msg crossing a SHA block boundary."""
+    items = []  # (msg, sig, pk, expect)
+    for i in range(4):
+        sk, pk = _keypair()
+        msg = secrets.token_bytes(40 + i)
+        items.append((msg, sk.sign(msg), pk, True))
+    sk, pk = _keypair()
+    msg = b"corrupted"
+    sig = sk.sign(msg)
+    items.append((msg, bytes([sig[0] ^ 1]) + sig[1:], pk, False))
+    items.append((b"wrong msg", sig, pk, False))
+    items.append((b"zero sig", b"\x00" * 64, pk, False))
+    items.append((b"bad pk", sig, b"\x01" * 32, False))
+    sk, pk = _keypair()
+    msg = b"high-s"
+    sig = sk.sign(msg)
+    s = int.from_bytes(sig[32:], "little")
+    if s + ref.L < 2**256:
+        items.append((msg, sig[:32] + (s + ref.L).to_bytes(32, "little"), pk, False))
+    sk, pk = _keypair()
+    long_msg = secrets.token_bytes(300)  # 64+300 spans 3+ blocks
+    items.append((long_msg, sk.sign(long_msg), pk, True))
+    sk, pk = _keypair()
+    items.append((b"", sk.sign(b""), pk, True))  # empty message
+    return items
+
+
+def test_jax_verify_batch(batch):
+    from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
+
+    msgs = [m for m, _, _, _ in batch]
+    sigs = [s for _, s, _, _ in batch]
+    pks = [p for _, _, p, _ in batch]
+    want = [e for _, _, _, e in batch]
+    got = verify_batch(msgs, sigs, pks, devices=1)
+    assert got == want
+
+
+def test_jax_verify_multidevice(batch):
+    import jax
+
+    from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
+
+    ndev = len(jax.devices())
+    assert ndev == 8, "conftest should provide 8 virtual devices"
+    msgs = [m for m, _, _, _ in batch]
+    sigs = [s for _, s, _, _ in batch]
+    pks = [p for _, _, p, _ in batch]
+    want = [e for _, _, _, e in batch]
+    got = verify_batch(msgs, sigs, pks, devices=ndev)
+    assert got == want
+
+
+def test_jax_backend_registered():
+    from tendermint_tpu.crypto.batch import backends
+
+    assert "jax" in backends()
+
+
+def test_batch_verifier_interface(batch):
+    from tendermint_tpu.crypto.batch import new_batch_verifier
+
+    bv = new_batch_verifier("jax")
+    for m, s, p, _ in batch[:5]:
+        bv.add(m, s, p)
+    want = [e for _, _, _, e in batch[:5]]
+    assert bv.verify() == want
+    assert bv.verify_all() == all(want)

@@ -1,0 +1,157 @@
+"""Field arithmetic vs python-int oracle. Runs on CPU (conftest)."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.jaxed25519 import field, pack, ref
+import jax
+
+# jit the expensive chains once — eager dispatch of ~300-op muls is slow
+_invert = jax.jit(field.invert)
+_pow22523 = jax.jit(field.pow22523)
+_sqrt_ratio = jax.jit(field.sqrt_ratio)
+_mulfreeze = jax.jit(lambda a, b: field.freeze(field.mul(a, b)))
+
+P = ref.P
+
+
+def _batch_fe(values):
+    """list of ints -> (20, B) int32 device array."""
+    import jax.numpy as jnp
+
+    arr = np.stack([pack.int_to_limbs(v % P) for v in values], axis=1)
+    return jnp.asarray(arr, dtype=jnp.int32)
+
+
+def _to_ints(fe_arr):
+    a = np.asarray(fe_arr)
+    return [pack.limbs_to_int(a[:, i]) for i in range(a.shape[1])]
+
+
+def _rand_vals(n):
+    vals = [secrets.randbelow(P) for _ in range(n - 4)]
+    return vals + [0, 1, P - 1, P - 2]
+
+
+B = 12
+
+
+@pytest.fixture(scope="module")
+def ab():
+    return _rand_vals(B), _rand_vals(B)
+
+
+def test_mul(ab):
+    a, b = ab
+    out = _to_ints(field.mul(_batch_fe(a), _batch_fe(b)))
+    for x, y, o in zip(a, b, out):
+        assert o % P == (x * y) % P
+
+
+def test_add_sub_neg(ab):
+    a, b = ab
+    fa, fb = _batch_fe(a), _batch_fe(b)
+    for got, want in zip(_to_ints(field.add(fa, fb)), [(x + y) for x, y in zip(a, b)]):
+        assert got % P == want % P
+    for got, want in zip(_to_ints(field.sub(fa, fb)), [(x - y) for x, y in zip(a, b)]):
+        assert got % P == want % P
+    for got, want in zip(_to_ints(field.neg(fa)), [-x for x in a]):
+        assert got % P == want % P
+
+
+def test_chained_ops_respect_bounds(ab):
+    """Adds/subs feeding muls — the invariant the curve formulas rely on."""
+    a, b = ab
+    fa, fb = _batch_fe(a), _batch_fe(b)
+    s = field.add(fa, fb)
+    d = field.sub(fa, fb)
+    out = _to_ints(field.mul(s, d))
+    for x, y, o in zip(a, b, out):
+        assert o % P == ((x + y) * (x - y)) % P
+    limbs = np.asarray(field.mul(s, d))
+    assert np.abs(limbs).max() <= field.LIMB_BOUND
+
+
+def test_invert(ab):
+    a, _ = ab
+    vals = [v for v in a if v % P != 0]
+    out = _to_ints(_invert(_batch_fe(vals)))
+    for x, o in zip(vals, out):
+        assert (o * x) % P == 1
+
+
+def test_pow22523(ab):
+    a, _ = ab
+    out = _to_ints(_pow22523(_batch_fe(a)))
+    for x, o in zip(a, out):
+        assert o % P == pow(x, (P - 5) // 8, P)
+
+
+def test_freeze_canonical():
+    vals = [0, 1, P - 1, P, P + 1, 2 * P + 5, 31 * P + 3, secrets.randbelow(P)]
+    import jax.numpy as jnp
+
+    arr = np.stack([pack.int_to_limbs(v, 20) for v in vals], axis=1)
+    frozen = field.freeze(jnp.asarray(arr, dtype=jnp.int32))
+    out = _to_ints(frozen)
+    for v, o in zip(vals, out):
+        assert o == v % P
+        assert 0 <= o < P
+    f = np.asarray(frozen)
+    assert f.min() >= 0 and f.max() <= pack.MASK
+
+
+def test_freeze_after_arithmetic(ab):
+    a, b = ab
+    out = _to_ints(_mulfreeze(_batch_fe(a), _batch_fe(b)))
+    for x, y, o in zip(a, b, out):
+        assert o == (x * y) % P
+
+
+def test_sqrt_ratio():
+    xs = [secrets.randbelow(P) for _ in range(6)]
+    us = [(x * x) % P for x in xs]  # perfect squares with v=1
+    ones = [1] * 6
+    x_out, ok = _sqrt_ratio(_batch_fe(us), _batch_fe(ones))
+    assert bool(np.asarray(ok).all())
+    for u, o in zip(us, _to_ints(field.freeze(x_out))):
+        assert (o * o) % P == u
+    # non-residue: 2 is a non-square mod p iff ... pick u with no sqrt
+    non_sq = []
+    v = 2
+    while len(non_sq) < 3:
+        if pow(v, (P - 1) // 2, P) == P - 1:
+            non_sq.append(v)
+        v += 1
+    _, ok = _sqrt_ratio(_batch_fe(non_sq), _batch_fe([1] * 3))
+    assert not bool(np.asarray(ok).any())
+
+
+def test_eq_mod_p():
+    a = [5, 7, P - 1]
+    b = [5 + 0, 7, P - 1]
+    fa, fb = _batch_fe(a), _batch_fe(b)
+    assert bool(np.asarray(field.eq_mod_p(fa, fb)).all())
+    fc = _batch_fe([6, 7, 0])
+    got = np.asarray(field.eq_mod_p(fa, fc))
+    assert list(got) == [False, True, False]
+
+
+def test_pack_roundtrip():
+    raw = np.frombuffer(secrets.token_bytes(32 * 8), dtype=np.uint8).reshape(8, 32)
+    limbs = pack.bytes_to_limbs_batch(raw)
+    for i in range(8):
+        want = int.from_bytes(raw[i].tobytes(), "little")
+        assert pack.limbs_to_int(limbs[:, i]) == want
+
+
+def test_lt_const():
+    L = ref.L
+    vals = [0, L - 1, L, L + 1, 2**256 - 1]
+    arr = np.stack(
+        [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in vals]
+    )
+    got = pack.lt_const_le_batch(arr, L)
+    assert list(got) == [True, True, False, False, False]

@@ -1,0 +1,71 @@
+"""Device SHA-512 and mod-L scalar reduction vs hashlib / python ints."""
+
+import hashlib
+import secrets
+
+import jax
+import numpy as np
+
+from tendermint_tpu.crypto.jaxed25519 import pack, ref, scalar, sha512
+
+
+def test_sha512_batch_matches_hashlib():
+    prefixes = np.frombuffer(secrets.token_bytes(64 * 5), dtype=np.uint8).reshape(5, 64)
+    msgs = [b"", b"a", secrets.token_bytes(63), secrets.token_bytes(64), secrets.token_bytes(300)]
+    words, nblocks = pack.sha512_pad_batch(prefixes, msgs)
+    fn = jax.jit(sha512.sha512_batch)
+    digest = np.asarray(fn(words, nblocks))  # (8, 2, B)
+    for i, m in enumerate(msgs):
+        want = hashlib.sha512(prefixes[i].tobytes() + m).digest()
+        got = b"".join(
+            int(digest[w, 0, i]).to_bytes(4, "big") + int(digest[w, 1, i]).to_bytes(4, "big")
+            for w in range(8)
+        )
+        assert got == want, f"item {i} (len {len(m)})"
+
+
+def test_digest_to_scalar_and_reduce():
+    prefixes = np.frombuffer(secrets.token_bytes(64 * 4), dtype=np.uint8).reshape(4, 64)
+    msgs = [secrets.token_bytes(50) for _ in range(4)]
+    words, nblocks = pack.sha512_pad_batch(prefixes, msgs)
+
+    def kernel(w, nb):
+        d = sha512.sha512_batch(w, nb)
+        k40 = sha512.digest_to_scalar_limbs(d)
+        return k40, scalar.reduce_512(k40)
+
+    k40, k20 = jax.jit(kernel)(words, nblocks)
+    k40, k20 = np.asarray(k40), np.asarray(k20)
+    for i, m in enumerate(msgs):
+        want_full = int.from_bytes(hashlib.sha512(prefixes[i].tobytes() + m).digest(), "little")
+        got_full = sum(int(k40[j, i]) << (13 * j) for j in range(40))
+        assert got_full == want_full, f"item {i}: 512-bit limb mismatch"
+        got_red = sum(int(k20[j, i]) << (13 * j) for j in range(20))
+        assert got_red % ref.L == want_full % ref.L, f"item {i}: reduction wrong"
+        assert got_red < 2**254
+
+
+def test_scalar_bits():
+    vals = [0, 1, 2**252 + 12345, ref.L - 1]
+    arr = np.stack([pack.int_to_limbs(v) for v in vals], axis=1)
+    bits = np.asarray(scalar.scalar_bits(arr, 256))
+    for i, v in enumerate(vals):
+        got = sum(int(bits[j, i]) << j for j in range(256))
+        assert got == v
+
+
+def test_reduce_is_canonical():
+    """reduce_512 must be CANONICAL mod L (Go sc_reduce parity —
+    matters for small-order-pubkey edge semantics)."""
+    rng = np.random.default_rng(7)
+    vals = [int.from_bytes(rng.bytes(64), "little") for _ in range(6)] + [
+        0, ref.L, ref.L - 1, 2 * ref.L + 5, 2**512 - 1,
+    ]
+    limbs = np.zeros((40, len(vals)), dtype=np.int32)
+    for i, v in enumerate(vals):
+        for j in range(40):
+            limbs[j, i] = (v >> (13 * j)) & 0x1FFF
+    out = np.asarray(jax.jit(scalar.reduce_512)(limbs))
+    for i, v in enumerate(vals):
+        got = sum(int(out[j, i]) << (13 * j) for j in range(20))
+        assert got == v % ref.L, f"item {i}"
