@@ -1,0 +1,3 @@
+"""Blockchain: block storage + fast-sync (reference blockchain/)."""
+
+from .store import BlockStore  # noqa: F401

@@ -1,0 +1,128 @@
+"""BlockStore — persistent blocks/parts/commits keyed by height.
+
+Reference parity: blockchain/store.go. Layout:
+  H:<height>        -> BlockMeta (block_id + header)
+  P:<height>:<idx>  -> block part bytes
+  C:<height>        -> commit FOR block at height (from block height+1's
+                       LastCommit)
+  SC:<height>       -> "seen commit" (the local +2/3 precommits)
+  blockStore        -> json {"height": N}
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Optional
+
+from ..libs.db import DB
+from ..types import serde
+from ..types.basic import BlockID
+from ..types.block import Block, BlockMeta, Commit
+from ..types.part_set import Part, PartSet
+
+_STORE_KEY = b"blockStore"
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">Q", height)
+
+
+def _meta_key(height: int) -> bytes:
+    return b"H:" + _h(height)
+
+
+def _part_key(height: int, index: int) -> bytes:
+    return b"P:" + _h(height) + b":" + struct.pack(">I", index)
+
+
+def _commit_key(height: int) -> bytes:
+    return b"C:" + _h(height)
+
+
+def _seen_commit_key(height: int) -> bytes:
+    return b"SC:" + _h(height)
+
+
+class BlockStore:
+    """Stores the chain: metas, parts, and commits (reference
+    blockchain/store.go:24-47 contract)."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._lock = threading.RLock()
+        raw = db.get(_STORE_KEY)
+        self._height = json.loads(raw)["height"] if raw else 0
+
+    def height(self) -> int:
+        with self._lock:
+            return self._height
+
+    # --- save ---------------------------------------------------------------
+
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
+        """Persist block at height == base+1 with its parts and the
+        locally-seen commit (reference store.go SaveBlock:148-183)."""
+        if block is None:
+            raise ValueError("cannot save nil block")
+        height = block.header.height
+        with self._lock:
+            if height != self._height + 1:
+                raise ValueError(
+                    f"cannot save block at height {height}; expected {self._height + 1}"
+                )
+            if not part_set.is_complete():
+                raise ValueError("cannot save block with incomplete part set")
+            meta = BlockMeta.from_block(block, part_set)
+            self._db.set(_meta_key(height), serde.pack(_meta_obj(meta)))
+            for i in range(part_set.total()):
+                part = part_set.get_part(i)
+                self._db.set(_part_key(height, i), serde.pack(serde.part_obj(part)))
+            if block.last_commit is not None:
+                self._db.set(
+                    _commit_key(height - 1), serde.encode_commit(block.last_commit)
+                )
+            self._db.set(_seen_commit_key(height), serde.encode_commit(seen_commit))
+            self._height = height
+            self._db.set_sync(_STORE_KEY, json.dumps({"height": height}).encode())
+
+    # --- load ---------------------------------------------------------------
+
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self._db.get(_meta_key(height))
+        return _meta_from(serde.unpack(raw)) if raw else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        chunks = []
+        for i in range(meta.block_id.parts_header.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                return None
+            chunks.append(part.bytes)
+        return serde.decode_block(b"".join(chunks))
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self._db.get(_part_key(height, index))
+        return serde.part_from(serde.unpack(raw)) if raw else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """The canonical commit for block at `height` (stored once block
+        height+1 is saved)."""
+        raw = self._db.get(_commit_key(height))
+        return serde.decode_commit(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        raw = self._db.get(_seen_commit_key(height))
+        return serde.decode_commit(raw) if raw else None
+
+
+def _meta_obj(m: BlockMeta):
+    return [serde.block_id_obj(m.block_id), serde.header_obj(m.header)]
+
+
+def _meta_from(o) -> BlockMeta:
+    return BlockMeta(block_id=serde.block_id_from(o[0]), header=serde.header_from(o[1]))

@@ -117,7 +117,14 @@ def make_sharded_commit_step(mesh):
     """Sharded verify-commit step over a 1-D 'dp' mesh: per-signature
     validity masks (sharded) plus the 2/3-quorum voting-power tally via a
     psum collective — the device-parallel equivalent of the reference's
-    talliedVotingPower loop (types/validator_set.go:358-366)."""
+    talliedVotingPower loop (types/validator_set.go:358-366).
+
+    The tally is exact int32 arithmetic in 2^16 limbs (powers split into
+    lo/hi 16-bit halves, summed separately, recombined on host as Python
+    ints by the caller via `lo + (hi << 16)`), so the 2/3-quorum decision
+    never rounds: batch ≤ 2^15 items with per-item power < 2^31 stays
+    exact. The authoritative quorum decision in verify_commit additionally
+    re-tallies host-side from the mask with unbounded Python ints."""
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
@@ -125,18 +132,25 @@ def make_sharded_commit_step(mesh):
 
     def step(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs, powers, for_block):
         mask = _verify_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs)
-        local = jnp.sum(jnp.where(mask & (for_block == 1), powers, 0.0))
-        tallied = jax.lax.psum(local, "dp")
-        return mask, tallied
+        powers = powers.astype(jnp.int32)
+        counted = jnp.where(mask & (for_block == 1), powers, 0)
+        lo = jnp.sum(counted & 0xFFFF)
+        hi = jnp.sum(counted >> 16)
+        return mask, jax.lax.psum(lo, "dp"), jax.lax.psum(hi, "dp")
 
     return jax.jit(
         shard_map(
             step,
             mesh=mesh,
             in_specs=(dp(4), dp(1), dp(2), dp(1), dp(2), dp(1), dp(2), dp(1), dp(1)),
-            out_specs=(dp(1), P()),
+            out_specs=(dp(1), P(), P()),
         )
     )
+
+
+def tallied_power(lo, hi) -> int:
+    """Recombine the limb sums from make_sharded_commit_step exactly."""
+    return int(lo) + (int(hi) << 16)
 
 
 class JAXBatchVerifier(BatchVerifier):

@@ -21,9 +21,19 @@ class QueryError(ValueError):
     pass
 
 
-_TOKEN_RE = re.compile(
-    r"\s*(?:(?P<op>=|<=|>=|<|>|CONTAINS)|(?P<and>AND)\b|(?P<key>[\w.\-]+)|'(?P<str>[^']*)')"
-)
+def match_op(op: str, have: str, want: str) -> bool:
+    """One operator of the query language; shared by pubsub filtering and
+    the kv tx indexer's secondary-index scans."""
+    if op == "=":
+        return have == want
+    if op == "CONTAINS":
+        return want in have
+    # numeric comparisons
+    try:
+        a, b = float(have), float(want)
+    except ValueError:
+        return False
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
 
 
 @dataclass(frozen=True)
@@ -35,17 +45,7 @@ class _Condition:
     def matches(self, tags: Dict[str, str]) -> bool:
         if self.key not in tags:
             return False
-        have = tags[self.key]
-        if self.op == "=":
-            return have == self.value
-        if self.op == "CONTAINS":
-            return self.value in have
-        # numeric comparisons
-        try:
-            a, b = float(have), float(self.value)
-        except ValueError:
-            return False
-        return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[self.op]
+        return match_op(self.op, tags[self.key], self.value)
 
 
 class Query:

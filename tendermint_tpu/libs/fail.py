@@ -1,0 +1,47 @@
+"""Fail-point injection for crash testing (reference libs/fail/fail.go).
+
+Each call to fail_point() increments a global counter; when the counter
+reaches int(FAIL_TEST_INDEX), the process exits hard (os._exit) —
+simulating a crash at exactly that point. The crash/restart test matrix
+(reference test/persist/test_failure_indices.sh) iterates the index over
+the 9 crash-critical spots in apply_block/finalize_commit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_lock = threading.Lock()
+_counter = 0
+_names: list[str] = []
+
+
+def env_index() -> int:
+    v = os.environ.get("FAIL_TEST_INDEX")
+    return int(v) if v is not None else -1
+
+
+def fail_point(name: str = "") -> None:
+    """Crash the process if this is the FAIL_TEST_INDEX'th fail point hit
+    (reference fail.Fail: libs/fail/fail.go:34-43)."""
+    global _counter
+    idx = env_index()
+    if idx < 0:
+        return
+    with _lock:
+        _names.append(name)
+        here = _counter
+        _counter += 1
+    if here == idx:
+        sys.stderr.write(f"*** fail-point {here} ({name}): exiting ***\n")
+        sys.stderr.flush()
+        os._exit(1)
+
+
+def reset() -> None:
+    global _counter
+    with _lock:
+        _counter = 0
+        _names.clear()

@@ -1,0 +1,319 @@
+"""BlockExecutor — validate, execute against the ABCI app, commit.
+
+Reference parity: state/execution.go. apply_block (reference :89-152) is
+the single chokepoint where a validated block mutates chain state;
+exec_block_on_proxy_app (:209-274) is the BeginBlock → DeliverTx loop →
+EndBlock pipeline across the app process boundary; commit (:160-202)
+locks the mempool around the app Commit + recheck.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..crypto import merkle, pubkey_from_bytes
+from ..libs import fail
+from ..libs.db import DB
+from ..types import serde
+from ..types.basic import BlockID
+from ..types.block import Block
+from ..types.validator_set import Validator
+from .state import VALSET_CHANGE_DELAY, State
+from .store import save_abci_responses, save_state
+from .validation import ErrInvalidBlock, validate_block
+
+
+class ABCIResponses:
+    """Results of exec_block_on_proxy_app, persisted per height for
+    replay-crash-recovery and last_results_hash (reference
+    state/store.go:109-135)."""
+
+    def __init__(self, deliver_tx: List[abci.ResponseDeliverTx], end_block: Optional[abci.ResponseEndBlock]):
+        self.deliver_tx = deliver_tx
+        self.end_block = end_block
+        self.begin_block: Optional[abci.ResponseBeginBlock] = None
+
+    def results_hash(self) -> bytes:
+        """Merkle root over (code, data) of each DeliverTx (reference
+        types/results.go ABCIResults.Hash)."""
+        from .. import codec
+
+        leaves = [
+            codec.t_uvarint(1, r.code) + codec.t_bytes(2, r.data)
+            for r in self.deliver_tx
+        ]
+        return merkle.hash_from_byte_slices(leaves)
+
+    def to_bytes(self) -> bytes:
+        return serde.pack(
+            [
+                [[r.code, r.data, r.log, r.gas_wanted, r.gas_used,
+                  [[kv.key, kv.value] for kv in r.tags]] for r in self.deliver_tx],
+                [
+                    [[u.pub_key, u.power] for u in self.end_block.validator_updates],
+                    _params_obj(self.end_block.consensus_param_updates),
+                ]
+                if self.end_block
+                else None,
+            ]
+        )
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "ABCIResponses":
+        o = serde.unpack(data)
+        dtxs = [
+            abci.ResponseDeliverTx(
+                code=r[0], data=r[1], log=r[2], gas_wanted=r[3], gas_used=r[4],
+                tags=[abci.KVPair(k, v) for k, v in r[5]],
+            )
+            for r in o[0]
+        ]
+        eb = None
+        if o[1] is not None:
+            eb = abci.ResponseEndBlock(
+                validator_updates=[abci.ValidatorUpdate(u[0], u[1]) for u in o[1][0]],
+                consensus_param_updates=_params_from(o[1][1]),
+            )
+        return cls(dtxs, eb)
+
+
+def _params_obj(p):
+    if p is None:
+        return None
+    return [
+        [p.block_size.max_bytes, p.block_size.max_gas] if p.block_size else None,
+        [p.evidence.max_age] if p.evidence else None,
+    ]
+
+
+def _params_from(o):
+    if o is None:
+        return None
+    return abci.ConsensusParamUpdates(
+        block_size=abci.BlockSizeParams(o[0][0], o[0][1]) if o[0] else None,
+        evidence=abci.EvidenceParams(o[1][0]) if o[1] else None,
+    )
+
+
+class BlockExecutor:
+    """Reference state/execution.go:22-39. Handles block validation +
+    execution; the ONLY writer of State past genesis."""
+
+    def __init__(
+        self,
+        db: DB,
+        proxy_app,  # AppConnConsensus-shaped client
+        mempool=None,
+        evidence_pool=None,
+        event_bus=None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.db = db
+        self.proxy_app = proxy_app
+        self.mempool = mempool
+        self.evidence_pool = evidence_pool
+        self.event_bus = event_bus
+        self.logger = logger or logging.getLogger("state.BlockExecutor")
+
+    def set_event_bus(self, event_bus) -> None:
+        self.event_bus = event_bus
+
+    def validate_block(self, state: State, block: Block) -> None:
+        validate_block(state, block, self.evidence_pool)
+
+    def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+        """Validate → exec against app → update state → commit app →
+        fire events. Returns the new State (reference execution.go:89-152)."""
+        self.validate_block(state, block)
+
+        abci_responses = self.exec_block_on_proxy_app(state, block)
+
+        fail.fail_point("ApplyBlock.SaveABCIResponses")  # execution.go:103
+        save_abci_responses(self.db, block.header.height, abci_responses)
+        fail.fail_point("ApplyBlock.AfterSaveABCIResponses")  # execution.go:108
+
+        val_updates = _abci_validator_updates(abci_responses)
+        if val_updates:
+            self.logger.info("updates to validators: %d", len(val_updates))
+
+        state = update_state(state, block_id, block.header, abci_responses)
+
+        # lock mempool, commit app state, update mempool (execution.go:130-135)
+        app_hash = self.commit(state, block)
+
+        fail.fail_point("ApplyBlock.AfterCommit")  # execution.go:139
+
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(block, state)
+
+        state.app_hash = app_hash
+        save_state(self.db, state)
+
+        fail.fail_point("ApplyBlock.AfterSaveState")  # execution.go:145
+
+        self._fire_events(block, abci_responses, val_updates)
+        return state
+
+    def commit(self, state: State, block: Block) -> bytes:
+        """App Commit under mempool lock; then mempool Update/recheck
+        (reference execution.go:160-202). Returns the new app hash."""
+        if self.mempool is not None:
+            self.mempool.lock()
+        try:
+            if self.mempool is not None:
+                self.mempool.flush_app_conn()
+            res = self.proxy_app.commit()
+            self.logger.debug(
+                "committed state: height=%d app_hash=%s",
+                block.header.height,
+                res.data.hex()[:16],
+            )
+            if self.mempool is not None:
+                self.mempool.update(
+                    block.header.height,
+                    block.data.txs,
+                    pre_check=_tx_pre_check(state),
+                )
+            return res.data
+        finally:
+            if self.mempool is not None:
+                self.mempool.unlock()
+
+    def exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:
+        """BeginBlock → DeliverTx× → EndBlock (reference execution.go:209-274).
+        DeliverTx calls are pipelined by the socket client's buffering."""
+        commit_info = _last_commit_info(state, block)
+        byz_vals = [
+            abci.Evidence(
+                type="duplicate/vote",
+                validator_address=ev.address(),
+                height=ev.height(),
+                time=block.header.time,
+            )
+            for ev in block.evidence.evidence
+        ]
+
+        res_begin = self.proxy_app.begin_block(
+            abci.RequestBeginBlock(
+                hash=block.hash() or b"",
+                header=block.header,
+                last_commit_info=commit_info,
+                byzantine_validators=byz_vals,
+            )
+        )
+
+        deliver_txs: List[abci.ResponseDeliverTx] = []
+        invalid_count = 0
+        for tx in block.data.txs:
+            r = self.proxy_app.deliver_tx(tx)
+            if not r.is_ok:
+                invalid_count += 1
+            deliver_txs.append(r)
+
+        res_end = self.proxy_app.end_block(abci.RequestEndBlock(height=block.header.height))
+
+        self.logger.info(
+            "executed block height=%d valid_txs=%d invalid_txs=%d",
+            block.header.height,
+            len(deliver_txs) - invalid_count,
+            invalid_count,
+        )
+        responses = ABCIResponses(deliver_txs, res_end)
+        responses.begin_block = res_begin
+        return responses
+
+    def _fire_events(self, block: Block, abci_responses: ABCIResponses, val_updates) -> None:
+        """Reference execution.go fireEvents:475-506."""
+        if self.event_bus is None:
+            return
+        self.event_bus.publish_new_block(
+            block, abci_responses.begin_block, abci_responses.end_block
+        )
+        self.event_bus.publish_new_block_header(
+            block.header, abci_responses.begin_block, abci_responses.end_block
+        )
+        for i, tx in enumerate(block.data.txs):
+            self.event_bus.publish_tx(
+                block.header.height, i, tx, abci_responses.deliver_tx[i]
+            )
+        if val_updates:
+            self.event_bus.publish_validator_set_updates(val_updates)
+
+
+def _tx_pre_check(state: State):
+    """Max-bytes pre-check filter for the mempool (reference
+    mempool.PreCheckAminoMaxBytes wiring at node/node.go:263)."""
+    max_bytes = state.consensus_params.block_size.max_bytes
+
+    def check(tx: bytes):
+        if len(tx) > max_bytes:
+            raise ValueError(f"tx too large ({len(tx)} > {max_bytes})")
+
+    return check
+
+
+def _last_commit_info(state: State, block: Block) -> abci.LastCommitInfo:
+    """(address, power, signed) per last validator (execution.go:277-300)."""
+    votes = []
+    if block.header.height > 1 and block.last_commit is not None:
+        for i, v in enumerate(state.last_validators.validators):
+            signed = (
+                i < len(block.last_commit.precommits)
+                and block.last_commit.precommits[i] is not None
+            )
+            votes.append((v.address, v.voting_power, signed))
+    return abci.LastCommitInfo(round=block.last_commit.round() if block.last_commit else 0, votes=votes)
+
+
+def _abci_validator_updates(abci_responses: ABCIResponses) -> List[abci.ValidatorUpdate]:
+    if abci_responses.end_block is None:
+        return []
+    return list(abci_responses.end_block.validator_updates)
+
+
+def update_state(
+    state: State, block_id: BlockID, header, abci_responses: ABCIResponses
+) -> State:
+    """Pure state transition (reference execution.go updateState:411-472).
+    Note: app_hash is filled AFTER Commit by the caller."""
+    n_val_set = state.next_validators.copy()
+
+    last_height_vals_changed = state.last_height_validators_changed
+    val_updates = _abci_validator_updates(abci_responses)
+    if val_updates:
+        changes = [
+            Validator.new(pubkey_from_bytes(u.pub_key), u.power) if u.power > 0
+            else Validator(pubkey_from_bytes(u.pub_key).address(), pubkey_from_bytes(u.pub_key), 0)
+            for u in val_updates
+        ]
+        n_val_set.update_with_changes(changes)
+        # changes take effect at height+2 (execution.go:419)
+        last_height_vals_changed = header.height + VALSET_CHANGE_DELAY
+
+    # next's proposer rotates by 1 (execution.go:428)
+    n_val_set.increment_proposer_priority(1)
+
+    params = state.consensus_params
+    last_height_params_changed = state.last_height_consensus_params_changed
+    if abci_responses.end_block is not None and abci_responses.end_block.consensus_param_updates is not None:
+        params = params.update(abci_responses.end_block.consensus_param_updates)
+        params.validate()
+        last_height_params_changed = header.height + 1
+
+    return State(
+        chain_id=state.chain_id,
+        last_block_height=header.height,
+        last_block_total_tx=state.last_block_total_tx + header.num_txs,
+        last_block_id=block_id,
+        last_block_time=header.time,
+        next_validators=n_val_set,
+        validators=state.next_validators.copy(),
+        last_validators=state.validators.copy(),
+        last_height_validators_changed=last_height_vals_changed,
+        consensus_params=params,
+        last_height_consensus_params_changed=last_height_params_changed,
+        last_results_hash=abci_responses.results_hash(),
+        app_hash=b"",  # set by caller after Commit
+    )

@@ -1,0 +1,182 @@
+"""Tx indexing (reference state/txindex/).
+
+KVTxIndexer stores TxResult by hash and tag for `tx_search`; the
+IndexerService subscribes to the event bus and indexes every committed
+tx (reference state/txindex/indexer_service.go:17-69, kv/kv.go:28,144).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..libs.db import DB
+from ..libs.events import Query, match_op
+from ..libs.service import BaseService
+from ..types import serde
+from ..types.block import tx_hash
+from ..types.event_bus import EVENT_TX, EventBus, query_for_event
+
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+@dataclass
+class TxResult:
+    height: int
+    index: int
+    tx: bytes
+    result: abci.ResponseDeliverTx
+
+    def to_bytes(self) -> bytes:
+        r = self.result
+        return serde.pack([
+            self.height, self.index, self.tx,
+            [r.code, r.data, r.log, r.gas_wanted, r.gas_used,
+             [[kv.key, kv.value] for kv in r.tags]],
+        ])
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "TxResult":
+        o = serde.unpack(raw)
+        return cls(
+            height=o[0], index=o[1], tx=o[2],
+            result=abci.ResponseDeliverTx(
+                code=o[3][0], data=o[3][1], log=o[3][2],
+                gas_wanted=o[3][3], gas_used=o[3][4],
+                tags=[abci.KVPair(k, v) for k, v in o[3][5]],
+            ),
+        )
+
+
+class TxIndexer:
+    def index(self, result: TxResult) -> None:
+        raise NotImplementedError
+
+    def get(self, hash_: bytes) -> Optional[TxResult]:
+        raise NotImplementedError
+
+    def search(self, query: Query) -> List[TxResult]:
+        raise NotImplementedError
+
+
+class NullTxIndexer(TxIndexer):
+    """reference state/txindex/null/null.go"""
+
+    def index(self, result: TxResult) -> None:
+        pass
+
+    def get(self, hash_: bytes) -> Optional[TxResult]:
+        return None
+
+    def search(self, query: Query) -> List[TxResult]:
+        return []
+
+
+def _tag_prefix(key: str) -> bytes:
+    """NUL-terminated tag key: values/heights live in a msgpack suffix, so
+    a '/' (or any byte) inside a tag value can't corrupt row parsing."""
+    kb = key.encode()
+    if b"\x00" in kb:
+        raise ValueError(f"tag key may not contain NUL: {key!r}")
+    return kb + b"\x00"
+
+
+def _tag_key(key: str, value: str, height: int, index: int) -> bytes:
+    return _tag_prefix(key) + serde.pack([value, height, index])
+
+
+class KVTxIndexer(TxIndexer):
+    """reference state/txindex/kv/kv.go:28. Primary rows are hash->TxResult;
+    secondary rows are tagkey/value/height/index -> hash."""
+
+    def __init__(self, db: DB, index_tags: Optional[List[str]] = None, index_all_tags: bool = False):
+        self._db = db
+        self._tags = set(index_tags or [])
+        self._all = index_all_tags
+        self._lock = threading.Lock()
+
+    def index(self, result: TxResult) -> None:
+        with self._lock:
+            h = tx_hash(result.tx)
+            batch = self._db.batch()
+            for kv in result.result.tags:
+                try:
+                    key = kv.key.decode()
+                    val = kv.value.decode()
+                except UnicodeDecodeError:
+                    continue
+                if self._all or key in self._tags:
+                    batch.set(_tag_key(key, val, result.height, result.index), h)
+            batch.set(
+                _tag_key(TX_HEIGHT_KEY, str(result.height), result.height, result.index), h
+            )
+            batch.set(h, result.to_bytes())
+            batch.write()
+
+    def get(self, hash_: bytes) -> Optional[TxResult]:
+        raw = self._db.get(hash_)
+        return TxResult.from_bytes(raw) if raw else None
+
+    def search(self, query: Query) -> List[TxResult]:
+        """Conjunctive tag search (reference kv.go Search:144-231). A
+        tx.hash condition short-circuits to a point lookup; otherwise
+        intersect hash sets across conditions, scanning secondary rows."""
+        for c in query.conditions:
+            if c.key == TX_HASH_KEY and c.op == "=":
+                res = self.get(bytes.fromhex(c.value))
+                return [res] if res else []
+
+        hashes: Optional[set] = None
+        for c in query.conditions:
+            matching = set()
+            prefix = _tag_prefix(c.key)
+            for k, v in self._db.iterator(prefix, prefix + b"\xff" * 8):
+                try:
+                    val, _h, _i = serde.unpack(k[len(prefix):])
+                except (ValueError, TypeError):
+                    continue
+                if match_op(c.op, val, c.value):
+                    matching.add(bytes(v))
+            hashes = matching if hashes is None else hashes & matching
+            if not hashes:
+                return []
+        results = [self.get(h) for h in (hashes or set())]
+        out = [r for r in results if r is not None]
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+
+class IndexerService(BaseService):
+    """Event-bus subscriber indexing each committed tx (reference
+    state/txindex/indexer_service.go:17-69)."""
+
+    SUBSCRIBER = "IndexerService"
+
+    def __init__(self, indexer: TxIndexer, event_bus: EventBus):
+        super().__init__("IndexerService")
+        self.indexer = indexer
+        self.event_bus = event_bus
+        self._thread: Optional[threading.Thread] = None
+
+    def on_start(self) -> None:
+        self._sub = self.event_bus.subscribe(
+            self.SUBSCRIBER, query_for_event(EVENT_TX), capacity=8192
+        )
+        self._thread = threading.Thread(target=self._run, name="tx-indexer", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._quit.is_set():
+            msg = self._sub.get(timeout=0.2)
+            if msg is None:
+                continue
+            d = msg.data
+            self.indexer.index(
+                TxResult(height=d["height"], index=d["index"], tx=d["tx"], result=d["result"])
+            )
+
+    def on_stop(self) -> None:
+        self.event_bus.unsubscribe_all(self.SUBSCRIBER)

@@ -1,0 +1,109 @@
+"""Block validation against State (reference state/validation.go:16-160).
+
+The LastCommit check routes through ValidatorSet.verify_commit — ONE
+batched TPU verification for the whole commit (north-star call site #1;
+reference does a serial loop at types/validator_set.go:345-371 invoked
+from state/validation.go:102-103).
+"""
+
+from __future__ import annotations
+
+from ..types.block import Block
+from .state import State, median_time
+
+
+class ErrInvalidBlock(Exception):
+    pass
+
+
+def validate_block(state: State, block: Block, evidence_pool=None) -> None:
+    """Raises ErrInvalidBlock (or ErrInvalidCommit subclasses) on failure."""
+    h = block.header
+    # header matches state (reference validation.go:25-98; chain/height
+    # checks come before structural validation so errors are precise)
+    if h.chain_id != state.chain_id:
+        raise ErrInvalidBlock(f"wrong chain_id {h.chain_id!r} != {state.chain_id!r}")
+    if h.height != state.last_block_height + 1:
+        raise ErrInvalidBlock(
+            f"wrong height {h.height}, expected {state.last_block_height + 1}"
+        )
+    block.validate_basic()
+    if h.last_block_id != state.last_block_id:
+        raise ErrInvalidBlock(
+            f"wrong last_block_id {h.last_block_id} != {state.last_block_id}"
+        )
+    if h.total_txs != state.last_block_total_tx + h.num_txs:
+        raise ErrInvalidBlock(f"wrong total_txs {h.total_txs}")
+    if h.app_hash != state.app_hash:
+        raise ErrInvalidBlock("wrong app_hash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ErrInvalidBlock("wrong last_results_hash")
+    if h.validators_hash != state.validators.hash():
+        raise ErrInvalidBlock("wrong validators_hash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ErrInvalidBlock("wrong next_validators_hash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ErrInvalidBlock("wrong consensus_hash")
+
+    # last commit (reference validation.go:100-116)
+    if h.height == 1:
+        if block.last_commit is not None and block.last_commit.precommits:
+            raise ErrInvalidBlock("block at height 1 can't have LastCommit precommits")
+    else:
+        if block.last_commit is None or len(block.last_commit.precommits) != len(
+            state.last_validators
+        ):
+            got = 0 if block.last_commit is None else len(block.last_commit.precommits)
+            raise ErrInvalidBlock(
+                f"wrong LastCommit size {got}, expected {len(state.last_validators)}"
+            )
+        # ★ batched signature verification (TPU path)
+        state.last_validators.verify_commit(
+            state.chain_id, state.last_block_id, h.height - 1, block.last_commit
+        )
+        # median-time rule (reference validation.go:118-128)
+        expected = median_time(block.last_commit, state.last_validators)
+        if h.time != expected:
+            raise ErrInvalidBlock(
+                f"invalid block time {h.time}, expected (median) {expected}"
+            )
+
+    # proposer must be in the current validator set (validation.go:131-138)
+    if not state.validators.has_address(h.proposer_address):
+        raise ErrInvalidBlock(
+            f"proposer {h.proposer_address.hex()} is not a validator"
+        )
+
+    # evidence (validation.go:141-152)
+    for ev in block.evidence.evidence:
+        verify_evidence(state, ev)
+        if evidence_pool is not None and evidence_pool.is_committed(ev):
+            raise ErrInvalidBlock(f"evidence was already committed: {ev}")
+
+
+def verify_evidence(state: State, evidence, load_validators=None) -> None:
+    """Reference state/validation.go:167-199 VerifyEvidence.
+
+    load_validators(height) loads the historical valset; defaults to the
+    current-state sets (enough for max_age within unchanged valsets)."""
+    height = state.last_block_height
+    ev_height = evidence.height()
+    max_age = state.consensus_params.evidence.max_age
+    if height - ev_height > max_age:
+        raise ErrInvalidBlock(
+            f"evidence from height {ev_height} is too old (max age {max_age})"
+        )
+    if ev_height > height:
+        raise ErrInvalidBlock(f"evidence from future height {ev_height}")
+
+    if load_validators is not None:
+        valset = load_validators(ev_height)
+    else:
+        valset = state.validators
+    addr = evidence.address()
+    idx, val = valset.get_by_address(addr)
+    if val is None:
+        raise ErrInvalidBlock(
+            f"address {addr.hex()} was not a validator at height {ev_height}"
+        )
+    evidence.verify(state.chain_id)

@@ -206,6 +206,22 @@ class Block:
         return f"Block{{{self.header} txs:{len(self.data.txs)}}}"
 
 
+@dataclass
+class BlockMeta:
+    """Header + BlockID summary stored per height (reference
+    types/block_meta.go)."""
+
+    block_id: BlockID
+    header: Header
+
+    @classmethod
+    def from_block(cls, block: Block, part_set) -> "BlockMeta":
+        return cls(
+            block_id=BlockID(block.hash(), part_set.header()),
+            header=block.header,
+        )
+
+
 def make_part_set(block: Block, part_size: int = 65536):
     from .part_set import PartSet
 

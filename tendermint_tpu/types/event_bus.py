@@ -1,0 +1,150 @@
+"""EventBus — typed event publishing over the pubsub core.
+
+Reference parity: types/event_bus.go:23 (EventBus wraps libs/pubsub and
+is the single place events get published), types/events.go (event string
+constants + tag keys). Subscribers (RPC websocket clients, the tx
+indexer) filter with the query language in libs/events.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..libs.events import PubSub, Query, Subscription
+from ..libs.service import BaseService
+
+# event values for the tm.event tag (reference types/events.go:17-36)
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_TX = "Tx"
+EVENT_VOTE = "Vote"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_POLKA = "Polka"
+EVENT_UNLOCK = "Unlock"
+EVENT_RELOCK = "Relock"
+EVENT_LOCK = "Lock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_PROPOSAL_HEARTBEAT = "ProposalHeartbeat"
+EVENT_VALIDATOR_SET_UPDATES = "ValidatorSetUpdates"
+
+# tag keys (reference types/events.go:79-86)
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def query_for_event(event: str) -> Query:
+    return Query(f"{EVENT_TYPE_KEY} = '{event}'")
+
+
+class EventBus(BaseService):
+    """The node-wide event bus (reference types/event_bus.go:23-49)."""
+
+    def __init__(self):
+        super().__init__("EventBus")
+        self._pubsub = PubSub()
+
+    def subscribe(self, subscriber: str, query: Query, capacity: int = 1024) -> Subscription:
+        return self._pubsub.subscribe(subscriber, query, capacity)
+
+    def unsubscribe(self, subscriber: str, query: Query) -> None:
+        self._pubsub.unsubscribe(subscriber, query)
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        self._pubsub.unsubscribe_all(subscriber)
+
+    def num_subscriptions(self) -> int:
+        return self._pubsub.num_subscriptions()
+
+    # --- publishing ---------------------------------------------------------
+
+    def _publish(self, event: str, data: object, extra_tags: Optional[Dict[str, str]] = None) -> None:
+        tags = {EVENT_TYPE_KEY: event}
+        if extra_tags:
+            # the event-type tag wins on collision (reference event_bus.go:72)
+            merged = dict(extra_tags)
+            merged.update(tags)
+            tags = merged
+        self._pubsub.publish(data, tags)
+
+    def publish_new_block(self, block, result_begin_block=None, result_end_block=None) -> None:
+        self._publish(EVENT_NEW_BLOCK, {
+            "block": block,
+            "result_begin_block": result_begin_block,
+            "result_end_block": result_end_block,
+        })
+
+    def publish_new_block_header(self, header, result_begin_block=None, result_end_block=None) -> None:
+        self._publish(EVENT_NEW_BLOCK_HEADER, {
+            "header": header,
+            "result_begin_block": result_begin_block,
+            "result_end_block": result_end_block,
+        })
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result) -> None:
+        """EventDataTx: app tags for this tx become query-able event tags
+        (reference event_bus.go PublishEventTx:78-108)."""
+        from .block import tx_hash
+
+        tags: Dict[str, str] = {}
+        res_tags = getattr(result, "tags", None) or []
+        for kv in res_tags:
+            try:
+                tags[kv.key.decode()] = kv.value.decode()
+            except (UnicodeDecodeError, AttributeError):
+                continue
+        tags[TX_HASH_KEY] = tx_hash(tx).hex().upper()
+        tags[TX_HEIGHT_KEY] = str(height)
+        self._publish(EVENT_TX, {
+            "height": height,
+            "index": index,
+            "tx": tx,
+            "result": result,
+        }, tags)
+
+    def publish_vote(self, vote) -> None:
+        self._publish(EVENT_VOTE, {"vote": vote})
+
+    def publish_validator_set_updates(self, updates) -> None:
+        self._publish(EVENT_VALIDATOR_SET_UPDATES, {"validator_updates": updates})
+
+    # round-state events (consensus machine → reactor/RPC; reference
+    # consensus/state.go eventBus usage + types/event_bus.go:110-150)
+    def publish_new_round_step(self, rs) -> None:
+        self._publish(EVENT_NEW_ROUND_STEP, rs)
+
+    def publish_new_round(self, rs) -> None:
+        self._publish(EVENT_NEW_ROUND, rs)
+
+    def publish_complete_proposal(self, rs) -> None:
+        self._publish(EVENT_COMPLETE_PROPOSAL, rs)
+
+    def publish_polka(self, rs) -> None:
+        self._publish(EVENT_POLKA, rs)
+
+    def publish_unlock(self, rs) -> None:
+        self._publish(EVENT_UNLOCK, rs)
+
+    def publish_relock(self, rs) -> None:
+        self._publish(EVENT_RELOCK, rs)
+
+    def publish_lock(self, rs) -> None:
+        self._publish(EVENT_LOCK, rs)
+
+    def publish_timeout_propose(self, rs) -> None:
+        self._publish(EVENT_TIMEOUT_PROPOSE, rs)
+
+    def publish_timeout_wait(self, rs) -> None:
+        self._publish(EVENT_TIMEOUT_WAIT, rs)
+
+
+class NopEventBus:
+    """Publish-to-nowhere bus for tests (reference types/nop_event_bus.go)."""
+
+    def __getattr__(self, name):
+        if name.startswith("publish"):
+            return lambda *a, **k: None
+        raise AttributeError(name)

@@ -1,0 +1,335 @@
+"""State layer tests: genesis, persistence, execution, validation.
+
+Mirrors reference state/state_test.go + state/execution_test.go shapes.
+Uses the cpu crypto backend for speed (TPU/jax path is covered by
+tests/test_jax_ed25519.py and the bench).
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import state as sm
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.crypto import PrivKeyEd25519
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.libs.events import Query
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    Vote,
+)
+from tendermint_tpu.types.block import make_part_set
+from tendermint_tpu.types.validator_set import random_validator_set
+
+
+def make_genesis(n=1, power=10):
+    vs, keys = random_validator_set(n, power)
+    doc = GenesisDoc(
+        chain_id="test-chain",
+        genesis_time=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pub_key=v.pub_key, power=v.voting_power) for v in vs.validators],
+    )
+    return doc, keys
+
+
+def sign_commit(state, block_id, height, round_, keys, time_ns=None):
+    """Sign precommits from all validators, building the Commit like the
+    consensus machine would."""
+    from tendermint_tpu.types.block import Commit
+
+    vals = state.validators
+    precommits = [None] * len(vals)
+    for key in keys:
+        addr = key.pub_key().address()
+        idx, val = vals.get_by_address(addr)
+        vote = Vote(
+            validator_address=addr,
+            validator_index=idx,
+            height=height,
+            round=round_,
+            timestamp=time_ns if time_ns is not None else 1_700_000_100_000_000_000,
+            type=VOTE_TYPE_PRECOMMIT,
+            block_id=block_id,
+        )
+        vote.signature = key.sign(vote.sign_bytes(state.chain_id))
+        precommits[idx] = vote
+    return Commit(block_id=block_id, precommits=precommits)
+
+
+def make_executor(db, n=1):
+    doc, keys = make_genesis(n)
+    state = sm.load_state_from_db_or_genesis(db, doc)
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    executor = sm.BlockExecutor(db, conns.consensus)
+    return state, executor, keys
+
+
+def apply_one(state, executor, keys, txs=()):
+    height = state.last_block_height + 1
+    proposer = state.validators.get_proposer().address
+    commit = (
+        sign_commit(state, state.last_block_id, height - 1, 0, keys)
+        if height > 1
+        else None
+    )
+    time_ns = (
+        sm.state.median_time(commit, state.last_validators)
+        if commit is not None
+        else state.last_block_time + 1
+    )
+    block = state.make_block(height, list(txs), commit, [], proposer, time_ns=time_ns)
+    ps = make_part_set(block)
+    block_id = BlockID(block.hash(), ps.header())
+    new_state = executor.apply_block(state, block_id, block)
+    return new_state, block, block_id
+
+
+class TestGenesisState:
+    def test_from_genesis(self):
+        doc, _ = make_genesis(4)
+        state = sm.state_from_genesis_doc(doc)
+        assert state.chain_id == "test-chain"
+        assert state.last_block_height == 0
+        assert len(state.validators) == 4
+        assert len(state.next_validators) == 4
+        assert len(state.last_validators) == 0
+
+    def test_save_load_roundtrip(self):
+        db = MemDB()
+        doc, _ = make_genesis(3)
+        state = sm.load_state_from_db_or_genesis(db, doc)
+        loaded = sm.load_state(db)
+        assert loaded.equals(state)
+        assert loaded.validators.hash() == state.validators.hash()
+
+    def test_load_validators_historical(self):
+        db = MemDB()
+        doc, _ = make_genesis(2)
+        state = sm.load_state_from_db_or_genesis(db, doc)
+        vals1 = sm.load_validators(db, 1)
+        assert vals1.hash() == state.validators.hash()
+        vals2 = sm.load_validators(db, 2)
+        assert vals2.hash() == state.next_validators.hash()
+        with pytest.raises(sm.store.NoValSetForHeightError):
+            sm.load_validators(db, 50)
+
+
+class TestBlockExecution:
+    def test_apply_blocks_advances_state(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db)
+        s1, b1, id1 = apply_one(state, executor, keys, [b"k=v"])
+        assert s1.last_block_height == 1
+        assert s1.last_block_id == id1
+        assert s1.last_block_total_tx == 1
+        # kvstore app_hash encodes tx count — changes after commit
+        assert s1.app_hash != state.app_hash
+
+        s2, b2, id2 = apply_one(s1, executor, keys, [b"a=b", b"c=d"])
+        assert s2.last_block_height == 2
+        assert s2.last_block_total_tx == 3
+        assert s2.last_validators.hash() == s1.validators.hash()
+
+    def test_abci_responses_persisted(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db)
+        s1, _, _ = apply_one(state, executor, keys, [b"x=1"])
+        res = sm.load_abci_responses(db, 1)
+        assert res is not None
+        assert len(res.deliver_tx) == 1
+        assert res.deliver_tx[0].code == abci.CODE_TYPE_OK
+        assert res.results_hash() == s1.last_results_hash
+
+    def test_validator_updates_take_effect_plus_2(self):
+        """EndBlock val updates land in next_validators at h, validators
+        at h+2 (reference execution.go:419)."""
+
+        class ValUpdateApp(KVStoreApplication):
+            def __init__(self, update_at, new_val):
+                super().__init__()
+                self._update_at = update_at
+                self._new_val = new_val
+                self._h = 0
+
+            def begin_block(self, req):
+                self._h += 1
+                return super().begin_block(req)
+
+            def end_block(self, req):
+                res = super().end_block(req)
+                if req.height == self._update_at:
+                    res.validator_updates = [self._new_val]
+                return res
+
+        from tendermint_tpu.crypto import pubkey_to_bytes
+
+        db = MemDB()
+        doc, keys = make_genesis(1)
+        new_key = PrivKeyEd25519.generate()
+        app = ValUpdateApp(
+            1, abci.ValidatorUpdate(pub_key=pubkey_to_bytes(new_key.pub_key()), power=5)
+        )
+        state = sm.load_state_from_db_or_genesis(db, doc)
+        conns = AppConns(local_client_creator(app))
+        conns.start()
+        executor = sm.BlockExecutor(db, conns.consensus)
+
+        s1, _, _ = apply_one(state, executor, keys)
+        assert len(s1.validators) == 1  # unchanged at h+1
+        assert len(s1.next_validators) == 2  # changed for h+2
+        assert s1.last_height_validators_changed == 3
+        s2, _, _ = apply_one(s1, executor, keys)
+        assert len(s2.validators) == 2
+
+
+class TestValidateBlock:
+    def test_valid_block_passes(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db, n=4)
+        s1, _, _ = apply_one(state, executor, keys)
+        # build a valid block at height 2 and validate without applying
+        commit = sign_commit(s1, s1.last_block_id, 1, 0, keys)
+        t = sm.state.median_time(commit, s1.last_validators)
+        proposer = s1.validators.get_proposer().address
+        block = s1.make_block(2, [], commit, [], proposer, time_ns=t)
+        sm.validate_block(s1, block)
+
+    def test_wrong_height_rejected(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db)
+        proposer = state.validators.get_proposer().address
+        block = state.make_block(5, [], None, [], proposer, time_ns=1)
+        with pytest.raises(sm.ErrInvalidBlock, match="wrong height"):
+            sm.validate_block(state, block)
+
+    def test_bad_commit_sig_rejected(self):
+        from tendermint_tpu.types.validator_set import ErrInvalidCommitSignatures
+
+        db = MemDB()
+        state, executor, keys = make_executor(db, n=4)
+        s1, _, _ = apply_one(state, executor, keys)
+        commit = sign_commit(s1, s1.last_block_id, 1, 0, keys)
+        # corrupt one signature
+        commit.precommits[0].signature = bytes(64)
+        t = sm.state.median_time(commit, s1.last_validators)
+        proposer = s1.validators.get_proposer().address
+        block = s1.make_block(2, [], commit, [], proposer, time_ns=t)
+        with pytest.raises(ErrInvalidCommitSignatures):
+            sm.validate_block(s1, block)
+
+    def test_wrong_time_rejected(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db, n=4)
+        s1, _, _ = apply_one(state, executor, keys)
+        commit = sign_commit(s1, s1.last_block_id, 1, 0, keys)
+        proposer = s1.validators.get_proposer().address
+        block = s1.make_block(2, [], commit, [], proposer, time_ns=12345)
+        with pytest.raises(sm.ErrInvalidBlock, match="invalid block time"):
+            sm.validate_block(s1, block)
+
+
+class TestBlockStore:
+    def test_save_load(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db)
+        store = BlockStore(MemDB())
+        assert store.height() == 0
+
+        height = 1
+        proposer = state.validators.get_proposer().address
+        block = state.make_block(height, [b"tx1"], None, [], proposer, time_ns=7)
+        ps = make_part_set(block, part_size=64)  # force multiple parts
+        block_id = BlockID(block.hash(), ps.header())
+        seen = sign_commit(state, block_id, 1, 0, keys)
+        store.save_block(block, ps, seen)
+
+        assert store.height() == 1
+        meta = store.load_block_meta(1)
+        assert meta.block_id == block_id
+        assert meta.header.height == 1
+        loaded = store.load_block(1)
+        assert loaded.hash() == block.hash()
+        assert loaded.data.txs == [b"tx1"]
+        sc = store.load_seen_commit(1)
+        assert sc.block_id == block_id
+        part = store.load_block_part(1, 0)
+        assert part.validate(ps.header())
+
+    def test_wrong_height_raises(self):
+        db = MemDB()
+        state, executor, keys = make_executor(db)
+        store = BlockStore(MemDB())
+        proposer = state.validators.get_proposer().address
+        block = state.make_block(3, [], None, [], proposer, time_ns=7)
+        ps = make_part_set(block)
+        with pytest.raises(ValueError, match="cannot save block"):
+            store.save_block(block, ps, sign_commit(state, BlockID(block.hash(), ps.header()), 3, 0, keys))
+
+
+class TestTxIndexer:
+    def test_index_get_search(self):
+        from tendermint_tpu.types.block import tx_hash
+
+        idx = sm.KVTxIndexer(MemDB(), index_all_tags=True)
+        tx = b"name=satoshi"
+        res = sm.TxResult(
+            height=5,
+            index=0,
+            tx=tx,
+            result=abci.ResponseDeliverTx(
+                code=0, tags=[abci.KVPair(b"app.creator", b"satoshi")]
+            ),
+        )
+        idx.index(res)
+        got = idx.get(tx_hash(tx))
+        assert got is not None and got.height == 5
+
+        hits = idx.search(Query("app.creator = 'satoshi'"))
+        assert len(hits) == 1 and hits[0].tx == tx
+        hits = idx.search(Query("tx.height = 5"))
+        assert len(hits) == 1
+        hits = idx.search(Query("tx.height > 7"))
+        assert hits == []
+        hits = idx.search(Query(f"tx.hash = '{tx_hash(tx).hex()}'"))
+        assert len(hits) == 1
+
+    def test_tag_value_with_slash(self):
+        """Tag values containing '/' must round-trip exactly through the
+        secondary index (regression: delimiter-based keys mis-split)."""
+        idx = sm.KVTxIndexer(MemDB(), index_all_tags=True)
+        tx = b"path-tx"
+        idx.index(sm.TxResult(
+            height=1, index=0, tx=tx,
+            result=abci.ResponseDeliverTx(code=0, tags=[abci.KVPair(b"acct.path", b"foo/bar")]),
+        ))
+        assert len(idx.search(Query("acct.path = 'foo/bar'"))) == 1
+        assert idx.search(Query("acct.path = 'foo'")) == []
+
+
+class TestABCIResponsesSerde:
+    def test_consensus_param_updates_roundtrip(self):
+        """Param updates must survive persistence or crash-replay diverges
+        (regression: updates were dropped by to_bytes)."""
+        res = sm.ABCIResponses(
+            [abci.ResponseDeliverTx(code=0)],
+            abci.ResponseEndBlock(
+                consensus_param_updates=abci.ConsensusParamUpdates(
+                    block_size=abci.BlockSizeParams(max_bytes=1234, max_gas=99),
+                    evidence=abci.EvidenceParams(max_age=777),
+                )
+            ),
+        )
+        back = sm.ABCIResponses.from_bytes(res.to_bytes())
+        p = back.end_block.consensus_param_updates
+        assert p.block_size.max_bytes == 1234
+        assert p.block_size.max_gas == 99
+        assert p.evidence.max_age == 777
