@@ -1,0 +1,263 @@
+"""Configuration — all 8 sections of the reference config
+(config/config.go:50-60): Base, RPC, P2P, Mempool, Consensus, TxIndex,
+Instrumentation (+ privval paths in Base). TOML-persisted
+(config/toml.go); tests use in-memory defaults via TestConfig.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BaseConfig:
+    """reference config/config.go:127-260"""
+
+    root_dir: str = ""
+    chain_id: str = ""
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_backend: str = "filedb"  # memdb | filedb | native
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_file: str = "config/priv_validator.json"
+    priv_validator_laddr: str = ""  # remote signer listen addr
+    node_key_file: str = "config/node_key.json"
+    abci: str = "socket"  # socket | grpc
+    proxy_app: str = "tcp://127.0.0.1:26658"  # or kvstore/counter/noop
+    prof_laddr: str = ""
+    filter_peers: bool = False
+
+    def genesis_path(self) -> str:
+        return os.path.join(self.root_dir, self.genesis_file)
+
+    def priv_validator_path(self) -> str:
+        return os.path.join(self.root_dir, self.priv_validator_file)
+
+    def node_key_path(self) -> str:
+        return os.path.join(self.root_dir, self.node_key_file)
+
+    def db_path(self) -> str:
+        return os.path.join(self.root_dir, self.db_dir)
+
+
+@dataclass
+class RPCConfig:
+    """reference config/config.go:262-347"""
+
+    laddr: str = "tcp://0.0.0.0:26657"
+    grpc_laddr: str = ""
+    grpc_max_open_connections: int = 900
+    unsafe: bool = False
+    max_open_connections: int = 900
+
+
+@dataclass
+class P2PConfig:
+    """reference config/config.go:349-484"""
+
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout: float = 0.1  # seconds (reference: 100ms)
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000  # 5MB/s
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = True
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    # fuzz testing (reference config/config.go:485-530)
+    test_fuzz: bool = False
+    test_fuzz_mode: str = "drop"  # drop | delay
+    test_fuzz_prob_drop_rw: float = 0.2
+    test_fuzz_delay_ms: int = 250
+
+
+@dataclass
+class MempoolConfig:
+    """reference config/config.go:508-560"""
+
+    recheck: bool = True
+    broadcast: bool = True
+    wal_path: str = ""  # empty = no mempool WAL
+    size: int = 5000
+    cache_size: int = 10000
+
+
+@dataclass
+class ConsensusConfig:
+    """reference config/config.go:564-720. Timeouts in seconds; each
+    timeout grows by its delta per round (accessors below mirror
+    Propose(round) etc. used at consensus/state.go:823,1016,1144)."""
+
+    wal_path: str = "data/cs.wal/wal"
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+    blocktime_iota: int = 1_000_000_000  # 1s in ns (min time between blocks)
+
+    def propose(self, round_: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote(self, round_: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit(self, round_: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit_time(self, t: float) -> float:
+        """Wall-clock at which to start the next height (reference
+        Commit(t))."""
+        return t + self.timeout_commit
+
+    def wal_file(self, root: str) -> str:
+        return os.path.join(root, self.wal_path)
+
+
+@dataclass
+class TxIndexConfig:
+    """reference config/config.go:723-760"""
+
+    indexer: str = "kv"  # kv | null
+    index_tags: str = ""
+    index_all_tags: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    """reference config/config.go:767-800"""
+
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        return self
+
+    @property
+    def root_dir(self) -> str:
+        return self.base.root_dir
+
+    # --- TOML ---------------------------------------------------------------
+
+    def to_toml(self) -> str:
+        def emit(name, obj, skip=()):
+            lines = [f"[{name}]"] if name else []
+            for k, v in vars(obj).items():
+                if k in skip:
+                    continue
+                if isinstance(v, bool):
+                    val = "true" if v else "false"
+                elif isinstance(v, (int, float)):
+                    val = str(v)
+                else:
+                    val = '"%s"' % str(v).replace("\\", "\\\\").replace('"', '\\"')
+                lines.append(f"{k} = {val}")
+            return "\n".join(lines)
+
+        parts = [
+            emit("", self.base, skip=("root_dir",)),
+            emit("rpc", self.rpc),
+            emit("p2p", self.p2p),
+            emit("mempool", self.mempool),
+            emit("consensus", self.consensus),
+            emit("tx_index", self.tx_index),
+            emit("instrumentation", self.instrumentation),
+        ]
+        return "\n\n".join(parts) + "\n"
+
+    @classmethod
+    def from_toml(cls, text: str) -> "Config":
+        import tomllib
+
+        o = tomllib.loads(text)
+        cfg = cls()
+        sections = {
+            "rpc": cfg.rpc,
+            "p2p": cfg.p2p,
+            "mempool": cfg.mempool,
+            "consensus": cfg.consensus,
+            "tx_index": cfg.tx_index,
+            "instrumentation": cfg.instrumentation,
+        }
+        for k, v in o.items():
+            if k in sections:
+                for kk, vv in v.items():
+                    if hasattr(sections[k], kk):
+                        setattr(sections[k], kk, vv)
+            elif hasattr(cfg.base, k):
+                setattr(cfg.base, k, v)
+        return cfg
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_toml(f.read())
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Fast timeouts for in-process tests (reference config.TestConfig,
+    config/config.go:90-99 + 612-629)."""
+    cfg = Config()
+    cfg.base.db_backend = "memdb"
+    cfg.consensus.timeout_propose = 0.4
+    cfg.consensus.timeout_propose_delta = 0.002
+    cfg.consensus.timeout_prevote = 0.1
+    cfg.consensus.timeout_prevote_delta = 0.002
+    cfg.consensus.timeout_precommit = 0.1
+    cfg.consensus.timeout_precommit_delta = 0.002
+    cfg.consensus.timeout_commit = 0.02
+    cfg.consensus.skip_timeout_commit = True
+    cfg.consensus.peer_gossip_sleep_duration = 0.005
+    cfg.consensus.peer_query_maj23_sleep_duration = 0.25
+    cfg.consensus.blocktime_iota = 10_000_000  # 10ms
+    return cfg
+
+
+def ensure_root(root: str) -> None:
+    """Create the standard directory skeleton (reference config/toml.go
+    EnsureRoot)."""
+    for d in ("config", "data"):
+        os.makedirs(os.path.join(root, d), exist_ok=True)

@@ -1,0 +1,18 @@
+"""Consensus: the Tendermint BFT state machine (reference consensus/)."""
+
+from .cstypes import (  # noqa: F401
+    HeightVoteSet,
+    RoundState,
+    RoundStepType,
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+)
+from .state import ConsensusState  # noqa: F401
+from .ticker import TimeoutInfo, TimeoutTicker  # noqa: F401
+from .wal import WAL, EndHeightMessage, NilWAL, TimedWALMessage  # noqa: F401

@@ -1,0 +1,176 @@
+"""Consensus round types (reference consensus/types/).
+
+RoundState is the public snapshot of the machine (round_state.go);
+HeightVoteSet tracks prevote+precommit VoteSets for every round of one
+height (height_vote_set.go), including the one-honest-peer rule for
+tracking votes from future rounds.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    Proposal,
+    Vote,
+)
+from ..types.block import Block, Commit
+from ..types.part_set import PartSet
+from ..types.validator_set import ValidatorSet
+from ..types.vote_set import VoteSet
+
+# RoundStepType (reference consensus/types/round_state.go:12-24)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+_STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight",
+    STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose",
+    STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait",
+    STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait",
+    STEP_COMMIT: "Commit",
+}
+
+
+class RoundStepType:
+    @staticmethod
+    def name(step: int) -> str:
+        return _STEP_NAMES.get(step, f"Unknown({step})")
+
+
+@dataclass
+class RoundState:
+    """Snapshot of the consensus internal state, exposed on the event bus
+    and to the reactor (reference round_state.go:29-71)."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time: float = 0.0
+    commit_time: float = 0.0
+    validators: Optional[ValidatorSet] = None
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[PartSet] = None
+    votes: Optional["HeightVoteSet"] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    triggered_timeout_precommit: bool = False
+
+    def event_tags(self) -> dict:
+        return {
+            "height": str(self.height),
+            "round": str(self.round),
+            "step": RoundStepType.name(self.step),
+        }
+
+    def __str__(self):
+        return f"RoundState{{{self.height}/{self.round}/{RoundStepType.name(self.step)}}}"
+
+
+class HeightVoteSet:
+    """Prevotes and precommits for every round of one height (reference
+    consensus/types/height_vote_set.go).
+
+    Tracks votes for round 0..round+1; votes from higher rounds are kept
+    only once a peer claims 2/3 there (set_peer_maj23) — the
+    one-honest-peer rule limiting memory from byzantine spam."""
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.val_set = val_set
+        self._lock = threading.RLock()
+        self.round = 0
+        self._round_vote_sets: Dict[int, Dict[int, VoteSet]] = {}
+        self._peer_catchup_rounds: Dict[str, List[int]] = {}
+        self._add_round(0)
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._round_vote_sets:
+            return
+        self._round_vote_sets[round_] = {
+            VOTE_TYPE_PREVOTE: VoteSet(self.chain_id, self.height, round_, VOTE_TYPE_PREVOTE, self.val_set),
+            VOTE_TYPE_PRECOMMIT: VoteSet(self.chain_id, self.height, round_, VOTE_TYPE_PRECOMMIT, self.val_set),
+        }
+
+    def set_round(self, round_: int) -> None:
+        """Track round 0..round+1 (reference height_vote_set.go:84-96)."""
+        with self._lock:
+            if self.round != 0 and round_ < self.round:
+                raise ValueError("set_round must increase the round")
+            for r in range(self.round, round_ + 2):
+                self._add_round(r)
+            self.round = round_
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+        """Verify+add; returns added. Unwanted future-round votes (no peer
+        maj23 claim) return False (reference :105-128)."""
+        with self._lock:
+            vs = self._get(vote.round, vote.type)
+            if vs is None:
+                rounds = self._peer_catchup_rounds.get(peer_id, [])
+                if len(rounds) < 2:
+                    self._add_round(vote.round)
+                    vs = self._get(vote.round, vote.type)
+                    rounds.append(vote.round)
+                    self._peer_catchup_rounds[peer_id] = rounds
+                else:
+                    return False  # punish peer? (reference returns ErrGotVoteFromUnwantedRound)
+            return vs.add_vote(vote)
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        with self._lock:
+            return self._get(round_, VOTE_TYPE_PREVOTE)
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        with self._lock:
+            return self._get(round_, VOTE_TYPE_PRECOMMIT)
+
+    def _get(self, round_: int, type_: int) -> Optional[VoteSet]:
+        rvs = self._round_vote_sets.get(round_)
+        return rvs[type_] if rvs else None
+
+    def pol_info(self) -> tuple:
+        """(pol_round, pol_block_id) for the highest round with a prevote
+        2/3 majority, else (-1, zero) (reference POLInfo :130-141)."""
+        with self._lock:
+            for r in range(self.round, -1, -1):
+                vs = self._get(r, VOTE_TYPE_PREVOTE)
+                if vs is not None:
+                    bid = vs.two_thirds_majority()
+                    if bid is not None:
+                        return r, bid
+            return -1, BlockID()
+
+    def set_peer_maj23(self, round_: int, type_: int, peer_id: str, block_id: BlockID) -> None:
+        with self._lock:
+            self._add_round(round_)
+            vs = self._get(round_, type_)
+            if vs is not None:
+                vs.set_peer_maj23(peer_id, block_id)
+
+    def __str__(self):
+        with self._lock:
+            return f"HeightVoteSet{{h:{self.height} r:{self.round} rounds:{sorted(self._round_vote_sets)}}}"

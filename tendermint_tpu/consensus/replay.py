@@ -1,0 +1,245 @@
+"""Handshake / block replay — reconciling node and app state on boot.
+
+Reference parity: consensus/replay.go. On startup the node asks the app
+where it is (ABCI Info) and replays stored blocks the app missed
+(ReplayBlocks :267-418 decision table). The WAL catchup replay for the
+in-flight height lives in ConsensusState._catchup_replay.
+
+Replayed block commits are verified upstream by the block store's
+integrity; the app replay path batches DeliverTxs straight through the
+proxy connection.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..abci import types as abci
+from ..crypto import pubkey_to_bytes
+from ..state import (
+    BlockExecutor,
+    load_abci_responses,
+    save_state,
+)
+from ..state import store as sm_store
+from ..types.basic import BlockID
+from ..types.block import make_part_set
+
+LOG = logging.getLogger("consensus.replay")
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    """reference consensus/replay.go:195-260"""
+
+    def __init__(self, state_db, state, block_store, genesis_doc, event_bus=None):
+        self.state_db = state_db
+        self.initial_state = state
+        self.store = block_store
+        self.genesis_doc = genesis_doc
+        self.event_bus = event_bus
+        self.n_blocks = 0
+
+    def handshake(self, proxy_app) -> bytes:
+        """Sync app ← chain; returns the app hash after sync (reference
+        Handshake :227-260)."""
+        res = proxy_app.query.info(abci.RequestInfo(version="tendermint-tpu"))
+        app_block_height = res.last_block_height
+        app_hash = res.last_block_app_hash
+        LOG.info(
+            "ABCI handshake: app height=%d hash=%s", app_block_height, app_hash.hex()[:16]
+        )
+        app_hash = self.replay_blocks(self.initial_state, app_hash, app_block_height, proxy_app)
+        LOG.info(
+            "completed ABCI handshake: replayed %d blocks, app hash=%s",
+            self.n_blocks,
+            app_hash.hex()[:16],
+        )
+        return app_hash
+
+    def replay_blocks(self, state, app_hash: bytes, app_block_height: int, proxy_app) -> bytes:
+        """The decision table (reference ReplayBlocks :267-418)."""
+        store_block_height = self.store.height()
+        state_block_height = state.last_block_height
+        LOG.info(
+            "ABCI replay: app=%d store=%d state=%d",
+            app_block_height,
+            store_block_height,
+            state_block_height,
+        )
+
+        # app is fresh: InitChain (reference :283-320)
+        if app_block_height == 0:
+            validators = [
+                abci.ValidatorUpdate(pub_key=pubkey_to_bytes(v.pub_key), power=v.power)
+                for v in self.genesis_doc.validators
+            ]
+            req = abci.RequestInitChain(
+                time=self.genesis_doc.genesis_time,
+                chain_id=self.genesis_doc.chain_id,
+                validators=validators,
+                app_state_bytes=b"",
+            )
+            res_init = proxy_app.consensus.init_chain(req)
+            if state_block_height == 0 and res_init.validators:
+                # app dictates the initial validator set (reference :305-315)
+                from ..crypto import pubkey_from_bytes
+                from ..types.validator_set import Validator, ValidatorSet
+
+                vals = [
+                    Validator.new(pubkey_from_bytes(u.pub_key), u.power)
+                    for u in res_init.validators
+                ]
+                state.validators = ValidatorSet(vals)
+                state.next_validators = ValidatorSet(vals)
+                state.next_validators.increment_proposer_priority(1)
+                save_state(self.state_db, state)
+
+        if store_block_height == 0:
+            return app_hash
+
+        if store_block_height < app_block_height:
+            raise HandshakeError(
+                f"app block height {app_block_height} ahead of store {store_block_height}"
+            )
+        if store_block_height < state_block_height:
+            raise HandshakeError(
+                f"state height {state_block_height} ahead of store {store_block_height}"
+            )
+        if store_block_height > state_block_height + 1:
+            raise HandshakeError(
+                f"store height {store_block_height} > state height {state_block_height}+1"
+            )
+
+        if store_block_height == state_block_height:
+            # chain state is in sync; catch the app up if needed (:354-365)
+            if app_block_height < store_block_height:
+                return self._replay_range(state, proxy_app, app_block_height, store_block_height, False)
+            return app_hash
+
+        # store == state + 1: block saved but not applied (crash between
+        # SaveBlock and ApplyBlock; reference :367-414)
+        if app_block_height < state_block_height:
+            # app even further behind: replay to store-1 then apply last
+            return self._replay_range(state, proxy_app, app_block_height, store_block_height, True)
+        if app_block_height == state_block_height:
+            # apply the saved block with the real app (:377-388)
+            return self._apply_block(state, proxy_app.consensus, store_block_height)
+        if app_block_height == store_block_height:
+            # app already executed it: replay state-mutation only with a
+            # mock app serving stored ABCI responses (:390-404)
+            responses = load_abci_responses(self.state_db, store_block_height)
+            if responses is None:
+                raise HandshakeError(
+                    f"no ABCI responses stored for height {store_block_height}"
+                )
+            mock = _MockProxyApp(app_hash, responses)
+            return self._apply_block(state, mock, store_block_height)
+
+        raise HandshakeError(
+            f"unhandled replay case app={app_block_height} store={store_block_height} state={state_block_height}"
+        )
+
+    def _replay_range(
+        self, state, proxy_app, app_block_height: int, store_block_height: int, mutate_state: bool
+    ) -> bytes:
+        """Replay blocks through the app only (no state mutation), except
+        optionally the last one (reference replayBlocks :420-460)."""
+        app_hash = b""
+        final = store_block_height
+        first = app_block_height + 1
+        if mutate_state:
+            final -= 1
+        for height in range(first, final + 1):
+            LOG.info("applying block %d (app-only replay)", height)
+            block = self.store.load_block(height)
+            app_hash = _exec_block_on_app(proxy_app.consensus, block, self.state_db)
+            self.n_blocks += 1
+        if mutate_state:
+            return self._apply_block(state, proxy_app.consensus, store_block_height)
+        return app_hash
+
+    def _apply_block(self, state, app_conn, height: int):
+        """Full ApplyBlock for the stored block at `height` (reference
+        replayBlock :462-480)."""
+        block = self.store.load_block(height)
+        part_set = make_part_set(block)
+        block_exec = BlockExecutor(self.state_db, app_conn, event_bus=self.event_bus)
+        new_state = block_exec.apply_block(
+            state, BlockID(block.hash(), part_set.header()), block
+        )
+        self.n_blocks += 1
+        self.initial_state = new_state
+        return new_state.app_hash
+
+
+def _exec_block_on_app(app_conn, block, state_db) -> bytes:
+    """BeginBlock→DeliverTx→EndBlock→Commit against the app only
+    (reference ExecCommitBlock, state/execution.go:509-525; no chain-state
+    mutation, returns the app hash). BeginBlock carries the same
+    last-commit vote info as original execution, loaded from the
+    historical validator store (reference getBeginBlockValidatorInfo)."""
+    from ..state.execution import make_last_commit_info
+
+    last_validators = None
+    if block.header.height > 1:
+        try:
+            last_validators = sm_store.load_validators(state_db, block.header.height - 1)
+        except sm_store.NoValSetForHeightError:
+            LOG.warning(
+                "no historical valset for height %d; replaying BeginBlock without vote info",
+                block.header.height - 1,
+            )
+    app_conn.begin_block(
+        abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header=block.header,
+            last_commit_info=make_last_commit_info(last_validators, block),
+            byzantine_validators=[
+                abci.Evidence(
+                    type="duplicate/vote",
+                    validator_address=ev.address(),
+                    height=ev.height(),
+                    time=block.header.time,
+                )
+                for ev in block.evidence.evidence
+            ],
+        )
+    )
+    for tx in block.data.txs:
+        app_conn.deliver_tx(tx)
+    app_conn.end_block(abci.RequestEndBlock(height=block.header.height))
+    res = app_conn.commit()
+    return res.data
+
+
+class _MockProxyApp:
+    """Serves stored ABCI responses instead of re-executing (reference
+    newMockProxyApp :446-481)."""
+
+    def __init__(self, app_hash: bytes, abci_responses):
+        self._app_hash = app_hash
+        self._responses = abci_responses
+        self._tx_count = 0
+
+    def begin_block(self, req):
+        self._tx_count = 0
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, tx):
+        r = self._responses.deliver_tx[self._tx_count]
+        self._tx_count += 1
+        return r
+
+    def end_block(self, req):
+        return self._responses.end_block or abci.ResponseEndBlock()
+
+    def commit(self):
+        return abci.ResponseCommit(data=self._app_hash)
+
+    def flush(self):
+        pass

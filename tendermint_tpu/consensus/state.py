@@ -1,0 +1,970 @@
+"""ConsensusState — the Tendermint BFT state machine.
+
+Reference parity: consensus/state.go. The single-writer receive loop
+(receiveRoutine :561-622) consumes peer messages, internal (self-signed)
+messages, and timeouts from one queue; every message is WAL'd before
+processing (fsync'd for internal ones). The transition graph —
+enterNewRound :730 → enterPropose :800 → enterPrevote :942 →
+enterPrevoteWait :997 → enterPrecommit (lock/unlock/POL) :1025 →
+enterPrecommitWait :1121 → enterCommit :1149 → finalizeCommit :1225 —
+is reproduced exactly, including proposer selection, POL locking rules,
+and the commit fsync ordering with fail points.
+
+Vote ingestion (addVote :1495-1639) is north-star call site #2: the
+machine verifies one vote at a time on the live path (latency-shaped);
+bulk verification happens in VoteSet.add_votes (WAL replay, reactor
+catch-up) and ValidatorSet.verify_commit (fast sync) on the TPU.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..config import ConsensusConfig
+from ..libs import fail
+from ..state import BlockExecutor
+from ..state import state as sm_state
+from ..types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    ErrVoteConflictingVotes,
+    Proposal,
+    Vote,
+    now_ns,
+)
+from ..types.block import Block, Commit
+from ..types.event_bus import EventBus
+from ..types.part_set import PartSet
+from ..types.vote_set import ErrVoteInvalid, VoteSet
+from . import cstypes
+from .cstypes import (
+    STEP_COMMIT,
+    STEP_NEW_HEIGHT,
+    STEP_NEW_ROUND,
+    STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT,
+    STEP_PREVOTE,
+    STEP_PREVOTE_WAIT,
+    STEP_PROPOSE,
+    HeightVoteSet,
+    RoundState,
+    RoundStepType,
+)
+from .messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import NilWAL, WAL, EndHeightMessage, TimedWALMessage
+
+LOG = logging.getLogger("consensus")
+
+
+class ConsensusState:
+    """The consensus machine for one node (reference ConsensusState
+    :63-119). Not a BaseService subclass: lifecycle is start()/stop()
+    with a dedicated receive thread."""
+
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state,  # sm.State
+        block_exec: BlockExecutor,
+        block_store,
+        mempool=None,
+        evpool=None,
+        event_bus: Optional[EventBus] = None,
+        priv_validator=None,
+        wal=None,
+    ):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        from ..types.event_bus import NopEventBus
+
+        self.mempool = mempool
+        self.evpool = evpool
+        self.event_bus = event_bus or NopEventBus()
+        self.priv_validator = priv_validator
+        self.wal = wal if wal is not None else NilWAL()
+
+        self.rs = RoundState()
+        self.state = None  # set by update_to_state
+
+        # message queues (reference :38 msgQueueSize=1000)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2000)
+        self.ticker = TimeoutTicker()
+        self._thread: Optional[threading.Thread] = None
+        self._tock_thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+        self._stopped = threading.Event()
+        self._replay_mode = False
+
+        # test/reactor hooks (reference :106-108,150-153)
+        self.decide_proposal: Callable = self._default_decide_proposal
+        self.do_prevote: Callable = self._default_do_prevote
+        self.set_proposal_fn: Callable = self._default_set_proposal
+        # called with each new (height, round, step) — reactor broadcast hook
+        self.on_new_round_step: Optional[Callable] = None
+        # called with each vote we add — reactor HasVote broadcast hook
+        self.on_vote_added: Optional[Callable] = None
+
+        self.n_height_committed = 0  # metrics
+
+        self.update_to_state(state)
+        self._reconstruct_last_commit_if_needed(state)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.wal.start()
+        self.ticker.start()
+        self._catchup_replay(self.rs.height)
+        self._tock_thread = threading.Thread(
+            target=self._tock_forwarder, name="cs-tock", daemon=True
+        )
+        self._tock_thread.start()
+        self._thread = threading.Thread(
+            target=self._receive_routine, name="cs-receive", daemon=True
+        )
+        self._thread.start()
+        self._schedule_round0(self.rs)
+
+    def stop(self) -> None:
+        self._done.set()
+        self.ticker.stop()
+        self._stopped.wait(timeout=5.0)
+        self.wal.stop()
+
+    def wait_until_stopped(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    # --- external API (reactor / RPC entry points) --------------------------
+
+    def add_peer_message(self, msg, peer_id: str = "") -> None:
+        """Queue a message from a peer (reference :356-365 peerMsgQueue)."""
+        try:
+            self._queue.put(("msg", (peer_id, msg)), timeout=1.0)
+        except queue.Full:
+            LOG.warning("consensus queue full; dropping peer message")
+
+    def _send_internal(self, msg) -> None:
+        # internal messages must not drop (reference sendInternalMessage :332)
+        self._queue.put(("msg", ("", msg)))
+
+    def get_round_state(self) -> RoundState:
+        """Snapshot (shallow; the receive loop is the only writer)."""
+        import copy
+
+        return copy.copy(self.rs)
+
+    def is_proposer(self, address: Optional[bytes] = None) -> bool:
+        if address is None:
+            if self.priv_validator is None:
+                return False
+            address = self.priv_validator.get_address()
+        return self.rs.validators.get_proposer().address == address
+
+    # --- state update -------------------------------------------------------
+
+    def update_to_state(self, state) -> None:
+        """Reset the RoundState for state.last_block_height+1 (reference
+        updateToState :471-557)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
+            raise RuntimeError(
+                f"update_to_state expected height {rs.height}, got {state.last_block_height}"
+            )
+
+        # last precommits become LastCommit (reference :497-508)
+        last_precommits: Optional[VoteSet] = None
+        if rs.commit_round > -1 and rs.votes is not None:
+            pc = rs.votes.precommits(rs.commit_round)
+            if pc is None or not pc.has_two_thirds_majority():
+                raise RuntimeError("update_to_state with no +2/3 precommits")
+            last_precommits = pc
+
+        height = state.last_block_height + 1
+        validators = state.validators.copy()
+
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+        if rs.commit_time == 0:
+            rs.start_time = self.config.commit_time(time.time())
+        else:
+            rs.start_time = self.config.commit_time(rs.commit_time)
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+
+        self.state = state
+        self._new_step()
+
+    def _reconstruct_last_commit_if_needed(self, state) -> None:
+        """Rebuild LastCommit from the block store's seen commit after a
+        restart (reference reconstructLastCommit :446-468)."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        seen = self.block_store.load_seen_commit(state.last_block_height)
+        if seen is None:
+            raise RuntimeError(
+                f"no seen commit for height {state.last_block_height} to reconstruct LastCommit"
+            )
+        last_precommits = VoteSet(
+            state.chain_id,
+            state.last_block_height,
+            seen.round(),
+            VOTE_TYPE_PRECOMMIT,
+            state.last_validators,
+        )
+        votes = [v for v in seen.precommits if v is not None]
+        # bulk path: ONE batched (TPU) verification for the whole commit
+        last_precommits.add_votes(votes)
+        if not last_precommits.has_two_thirds_majority():
+            raise RuntimeError("reconstructed LastCommit lacks +2/3")
+        self.rs.last_commit = last_precommits
+
+    def _new_step(self) -> None:
+        rs = self.get_round_state()
+        self.event_bus.publish_new_round_step(rs)
+        if self.on_new_round_step is not None:
+            self.on_new_round_step(rs)
+
+    # --- the receive loop ---------------------------------------------------
+
+    def _tock_forwarder(self) -> None:
+        while not self._done.is_set():
+            try:
+                ti = self.ticker.tock_queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._queue.put(("timeout", ti))
+
+    def _receive_routine(self) -> None:
+        """Single-writer loop (reference receiveRoutine :561-622). All
+        state mutation happens on this thread."""
+        try:
+            while not self._done.is_set():
+                try:
+                    kind, payload = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    if kind == "msg":
+                        peer_id, msg = payload
+                        if peer_id == "":
+                            self.wal.write_sync((peer_id, msg))  # :604-609
+                        else:
+                            self.wal.write((peer_id, msg))
+                        self._handle_msg(msg, peer_id)
+                    elif kind == "timeout":
+                        ti: TimeoutInfo = payload
+                        self.wal.write(ti)
+                        self._handle_timeout(ti)
+                except Exception:
+                    LOG.exception("error in consensus receive loop")
+        finally:
+            self._stopped.set()
+
+    def _handle_msg(self, msg, peer_id: str) -> None:
+        """reference handleMsg :625-674"""
+        if isinstance(msg, ProposalMessage):
+            self.set_proposal_fn(msg.proposal)
+        elif isinstance(msg, BlockPartMessage):
+            self._add_proposal_block_part(msg, peer_id)
+        elif isinstance(msg, VoteMessage):
+            self._try_add_vote(msg.vote, peer_id)
+        else:
+            LOG.warning("unknown message type %s", type(msg))
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """reference handleTimeout :677-711"""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self.event_bus.publish_timeout_propose(self.get_round_state())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self.event_bus.publish_timeout_wait(self.get_round_state())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self.event_bus.publish_timeout_wait(self.get_round_state())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise RuntimeError(f"invalid timeout step {ti.step}")
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:
+        self.ticker.schedule_timeout(TimeoutInfo(duration, height, round_, step))
+
+    def _schedule_round0(self, rs: RoundState) -> None:
+        """reference scheduleRound0 :324-329"""
+        sleep = max(0.0, rs.start_time - time.time())
+        self._schedule_timeout(sleep, rs.height, 0, STEP_NEW_HEIGHT)
+
+    # --- transitions --------------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """reference enterNewRound :730-794"""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != STEP_NEW_HEIGHT
+        ):
+            return
+        LOG.debug("enterNewRound(%d/%d) cur=%s", height, round_, rs)
+
+        # round advance: rotate proposer (reference :747-753)
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            # round 0 fields were set in update_to_state (reference :760-768)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)
+        rs.triggered_timeout_precommit = False
+        self.event_bus.publish_new_round(self.get_round_state())
+        self._new_step()
+
+        # WaitForTxs semantics (reference :775-792 + config.WaitForTxs):
+        # with create_empty_blocks off (or paced by an interval), an empty
+        # mempool waits — except when a proof block is needed (app hash
+        # changed; needProofBlock :713-721)
+        wait_for_txs = (
+            (not self.config.create_empty_blocks or self.config.create_empty_blocks_interval > 0)
+            and round_ == 0
+            and self.mempool is not None
+            and self.mempool.size() == 0
+            and not self._need_proof_block(height)
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval, height, round_, STEP_NEW_ROUND
+                )
+            self.mempool.notify_txs_available(
+                lambda: self._queue.put(("timeout", TimeoutInfo(0, height, round_, STEP_NEW_ROUND)))
+            )
+            return
+        self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """A block is needed even without txs when the app hash changed,
+        to get the new hash signed (reference needProofBlock :713-721)."""
+        if height == 1:
+            return True
+        last_meta = self.block_store.load_block_meta(height - 1)
+        return last_meta is None or self.state.app_hash != last_meta.header.app_hash
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """reference enterPropose :800-847"""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PROPOSE
+        ):
+            return
+        LOG.debug("enterPropose(%d/%d)", height, round_)
+        rs.round = round_
+        rs.step = STEP_PROPOSE
+        self._new_step()
+
+        # if we already have the complete proposal, go straight to prevote
+        # (guarded at the end, reference :812-820)
+        try:
+            self._schedule_timeout(self.config.propose(round_), height, round_, STEP_PROPOSE)
+
+            if self.priv_validator is None:
+                return
+            if not self.is_proposer():
+                return
+            self.decide_proposal(height, round_)
+        finally:
+            if self._is_proposal_complete():
+                self._enter_prevote(height, round_)
+
+    def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """reference defaultDecideProposal :850-905; skipped during WAL
+        replay (the original signed proposal is in the WAL)."""
+        if self._replay_mode:
+            return
+        rs = self.rs
+        if rs.locked_block is not None:
+            block, block_parts = rs.locked_block, rs.locked_block_parts
+        elif rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            made = self._create_proposal_block()
+            if made is None:
+                return
+            block, block_parts = made
+
+        pol_round, pol_block_id = rs.votes.pol_info()
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            block_parts_header=block_parts.header(),
+            pol_round=pol_round,
+            pol_block_id=pol_block_id,
+            timestamp=now_ns(),
+        )
+        try:
+            self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception:
+            LOG.exception("propose: failed to sign proposal")
+            return
+        self._send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total()):
+            self._send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))
+        LOG.info("signed proposal %s", proposal)
+
+    def _create_proposal_block(self):
+        """reference createProposalBlock :907-940"""
+        rs = self.rs
+        if rs.height == 1:
+            commit = Commit(block_id=BlockID(), precommits=[])
+            commit_ok = True
+        elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            commit = rs.last_commit.make_commit()
+            commit_ok = True
+        else:
+            commit_ok = False
+        if not commit_ok:
+            LOG.error("propose step; cannot propose without LastCommit")
+            return None
+
+        max_bytes = self.state.consensus_params.block_size.max_bytes
+        max_gas = self.state.consensus_params.block_size.max_gas
+        if self.mempool is not None:
+            txs = self.mempool.reap_max_bytes_max_gas(max_bytes // 2, max_gas)
+        else:
+            txs = []
+        evidence = self.evpool.pending_evidence() if self.evpool is not None else []
+        proposer = self.priv_validator.get_address()
+        if rs.height == 1:
+            t = self.state.last_block_time  # genesis time (reference state.go:146)
+        else:
+            t = sm_state.median_time(commit, self.state.last_validators)
+        block = self.state.make_block(rs.height, txs, commit if rs.height > 1 else None, evidence, proposer, time_ns=t)
+        if rs.height == 1:
+            block.last_commit = None
+        from ..types.block import make_part_set
+
+        return block, make_part_set(block)
+
+    def _is_proposal_complete(self) -> bool:
+        """reference isProposalComplete :796-809"""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """reference enterPrevote :942-975"""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PREVOTE
+        ):
+            return
+        LOG.debug("enterPrevote(%d/%d)", height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+        self.do_prevote(height, round_)
+
+    def _default_do_prevote(self, height: int, round_: int) -> None:
+        """reference defaultDoPrevote :977-995"""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(VOTE_TYPE_PREVOTE, rs.locked_block.hash(), rs.locked_block_parts.header())
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", None)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except Exception as e:
+            LOG.warning("prevote: ProposalBlock is invalid: %s", e)
+            self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", None)
+            return
+        self._sign_add_vote(
+            VOTE_TYPE_PREVOTE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
+        )
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        """reference enterPrevoteWait :997-1022"""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise RuntimeError("enter_prevote_wait without +2/3 prevotes (any)")
+        LOG.debug("enterPrevoteWait(%d/%d)", height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.config.prevote(round_), height, round_, STEP_PREVOTE_WAIT)
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """reference enterPrecommit :1025-1118 — the POL lock/unlock
+        logic."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= STEP_PRECOMMIT
+        ):
+            return
+        LOG.debug("enterPrecommit(%d/%d)", height, round_)
+        rs.round = round_
+        rs.step = STEP_PRECOMMIT
+        self._new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id = prevotes.two_thirds_majority() if prevotes else None
+
+        # no polka: precommit nil (reference :1044-1052)
+        if block_id is None:
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+            return
+
+        self.event_bus.publish_polka(self.get_round_state())
+
+        # polka for nil: unlock if locked (reference :1061-1075)
+        if not block_id.hash:
+            if rs.locked_block is not None:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                self.event_bus.publish_unlock(self.get_round_state())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+            return
+
+        # polka for our locked block: re-lock (reference :1078-1086)
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.locked_round = round_
+            self.event_bus.publish_relock(self.get_round_state())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            return
+
+        # polka for our proposal block: lock it (reference :1089-1103)
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+            except Exception as e:
+                raise RuntimeError(f"enter_precommit: +2/3 prevoted an invalid block: {e}")
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish_lock(self.get_round_state())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            return
+
+        # polka for a block we don't have: unlock, fetch (reference :1106-1116)
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+            block_id.parts_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(block_id.parts_header)
+        self.event_bus.publish_unlock(self.get_round_state())
+        self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        """reference enterPrecommitWait :1121-1146"""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise RuntimeError("enter_precommit_wait without +2/3 precommits (any)")
+        LOG.debug("enterPrecommitWait(%d/%d)", height, round_)
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.config.precommit(round_), height, round_, STEP_PRECOMMIT_WAIT)
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """reference enterCommit :1149-1198"""
+        rs = self.rs
+        if rs.height != height or rs.step >= STEP_COMMIT:
+            return
+        LOG.debug("enterCommit(%d/%d)", height, commit_round)
+        try:
+            rs.step = STEP_COMMIT
+            rs.commit_round = commit_round
+            rs.commit_time = time.time()
+            self._new_step()
+
+            block_id = rs.votes.precommits(commit_round).two_thirds_majority()
+            if block_id is None:
+                raise RuntimeError("enter_commit without +2/3 precommit majority")
+            # our locked block IS the committed block (reference :1168-1174)
+            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.parts_header
+                ):
+                    # need to fetch the committed block (reference :1180-1190)
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(block_id.parts_header)
+        finally:
+            self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        """reference tryFinalizeCommit :1201-1222"""
+        rs = self.rs
+        if rs.height != height:
+            raise RuntimeError("try_finalize_commit wrong height")
+        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        if block_id is None or not block_id.hash:
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            return  # don't have the block yet
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """reference finalizeCommit :1225-1318 — the fsync-ordered commit
+        sequence with fail points."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if block is None or block.hash() != block_id.hash:
+            raise RuntimeError("cannot finalize: no proposal block / hash mismatch")
+
+        self.block_exec.validate_block(self.state, block)  # :1243
+
+        LOG.info(
+            "finalizing commit of block h=%d hash=%s txs=%d",
+            block.header.height,
+            (block.hash() or b"").hex()[:12],
+            len(block.data.txs),
+        )
+
+        fail.fail_point("FinalizeCommit.BeforeSave")  # :1251
+        if self.block_store.height() < block.header.height:
+            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+            self.block_store.save_block(block, block_parts, seen_commit)  # :1254-1259
+        fail.fail_point("FinalizeCommit.AfterSave")  # :1265
+
+        # WAL EndHeight BEFORE ApplyBlock: on crash we replay from here and
+        # the handshake re-applies the block to the app (reference :1271-1285)
+        self.wal.write_end_height(height)
+        fail.fail_point("FinalizeCommit.AfterWAL")  # :1282
+
+        state_copy = self.state.copy()
+        try:
+            state_copy = self.block_exec.apply_block(
+                state_copy, BlockID(block.hash(), block_parts.header()), block
+            )
+        except Exception:
+            LOG.exception("failed to apply block; exiting consensus")
+            raise
+        fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
+
+        self.n_height_committed += 1
+        self.update_to_state(state_copy)  # :1306
+        self._schedule_round0(self.rs)  # :1312
+
+    # --- proposal handling --------------------------------------------------
+
+    def _default_set_proposal(self, proposal: Proposal) -> None:
+        """reference defaultSetProposal :1324-1357"""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or (
+            proposal.pol_round >= 0 and proposal.pol_round >= proposal.round
+        ):
+            raise ErrVoteInvalid("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_bytes(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ErrVoteInvalid("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(proposal.block_parts_header)
+        LOG.info("received proposal %s", proposal)
+
+    def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
+        """reference addProposalBlockPart :1361-1462"""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.is_complete():
+            from ..types import serde
+
+            rs.proposal_block = serde.decode_block(rs.proposal_block_parts.assemble())
+            LOG.info("received complete proposal block %s", rs.proposal_block)
+            self.event_bus.publish_complete_proposal(self.get_round_state())
+
+            prevotes = rs.votes.prevotes(rs.round)
+            block_id = prevotes.two_thirds_majority() if prevotes else None
+            if block_id is not None and block_id.hash and rs.valid_round < rs.round:
+                if rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = rs.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+
+            if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+            elif rs.step == STEP_COMMIT:
+                self._try_finalize_commit(rs.height)
+        return True
+
+    # --- vote handling ------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference tryAddVote :1468-1493 — conflicting votes become
+        evidence."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ErrVoteConflictingVotes as e:
+            if self.priv_validator is not None and vote.validator_address == self.priv_validator.get_address():
+                LOG.error("found conflicting vote from ourselves: %s", vote)
+                return False
+            if self.evpool is not None:
+                from ..types.evidence import DuplicateVoteEvidence
+
+                _, val = self.rs.validators.get_by_address(vote.validator_address)
+                if val is not None:
+                    self.evpool.add_evidence(
+                        DuplicateVoteEvidence(val.pub_key, e.vote_a, e.vote_b)
+                    )
+            return False
+        except ErrVoteInvalid as e:
+            LOG.warning("invalid vote from %s: %s", peer_id or "self", e)
+            return False
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """reference addVote :1495-1639"""
+        rs = self.rs
+
+        # late precommit for the previous height (reference :1504-1527)
+        if vote.height + 1 == rs.height:
+            if not (vote.type == VOTE_TYPE_PRECOMMIT and rs.step == STEP_NEW_HEIGHT and rs.last_commit is not None):
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added:
+                LOG.debug("added late precommit to last commit: %s", rs.last_commit)
+                self.event_bus.publish_vote(vote)
+                if self.on_vote_added is not None:
+                    self.on_vote_added(vote)
+                if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                    self._enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            LOG.debug("vote ignored: wrong height %d vs %d", vote.height, rs.height)
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self.event_bus.publish_vote(vote)
+        if self.on_vote_added is not None:
+            self.on_vote_added(vote)
+
+        if vote.type == VOTE_TYPE_PREVOTE:
+            self._on_prevote_added(vote)
+        elif vote.type == VOTE_TYPE_PRECOMMIT:
+            self._on_precommit_added(vote)
+        return True
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        """reference addVote prevote branch :1539-1601"""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        block_id = prevotes.two_thirds_majority()
+
+        if block_id is not None:
+            # unlock on newer polka (reference :1547-1558)
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round
+                and vote.round <= rs.round
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                LOG.info("unlocking because of POL at round %d", vote.round)
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                self.event_bus.publish_unlock(self.get_round_state())
+            # valid-block update (reference :1561-1581)
+            if block_id.hash and rs.valid_round < vote.round and vote.round == rs.round:
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    rs.proposal_block = None
+                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                    block_id.parts_header
+                ):
+                    rs.proposal_block_parts = PartSet(block_id.parts_header)
+
+        # step transitions (reference :1585-1601)
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= STEP_PREVOTE:
+            if block_id is not None and (self._is_proposal_complete() or not block_id.hash):
+                self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                self._enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and 0 <= rs.proposal.pol_round == vote.round:
+            if self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        """reference addVote precommit branch :1603-1632"""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        block_id = precommits.two_thirds_majority()
+        if block_id is not None:
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit(rs.height, vote.round)
+            if block_id.hash:
+                self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self._enter_new_round(rs.height, 0)
+            else:
+                self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit_wait(rs.height, vote.round)
+
+    # --- vote signing -------------------------------------------------------
+
+    def _sign_vote(self, type_: int, hash_: bytes, header) -> Vote:
+        """reference signVote :1641-1668"""
+        rs = self.rs
+        addr = self.priv_validator.get_address()
+        idx, _ = rs.validators.get_by_address(addr)
+        from ..types.basic import PartSetHeader
+
+        vote = Vote(
+            validator_address=addr,
+            validator_index=idx,
+            height=rs.height,
+            round=rs.round,
+            timestamp=self._vote_time(),
+            type=type_,
+            block_id=BlockID(hash_, header or PartSetHeader()),
+        )
+        self.priv_validator.sign_vote(self.state.chain_id, vote)
+        return vote
+
+    def _vote_time(self) -> int:
+        """Vote time must exceed the voted block's time by iota, so the
+        next block's median commit time is strictly increasing (reference
+        voteTime :1658-1673)."""
+        now = now_ns()
+        rs = self.rs
+        min_t = now
+        if rs.locked_block is not None:
+            min_t = rs.locked_block.header.time + self.config.blocktime_iota
+        elif rs.proposal_block is not None:
+            min_t = rs.proposal_block.header.time + self.config.blocktime_iota
+        return max(now, min_t)
+
+    def _sign_add_vote(self, type_: int, hash_: bytes, header) -> Optional[Vote]:
+        """reference signAddVote :1676-1690; skipped during WAL replay —
+        the WAL already holds the originally-signed votes."""
+        rs = self.rs
+        if self.priv_validator is None or self._replay_mode:
+            return None
+        idx, _ = rs.validators.get_by_address(self.priv_validator.get_address())
+        if idx < 0:
+            return None  # not a validator
+        try:
+            vote = self._sign_vote(type_, hash_, header)
+        except Exception:
+            LOG.exception("failed signing %s vote", "prevote" if type_ == VOTE_TYPE_PREVOTE else "precommit")
+            return None
+        self._send_internal(VoteMessage(vote))
+        LOG.debug("signed and queued vote %s", vote)
+        return vote
+
+    # --- WAL catchup replay -------------------------------------------------
+
+    def _catchup_replay(self, height: int) -> None:
+        """Replay WAL messages for `height` after a crash (reference
+        catchupReplay :97-155)."""
+        msgs = self.wal.search_for_end_height(height - 1)
+        if msgs is None:
+            if height == 1:
+                return
+            LOG.info("no WAL data for height %d; relying on handshake", height)
+            return
+        self._replay_mode = True
+        try:
+            for m in msgs:
+                self._replay_one(m)
+            LOG.info("WAL replay for height %d done: %d messages", height, len(msgs))
+        finally:
+            self._replay_mode = False
+
+    def _replay_one(self, msg) -> None:
+        if isinstance(msg, EndHeightMessage):
+            return
+        if isinstance(msg, TimedWALMessage):
+            msg = msg.msg
+        if isinstance(msg, TimeoutInfo):
+            self._handle_timeout(msg)
+        elif isinstance(msg, tuple):
+            peer_id, m = msg
+            try:
+                self._handle_msg(m, peer_id)
+            except Exception:
+                LOG.exception("error replaying WAL message")
+
+

@@ -1,0 +1,68 @@
+"""TimeoutTicker — schedules consensus step timeouts (reference
+consensus/ticker.go:17-40).
+
+One timer at a time; scheduling a new timeout for a later (H,R,S)
+overrides the pending one; stale timeouts (older HRS) are ignored both at
+schedule and at fire time. Fired timeouts land on tick_chan for the
+consensus receive loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+from ..libs.service import BaseService
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float  # seconds
+    height: int
+    round: int
+    step: int
+
+    def hrs(self):
+        return (self.height, self.round, self.step)
+
+    def __str__(self):
+        return f"{self.duration:.3f}s@{self.height}/{self.round}/{self.step}"
+
+
+class TimeoutTicker(BaseService):
+    """schedule_timeout(ti) → (after ti.duration) tock_queue.put(ti),
+    unless overridden by a newer HRS first."""
+
+    def __init__(self):
+        super().__init__("TimeoutTicker")
+        self.tock_queue: "queue.Queue[TimeoutInfo]" = queue.Queue()
+        self._timer: threading.Timer | None = None
+        self._active: TimeoutInfo | None = None
+        self._tlock = threading.Lock()
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        with self._tlock:
+            if self._active is not None and ti.hrs() < self._active.hrs():
+                return  # stale
+            if self._timer is not None:
+                self._timer.cancel()
+            self._active = ti
+            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        with self._tlock:
+            if self._active is not ti:
+                return  # overridden
+            self._active = None
+            self._timer = None
+        self.tock_queue.put(ti)
+
+    def on_stop(self) -> None:
+        with self._tlock:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            self._active = None

@@ -1,0 +1,4 @@
+"""Evidence pool + store (reference evidence/)."""
+
+from .pool import EvidencePool  # noqa: F401
+from .store import EvidenceStore  # noqa: F401

@@ -1,0 +1,83 @@
+"""EvidencePool — uncommitted evidence awaiting block inclusion.
+
+Reference parity: evidence/pool.go:17-151. Valid new evidence enters the
+store + an in-order list the reactor broadcasts from; on every committed
+block the pool marks included evidence committed and prunes expired
+entries.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from ..state import validation as sm_validation
+from .store import EvidenceStore
+
+LOG = logging.getLogger("evidence")
+
+
+class EvidencePool:
+    def __init__(self, store: EvidenceStore, state, load_validators=None):
+        self.store = store
+        self._state = state  # latest sm.State
+        self._load_validators = load_validators
+        self._lock = threading.Lock()
+        self._evidence_list: List[object] = list(store.pending_evidence())
+        # reactor wait hook: callbacks fired when new evidence arrives
+        self._new_evidence_cbs: List = []
+
+    def update_state(self, state) -> None:
+        with self._lock:
+            self._state = state
+
+    def pending_evidence(self) -> List[object]:
+        return self.store.pending_evidence()
+
+    def is_committed(self, evidence) -> bool:
+        return self.store.is_committed(evidence)
+
+    def add_evidence(self, evidence) -> None:
+        """Verify + admit (reference pool.go AddEvidence :81-113). Raises
+        on invalid evidence; duplicates are no-ops."""
+        with self._lock:
+            state = self._state
+        sm_validation.verify_evidence(state, evidence, self._load_validators)
+        _, val = state.validators.get_by_address(evidence.address())
+        priority = val.voting_power if val is not None else 0
+        if not self.store.add_new_evidence(evidence, priority):
+            return  # already known
+        LOG.info("verified new evidence of byzantine behavior: %s", evidence)
+        with self._lock:
+            self._evidence_list.append(evidence)
+            cbs, self._new_evidence_cbs = self._new_evidence_cbs, []
+        for cb in cbs:
+            try:
+                cb(evidence)
+            except Exception:
+                LOG.exception("evidence callback failed")
+
+    def update(self, block, state) -> None:
+        """Post-commit bookkeeping (reference pool.go Update :115-134)."""
+        if state.last_block_height != block.header.height:
+            raise ValueError("evidence pool update with non-matching state height")
+        self.update_state(state)
+        for ev in block.evidence.evidence:
+            self.store.mark_committed(ev)
+            with self._lock:
+                self._evidence_list = [
+                    e for e in self._evidence_list if e.hash() != ev.hash()
+                ]
+        # prune expired
+        max_age = state.consensus_params.evidence.max_age
+        if block.header.height > max_age:
+            self.store.prune_pending_before(block.header.height - max_age)
+
+    def notify_new_evidence(self, cb) -> None:
+        with self._lock:
+            self._new_evidence_cbs.append(cb)
+
+    def evidence_snapshot(self) -> List[object]:
+        with self._lock:
+            return list(self._evidence_list)

@@ -1,0 +1,92 @@
+"""EvidenceStore — persistent evidence keyed by (height, hash).
+
+Reference parity: evidence/store.go. Three namespaces: lookup (all
+evidence with metadata), outqueue (pending broadcast), pendingqueue
+(not yet committed to a block).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..libs.db import DB
+from ..types import serde
+from ..types.evidence import evidence_from_obj, evidence_to_obj
+
+
+def _key(prefix: bytes, height: int, hash_: bytes) -> bytes:
+    return prefix + struct.pack(">Q", height) + b"/" + hash_
+
+
+_LOOKUP = b"evidence-lookup/"
+_PENDING = b"evidence-pending/"
+
+
+@dataclass
+class EvidenceInfo:
+    committed: bool
+    priority: int
+    evidence: object
+
+
+class EvidenceStore:
+    def __init__(self, db: DB):
+        self.db = db
+
+    def _info_obj(self, ei: EvidenceInfo):
+        return [ei.committed, ei.priority, evidence_to_obj(ei.evidence)]
+
+    def _info_from(self, o) -> EvidenceInfo:
+        return EvidenceInfo(committed=o[0], priority=o[1], evidence=evidence_from_obj(o[2]))
+
+    def add_new_evidence(self, evidence, priority: int) -> bool:
+        """False if already stored (reference store.go AddNewEvidence)."""
+        lk = _key(_LOOKUP, evidence.height(), evidence.hash())
+        if self.db.get(lk) is not None:
+            return False
+        ei = EvidenceInfo(committed=False, priority=priority, evidence=evidence)
+        raw = serde.pack(self._info_obj(ei))
+        self.db.set(lk, raw)
+        self.db.set(_key(_PENDING, evidence.height(), evidence.hash()), raw)
+        return True
+
+    def pending_evidence(self) -> List[object]:
+        """All uncommitted evidence, oldest height first."""
+        out = []
+        for _, raw in self.db.iterator(_PENDING, _PENDING + b"\xff" * 9):
+            out.append(self._info_from(serde.unpack(raw)).evidence)
+        return out
+
+    def mark_committed(self, evidence) -> None:
+        """Remove from pending; flag lookup row committed (reference
+        MarkEvidenceAsCommitted)."""
+        self.db.delete(_key(_PENDING, evidence.height(), evidence.hash()))
+        lk = _key(_LOOKUP, evidence.height(), evidence.hash())
+        raw = self.db.get(lk)
+        if raw is not None:
+            ei = self._info_from(serde.unpack(raw))
+            ei.committed = True
+            self.db.set(lk, serde.pack(self._info_obj(ei)))
+
+    def get_info(self, height: int, hash_: bytes) -> Optional[EvidenceInfo]:
+        raw = self.db.get(_key(_LOOKUP, height, hash_))
+        return self._info_from(serde.unpack(raw)) if raw else None
+
+    def is_committed(self, evidence) -> bool:
+        ei = self.get_info(evidence.height(), evidence.hash())
+        return ei is not None and ei.committed
+
+    def has_evidence(self, evidence) -> bool:
+        return self.get_info(evidence.height(), evidence.hash()) is not None
+
+    def prune_pending_before(self, height: int) -> None:
+        """Drop expired pending evidence (age pruning)."""
+        dead = []
+        for k, raw in self.db.iterator(_PENDING, _PENDING + b"\xff" * 9):
+            ei = self._info_from(serde.unpack(raw))
+            if ei.evidence.height() < height:
+                dead.append(k)
+        for k in dead:
+            self.db.delete(k)

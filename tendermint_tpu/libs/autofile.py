@@ -1,0 +1,135 @@
+"""Rotating file groups — the WAL substrate (reference libs/autofile/group.go).
+
+A Group is a head file `path` plus rotated chunks `path.000`, `path.001`, …
+Writes go to the head; when the head exceeds head_size_limit it rotates.
+total_size_limit prunes the oldest chunks. Readers iterate all chunks in
+order (oldest → head), which is what WAL replay and SearchForEndHeight
+need.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Iterator, List, Optional
+
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # group.go:26 (10MB)
+DEFAULT_TOTAL_SIZE_LIMIT = 1024 * 1024 * 1024  # 1GB
+
+
+class Group:
+    def __init__(
+        self,
+        head_path: str,
+        head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+        total_size_limit: int = DEFAULT_TOTAL_SIZE_LIMIT,
+    ):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(head_path) or ".", exist_ok=True)
+        self._head = open(head_path, "ab")
+
+    # --- write --------------------------------------------------------------
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            self._head.write(data)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._head.flush()
+
+    def sync(self) -> None:
+        with self._lock:
+            self._head.flush()
+            os.fsync(self._head.fileno())
+
+    def maybe_rotate(self) -> None:
+        """Rotate the head if it exceeds head_size_limit; prune when the
+        group exceeds total_size_limit (group.go checkHeadSizeLimit /
+        checkTotalSizeLimit)."""
+        with self._lock:
+            self._head.flush()
+            if os.path.getsize(self.head_path) < self.head_size_limit:
+                return
+            self._rotate_locked()
+            self._prune_locked()
+
+    def _rotate_locked(self) -> None:
+        self._head.close()
+        idx = self._chunk_indices()
+        nxt = (idx[-1] + 1) if idx else 0
+        os.replace(self.head_path, f"{self.head_path}.{nxt:03d}")
+        self._head = open(self.head_path, "ab")
+
+    def _prune_locked(self) -> None:
+        total = os.path.getsize(self.head_path)
+        chunks = [(i, f"{self.head_path}.{i:03d}") for i in self._chunk_indices()]
+        sizes = {p: os.path.getsize(p) for _, p in chunks}
+        total += sum(sizes.values())
+        for _, p in chunks:
+            if total <= self.total_size_limit:
+                break
+            os.remove(p)
+            total -= sizes[p]
+
+    def _chunk_indices(self) -> List[int]:
+        d = os.path.dirname(self.head_path) or "."
+        base = os.path.basename(self.head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        out = []
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # --- read ---------------------------------------------------------------
+
+    def paths_in_order(self) -> List[str]:
+        with self._lock:
+            self._head.flush()
+        paths = [f"{self.head_path}.{i:03d}" for i in self._chunk_indices()]
+        if os.path.exists(self.head_path):
+            paths.append(self.head_path)
+        return paths
+
+    def reader(self) -> "GroupReader":
+        return GroupReader(self.paths_in_order())
+
+    def close(self) -> None:
+        with self._lock:
+            self._head.close()
+
+
+class GroupReader:
+    """Sequential reader over the group's chunks oldest → head."""
+
+    def __init__(self, paths: List[str]):
+        self._paths = paths
+        self._i = 0
+        self._fh = None
+
+    def read(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            if self._fh is None:
+                if self._i >= len(self._paths):
+                    break
+                self._fh = open(self._paths[self._i], "rb")
+                self._i += 1
+            chunk = self._fh.read(n - len(out))
+            if not chunk:
+                self._fh.close()
+                self._fh = None
+                continue
+            out += chunk
+        return out
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,8 @@
+"""Mempool (reference mempool/)."""
+
+from .mempool import (  # noqa: F401
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    Mempool,
+    TxCache,
+)

@@ -1,0 +1,271 @@
+"""Mempool — app-validated pending transactions.
+
+Reference parity: mempool/mempool.go. Txs pass CheckTx against the app's
+mempool connection (:299), live in an ordered list traversed lock-light
+by the gossip reactor (CList in the reference; here a list + condition
+variable with monotonically-growing indices), are reaped for proposals
+(:466 ReapMaxBytesMaxGas), and are rechecked after every commit (:526
+Update). A sha256 cache dedupes (:60).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..abci import types as abci
+from ..config import MempoolConfig
+
+LOG = logging.getLogger("mempool")
+
+
+class ErrTxInCache(Exception):
+    pass
+
+
+class ErrMempoolIsFull(Exception):
+    pass
+
+
+class ErrPreCheck(Exception):
+    pass
+
+
+def _tx_key(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+@dataclass
+class MempoolTx:
+    """reference mempoolTx :550-560"""
+
+    tx: bytes
+    gas_wanted: int
+    height: int  # height at which tx was validated
+
+
+class TxCache:
+    """LRU sha256 cache (reference mempool/mempool.go:613-675)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        key = _tx_key(tx)
+        with self._lock:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self.size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._lock:
+            self._map.pop(_tx_key(tx), None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+class Mempool:
+    """The reference's Mempool struct (:63-117). Locking model: `lock`
+    serializes Update/Reap against CheckTx (reference :34-60 doc)."""
+
+    def __init__(
+        self,
+        config: MempoolConfig,
+        proxy_app,  # mempool connection client
+        height: int = 0,
+    ):
+        self.config = config
+        self.proxy_app = proxy_app
+        self.height = height
+        self._lock = threading.RLock()  # the proxy/update mutex
+        self._txs: List[MempoolTx] = []
+        self._txs_map: Dict[bytes, MempoolTx] = {}
+        self.cache = TxCache(config.cache_size)
+        self.pre_check: Optional[Callable[[bytes], None]] = None
+        self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
+        self._txs_available_cbs: List[Callable[[], None]] = []
+        self._cond = threading.Condition(self._lock)
+
+    # --- basic accessors ----------------------------------------------------
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def tx_bytes(self) -> int:
+        with self._lock:
+            return sum(len(t.tx) for t in self._txs)
+
+    def lock(self) -> None:
+        self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    def flush_app_conn(self) -> None:
+        self.proxy_app.flush()
+
+    def flush(self) -> None:
+        """Remove everything (reference Flush :450)."""
+        with self._lock:
+            self._txs.clear()
+            self._txs_map.clear()
+            self.cache.reset()
+
+    def txs_snapshot(self) -> List[bytes]:
+        with self._lock:
+            return [t.tx for t in self._txs]
+
+    # --- txs-available notification (reference :119-161) --------------------
+
+    def notify_txs_available(self, cb: Callable[[], None]) -> None:
+        """One-shot callback when the pool becomes non-empty."""
+        with self._lock:
+            if self._txs:
+                cb()
+            else:
+                self._txs_available_cbs.append(cb)
+
+    def _fire_txs_available(self) -> None:
+        cbs, self._txs_available_cbs = self._txs_available_cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:
+                LOG.exception("txs_available callback failed")
+
+    # --- CheckTx ------------------------------------------------------------
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        """Validate tx against the app and admit to the pool (reference
+        CheckTx :299-345 + resCbNormal :357-397)."""
+        with self._lock:
+            if len(self._txs) >= self.config.size:
+                raise ErrMempoolIsFull(f"mempool is full: {len(self._txs)} txs")
+            if self.pre_check is not None:
+                try:
+                    self.pre_check(tx)
+                except Exception as e:
+                    raise ErrPreCheck(str(e))
+            if not self.cache.push(tx):
+                raise ErrTxInCache("tx already exists in cache")
+
+            res = self.proxy_app.check_tx(tx)
+            if self.post_check is not None:
+                try:
+                    self.post_check(tx, res)
+                except Exception as e:
+                    res = abci.ResponseCheckTx(code=1, log=f"postCheck: {e}")
+
+            if res.code == abci.CODE_TYPE_OK:
+                mtx = MempoolTx(tx=tx, gas_wanted=res.gas_wanted, height=self.height)
+                self._txs.append(mtx)
+                self._txs_map[_tx_key(tx)] = mtx
+                LOG.debug("added good tx %s (pool=%d)", _tx_key(tx).hex()[:12], len(self._txs))
+                self._fire_txs_available()
+                self._cond.notify_all()
+            else:
+                # ineligible: evict from cache so a future fixed app state
+                # can re-admit it (reference :389-394)
+                self.cache.remove(tx)
+                LOG.debug("rejected bad tx code=%d log=%s", res.code, res.log)
+            return res
+
+    # --- Reap ---------------------------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """Txs for a proposal under byte+gas limits (reference
+        ReapMaxBytesMaxGas :466-505)."""
+        with self._lock:
+            total_bytes = 0
+            total_gas = 0
+            out: List[bytes] = []
+            for mtx in self._txs:
+                n = len(mtx.tx)
+                if max_bytes > -1 and total_bytes + n > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + mtx.gas_wanted > max_gas:
+                    break
+                total_bytes += n
+                total_gas += mtx.gas_wanted
+                out.append(mtx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._lock:
+            if n < 0:
+                return [t.tx for t in self._txs]
+            return [t.tx for t in self._txs[:n]]
+
+    # --- Update (post-commit) ----------------------------------------------
+
+    def update(
+        self,
+        height: int,
+        txs: List[bytes],
+        pre_check: Optional[Callable[[bytes], None]] = None,
+        post_check=None,
+    ) -> None:
+        """Remove committed txs; recheck the remainder against the new app
+        state (reference Update :526-567). Caller MUST hold the lock (the
+        BlockExecutor commits under mempool.lock())."""
+        self.height = height
+        if pre_check is not None:
+            self.pre_check = pre_check
+        if post_check is not None:
+            self.post_check = post_check
+
+        committed = {_tx_key(tx) for tx in txs}
+        # commit txs stay in the cache so they can't re-enter
+        for tx in txs:
+            self.cache.push(tx)
+        kept = [m for m in self._txs if _tx_key(m.tx) not in committed]
+        self._txs = kept
+        self._txs_map = {_tx_key(m.tx): m for m in kept}
+
+        if kept and self.config.recheck:
+            LOG.debug("rechecking %d txs at height %d", len(kept), height)
+            self._recheck_txs()
+        if self._txs:
+            self._fire_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """Re-run CheckTx on everything still pending (reference
+        recheckTxs :569-585 + resCbRecheck :399-442)."""
+        still: List[MempoolTx] = []
+        for mtx in self._txs:
+            res = self.proxy_app.check_tx(mtx.tx)
+            if res.code == abci.CODE_TYPE_OK:
+                still.append(mtx)
+            else:
+                self.cache.remove(mtx.tx)
+        self._txs = still
+        self._txs_map = {_tx_key(m.tx): m for m in still}
+
+    # --- gossip support -----------------------------------------------------
+
+    def wait_for_tx_after(self, idx: int, timeout: float = 0.2) -> Optional[int]:
+        """Block until a tx exists at list position idx (the reactor's
+        CList-wait analogue). Returns idx if available."""
+        with self._cond:
+            if idx < len(self._txs):
+                return idx
+            self._cond.wait(timeout)
+            return idx if idx < len(self._txs) else None
+
+    def tx_at(self, idx: int) -> Optional[bytes]:
+        with self._lock:
+            return self._txs[idx].tx if idx < len(self._txs) else None

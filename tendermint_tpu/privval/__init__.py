@@ -1,0 +1,3 @@
+"""Validator signing (reference privval/)."""
+
+from .file_pv import FilePV, load_or_gen_file_pv  # noqa: F401

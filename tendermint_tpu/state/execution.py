@@ -50,13 +50,15 @@ class ABCIResponses:
         return serde.pack(
             [
                 [[r.code, r.data, r.log, r.gas_wanted, r.gas_used,
-                  [[kv.key, kv.value] for kv in r.tags]] for r in self.deliver_tx],
+                  _tags_obj(r.tags)] for r in self.deliver_tx],
                 [
                     [[u.pub_key, u.power] for u in self.end_block.validator_updates],
                     _params_obj(self.end_block.consensus_param_updates),
+                    _tags_obj(self.end_block.tags),
                 ]
                 if self.end_block
                 else None,
+                _tags_obj(self.begin_block.tags) if self.begin_block else None,
             ]
         )
 
@@ -66,7 +68,7 @@ class ABCIResponses:
         dtxs = [
             abci.ResponseDeliverTx(
                 code=r[0], data=r[1], log=r[2], gas_wanted=r[3], gas_used=r[4],
-                tags=[abci.KVPair(k, v) for k, v in r[5]],
+                tags=_tags_from(r[5]),
             )
             for r in o[0]
         ]
@@ -75,8 +77,20 @@ class ABCIResponses:
             eb = abci.ResponseEndBlock(
                 validator_updates=[abci.ValidatorUpdate(u[0], u[1]) for u in o[1][0]],
                 consensus_param_updates=_params_from(o[1][1]),
+                tags=_tags_from(o[1][2]) if len(o[1]) > 2 else [],
             )
-        return cls(dtxs, eb)
+        res = cls(dtxs, eb)
+        if len(o) > 2 and o[2] is not None:
+            res.begin_block = abci.ResponseBeginBlock(tags=_tags_from(o[2]))
+        return res
+
+
+def _tags_obj(tags):
+    return [[kv.key, kv.value] for kv in (tags or [])]
+
+
+def _tags_from(o):
+    return [abci.KVPair(k, v) for k, v in (o or [])]
 
 
 def _params_obj(p):
@@ -242,29 +256,40 @@ class BlockExecutor:
             self.event_bus.publish_validator_set_updates(val_updates)
 
 
+# headroom for header, last commit, and framing when a tx is packed into a
+# block — a tx may only use what's left (reference types.MaxDataBytes)
+BLOCK_OVERHEAD_BYTES = 4096
+
+
 def _tx_pre_check(state: State):
     """Max-bytes pre-check filter for the mempool (reference
     mempool.PreCheckAminoMaxBytes wiring at node/node.go:263)."""
-    max_bytes = state.consensus_params.block_size.max_bytes
+    max_data = state.consensus_params.block_size.max_bytes - BLOCK_OVERHEAD_BYTES
 
     def check(tx: bytes):
-        if len(tx) > max_bytes:
-            raise ValueError(f"tx too large ({len(tx)} > {max_bytes})")
+        if len(tx) > max_data:
+            raise ValueError(f"tx too large ({len(tx)} > {max_data})")
 
     return check
 
 
-def _last_commit_info(state: State, block: Block) -> abci.LastCommitInfo:
-    """(address, power, signed) per last validator (execution.go:277-300)."""
+def make_last_commit_info(last_validators, block: Block) -> abci.LastCommitInfo:
+    """(address, power, signed) per last validator (execution.go:277-300).
+    Shared with handshake replay so replayed BeginBlocks carry the same
+    vote info as original execution."""
     votes = []
-    if block.header.height > 1 and block.last_commit is not None:
-        for i, v in enumerate(state.last_validators.validators):
+    if block.header.height > 1 and block.last_commit is not None and last_validators is not None:
+        for i, v in enumerate(last_validators.validators):
             signed = (
                 i < len(block.last_commit.precommits)
                 and block.last_commit.precommits[i] is not None
             )
             votes.append((v.address, v.voting_power, signed))
     return abci.LastCommitInfo(round=block.last_commit.round() if block.last_commit else 0, votes=votes)
+
+
+def _last_commit_info(state: State, block: Block) -> abci.LastCommitInfo:
+    return make_last_commit_info(state.last_validators, block)
 
 
 def _abci_validator_updates(abci_responses: ABCIResponses) -> List[abci.ValidatorUpdate]:
@@ -284,9 +309,7 @@ def update_state(
     val_updates = _abci_validator_updates(abci_responses)
     if val_updates:
         changes = [
-            Validator.new(pubkey_from_bytes(u.pub_key), u.power) if u.power > 0
-            else Validator(pubkey_from_bytes(u.pub_key).address(), pubkey_from_bytes(u.pub_key), 0)
-            for u in val_updates
+            Validator.new(pubkey_from_bytes(u.pub_key), u.power) for u in val_updates
         ]
         n_val_set.update_with_changes(changes)
         # changes take effect at height+2 (execution.go:419)

@@ -109,9 +109,9 @@ class State:
             self.last_block_total_tx,
             serde.block_id_obj(self.last_block_id),
             self.last_block_time,
-            serde.valset_obj(self.next_validators) if self.next_validators else None,
-            serde.valset_obj(self.validators) if self.validators else None,
-            serde.valset_obj(self.last_validators) if self.last_validators else None,
+            serde.valset_obj(self.next_validators) if self.next_validators is not None else None,
+            serde.valset_obj(self.validators) if self.validators is not None else None,
+            serde.valset_obj(self.last_validators) if self.last_validators is not None else None,
             self.last_height_validators_changed,
             [
                 self.consensus_params.block_size.max_bytes,
@@ -133,9 +133,9 @@ class State:
             last_block_total_tx=o[2],
             last_block_id=serde.block_id_from(o[3]),
             last_block_time=o[4],
-            next_validators=serde.valset_from(o[5]) if o[5] else None,
-            validators=serde.valset_from(o[6]) if o[6] else None,
-            last_validators=serde.valset_from(o[7]) if o[7] else None,
+            next_validators=serde.valset_from(o[5]) if o[5] is not None else None,
+            validators=serde.valset_from(o[6]) if o[6] is not None else None,
+            last_validators=serde.valset_from(o[7]) if o[7] is not None else None,
             last_height_validators_changed=o[8],
             consensus_params=ConsensusParams(
                 BlockSizeParams(o[9][0], o[9][1]), EvidenceParams(o[9][2])

@@ -17,10 +17,13 @@ from ..libs.events import Query, match_op
 from ..libs.service import BaseService
 from ..types import serde
 from ..types.block import tx_hash
-from ..types.event_bus import EVENT_TX, EventBus, query_for_event
-
-TX_HASH_KEY = "tx.hash"
-TX_HEIGHT_KEY = "tx.height"
+from ..types.event_bus import (
+    EVENT_TX,
+    TX_HASH_KEY,
+    TX_HEIGHT_KEY,
+    EventBus,
+    query_for_event,
+)
 
 
 @dataclass
@@ -126,7 +129,11 @@ class KVTxIndexer(TxIndexer):
         intersect hash sets across conditions, scanning secondary rows."""
         for c in query.conditions:
             if c.key == TX_HASH_KEY and c.op == "=":
-                res = self.get(bytes.fromhex(c.value))
+                try:
+                    h = bytes.fromhex(c.value)
+                except ValueError:
+                    return []
+                res = self.get(h)
                 return [res] if res else []
 
         hashes: Optional[set] = None

@@ -49,6 +49,11 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
     if h.height == 1:
         if block.last_commit is not None and block.last_commit.precommits:
             raise ErrInvalidBlock("block at height 1 can't have LastCommit precommits")
+        # block time at height 1 IS the genesis time (validation.go:126-133)
+        if h.time != state.last_block_time:
+            raise ErrInvalidBlock(
+                f"block time {h.time} != genesis time {state.last_block_time}"
+            )
     else:
         if block.last_commit is None or len(block.last_commit.precommits) != len(
             state.last_validators
@@ -61,7 +66,12 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
         state.last_validators.verify_commit(
             state.chain_id, state.last_block_id, h.height - 1, block.last_commit
         )
-        # median-time rule (reference validation.go:118-128)
+        # median-time rule (reference validation.go:110-124): strictly
+        # increasing AND exactly the weighted median of LastCommit times
+        if h.time <= state.last_block_time:
+            raise ErrInvalidBlock(
+                f"block time {h.time} not greater than last block time {state.last_block_time}"
+            )
         expected = median_time(block.last_commit, state.last_validators)
         if h.time != expected:
             raise ErrInvalidBlock(

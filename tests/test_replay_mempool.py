@@ -1,0 +1,179 @@
+"""Handshake/replay decision table + mempool behavior tests
+(reference consensus/replay_test.go, mempool/mempool_test.go shapes)."""
+
+import os
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu import state as sm
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.counter import CounterApplication
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.consensus import ConsensusState
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool import ErrTxInCache, Mempool
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, EventBus, query_for_event
+from tendermint_tpu.types.validator_set import random_validator_set
+
+
+def run_chain(n_blocks=3):
+    """Run a single-validator chain for n blocks; return its artifacts."""
+    vs, keys = random_validator_set(1, 10)
+    doc = GenesisDoc(
+        chain_id="replay-test",
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators],
+    )
+    db = MemDB()
+    state = sm.load_state_from_db_or_genesis(db, doc)
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    mp = Mempool(cfg.MempoolConfig(), conns.mempool)
+    bus = EventBus()
+    bus.start()
+    block_exec = sm.BlockExecutor(db, conns.consensus, mempool=mp, event_bus=bus)
+    bstore = BlockStore(MemDB())
+    cs = ConsensusState(
+        cfg.test_config().consensus, state, block_exec, bstore,
+        mempool=mp, event_bus=bus, priv_validator=FilePV(keys[0], None),
+    )
+    sub = bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 64)
+    cs.start()
+    mp.check_tx(b"a=1")
+    deadline = time.time() + 15
+    n = 0
+    while n < n_blocks and time.time() < deadline:
+        if sub.get(timeout=0.25) is not None:
+            n += 1
+    cs.stop()
+    bus.stop()
+    assert n >= n_blocks
+    return db, bstore, doc, sm.load_state(db)
+
+
+class TestHandshake:
+    def test_fresh_app_replays_all_blocks(self):
+        """App at height 0, chain at height N: handshake replays all
+        blocks into the app (reference replay.go case appHeight < store)."""
+        db, bstore, doc, state = run_chain(3)
+        fresh_app = KVStoreApplication()
+        conns = AppConns(local_client_creator(fresh_app))
+        conns.start()
+        h = Handshaker(db, state, bstore, doc)
+        app_hash = h.handshake(conns)
+        assert h.n_blocks >= bstore.height() - 0  # replayed everything
+        assert app_hash == state.app_hash
+        info = conns.query.info(abci.RequestInfo())
+        assert info.last_block_height == bstore.height()
+
+    def test_in_sync_app_no_replay(self):
+        """App already at store height: nothing to replay."""
+        db, bstore, doc, state = run_chain(2)
+
+        class SyncedApp(KVStoreApplication):
+            def info(self, req):
+                r = super().info(req)
+                r.last_block_height = state.last_block_height
+                r.last_block_app_hash = state.app_hash
+                return r
+
+        conns = AppConns(local_client_creator(SyncedApp()))
+        conns.start()
+        h = Handshaker(db, state, bstore, doc)
+        app_hash = h.handshake(conns)
+        assert h.n_blocks == 0
+        assert app_hash == state.app_hash
+
+    def test_app_ahead_of_store_fails(self):
+        from tendermint_tpu.consensus.replay import HandshakeError
+
+        db, bstore, doc, state = run_chain(2)
+
+        class AheadApp(KVStoreApplication):
+            def info(self, req):
+                r = super().info(req)
+                r.last_block_height = bstore.height() + 5
+                return r
+
+        conns = AppConns(local_client_creator(AheadApp()))
+        conns.start()
+        h = Handshaker(db, state, bstore, doc)
+        with pytest.raises(HandshakeError):
+            h.handshake(conns)
+
+
+class TestMempool:
+    def make(self, app=None, mcfg=None):
+        conns = AppConns(local_client_creator(app or KVStoreApplication()))
+        conns.start()
+        return Mempool(mcfg or cfg.MempoolConfig(), conns.mempool), conns
+
+    def test_checktx_admits_and_dedupes(self):
+        mp, _ = self.make()
+        res = mp.check_tx(b"k=v")
+        assert res.code == abci.CODE_TYPE_OK
+        assert mp.size() == 1
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"k=v")
+        assert mp.size() == 1
+
+    def test_bad_tx_rejected(self):
+        """Counter app in serial mode rejects out-of-order nonces."""
+        app = CounterApplication(serial=True)
+        app.set_option(abci.RequestSetOption(key="serial", value="on"))
+        mp, _ = self.make(app)
+        bad = b"\x00" * 9  # too long for the counter app
+        res = mp.check_tx(bad)
+        assert res.code != abci.CODE_TYPE_OK
+        assert mp.size() == 0
+
+    def test_reap_respects_max_bytes(self):
+        mp, _ = self.make()
+        for i in range(10):
+            mp.check_tx(b"tx-%04d" % i)  # 7 bytes each
+        txs = mp.reap_max_bytes_max_gas(21, -1)
+        assert len(txs) == 3
+        txs = mp.reap_max_bytes_max_gas(-1, -1)
+        assert len(txs) == 10
+
+    def test_update_removes_committed_and_rechecks(self):
+        mp, _ = self.make()
+        for i in range(5):
+            mp.check_tx(b"tx-%d" % i)
+        mp.lock()
+        try:
+            mp.update(1, [b"tx-0", b"tx-3"])
+        finally:
+            mp.unlock()
+        assert mp.size() == 3
+        assert b"tx-0" not in mp.txs_snapshot()
+        # committed txs can't re-enter (cache)
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(b"tx-0")
+
+    def test_full_mempool(self):
+        from tendermint_tpu.mempool import ErrMempoolIsFull
+
+        mp, _ = self.make(mcfg=cfg.MempoolConfig(size=2))
+        mp.check_tx(b"a")
+        mp.check_tx(b"b")
+        with pytest.raises(ErrMempoolIsFull):
+            mp.check_tx(b"c")
+
+    def test_txs_available_notification(self):
+        mp, _ = self.make()
+        fired = []
+        mp.notify_txs_available(lambda: fired.append(1))
+        assert not fired
+        mp.check_tx(b"x=y")
+        assert fired == [1]

@@ -84,7 +84,7 @@ def apply_one(state, executor, keys, txs=()):
     time_ns = (
         sm.state.median_time(commit, state.last_validators)
         if commit is not None
-        else state.last_block_time + 1
+        else state.last_block_time  # height 1: genesis time exactly
     )
     block = state.make_block(height, list(txs), commit, [], proposer, time_ns=time_ns)
     ps = make_part_set(block)
@@ -233,7 +233,7 @@ class TestValidateBlock:
         commit = sign_commit(s1, s1.last_block_id, 1, 0, keys)
         proposer = s1.validators.get_proposer().address
         block = s1.make_block(2, [], commit, [], proposer, time_ns=12345)
-        with pytest.raises(sm.ErrInvalidBlock, match="invalid block time"):
+        with pytest.raises(sm.ErrInvalidBlock, match="block time"):
             sm.validate_block(s1, block)
 
 
