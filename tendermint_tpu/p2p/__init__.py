@@ -1,0 +1,16 @@
+"""p2p — the distributed communication backend (reference p2p/).
+
+Inter-validator traffic is message-passing over TCP (validators are
+separate trust domains; collectives don't apply — SURVEY §2.3): an
+authenticated-encryption transport (SecretConnection), channel
+multiplexing with priorities (MConnection), and a Switch routing
+messages to registered Reactors.  ICI collectives live *inside* a
+validator, in the crypto.jaxed25519 batch-verify engine.
+"""
+
+from .base_reactor import ChannelDescriptor, Reactor  # noqa: F401
+from .key import NodeKey, node_id  # noqa: F401
+from .node_info import NodeInfo, ProtocolVersion  # noqa: F401
+from .peer import Peer, PeerSet  # noqa: F401
+from .switch import Switch  # noqa: F401
+from .transport import MultiplexTransport  # noqa: F401

@@ -1,0 +1,55 @@
+"""Reactor interface + channel descriptors (reference p2p/base_reactor.go).
+
+A Reactor handles one-or-more channels of peer traffic; the Switch
+routes inbound messages to the reactor owning the channel and tells
+reactors about peer arrival/departure.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass
+class ChannelDescriptor:
+    """p2p/conn/connection.go:540-566."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 0  # 0 = MConnConfig default
+    recv_message_capacity: int = 0  # 0 = MConnConfig default
+    recv_buffer_capacity: int = 0
+
+
+class Reactor:
+    """Base reactor: subclasses override the hooks they need."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.switch = None  # set by Switch.add_reactor
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        """Channels this reactor owns (called once at registration)."""
+        return []
+
+    def init_peer(self, peer) -> None:
+        """Called before the peer starts (InitPeer)."""
+
+    def add_peer(self, peer) -> None:
+        """Called once the peer is started and routable."""
+
+    def remove_peer(self, peer, reason: Optional[Exception]) -> None:
+        """Called when a peer is stopped (graceful or error)."""
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        """Inbound message on one of this reactor's channels."""
+
+    def start(self) -> None:
+        """Reactor lifecycle start (OnStart)."""
+
+    def stop(self) -> None:
+        """Reactor lifecycle stop (OnStop)."""

@@ -1,0 +1,314 @@
+"""MConnection — multiplexed priority channels over one SecretConnection.
+
+Reference parity: p2p/conn/connection.go.  One MConnection per peer:
+byte-ID'd channels with priorities and bounded send queues; messages are
+packetized (≤1024B payload, :21), the send loop picks the channel with
+the least recently_sent/priority ratio (:464-486) and sends batches of
+10 packets (:23, :448-462); both directions are flow-rate limited
+(:370,504); ping/pong liveness with a pong timeout (:38-40).
+
+on_receive(ch_id, msg_bytes) fires when a packet with EOF completes a
+message; on_error(err) fires once on connection failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import msgpack
+
+from ...libs.flowrate import Monitor
+
+LOG = logging.getLogger("p2p.conn")
+
+MAX_PACKET_MSG_PAYLOAD_SIZE = 1024  # connection.go:21
+NUM_BATCH_PACKET_MSGS = 10  # connection.go:23
+
+_PKT_PING = 0
+_PKT_PONG = 1
+_PKT_MSG = 2
+
+
+@dataclass
+class MConnConfig:
+    """connection.go:30-40 defaults (flush throttle, rates, ping)."""
+
+    send_rate: int = 512000
+    recv_rate: int = 512000
+    max_packet_msg_payload_size: int = MAX_PACKET_MSG_PAYLOAD_SIZE
+    flush_throttle: float = 0.1
+    ping_interval: float = 60.0
+    pong_timeout: float = 45.0
+    send_queue_capacity: int = 1
+    recv_message_capacity: int = 22020096  # 21MB
+
+
+@dataclass
+class ChannelStatus:
+    id: int
+    send_queue_size: int
+    send_queue_capacity: int
+    recently_sent: int
+    priority: int
+
+
+class _Channel:
+    """connection.go:570-680: bounded send queue + packetizer +
+    reassembly buffer, with a recently-sent counter for scheduling."""
+
+    def __init__(self, desc, config: MConnConfig):
+        self.desc = desc
+        cap = desc.send_queue_capacity or config.send_queue_capacity
+        self.send_queue: "queue.Queue[bytes]" = queue.Queue(maxsize=cap)
+        self.sending: Optional[bytes] = None
+        self.sent_pos = 0
+        self.recently_sent = 0
+        self.recv_msg_capacity = desc.recv_message_capacity or config.recv_message_capacity
+        self.recving = bytearray()
+        self.max_payload = config.max_packet_msg_payload_size
+
+    def is_send_pending(self) -> bool:
+        return self.sending is not None or not self.send_queue.empty()
+
+    def next_packet(self):
+        """-> (eof, payload) for the next outbound packet."""
+        if self.sending is None:
+            self.sending = self.send_queue.get_nowait()
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos : self.sent_pos + self.max_payload]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = None
+        self.recently_sent += len(chunk)
+        return eof, chunk
+
+    def recv_packet(self, eof: bool, data: bytes) -> Optional[bytes]:
+        """Reassemble; returns the full message on EOF."""
+        if len(self.recving) + len(data) > self.recv_msg_capacity:
+            raise ConnectionError(
+                f"recv msg exceeds capacity {self.recv_msg_capacity} on ch {self.desc.id}"
+            )
+        self.recving.extend(data)
+        if eof:
+            msg = bytes(self.recving)
+            self.recving = bytearray()
+            return msg
+        return None
+
+
+class MConnection:
+    """The multiplexed connection (connection.go:70)."""
+
+    def __init__(
+        self,
+        conn,  # SecretConnection-like: write/read_exact/close
+        ch_descs: List,
+        on_receive: Callable[[int, bytes], None],
+        on_error: Callable[[Exception], None],
+        config: Optional[MConnConfig] = None,
+    ):
+        self.conn = conn
+        self.config = config or MConnConfig()
+        self.channels: Dict[int, _Channel] = {
+            d.id: _Channel(d, self.config) for d in ch_descs
+        }
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self.send_monitor = Monitor()
+        self.recv_monitor = Monitor()
+        self._send_signal = threading.Event()
+        self._pong_pending = threading.Event()
+        self._pong_received = threading.Event()
+        self._last_pong = time.monotonic()
+        self._wlock = threading.Lock()
+        self._errored = False
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        for fn, name in (
+            (self._send_routine, "mconn-send"),
+            (self._recv_routine, "mconn-recv"),
+            (self._ping_routine, "mconn-ping"),
+        ):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._send_signal.set()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+
+    def _error(self, err: Exception) -> None:
+        if self._errored or self._stop.is_set():
+            return
+        self._errored = True
+        self.stop()
+        try:
+            self.on_error(err)
+        except Exception:
+            LOG.exception("on_error callback failed")
+
+    # -- sending -------------------------------------------------------
+
+    def send(self, ch_id: int, msg_bytes: bytes, timeout: float = 10.0) -> bool:
+        """Blocking enqueue (connection.go Send, defaultSendTimeout 10s)."""
+        ch = self.channels.get(ch_id)
+        if ch is None or self._stop.is_set():
+            return False
+        try:
+            ch.send_queue.put(msg_bytes, timeout=timeout)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
+        """Non-blocking enqueue."""
+        ch = self.channels.get(ch_id)
+        if ch is None or self._stop.is_set():
+            return False
+        try:
+            ch.send_queue.put_nowait(msg_bytes)
+        except queue.Full:
+            return False
+        self._send_signal.set()
+        return True
+
+    def can_send(self, ch_id: int) -> bool:
+        ch = self.channels.get(ch_id)
+        return ch is not None and not ch.send_queue.full()
+
+    def _write_packet(self, obj) -> None:
+        body = msgpack.packb(obj, use_bin_type=True)
+        with self._wlock:
+            self.conn.write(struct.pack("<I", len(body)) + body)
+
+    def _send_routine(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if self._pong_pending.is_set():
+                    self._pong_pending.clear()
+                    self._write_packet([_PKT_PONG])
+                if not self._send_some_packets():
+                    # nothing pending: wait for a signal (bounded so the
+                    # pong/ping path stays responsive)
+                    self._send_signal.wait(timeout=self.config.flush_throttle)
+                    self._send_signal.clear()
+        except Exception as e:
+            self._error(e)
+
+    def _send_some_packets(self) -> bool:
+        """Send up to a batch of packets; True if any were sent
+        (connection.go:448-486)."""
+        # rate-limit on the monitor before a batch
+        self.send_monitor.limit(
+            NUM_BATCH_PACKET_MSGS * self.config.max_packet_msg_payload_size,
+            self.config.send_rate,
+        )
+        sent_any = False
+        for _ in range(NUM_BATCH_PACKET_MSGS):
+            best, least_ratio = None, float("inf")
+            for ch in self.channels.values():
+                if not ch.is_send_pending():
+                    continue
+                ratio = ch.recently_sent / ch.desc.priority
+                if ratio < least_ratio:
+                    least_ratio, best = ratio, ch
+            if best is None:
+                break
+            try:
+                eof, chunk = best.next_packet()
+            except queue.Empty:
+                continue
+            self._write_packet([_PKT_MSG, best.desc.id, eof, chunk])
+            self.send_monitor.update(len(chunk))
+            sent_any = True
+        # decay recently_sent so priorities re-assert over time
+        for ch in self.channels.values():
+            ch.recently_sent = int(ch.recently_sent * 0.8)
+        return sent_any
+
+    # -- receiving -----------------------------------------------------
+
+    def _recv_routine(self) -> None:
+        # a packet is msgpack of [type, ch, eof, <=max_payload chunk];
+        # cap well under that bound so a malicious 4-byte header can't
+        # force a multi-MB allocation (reference maxPacketMsgSize)
+        max_packet = self.config.max_packet_msg_payload_size + 128
+        try:
+            while not self._stop.is_set():
+                hdr = self.conn.read_exact(4)
+                (length,) = struct.unpack("<I", hdr)
+                if length > max_packet:
+                    raise ConnectionError(f"packet too large: {length}")
+                body = self.conn.read_exact(length)
+                self.recv_monitor.update(len(body))
+                self.recv_monitor.limit(len(body), self.config.recv_rate)
+                pkt = msgpack.unpackb(body, raw=False)
+                kind = pkt[0]
+                if kind == _PKT_PING:
+                    self._pong_pending.set()
+                    self._send_signal.set()
+                elif kind == _PKT_PONG:
+                    self._last_pong = time.monotonic()
+                    self._pong_received.set()
+                elif kind == _PKT_MSG:
+                    _, ch_id, eof, data = pkt
+                    ch = self.channels.get(ch_id)
+                    if ch is None:
+                        raise ConnectionError(f"unknown channel {ch_id:#x}")
+                    msg = ch.recv_packet(eof, bytes(data))
+                    if msg is not None:
+                        self.on_receive(ch_id, msg)
+                else:
+                    raise ConnectionError(f"unknown packet type {kind}")
+        except Exception as e:
+            self._error(e)
+
+    # -- liveness ------------------------------------------------------
+
+    def _ping_routine(self) -> None:
+        try:
+            while not self._stop.wait(timeout=self.config.ping_interval):
+                self._pong_received.clear()
+                self._write_packet([_PKT_PING])
+                # the recv routine sets _pong_received; an early pong
+                # ends the wait so the period stays ~ping_interval
+                if not self._pong_received.wait(timeout=self.config.pong_timeout):
+                    if self._stop.is_set():
+                        return
+                    raise ConnectionError("pong timeout")
+        except Exception as e:
+            self._error(e)
+
+    # -- introspection -------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "send_monitor": self.send_monitor.status(),
+            "recv_monitor": self.recv_monitor.status(),
+            "channels": [
+                ChannelStatus(
+                    id=ch.desc.id,
+                    send_queue_size=ch.send_queue.qsize(),
+                    send_queue_capacity=ch.send_queue.maxsize,
+                    recently_sent=ch.recently_sent,
+                    priority=ch.desc.priority,
+                ).__dict__
+                for ch in self.channels.values()
+            ],
+        }

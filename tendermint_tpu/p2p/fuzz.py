@@ -1,0 +1,68 @@
+"""FuzzedConnection — network fault injection (reference p2p/fuzz.go:14-104).
+
+Wraps a socket; in async mode randomly delays or drops writes, in sync
+mode sleeps inline.  Activated via FuzzConnConfig (config/config.go:485)
+for network-level fuzz testing (SURVEY §4 tier 4).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class FuzzConnConfig:
+    mode: str = "drop"  # "drop" | "delay"
+    max_delay: float = 3.0
+    prob_drop_rw: float = 0.2
+    prob_drop_conn: float = 0.0
+    prob_sleep: float = 0.0
+
+
+class FuzzedConnection:
+    """Duck-types the subset of socket used by SecretConnection."""
+
+    def __init__(self, conn: socket.socket, config: FuzzConnConfig = None):
+        self._conn = conn
+        self.config = config or FuzzConnConfig()
+        self._lock = threading.Lock()
+
+    def _fuzz(self) -> bool:
+        """True = drop this operation."""
+        cfg = self.config
+        if cfg.mode == "drop":
+            r = random.random()
+            if r < cfg.prob_drop_rw:
+                return True
+            if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
+                self._conn.close()
+                return True
+            if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
+                time.sleep(random.random() * cfg.max_delay)
+        elif cfg.mode == "delay":
+            time.sleep(random.random() * cfg.max_delay)
+        return False
+
+    def sendall(self, data: bytes) -> None:
+        if self._fuzz():
+            return  # silently dropped
+        self._conn.sendall(data)
+
+    def recv(self, n: int) -> bytes:
+        if self._fuzz():
+            # a dropped read manifests as a stall, not data loss
+            time.sleep(random.random() * self.config.max_delay)
+        return self._conn.recv(n)
+
+    def settimeout(self, t) -> None:
+        self._conn.settimeout(t)
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def shutdown(self, how) -> None:
+        self._conn.shutdown(how)

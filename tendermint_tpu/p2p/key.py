@@ -1,0 +1,59 @@
+"""Node identity (reference p2p/key.go).
+
+A node's ID is the hex of its pubkey address (SHA256-20), giving
+authenticated peer identities: the SecretConnection handshake proves
+possession of the key behind the ID.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from ..crypto.keys import PrivKey, PrivKeyEd25519, PubKey
+
+ID_BYTE_LENGTH = 20  # address length (p2p/key.go:24)
+
+
+def node_id(pub_key: PubKey) -> str:
+    """ID = hex(address(pubkey)) (p2p/key.go:49-51)."""
+    return pub_key.address().hex()
+
+
+@dataclass
+class NodeKey:
+    priv_key: PrivKey
+
+    @property
+    def id(self) -> str:
+        return node_id(self.priv_key.pub_key())
+
+    def pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign(self, msg: bytes) -> bytes:
+        return self.priv_key.sign(msg)
+
+    def save_as(self, path: str) -> None:
+        doc = {"priv_key": self.priv_key.bytes().hex()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "NodeKey":
+        with open(path) as f:
+            doc = json.load(f)
+        return NodeKey(PrivKeyEd25519.from_seed(bytes.fromhex(doc["priv_key"])[:32]))
+
+    @staticmethod
+    def load_or_gen(path: str) -> "NodeKey":
+        """LoadOrGenNodeKey (p2p/key.go:62-72)."""
+        if path and os.path.exists(path):
+            return NodeKey.load(path)
+        nk = NodeKey(PrivKeyEd25519.generate())
+        if path:
+            nk.save_as(path)
+        return nk

@@ -1,0 +1,94 @@
+"""NodeInfo — identity + capability advertisement (reference p2p/node_info.go).
+
+Exchanged in plaintext-over-SecretConnection right after the encrypted
+handshake; peers reject on version/network mismatch or zero channel
+intersection (CompatibleWith, p2p/node_info.go:142-173).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+import msgpack
+
+MAX_NUM_CHANNELS = 16  # p2p/node_info.go:16
+
+
+@dataclass
+class ProtocolVersion:
+    """Triple of p2p/block/app protocol versions (version/version.go:38-44)."""
+
+    p2p: int = 1
+    block: int = 1
+    app: int = 0
+
+
+@dataclass
+class NodeInfo:
+    protocol_version: ProtocolVersion
+    id: str  # hex node ID (authenticated against conn pubkey)
+    listen_addr: str  # "host:port" accepting incoming conns
+    network: str  # chain ID
+    version: str  # software version
+    channels: bytes  # channel IDs this node handles
+    moniker: str = ""
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def validate(self) -> None:
+        """Basic sanity (p2p/node_info.go:103-140)."""
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise ValueError(f"too many channels: {len(self.channels)}")
+        if len(set(self.channels)) != len(self.channels):
+            raise ValueError("duplicate channel ids")
+        if len(self.moniker) > 255 or len(self.network) > 255:
+            raise ValueError("moniker/network too long")
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """Raise if peers can't talk (p2p/node_info.go:142-173):
+        same block protocol version, same network, >=1 common channel."""
+        if self.protocol_version.block != other.protocol_version.block:
+            raise ValueError(
+                f"peer block version {other.protocol_version.block} != "
+                f"ours {self.protocol_version.block}"
+            )
+        if self.network != other.network:
+            raise ValueError(f"peer network {other.network!r} != ours {self.network!r}")
+        if not set(self.channels) & set(other.channels):
+            raise ValueError("no common channels")
+
+    def encode(self) -> bytes:
+        return msgpack.packb(
+            [
+                [
+                    self.protocol_version.p2p,
+                    self.protocol_version.block,
+                    self.protocol_version.app,
+                ],
+                self.id,
+                self.listen_addr,
+                self.network,
+                self.version,
+                self.channels,
+                self.moniker,
+                self.tx_index,
+                self.rpc_address,
+            ],
+            use_bin_type=True,
+        )
+
+    @staticmethod
+    def decode(data: bytes) -> "NodeInfo":
+        o = msgpack.unpackb(data, raw=False)
+        return NodeInfo(
+            protocol_version=ProtocolVersion(*o[0]),
+            id=o[1],
+            listen_addr=o[2],
+            network=o[3],
+            version=o[4],
+            channels=bytes(o[5]),
+            moniker=o[6],
+            tx_index=o[7],
+            rpc_address=o[8],
+        )

@@ -1,0 +1,282 @@
+"""Switch — owns reactors and peers; routes messages (reference p2p/switch.go).
+
+Registers reactors with their channel descriptors, runs the accept
+loop, dials configured peers (with the reference's reconnect policy for
+persistent peers: 20 linear retries then exponential backoff,
+switch.go:14-28,321-369), and fans inbound messages out to the reactor
+owning each channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .base_reactor import ChannelDescriptor, Reactor
+from .conn.connection import MConnConfig
+from .node_info import NodeInfo
+from .peer import Peer, PeerSet
+from .transport import MultiplexTransport, RejectedError
+
+LOG = logging.getLogger("p2p.switch")
+
+RECONNECT_ATTEMPTS = 20  # switch.go:22 reconnectAttempts
+RECONNECT_INTERVAL = 5.0  # switch.go:23 reconnectInterval
+RECONNECT_BACK_OFF_ATTEMPTS = 10  # switch.go:26
+RECONNECT_BACK_OFF_BASE = 3.0  # switch.go:27
+DIAL_RANDOMIZER_INTERVAL = 3.0  # switch.go:17 randomization of dial start
+
+
+class Switch:
+    def __init__(
+        self,
+        transport: MultiplexTransport,
+        mconfig: Optional[MConnConfig] = None,
+        max_inbound: int = 40,
+        max_outbound: int = 10,
+    ):
+        self.transport = transport
+        self.mconfig = mconfig
+        self.reactors: Dict[str, Reactor] = {}
+        self.ch_descs: List[ChannelDescriptor] = []
+        self._reactor_by_ch: Dict[int, Reactor] = {}
+        self.peers = PeerSet()
+        self.dialing: Dict[str, bool] = {}
+        self.reconnecting: Dict[str, bool] = {}
+        self.persistent_addrs: Dict[str, str] = {}  # id -> addr
+        self.max_inbound = max_inbound
+        self.max_outbound = max_outbound
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- registry ------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self._reactor_by_ch:
+                raise ValueError(f"channel {desc.id:#x} already registered")
+            self.ch_descs.append(desc)
+            self._reactor_by_ch[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def node_info(self) -> NodeInfo:
+        return self.transport.node_info
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._running.set()
+        for reactor in self.reactors.values():
+            reactor.start()
+        if self.transport._listener is not None:
+            t = threading.Thread(target=self._accept_routine, name="sw-accept", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running.clear()
+        self.transport.close()
+        for peer in self.peers.list():
+            self.stop_peer_gracefully(peer)
+        for reactor in self.reactors.values():
+            reactor.stop()
+
+    def is_running(self) -> bool:
+        return self._running.is_set()
+
+    # -- peer intake ---------------------------------------------------
+
+    def _accept_routine(self) -> None:
+        """switch.go:472-521; upgrades run one thread per inbound conn
+        so a stalling client can't block the accept loop."""
+        while self._running.is_set():
+            try:
+                raw, remote = self.transport.accept_raw()
+            except OSError as e:
+                if self._running.is_set():
+                    LOG.debug("accept error: %s", e)
+                    time.sleep(0.05)
+                    continue
+                return
+            threading.Thread(
+                target=self._upgrade_inbound, args=(raw, remote), daemon=True
+            ).start()
+
+    def _upgrade_inbound(self, raw, remote: str) -> None:
+        try:
+            sc, their_info, remote = self.transport.upgrade_inbound(raw, remote)
+        except (RejectedError, OSError, ValueError, ConnectionError) as e:
+            LOG.debug("inbound upgrade rejected (%s): %s", remote, e)
+            return
+        inbound = sum(1 for p in self.peers.list() if not p.outbound)
+        if inbound >= self.max_inbound:
+            sc.close()
+            return
+        self._add_peer_conn(sc, their_info, remote, outbound=False)
+
+    def dial_peer(self, addr: str, expect_id: str = "", persistent: bool = False) -> Optional[Peer]:
+        """Dial one address and add the peer (DialPeerWithAddress)."""
+        key = expect_id or addr
+        if persistent and expect_id:
+            # record intent up front so persistence survives a failed
+            # first dial + reconnect cycle
+            self.persistent_addrs[expect_id] = addr
+        with self._lock:
+            if self.dialing.get(key):
+                return None
+            self.dialing[key] = True
+        try:
+            sc, their_info, remote = self.transport.dial(addr, expect_id)
+        except Exception as e:
+            LOG.debug("dial %s failed: %s", addr, e)
+            if persistent:
+                self._schedule_reconnect(addr, expect_id)
+            return None
+        finally:
+            with self._lock:
+                self.dialing.pop(key, None)
+        if persistent:
+            self.persistent_addrs[their_info.id] = addr
+        return self._add_peer_conn(sc, their_info, remote, outbound=True, persistent=persistent)
+
+    def dial_peers_async(self, addrs: List[str], persistent: bool = False) -> None:
+        """switch.go:551-583: randomized-delay parallel dialing."""
+
+        def one(a: str):
+            time.sleep(random.random() * DIAL_RANDOMIZER_INTERVAL)
+            eid = ""
+            if "@" in a:
+                eid, a2 = a.split("@", 1)
+            else:
+                a2 = a
+            self.dial_peer(a2, expect_id=eid, persistent=persistent)
+
+        for a in addrs:
+            threading.Thread(target=one, args=(a,), daemon=True).start()
+
+    def _add_peer_conn(
+        self, sc, their_info: NodeInfo, remote: str, outbound: bool, persistent: bool = False
+    ) -> Optional[Peer]:
+        if self.peers.has(their_info.id):
+            sc.close()
+            return None
+        persistent = persistent or their_info.id in self.persistent_addrs
+        peer = Peer(
+            sc,
+            their_info,
+            self.ch_descs,
+            on_receive=self._on_peer_receive,
+            on_error=self._on_peer_error,
+            outbound=outbound,
+            persistent=persistent,
+            mconfig=self.mconfig,
+            socket_addr=remote,
+        )
+        for reactor in self.reactors.values():
+            reactor.init_peer(peer)
+        try:
+            self.peers.add(peer)
+        except KeyError:
+            sc.close()
+            return None
+        peer.start()
+        for reactor in self.reactors.values():
+            try:
+                reactor.add_peer(peer)
+            except Exception:
+                LOG.exception("reactor %s add_peer failed", reactor.name)
+        LOG.info("added peer %s", peer)
+        return peer
+
+    # -- routing -------------------------------------------------------
+
+    def _on_peer_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        reactor = self._reactor_by_ch.get(ch_id)
+        if reactor is None:
+            self.stop_peer_for_error(peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
+            return
+        try:
+            reactor.receive(ch_id, peer, msg_bytes)
+        except Exception as e:
+            LOG.exception("reactor %s receive failed", reactor.name)
+            self.stop_peer_for_error(peer, e)
+
+    def broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
+        """Best-effort send to every peer (switch.go:235-255): a
+        non-blocking enqueue onto each peer's MConnection queue — no
+        thread per send; full queues simply drop."""
+        for peer in self.peers.list():
+            peer.try_send(ch_id, msg_bytes)
+
+    def num_peers(self):
+        out = sum(1 for p in self.peers.list() if p.outbound)
+        inb = self.peers.size() - out
+        return out, inb, len(self.dialing)
+
+    # -- peer removal --------------------------------------------------
+
+    def _on_peer_error(self, peer: Peer, err: Exception) -> None:
+        self.stop_peer_for_error(peer, err)
+
+    def stop_peer_for_error(self, peer: Peer, reason: Exception) -> None:
+        """switch.go:281-299; persistent peers get reconnected."""
+        if not self.peers.remove(peer):
+            return
+        LOG.info("stopping peer %s: %s", peer, reason)
+        peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception:
+                LOG.exception("reactor %s remove_peer failed", reactor.name)
+        if peer.persistent and self._running.is_set():
+            addr = self.persistent_addrs.get(peer.id, peer.socket_addr)
+            self._schedule_reconnect(addr, peer.id)
+
+    def stop_peer_gracefully(self, peer: Peer) -> None:
+        if not self.peers.remove(peer):
+            return
+        peer.stop()
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, None)
+            except Exception:
+                pass
+
+    def _schedule_reconnect(self, addr: str, peer_id: str) -> None:
+        key = peer_id or addr
+        with self._lock:
+            if self.reconnecting.get(key):
+                return
+            self.reconnecting[key] = True
+
+        def try_once() -> bool:
+            if not self._running.is_set() or (peer_id and self.peers.has(peer_id)):
+                return True
+            # persistent=True keeps persistent_addrs populated so the
+            # re-established peer reconnects again on its next drop
+            return self.dial_peer(addr, expect_id=peer_id, persistent=True) is not None
+
+        def loop():
+            try:
+                # phase 1: linear retries (switch.go:334-350)
+                for _ in range(RECONNECT_ATTEMPTS):
+                    time.sleep(RECONNECT_INTERVAL * (1 + 0.3 * random.random()))
+                    if try_once():
+                        return
+                # phase 2: exponential backoff (switch.go:352-367)
+                for i in range(1, RECONNECT_BACK_OFF_ATTEMPTS + 1):
+                    time.sleep((RECONNECT_BACK_OFF_BASE**i) * (1 + 0.3 * random.random()))
+                    if try_once():
+                        return
+            finally:
+                with self._lock:
+                    self.reconnecting.pop(key, None)
+
+        threading.Thread(target=loop, name=f"sw-reconnect-{key[:8]}", daemon=True).start()

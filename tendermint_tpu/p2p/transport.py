@@ -1,0 +1,131 @@
+"""MultiplexTransport — TCP listen/dial + connection upgrade.
+
+Reference parity: p2p/transport.go:114-504.  accept/dial produce a raw
+TCP socket; `upgrade` wraps it in a SecretConnection, exchanges
+NodeInfo, and applies filters (duplicate-ID, dup-IP, user hooks) before
+the Switch turns it into a Peer.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .conn.secret_connection import SecretConnection
+from .key import NodeKey, node_id
+from .node_info import NodeInfo
+
+HANDSHAKE_TIMEOUT = 3.0  # p2p/transport.go:33 defaultHandshakeTimeout
+DIAL_TIMEOUT = 3.0
+
+ConnFilter = Callable[[socket.socket, str], None]  # raises to reject
+
+
+class RejectedError(Exception):
+    """Connection rejected during upgrade (p2p/errors.go ErrRejected)."""
+
+
+def split_host_port(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class MultiplexTransport:
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        node_key: NodeKey,
+        conn_filters: Optional[List[ConnFilter]] = None,
+        fuzz_wrap: Optional[Callable] = None,
+    ):
+        self.node_info = node_info
+        self.node_key = node_key
+        self.conn_filters = conn_filters or []
+        self.fuzz_wrap = fuzz_wrap  # optional FuzzedConnection wrapper
+        self._listener: Optional[socket.socket] = None
+        self.listen_addr = ""
+        self._closed = threading.Event()
+
+    # -- listening -----------------------------------------------------
+
+    def listen(self, addr: str) -> None:
+        host, port = split_host_port(addr)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        self._listener = srv
+        self.listen_addr = f"{host}:{srv.getsockname()[1]}"
+
+    def accept_raw(self) -> Tuple[socket.socket, str]:
+        """Block for one raw inbound TCP connection (no handshake yet —
+        the caller upgrades in its own thread so a stalling client
+        can't head-of-line-block the accept loop, transport.go
+        acceptPeers)."""
+        assert self._listener is not None, "transport not listening"
+        conn, addr = self._listener.accept()
+        return conn, f"{addr[0]}:{addr[1]}"
+
+    def upgrade_inbound(
+        self, conn: socket.socket, remote: str
+    ) -> Tuple[SecretConnection, NodeInfo, str]:
+        return self._upgrade(conn, remote, dialed_id=None)
+
+    def accept(self) -> Tuple[SecretConnection, NodeInfo, str]:
+        """accept_raw + upgrade in one call (tests/simple callers)."""
+        conn, remote = self.accept_raw()
+        return self._upgrade(conn, remote, dialed_id=None)
+
+    # -- dialing -------------------------------------------------------
+
+    def dial(self, addr: str, expect_id: str = "") -> Tuple[SecretConnection, NodeInfo, str]:
+        host, port = split_host_port(addr)
+        conn = socket.create_connection((host, port), timeout=DIAL_TIMEOUT)
+        return self._upgrade(conn, f"{host}:{port}", dialed_id=expect_id or None)
+
+    # -- upgrade -------------------------------------------------------
+
+    def _upgrade(
+        self, conn: socket.socket, remote: str, dialed_id: Optional[str]
+    ) -> Tuple[SecretConnection, NodeInfo, str]:
+        try:
+            for f in self.conn_filters:
+                f(conn, remote)
+            conn.settimeout(HANDSHAKE_TIMEOUT)
+            if self.fuzz_wrap is not None:
+                conn = self.fuzz_wrap(conn)
+            sc = SecretConnection(conn, self.node_key.priv_key)
+            # authenticate the advertised ID against the conn's pubkey
+            # (transport.go:375-393)
+            sc.write_msg(self.node_info.encode())
+            their_info = NodeInfo.decode(sc.read_msg())
+            their_info.validate()
+            conn_id = node_id(sc.remote_pub_key())
+            if their_info.id != conn_id:
+                raise RejectedError(
+                    f"nodeinfo ID {their_info.id} != conn pubkey ID {conn_id}"
+                )
+            if dialed_id is not None and their_info.id != dialed_id:
+                raise RejectedError(
+                    f"dialed {dialed_id} but connected to {their_info.id}"
+                )
+            if their_info.id == self.node_info.id:
+                raise RejectedError("self connection")
+            self.node_info.compatible_with(their_info)
+            conn.settimeout(None)
+            return sc, their_info, remote
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass

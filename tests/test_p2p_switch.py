@@ -1,0 +1,152 @@
+"""Switch/transport integration over real localhost TCP
+(reference p2p/switch_test.go, p2p/transport_test.go)."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    MultiplexTransport,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Reactor,
+    Switch,
+)
+
+
+class EchoReactor(Reactor):
+    """Records inbound messages; echoes on a second channel."""
+
+    def __init__(self, name, ch_id=0x01):
+        super().__init__(name)
+        self.ch_id = ch_id
+        self.received = []
+        self.peers_added = []
+        self.peers_removed = []
+        self.got = threading.Event()
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.ch_id, priority=1)]
+
+    def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    def receive(self, ch_id, peer, msg_bytes):
+        self.received.append((ch_id, peer.id, msg_bytes))
+        self.got.set()
+
+
+def make_switch(name, network="test-chain", channels=bytes([0x01])):
+    nk = NodeKey(PrivKeyEd25519.generate())
+    ni = NodeInfo(
+        protocol_version=ProtocolVersion(),
+        id=nk.id,
+        listen_addr="",
+        network=network,
+        version="dev",
+        channels=channels,
+        moniker=name,
+    )
+    tr = MultiplexTransport(ni, nk)
+    tr.listen("127.0.0.1:0")
+    ni.listen_addr = tr.listen_addr
+    sw = Switch(tr)
+    return sw
+
+
+def connected_pair():
+    sw1, sw2 = make_switch("a"), make_switch("b")
+    r1, r2 = EchoReactor("echo"), EchoReactor("echo")
+    sw1.add_reactor("echo", r1)
+    sw2.add_reactor("echo", r2)
+    sw1.start()
+    sw2.start()
+    peer = sw1.dial_peer(sw2.transport.listen_addr)
+    assert peer is not None
+    deadline = time.time() + 5
+    while not (sw2.peers.size() >= 1 and r2.peers_added) and time.time() < deadline:
+        time.sleep(0.01)
+    return sw1, sw2, r1, r2
+
+
+class TestSwitch:
+    def test_dial_and_exchange(self):
+        sw1, sw2, r1, r2 = connected_pair()
+        try:
+            assert sw1.peers.size() == 1
+            assert sw2.peers.size() == 1
+            assert r1.peers_added and r2.peers_added
+            peer = sw1.peers.list()[0]
+            assert peer.send(0x01, b"ping-msg")
+            assert r2.got.wait(5)
+            assert r2.received[0] == (0x01, sw1.transport.node_info.id, b"ping-msg")
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_broadcast(self):
+        sw1, sw2, r1, r2 = connected_pair()
+        try:
+            sw1.broadcast(0x01, b"to-everyone")
+            assert r2.got.wait(5)
+            assert r2.received[0][2] == b"to-everyone"
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_stop_peer_notifies_reactors(self):
+        sw1, sw2, r1, r2 = connected_pair()
+        try:
+            peer = sw1.peers.list()[0]
+            sw1.stop_peer_for_error(peer, RuntimeError("test"))
+            assert sw1.peers.size() == 0
+            assert r1.peers_removed == [peer.id]
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_network_mismatch_rejected(self):
+        sw1 = make_switch("a", network="chain-A")
+        sw2 = make_switch("b", network="chain-B")
+        sw1.add_reactor("echo", EchoReactor("echo"))
+        sw2.add_reactor("echo", EchoReactor("echo"))
+        sw1.start()
+        sw2.start()
+        try:
+            peer = sw1.dial_peer(sw2.transport.listen_addr)
+            assert peer is None
+            assert sw1.peers.size() == 0
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_duplicate_peer_dropped(self):
+        sw1, sw2, r1, r2 = connected_pair()
+        try:
+            dup = sw1.dial_peer(sw2.transport.listen_addr)
+            assert dup is None
+            assert sw1.peers.size() == 1
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_wrong_expected_id_rejected(self):
+        sw1, sw2, _, _ = connected_pair()
+        sw3 = make_switch("c")
+        sw3.add_reactor("echo", EchoReactor("echo"))
+        sw3.start()
+        try:
+            bogus = "ab" * 20
+            peer = sw1.dial_peer(sw3.transport.listen_addr, expect_id=bogus)
+            assert peer is None
+        finally:
+            sw1.stop()
+            sw2.stop()
+            sw3.stop()
