@@ -1,0 +1,721 @@
+"""ConsensusReactor — vote/proposal/block-part gossip.
+
+Reference parity: consensus/reactor.go.  Four p2p channels
+(State 0x20, Data 0x21, Vote 0x22, VoteSetBits 0x23, :23-26,125-157);
+per-peer gossip threads (gossipDataRoutine :456, gossipVotesRoutine
+:593, queryMaj23Routine :720); PeerState tracks what each peer has
+(:895-1334) so gossip sends only what's missing.  Broadcasts of
+NewRoundStep/HasVote ride the node event bus (the reference uses an
+internal event switch, reactor.go:371-395).
+
+Vote gossip is where the TPU batch-verify engine aggregates work: a
+catch-up peer's vote stream lands in VoteSet.add_votes which verifies
+whole batches at once.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from ..libs.bit_array import BitArray
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serde
+from ..types.basic import BlockID, PartSetHeader
+from ..types.basic import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE
+from ..types.event_bus import (
+    EVENT_NEW_ROUND_STEP,
+    EVENT_VOTE,
+    query_for_event,
+)
+from .cstypes import STEP_NEW_HEIGHT, STEP_PREVOTE_WAIT
+from .messages import (
+    BlockPartMessage,
+    CommitStepMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+    message_from_obj,
+    message_to_obj,
+)
+
+LOG = logging.getLogger("consensus.reactor")
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_GOSSIP_SLEEP = 0.1  # reactor.go:36 peerGossipSleepDuration
+PEER_QUERY_MAJ23_SLEEP = 2.0  # reactor.go:39
+
+
+def encode_msg(m) -> bytes:
+    return serde.pack(message_to_obj(m))
+
+
+def decode_msg(b: bytes):
+    return message_from_obj(serde.unpack(b))
+
+
+class PeerRoundState:
+    """What we know the peer knows (reference cstypes/peer_round_state.go)."""
+
+    def __init__(self):
+        self.height = 0
+        self.round = -1
+        self.step = STEP_NEW_HEIGHT
+        self.start_time = 0.0
+        self.proposal = False
+        self.proposal_block_parts_header: Optional[PartSetHeader] = None
+        self.proposal_block_parts: Optional[BitArray] = None
+        self.proposal_pol_round = -1
+        self.proposal_pol: Optional[BitArray] = None
+        self.prevotes: Optional[BitArray] = None
+        self.precommits: Optional[BitArray] = None
+        self.last_commit_round = -1
+        self.last_commit: Optional[BitArray] = None
+        self.catchup_commit_round = -1
+        self.catchup_commit: Optional[BitArray] = None
+
+
+class PeerState:
+    """Thread-safe peer-knowledge tracker (reactor.go:895-1334)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self._lock = threading.RLock()
+        self.prs = PeerRoundState()
+
+    # -- queries -------------------------------------------------------
+
+    def get_round_state(self) -> PeerRoundState:
+        with self._lock:
+            return self.prs  # callers only read under short races; fine
+
+    def get_height(self) -> int:
+        with self._lock:
+            return self.prs.height
+
+    # -- updates from messages ----------------------------------------
+
+    def apply_new_round_step(self, msg: NewRoundStepMessage) -> None:
+        """reactor.go:1091-1137."""
+        with self._lock:
+            prs = self.prs
+            ps_height, ps_round = prs.height, prs.round
+            ps_catchup_round = prs.catchup_commit_round
+            ps_last_commit, ps_last_commit_round = prs.last_commit, prs.last_commit_round
+
+            prs.height = msg.height
+            prs.round = msg.round
+            prs.step = msg.step
+            prs.start_time = time.time() - msg.seconds_since_start_time
+            if ps_height != msg.height or ps_round != msg.round:
+                prs.proposal = False
+                prs.proposal_block_parts_header = None
+                prs.proposal_block_parts = None
+                prs.proposal_pol_round = -1
+                prs.proposal_pol = None
+                prs.prevotes = None
+                prs.precommits = None
+            if ps_height == msg.height and ps_round != msg.round and msg.round == ps_catchup_round:
+                prs.precommits = prs.catchup_commit
+            if ps_height != msg.height:
+                # peer moved a height: shift commit tracking
+                if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = prs.precommits
+                else:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = None
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = None
+
+    def apply_commit_step(self, msg: CommitStepMessage) -> None:
+        with self._lock:
+            if self.prs.height != msg.height:
+                return
+            self.prs.proposal_block_parts_header = msg.block_parts_header
+            self.prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg: ProposalPOLMessage) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != msg.height or prs.proposal_pol_round != msg.proposal_pol_round:
+                return
+            prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: HasVoteMessage) -> None:
+        with self._lock:
+            if self.prs.height != msg.height:
+                return
+            self._set_has_vote_locked(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_vote_set_bits(self, msg: VoteSetBitsMessage, our_votes: Optional[BitArray]) -> None:
+        """reactor.go:1319-1334: if we have our_votes, the peer's claim is
+        OR'd with what we already track (union of knowledge)."""
+        with self._lock:
+            votes = self._get_vote_bit_array_locked(msg.height, msg.round, msg.type)
+            if votes is not None and our_votes is not None:
+                have = votes.or_(msg.votes)
+                self._set_vote_bit_array_locked(msg.height, msg.round, msg.type, have)
+            else:
+                self._set_vote_bit_array_locked(msg.height, msg.round, msg.type, msg.votes)
+
+    def set_has_proposal(self, proposal) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round != proposal.round or prs.proposal:
+                return
+            prs.proposal = True
+            prs.proposal_block_parts_header = proposal.block_parts_header
+            if prs.proposal_block_parts is None:
+                prs.proposal_block_parts = BitArray(proposal.block_parts_header.total)
+            prs.proposal_pol_round = proposal.pol_round
+            prs.proposal_pol = None
+
+    def set_has_proposal_block_part(self, height: int, round_: int, index: int) -> None:
+        with self._lock:
+            prs = self.prs
+            if prs.height != height or prs.round != round_:
+                return
+            if prs.proposal_block_parts is not None:
+                prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, vote) -> None:
+        with self._lock:
+            self._set_has_vote_locked(
+                vote.height, vote.round, vote.type, vote.validator_index
+            )
+
+    def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
+        """reactor.go:975-994."""
+        with self._lock:
+            prs = self.prs
+            if prs.height != height:
+                return
+            if prs.catchup_commit_round == round_:
+                return
+            prs.catchup_commit_round = round_
+            if round_ == prs.round:
+                prs.catchup_commit = prs.precommits
+            else:
+                prs.catchup_commit = BitArray(num_validators)
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        """reactor.go:996-1018."""
+        with self._lock:
+            prs = self.prs
+            if prs.height == height:
+                if prs.prevotes is None:
+                    prs.prevotes = BitArray(num_validators)
+                if prs.precommits is None:
+                    prs.precommits = BitArray(num_validators)
+                if prs.catchup_commit is None and prs.catchup_commit_round >= 0:
+                    prs.catchup_commit = BitArray(num_validators)
+                if prs.proposal_pol is None and prs.proposal_pol_round >= 0:
+                    prs.proposal_pol = BitArray(num_validators)
+            elif prs.height == height + 1:
+                if prs.last_commit is None:
+                    prs.last_commit = BitArray(num_validators)
+
+    # -- internals -----------------------------------------------------
+
+    def _set_has_vote_locked(self, height: int, round_: int, type_: int, index: int) -> None:
+        ba = self._get_vote_bit_array_locked(height, round_, type_)
+        if ba is not None and index is not None and index >= 0:
+            ba.set_index(index, True)
+
+    def _get_vote_bit_array_locked(self, height: int, round_: int, type_: int) -> Optional[BitArray]:
+        prs = self.prs
+        if prs.height == height:
+            if round_ == prs.round:
+                return prs.prevotes if type_ == VOTE_TYPE_PREVOTE else prs.precommits
+            if round_ == prs.catchup_commit_round and type_ == VOTE_TYPE_PRECOMMIT:
+                return prs.catchup_commit
+            if round_ == prs.proposal_pol_round and type_ == VOTE_TYPE_PREVOTE:
+                return prs.proposal_pol
+        elif prs.height == height + 1:
+            if round_ == prs.last_commit_round and type_ == VOTE_TYPE_PRECOMMIT:
+                return prs.last_commit
+        return None
+
+    def _set_vote_bit_array_locked(self, height, round_, type_, ba) -> None:
+        prs = self.prs
+        if prs.height == height:
+            if round_ == prs.round:
+                if type_ == VOTE_TYPE_PREVOTE:
+                    prs.prevotes = ba
+                else:
+                    prs.precommits = ba
+            elif round_ == prs.catchup_commit_round and type_ == VOTE_TYPE_PRECOMMIT:
+                prs.catchup_commit = ba
+            elif round_ == prs.proposal_pol_round and type_ == VOTE_TYPE_PREVOTE:
+                prs.proposal_pol = ba
+        elif prs.height == height + 1:
+            if round_ == prs.last_commit_round and type_ == VOTE_TYPE_PRECOMMIT:
+                prs.last_commit = ba
+
+    def pick_vote_to_send(self, votes) -> Optional[object]:
+        """Pick a random vote from `votes` (a VoteSet) that the peer
+        lacks; marks it sent (reactor.go:1077-1089)."""
+        if votes is None or votes.size() == 0:
+            return None
+        with self._lock:
+            height, round_, type_ = votes.height, votes.round, votes.type
+            self.ensure_vote_bit_arrays(height, len(votes.val_set))
+            ps_votes = self._get_vote_bit_array_locked(height, round_, type_)
+            if ps_votes is None:
+                return None
+            missing = votes.bit_array().sub(ps_votes)
+            idx = missing.pick_random()
+            if idx is None:
+                return None
+            vote = votes.get_by_index(idx)
+            if vote is not None:
+                self._set_has_vote_locked(height, round_, type_, idx)
+            return vote
+
+
+class ConsensusReactor(Reactor):
+    """reactor.go:37."""
+
+    def __init__(self, consensus_state, fast_sync: bool = False):
+        super().__init__("ConsensusReactor")
+        self.cs = consensus_state
+        self.fast_sync = fast_sync
+        self._peer_states: Dict[str, PeerState] = {}
+        self._peer_threads: Dict[str, list] = {}
+        self._stop = threading.Event()
+        self._bcast_thread: Optional[threading.Thread] = None
+        self._subs = []
+
+    def get_channels(self):
+        """reactor.go:125-157."""
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
+            ChannelDescriptor(
+                id=DATA_CHANNEL, priority=10, send_queue_capacity=100,
+                recv_message_capacity=1048576,
+            ),
+            ChannelDescriptor(
+                id=VOTE_CHANNEL, priority=5, send_queue_capacity=100,
+                recv_message_capacity=100 * 1024,
+            ),
+            ChannelDescriptor(
+                id=VOTE_SET_BITS_CHANNEL, priority=1, send_queue_capacity=2,
+                recv_message_capacity=1024,
+            ),
+        ]
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        if not self.fast_sync:
+            self.cs.start()
+        self._bcast_thread = threading.Thread(
+            target=self._broadcast_routine, name="cons-bcast", daemon=True
+        )
+        self._bcast_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.cs.stop()
+        except Exception:
+            pass
+
+    def switch_to_consensus(self, state, blocks_synced: int = 0) -> None:
+        """Fast-sync handoff (reactor.go:101-123)."""
+        self.cs.update_to_state(state)
+        self.fast_sync = False
+        self.cs.start()
+
+    # -- peers ---------------------------------------------------------
+
+    def init_peer(self, peer) -> None:
+        peer.set("consensus_peer_state", PeerState(peer))
+
+    def add_peer(self, peer) -> None:
+        ps: PeerState = peer.get("consensus_peer_state")
+        self._peer_states[peer.id] = ps
+        # announce our current state so the peer can gossip to us
+        rs = self.cs.get_round_state()
+        peer.send(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+        threads = []
+        for fn, nm in (
+            (self._gossip_data_routine, "gossip-data"),
+            (self._gossip_votes_routine, "gossip-votes"),
+            (self._query_maj23_routine, "query-maj23"),
+        ):
+            t = threading.Thread(target=fn, args=(peer, ps), name=f"{nm}-{peer.id[:8]}", daemon=True)
+            t.start()
+            threads.append(t)
+        self._peer_threads[peer.id] = threads
+
+    def remove_peer(self, peer, reason) -> None:
+        self._peer_states.pop(peer.id, None)
+        self._peer_threads.pop(peer.id, None)
+        # threads exit on peer.is_running() checks
+
+    # -- inbound -------------------------------------------------------
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        """reactor.go:199-320."""
+        msg = decode_msg(msg_bytes)
+        ps: Optional[PeerState] = peer.get("consensus_peer_state")
+        if ps is None:
+            return
+        if ch_id == STATE_CHANNEL:
+            if isinstance(msg, NewRoundStepMessage):
+                ps.apply_new_round_step(msg)
+            elif isinstance(msg, CommitStepMessage):
+                ps.apply_commit_step(msg)
+            elif isinstance(msg, HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, VoteSetMaj23Message):
+                self._handle_vote_set_maj23(peer, ps, msg)
+        elif ch_id == DATA_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, ProposalMessage):
+                ps.set_has_proposal(msg.proposal)
+                self.cs.add_peer_message(msg, peer.id)
+            elif isinstance(msg, ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, BlockPartMessage):
+                ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+                self.cs.add_peer_message(msg, peer.id)
+        elif ch_id == VOTE_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, VoteMessage):
+                rs = self.cs.get_round_state()
+                n = len(rs.validators) if rs.validators else 0
+                ps.ensure_vote_bit_arrays(rs.height, n)
+                ps.ensure_vote_bit_arrays(rs.height - 1, n)
+                ps.set_has_vote(msg.vote)
+                self.cs.add_peer_message(msg, peer.id)
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, VoteSetBitsMessage):
+                rs = self.cs.get_round_state()
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg.round)
+                        if msg.type == VOTE_TYPE_PREVOTE
+                        else rs.votes.precommits(msg.round)
+                    )
+                    ours = vs.bit_array_by_block_id(msg.block_id) if vs else None
+                    ps.apply_vote_set_bits(msg, ours)
+                else:
+                    ps.apply_vote_set_bits(msg, None)
+
+    def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: VoteSetMaj23Message) -> None:
+        """reactor.go:249-304: record the claim, respond with our bits."""
+        rs = self.cs.get_round_state()
+        if rs.height != msg.height or rs.votes is None:
+            return
+        rs.votes.set_peer_maj23(msg.round, msg.type, peer.id, msg.block_id)
+        vs = (
+            rs.votes.prevotes(msg.round)
+            if msg.type == VOTE_TYPE_PREVOTE
+            else rs.votes.precommits(msg.round)
+        )
+        if vs is None:
+            return
+        our_votes = vs.bit_array_by_block_id(msg.block_id)
+        if our_votes is None:
+            our_votes = BitArray(vs.val_set.size())
+        peer.try_send(
+            VOTE_SET_BITS_CHANNEL,
+            encode_msg(
+                VoteSetBitsMessage(
+                    height=msg.height, round=msg.round, type=msg.type,
+                    block_id=msg.block_id, votes=our_votes,
+                )
+            ),
+        )
+
+    # -- broadcast routine (event bus -> all peers) --------------------
+
+    def _broadcast_routine(self) -> None:
+        """reactor.go:371-395 subscribeToBroadcastEvents."""
+        bus = getattr(self.cs, "event_bus", None)
+        if bus is None or not hasattr(bus, "subscribe"):
+            return
+        sub_step = bus.subscribe("cons-reactor-step", query_for_event(EVENT_NEW_ROUND_STEP))
+        sub_vote = bus.subscribe("cons-reactor-vote", query_for_event(EVENT_VOTE))
+        self._subs = [sub_step, sub_vote]
+        while not self._stop.is_set():
+            msg = sub_step.get(timeout=0.05)
+            if msg is not None:
+                rs = msg.data
+                self._broadcast(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+            vmsg = sub_vote.get(timeout=0.0)
+            if vmsg is not None:
+                vote = vmsg.data["vote"]
+                self._broadcast(
+                    STATE_CHANNEL,
+                    encode_msg(
+                        HasVoteMessage(
+                            height=vote.height, round=vote.round,
+                            type=vote.type, index=vote.validator_index,
+                        )
+                    ),
+                )
+
+    def _broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(ch_id, msg_bytes)
+
+    # -- per-peer gossip -----------------------------------------------
+
+    def _gossip_data_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:456-526."""
+        while peer.is_running() and not self._stop.is_set():
+            try:
+                if self._gossip_data_once(peer, ps):
+                    continue
+            except Exception:
+                LOG.exception("gossip data error for %s", peer.id[:8])
+            time.sleep(PEER_GOSSIP_SLEEP)
+
+    def _gossip_data_once(self, peer, ps: PeerState) -> bool:
+        """One attempt; True if something was sent (skip the sleep)."""
+        rs = self.cs.get_round_state()
+        prs = ps.get_round_state()
+
+        # send proposal block parts the peer is missing
+        if (
+            rs.proposal_block_parts is not None
+            and prs.proposal_block_parts_header is not None
+            and rs.proposal_block_parts.has_header(prs.proposal_block_parts_header)
+            and prs.proposal_block_parts is not None
+        ):
+            missing = rs.proposal_block_parts.bit_array().sub(prs.proposal_block_parts)
+            idx = missing.pick_random()
+            if idx is not None:
+                part = rs.proposal_block_parts.get_part(idx)
+                if part is not None and peer.send(
+                    DATA_CHANNEL,
+                    encode_msg(BlockPartMessage(height=rs.height, round=rs.round, part=part)),
+                ):
+                    ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+                    return True
+
+        # peer is catching up: send parts of the committed block at their height
+        block_store = getattr(self.cs, "block_store", None)
+        if prs.height != 0 and prs.height < rs.height and block_store is not None:
+            if prs.height < (block_store.height() or 0) + 1:
+                return self._gossip_catchup_block_part(peer, ps, prs, block_store)
+
+        if rs.height != prs.height or rs.round != prs.round:
+            return False
+
+        # send the proposal (+POL) if the peer lacks it
+        if rs.proposal is not None and not prs.proposal:
+            if peer.send(DATA_CHANNEL, encode_msg(ProposalMessage(proposal=rs.proposal))):
+                ps.set_has_proposal(rs.proposal)
+            if 0 <= rs.proposal.pol_round and rs.votes is not None:
+                pol = rs.votes.prevotes(rs.proposal.pol_round)
+                if pol is not None:
+                    peer.send(
+                        DATA_CHANNEL,
+                        encode_msg(
+                            ProposalPOLMessage(
+                                height=rs.height,
+                                proposal_pol_round=rs.proposal.pol_round,
+                                proposal_pol=pol.bit_array(),
+                            )
+                        ),
+                    )
+            return True
+        return False
+
+    def _gossip_catchup_block_part(self, peer, ps: PeerState, prs, block_store) -> bool:
+        """reactor.go:528-591: feed an old block part by part."""
+        meta = block_store.load_block_meta(prs.height)
+        if meta is None:
+            return False
+        if prs.proposal_block_parts_header is None or not (
+            prs.proposal_block_parts_header.hash == meta.block_id.parts_header.hash
+        ):
+            # peer doesn't know the right parts header yet: tell it
+            peer.try_send(
+                STATE_CHANNEL,
+                encode_msg(
+                    CommitStepMessage(
+                        height=prs.height,
+                        block_parts_header=meta.block_id.parts_header,
+                        block_parts=BitArray(meta.block_id.parts_header.total),
+                    )
+                ),
+            )
+            return False
+        if prs.proposal_block_parts is None:
+            return False
+        missing = BitArray(prs.proposal_block_parts.bits)
+        for i in range(missing.bits):
+            missing.set_index(i, True)
+        missing = missing.sub(prs.proposal_block_parts)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        part = block_store.load_block_part(prs.height, idx)
+        if part is None:
+            return False
+        if peer.send(
+            DATA_CHANNEL,
+            encode_msg(BlockPartMessage(height=prs.height, round=prs.round, part=part)),
+        ):
+            ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+            return True
+        return False
+
+    def _gossip_votes_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:593-717."""
+        while peer.is_running() and not self._stop.is_set():
+            try:
+                if self._gossip_votes_once(peer, ps):
+                    continue
+            except Exception:
+                LOG.exception("gossip votes error for %s", peer.id[:8])
+            time.sleep(PEER_GOSSIP_SLEEP)
+
+    def _gossip_votes_once(self, peer, ps: PeerState) -> bool:
+        rs = self.cs.get_round_state()
+        prs = ps.get_round_state()
+
+        def send(vote) -> bool:
+            if vote is None:
+                return False
+            return peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote=vote)))
+
+        # same height: current-round votes, POL prevotes, last commit
+        if rs.height == prs.height and rs.votes is not None:
+            # last commit to help the peer finish the previous height
+            if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+                if send(ps.pick_vote_to_send(rs.last_commit)):
+                    return True
+            # POL prevotes for the peer's proposal_pol_round
+            if 0 <= prs.proposal_pol_round:
+                pol = rs.votes.prevotes(prs.proposal_pol_round)
+                if pol is not None and send(ps.pick_vote_to_send(pol)):
+                    return True
+            # current round votes
+            if 0 <= prs.round <= rs.round:
+                pv = rs.votes.prevotes(prs.round)
+                if prs.step <= STEP_PREVOTE_WAIT and pv is not None:
+                    if send(ps.pick_vote_to_send(pv)):
+                        return True
+                pc = rs.votes.precommits(prs.round)
+                if pc is not None and send(ps.pick_vote_to_send(pc)):
+                    return True
+        # peer one height behind: our last commit is their current precommits
+        if rs.height == prs.height + 1 and rs.last_commit is not None:
+            if send(ps.pick_vote_to_send(rs.last_commit)):
+                return True
+        # further behind: stored commit for their height
+        block_store = getattr(self.cs, "block_store", None)
+        if prs.height != 0 and rs.height >= prs.height + 2 and block_store is not None:
+            commit = block_store.load_block_commit(prs.height)
+            if commit is not None:
+                ps.ensure_catchup_commit_round(prs.height, commit.round(), len(commit.precommits))
+                vote = ps.pick_vote_to_send(_CommitVoteSetView(commit))
+                if send(vote):
+                    return True
+        return False
+
+    def _query_maj23_routine(self, peer, ps: PeerState) -> None:
+        """reactor.go:720-802: periodically ask the peer for vote bits of
+        claimed majorities."""
+        while peer.is_running() and not self._stop.is_set():
+            time.sleep(PEER_QUERY_MAJ23_SLEEP)
+            try:
+                rs = self.cs.get_round_state()
+                prs = ps.get_round_state()
+                if rs.votes is None:
+                    continue
+                if rs.height == prs.height:
+                    pv = rs.votes.prevotes(prs.round) if prs.round >= 0 else None
+                    if pv is not None:
+                        maj = pv.two_thirds_majority()
+                        if maj is not None:
+                            peer.try_send(
+                                STATE_CHANNEL,
+                                encode_msg(
+                                    VoteSetMaj23Message(
+                                        height=prs.height, round=prs.round,
+                                        type=VOTE_TYPE_PREVOTE, block_id=maj,
+                                    )
+                                ),
+                            )
+                    pc = rs.votes.precommits(prs.round) if prs.round >= 0 else None
+                    if pc is not None:
+                        maj = pc.two_thirds_majority()
+                        if maj is not None:
+                            peer.try_send(
+                                STATE_CHANNEL,
+                                encode_msg(
+                                    VoteSetMaj23Message(
+                                        height=prs.height, round=prs.round,
+                                        type=VOTE_TYPE_PRECOMMIT, block_id=maj,
+                                    )
+                                ),
+                            )
+            except Exception:
+                LOG.exception("query maj23 error for %s", peer.id[:8])
+
+
+class _CommitVoteSetView:
+    """Adapter presenting a stored Commit as a minimal VoteSet for
+    pick_vote_to_send (reference uses Commit.BitArray/GetByIndex via the
+    VoteSetReader interface, types/block.go:540-620)."""
+
+    def __init__(self, commit):
+        self.commit = commit
+        votes = [v for v in commit.precommits]
+        self.height = commit.height()
+        self.round = commit.round()
+        self.type = VOTE_TYPE_PRECOMMIT
+        self._votes = votes
+
+        class _VS:
+            def __init__(self, n):
+                self._n = n
+
+            def __len__(self):
+                return self._n
+
+        self.val_set = _VS(len(votes))
+
+    def size(self) -> int:
+        return len(self._votes)
+
+    def bit_array(self) -> BitArray:
+        return BitArray.from_bools([v is not None for v in self._votes])
+
+    def get_by_index(self, idx: int):
+        return self._votes[idx]
+
+
+def _new_round_step_msg(rs) -> NewRoundStepMessage:
+    since = int(time.time() - rs.start_time) if rs.start_time else 0
+    last_commit_round = rs.last_commit.round if rs.last_commit is not None else -1
+    return NewRoundStepMessage(
+        height=rs.height,
+        round=rs.round,
+        step=rs.step,
+        seconds_since_start_time=max(since, 0),
+        last_commit_round=last_commit_round,
+    )

@@ -27,12 +27,17 @@ class Monitor:
         self._sample_start = self.start
         self._rate = 0.0  # EWMA bytes/sec
         self.samples = 0
+        # token-bucket origin for limit(); kept separate from the stats
+        # epoch `start` so credit-forfeiture can't corrupt avg_rate()
+        self._limit_start = self.start
+        self._limit_total = 0
 
     def update(self, n: int) -> int:
         """Record n bytes transferred; returns n."""
         with self._lock:
             self._tick_locked()
             self.total += n
+            self._limit_total += n
             self._acc += n
         return n
 
@@ -73,13 +78,13 @@ class Monitor:
             with self._lock:
                 self._tick_locked()
                 now = time.monotonic()
-                elapsed = max(now - self.start, 1e-9)
-                allowed = rate_limit * elapsed - self.total
+                elapsed = max(now - self._limit_start, 1e-9)
+                allowed = rate_limit * elapsed - self._limit_total
                 burst_cap = rate_limit * self.window
                 if allowed > burst_cap:
                     # forfeit credit beyond one window by sliding the
-                    # accounting origin forward
-                    self.start = now - (burst_cap + self.total) / rate_limit
+                    # bucket origin forward
+                    self._limit_start = now - (burst_cap + self._limit_total) / rate_limit
                     allowed = burst_cap
             if allowed >= 1:
                 return min(want, int(allowed))

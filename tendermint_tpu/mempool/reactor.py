@@ -1,0 +1,80 @@
+"""Mempool reactor — tx gossip on channel 0x30 (reference mempool/reactor.go).
+
+One broadcastTxRoutine per peer walks the mempool's tx list from the
+front, sending each tx and blocking (with a timeout-poll) at the tail
+until new txs arrive; txs aren't sent to peers whose reported height
+shows they'd reject them (reactor.go:134-185).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict
+
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serde
+
+LOG = logging.getLogger("mempool.reactor")
+
+MEMPOOL_CHANNEL = 0x30
+PEER_CATCHUP_SLEEP = 0.1
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, config, mempool):
+        super().__init__("MempoolReactor")
+        self.config = config
+        self.mempool = mempool
+        self._stop = threading.Event()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=MEMPOOL_CHANNEL, priority=5, recv_message_capacity=1048576
+            )
+        ]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def add_peer(self, peer) -> None:
+        if not getattr(self.config, "broadcast", True):
+            return
+        t = threading.Thread(
+            target=self._broadcast_tx_routine,
+            args=(peer,),
+            name=f"mempool-bcast-{peer.id[:8]}",
+            daemon=True,
+        )
+        t.start()
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        """reactor.go:119-132: CheckTx every gossiped tx."""
+        obj = serde.unpack(msg_bytes)
+        if not (isinstance(obj, (list, tuple)) and obj and obj[0] == "tx"):
+            raise ValueError("bad mempool message")
+        tx = bytes(obj[1])
+        try:
+            self.mempool.check_tx(tx)
+        except Exception as e:
+            LOG.debug("gossiped tx rejected: %s", e)
+
+    def _broadcast_tx_routine(self, peer) -> None:
+        """reactor.go:134-185: walk the tx list; idx is our cursor into
+        the mempool's append-only running order."""
+        idx = 0
+        while peer.is_running() and not self._stop.is_set():
+            if self.mempool.wait_for_tx_after(idx, timeout=0.2) is None:
+                # nothing at our cursor yet; if the list compacted under
+                # us (commit removed txs), snap the cursor back
+                idx = min(idx, self.mempool.size())
+                continue
+            tx = self.mempool.tx_at(idx)
+            if tx is None:
+                continue
+            if peer.send(MEMPOOL_CHANNEL, serde.pack(["tx", tx])):
+                idx += 1
+            else:
+                time.sleep(PEER_CATCHUP_SLEEP)

@@ -301,6 +301,7 @@ class MConnection:
         return {
             "send_monitor": self.send_monitor.status(),
             "recv_monitor": self.recv_monitor.status(),
+            "last_pong": self._last_pong,
             "channels": [
                 ChannelStatus(
                     id=ch.desc.id,

@@ -59,6 +59,11 @@ class Switch:
         for desc in reactor.get_channels():
             if desc.id in self._reactor_by_ch:
                 raise ValueError(f"channel {desc.id:#x} already registered")
+            if desc.priority <= 0:
+                raise ValueError(
+                    f"channel {desc.id:#x} priority must be > 0 "
+                    "(the send scheduler divides by it)"
+                )
             self.ch_descs.append(desc)
             self._reactor_by_ch[desc.id] = reactor
         self.reactors[name] = reactor
@@ -111,12 +116,10 @@ class Switch:
     def _upgrade_inbound(self, raw, remote: str) -> None:
         try:
             sc, their_info, remote = self.transport.upgrade_inbound(raw, remote)
-        except (RejectedError, OSError, ValueError, ConnectionError) as e:
+        except Exception as e:
+            # remote-triggerable failures (bad auth sig, malformed
+            # NodeInfo, ...) must never escape the upgrade thread
             LOG.debug("inbound upgrade rejected (%s): %s", remote, e)
-            return
-        inbound = sum(1 for p in self.peers.list() if not p.outbound)
-        if inbound >= self.max_inbound:
-            sc.close()
             return
         self._add_peer_conn(sc, their_info, remote, outbound=False)
 
@@ -163,9 +166,6 @@ class Switch:
     def _add_peer_conn(
         self, sc, their_info: NodeInfo, remote: str, outbound: bool, persistent: bool = False
     ) -> Optional[Peer]:
-        if self.peers.has(their_info.id):
-            sc.close()
-            return None
         persistent = persistent or their_info.id in self.persistent_addrs
         peer = Peer(
             sc,
@@ -180,11 +180,22 @@ class Switch:
         )
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
-        try:
-            self.peers.add(peer)
-        except KeyError:
-            sc.close()
-            return None
+        # atomically check limits + dedupe + insert (concurrent upgrade
+        # threads must not overshoot max_inbound or double-add an ID)
+        with self._lock:
+            if self.peers.has(their_info.id):
+                sc.close()
+                return None
+            if not outbound:
+                inbound = sum(1 for p in self.peers.list() if not p.outbound)
+                if inbound >= self.max_inbound:
+                    sc.close()
+                    return None
+            try:
+                self.peers.add(peer)
+            except KeyError:
+                sc.close()
+                return None
         peer.start()
         for reactor in self.reactors.values():
             try:

@@ -64,6 +64,8 @@ class MultiplexTransport:
         can't head-of-line-block the accept loop, transport.go
         acceptPeers)."""
         assert self._listener is not None, "transport not listening"
+        if self._closed.is_set():
+            raise OSError("transport closed")
         conn, addr = self._listener.accept()
         return conn, f"{addr[0]}:{addr[1]}"
 
@@ -80,6 +82,8 @@ class MultiplexTransport:
     # -- dialing -------------------------------------------------------
 
     def dial(self, addr: str, expect_id: str = "") -> Tuple[SecretConnection, NodeInfo, str]:
+        if self._closed.is_set():
+            raise OSError("transport closed")
         host, port = split_host_port(addr)
         conn = socket.create_connection((host, port), timeout=DIAL_TIMEOUT)
         return self._upgrade(conn, f"{host}:{port}", dialed_id=expect_id or None)

@@ -1,0 +1,202 @@
+"""In-process multi-validator network over real TCP — the workhorse
+integration tier (reference consensus/reactor_test.go + common_test.go
+randConsensusNet / p2p/test_util.go MakeConnectedSwitches).
+
+N full stacks (consensus state + reactor + switch), one per validator,
+gossiping proposals/parts/votes over encrypted MConnections; asserts
+every node commits the same blocks.
+"""
+
+import os
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu import state as sm
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.consensus import ConsensusState
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p import (
+    MultiplexTransport,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Switch,
+)
+from tendermint_tpu.privval import FilePV
+from tendermint_tpu.proxy import AppConns, local_client_creator
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, EventBus, query_for_event
+from tendermint_tpu.types.validator_set import random_validator_set
+
+CHAIN_ID = "reactor-net"
+
+
+class NetNode:
+    def __init__(self, idx, doc, key, fast_sync=False):
+        db = MemDB()
+        self.state = sm.load_state_from_db_or_genesis(db, doc)
+        self.conns = AppConns(local_client_creator(KVStoreApplication()))
+        self.conns.start()
+        self.mempool = Mempool(cfg.MempoolConfig(), self.conns.mempool)
+        self.bus = EventBus()
+        self.bus.start()
+        block_exec = sm.BlockExecutor(
+            db, self.conns.consensus, mempool=self.mempool, event_bus=self.bus
+        )
+        self.bstore = BlockStore(MemDB())
+        conf = cfg.test_config().consensus
+        self.cs = ConsensusState(
+            conf,
+            self.state,
+            block_exec,
+            self.bstore,
+            mempool=self.mempool,
+            event_bus=self.bus,
+            priv_validator=FilePV(key, None),
+        )
+        self.cons_reactor = ConsensusReactor(self.cs, fast_sync=fast_sync)
+        self.mp_reactor = MempoolReactor(cfg.MempoolConfig(), self.mempool)
+        self.bc_reactor = BlockchainReactor(
+            self.state, block_exec, self.bstore, fast_sync,
+            consensus_reactor=self.cons_reactor,
+        )
+
+        nk = NodeKey(PrivKeyEd25519.generate())
+        ni = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            id=nk.id,
+            listen_addr="",
+            network=CHAIN_ID,
+            version="dev",
+            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x40]),
+            moniker=f"node{idx}",
+        )
+        tr = MultiplexTransport(ni, nk)
+        tr.listen("127.0.0.1:0")
+        ni.listen_addr = tr.listen_addr
+        self.switch = Switch(tr)
+        self.switch.add_reactor("CONSENSUS", self.cons_reactor)
+        self.switch.add_reactor("MEMPOOL", self.mp_reactor)
+        self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        self.switch.stop()
+        self.bus.stop()
+
+
+def make_net(n):
+    vs, keys = random_validator_set(n, 10)
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators],
+    )
+    nodes = [NetNode(i, doc, keys[i]) for i in range(n)]
+    subs = [
+        node.bus.subscribe(f"t{i}", query_for_event(EVENT_NEW_BLOCK), 64)
+        for i, node in enumerate(nodes)
+    ]
+    for node in nodes:
+        node.start()
+    # connect all-to-all (reference MakeConnectedSwitches)
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1 :]:
+            a.switch.dial_peer(b.switch.transport.listen_addr)
+    return nodes, subs
+
+
+def collect_blocks(sub, want, timeout):
+    out = []
+    deadline = time.time() + timeout
+    while len(out) < want and time.time() < deadline:
+        msg = sub.get(timeout=0.25)
+        if msg is not None:
+            out.append(msg.data["block"])
+    return out
+
+
+class TestConsensusNet:
+    def test_four_validators_commit_blocks(self):
+        nodes, subs = make_net(4)
+        try:
+            per_node = [collect_blocks(s, 2, timeout=60.0) for s in subs]
+            for i, blocks in enumerate(per_node):
+                assert len(blocks) >= 2, f"node {i} committed only {len(blocks)} blocks"
+            # all nodes agree on block 1's hash
+            h1 = {b.header.height: b.hash() for b in per_node[0]}
+            for blocks in per_node[1:]:
+                for b in blocks:
+                    assert b.hash() == h1.get(b.header.height, b.hash())
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_fast_sync_then_consensus(self):
+        """A lone validator commits blocks; a late joiner fast-syncs the
+        backlog via the blockchain reactor (batched commit verification,
+        reactor.go:310) then switches to live consensus."""
+        vs, keys = random_validator_set(1, 10)
+        doc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=time.time_ns() - 10**9,
+            validators=[
+                GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators
+            ],
+        )
+        a = NetNode(0, doc, keys[0])
+        sub_a = a.bus.subscribe("ta", query_for_event(EVENT_NEW_BLOCK), 256)
+        a.start()
+        try:
+            assert len(collect_blocks(sub_a, 5, timeout=30.0)) >= 5
+            # late joiner: not a validator, starts in fast-sync
+            b = NetNode(1, doc, PrivKeyEd25519.generate(), fast_sync=True)
+            sub_b = b.bus.subscribe("tb", query_for_event(EVENT_NEW_BLOCK), 256)
+            b.start()
+            try:
+                b.switch.dial_peer(a.switch.transport.listen_addr)
+                blocks_b = collect_blocks(sub_b, 6, timeout=60.0)
+                assert len(blocks_b) >= 6, f"joiner saw only {len(blocks_b)} blocks"
+                # joiner agrees with the validator's chain
+                for blk in blocks_b[:4]:
+                    assert a.bstore.load_block(blk.header.height).hash() == blk.hash()
+                # and switches to live consensus (pool stops; checked on
+                # a 1s cadence in the pool routine)
+                deadline = time.time() + 15
+                while b.bc_reactor.pool.is_running() and time.time() < deadline:
+                    time.sleep(0.1)
+                assert not b.bc_reactor.pool.is_running()
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_tx_gossip_reaches_block(self):
+        nodes, subs = make_net(3)
+        try:
+            # wait until peers are wired
+            deadline = time.time() + 10
+            while time.time() < deadline and any(
+                n.switch.peers.size() < 2 for n in nodes
+            ):
+                time.sleep(0.05)
+            # inject the tx at node 2; it must reach the proposer via gossip
+            nodes[2].mempool.check_tx(b"gossip=works")
+            blocks = collect_blocks(subs[0], 4, timeout=60.0)
+            all_txs = [tx for b in blocks for tx in b.data.txs]
+            assert b"gossip=works" in all_txs
+        finally:
+            for n in nodes:
+                n.stop()
