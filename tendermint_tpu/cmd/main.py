@@ -1,18 +1,303 @@
-"""CLI entry point (reference: cmd/tendermint/main.go). Commands land in
-later milestones; `version` works from day one."""
+"""CLI entry point (reference cmd/tendermint/main.go:48 + commands/).
 
+Commands: init, node, testnet, gen_validator, show_node_id,
+show_validator, reset_priv_validator, unsafe_reset_all, replay,
+replay_console, lite, version — argparse standing in for cobra, with
+--home as the root flag (reference libs/cli/setup.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import signal
 import sys
+import time
 
 
-def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
+def _load_config(home: str):
+    from tendermint_tpu import config as cfg
+
+    path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(path):
+        c = cfg.Config.load(path)
+    else:
+        c = cfg.default_config()
+    c.set_root(home)
+    return c
+
+
+def cmd_init(args) -> int:
+    """commands/init.go: private validator, node key, genesis."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    c = _load_config(args.home)
+    cfg.ensure_root(c.root_dir)
+    pv = load_or_gen_file_pv(c.base.priv_validator_path())
+    NodeKey.load_or_gen(c.base.node_key_path())
+    gen_path = c.base.genesis_path()
+    if os.path.exists(gen_path):
+        print(f"Found genesis file {gen_path}")
+    else:
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        doc.save(gen_path)
+        print(f"Generated genesis file {gen_path}")
+    conf_path = os.path.join(c.root_dir, "config", "config.toml")
+    if not os.path.exists(conf_path):
+        c.save(conf_path)
+        print(f"Generated config file {conf_path}")
+    print(f"Generated private validator {c.base.priv_validator_path()}")
+    print(f"Generated node key {c.base.node_key_path()}")
+    return 0
+
+
+def cmd_node(args) -> int:
+    """commands/run_node.go: build + run the node until signalled."""
+    from tendermint_tpu.node import default_new_node
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    c = _load_config(args.home)
+    if args.proxy_app:
+        c.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        c.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        c.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        c.p2p.persistent_peers = args.persistent_peers
+    if args.seeds:
+        c.p2p.seeds = args.seeds
+    if args.fast_sync is not None:
+        c.base.fast_sync = args.fast_sync == "true"
+    node = default_new_node(c)
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    node.start()
+    print(f"Started node {node.node_key.id}  "
+          f"p2p={node.transport.listen_addr}  "
+          f"rpc={node.rpc_listen_addr or '-'}", flush=True)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go: write N validator config roots that dial
+    each other as persistent peers."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    n = args.v
+    out = args.o
+    starting_port = args.starting_port
+    roots, node_keys, pvs = [], [], []
+    for i in range(n):
+        root = os.path.join(out, f"{args.node_dir_prefix}{i}")
+        c = cfg.default_config().set_root(root)
+        cfg.ensure_root(root)
+        node_keys.append(NodeKey.load_or_gen(c.base.node_key_path()))
+        pvs.append(load_or_gen_file_pv(c.base.priv_validator_path()))
+        roots.append((root, c))
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"chain-{os.urandom(3).hex()}",
+        genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 1) for pv in pvs],
+    )
+    peers = ",".join(
+        f"{node_keys[i].id}@127.0.0.1:{starting_port + 2 * i}"
+        for i in range(n)
+    )
+    for i, (root, c) in enumerate(roots):
+        c.base.moniker = f"node{i}"
+        c.p2p.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i}"
+        c.rpc.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i + 1}"
+        c.p2p.persistent_peers = peers
+        c.p2p.addr_book_strict = False
+        c.base.proxy_app = args.proxy_app
+        doc.save(c.base.genesis_path())
+        c.save(os.path.join(root, "config", "config.toml"))
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """commands/gen_validator.go: print a fresh priv validator JSON."""
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.privval import FilePV
+
+    pv = FilePV(PrivKeyEd25519.generate(), None)
+    print(pv.to_json())
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from tendermint_tpu.p2p import NodeKey
+
+    c = _load_config(args.home)
+    nk = NodeKey.load(c.base.node_key_path())
+    print(nk.id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tendermint_tpu.privval import load_or_gen_file_pv
+
+    c = _load_config(args.home)
+    pv = load_or_gen_file_pv(c.base.priv_validator_path())
+    pk = pv.get_pub_key()
+    print(json.dumps({"type": "ed25519",
+                      "value": pk.bytes().hex().upper()}))
+    return 0
+
+
+def cmd_reset_priv_validator(args) -> int:
+    """commands/reset_priv_validator.go: wipe last-sign state, KEEPING
+    the key (DANGEROUS on a live validator — double-sign protection)."""
+    from tendermint_tpu.privval import load_or_gen_file_pv
+
+    c = _load_config(args.home)
+    path = c.base.priv_validator_path()
+    pv = load_or_gen_file_pv(path)
+    pv.reset()
+    print(f"Reset private validator sign-state {path}")
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset_priv_validator.go ResetAll: wipe data + sign-state."""
+    c = _load_config(args.home)
+    data_dir = c.base.db_path()
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    return cmd_reset_priv_validator(args)
+
+
+def cmd_replay(args, console: bool = False) -> int:
+    """commands/replay.go: replay the WAL through consensus."""
+    from tendermint_tpu.consensus.replay_file import run_replay_file
+
+    c = _load_config(args.home)
+    run_replay_file(c, console=console)
+    return 0
+
+
+def cmd_lite(args) -> int:
+    """commands/lite.go: verifying light-client RPC proxy."""
+    from tendermint_tpu.lite.proxy import run_lite_proxy
+
+    logging.basicConfig(level=logging.INFO)
+    run_lite_proxy(
+        node_addr=args.node, listen=args.laddr, chain_id=args.chain_id,
+        home=args.home,
+    )
+    return 0
+
+
+def cmd_version(args) -> int:
     from tendermint_tpu import __version__
 
-    if not argv or argv[0] in ("version", "--version", "-v"):
-        print(f"tendermint-tpu {__version__}")
-        return 0
-    print(f"unknown command {argv[0]!r}; available: version", file=sys.stderr)
-    return 1
+    print(f"tendermint-tpu {__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tendermint-tpu",
+        description="TPU-native BFT state-machine replication "
+                    "(Tendermint-compatible capability surface)",
+    )
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"),
+                   help="directory for config and data")
+    sub = p.add_subparsers(dest="command")
+
+    sp = sub.add_parser("init", help="initialize a node (key, genesis)")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", help="run the node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers",
+                    default="")
+    sp.add_argument("--p2p.seeds", dest="seeds", default="")
+    sp.add_argument("--fast_sync", choices=("true", "false"), default=None)
+    sp.add_argument("--log_level", default="info")
+    sp.set_defaults(fn=cmd_node)
+
+    sp = sub.add_parser("testnet", help="generate testnet config dirs")
+    sp.add_argument("--v", type=int, default=4, help="number of validators")
+    sp.add_argument("--o", default="./mytestnet", help="output dir")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", type=int, default=26656)
+    sp.add_argument("--node-dir-prefix", default="node")
+    sp.add_argument("--proxy_app", default="kvstore")
+    sp.set_defaults(fn=cmd_testnet)
+
+    sub.add_parser("gen_validator",
+                   help="generate a priv validator").set_defaults(
+        fn=cmd_gen_validator)
+    sub.add_parser("show_node_id",
+                   help="print the node p2p id").set_defaults(
+        fn=cmd_show_node_id)
+    sub.add_parser("show_validator",
+                   help="print the validator pubkey").set_defaults(
+        fn=cmd_show_validator)
+    sub.add_parser("reset_priv_validator",
+                   help="reset the priv validator sign-state").set_defaults(
+        fn=cmd_reset_priv_validator)
+    sub.add_parser("unsafe_reset_all",
+                   help="wipe all chain data + sign-state").set_defaults(
+        fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("replay", help="replay the consensus WAL")
+    sp.set_defaults(fn=cmd_replay)
+    sp = sub.add_parser("replay_console",
+                        help="interactive WAL replay")
+    sp.set_defaults(fn=lambda a: cmd_replay(a, console=True))
+
+    sp = sub.add_parser("lite", help="run a verifying light-client proxy")
+    sp.add_argument("--node", default="tcp://localhost:26657")
+    sp.add_argument("--laddr", default="tcp://localhost:8888")
+    sp.add_argument("--chain-id", default="tendermint")
+    sp.set_defaults(fn=cmd_lite)
+
+    sub.add_parser("version", help="print the version").set_defaults(
+        fn=cmd_version)
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help()
+        return 1
+    return args.fn(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,102 @@
+"""WAL file replay — `tendermint replay` / `replay_console` commands
+(reference consensus/replay_file.go).
+
+Rebuilds a ConsensusState over the node's real stores, hands the app
+the chain via ABCI handshake, then feeds every WAL record through the
+consensus machine in replay mode. Console mode steps interactively:
+next [N] / rs / quit.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+from .. import state as sm
+from ..blockchain.store import BlockStore
+from ..consensus import ConsensusState
+from ..consensus.replay import Handshaker
+from ..consensus.wal import WAL, EndHeightMessage, TimedWALMessage
+from ..proxy import AppConns, default_client_creator
+from ..types import GenesisDoc
+from ..types.event_bus import EventBus
+
+LOG = logging.getLogger("consensus.replay_file")
+
+
+def _build_consensus_for_replay(config):
+    """reference replay_file.go newConsensusStateForReplay:255-310"""
+    from ..node.node import db_provider
+
+    db_dir = config.base.db_path()
+    backend = config.base.db_backend
+    genesis_doc = GenesisDoc.load(config.base.genesis_path())
+    state_db = db_provider("state", backend, db_dir)
+    block_store = BlockStore(db_provider("blockstore", backend, db_dir))
+    state = sm.load_state_from_db_or_genesis(state_db, genesis_doc)
+
+    proxy_app = AppConns(default_client_creator(config.base.proxy_app))
+    proxy_app.start()
+    event_bus = EventBus()
+    event_bus.start()
+    Handshaker(state_db, state, block_store, genesis_doc,
+               event_bus).handshake(proxy_app)
+    state = sm.load_state_from_db_or_genesis(state_db, genesis_doc)
+
+    block_exec = sm.BlockExecutor(state_db, proxy_app.consensus,
+                                  event_bus=event_bus)
+    cs = ConsensusState(config.consensus, state, block_exec, block_store,
+                        event_bus=event_bus)
+    return cs
+
+
+def run_replay_file(config, console: bool = False) -> None:
+    """reference replay_file.go RunReplayFile:30 + replayFile loop."""
+    cs = _build_consensus_for_replay(config)
+    wal_path = config.consensus.wal_file(config.root_dir)
+    if not os.path.exists(wal_path):
+        print(f"no WAL at {wal_path}", file=sys.stderr)
+        return
+    wal = WAL(wal_path)
+    wal.start()
+    try:
+        msgs = list(wal.iter_messages())
+    finally:
+        wal.stop()
+    print(f"replaying {len(msgs)} WAL records through consensus "
+          f"(height {cs.rs.height})")
+    cs._replay_mode = True
+    count = 0
+    pending = 0  # console: records to play before next prompt
+    for m in msgs:
+        if console and pending == 0:
+            pending = _console_prompt(cs)
+            if pending < 0:
+                break
+        cs._replay_one(m)
+        count += 1
+        pending = max(pending - 1, 0)
+        if isinstance(m, EndHeightMessage):
+            print(f"  #ENDHEIGHT {m.height}")
+    print(f"replayed {count} records; final state height={cs.rs.height} "
+          f"round={cs.rs.round} step={cs.rs.step}")
+
+
+def _console_prompt(cs) -> int:
+    """console commands (replay_file.go:120-180): next [N], rs, quit."""
+    while True:
+        try:
+            line = input("> ").strip()
+        except EOFError:
+            return -1
+        if not line or line.split()[0] == "next":
+            parts = line.split()
+            return int(parts[1]) if len(parts) > 1 else 1
+        if line == "rs":
+            print(f"height={cs.rs.height} round={cs.rs.round} "
+                  f"step={cs.rs.step}")
+        elif line in ("quit", "q", "exit"):
+            return -1
+        else:
+            print("commands: next [N] | rs | quit")

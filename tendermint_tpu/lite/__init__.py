@@ -1,0 +1,26 @@
+"""lite — light client (reference lite/).
+
+A light client tracks a chain by verifying signed headers against
+validator sets it trusts, without executing blocks. Model types in
+types.py (SignedHeader/FullCommit, lite/types.go equivalents),
+verifiers in verifier.py (BaseVerifier/DynamicVerifier), header/valset
+sources in provider.py, verifying RPC proxy in proxy.py.
+
+Commit verification rides the process-wide BatchVerifier — on TPU a
+light client catching up over many headers batches every commit's
+signatures (SURVEY §2.5 lite).
+"""
+
+from .types import FullCommit, SignedHeader  # noqa: F401
+from .verifier import (  # noqa: F401
+    BaseVerifier,
+    DynamicVerifier,
+    ErrLiteVerification,
+    ErrUnknownValidators,
+)
+from .provider import (  # noqa: F401
+    DBProvider,
+    MemProvider,
+    Provider,
+    RPCProvider,
+)

@@ -1,0 +1,129 @@
+"""Light-client providers (reference lite/provider.go,
+lite/dbprovider.go, lite/client/provider.go).
+
+Provider: serve FullCommits at (or at the greatest height ≤) a target.
+MemProvider/DBProvider: local caches (DBProvider persists through the
+libs.db interface like lite/dbprovider.go). RPCProvider: pulls commits
++ validator sets from a full node's RPC.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..libs.db import DB
+from ..rpc import encoding as enc
+from .types import FullCommit, SignedHeader
+
+
+class Provider:
+    def latest_full_commit(self, chain_id: str,
+                           max_height: int) -> Optional[FullCommit]:
+        """FullCommit at the greatest height ≤ max_height."""
+        raise NotImplementedError
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        raise NotImplementedError  # only trusted providers implement
+
+
+class MemProvider(Provider):
+    """In-memory trusted store (lite/memprovider equivalents)."""
+
+    def __init__(self):
+        self._by_height = {}
+
+    def latest_full_commit(self, chain_id, max_height):
+        hs = [h for h in self._by_height if h <= max_height]
+        return self._by_height[max(hs)] if hs else None
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        self._by_height[fc.height] = fc
+
+
+def _fc_to_json(fc: FullCommit) -> dict:
+    return {
+        "signed_header": {
+            "header": enc.header_json(fc.signed_header.header),
+            "commit": enc.commit_json(fc.signed_header.commit),
+        },
+        "validators": [enc.validator_json(v)
+                       for v in fc.validators.validators],
+        "next_validators": (
+            [enc.validator_json(v) for v in fc.next_validators.validators]
+            if fc.next_validators is not None else None
+        ),
+    }
+
+
+def _fc_from_json(o: dict) -> FullCommit:
+    nv = o.get("next_validators")
+    return FullCommit(
+        signed_header=SignedHeader(
+            header=enc.header_from_json(o["signed_header"]["header"]),
+            commit=enc.commit_from_json(o["signed_header"]["commit"]),
+        ),
+        validators=enc.validator_set_from_json(o["validators"]),
+        next_validators=enc.validator_set_from_json(nv) if nv else None,
+    )
+
+
+class DBProvider(Provider):
+    """Persistent trusted store over the DB interface
+    (lite/dbprovider.go:24-60; keys fc:<chain>:<height-padded>)."""
+
+    def __init__(self, db: DB):
+        self.db = db
+
+    @staticmethod
+    def _key(chain_id: str, height: int) -> bytes:
+        return f"fc:{chain_id}:{height:020d}".encode()
+
+    def latest_full_commit(self, chain_id, max_height):
+        best = None
+        best_h = -1
+        prefix = f"fc:{chain_id}:".encode()
+        end = self._key(chain_id, max_height) + b"\xff"
+        for k, v in self.db.iterator(prefix, end):
+            if not k.startswith(prefix):
+                continue
+            h = int(k[len(prefix):])
+            if best_h < h <= max_height:
+                best_h, best = h, v
+        return _fc_from_json(json.loads(best)) if best else None
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        self.db.set(self._key(fc.signed_header.chain_id, fc.height),
+                    json.dumps(_fc_to_json(fc)).encode())
+
+
+class RPCProvider(Provider):
+    """Source provider over a full node's RPC
+    (lite/client/provider.go:21-70): commit + validators per height."""
+
+    def __init__(self, client):
+        self.client = client  # rpc.client.HTTPClient
+
+    def latest_full_commit(self, chain_id, max_height):
+        status = self.client.status()
+        tip = int(status["sync_info"]["latest_block_height"])
+        h = min(max_height, tip)
+        if h < 1:
+            return None
+        com = self.client.commit(h)
+        sh = SignedHeader(
+            header=enc.header_from_json(com["signed_header"]["header"]),
+            commit=enc.commit_from_json(com["signed_header"]["commit"]),
+        )
+        vals = enc.validator_set_from_json(
+            self.client.validators(h)["validators"])
+        try:
+            next_vals = enc.validator_set_from_json(
+                self.client.validators(h + 1)["validators"])
+        except Exception:  # noqa: BLE001 - next valset may not exist yet
+            next_vals = None
+        return FullCommit(signed_header=sh, validators=vals,
+                          next_validators=next_vals)
+
+    def save_full_commit(self, fc):  # source-only provider
+        raise NotImplementedError("RPCProvider is read-only")

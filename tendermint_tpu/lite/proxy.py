@@ -1,0 +1,185 @@
+"""Verifying RPC proxy (reference lite/proxy/proxy.go + wrapper.go).
+
+Serves a JSON-RPC endpoint whose block/commit/status answers are
+verified against the light client before being returned: commits are
+checked with the DynamicVerifier, block contents against the verified
+header's hashes (lite/proxy/wrapper.go Block/Commit).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..libs.db import FileDB
+from ..rpc import encoding as enc
+from ..rpc import jsonrpc
+from ..rpc.client import HTTPClient
+from .provider import DBProvider, RPCProvider
+from .types import SignedHeader
+from .verifier import DynamicVerifier, ErrLiteVerification
+
+LOG = logging.getLogger("lite.proxy")
+
+
+class VerifyingClient:
+    """lite/proxy/wrapper.go: an RPC client whose answers are verified."""
+
+    def __init__(self, client: HTTPClient, verifier: DynamicVerifier):
+        self.client = client
+        self.verifier = verifier
+
+    def _verified_signed_header(self, height: int) -> SignedHeader:
+        com = self.client.commit(height)
+        sh = SignedHeader(
+            header=enc.header_from_json(com["signed_header"]["header"]),
+            commit=enc.commit_from_json(com["signed_header"]["commit"]),
+        )
+        self.verifier.verify(sh)
+        return sh
+
+    def commit(self, height: int) -> dict:
+        sh = self._verified_signed_header(height)
+        return {
+            "signed_header": {
+                "header": enc.header_json(sh.header),
+                "commit": enc.commit_json(sh.commit),
+            },
+            "canonical": True,
+        }
+
+    def block(self, height: int) -> dict:
+        out = self.client.block(height)
+        sh = self._verified_signed_header(height)
+        blk = enc.header_from_json(out["block"]["header"])
+        if blk.hash() != sh.header_hash():
+            raise ErrLiteVerification(
+                f"block header at {height} does not match verified commit")
+        # data integrity: tx merkle root must match the verified header
+        from ..crypto import merkle
+
+        txs = [enc.unb64(tx) for tx in out["block"]["data"]["txs"]]
+        if merkle.hash_from_byte_slices(txs) != sh.header.data_hash:
+            raise ErrLiteVerification(
+                f"block data at {height} does not match data_hash")
+        return out
+
+    def status(self) -> dict:
+        return self.client.status()
+
+    def validators(self, height: int) -> dict:
+        sh = self._verified_signed_header(height)
+        out = self.client.validators(height)
+        vals = enc.validator_set_from_json(out["validators"])
+        if vals.hash() != sh.header.validators_hash:
+            raise ErrLiteVerification(
+                f"validators at {height} do not match validators_hash")
+        return out
+
+
+def run_lite_proxy(node_addr: str, listen: str, chain_id: str,
+                   home: str, blocking: bool = True) -> "LiteProxyServer":
+    """lite/proxy/proxy.go StartProxy."""
+    client = HTTPClient(node_addr)
+    trust_db = FileDB(os.path.join(home, "data", "lite-trust.db"))
+    trusted = DBProvider(trust_db)
+    source = RPCProvider(client)
+    verifier = DynamicVerifier(chain_id, trusted, source)
+    # seed trust from the source's current tip if the store is empty
+    if trusted.latest_full_commit(chain_id, 1 << 60) is None:
+        fc = source.latest_full_commit(chain_id, 1 << 60)
+        if fc is None:
+            raise RuntimeError("cannot seed trust: node has no blocks")
+        verifier.init_trust(fc)
+        LOG.info("seeded trust at height %d", fc.height)
+    vc = VerifyingClient(client, verifier)
+    addr = listen.split("://")[-1]
+    host, _, port = addr.rpartition(":")
+    srv = LiteProxyServer(vc, host or "127.0.0.1", int(port))
+    srv.start()
+    LOG.info("lite proxy listening on %s -> %s", srv.listen_addr, node_addr)
+    if blocking:
+        threading.Event().wait()
+    return srv
+
+
+class LiteProxyServer:
+    """JSON-RPC server fronting a VerifyingClient (subset of routes:
+    status, commit, block, validators; everything else proxied raw for
+    non-proof routes is intentionally NOT offered — parity with
+    lite/proxy routes)."""
+
+    def __init__(self, vc: VerifyingClient, host: str, port: int):
+        self.vc = vc
+        handler = _make_handler(vc)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def listen_addr(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="lite-proxy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _make_handler(vc: VerifyingClient):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            LOG.debug("http %s", fmt % args)
+
+        def _send(self, obj):
+            raw = jsonrpc.dumps(obj)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                req = jsonrpc.loads(self.rfile.read(length))
+            except jsonrpc.RPCError as e:
+                return self._send(
+                    jsonrpc.error_response(None, e.code, e.message))
+            id_ = req.get("id")
+            method = req.get("method", "")
+            params = req.get("params") or {}
+            try:
+                if method == "status":
+                    result = vc.status()
+                elif method == "commit":
+                    result = vc.commit(int(params.get("height", 0)))
+                elif method == "block":
+                    result = vc.block(int(params.get("height", 0)))
+                elif method == "validators":
+                    result = vc.validators(int(params.get("height", 0)))
+                else:
+                    return self._send(jsonrpc.error_response(
+                        id_, jsonrpc.ERR_METHOD_NOT_FOUND,
+                        f"method {method!r} not proxied"))
+                self._send(jsonrpc.ok_response(id_, result))
+            except ErrLiteVerification as e:
+                self._send(jsonrpc.error_response(
+                    id_, jsonrpc.ERR_SERVER, f"verification failed: {e}"))
+            except Exception as e:  # noqa: BLE001
+                LOG.exception("lite proxy %s failed", method)
+                self._send(jsonrpc.error_response(
+                    id_, jsonrpc.ERR_INTERNAL, str(e)))
+
+    return Handler

@@ -1,0 +1,76 @@
+"""Light-client model types (reference types/signed_header.go +
+lite/commit.go).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..types.block import Commit, Header
+from ..types.validator_set import ValidatorSet
+
+
+@dataclass
+class SignedHeader:
+    """Header + the commit that signed it (types/signed_header.go)."""
+
+    header: Header
+    commit: Commit
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    @property
+    def chain_id(self) -> str:
+        return self.header.chain_id
+
+    def validate_basic(self, chain_id: str) -> None:
+        """types/signed_header.go ValidateBasic."""
+        if self.header is None or self.commit is None:
+            raise ValueError("signed header missing header or commit")
+        if self.header.chain_id != chain_id:
+            raise ValueError(
+                f"signed header chain id {self.header.chain_id!r} != "
+                f"{chain_id!r}"
+            )
+        if self.commit.height() != self.header.height:
+            raise ValueError(
+                f"commit height {self.commit.height()} != header height "
+                f"{self.header.height}"
+            )
+        if self.commit.block_id.hash != self.header_hash():
+            raise ValueError("commit signs a different header")
+
+    def header_hash(self) -> bytes:
+        return self.header.hash()
+
+
+@dataclass
+class FullCommit:
+    """SignedHeader + the validator sets needed to verify it
+    (lite/commit.go:9-25)."""
+
+    signed_header: SignedHeader
+    validators: ValidatorSet
+    next_validators: Optional[ValidatorSet] = None
+
+    @property
+    def height(self) -> int:
+        return self.signed_header.height
+
+    def validate_full(self, chain_id: str) -> None:
+        """lite/commit.go ValidateFull: hashes line up."""
+        self.signed_header.validate_basic(chain_id)
+        if self.signed_header.header.validators_hash != self.validators.hash():
+            raise ValueError(
+                "validators hash mismatch: header says "
+                f"{self.signed_header.header.validators_hash.hex()[:12]}"
+            )
+        if (
+            self.next_validators is not None
+            and self.signed_header.header.next_validators_hash
+            != self.next_validators.hash()
+        ):
+            raise ValueError("next validators hash mismatch")

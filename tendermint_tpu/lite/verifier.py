@@ -1,0 +1,205 @@
+"""Light-client verifiers (reference lite/base_verifier.go +
+lite/dynamic_verifier.go).
+
+BaseVerifier: fixed known validator set; verifies a SignedHeader if
++2/3 of that set signed it.
+
+DynamicVerifier: tracks validator-set changes. For a header whose
+valset it doesn't know, it walks backward ("bisection",
+dynamic_verifier.go:195-255): fetch an earlier FullCommit it can
+verify, use its next_validators to step forward, recurse until the
+target height's valset is trusted.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..types.validator_set import ErrInvalidCommit, ValidatorSet
+from .types import FullCommit, SignedHeader
+
+LOG = logging.getLogger("lite")
+
+
+class ErrLiteVerification(Exception):
+    pass
+
+
+class ErrUnknownValidators(ErrLiteVerification):
+    """dynamic_verifier.go errUnknownValidators."""
+
+
+def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
+                            signed_header: SignedHeader,
+                            trust_fraction_num: int = 1,
+                            trust_fraction_den: int = 3) -> None:
+    """types/validator_set.go VerifyCommitTrusting-style check: enough
+    of OUR trusted set signed the new header (used while stepping
+    across valset changes). Signature validity rides the batch
+    verifier; power tally over the trusted set."""
+    from ..crypto import batch
+    from ..types.basic import VOTE_TYPE_PRECOMMIT
+
+    commit = signed_header.commit
+    bv = batch.new_batch_verifier()
+    entries = []
+    for precommit in commit.precommits:
+        if precommit is None:
+            continue
+        if precommit.type != VOTE_TYPE_PRECOMMIT:
+            raise ErrLiteVerification("commit contains non-precommit")
+        idx, val = vals.get_by_address(precommit.validator_address)
+        if val is None:
+            continue  # signer not in our trusted set
+        bv.add(precommit.sign_bytes(chain_id), precommit.signature,
+               val.pub_key.bytes())
+        entries.append((precommit, val))
+    mask = bv.verify()
+    tallied = 0
+    for ok, (precommit, val) in zip(mask, entries):
+        if not ok:
+            raise ErrLiteVerification(
+                f"invalid signature from {val.address.hex()[:12]}")
+        if precommit.block_id == commit.block_id:
+            tallied += val.voting_power
+    total = vals.total_voting_power()
+    if tallied * trust_fraction_den <= total * trust_fraction_num:
+        raise ErrLiteVerification(
+            f"too little trusted power signed: {tallied}/{total}")
+
+
+class BaseVerifier:
+    """lite/base_verifier.go:14-73."""
+
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet):
+        self.chain_id = chain_id
+        self.height = height
+        self.valset = valset
+
+    def verify(self, signed_header: SignedHeader) -> None:
+        """Certify: right chain, known valset hash, +2/3 signed."""
+        signed_header.validate_basic(self.chain_id)
+        if signed_header.height < self.height:
+            raise ErrLiteVerification(
+                f"header height {signed_header.height} < verifier base "
+                f"height {self.height}")
+        if signed_header.header.validators_hash != self.valset.hash():
+            raise ErrUnknownValidators(
+                f"unknown validators at height {signed_header.height}")
+        try:
+            self.valset.verify_commit(
+                self.chain_id,
+                signed_header.commit.block_id,
+                signed_header.height,
+                signed_header.commit,
+            )
+        except ErrInvalidCommit as e:
+            raise ErrLiteVerification(str(e))
+
+
+class DynamicVerifier:
+    """lite/dynamic_verifier.go:21-68.
+
+    source: Provider serving FullCommits (usually RPCProvider).
+    trusted: Provider caching verified FullCommits (usually DBProvider).
+    """
+
+    def __init__(self, chain_id: str, trusted, source):
+        self.chain_id = chain_id
+        self.trusted = trusted
+        self.source = source
+
+    def init_trust(self, full_commit: FullCommit) -> None:
+        """Seed the trusted store (the social-consensus root of trust)."""
+        full_commit.validate_full(self.chain_id)
+        self.trusted.save_full_commit(full_commit)
+
+    def verify(self, signed_header: SignedHeader) -> None:
+        """dynamic_verifier.go Verify:74-120."""
+        h = signed_header.height
+        trusted_fc = self.trusted.latest_full_commit(self.chain_id, h)
+        if trusted_fc is None:
+            raise ErrLiteVerification("no trusted full commit; call "
+                                      "init_trust first")
+        if trusted_fc.height == h:
+            vals = trusted_fc.validators
+        elif (trusted_fc.next_validators is not None
+              and trusted_fc.next_validators.hash()
+              == signed_header.header.validators_hash):
+            # immediately-next height: next valset is already proven
+            vals = trusted_fc.next_validators
+        else:
+            self._update_to_height(h, signed_header)
+            trusted_fc = self.trusted.latest_full_commit(self.chain_id, h)
+            if (trusted_fc.height == h):
+                vals = trusted_fc.validators
+            elif (trusted_fc.next_validators is not None
+                  and trusted_fc.next_validators.hash()
+                  == signed_header.header.validators_hash):
+                vals = trusted_fc.next_validators
+            else:
+                raise ErrUnknownValidators(
+                    f"cannot establish validators for height {h}")
+        BaseVerifier(self.chain_id, h, vals).verify(signed_header)
+
+    def _update_to_height(self, h: int,
+                          signed_header: SignedHeader) -> None:
+        """Bisection walk (dynamic_verifier.go:195-255): fetch the
+        source FullCommit at h; if its valset is unknown, recursively
+        trust an intermediate height, then verify forward."""
+        source_fc = self.source.latest_full_commit(self.chain_id, h)
+        if source_fc is None:
+            raise ErrLiteVerification(f"source has no commit ≤ {h}")
+        source_fc.validate_full(self.chain_id)
+        self._verify_and_save(source_fc)
+        if source_fc.height < h and signed_header is not None:
+            # source is behind the target: nothing more we can do
+            if (source_fc.next_validators is None
+                    or source_fc.next_validators.hash()
+                    != signed_header.header.validators_hash):
+                raise ErrUnknownValidators(
+                    f"source commit height {source_fc.height} cannot "
+                    f"prove validators at {h}")
+
+    def _verify_and_save(self, source_fc: FullCommit) -> None:
+        """Try to verify source_fc against what we trust; on unknown
+        validators, bisect the height range (dynamic_verifier.go:
+        verifyAndSave + updateToHeight recursion)."""
+        trusted_fc = self.trusted.latest_full_commit(
+            self.chain_id, source_fc.height)
+        if trusted_fc is None:
+            raise ErrLiteVerification("no trusted root")
+        if trusted_fc.height == source_fc.height:
+            return  # already trusted
+        try:
+            # can our trusted valset vouch for this header directly?
+            if (trusted_fc.next_validators is not None
+                    and trusted_fc.next_validators.hash()
+                    == source_fc.signed_header.header.validators_hash):
+                BaseVerifier(
+                    self.chain_id, source_fc.height,
+                    trusted_fc.next_validators,
+                ).verify(source_fc.signed_header)
+            else:
+                # valset changed: accept if +1/3 of trusted signed
+                _verify_commit_trusting(
+                    trusted_fc.next_validators or trusted_fc.validators,
+                    self.chain_id, source_fc.signed_header)
+                source_fc.validate_full(self.chain_id)
+            self.trusted.save_full_commit(source_fc)
+            return
+        except ErrLiteVerification:
+            pass
+        # bisect: trust the midpoint first, then retry
+        mid = (trusted_fc.height + source_fc.height) // 2
+        if mid in (trusted_fc.height, source_fc.height):
+            raise ErrLiteVerification(
+                f"bisection exhausted between {trusted_fc.height} and "
+                f"{source_fc.height}")
+        mid_fc = self.source.latest_full_commit(self.chain_id, mid)
+        if mid_fc is None or mid_fc.height <= trusted_fc.height:
+            raise ErrLiteVerification(f"source has no commit near {mid}")
+        mid_fc.validate_full(self.chain_id)
+        self._verify_and_save(mid_fc)
+        self._verify_and_save(source_fc)

@@ -98,6 +98,23 @@ class Mempool:
         self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
         self._txs_available_cbs: List[Callable[[], None]] = []
         self._cond = threading.Condition(self._lock)
+        self._wal = None
+
+    # --- WAL (reference mempool/mempool.go:221-258 InitWAL) -----------------
+
+    def init_wal(self, path: str) -> None:
+        """Append-only log of every tx admitted to the pool, for
+        post-crash inspection (the reference never replays it either)."""
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._wal = open(path, "ab")
+
+    def close_wal(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
 
     # --- basic accessors ----------------------------------------------------
 
@@ -162,6 +179,10 @@ class Mempool:
                     raise ErrPreCheck(str(e))
             if not self.cache.push(tx):
                 raise ErrTxInCache("tx already exists in cache")
+
+            if self._wal is not None:
+                self._wal.write(tx + b"\n")
+                self._wal.flush()
 
             res = self.proxy_app.check_tx(tx)
             if self.post_check is not None:

@@ -1,0 +1,3 @@
+from .node import Node, default_new_node, db_provider
+
+__all__ = ["Node", "default_new_node", "db_provider"]

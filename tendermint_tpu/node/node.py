@@ -1,0 +1,342 @@
+"""Node — the composition root.
+
+Reference parity: node/node.go. `NewNode` (node/node.go:152-501) wires
+DBs, state, the proxy app + ABCI handshake, mempool/evidence/consensus/
+blockchain reactors, the p2p switch, event bus and tx indexer;
+`OnStart` (node/node.go:504-562) brings up the event bus, RPC, the
+transport listener, the switch (all reactors), and dials persistent
+peers. `DefaultNewNode` (node/node.go:83) loads node key + file priv
+validator from the config root.
+
+TPU-first notes: the hot verification path (vote/commit Ed25519) runs
+through the pluggable crypto BatchVerifier configured process-wide
+(crypto/batch.py); the node itself is plain host-side composition and
+stays framework-agnostic.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+from .. import config as cfg
+from .. import state as sm
+from ..blockchain.reactor import BlockchainReactor
+from ..blockchain.store import BlockStore
+from ..consensus import ConsensusState
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import Handshaker
+from ..consensus.wal import WAL
+from ..evidence.pool import EvidencePool
+from ..evidence.reactor import EvidenceReactor
+from ..evidence.store import EvidenceStore
+from ..libs.db import DB, FileDB, MemDB
+from ..mempool import Mempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p import (
+    MConnConfig,
+    MultiplexTransport,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Switch,
+)
+from ..privval import FilePV, load_or_gen_file_pv
+from ..proxy import AppConns, default_client_creator
+from ..state.txindex import IndexerService, KVTxIndexer, NullTxIndexer
+from ..types import GenesisDoc
+from ..types.event_bus import EventBus
+
+LOG = logging.getLogger("node")
+
+# p2p channel ids advertised in NodeInfo (reference node/node.go:795-800);
+# the PEX channel 0x00 is appended only when PEX is enabled
+NODE_CHANNELS = bytes([0x40, 0x20, 0x21, 0x22, 0x23, 0x30, 0x38])
+
+
+def db_provider(name: str, backend: str, db_dir: str) -> DB:
+    """DBProvider (reference node/node.go:60-66): one KV store per
+    subsystem (blockstore / state / evidence / tx_index)."""
+    if backend == "memdb":
+        return MemDB()
+    if backend == "native":
+        from ..libs.nativedb import NativeDB
+
+        return NativeDB(os.path.join(db_dir, name + ".ndb"))
+    return FileDB(os.path.join(db_dir, name + ".db"))
+
+
+def _split_addr(laddr: str) -> str:
+    """tcp://host:port -> host:port"""
+    return laddr.split("://", 1)[-1]
+
+
+class Node:
+    """A full Tendermint node (reference node/node.go:118-150 struct)."""
+
+    def __init__(
+        self,
+        config: cfg.Config,
+        priv_validator: FilePV,
+        node_key: NodeKey,
+        client_creator: Callable,
+        genesis_doc: GenesisDoc,
+    ):
+        self.config = config
+        self.genesis_doc = genesis_doc
+        self.priv_validator = priv_validator
+        self.node_key = node_key
+
+        root = config.root_dir
+        db_dir = config.base.db_path()
+        backend = config.base.db_backend
+        if backend != "memdb":
+            os.makedirs(db_dir, exist_ok=True)
+
+        # --- storage (node/node.go:162-171) --------------------------
+        self.block_store_db = db_provider("blockstore", backend, db_dir)
+        self.state_db = db_provider("state", backend, db_dir)
+        self.block_store = BlockStore(self.block_store_db)
+
+        state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
+
+        # --- proxy app + handshake (node/node.go:193-206) ------------
+        self.proxy_app = AppConns(client_creator)
+        self.proxy_app.start()
+        self.event_bus = EventBus()
+        handshaker = Handshaker(
+            self.state_db, state, self.block_store, genesis_doc, self.event_bus
+        )
+        handshaker.handshake(self.proxy_app)
+        # reload: handshake may have advanced state via replay
+        state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
+
+        # fast-sync only makes sense with peers to sync from; a sole
+        # validator skips it (reference node/node.go:240-246)
+        fast_sync = config.base.fast_sync
+        if len(state.validators) == 1 and priv_validator is not None:
+            addr = priv_validator.get_address()
+            if state.validators.has_address(addr):
+                fast_sync = False
+
+        # --- mempool (node/node.go:255-271) --------------------------
+        self.mempool = Mempool(
+            config.mempool,
+            self.proxy_app.mempool,
+            height=state.last_block_height,
+        )
+        if config.mempool.wal_path:
+            self.mempool.init_wal(os.path.join(root, config.mempool.wal_path))
+        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+
+        # --- evidence (node/node.go:273-291) -------------------------
+        self.evidence_db = db_provider("evidence", backend, db_dir)
+        evidence_store = EvidenceStore(self.evidence_db)
+        self.evidence_pool = EvidencePool(
+            evidence_store,
+            state,
+            load_validators=lambda h: sm.load_validators(self.state_db, h),
+        )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        # --- block executor + blockchain reactor (node/node.go:293-307)
+        self.block_exec = sm.BlockExecutor(
+            self.state_db,
+            self.proxy_app.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+        )
+
+        # --- consensus (node/node.go:309-326) ------------------------
+        wal = None
+        if config.consensus.wal_path:
+            wal_path = config.consensus.wal_file(root)
+            os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+            wal = WAL(wal_path)
+        self.consensus_state = ConsensusState(
+            config.consensus,
+            state,
+            self.block_exec,
+            self.block_store,
+            mempool=self.mempool,
+            evpool=self.evidence_pool,
+            event_bus=self.event_bus,
+            priv_validator=priv_validator,
+            wal=wal,
+        )
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, fast_sync=fast_sync
+        )
+        self.blockchain_reactor = BlockchainReactor(
+            state,
+            self.block_exec,
+            self.block_store,
+            fast_sync,
+            consensus_reactor=self.consensus_reactor,
+        )
+
+        # --- tx indexer (node/node.go:329-349) -----------------------
+        if config.tx_index.indexer == "kv":
+            self.tx_index_db = db_provider("tx_index", backend, db_dir)
+            tags = [
+                t.strip()
+                for t in config.tx_index.index_tags.split(",")
+                if t.strip()
+            ]
+            self.tx_indexer = KVTxIndexer(
+                self.tx_index_db,
+                index_tags=tags,
+                index_all_tags=config.tx_index.index_all_tags,
+            )
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+
+        # --- p2p (node/node.go:366-464) ------------------------------
+        channels = NODE_CHANNELS + (b"\x00" if config.p2p.pex else b"")
+        node_info = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            id=node_key.id,
+            listen_addr=_split_addr(config.p2p.laddr),
+            network=genesis_doc.chain_id,
+            version="tendermint-tpu",
+            channels=channels,
+            moniker=config.base.moniker,
+        )
+        mconfig = MConnConfig(
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+            max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+            flush_throttle=config.p2p.flush_throttle_timeout,
+        )
+        self.transport = MultiplexTransport(node_info, node_key)
+        self.sw = Switch(
+            self.transport,
+            mconfig=mconfig,
+            max_inbound=config.p2p.max_num_inbound_peers,
+            max_outbound=config.p2p.max_num_outbound_peers,
+        )
+        self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
+        self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.sw.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        # PEX reactor + address book (node/node.go:417-464)
+        self.pex_reactor = None
+        self.addr_book = None
+        if config.p2p.pex:
+            from ..p2p.pex import AddrBook, PEXReactor
+
+            addr_book_path = os.path.join(root, config.p2p.addr_book_file)
+            os.makedirs(os.path.dirname(addr_book_path) or ".", exist_ok=True)
+            self.addr_book = AddrBook(
+                addr_book_path, strict=config.p2p.addr_book_strict
+            )
+            self.addr_book.add_our_address(node_info.listen_addr, node_key.id)
+            seeds = [s.strip() for s in config.p2p.seeds.split(",") if s.strip()]
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                seeds=seeds,
+                seed_mode=config.p2p.seed_mode,
+            )
+            self.sw.add_reactor("PEX", self.pex_reactor)
+
+        self._rpc_server = None
+        self._grpc_server = None
+        self._prof_server = None
+        self._running = False
+        self._stopped = threading.Event()
+
+    # --- lifecycle (node/node.go:504-607) ----------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._stopped.clear()
+        self.event_bus.start()
+        self.indexer_service.start()
+
+        if self.config.rpc.laddr:
+            self._start_rpc()
+        if self.config.base.prof_laddr:
+            self._start_prof()
+
+        laddr = _split_addr(self.config.p2p.laddr)
+        self.transport.listen(laddr)
+        # rewrite advertised addr with the bound port (useful for :0)
+        self.transport.node_info.listen_addr = self.transport.listen_addr
+        self.sw.start()
+
+        peers = [
+            p.strip()
+            for p in self.config.p2p.persistent_peers.split(",")
+            if p.strip()
+        ]
+        if peers:
+            self.sw.dial_peers_async(peers, persistent=True)
+
+    def _start_rpc(self) -> None:
+        from ..rpc.core import RPCEnvironment
+        from ..rpc.server import RPCServer
+
+        env = RPCEnvironment(self)
+        addr = _split_addr(self.config.rpc.laddr)
+        host, _, port = addr.rpartition(":")
+        host = host or "127.0.0.1"
+        self._rpc_server = RPCServer(
+            env, host, int(port), unsafe=self.config.rpc.unsafe
+        )
+        self._rpc_server.start()
+        if self.config.rpc.grpc_laddr:
+            from ..rpc.grpc_api import BroadcastAPIServer
+
+            gaddr = _split_addr(self.config.rpc.grpc_laddr)
+            ghost, _, gport = gaddr.rpartition(":")
+            self._grpc_server = BroadcastAPIServer(env, ghost or "127.0.0.1", int(gport))
+            self._grpc_server.start()
+
+    def _start_prof(self) -> None:
+        """pprof-equivalent profile endpoint (reference node/node.go:468-474)."""
+        from ..rpc.prof import ProfServer
+
+        addr = _split_addr(self.config.base.prof_laddr)
+        host, _, port = addr.rpartition(":")
+        self._prof_server = ProfServer(host or "127.0.0.1", int(port))
+        self._prof_server.start()
+
+    @property
+    def rpc_listen_addr(self) -> Optional[str]:
+        return self._rpc_server.listen_addr if self._rpc_server else None
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        for srv in (self._rpc_server, self._grpc_server, self._prof_server):
+            if srv is not None:
+                srv.stop()
+        self.sw.stop()
+        if self.addr_book is not None:
+            self.addr_book.save()
+        self.indexer_service.stop()
+        self.event_bus.stop()
+        self.mempool.close_wal()
+        self.proxy_app.stop()
+        self._stopped.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until stop() completes (reference node runner blocks)."""
+        self._stopped.wait(timeout)
+
+
+def default_new_node(config: cfg.Config) -> Node:
+    """Load node key, priv validator and genesis from the config root
+    and construct a Node (reference node/node.go:83-98)."""
+    cfg.ensure_root(config.root_dir)
+    node_key = NodeKey.load_or_gen(config.base.node_key_path())
+    pv = load_or_gen_file_pv(config.base.priv_validator_path())
+    genesis_doc = GenesisDoc.load(config.base.genesis_path())
+    creator = default_client_creator(config.base.proxy_app)
+    return Node(config, pv, node_key, creator, genesis_doc)

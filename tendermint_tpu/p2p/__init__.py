@@ -9,6 +9,7 @@ validator, in the crypto.jaxed25519 batch-verify engine.
 """
 
 from .base_reactor import ChannelDescriptor, Reactor  # noqa: F401
+from .conn.connection import MConnConfig  # noqa: F401
 from .key import NodeKey, node_id  # noqa: F401
 from .node_info import NodeInfo, ProtocolVersion  # noqa: F401
 from .peer import Peer, PeerSet  # noqa: F401

@@ -1,0 +1,407 @@
+"""PEX — peer exchange reactor + address book.
+
+Reference parity: p2p/pex/pex_reactor.go (PEXReactor: channel 0x00,
+request/addrs messages, ensurePeers routine, seed-mode crawling) and
+p2p/pex/addrbook.go (bucketed new/old address book with biased random
+selection and JSON persistence).
+
+The book keeps two tiers: "new" (heard about, never connected) and
+"old" (we connected at least once — markGood promotes). Buckets are
+hash-partitioned like the reference (addrbook.go bucket math) but the
+bucket count is small since the semantics — bounded memory, eviction
+within a bucket, spread across sources — is what matters, not bitcoin's
+exact constants.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..types import serde
+from .base_reactor import ChannelDescriptor, Reactor
+
+LOG = logging.getLogger("p2p.pex")
+
+PEX_CHANNEL = 0x00
+
+# reference pex_reactor.go:33-44
+DEFAULT_ENSURE_PEERS_PERIOD = 30.0
+MIN_RECEIVE_REQUEST_INTERVAL = 60.0  # per-peer request rate limit
+MAX_MSG_COUNT_BY_PEER = 1000
+
+NEW_BUCKET_COUNT = 64
+OLD_BUCKET_COUNT = 16
+BUCKET_SIZE = 64
+MAX_GET_SELECTION = 250  # addrbook.go getSelection cap
+BIAS_TO_SELECT_NEW_PEERS = 30  # pex_reactor.go:289
+
+
+def parse_net_address(s: str):
+    """'id@host:port' -> (id, 'host:port'); bare 'host:port' -> ('', ...)."""
+    if "@" in s:
+        nid, _, hp = s.partition("@")
+        return nid.lower(), hp
+    return "", s
+
+
+@dataclass
+class KnownAddress:
+    """addrbook.go knownAddress"""
+
+    id: str
+    addr: str  # host:port
+    src: str  # id of the peer that told us
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    bucket_type: str = "new"  # new | old
+    buckets: List[int] = field(default_factory=list)
+
+    @property
+    def net_addr(self) -> str:
+        return f"{self.id}@{self.addr}" if self.id else self.addr
+
+    def is_bad(self, now: float) -> bool:
+        """addrbook.go isBad: too many failed attempts and stale."""
+        if self.last_attempt == 0:
+            return False
+        if self.attempts >= 3 and self.last_success == 0:
+            return True
+        return self.attempts >= 10 and (now - self.last_success) > 7 * 86400
+
+
+class AddrBook:
+    """Bucketed address book (reference p2p/pex/addrbook.go:57-120)."""
+
+    def __init__(self, file_path: Optional[str] = None, strict: bool = True):
+        self.file_path = file_path
+        self.strict = strict
+        self._lock = threading.RLock()
+        self._addrs: Dict[str, KnownAddress] = {}  # by node id
+        self._our_ids: Set[str] = set()
+        self._our_addrs: Set[str] = set()
+        self._rand = random.Random()
+        if file_path and os.path.exists(file_path):
+            self.load(file_path)
+
+    # -- identity ------------------------------------------------------
+
+    def add_our_address(self, addr: str, node_id: str) -> None:
+        with self._lock:
+            self._our_ids.add(node_id.lower())
+            self._our_addrs.add(addr)
+
+    def is_our_address(self, nid: str, addr: str) -> bool:
+        return nid.lower() in self._our_ids or addr in self._our_addrs
+
+    # -- bucket math (addrbook.go calcNewBucket/calcOldBucket) ---------
+
+    def _bucket_of(self, ka: KnownAddress) -> int:
+        n = NEW_BUCKET_COUNT if ka.bucket_type == "new" else OLD_BUCKET_COUNT
+        h = hashlib.sha256((ka.bucket_type + ka.src + ka.addr).encode()).digest()
+        return int.from_bytes(h[:4], "big") % n
+
+    # -- mutation ------------------------------------------------------
+
+    @staticmethod
+    def _key(nid: str, addr: str) -> str:
+        """Book key: node id when known, else the bare address (so a
+        non-strict book can hold many id-less addresses distinctly)."""
+        return nid or addr
+
+    def add_address(self, addr_str: str, src_id: str = "") -> bool:
+        """addrbook.go AddAddress: record a heard-about address into a
+        'new' bucket. Returns False for self/invalid/duplicate-in-old."""
+        nid, addr = parse_net_address(addr_str)
+        if (not nid or ":" not in addr) and self.strict:
+            return False
+        with self._lock:
+            if self.is_our_address(nid, addr):
+                return False
+            ka = self._addrs.get(self._key(nid, addr))
+            if ka is not None:
+                if ka.bucket_type == "old":
+                    return False  # already vetted; keep old entry
+                ka.addr = addr  # refresh
+                return True
+            # evict a random bad address if a bucket would overflow
+            news = [a for a in self._addrs.values() if a.bucket_type == "new"]
+            if len(news) >= NEW_BUCKET_COUNT * BUCKET_SIZE:
+                now = time.time()
+                bad = [a for a in news if a.is_bad(now)] or news
+                victim = self._rand.choice(bad)
+                del self._addrs[self._key(victim.id, victim.addr)]
+            self._addrs[self._key(nid, addr)] = KnownAddress(
+                id=nid, addr=addr, src=src_id or nid or addr
+            )
+            return True
+
+    def remove_address(self, addr_str: str) -> None:
+        nid, addr = parse_net_address(addr_str)
+        with self._lock:
+            self._addrs.pop(self._key(nid, addr), None)
+
+    def mark_attempt(self, addr_str: str) -> None:
+        nid, addr = parse_net_address(addr_str)
+        with self._lock:
+            ka = self._addrs.get(self._key(nid, addr))
+            if ka:
+                ka.attempts += 1
+                ka.last_attempt = time.time()
+
+    def mark_good(self, addr_str: str) -> None:
+        """Promote new → old on successful connect (addrbook.go MarkGood)."""
+        nid, addr = parse_net_address(addr_str)
+        with self._lock:
+            ka = self._addrs.get(self._key(nid, addr))
+            if ka is None:
+                ka = KnownAddress(id=nid, addr=addr, src=nid or addr)
+                self._addrs[self._key(nid, addr)] = ka
+            ka.attempts = 0
+            ka.last_success = time.time()
+            ka.bucket_type = "old"
+
+    def mark_bad(self, addr_str: str) -> None:
+        self.remove_address(addr_str)
+
+    # -- queries -------------------------------------------------------
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._addrs)
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def need_more_addrs(self) -> bool:
+        return self.size() < 1000  # addrbook.go needAddressThreshold
+
+    def has_address(self, addr_str: str) -> bool:
+        nid, addr = parse_net_address(addr_str)
+        with self._lock:
+            return self._key(nid, addr) in self._addrs
+
+    def pick_address(self, bias_new_pct: int) -> Optional[str]:
+        """Biased random pick (addrbook.go PickAddress): bias% chance of
+        a 'new' address, else 'old' (falling back across tiers)."""
+        with self._lock:
+            if not self._addrs:
+                return None
+            news = [a for a in self._addrs.values() if a.bucket_type == "new"]
+            olds = [a for a in self._addrs.values() if a.bucket_type == "old"]
+            pool = news if (self._rand.randint(0, 99) < bias_new_pct) else olds
+            pool = pool or news or olds
+            return self._rand.choice(pool).net_addr if pool else None
+
+    def get_selection(self) -> List[str]:
+        """Random subset for a PEX response (addrbook.go GetSelection:
+        max 250 or 23% of book)."""
+        with self._lock:
+            if not self._addrs:
+                return []
+            n = max(min(len(self._addrs), MAX_GET_SELECTION),
+                    (len(self._addrs) * 23) // 100)
+            n = min(n, len(self._addrs), MAX_GET_SELECTION)
+            picked = self._rand.sample(list(self._addrs.values()), n)
+            return [a.net_addr for a in picked]
+
+    def our_addresses(self) -> List[str]:
+        with self._lock:
+            return sorted(self._our_addrs)
+
+    # -- persistence (addrbook.go saveToFile/loadFromFile) -------------
+
+    def save(self, path: Optional[str] = None) -> None:
+        path = path or self.file_path
+        if not path:
+            return
+        with self._lock:
+            out = {
+                "addrs": [
+                    {
+                        "id": a.id,
+                        "addr": a.addr,
+                        "src": a.src,
+                        "attempts": a.attempts,
+                        "last_attempt": a.last_attempt,
+                        "last_success": a.last_success,
+                        "bucket_type": a.bucket_type,
+                    }
+                    for a in self._addrs.values()
+                ]
+            }
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        with open(path) as f:
+            data = json.load(f)
+        with self._lock:
+            for o in data.get("addrs", []):
+                self._addrs[self._key(o["id"], o["addr"])] = KnownAddress(
+                    id=o["id"],
+                    addr=o["addr"],
+                    src=o.get("src", o["id"]),
+                    attempts=o.get("attempts", 0),
+                    last_attempt=o.get("last_attempt", 0.0),
+                    last_success=o.get("last_success", 0.0),
+                    bucket_type=o.get("bucket_type", "new"),
+                )
+
+
+class PEXReactor(Reactor):
+    """Peer-exchange reactor on channel 0x00 (pex_reactor.go:46-96).
+
+    Normal mode: asks outbound peers for addresses, answers requests
+    from its book, and runs ensurePeers to keep outbound slots full.
+    Seed mode: answers requests then disconnects (crawler-lite)."""
+
+    def __init__(
+        self,
+        book: AddrBook,
+        seeds: Optional[List[str]] = None,
+        seed_mode: bool = False,
+        ensure_peers_period: float = DEFAULT_ENSURE_PEERS_PERIOD,
+    ):
+        super().__init__("PEXReactor")
+        self.book = book
+        self.seeds = seeds or []
+        self.seed_mode = seed_mode
+        self.ensure_peers_period = ensure_peers_period
+        self._last_request_from: Dict[str, float] = {}
+        self._requested: Set[str] = set()  # peers we asked (awaiting addrs)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10)]
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.book.save()
+
+    # -- reactor hooks -------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        """pex_reactor.go:133-150"""
+        if peer.outbound:
+            self.book.mark_good(f"{peer.id}@{peer.socket_addr}")
+            if self.book.need_more_addrs():
+                self._request_addrs(peer)
+        else:
+            # record the inbound peer's self-reported listen addr
+            la = peer.node_info.listen_addr
+            if la:
+                self.book.add_address(f"{peer.id}@{la}", src_id=peer.id)
+
+    def remove_peer(self, peer, reason) -> None:
+        self._requested.discard(peer.id)
+        self._last_request_from.pop(peer.id, None)
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        """pex_reactor.go:152-201"""
+        obj = serde.unpack(msg_bytes)
+        if not (isinstance(obj, (list, tuple)) and obj):
+            raise ValueError("bad pex message")
+        kind = obj[0]
+        if kind == "pex_request":
+            now = time.time()
+            last = self._last_request_from.get(peer.id, 0.0)
+            if not self.seed_mode and now - last < MIN_RECEIVE_REQUEST_INTERVAL:
+                raise ValueError(
+                    f"peer {peer.id[:8]} sent PEX requests too often"
+                )
+            self._last_request_from[peer.id] = now
+            addrs = self.book.get_selection()
+            peer.send(PEX_CHANNEL, serde.pack(["pex_addrs", addrs]))
+            if self.seed_mode and not peer.outbound:
+                # seeds serve the book then hang up (pex_reactor.go:176)
+                threading.Timer(
+                    0.5, lambda: self.switch.stop_peer_gracefully(peer)
+                ).start()
+        elif kind == "pex_addrs":
+            if peer.id not in self._requested:
+                raise ValueError(
+                    f"unsolicited pex_addrs from {peer.id[:8]}"
+                )
+            self._requested.discard(peer.id)
+            for a in obj[1]:
+                self.book.add_address(str(a), src_id=peer.id)
+        else:
+            raise ValueError(f"unknown pex message {kind!r}")
+
+    def _request_addrs(self, peer) -> None:
+        if peer.id in self._requested:
+            return
+        self._requested.add(peer.id)
+        peer.try_send(PEX_CHANNEL, serde.pack(["pex_request"]))
+
+    # -- ensure-peers (pex_reactor.go:257-336) -------------------------
+
+    def _ensure_peers_routine(self) -> None:
+        # jittered first run so simultaneous starts don't thundering-herd
+        self._stop.wait(random.random() * min(3.0, self.ensure_peers_period))
+        while not self._stop.is_set():
+            self._ensure_peers()
+            self._stop.wait(self.ensure_peers_period)
+
+    def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None:
+            return
+        out = sum(1 for p in sw.peers.list() if p.outbound)
+        need = sw.max_outbound - out
+        if need <= 0:
+            return
+        connected = {p.id for p in sw.peers.list()}
+        tried: Set[str] = set()
+        for _ in range(need * 3):
+            pick = self.book.pick_address(BIAS_TO_SELECT_NEW_PEERS)
+            if pick is None:
+                break
+            nid, addr = parse_net_address(pick)
+            if nid in connected or pick in tried or nid in self.book._our_ids:
+                tried.add(pick)
+                continue
+            tried.add(pick)
+            self.book.mark_attempt(pick)
+            try:
+                if sw.dial_peer(addr, expect_id=nid) is not None:
+                    self.book.mark_good(pick)
+                    need -= 1
+            except Exception as e:  # noqa: BLE001 - dial errors are routine
+                LOG.debug("pex dial %s failed: %s", pick, e)
+            if need <= 0:
+                return
+        # book exhausted: ask a connected peer, else dial seeds
+        peers = sw.peers.list()
+        if self.book.need_more_addrs() and peers:
+            self._request_addrs(random.choice(peers))
+        if not peers and self.seeds:
+            seed = random.choice(self.seeds)
+            nid, addr = parse_net_address(seed)
+            try:
+                sw.dial_peer(addr, expect_id=nid)
+            except Exception as e:  # noqa: BLE001
+                LOG.debug("seed dial %s failed: %s", seed, e)

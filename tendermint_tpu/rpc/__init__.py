@@ -1,0 +1,11 @@
+"""rpc — JSON-RPC 2.0 API over HTTP + websocket (reference rpc/).
+
+rpc/lib equivalent: jsonrpc.py (framing) + server.py (HTTP POST, GET
+URI, and websocket handlers on one port). rpc/core equivalent: core.py
+(the route table + handlers, env-injected like rpc/core/pipe.go).
+Clients in client.py.
+"""
+
+from .client import HTTPClient  # noqa: F401
+from .core import RPCEnvironment, ROUTES  # noqa: F401
+from .server import RPCServer  # noqa: F401

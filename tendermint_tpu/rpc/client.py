@@ -1,0 +1,278 @@
+"""RPC clients (reference rpc/client/httpclient.go + lib/client/).
+
+HTTPClient: JSON-RPC over HTTP POST via urllib (stdlib; zero deps).
+WSClient: thread-driven websocket client for subscriptions — the
+transport tm-bench/tm-monitor equivalents use.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional
+from urllib.request import Request, urlopen
+
+from . import jsonrpc
+from .jsonrpc import RPCError
+from .server import WS_GUID
+
+
+class HTTPClient:
+    """JSON-RPC over HTTP POST (rpc/lib/client/httpclient.go)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        # accept "host:port", "tcp://host:port" or full http URL
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        if not addr.startswith("http"):
+            addr = "http://" + addr
+        self.url = addr
+        self.timeout = timeout
+        self._id = 0
+        self._lock = threading.Lock()
+
+    def call(self, method: str, params: Optional[dict] = None) -> Any:
+        with self._lock:
+            self._id += 1
+            id_ = self._id
+        body = jsonrpc.dumps(jsonrpc.request(id_, method, params))
+        req = Request(self.url, data=body,
+                      headers={"Content-Type": "application/json"})
+        with urlopen(req, timeout=self.timeout) as resp:
+            out = jsonrpc.loads(resp.read())
+        if "error" in out and out["error"]:
+            e = out["error"]
+            raise RPCError(e.get("code", -1), e.get("message", ""),
+                           e.get("data"))
+        return out.get("result")
+
+    # -- convenience wrappers (rpc/client/httpclient.go methods) -------
+
+    def status(self):
+        return self.call("status")
+
+    def net_info(self):
+        return self.call("net_info")
+
+    def genesis(self):
+        return self.call("genesis")
+
+    def health(self):
+        return self.call("health")
+
+    def block(self, height: Optional[int] = None):
+        return self.call("block", {"height": height} if height else {})
+
+    def block_results(self, height: Optional[int] = None):
+        return self.call("block_results",
+                         {"height": height} if height else {})
+
+    def blockchain(self, min_height: int = 0, max_height: int = 0):
+        return self.call("blockchain", {"minHeight": min_height,
+                                        "maxHeight": max_height})
+
+    def commit(self, height: Optional[int] = None):
+        return self.call("commit", {"height": height} if height else {})
+
+    def validators(self, height: Optional[int] = None):
+        return self.call("validators", {"height": height} if height else {})
+
+    def abci_info(self):
+        return self.call("abci_info")
+
+    def abci_query(self, path: str, data: bytes, height: int = 0,
+                   prove: bool = False):
+        return self.call("abci_query", {
+            "path": path, "data": data.hex(), "height": height,
+            "prove": prove,
+        })
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self.call("broadcast_tx_async",
+                         {"tx": base64.b64encode(tx).decode()})
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self.call("broadcast_tx_sync",
+                         {"tx": base64.b64encode(tx).decode()})
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self.call("broadcast_tx_commit",
+                         {"tx": base64.b64encode(tx).decode()})
+
+    def tx(self, hash_: bytes):
+        return self.call("tx", {"hash": hash_.hex()})
+
+    def tx_search(self, query: str, page: int = 1, per_page: int = 30):
+        return self.call("tx_search", {"query": query, "page": page,
+                                       "per_page": per_page})
+
+    def unconfirmed_txs(self, limit: int = 30):
+        return self.call("unconfirmed_txs", {"limit": limit})
+
+    def num_unconfirmed_txs(self):
+        return self.call("num_unconfirmed_txs")
+
+    def consensus_state(self):
+        return self.call("consensus_state")
+
+    def dump_consensus_state(self):
+        return self.call("dump_consensus_state")
+
+
+class WSClient:
+    """Minimal websocket JSON-RPC client (rpc/lib/client/ws_client.go).
+
+    Responses and event notifications are delivered on an internal
+    queue (or a callback); the caller drives subscribe()/call()."""
+
+    def __init__(self, addr: str,
+                 on_event: Optional[Callable[[dict], None]] = None):
+        if addr.startswith("tcp://"):
+            addr = addr[len("tcp://"):]
+        if addr.startswith("http://"):
+            addr = addr[len("http://"):]
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host, int(port)
+        self.on_event = on_event
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self.responses: "queue.Queue[dict]" = queue.Queue()
+        self._sock: Optional[socket.socket] = None
+        self._id = 0
+        self._send_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def connect(self, timeout: float = 10.0) -> None:
+        self._sock = socket.create_connection((self.host, self.port),
+                                              timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET /websocket HTTP/1.1\r\nHost: {self.host}:{self.port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self._sock.sendall(req.encode())
+        # read the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws handshake failed")
+            buf += chunk
+        status = buf.split(b"\r\n", 1)[0]
+        if b"101" not in status:
+            raise ConnectionError(f"ws handshake rejected: {status!r}")
+        expect = base64.b64encode(
+            hashlib.sha1((key + WS_GUID).encode()).digest())
+        if expect not in buf:
+            raise ConnectionError("bad Sec-WebSocket-Accept")
+        self._sock.settimeout(None)
+        self._thread = threading.Thread(target=self._read_loop,
+                                        name="ws-client", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._closed.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- frame IO (client frames are masked per RFC6455) ---------------
+
+    def _send_frame(self, payload: bytes, opcode: int = 0x1) -> None:
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        header = bytes([0x80 | opcode])
+        ln = len(payload)
+        if ln < 126:
+            header += bytes([0x80 | ln])
+        elif ln < (1 << 16):
+            header += bytes([0x80 | 126]) + struct.pack(">H", ln)
+        else:
+            header += bytes([0x80 | 127]) + struct.pack(">Q", ln)
+        with self._send_lock:
+            self._sock.sendall(header + mask + masked)
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        try:
+            message = b""
+            while not self._closed.is_set():
+                hdr = self._recv_exact(2)
+                fin = hdr[0] & 0x80
+                opcode = hdr[0] & 0x0F
+                ln = hdr[1] & 0x7F
+                if ln == 126:
+                    ln = struct.unpack(">H", self._recv_exact(2))[0]
+                elif ln == 127:
+                    ln = struct.unpack(">Q", self._recv_exact(8))[0]
+                payload = self._recv_exact(ln)  # server frames unmasked
+                if opcode == 0x8:
+                    break
+                if opcode == 0x9:
+                    self._send_frame(payload, opcode=0xA)
+                    continue
+                if opcode == 0xA:
+                    continue
+                message += payload
+                if not fin:
+                    continue
+                self._handle(message)
+                message = b""
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed.set()
+
+    def _handle(self, raw: bytes) -> None:
+        try:
+            obj = jsonrpc.loads(raw)
+        except RPCError:
+            return
+        if obj.get("id") == "#event":
+            if self.on_event is not None:
+                self.on_event(obj.get("result") or {})
+            else:
+                self.events.put(obj.get("result") or {})
+        else:
+            self.responses.put(obj)
+
+    # -- calls ---------------------------------------------------------
+
+    def call(self, method: str, params: Optional[dict] = None,
+             timeout: float = 10.0) -> Any:
+        self._id += 1
+        self._send_frame(
+            jsonrpc.dumps(jsonrpc.request(self._id, method, params)))
+        resp = self.responses.get(timeout=timeout)
+        if resp.get("error"):
+            e = resp["error"]
+            raise RPCError(e.get("code", -1), e.get("message", ""))
+        return resp.get("result")
+
+    def subscribe(self, query: str, timeout: float = 10.0) -> None:
+        self.call("subscribe", {"query": query}, timeout=timeout)
+
+    def unsubscribe(self, query: str, timeout: float = 10.0) -> None:
+        self.call("unsubscribe", {"query": query}, timeout=timeout)
+
+    def next_event(self, timeout: Optional[float] = None) -> Optional[dict]:
+        try:
+            return self.events.get(timeout=timeout)
+        except queue.Empty:
+            return None

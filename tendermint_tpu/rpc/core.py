@@ -1,0 +1,579 @@
+"""RPC core — the route table + handlers (reference rpc/core/).
+
+Route parity with rpc/core/routes.go:11-52. Handlers receive their
+dependencies through RPCEnvironment (the setter-injected globals of
+rpc/core/pipe.go become one explicit env object around the Node).
+Heights/ints are rendered as strings like the reference's amino-JSON.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..abci import types as abci
+from ..libs.events import Query
+from ..state import load_abci_responses, load_validators
+from ..types.block import tx_hash as compute_tx_hash
+from ..types.event_bus import (
+    EVENT_TX,
+    TX_HASH_KEY,
+    query_for_event,
+)
+from . import encoding as enc
+from .jsonrpc import ERR_INVALID_PARAMS, ERR_SERVER, RPCError
+
+SUBSCRIBE_TIMEOUT = 10.0  # reference rpc/core/events.go subscribeTimeout
+
+
+class RPCEnvironment:
+    """All node internals the handlers need (rpc/core/pipe.go)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.config = node.config
+        self.block_store = node.block_store
+        self.state_db = node.state_db
+        self.mempool = node.mempool
+        self.evidence_pool = node.evidence_pool
+        self.consensus_state = node.consensus_state
+        self.p2p_switch = node.sw
+        self.event_bus = node.event_bus
+        self.tx_indexer = node.tx_indexer
+        self.genesis_doc = node.genesis_doc
+        self.proxy_app_query = node.proxy_app.query
+        self.pub_key = (
+            node.priv_validator.get_pub_key() if node.priv_validator else None
+        )
+
+    def latest_state(self):
+        return self.consensus_state.state
+
+
+# --- helpers ----------------------------------------------------------
+
+
+def _int(params: dict, key: str, default=None) -> Optional[int]:
+    v = params.get(key, None)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise RPCError(ERR_INVALID_PARAMS, f"bad int param {key}={v!r}")
+
+
+def _tx_param(params: dict) -> bytes:
+    tx = params.get("tx")
+    if tx is None:
+        raise RPCError(ERR_INVALID_PARAMS, "missing tx param")
+    if isinstance(tx, str):
+        return enc.unb64(tx)
+    return bytes(tx)
+
+
+def _hash_param(params: dict, key: str = "hash") -> bytes:
+    h = params.get(key)
+    if h is None:
+        raise RPCError(ERR_INVALID_PARAMS, f"missing {key} param")
+    if isinstance(h, str):
+        return bytes.fromhex(h)
+    return bytes(h)
+
+
+def _load_height(env: RPCEnvironment, params: dict) -> int:
+    """Height param defaulting to the store tip (rpc/core/blocks.go
+    getHeight)."""
+    store_h = env.block_store.height()
+    h = _int(params, "height", None)
+    if h is None or h == 0:
+        return store_h
+    if h <= 0:
+        raise RPCError(ERR_INVALID_PARAMS, "height must be greater than 0")
+    if h > store_h:
+        raise RPCError(
+            ERR_SERVER, f"height {h} must be less than or equal to the "
+            f"current blockchain height {store_h}"
+        )
+    return h
+
+
+# --- info routes (rpc/core/routes.go:14-27) ---------------------------
+
+
+def health(env: RPCEnvironment, params: dict) -> dict:
+    return {}
+
+
+def status(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/status.go Status"""
+    node_info = env.p2p_switch.node_info()
+    latest_height = env.block_store.height()
+    latest_meta = (
+        env.block_store.load_block_meta(latest_height) if latest_height else None
+    )
+    latest_hash = latest_meta.block_id.hash if latest_meta else b""
+    latest_app_hash = latest_meta.header.app_hash if latest_meta else b""
+    latest_time = latest_meta.header.time if latest_meta else 0
+    voting_power = 0
+    if env.pub_key is not None:
+        state = env.latest_state()
+        addr = env.pub_key.address()
+        if state.validators.has_address(addr):
+            voting_power = state.validators.get_by_address(addr)[1].voting_power
+    catching_up = getattr(env.node.blockchain_reactor, "fast_sync", False)
+    return {
+        "node_info": {
+            "id": node_info.id,
+            "listen_addr": node_info.listen_addr,
+            "network": node_info.network,
+            "version": node_info.version,
+            "channels": node_info.channels.hex(),
+            "moniker": node_info.moniker,
+            "protocol_version": {
+                "p2p": str(node_info.protocol_version.p2p),
+                "block": str(node_info.protocol_version.block),
+                "app": str(node_info.protocol_version.app),
+            },
+        },
+        "sync_info": {
+            "latest_block_hash": enc.hexu(latest_hash),
+            "latest_app_hash": enc.hexu(latest_app_hash),
+            "latest_block_height": str(latest_height),
+            "latest_block_time": str(latest_time),
+            "catching_up": catching_up,
+        },
+        "validator_info": {
+            "address": enc.hexu(env.pub_key.address()) if env.pub_key else "",
+            "pub_key": (
+                {"type": "ed25519", "value": enc.b64(env.pub_key.bytes())}
+                if env.pub_key
+                else None
+            ),
+            "voting_power": str(voting_power),
+        },
+    }
+
+
+def net_info(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/net.go NetInfo"""
+    peers = []
+    for p in env.p2p_switch.peers.list():
+        peers.append({
+            "node_info": {
+                "id": p.node_info.id,
+                "listen_addr": p.node_info.listen_addr,
+                "network": p.node_info.network,
+                "moniker": p.node_info.moniker,
+            },
+            "is_outbound": p.outbound,
+            "remote_ip": p.socket_addr,
+        })
+    return {
+        "listening": True,
+        "listeners": [env.p2p_switch.transport.listen_addr],
+        "n_peers": str(len(peers)),
+        "peers": peers,
+    }
+
+
+def genesis(env: RPCEnvironment, params: dict) -> dict:
+    import json
+
+    return {"genesis": json.loads(env.genesis_doc.to_json())}
+
+
+def blockchain(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/blocks.go BlockchainInfo: metas for [min,max], newest
+    first, max 20 per page."""
+    store_h = env.block_store.height()
+    min_h = _int(params, "minHeight", 1) or 1
+    max_h = _int(params, "maxHeight", store_h) or store_h
+    max_h = min(max_h, store_h) if max_h > 0 else store_h
+    min_h = max(min_h, 1)
+    min_h = max(min_h, max_h - 20 + 1)
+    if min_h > max_h:
+        raise RPCError(ERR_SERVER, f"min height {min_h} > max height {max_h}")
+    metas = []
+    for h in range(max_h, min_h - 1, -1):
+        m = env.block_store.load_block_meta(h)
+        if m is not None:
+            metas.append(enc.block_meta_json(m))
+    return {"last_height": str(store_h), "block_metas": metas}
+
+
+def block(env: RPCEnvironment, params: dict) -> dict:
+    h = _load_height(env, params)
+    meta = env.block_store.load_block_meta(h)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(ERR_SERVER, f"no block at height {h}")
+    return {
+        "block_meta": enc.block_meta_json(meta) if meta else None,
+        "block": enc.block_json(blk),
+    }
+
+
+def block_results(env: RPCEnvironment, params: dict) -> dict:
+    h = _load_height(env, params)
+    res = load_abci_responses(env.state_db, h)
+    if res is None:
+        raise RPCError(ERR_SERVER, f"no results for height {h}")
+    return {
+        "height": str(h),
+        "results": {
+            "DeliverTx": [enc.tx_response_json(r) for r in res.deliver_tx],
+            "EndBlock": {
+                "validator_updates": [],
+                "consensus_param_updates": None,
+            },
+        },
+    }
+
+
+def commit(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/blocks.go Commit: header + commit; canonical unless the
+    commit is the tip's seen-commit."""
+    h = _load_height(env, params)
+    meta = env.block_store.load_block_meta(h)
+    if meta is None:
+        raise RPCError(ERR_SERVER, f"no header at height {h}")
+    if h == env.block_store.height():
+        com = env.block_store.load_seen_commit(h)
+        canonical = False
+    else:
+        com = env.block_store.load_block_commit(h)
+        canonical = True
+    return {
+        "signed_header": {
+            "header": enc.header_json(meta.header),
+            "commit": enc.commit_json(com),
+        },
+        "canonical": canonical,
+    }
+
+
+def validators(env: RPCEnvironment, params: dict) -> dict:
+    store_h = env.block_store.height()
+    h = _int(params, "height", None)
+    if h is None or h == 0:
+        h = store_h + 1  # current validators are for next height
+        vals = env.latest_state().validators
+    else:
+        vals = load_validators(env.state_db, h)
+        if vals is None:
+            raise RPCError(ERR_SERVER, f"no validators at height {h}")
+    return {
+        "block_height": str(h),
+        "validators": [enc.validator_json(v) for v in vals.validators],
+    }
+
+
+def dump_consensus_state(env: RPCEnvironment, params: dict) -> dict:
+    rs = env.consensus_state.rs
+    peers = []
+    for p in env.p2p_switch.peers.list():
+        ps = p.get("consensus_peer_state")
+        prs = ps.get_round_state() if ps is not None else None
+        peers.append({
+            "node_address": f"{p.node_info.id}@{p.socket_addr}",
+            "peer_state": (
+                {
+                    "height": str(prs.height),
+                    "round": str(prs.round),
+                    "step": prs.step,
+                }
+                if prs is not None
+                else None
+            ),
+        })
+    return {"round_state": _round_state_json(rs, full=True),
+            "peers": peers}
+
+
+def consensus_state(env: RPCEnvironment, params: dict) -> dict:
+    return {"round_state": _round_state_json(env.consensus_state.rs,
+                                             full=False)}
+
+
+def _round_state_json(rs, full: bool) -> dict:
+    from ..consensus.cstypes import RoundStepType
+
+    out = {
+        "height": str(rs.height),
+        "round": str(rs.round),
+        "step": RoundStepType.name(rs.step),
+        "height/round/step": f"{rs.height}/{rs.round}/{rs.step}",
+        "start_time": str(rs.start_time),
+        "proposal_block_hash": enc.hexu(
+            rs.proposal_block.hash() if rs.proposal_block else b""
+        ),
+        "locked_block_hash": enc.hexu(
+            rs.locked_block.hash() if rs.locked_block else b""
+        ),
+        "valid_block_hash": enc.hexu(
+            rs.valid_block.hash() if rs.valid_block else b""
+        ),
+    }
+    if full and rs.votes is not None:
+        out["height_vote_set"] = str(rs.votes)
+    return out
+
+
+def unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
+    limit = _int(params, "limit", 30) or 30
+    txs = env.mempool.reap_max_txs(limit)
+    return {
+        "n_txs": str(len(txs)),
+        "txs": [enc.b64(tx) for tx in txs],
+    }
+
+
+def num_unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
+    return {"n_txs": str(env.mempool.size()), "txs": None}
+
+
+# --- tx routes (rpc/core/mempool.go, tx.go) ---------------------------
+
+
+def broadcast_tx_async(env: RPCEnvironment, params: dict) -> dict:
+    """CheckTx in the background; return immediately (mempool.go:26)."""
+    tx = _tx_param(params)
+    threading.Thread(
+        target=_checked_check_tx, args=(env, tx), daemon=True
+    ).start()
+    return {"code": 0, "data": "", "log": "",
+            "hash": enc.hexu(compute_tx_hash(tx))}
+
+
+def _checked_check_tx(env, tx):
+    try:
+        env.mempool.check_tx(tx)
+    except Exception:  # noqa: BLE001 - async fire-and-forget
+        pass
+
+
+def broadcast_tx_sync(env: RPCEnvironment, params: dict) -> dict:
+    """CheckTx and return its result (mempool.go:76)."""
+    tx = _tx_param(params)
+    try:
+        res = env.mempool.check_tx(tx)
+    except Exception as e:  # mempool full / cache errors
+        raise RPCError(ERR_SERVER, str(e))
+    return {
+        "code": res.code,
+        "data": enc.b64(res.data) if res.data else "",
+        "log": res.log,
+        "hash": enc.hexu(compute_tx_hash(tx)),
+    }
+
+
+def broadcast_tx_commit(env: RPCEnvironment, params: dict) -> dict:
+    """Subscribe to the tx's DeliverTx event, CheckTx, wait for commit
+    (reference rpc/core/mempool.go:168-230)."""
+    tx = _tx_param(params)
+    txh = compute_tx_hash(tx)
+    q = Query(f"{TX_HASH_KEY} = '{txh.hex().upper()}'")
+    subscriber = f"rpc-btc-{txh.hex()[:16]}-{time.monotonic_ns()}"
+    sub = env.event_bus.subscribe(subscriber, q, 4)
+    try:
+        try:
+            check_res = env.mempool.check_tx(tx)
+        except Exception as e:
+            raise RPCError(ERR_SERVER, str(e))
+        if check_res.code != abci.CODE_TYPE_OK:
+            return {
+                "check_tx": enc.tx_response_json(check_res),
+                "deliver_tx": enc.tx_response_json(abci.ResponseDeliverTx()),
+                "hash": enc.hexu(txh),
+                "height": "0",
+            }
+        msg = sub.get(timeout=SUBSCRIBE_TIMEOUT)
+        if msg is None:
+            raise RPCError(ERR_SERVER, "timed out waiting for tx to be "
+                           "included in a block")
+        data = msg.data
+        return {
+            "check_tx": enc.tx_response_json(check_res),
+            "deliver_tx": enc.tx_response_json(data["result"]),
+            "hash": enc.hexu(txh),
+            "height": str(data["height"]),
+        }
+    finally:
+        env.event_bus.unsubscribe_all(subscriber)
+
+
+def tx(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/tx.go Tx: look up one tx by hash in the indexer."""
+    h = _hash_param(params)
+    r = env.tx_indexer.get(h)
+    if r is None:
+        raise RPCError(ERR_SERVER, f"tx {h.hex().upper()} not found")
+    return _tx_result_json(r, h)
+
+
+def tx_search(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/tx.go TxSearch with page/per_page."""
+    qs = params.get("query")
+    if not qs:
+        raise RPCError(ERR_INVALID_PARAMS, "missing query param")
+    page = max(_int(params, "page", 1) or 1, 1)
+    per_page = min(max(_int(params, "per_page", 30) or 30, 1), 100)
+    results = env.tx_indexer.search(Query(qs))
+    total = len(results)
+    start = (page - 1) * per_page
+    chunk = results[start : start + per_page]
+    return {
+        "txs": [_tx_result_json(r, compute_tx_hash(r.tx)) for r in chunk],
+        "total_count": str(total),
+    }
+
+
+def _tx_result_json(r, h: bytes) -> dict:
+    return {
+        "hash": enc.hexu(h),
+        "height": str(r.height),
+        "index": r.index,
+        "tx_result": enc.tx_response_json(r.result),
+        "tx": enc.b64(r.tx),
+    }
+
+
+# --- abci routes (rpc/core/abci.go) -----------------------------------
+
+
+def abci_query(env: RPCEnvironment, params: dict) -> dict:
+    data = params.get("data", "")
+    if isinstance(data, str):
+        data = bytes.fromhex(data) if data else b""
+    res = env.proxy_app_query.query(
+        abci.RequestQuery(
+            data=data,
+            path=params.get("path", ""),
+            height=_int(params, "height", 0) or 0,
+            prove=bool(params.get("prove", False)),
+        )
+    )
+    return {
+        "response": {
+            "code": res.code,
+            "log": res.log,
+            "info": res.info,
+            "index": str(res.index),
+            "key": enc.b64(res.key) if res.key else "",
+            "value": enc.b64(res.value) if res.value else "",
+            "height": str(res.height),
+        }
+    }
+
+
+def abci_info(env: RPCEnvironment, params: dict) -> dict:
+    res = env.proxy_app_query.info(abci.RequestInfo(version="rpc"))
+    return {
+        "response": {
+            "data": res.data,
+            "version": res.version,
+            "last_block_height": str(res.last_block_height),
+            "last_block_app_hash": enc.b64(res.last_block_app_hash),
+        }
+    }
+
+
+# --- unsafe routes (rpc/core/routes.go:44-52, net.go) -----------------
+
+
+def dial_seeds(env: RPCEnvironment, params: dict) -> dict:
+    seeds = params.get("seeds") or []
+    if not seeds:
+        raise RPCError(ERR_INVALID_PARAMS, "no seeds provided")
+    for s in seeds:
+        from ..p2p.pex import parse_net_address
+
+        nid, addr = parse_net_address(str(s))
+        threading.Thread(
+            target=env.p2p_switch.dial_peer, args=(addr,),
+            kwargs={"expect_id": nid}, daemon=True,
+        ).start()
+    return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+
+def dial_peers(env: RPCEnvironment, params: dict) -> dict:
+    peers = params.get("peers") or []
+    persistent = bool(params.get("persistent", False))
+    if not peers:
+        raise RPCError(ERR_INVALID_PARAMS, "no peers provided")
+    for s in peers:
+        from ..p2p.pex import parse_net_address
+
+        nid, addr = parse_net_address(str(s))
+        threading.Thread(
+            target=env.p2p_switch.dial_peer, args=(addr,),
+            kwargs={"expect_id": nid, "persistent": persistent}, daemon=True,
+        ).start()
+    return {"log": "Dialing peers in progress. See /net_info for details"}
+
+
+# --- event rendering for websocket subscribers ------------------------
+
+
+def _event_data_json(msg) -> dict:
+    """Render an EventBus message for a websocket subscriber (reference
+    amino-JSON EventData* union, rpc/core/types/responses.go:190)."""
+    event_type = msg.tags.get("tm.event", "")
+    data = msg.data
+    out: dict = {"type": event_type}
+    if not isinstance(data, dict):
+        out["value"] = str(data)
+        return out
+    value: dict = {}
+    for k, v in data.items():
+        if v is None:
+            value[k] = None
+        elif k == "block":
+            value[k] = enc.block_json(v)
+        elif k == "header":
+            value[k] = enc.header_json(v)
+        elif k == "vote":
+            value[k] = enc.vote_json(v)
+        elif k == "result" and hasattr(v, "code"):
+            value[k] = enc.tx_response_json(v)
+        elif isinstance(v, bytes):
+            value[k] = enc.b64(v)
+        elif isinstance(v, (int, float, str, bool)):
+            value[k] = v
+        else:
+            value[k] = str(v)
+    out["value"] = value
+    return out
+
+
+# --- route table (rpc/core/routes.go:11-52) ---------------------------
+
+ROUTES: Dict[str, Callable[[RPCEnvironment, dict], dict]] = {
+    "health": health,
+    "status": status,
+    "net_info": net_info,
+    "genesis": genesis,
+    "blockchain": blockchain,
+    "block": block,
+    "block_results": block_results,
+    "commit": commit,
+    "validators": validators,
+    "dump_consensus_state": dump_consensus_state,
+    "consensus_state": consensus_state,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_async": broadcast_tx_async,
+    "tx": tx,
+    "tx_search": tx_search,
+    "abci_query": abci_query,
+    "abci_info": abci_info,
+}
+
+UNSAFE_ROUTES: Dict[str, Callable[[RPCEnvironment, dict], dict]] = {
+    "dial_seeds": dial_seeds,
+    "dial_peers": dial_peers,
+}

@@ -1,0 +1,195 @@
+"""JSON views of core types for RPC responses (reference renders these
+via amino-JSON; we use plain JSON with hex hashes and base64 txs, the
+same field names as rpc/core/types/responses.go).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def hexu(data: bytes) -> str:
+    return data.hex().upper()
+
+
+def part_set_header_json(psh) -> dict:
+    return {"total": psh.total, "hash": hexu(psh.hash)}
+
+
+def block_id_json(bid) -> dict:
+    return {"hash": hexu(bid.hash),
+            "parts": part_set_header_json(bid.parts_header)}
+
+
+def header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": str(h.time),
+        "num_txs": str(h.num_txs),
+        "total_txs": str(h.total_txs),
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hexu(h.last_commit_hash),
+        "data_hash": hexu(h.data_hash),
+        "validators_hash": hexu(h.validators_hash),
+        "next_validators_hash": hexu(h.next_validators_hash),
+        "consensus_hash": hexu(h.consensus_hash),
+        "app_hash": hexu(h.app_hash),
+        "last_results_hash": hexu(h.last_results_hash),
+        "evidence_hash": hexu(h.evidence_hash),
+        "proposer_address": hexu(h.proposer_address),
+    }
+
+
+def vote_json(v) -> Optional[dict]:
+    if v is None:
+        return None
+    return {
+        "validator_address": hexu(v.validator_address),
+        "validator_index": str(v.validator_index),
+        "height": str(v.height),
+        "round": str(v.round),
+        "timestamp": str(v.timestamp),
+        "type": v.type,
+        "block_id": block_id_json(v.block_id),
+        "signature": b64(v.signature),
+    }
+
+
+def commit_json(c) -> Optional[dict]:
+    if c is None:
+        return None
+    return {
+        "block_id": block_id_json(c.block_id),
+        "precommits": [vote_json(v) for v in c.precommits],
+    }
+
+
+def block_json(b) -> dict:
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [b64(tx) for tx in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": commit_json(b.last_commit),
+    }
+
+
+def block_meta_json(m) -> dict:
+    return {"block_id": block_id_json(m.block_id),
+            "header": header_json(m.header)}
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": hexu(v.address),
+        "pub_key": {"type": "ed25519", "value": b64(v.pub_key.bytes())},
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+# --- decoders (inverse views, used by the lite client and RPC-driven
+# tools to rebuild typed objects from responses) -----------------------
+
+
+def part_set_header_from_json(o) -> "PartSetHeader":
+    from ..types.basic import PartSetHeader
+
+    return PartSetHeader(total=int(o["total"]), hash=bytes.fromhex(o["hash"]))
+
+
+def block_id_from_json(o) -> "BlockID":
+    from ..types.basic import BlockID
+
+    return BlockID(hash=bytes.fromhex(o["hash"]),
+                   parts_header=part_set_header_from_json(o["parts"]))
+
+
+def header_from_json(o) -> "Header":
+    from ..types.block import Header
+
+    return Header(
+        chain_id=o["chain_id"],
+        height=int(o["height"]),
+        time=int(o["time"]),
+        num_txs=int(o["num_txs"]),
+        total_txs=int(o["total_txs"]),
+        last_block_id=block_id_from_json(o["last_block_id"]),
+        last_commit_hash=bytes.fromhex(o["last_commit_hash"]),
+        data_hash=bytes.fromhex(o["data_hash"]),
+        validators_hash=bytes.fromhex(o["validators_hash"]),
+        next_validators_hash=bytes.fromhex(o["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(o["consensus_hash"]),
+        app_hash=bytes.fromhex(o["app_hash"]),
+        last_results_hash=bytes.fromhex(o["last_results_hash"]),
+        evidence_hash=bytes.fromhex(o["evidence_hash"]),
+        proposer_address=bytes.fromhex(o["proposer_address"]),
+    )
+
+
+def vote_from_json(o) -> Optional["Vote"]:
+    from ..types.basic import Vote
+
+    if o is None:
+        return None
+    return Vote(
+        validator_address=bytes.fromhex(o["validator_address"]),
+        validator_index=int(o["validator_index"]),
+        height=int(o["height"]),
+        round=int(o["round"]),
+        timestamp=int(o["timestamp"]),
+        type=int(o["type"]),
+        block_id=block_id_from_json(o["block_id"]),
+        signature=unb64(o["signature"]),
+    )
+
+
+def commit_from_json(o) -> Optional["Commit"]:
+    from ..types.block import Commit
+
+    if o is None:
+        return None
+    return Commit(
+        block_id=block_id_from_json(o["block_id"]),
+        precommits=[vote_from_json(v) for v in o["precommits"]],
+    )
+
+
+def validator_from_json(o) -> "Validator":
+    from ..crypto.keys import PubKeyEd25519
+    from ..types.validator_set import Validator
+
+    v = Validator.new(PubKeyEd25519(unb64(o["pub_key"]["value"])),
+                      int(o["voting_power"]))
+    v.proposer_priority = int(o.get("proposer_priority", 0))
+    return v
+
+
+def validator_set_from_json(vals: list) -> "ValidatorSet":
+    from ..types.validator_set import ValidatorSet
+
+    return ValidatorSet([validator_from_json(o) for o in vals])
+
+
+def tx_response_json(res) -> dict:
+    """ResponseCheckTx / ResponseDeliverTx → JSON."""
+    return {
+        "code": res.code,
+        "data": b64(res.data) if res.data else "",
+        "log": res.log,
+        "info": res.info,
+        "gas_wanted": str(res.gas_wanted),
+        "gas_used": str(res.gas_used),
+        "tags": [
+            {"key": b64(kv.key), "value": b64(kv.value)} for kv in res.tags
+        ],
+    }

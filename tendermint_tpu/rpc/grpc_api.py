@@ -1,0 +1,94 @@
+"""gRPC BroadcastAPI (reference rpc/grpc/: Ping + BroadcastTx).
+
+The reference exposes a minimal gRPC service next to JSON-RPC
+(rpc/grpc/api.go). We register the same two methods as generic gRPC
+handlers with JSON-encoded request/response bodies — real gRPC over
+HTTP/2 (grpcio), without a .proto codegen step.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import grpc
+from concurrent import futures
+
+SERVICE = "core_grpc.BroadcastAPI"
+
+
+def _ser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _deser(raw: bytes):
+    return json.loads(raw) if raw else {}
+
+
+class BroadcastAPIServer:
+    """rpc/grpc/api.go broadcastAPI over generic handlers."""
+
+    def __init__(self, env, host: str, port: int):
+        self.env = env
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        handlers = {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                self._ping, request_deserializer=_deser,
+                response_serializer=_ser),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                self._broadcast_tx, request_deserializer=_deser,
+                response_serializer=_ser),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    @property
+    def listen_addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    # -- methods (rpc/grpc/api.go:15-36) -------------------------------
+
+    def _ping(self, request, context):
+        return {}
+
+    def _broadcast_tx(self, request, context):
+        from .core import broadcast_tx_commit
+
+        res = broadcast_tx_commit(self.env, {"tx": request.get("tx", "")})
+        return {
+            "check_tx": res["check_tx"],
+            "deliver_tx": res["deliver_tx"],
+            "hash": res["hash"],
+            "height": res["height"],
+        }
+
+
+class BroadcastAPIClient:
+    """gRPC client for the BroadcastAPI (rpc/grpc/client_server.go)."""
+
+    def __init__(self, addr: str):
+        self._channel = grpc.insecure_channel(addr)
+        self._ping = self._channel.unary_unary(
+            f"/{SERVICE}/Ping", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._btx = self._channel.unary_unary(
+            f"/{SERVICE}/BroadcastTx", request_serializer=_ser,
+            response_deserializer=_deser)
+
+    def ping(self) -> dict:
+        return self._ping({})
+
+    def broadcast_tx(self, tx: bytes) -> dict:
+        import base64
+
+        return self._btx({"tx": base64.b64encode(tx).decode()})
+
+    def close(self) -> None:
+        self._channel.close()

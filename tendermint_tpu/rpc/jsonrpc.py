@@ -1,0 +1,54 @@
+"""JSON-RPC 2.0 framing (reference rpc/lib/types/types.go).
+
+Requests: {"jsonrpc":"2.0","id":...,"method":...,"params":{...}}.
+Responses carry either "result" or "error":{code,message,data}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+# reference rpc/lib/types/types.go error codes (JSON-RPC 2.0 standard)
+ERR_PARSE = -32700
+ERR_INVALID_REQUEST = -32600
+ERR_METHOD_NOT_FOUND = -32601
+ERR_INVALID_PARAMS = -32602
+ERR_INTERNAL = -32603
+ERR_SERVER = -32000
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: Optional[str] = None):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+def request(id_: Any, method: str, params: Optional[dict] = None) -> dict:
+    return {"jsonrpc": "2.0", "id": id_, "method": method,
+            "params": params or {}}
+
+
+def ok_response(id_: Any, result: Any) -> dict:
+    return {"jsonrpc": "2.0", "id": id_, "result": result}
+
+
+def error_response(id_: Any, code: int, message: str,
+                   data: Optional[str] = None) -> dict:
+    err = {"code": code, "message": message}
+    if data:
+        err["data"] = data
+    return {"jsonrpc": "2.0", "id": id_, "error": err}
+
+
+def dumps(obj: Any) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def loads(raw: bytes) -> Any:
+    try:
+        return json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as e:
+        raise RPCError(ERR_PARSE, f"parse error: {e}")

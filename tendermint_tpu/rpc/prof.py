@@ -1,0 +1,106 @@
+"""Profiling endpoint — pprof-equivalent (reference node/node.go:468-474
+mounts net/http/pprof when ProfListenAddress is set).
+
+Serves:
+- /debug/pprof/            index
+- /debug/pprof/goroutine   all thread stacks (goroutine-dump analogue)
+- /debug/pprof/heap        tracemalloc snapshot (top allocations)
+- /debug/pprof/profile?seconds=N  statistical CPU profile via cProfile
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+
+class ProfServer:
+    def __init__(self, host: str, port: int):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def listen_addr(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="prof-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _thread_dump() -> str:
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append(f"thread {names.get(tid, '?')} (id={tid}):")
+        out.extend(l.rstrip() for l in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
+def _heap_dump() -> str:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return "tracemalloc just started; re-request for a snapshot"
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:50]
+    return "\n".join(str(s) for s in stats)
+
+
+def _cpu_profile(seconds: float) -> str:
+    prof = cProfile.Profile()
+    prof.enable()
+    threading.Event().wait(seconds)
+    prof.disable()
+    buf = io.StringIO()
+    pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(60)
+    return buf.getvalue()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _text(self, body: str, status: int = 200) -> None:
+        raw = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        path = parsed.path.rstrip("/")
+        if path in ("", "/debug/pprof"):
+            self._text("profiles: goroutine heap profile\n")
+        elif path == "/debug/pprof/goroutine":
+            self._text(_thread_dump())
+        elif path == "/debug/pprof/heap":
+            self._text(_heap_dump())
+        elif path == "/debug/pprof/profile":
+            q = dict(parse_qsl(parsed.query))
+            secs = min(float(q.get("seconds", 5)), 60.0)
+            self._text(_cpu_profile(secs))
+        else:
+            self._text("not found", status=404)

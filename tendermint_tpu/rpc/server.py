@@ -1,0 +1,346 @@
+"""JSON-RPC server: HTTP POST + GET-URI + websocket on one port
+(reference rpc/lib/server/handlers.go + http_server.go).
+
+- POST /            JSON-RPC 2.0 body
+- GET  /<method>?a=b   URI route (params from query string)
+- GET  /websocket   RFC6455 upgrade; JSON-RPC frames; subscribe/
+                    unsubscribe stream events to the client
+- GET  /            route listing (handlers.go writes the same)
+
+The websocket side is hand-rolled (accept-key handshake + masked
+client frames) so one threaded server owns both transports, matching
+the reference's single listener.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import logging
+import socket
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qsl, urlparse
+
+from ..libs.events import Query
+from . import jsonrpc
+from .core import ROUTES, UNSAFE_ROUTES, RPCEnvironment
+from .jsonrpc import RPCError
+
+LOG = logging.getLogger("rpc.server")
+
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+
+class RPCServer:
+    def __init__(self, env: RPCEnvironment, host: str, port: int,
+                 unsafe: bool = False, max_open_connections: int = 0):
+        self.env = env
+        self.unsafe = unsafe
+        self.routes = dict(ROUTES)
+        if unsafe:
+            self.routes.update(UNSAFE_ROUTES)
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def listen_addr(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-http", daemon=True
+        )
+        self._thread.start()
+        LOG.info("RPC server listening on %s", self.listen_addr)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- dispatch ------------------------------------------------------
+
+    def call(self, method: str, params: dict) -> dict:
+        fn = self.routes.get(method)
+        if fn is None:
+            raise RPCError(jsonrpc.ERR_METHOD_NOT_FOUND,
+                           f"method {method!r} not found")
+        return fn(self.env, params)
+
+
+def _make_handler(server: RPCServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route to our logger
+            LOG.debug("http %s", fmt % args)
+
+        # ---- plain HTTP ---------------------------------------------
+
+        def _send_json(self, obj: dict, status: int = 200) -> None:
+            body = jsonrpc.dumps(obj)
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length)
+            try:
+                req = jsonrpc.loads(raw)
+            except RPCError as e:
+                return self._send_json(
+                    jsonrpc.error_response(None, e.code, e.message))
+            if isinstance(req, list):  # batch
+                return self._send_json(
+                    [self._handle_one(r) for r in req])
+            self._send_json(self._handle_one(req))
+
+        def _handle_one(self, req) -> dict:
+            if not isinstance(req, dict) or "method" not in req:
+                return jsonrpc.error_response(
+                    None, jsonrpc.ERR_INVALID_REQUEST, "invalid request")
+            id_ = req.get("id")
+            try:
+                result = server.call(req["method"], req.get("params") or {})
+                return jsonrpc.ok_response(id_, result)
+            except RPCError as e:
+                return jsonrpc.error_response(id_, e.code, e.message, e.data)
+            except Exception as e:  # noqa: BLE001 - handler crash → 32603
+                LOG.exception("rpc %s failed", req.get("method"))
+                return jsonrpc.error_response(
+                    id_, jsonrpc.ERR_INTERNAL, str(e))
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            path = parsed.path.strip("/")
+            if path == "websocket":
+                return self._upgrade_websocket()
+            if not path:  # route listing (handlers.go writeListOfEndpoints)
+                listing = "".join(
+                    f"<a href=\"/{m}\">/{m}</a><br>"
+                    for m in sorted(server.routes)
+                )
+                body = f"<html><body>{listing}</body></html>".encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            params = dict(parse_qsl(parsed.query))
+            # strip surrounding quotes the reference's URI parser accepts
+            params = {
+                k: (v[1:-1] if len(v) >= 2 and v[0] == v[-1] == '"' else v)
+                for k, v in params.items()
+            }
+            try:
+                result = server.call(path, params)
+                self._send_json(jsonrpc.ok_response("", result))
+            except RPCError as e:
+                self._send_json(
+                    jsonrpc.error_response("", e.code, e.message, e.data))
+            except Exception as e:  # noqa: BLE001
+                LOG.exception("rpc %s failed", path)
+                self._send_json(
+                    jsonrpc.error_response("", jsonrpc.ERR_INTERNAL, str(e)))
+
+        # ---- websocket (rpc/lib/server/handlers.go wsConnection) ----
+
+        def _upgrade_websocket(self):
+            key = self.headers.get("Sec-WebSocket-Key")
+            if not key or "upgrade" not in self.headers.get(
+                    "Connection", "").lower():
+                self.send_error(400, "not a websocket handshake")
+                return
+            accept = base64.b64encode(
+                hashlib.sha1((key + WS_GUID).encode()).digest()
+            ).decode()
+            self.send_response(101, "Switching Protocols")
+            self.send_header("Upgrade", "websocket")
+            self.send_header("Connection", "Upgrade")
+            self.send_header("Sec-WebSocket-Accept", accept)
+            self.end_headers()
+            self.close_connection = True
+            conn = WSConn(self.connection, server)
+            conn.serve()  # blocks for the life of the ws conn
+
+    return Handler
+
+
+class WSConn:
+    """One websocket client: JSON-RPC dispatch + event subscriptions
+    (reference wsConnection + wsSubscribe in rpc/core/events.go)."""
+
+    def __init__(self, sock: socket.socket, server: RPCServer):
+        self.sock = sock
+        self.server = server
+        self.env = server.env
+        self._send_lock = threading.Lock()
+        self._subscriber = f"ws-{id(self):x}-{time.monotonic_ns()}"
+        self._subs: Dict[str, object] = {}  # query str -> Subscription
+        self._pumps = []
+        self._closed = threading.Event()
+
+    # -- frame IO ------------------------------------------------------
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf += chunk
+        return buf
+
+    def recv_frame(self) -> Optional[bytes]:
+        """Returns a full text/binary message, None on close frame.
+        Fragmented messages are reassembled; ping answered inline."""
+        message = b""
+        while True:
+            hdr = self._recv_exact(2)
+            fin = hdr[0] & 0x80
+            opcode = hdr[0] & 0x0F
+            masked = hdr[1] & 0x80
+            ln = hdr[1] & 0x7F
+            if ln == 126:
+                ln = struct.unpack(">H", self._recv_exact(2))[0]
+            elif ln == 127:
+                ln = struct.unpack(">Q", self._recv_exact(8))[0]
+            mask = self._recv_exact(4) if masked else b""
+            payload = self._recv_exact(ln)
+            if masked:
+                payload = bytes(
+                    b ^ mask[i % 4] for i, b in enumerate(payload))
+            if opcode == 0x8:  # close
+                return None
+            if opcode == 0x9:  # ping → pong
+                self.send_frame(payload, opcode=0xA)
+                continue
+            if opcode == 0xA:  # pong
+                continue
+            message += payload
+            if fin:
+                return message
+
+    def send_frame(self, payload: bytes, opcode: int = 0x1) -> None:
+        with self._send_lock:
+            header = bytes([0x80 | opcode])
+            ln = len(payload)
+            if ln < 126:
+                header += bytes([ln])
+            elif ln < (1 << 16):
+                header += bytes([126]) + struct.pack(">H", ln)
+            else:
+                header += bytes([127]) + struct.pack(">Q", ln)
+            self.sock.sendall(header + payload)
+
+    def send_json(self, obj: dict) -> None:
+        try:
+            self.send_frame(jsonrpc.dumps(obj))
+        except OSError:
+            self._closed.set()
+
+    # -- serve loop ----------------------------------------------------
+
+    def serve(self) -> None:
+        try:
+            while not self._closed.is_set():
+                msg = self.recv_frame()
+                if msg is None:
+                    break
+                try:
+                    req = jsonrpc.loads(msg)
+                except RPCError as e:
+                    self.send_json(
+                        jsonrpc.error_response(None, e.code, e.message))
+                    continue
+                self._dispatch(req)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed.set()
+            self.env.event_bus.unsubscribe_all(self._subscriber)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, req: dict) -> None:
+        if not isinstance(req, dict) or "method" not in req:
+            return self.send_json(jsonrpc.error_response(
+                None, jsonrpc.ERR_INVALID_REQUEST, "invalid request"))
+        id_ = req.get("id")
+        method = req["method"]
+        params = req.get("params") or {}
+        try:
+            if method == "subscribe":
+                result = self._subscribe(params)
+            elif method == "unsubscribe":
+                result = self._unsubscribe(params)
+            elif method == "unsubscribe_all":
+                self.env.event_bus.unsubscribe_all(self._subscriber)
+                self._subs.clear()
+                result = {}
+            else:
+                result = self.server.call(method, params)
+            self.send_json(jsonrpc.ok_response(id_, result))
+        except RPCError as e:
+            self.send_json(jsonrpc.error_response(id_, e.code, e.message))
+        except Exception as e:  # noqa: BLE001
+            LOG.exception("ws rpc %s failed", method)
+            self.send_json(
+                jsonrpc.error_response(id_, jsonrpc.ERR_INTERNAL, str(e)))
+
+    # -- subscriptions (rpc/core/events.go Subscribe) ------------------
+
+    def _subscribe(self, params: dict) -> dict:
+        qs = params.get("query")
+        if not qs:
+            raise RPCError(jsonrpc.ERR_INVALID_PARAMS, "missing query")
+        if qs in self._subs:
+            raise RPCError(jsonrpc.ERR_SERVER, "already subscribed")
+        sub = self.env.event_bus.subscribe(self._subscriber, Query(qs), 128)
+        self._subs[qs] = sub
+        t = threading.Thread(
+            target=self._pump, args=(qs, sub), daemon=True,
+            name=f"ws-sub-{len(self._subs)}",
+        )
+        t.start()
+        self._pumps.append(t)
+        return {}
+
+    def _unsubscribe(self, params: dict) -> dict:
+        qs = params.get("query")
+        if not qs or qs not in self._subs:
+            raise RPCError(jsonrpc.ERR_SERVER, "subscription not found")
+        self.env.event_bus.unsubscribe(self._subscriber, Query(qs))
+        self._subs.pop(qs, None)
+        return {}
+
+    def _pump(self, qs: str, sub) -> None:
+        """Stream matching events to the client as JSON-RPC
+        notifications with id '#event' (reference events.go:73-90)."""
+        from .core import _event_data_json
+
+        while not self._closed.is_set() and not sub.cancelled:
+            msg = sub.get(timeout=0.5)
+            if msg is None:
+                continue
+            self.send_json({
+                "jsonrpc": "2.0",
+                "id": "#event",
+                "result": {
+                    "query": qs,
+                    "data": _event_data_json(msg),
+                    "tags": msg.tags,
+                },
+            })

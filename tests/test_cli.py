@@ -1,0 +1,128 @@
+"""CLI tests (reference cmd/tendermint/commands tests): every command
+through main(argv), plus a full init→node→RPC→shutdown run in a
+subprocess.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.cmd.main import main
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    assert "tendermint-tpu" in capsys.readouterr().out
+
+
+def test_init_and_show_commands(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    assert main(["--home", home, "init", "--chain-id", "cli-chain"]) == 0
+    capsys.readouterr()
+    for sub in ("config/genesis.json", "config/priv_validator.json",
+                "config/node_key.json", "config/config.toml"):
+        assert os.path.exists(os.path.join(home, sub)), sub
+
+    assert main(["--home", home, "show_node_id"]) == 0
+    node_id = capsys.readouterr().out.strip()
+    assert len(node_id) == 40
+
+    assert main(["--home", home, "show_validator"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["type"] == "ed25519"
+
+    # init is idempotent
+    assert main(["--home", home, "init"]) == 0
+    assert main(["--home", home, "show_node_id"]) == 0
+    assert capsys.readouterr().out.strip().endswith(node_id)
+
+
+def test_gen_validator(capsys):
+    assert main(["gen_validator"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "priv_key" in out or "pub_key" in out
+
+
+def test_reset_commands(tmp_path, capsys):
+    home = str(tmp_path / "home")
+    main(["--home", home, "init"])
+    data = os.path.join(home, "data")
+    os.makedirs(data, exist_ok=True)
+    marker = os.path.join(data, "junk.db")
+    open(marker, "w").write("x")
+    assert main(["--home", home, "unsafe_reset_all"]) == 0
+    assert not os.path.exists(marker)
+    assert os.path.exists(os.path.join(home, "config/priv_validator.json"))
+
+
+def test_testnet(tmp_path, capsys):
+    out_dir = str(tmp_path / "net")
+    assert main(["testnet", "--v", "3", "--o", out_dir,
+                 "--starting-port", "27000"]) == 0
+    docs = []
+    for i in range(3):
+        root = os.path.join(out_dir, f"node{i}")
+        assert os.path.exists(os.path.join(root, "config/config.toml"))
+        docs.append(open(os.path.join(root, "config/genesis.json")).read())
+    assert docs[0] == docs[1] == docs[2]
+    gen = json.loads(docs[0])
+    assert len(gen["validators"]) == 3
+    conf = open(os.path.join(out_dir, "node1", "config/config.toml")).read()
+    assert "27002" in conf  # node1 p2p port
+    assert "persistent_peers" in conf
+
+
+def test_node_subprocess_runs_and_serves_rpc(tmp_path):
+    """init + node in a real subprocess; poll RPC until blocks commit,
+    then SIGTERM and expect clean exit."""
+    home = str(tmp_path / "home")
+    env = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu", JAX_PLATFORMS="cpu")
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main",
+         "--home", home, "init"],
+        check=True, env=env, capture_output=True,
+    )
+    # pin ports to something free-ish via :0 is impossible to discover,
+    # so use a fixed high port pair
+    rpc_port = 27657
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main",
+         "--home", home, "node",
+         "--proxy_app", "kvstore",
+         "--p2p.laddr", "tcp://127.0.0.1:27656",
+         "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        from tendermint_tpu.rpc.client import HTTPClient
+
+        client = HTTPClient(f"127.0.0.1:{rpc_port}", timeout=2.0)
+        deadline = time.time() + 60
+        height = 0
+        while time.time() < deadline and height < 2:
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode()
+                pytest.fail(f"node exited early:\n{out}")
+            try:
+                st = client.status()
+                height = int(st["sync_info"]["latest_block_height"])
+            except Exception:
+                time.sleep(0.5)
+        assert height >= 2, "node never committed blocks"
+        res = client.broadcast_tx_commit(b"clikey=clivalue")
+        assert res["deliver_tx"]["code"] == 0
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            pytest.fail("node did not exit on SIGTERM")
+    assert proc.returncode == 0

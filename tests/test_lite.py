@@ -1,0 +1,195 @@
+"""Light-client tests (reference lite/base_verifier_test.go +
+dynamic_verifier_test.go + proxy tests): synthetic chains with and
+without validator-set changes, then the verifying proxy against a live
+node.
+"""
+
+import os
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.lite import (
+    BaseVerifier,
+    DBProvider,
+    DynamicVerifier,
+    ErrLiteVerification,
+    FullCommit,
+    MemProvider,
+    SignedHeader,
+)
+from tendermint_tpu.types.basic import VOTE_TYPE_PRECOMMIT, BlockID, PartSetHeader, Vote
+from tendermint_tpu.types.block import Commit, Header
+from tendermint_tpu.types.validator_set import random_validator_set
+
+CHAIN = "lite-chain"
+
+
+def make_header(height, vals, next_vals, app_hash=b"\x01" * 20):
+    return Header(
+        chain_id=CHAIN,
+        height=height,
+        time=1_700_000_000_000_000_000 + height,
+        num_txs=0,
+        total_txs=0,
+        last_commit_hash=b"\x02" * 32,
+        data_hash=merkle.hash_from_byte_slices([]),
+        validators_hash=vals.hash(),
+        next_validators_hash=next_vals.hash(),
+        consensus_hash=b"\x03" * 32,
+        app_hash=app_hash,
+        last_results_hash=b"",
+        evidence_hash=merkle.hash_from_byte_slices([]),
+        proposer_address=vals.validators[0].address,
+    )
+
+
+def sign_header(header, vals, keys):
+    bid = BlockID(hash=header.hash(),
+                  parts_header=PartSetHeader(1, b"\x04" * 32))
+    precommits = [None] * len(vals)
+    for key in keys:
+        addr = key.pub_key().address()
+        idx, _ = vals.get_by_address(addr)
+        if idx < 0:
+            continue
+        v = Vote(
+            validator_address=addr,
+            validator_index=idx,
+            height=header.height,
+            round=0,
+            timestamp=header.time + 1,
+            type=VOTE_TYPE_PRECOMMIT,
+            block_id=bid,
+        )
+        v.signature = key.sign(v.sign_bytes(CHAIN))
+        precommits[idx] = v
+    return Commit(block_id=bid, precommits=precommits)
+
+
+def make_fc(height, vals, keys, next_vals=None):
+    nv = next_vals if next_vals is not None else vals
+    h = make_header(height, vals, nv)
+    return FullCommit(
+        signed_header=SignedHeader(header=h, commit=sign_header(h, vals, keys)),
+        validators=vals,
+        next_validators=nv,
+    )
+
+
+def test_base_verifier_ok_and_bad():
+    vals, keys = random_validator_set(4, 10)
+    fc = make_fc(5, vals, keys)
+    bv = BaseVerifier(CHAIN, 5, vals)
+    bv.verify(fc.signed_header)
+
+    # tampered header → commit signs a different header
+    fc2 = make_fc(5, vals, keys)
+    fc2.signed_header.header.app_hash = b"\xff" * 20
+    with pytest.raises(Exception):
+        bv.verify(fc2.signed_header)
+
+    # unknown valset
+    other_vals, _ = random_validator_set(4, 10)
+    with pytest.raises(ErrLiteVerification):
+        BaseVerifier(CHAIN, 5, other_vals).verify(fc.signed_header)
+
+
+def test_base_verifier_insufficient_power():
+    vals, keys = random_validator_set(4, 10)
+    # only 2 of 4 sign: 20/40 <= 2/3 → reject
+    fc_partial_header = make_header(3, vals, vals)
+    commit = sign_header(fc_partial_header, vals, keys[:2])
+    sh = SignedHeader(header=fc_partial_header, commit=commit)
+    with pytest.raises(Exception):
+        BaseVerifier(CHAIN, 3, vals).verify(sh)
+
+
+def test_dynamic_verifier_static_valset():
+    vals, keys = random_validator_set(4, 10)
+    source = MemProvider()
+    for h in (1, 3, 5, 8):
+        source.save_full_commit(make_fc(h, vals, keys))
+    trusted = DBProvider(MemDB())
+    dv = DynamicVerifier(CHAIN, trusted, source)
+    dv.init_trust(source.latest_full_commit(CHAIN, 1))
+
+    target = make_fc(8, vals, keys)
+    dv.verify(target.signed_header)  # same valset: direct
+
+
+def test_dynamic_verifier_valset_change_bisection():
+    vals_a, keys_a = random_validator_set(4, 10)
+    vals_b, keys_b = random_validator_set(4, 10)
+    source = MemProvider()
+    # heights 1-2 under A (2 announces B), 3+ under B
+    source.save_full_commit(make_fc(1, vals_a, keys_a))
+    source.save_full_commit(make_fc(2, vals_a, keys_a, next_vals=vals_b))
+    source.save_full_commit(make_fc(3, vals_b, keys_b))
+    source.save_full_commit(make_fc(4, vals_b, keys_b))
+
+    trusted = DBProvider(MemDB())
+    dv = DynamicVerifier(CHAIN, trusted, source)
+    dv.init_trust(source.latest_full_commit(CHAIN, 1))
+
+    target = make_fc(4, vals_b, keys_b)
+    dv.verify(target.signed_header)  # needs the walk through height 2
+
+    # a forged valset C cannot pass
+    vals_c, keys_c = random_validator_set(4, 10)
+    forged = make_fc(4, vals_c, keys_c)
+    with pytest.raises(ErrLiteVerification):
+        dv.verify(forged.signed_header)
+
+
+def test_lite_proxy_against_live_node(tmp_path):
+    from test_node import init_files, make_config
+
+    from tendermint_tpu.lite.proxy import run_lite_proxy
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.rpc.client import HTTPClient
+    from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+    c = make_config(tmp_path, "n0")
+    c.rpc.laddr = "tcp://127.0.0.1:0"
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    srv = None
+    try:
+        sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 8)
+        h = 0
+        deadline = time.time() + 30
+        while h < 2 and time.time() < deadline:
+            m = sub.get(timeout=1.0)
+            if m is not None:
+                h = m.data["block"].header.height
+        assert h >= 2
+
+        srv = run_lite_proxy(
+            node_addr=node.rpc_listen_addr,
+            listen="tcp://127.0.0.1:0",
+            chain_id=node.genesis_doc.chain_id,
+            home=c.root_dir,
+            blocking=False,
+        )
+        proxy_client = HTTPClient(srv.listen_addr)
+        st = proxy_client.status()
+        tip = int(st["sync_info"]["latest_block_height"])
+        com = proxy_client.commit(tip)
+        assert com["signed_header"]["header"]["height"] == str(tip)
+        blk = proxy_client.block(tip)
+        assert blk["block"]["header"]["height"] == str(tip)
+        # unknown method is rejected, not proxied
+        from tendermint_tpu.rpc.jsonrpc import RPCError
+
+        with pytest.raises(RPCError):
+            proxy_client.call("broadcast_tx_sync", {"tx": ""})
+    finally:
+        if srv is not None:
+            srv.stop()
+        node.stop()

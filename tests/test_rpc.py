@@ -1,0 +1,218 @@
+"""RPC layer tests (reference rpc/client/rpc_test.go +
+rpc/lib/server tests): boot one node with RPC enabled, drive every
+route over HTTP POST, GET-URI, and websocket.
+"""
+
+import base64
+import json
+import os
+import time
+import urllib.request
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.rpc.client import HTTPClient, WSClient
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+from test_node import init_files, make_config
+
+
+@pytest.fixture(scope="module")
+def rpc_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("rpcnode")
+    c = make_config(tmp, "n0")
+    c.rpc.laddr = "tcp://127.0.0.1:0"
+    c.rpc.unsafe = True
+    c.base.proxy_app = "kvstore"
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    # wait for a couple of blocks so queries have data
+    sub = node.event_bus.subscribe("warm", query_for_event(EVENT_NEW_BLOCK), 8)
+    deadline = time.time() + 30
+    h = 0
+    while h < 2 and time.time() < deadline:
+        m = sub.get(timeout=1.0)
+        if m is not None:
+            h = m.data["block"].header.height
+    assert h >= 2
+    client = HTTPClient(node.rpc_listen_addr)
+    yield node, client
+    node.stop()
+
+
+def test_health_status(rpc_node):
+    node, c = rpc_node
+    assert c.health() == {}
+    st = c.status()
+    assert st["node_info"]["id"] == node.node_key.id
+    assert int(st["sync_info"]["latest_block_height"]) >= 2
+    assert st["validator_info"]["voting_power"] == "10"
+
+
+def test_block_and_commit(rpc_node):
+    node, c = rpc_node
+    b = c.block(1)
+    assert b["block"]["header"]["height"] == "1"
+    bid_hash = b["block_meta"]["block_id"]["hash"]
+    assert len(bid_hash) == 64  # SHA256 hex
+
+    cm = c.commit(1)
+    assert cm["canonical"] is True
+    assert cm["signed_header"]["header"]["height"] == "1"
+    assert any(
+        v is not None for v in cm["signed_header"]["commit"]["precommits"]
+    )
+
+    bc = c.blockchain(1, 2)
+    assert int(bc["last_height"]) >= 2
+    hts = [m["header"]["height"] for m in bc["block_metas"]]
+    assert hts == sorted(hts, reverse=True)
+
+
+def test_validators_genesis(rpc_node):
+    node, c = rpc_node
+    v = c.validators(1)
+    assert len(v["validators"]) == 1
+    assert v["validators"][0]["voting_power"] == "10"
+    g = c.genesis()
+    assert g["genesis"]["chain_id"] == node.genesis_doc.chain_id
+
+
+def test_broadcast_tx_commit_and_query(rpc_node):
+    node, c = rpc_node
+    tx = b"rpckey=rpcvalue"
+    res = c.broadcast_tx_commit(tx)
+    assert res["check_tx"]["code"] == 0
+    assert res["deliver_tx"]["code"] == 0
+    assert int(res["height"]) > 0
+
+    # abci_query sees the committed kv
+    q = c.abci_query("", b"rpckey")
+    assert q["response"]["code"] == 0
+    assert base64.b64decode(q["response"]["value"]) == b"rpcvalue"
+
+    # the tx indexer has it
+    txh = bytes.fromhex(res["hash"])
+    found = c.tx(txh)
+    assert base64.b64decode(found["tx"]) == tx
+    assert found["height"] == res["height"]
+
+    sr = c.tx_search(f"tx.height = {int(res['height'])}")
+    assert int(sr["total_count"]) >= 1
+
+
+def test_broadcast_tx_sync_async(rpc_node):
+    node, c = rpc_node
+    r = c.broadcast_tx_sync(b"synckey=1")
+    assert r["code"] == 0
+    r = c.broadcast_tx_async(b"asynckey=1")
+    assert "hash" in r
+    time.sleep(0.2)
+    n = c.num_unconfirmed_txs()
+    assert int(n["n_txs"]) >= 0  # may already be reaped
+
+
+def test_abci_info_consensus_net_info(rpc_node):
+    node, c = rpc_node
+    info = c.abci_info()
+    assert int(info["response"]["last_block_height"]) >= 1
+    cs = c.consensus_state()
+    assert int(cs["round_state"]["height"]) >= 1
+    dump = c.dump_consensus_state()
+    assert "round_state" in dump
+    ni = c.net_info()
+    assert ni["listening"] is True
+    assert ni["n_peers"] == "0"
+
+
+def test_uri_get_routes(rpc_node):
+    node, c = rpc_node
+    base = f"http://{node.rpc_listen_addr}"
+    with urllib.request.urlopen(f"{base}/status") as r:
+        out = json.loads(r.read())
+    assert out["result"]["node_info"]["id"] == node.node_key.id
+    with urllib.request.urlopen(f"{base}/block?height=1") as r:
+        out = json.loads(r.read())
+    assert out["result"]["block"]["header"]["height"] == "1"
+    # route listing
+    with urllib.request.urlopen(base) as r:
+        assert b"/status" in r.read()
+    # error shape
+    with urllib.request.urlopen(f"{base}/block?height=10000000") as r:
+        out = json.loads(r.read())
+    assert out["error"]["code"] == -32000
+
+
+def test_rpc_error_method_not_found(rpc_node):
+    node, c = rpc_node
+    from tendermint_tpu.rpc.jsonrpc import RPCError
+
+    with pytest.raises(RPCError) as ei:
+        c.call("nonsense_method")
+    assert ei.value.code == -32601
+
+
+def test_unsafe_routes_enabled(rpc_node):
+    node, c = rpc_node
+    # dial_peers with a bogus address: accepted (dials in background)
+    out = c.call("dial_peers", {"peers": ["deadbeef@127.0.0.1:1"]})
+    assert "Dialing" in out["log"]
+
+
+def test_websocket_subscribe_new_block(rpc_node):
+    node, c = rpc_node
+    ws = WSClient(node.rpc_listen_addr)
+    ws.connect()
+    try:
+        assert ws.call("status")["node_info"]["id"] == node.node_key.id
+        ws.subscribe("tm.event = 'NewBlock'")
+        ev = ws.next_event(timeout=15)
+        assert ev is not None
+        assert ev["data"]["type"] == "NewBlock"
+        h1 = int(ev["data"]["value"]["block"]["header"]["height"])
+        ev2 = ws.next_event(timeout=15)
+        assert ev2 is not None
+        h2 = int(ev2["data"]["value"]["block"]["header"]["height"])
+        assert h2 == h1 + 1
+        ws.unsubscribe("tm.event = 'NewBlock'")
+    finally:
+        ws.close()
+
+
+def test_websocket_tx_event(rpc_node):
+    node, c = rpc_node
+    ws = WSClient(node.rpc_listen_addr)
+    ws.connect()
+    try:
+        ws.subscribe("tm.event = 'Tx'")
+        res = c.broadcast_tx_sync(b"wstxkey=abc")
+        assert res["code"] == 0
+        ev = ws.next_event(timeout=15)
+        assert ev is not None
+        assert ev["data"]["type"] == "Tx"
+        assert base64.b64decode(ev["data"]["value"]["tx"]) == b"wstxkey=abc"
+        assert ev["tags"]["tx.hash"] == res["hash"]
+    finally:
+        ws.close()
+
+
+def test_grpc_broadcast_api(rpc_node):
+    node, c = rpc_node
+    from tendermint_tpu.rpc.core import RPCEnvironment
+    from tendermint_tpu.rpc.grpc_api import BroadcastAPIClient, BroadcastAPIServer
+
+    srv = BroadcastAPIServer(RPCEnvironment(node), "127.0.0.1", 0)
+    srv.start()
+    try:
+        cl = BroadcastAPIClient(srv.listen_addr)
+        assert cl.ping() == {}
+        out = cl.broadcast_tx(b"grpckey=1")
+        assert out["deliver_tx"]["code"] == 0
+        cl.close()
+    finally:
+        srv.stop()
