@@ -1,0 +1,292 @@
+// nativedb — C++ log-structured KV store with a C API for ctypes.
+//
+// Native-equivalent of the reference's cgo→C++ LevelDB binding
+// (libs/db/c_level_db.go, build tag `gcc`): same DB-interface surface
+// (get/put/delete/ordered iteration/batch/sync) behind a tiny C ABI.
+//
+// Design: append-only data log + in-memory ordered index
+// (std::map<string,loc>). Records are crc32-framed; recovery scans the
+// log and truncates at the first corrupt/short record. Deletes are
+// tombstones; compact() rewrites the live set. One mutex per DB — the
+// store targets correctness + sequential-scan speed, not concurrency
+// (callers in this framework serialize per-store anyway).
+//
+// Build: g++ -O2 -shared -fPIC -o libnativedb.so nativedb.cpp
+
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kTombstone = 0xFFFFFFFFu;
+
+// crc32 (IEEE, table-driven) — matches Python's binascii.crc32
+uint32_t crc32(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++)
+    crc = table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+void put_u32(std::string& out, uint32_t v) {
+  out.push_back(static_cast<char>(v >> 24));
+  out.push_back(static_cast<char>(v >> 16));
+  out.push_back(static_cast<char>(v >> 8));
+  out.push_back(static_cast<char>(v));
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+struct DB {
+  std::mutex mu;
+  std::string path;
+  FILE* log = nullptr;
+  // key -> value (values live in memory; the log is the durable copy.
+  // For this framework's stores — blocks, state, index — working sets
+  // are modest and the memory index keeps gets O(log n) with zero
+  // read-path IO, like a memtable that never flushes).
+  std::map<std::string, std::string> index;
+  uint64_t live_bytes = 0;
+  uint64_t total_bytes = 0;
+
+  bool recover();
+  bool append(const std::string& key, const std::string* val);
+  bool compact();
+};
+
+// record: crc32(4) | klen(4) | vlen(4) | key | value
+bool DB::recover() {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return true;  // fresh db
+  std::vector<uint8_t> hdr(12);
+  long good_end = 0;
+  for (;;) {
+    if (fread(hdr.data(), 1, 12, f) != 12) break;
+    uint32_t crc = get_u32(hdr.data());
+    uint32_t klen = get_u32(hdr.data() + 4);
+    uint32_t vlen = get_u32(hdr.data() + 8);
+    uint32_t real_vlen = (vlen == kTombstone) ? 0 : vlen;
+    if (klen > (1u << 30) || real_vlen > (1u << 30)) break;
+    std::vector<uint8_t> payload(8 + klen + real_vlen);
+    memcpy(payload.data(), hdr.data() + 4, 8);
+    if (fread(payload.data() + 8, 1, klen + real_vlen, f) !=
+        klen + real_vlen)
+      break;
+    if (crc32(payload.data(), payload.size()) != crc) break;
+    std::string key(reinterpret_cast<char*>(payload.data() + 8), klen);
+    if (vlen == kTombstone) {
+      index.erase(key);
+    } else {
+      index[key] = std::string(
+          reinterpret_cast<char*>(payload.data() + 8 + klen), real_vlen);
+    }
+    good_end = ftell(f);
+  }
+  fclose(f);
+  // truncate torn tail so future appends start at a clean record edge
+  long sz = 0;
+  {
+    FILE* g = fopen(path.c_str(), "rb");
+    if (g) { fseek(g, 0, SEEK_END); sz = ftell(g); fclose(g); }
+  }
+  if (sz > good_end) {
+    if (truncate(path.c_str(), good_end) != 0) return false;
+  }
+  total_bytes = static_cast<uint64_t>(good_end);
+  live_bytes = 0;
+  for (auto& kv : index) live_bytes += 12 + kv.first.size() + kv.second.size();
+  return true;
+}
+
+bool DB::append(const std::string& key, const std::string* val) {
+  std::string payload;
+  put_u32(payload, static_cast<uint32_t>(key.size()));
+  put_u32(payload, val ? static_cast<uint32_t>(val->size()) : kTombstone);
+  payload += key;
+  if (val) payload += *val;
+  std::string rec;
+  put_u32(rec, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                     payload.size()));
+  rec += payload;
+  if (fwrite(rec.data(), 1, rec.size(), log) != rec.size()) return false;
+  total_bytes += rec.size();
+  return true;
+}
+
+bool DB::compact() {
+  // rewrite live set to a temp log, atomically swap
+  std::string tmp = path + ".compact";
+  FILE* out = fopen(tmp.c_str(), "wb");
+  if (!out) return false;
+  FILE* old = log;
+  log = out;
+  bool ok = true;
+  total_bytes = 0;
+  for (auto& kv : index)
+    if (!append(kv.first, &kv.second)) { ok = false; break; }
+  fflush(out);
+  log = old;
+  fclose(out);
+  if (!ok) { remove(tmp.c_str()); return false; }
+  if (log) fclose(log);
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    log = fopen(path.c_str(), "ab");
+    return false;
+  }
+  log = fopen(path.c_str(), "ab");
+  live_bytes = 0;
+  for (auto& kv : index) live_bytes += 12 + kv.first.size() + kv.second.size();
+  return log != nullptr;
+}
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> items;  // snapshot
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ndb_open(const char* path) {
+  auto db = std::make_unique<DB>();
+  db->path = path;
+  if (!db->recover()) return nullptr;
+  db->log = fopen(path, "ab");
+  if (!db->log) return nullptr;
+  return db.release();
+}
+
+void ndb_close(void* h) {
+  auto* db = static_cast<DB*>(h);
+  {
+    std::lock_guard<std::mutex> g(db->mu);
+    // compact on close when >50% of the log is garbage
+    if (db->total_bytes > 2 * db->live_bytes && db->total_bytes > 1 << 20)
+      db->compact();
+    if (db->log) { fflush(db->log); fclose(db->log); db->log = nullptr; }
+  }
+  delete db;
+}
+
+int ndb_put(void* h, const uint8_t* k, uint32_t klen, const uint8_t* v,
+            uint32_t vlen) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string key(reinterpret_cast<const char*>(k), klen);
+  std::string val(reinterpret_cast<const char*>(v), vlen);
+  if (!db->append(key, &val)) return -1;
+  auto it = db->index.find(key);
+  if (it != db->index.end())
+    db->live_bytes -= 12 + key.size() + it->second.size();
+  db->live_bytes += 12 + key.size() + val.size();
+  db->index[key] = std::move(val);
+  return 0;
+}
+
+int ndb_delete(void* h, const uint8_t* k, uint32_t klen) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  std::string key(reinterpret_cast<const char*>(k), klen);
+  auto it = db->index.find(key);
+  if (it == db->index.end()) return 0;  // delete of absent key is a no-op
+  if (!db->append(key, nullptr)) return -1;
+  db->live_bytes -= 12 + key.size() + it->second.size();
+  db->index.erase(it);
+  return 0;
+}
+
+// 0 = found (copy into malloc'd buffer), 1 = not found, -1 = error
+int ndb_get(void* h, const uint8_t* k, uint32_t klen, uint8_t** val,
+            uint32_t* vlen) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  auto it = db->index.find(
+      std::string(reinterpret_cast<const char*>(k), klen));
+  if (it == db->index.end()) return 1;
+  *vlen = static_cast<uint32_t>(it->second.size());
+  *val = static_cast<uint8_t*>(malloc(it->second.size()));
+  if (!*val && !it->second.empty()) return -1;
+  memcpy(*val, it->second.data(), it->second.size());
+  return 0;
+}
+
+void ndb_free(uint8_t* p) { free(p); }
+
+int ndb_sync(void* h) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  if (fflush(db->log) != 0) return -1;
+  return 0;
+}
+
+int ndb_compact(void* h) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->compact() ? 0 : -1;
+}
+
+uint64_t ndb_count(void* h) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  return db->index.size();
+}
+
+// iterator over [start, end); empty start/end = unbounded
+void* ndb_iter_new(void* h, const uint8_t* start, uint32_t slen,
+                   const uint8_t* end, uint32_t elen, int reverse) {
+  auto* db = static_cast<DB*>(h);
+  std::lock_guard<std::mutex> g(db->mu);
+  auto it = std::make_unique<Iter>();
+  std::string s(reinterpret_cast<const char*>(start), slen);
+  std::string e(reinterpret_cast<const char*>(end), elen);
+  auto lo = slen ? db->index.lower_bound(s) : db->index.begin();
+  auto hi = elen ? db->index.lower_bound(e) : db->index.end();
+  for (auto p = lo; p != hi; ++p) it->items.emplace_back(p->first, p->second);
+  if (reverse) std::reverse(it->items.begin(), it->items.end());
+  return it.release();
+}
+
+// 0 = item produced, 1 = exhausted
+int ndb_iter_next(void* hi, uint8_t** k, uint32_t* klen, uint8_t** v,
+                  uint32_t* vlen) {
+  auto* it = static_cast<Iter*>(hi);
+  if (it->pos >= it->items.size()) return 1;
+  auto& kv = it->items[it->pos++];
+  *klen = static_cast<uint32_t>(kv.first.size());
+  *k = static_cast<uint8_t*>(malloc(kv.first.size()));
+  memcpy(*k, kv.first.data(), kv.first.size());
+  *vlen = static_cast<uint32_t>(kv.second.size());
+  *v = static_cast<uint8_t*>(malloc(kv.second.size()));
+  memcpy(*v, kv.second.data(), kv.second.size());
+  return 0;
+}
+
+void ndb_iter_free(void* hi) { delete static_cast<Iter*>(hi); }
+
+}  // extern "C"

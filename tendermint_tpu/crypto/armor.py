@@ -1,0 +1,82 @@
+"""ASCII armor for key material (reference crypto/armor/armor.go).
+
+PEM-like blocks with headers (the reference uses OpenPGP armor via
+golang.org/x/crypto/openpgp/armor; same shape: type line, k/v headers,
+base64 body, end line), plus the encrypt-armor-privkey helpers that
+pair armor with the symmetric secret-box (keys/mintkey.go pattern).
+"""
+
+from __future__ import annotations
+
+import base64
+import textwrap
+from typing import Dict, Tuple
+
+from .keys import PrivKey, privkey_from_bytes, privkey_to_bytes
+from .symmetric import decrypt_symmetric, encrypt_symmetric, key_from_passphrase
+
+BLOCK_TYPE_PRIVKEY = "TENDERMINT PRIVATE KEY"
+BLOCK_TYPE_KEYINFO = "TENDERMINT KEY INFO"
+
+
+def encode_armor(block_type: str, headers: Dict[str, str],
+                 data: bytes) -> str:
+    """armor.go EncodeArmor."""
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    body = base64.b64encode(data).decode()
+    lines.extend(textwrap.wrap(body, 64))
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> Tuple[str, Dict[str, str], bytes]:
+    """armor.go DecodeArmor -> (block_type, headers, data)."""
+    lines = [l.rstrip("\r") for l in armor_str.strip().split("\n")]
+    if not lines or not lines[0].startswith("-----BEGIN "):
+        raise ValueError("no armor begin line")
+    block_type = lines[0][len("-----BEGIN "):].rstrip("-")
+    end = f"-----END {block_type}-----"
+    if lines[-1] != end:
+        raise ValueError("no matching armor end line")
+    headers: Dict[str, str] = {}
+    i = 1
+    while i < len(lines) - 1 and lines[i]:
+        if ":" not in lines[i]:
+            break  # body started without blank separator
+        k, _, v = lines[i].partition(":")
+        headers[k.strip()] = v.strip()
+        i += 1
+    if i < len(lines) - 1 and not lines[i]:
+        i += 1
+    body = "".join(lines[i:-1])
+    return block_type, headers, base64.b64decode(body)
+
+
+def encrypt_armor_privkey(privkey: PrivKey, passphrase: str) -> str:
+    """mintkey.go EncryptArmorPrivKey: scrypt(salt) + secret-box +
+    armor with the salt/kdf in headers."""
+    import os
+
+    salt = os.urandom(16)
+    key = key_from_passphrase(passphrase, salt)
+    ct = encrypt_symmetric(privkey_to_bytes(privkey), key)
+    return encode_armor(
+        BLOCK_TYPE_PRIVKEY,
+        {"kdf": "scrypt", "salt": salt.hex().upper()},
+        ct,
+    )
+
+
+def unarmor_decrypt_privkey(armor_str: str, passphrase: str) -> PrivKey:
+    """mintkey.go UnarmorDecryptPrivKey."""
+    block_type, headers, data = decode_armor(armor_str)
+    if block_type != BLOCK_TYPE_PRIVKEY:
+        raise ValueError(f"unrecognized armor type {block_type!r}")
+    if headers.get("kdf") != "scrypt":
+        raise ValueError(f"unrecognized KDF {headers.get('kdf')!r}")
+    salt = bytes.fromhex(headers["salt"])
+    key = key_from_passphrase(passphrase, salt)
+    return privkey_from_bytes(decrypt_symmetric(data, key))

@@ -154,11 +154,20 @@ class PrivKeyEd25519(PrivKey):
 # The reference uses amino type-prefixed bytes; we use a 1-byte type tag.
 
 TYPE_ED25519 = 0x01
+TYPE_SECP256K1 = 0x02
+TYPE_MULTISIG = 0x03
 
 
 def pubkey_to_bytes(pk: PubKey) -> bytes:
+    from .multisig import PubKeyMultisigThreshold
+    from .secp256k1 import PubKeySecp256k1
+
     if isinstance(pk, PubKeyEd25519):
         return bytes([TYPE_ED25519]) + pk.data
+    if isinstance(pk, PubKeySecp256k1):
+        return bytes([TYPE_SECP256K1]) + pk.data
+    if isinstance(pk, PubKeyMultisigThreshold):
+        return bytes([TYPE_MULTISIG]) + pk.bytes()
     raise TypeError(f"unknown pubkey type {type(pk)}")
 
 
@@ -167,12 +176,24 @@ def pubkey_from_bytes(data: bytes) -> PubKey:
         raise ValueError("empty pubkey bytes")
     if data[0] == TYPE_ED25519:
         return PubKeyEd25519(data[1:])
+    if data[0] == TYPE_SECP256K1:
+        from .secp256k1 import PubKeySecp256k1
+
+        return PubKeySecp256k1(data[1:])
+    if data[0] == TYPE_MULTISIG:
+        from .multisig import PubKeyMultisigThreshold
+
+        return PubKeyMultisigThreshold.from_bytes(data[1:])
     raise ValueError(f"unknown pubkey type tag {data[0]:#x}")
 
 
 def privkey_to_bytes(sk: PrivKey) -> bytes:
+    from .secp256k1 import PrivKeySecp256k1
+
     if isinstance(sk, PrivKeyEd25519):
         return bytes([TYPE_ED25519]) + sk.data
+    if isinstance(sk, PrivKeySecp256k1):
+        return bytes([TYPE_SECP256K1]) + sk.data
     raise TypeError(f"unknown privkey type {type(sk)}")
 
 
@@ -181,4 +202,8 @@ def privkey_from_bytes(data: bytes) -> PrivKey:
         raise ValueError("empty privkey bytes")
     if data[0] == TYPE_ED25519:
         return PrivKeyEd25519(data[1:])
+    if data[0] == TYPE_SECP256K1:
+        from .secp256k1 import PrivKeySecp256k1
+
+        return PrivKeySecp256k1(data[1:])
     raise ValueError(f"unknown privkey type tag {data[0]:#x}")

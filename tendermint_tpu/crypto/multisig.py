@@ -1,0 +1,156 @@
+"""Threshold multisig pubkeys + compact bit arrays (reference
+crypto/multisig/threshold_pubkey.go + bitarray/compact_bit_array.go).
+
+A K-of-N pubkey: verification succeeds when the multisignature carries
+≥K valid signatures from distinct member keys, positions flagged in a
+compact bit array.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List
+
+from ..types import serde
+from . import tmhash
+from .keys import PubKey, pubkey_from_bytes, pubkey_to_bytes
+
+
+class CompactBitArray:
+    """bitarray/compact_bit_array.go: bits packed into bytes, MSB
+    first, with the true size carried separately."""
+
+    def __init__(self, size: int):
+        if size < 0:
+            raise ValueError("negative size")
+        self.size = size
+        self.elems = bytearray((size + 7) // 8)
+
+    def get_index(self, i: int) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        return bool(self.elems[i >> 3] & (1 << (7 - (i & 7))))
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        if v:
+            self.elems[i >> 3] |= 1 << (7 - (i & 7))
+        else:
+            self.elems[i >> 3] &= ~(1 << (7 - (i & 7)))
+        return True
+
+    def num_true_bits_before(self, index: int) -> int:
+        """compact_bit_array.go NumTrueBitsBefore — the signature slot
+        for member `index`."""
+        return sum(1 for i in range(index) if self.get_index(i))
+
+    def count_true(self) -> int:
+        return self.num_true_bits_before(self.size)
+
+    def to_bytes(self) -> bytes:
+        return serde.pack([self.size, bytes(self.elems)])
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "CompactBitArray":
+        size, elems = serde.unpack(raw)
+        ba = cls(size)
+        if len(elems) != len(ba.elems):
+            raise ValueError(
+                f"bit array size {size} needs {len(ba.elems)} bytes, "
+                f"got {len(elems)}")
+        ba.elems = bytearray(elems)
+        return ba
+
+    def __eq__(self, other):
+        return (isinstance(other, CompactBitArray)
+                and self.size == other.size and self.elems == other.elems)
+
+
+@dataclass
+class Multisignature:
+    """multisig/multisignature.go: bit array + ordered sub-signatures."""
+
+    bit_array: CompactBitArray
+    sigs: List[bytes] = field(default_factory=list)
+
+    def add_signature_from_pubkey(self, sig: bytes, pubkey: PubKey,
+                                  keys: List[PubKey]) -> None:
+        index = next(
+            (i for i, k in enumerate(keys) if k.bytes() == pubkey.bytes()),
+            -1)
+        if index < 0:
+            raise ValueError("pubkey not in multisig key list")
+        slot = self.bit_array.num_true_bits_before(index)
+        if self.bit_array.get_index(index):
+            self.sigs[slot] = sig  # replace
+            return
+        self.bit_array.set_index(index, True)
+        self.sigs.insert(slot, sig)
+
+    def marshal(self) -> bytes:
+        return serde.pack([self.bit_array.to_bytes(), list(self.sigs)])
+
+    @classmethod
+    def unmarshal(cls, raw: bytes) -> "Multisignature":
+        ba_raw, sigs = serde.unpack(raw)
+        return cls(bit_array=CompactBitArray.from_bytes(ba_raw),
+                   sigs=[bytes(s) for s in sigs])
+
+
+@dataclass(frozen=True)
+class PubKeyMultisigThreshold(PubKey):
+    """threshold_pubkey.go:10-60: K-of-N."""
+
+    k: int
+    pubkeys: tuple  # tuple[PubKey, ...]
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError("threshold k must be > 0")
+        if len(self.pubkeys) < self.k:
+            raise ValueError("len(pubkeys) < k")
+
+    def bytes(self) -> bytes:
+        return serde.pack(
+            ["multisig", self.k,
+             [pubkey_to_bytes(pk) for pk in self.pubkeys]])
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PubKeyMultisigThreshold":
+        tag, k, pks = serde.unpack(raw)
+        if tag != "multisig":
+            raise ValueError("not a multisig pubkey")
+        return cls(k=k, pubkeys=tuple(pubkey_from_bytes(bytes(b))
+                                      for b in pks))
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self.bytes())
+
+    def verify_bytes(self, msg: bytes, sig: bytes) -> bool:
+        """threshold_pubkey.go VerifyBytes:24-57."""
+        try:
+            ms = Multisignature.unmarshal(sig)
+        except Exception:  # noqa: BLE001 - malformed multisig blob
+            return False
+        size = ms.bit_array.size
+        if len(self.pubkeys) != size:
+            return False
+        if len(ms.sigs) < self.k or ms.bit_array.count_true() != len(ms.sigs):
+            return False
+        sig_index = 0
+        for i in range(size):
+            if not ms.bit_array.get_index(i):
+                continue
+            if not self.pubkeys[i].verify_bytes(msg, ms.sigs[sig_index]):
+                return False
+            sig_index += 1
+        return sig_index >= self.k
+
+    def equals(self, other) -> bool:
+        return (isinstance(other, PubKeyMultisigThreshold)
+                and self.k == other.k
+                and len(self.pubkeys) == len(other.pubkeys)
+                and all(a.bytes() == b.bytes()
+                        for a, b in zip(self.pubkeys, other.pubkeys)))

@@ -1,0 +1,126 @@
+"""secp256k1 ECDSA keys (reference crypto/secp256k1/secp256k1.go).
+
+Reference semantics: 32-byte privkey, 33-byte compressed pubkey,
+address = RIPEMD160(SHA256(compressed-pubkey)) (secp256k1.go:10-14,
+unlike ed25519's SHA256-20). Signatures are 64-byte r||s with low-s
+normalization. Backed by the `cryptography` library's EC primitives
+(the host-native path; this curve never needs the TPU batch engine —
+consensus keys are ed25519).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from .keys import PrivKey, PubKey
+
+# curve order, for low-s normalization
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+
+SECP256K1_PUBKEY_SIZE = 33
+SECP256K1_PRIVKEY_SIZE = 32
+SECP256K1_SIG_SIZE = 64
+
+
+def _ripemd160_sha256(data: bytes) -> bytes:
+    h = hashlib.new("ripemd160")
+    h.update(hashlib.sha256(data).digest())
+    return h.digest()
+
+
+@dataclass(frozen=True)
+class PubKeySecp256k1(PubKey):
+    data: bytes  # 33-byte compressed SEC1
+
+    def __post_init__(self):
+        if len(self.data) != SECP256K1_PUBKEY_SIZE:
+            raise ValueError(
+                f"secp256k1 pubkey must be {SECP256K1_PUBKEY_SIZE} bytes")
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(pubkey)) — secp256k1.go:117-124."""
+        return _ripemd160_sha256(self.data)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_bytes(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SECP256K1_SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self.data)
+            pub.verify(encode_dss_signature(r, s), msg,
+                       ec.ECDSA(hashes.SHA256()))
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def equals(self, other) -> bool:
+        return isinstance(other, PubKeySecp256k1) and self.data == other.data
+
+
+@dataclass(frozen=True)
+class PrivKeySecp256k1(PrivKey):
+    data: bytes  # 32-byte big-endian scalar
+
+    def __post_init__(self):
+        if len(self.data) != SECP256K1_PRIVKEY_SIZE:
+            raise ValueError(
+                f"secp256k1 privkey must be {SECP256K1_PRIVKEY_SIZE} bytes")
+
+    @classmethod
+    def generate(cls) -> "PrivKeySecp256k1":
+        key = ec.generate_private_key(ec.SECP256K1())
+        d = key.private_numbers().private_value
+        return cls(d.to_bytes(32, "big"))
+
+    @classmethod
+    def gen_from_secret(cls, secret: bytes) -> "PrivKeySecp256k1":
+        """secp256k1.go GenPrivKeySecp256k1: sha256(secret) used
+        directly as the scalar, re-hashed until it lands in [1, n)."""
+        digest = hashlib.sha256(secret).digest()
+        d = int.from_bytes(digest, "big")
+        while d == 0 or d >= _N:
+            digest = hashlib.sha256(digest).digest()
+            d = int.from_bytes(digest, "big")
+        return cls(d.to_bytes(32, "big"))
+
+    def _key(self) -> ec.EllipticCurvePrivateKey:
+        return ec.derive_private_key(
+            int.from_bytes(self.data, "big"), ec.SECP256K1())
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _N // 2:  # low-s, like btcec
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKeySecp256k1:
+        pub = self._key().public_key()
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        return PubKeySecp256k1(
+            pub.public_bytes(Encoding.X962, PublicFormat.CompressedPoint))
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def equals(self, other) -> bool:
+        return (isinstance(other, PrivKeySecp256k1)
+                and self.data == other.data)

@@ -1,0 +1,56 @@
+"""Symmetric AEAD secret-box (reference crypto/xchacha20poly1305 +
+crypto/xsalsa20symmetric).
+
+Same capability surface — encrypt/decrypt with a 32-byte key, nonce
+handled internally, authenticated — over ChaCha20-Poly1305 (the IETF
+96-bit-nonce construction from `cryptography`; the reference's
+24-byte-nonce X variants exist only to make random nonces safe, which
+we keep by bounding messages per key the same way callers do: armored
+key files are encrypt-once). Passphrase keys are derived with scrypt
+standing in for the reference's bcrypt (armor key path,
+crypto/armor + keys).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+NONCE_SIZE = 12
+KEY_SIZE = 32
+
+
+class DecryptError(Exception):
+    pass
+
+
+def encrypt_symmetric(plaintext: bytes, key: bytes) -> bytes:
+    """xsalsa20symmetric.EncryptSymmetric equivalent:
+    nonce ‖ ciphertext+tag."""
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"key must be {KEY_SIZE} bytes")
+    nonce = os.urandom(NONCE_SIZE)
+    ct = ChaCha20Poly1305(key).encrypt(nonce, plaintext, b"")
+    return nonce + ct
+
+def decrypt_symmetric(ciphertext: bytes, key: bytes) -> bytes:
+    if len(key) != KEY_SIZE:
+        raise ValueError(f"key must be {KEY_SIZE} bytes")
+    if len(ciphertext) < NONCE_SIZE + 16:
+        raise DecryptError("ciphertext too short")
+    nonce, ct = ciphertext[:NONCE_SIZE], ciphertext[NONCE_SIZE:]
+    try:
+        return ChaCha20Poly1305(key).decrypt(nonce, ct, b"")
+    except InvalidTag:
+        raise DecryptError("ciphertext decryption failed")
+
+
+def key_from_passphrase(passphrase: str, salt: bytes) -> bytes:
+    """Derive a 32-byte key (reference uses bcrypt(12) then sha256;
+    scrypt n=2^15 gives comparable work)."""
+    return hashlib.scrypt(passphrase.encode(), salt=salt,
+                          n=1 << 15, r=8, p=1, dklen=KEY_SIZE,
+                          maxmem=64 * 1024 * 1024)

@@ -80,17 +80,14 @@ class DBProvider(Provider):
         return f"fc:{chain_id}:{height:020d}".encode()
 
     def latest_full_commit(self, chain_id, max_height):
-        best = None
-        best_h = -1
         prefix = f"fc:{chain_id}:".encode()
         end = self._key(chain_id, max_height) + b"\xff"
-        for k, v in self.db.iterator(prefix, end):
-            if not k.startswith(prefix):
-                continue
-            h = int(k[len(prefix):])
-            if best_h < h <= max_height:
-                best_h, best = h, v
-        return _fc_from_json(json.loads(best)) if best else None
+        # keys are zero-padded so they sort by height: first hit of the
+        # reverse scan IS the greatest height ≤ max_height
+        for k, v in self.db.reverse_iterator(prefix, end):
+            if k.startswith(prefix):
+                return _fc_from_json(json.loads(v))
+        return None
 
     def save_full_commit(self, fc: FullCommit) -> None:
         self.db.set(self._key(fc.signed_header.chain_id, fc.height),

@@ -182,11 +182,16 @@ class DynamicVerifier:
                     trusted_fc.next_validators,
                 ).verify(source_fc.signed_header)
             else:
-                # valset changed: accept if +1/3 of trusted signed
+                # valset changed (reference VerifyFutureCommit): BOTH
+                # +1/3 of the old trusted set signed it AND +2/3 of
+                # the commit's own claimed valset signed it
                 _verify_commit_trusting(
                     trusted_fc.next_validators or trusted_fc.validators,
                     self.chain_id, source_fc.signed_header)
                 source_fc.validate_full(self.chain_id)
+                BaseVerifier(
+                    self.chain_id, source_fc.height, source_fc.validators,
+                ).verify(source_fc.signed_header)
             self.trusted.save_full_commit(source_fc)
             return
         except ErrLiteVerification:

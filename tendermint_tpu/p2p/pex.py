@@ -6,16 +6,15 @@ p2p/pex/addrbook.go (bucketed new/old address book with biased random
 selection and JSON persistence).
 
 The book keeps two tiers: "new" (heard about, never connected) and
-"old" (we connected at least once — markGood promotes). Buckets are
-hash-partitioned like the reference (addrbook.go bucket math) but the
-bucket count is small since the semantics — bounded memory, eviction
-within a bucket, spread across sources — is what matters, not bitcoin's
-exact constants.
+"old" (we connected at least once — markGood promotes). Unlike the
+reference's bitcoin-style hash buckets, this book is one flat map with
+a global "new"-tier cap and bad-address eviction — the semantics that
+matter here (bounded memory, evict stale failures first, old entries
+never clobbered by gossip) with none of the bucket bookkeeping.
 """
 
 from __future__ import annotations
 
-import hashlib
 import json
 import logging
 import os
@@ -37,9 +36,7 @@ DEFAULT_ENSURE_PEERS_PERIOD = 30.0
 MIN_RECEIVE_REQUEST_INTERVAL = 60.0  # per-peer request rate limit
 MAX_MSG_COUNT_BY_PEER = 1000
 
-NEW_BUCKET_COUNT = 64
-OLD_BUCKET_COUNT = 16
-BUCKET_SIZE = 64
+MAX_NEW_ADDRESSES = 4096  # "new"-tier cap (stands in for bucket math)
 MAX_GET_SELECTION = 250  # addrbook.go getSelection cap
 BIAS_TO_SELECT_NEW_PEERS = 30  # pex_reactor.go:289
 
@@ -63,7 +60,6 @@ class KnownAddress:
     last_attempt: float = 0.0
     last_success: float = 0.0
     bucket_type: str = "new"  # new | old
-    buckets: List[int] = field(default_factory=list)
 
     @property
     def net_addr(self) -> str:
@@ -79,7 +75,7 @@ class KnownAddress:
 
 
 class AddrBook:
-    """Bucketed address book (reference p2p/pex/addrbook.go:57-120)."""
+    """Two-tier address book (reference p2p/pex/addrbook.go:57-120)."""
 
     def __init__(self, file_path: Optional[str] = None, strict: bool = True):
         self.file_path = file_path
@@ -101,13 +97,6 @@ class AddrBook:
 
     def is_our_address(self, nid: str, addr: str) -> bool:
         return nid.lower() in self._our_ids or addr in self._our_addrs
-
-    # -- bucket math (addrbook.go calcNewBucket/calcOldBucket) ---------
-
-    def _bucket_of(self, ka: KnownAddress) -> int:
-        n = NEW_BUCKET_COUNT if ka.bucket_type == "new" else OLD_BUCKET_COUNT
-        h = hashlib.sha256((ka.bucket_type + ka.src + ka.addr).encode()).digest()
-        return int.from_bytes(h[:4], "big") % n
 
     # -- mutation ------------------------------------------------------
 
@@ -132,9 +121,9 @@ class AddrBook:
                     return False  # already vetted; keep old entry
                 ka.addr = addr  # refresh
                 return True
-            # evict a random bad address if a bucket would overflow
+            # evict a random bad address when the new tier is full
             news = [a for a in self._addrs.values() if a.bucket_type == "new"]
-            if len(news) >= NEW_BUCKET_COUNT * BUCKET_SIZE:
+            if len(news) >= MAX_NEW_ADDRESSES:
                 now = time.time()
                 bad = [a for a in news if a.is_bad(now)] or news
                 victim = self._rand.choice(bad)

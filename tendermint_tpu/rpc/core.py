@@ -337,12 +337,29 @@ def num_unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
 # --- tx routes (rpc/core/mempool.go, tx.go) ---------------------------
 
 
+_async_pool = None
+_async_pool_lock = threading.Lock()
+
+
+def _async_executor():
+    """Shared small worker pool for fire-and-forget CheckTx — mempool
+    admission is serialized behind its own lock anyway, so per-tx
+    threads would be pure churn."""
+    global _async_pool
+    if _async_pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with _async_pool_lock:
+            if _async_pool is None:
+                _async_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="rpc-tx-async")
+    return _async_pool
+
+
 def broadcast_tx_async(env: RPCEnvironment, params: dict) -> dict:
     """CheckTx in the background; return immediately (mempool.go:26)."""
     tx = _tx_param(params)
-    threading.Thread(
-        target=_checked_check_tx, args=(env, tx), daemon=True
-    ).start()
+    _async_executor().submit(_checked_check_tx, env, tx)
     return {"code": 0, "data": "", "log": "",
             "hash": enc.hexu(compute_tx_hash(tx))}
 

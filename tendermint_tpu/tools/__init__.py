@@ -1,0 +1,2 @@
+"""Operational tools (reference tools/): tm-bench load generator and
+tm-monitor network monitor, as library modules + CLI entry points."""

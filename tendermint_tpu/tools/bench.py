@@ -1,0 +1,173 @@
+"""tm-bench equivalent — RPC load generator (reference tools/tm-bench/).
+
+N connections × rate tx/s against one or more nodes' RPC endpoints for
+a duration; reports tx throughput and block throughput like
+tools/tm-bench/statistics.go (avg/stddev/max per second).
+
+Usage: python -m tendermint_tpu.tools.bench [-c N] [-r RATE] [-T SECS]
+       [--broadcast-tx-method async|sync|commit] host:port[,host:port]
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import threading
+import time
+from typing import Dict, List
+
+from ..rpc.client import HTTPClient, WSClient
+
+
+class Transacter:
+    """One connection's send loop (tools/tm-bench/transacter.go):
+    `rate` txs per second in 1s batches."""
+
+    def __init__(self, addr: str, rate: int, size: int, method: str,
+                 conn_index: int):
+        self.client = HTTPClient(addr)
+        self.rate = rate
+        self.size = size
+        self.method = f"broadcast_tx_{method}"
+        self.conn_index = conn_index
+        self.sent = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _tx(self, i: int) -> bytes:
+        # unique tx payload: conn/index/time + random padding to size
+        head = f"bench-c{self.conn_index}-{i}-{time.time_ns()}=1".encode()
+        pad = max(self.size - len(head), 0)
+        return head + os.urandom(pad // 2).hex().encode()[:pad]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        import base64
+
+        i = 0
+        while not self._stop.is_set():
+            batch_start = time.monotonic()
+            for _ in range(self.rate):
+                if self._stop.is_set():
+                    return
+                try:
+                    self.client.call(
+                        self.method,
+                        {"tx": base64.b64encode(self._tx(i)).decode()},
+                    )
+                    self.sent += 1
+                except Exception:  # noqa: BLE001 - count and continue
+                    self.errors += 1
+                i += 1
+            elapsed = time.monotonic() - batch_start
+            if elapsed < 1.0:
+                self._stop.wait(1.0 - elapsed)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+def collect_block_stats(addr: str, start_height: int,
+                        end_height: int) -> Dict[str, float]:
+    """statistics.go: per-second tx and block counts from block metas."""
+    client = HTTPClient(addr)
+    per_sec_txs: Dict[int, int] = {}
+    per_sec_blocks: Dict[int, int] = {}
+    h = start_height
+    while h <= end_height:
+        info = client.blockchain(h, min(h + 19, end_height))
+        metas = info["block_metas"]
+        if not metas:
+            break
+        for m in metas:
+            sec = int(m["header"]["time"]) // 1_000_000_000
+            per_sec_txs[sec] = per_sec_txs.get(sec, 0) + int(
+                m["header"]["num_txs"])
+            per_sec_blocks[sec] = per_sec_blocks.get(sec, 0) + 1
+        h = min(h + 19, end_height) + 1
+
+    def stats(d: Dict[int, int]) -> Dict[str, float]:
+        if not d:
+            return {"avg": 0.0, "stddev": 0.0, "max": 0, "total": 0}
+        vals = list(d.values())
+        avg = sum(vals) / len(vals)
+        var = sum((v - avg) ** 2 for v in vals) / len(vals)
+        return {"avg": avg, "stddev": math.sqrt(var), "max": max(vals),
+                "total": sum(vals)}
+
+    tx = stats(per_sec_txs)
+    bl = stats(per_sec_blocks)
+    return {
+        "txs_per_sec_avg": tx["avg"], "txs_per_sec_stddev": tx["stddev"],
+        "txs_per_sec_max": tx["max"], "total_txs": tx["total"],
+        "blocks_per_sec_avg": bl["avg"], "blocks_per_sec_max": bl["max"],
+        "total_blocks": bl["total"],
+    }
+
+
+def run_bench(endpoints: List[str], connections: int = 1, rate: int = 1000,
+              duration: float = 10.0, tx_size: int = 250,
+              method: str = "async") -> dict:
+    """main.go flow: start transacters, run for duration, then read
+    block stats over the height range the run covered."""
+    first = HTTPClient(endpoints[0])
+    start_height = int(
+        first.status()["sync_info"]["latest_block_height"])
+    transacters = []
+    idx = 0
+    for ep in endpoints:
+        for _ in range(connections):
+            t = Transacter(ep, rate, tx_size, method, idx)
+            t.start()
+            transacters.append(t)
+            idx += 1
+    time.sleep(duration)
+    for t in transacters:
+        t.stop()
+    # allow the tail of txs to commit
+    time.sleep(1.0)
+    end_height = int(first.status()["sync_info"]["latest_block_height"])
+    stats = collect_block_stats(endpoints[0], start_height + 1, end_height)
+    stats["sent"] = sum(t.sent for t in transacters)
+    stats["send_errors"] = sum(t.errors for t in transacters)
+    stats["duration_s"] = duration
+    return stats
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tm-bench", description="RPC load generator")
+    p.add_argument("endpoints",
+                   help="comma-separated host:port RPC endpoints")
+    p.add_argument("-c", "--connections", type=int, default=1)
+    p.add_argument("-r", "--rate", type=int, default=1000)
+    p.add_argument("-T", "--duration", type=float, default=10.0)
+    p.add_argument("-s", "--size", type=int, default=250,
+                   help="tx size in bytes")
+    p.add_argument("--broadcast-tx-method", default="async",
+                   choices=("async", "sync", "commit"))
+    args = p.parse_args(argv)
+    stats = run_bench(
+        args.endpoints.split(","), connections=args.connections,
+        rate=args.rate, duration=args.duration, tx_size=args.size,
+        method=args.broadcast_tx_method,
+    )
+    print(f"Stats          Avg       StdDev     Max      Total")
+    print(f"Txs/sec        {stats['txs_per_sec_avg']:<10.0f}"
+          f"{stats['txs_per_sec_stddev']:<11.0f}"
+          f"{stats['txs_per_sec_max']:<9.0f}{stats['total_txs']}")
+    print(f"Blocks/sec     {stats['blocks_per_sec_avg']:<10.3f}"
+          f"{'':<11}{stats['blocks_per_sec_max']:<9.0f}"
+          f"{stats['total_blocks']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,174 @@
+"""tm-monitor equivalent — live network monitor (reference
+tools/tm-monitor/).
+
+Tracks N nodes over RPC + websocket NewBlock subscriptions
+(monitor/monitor.go + eventmeter): per-node height/latency/uptime and
+network-wide health (all nodes within one block of each other).
+Library-first (Monitor class) with a small curses-free CLI printer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..rpc.client import HTTPClient, WSClient
+
+
+@dataclass
+class NodeStatus:
+    """monitor/node.go Node fields we track."""
+
+    addr: str
+    moniker: str = ""
+    online: bool = False
+    height: int = 0
+    last_block_time_ns: int = 0
+    block_latency_ms: float = 0.0  # our-clock arrival delta
+    blocks_seen: int = 0
+    first_seen: float = field(default_factory=time.time)
+    last_seen: float = 0.0
+
+    @property
+    def uptime_pct(self) -> float:
+        if self.last_seen == 0:
+            return 0.0
+        window = max(self.last_seen - self.first_seen, 1e-9)
+        return 100.0 if self.online else 0.0  # simple: online-now
+
+
+HEALTH_FULL = "full"  # all nodes online + heights within 1
+HEALTH_MODERATE = "moderate"  # some nodes lagging/offline
+HEALTH_DEAD = "dead"  # no node responding
+
+
+class Monitor:
+    """monitor/monitor.go: poll status + subscribe to NewBlock."""
+
+    def __init__(self, addrs: List[str], poll_interval: float = 1.0):
+        self.nodes: Dict[str, NodeStatus] = {
+            a: NodeStatus(addr=a) for a in addrs
+        }
+        self.poll_interval = poll_interval
+        self._ws: Dict[str, WSClient] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for addr in self.nodes:
+            t = threading.Thread(
+                target=self._watch_node, args=(addr,), daemon=True,
+                name=f"monitor-{addr}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for ws in self._ws.values():
+            ws.close()
+
+    def _watch_node(self, addr: str) -> None:
+        ns = self.nodes[addr]
+        client = HTTPClient(addr, timeout=2.0)
+        ws: Optional[WSClient] = None
+        while not self._stop.is_set():
+            try:
+                st = client.status()
+                ns.online = True
+                ns.last_seen = time.time()
+                ns.moniker = st["node_info"]["moniker"]
+                ns.height = int(st["sync_info"]["latest_block_height"])
+                ns.last_block_time_ns = int(
+                    st["sync_info"]["latest_block_time"])
+                if ws is None:
+                    ws = WSClient(addr, on_event=lambda ev, a=addr:
+                                  self._on_block(a, ev))
+                    ws.connect(timeout=2.0)
+                    ws.subscribe("tm.event = 'NewBlock'")
+                    self._ws[addr] = ws
+            except Exception:  # noqa: BLE001 - node down: mark + retry
+                ns.online = False
+                if ws is not None:
+                    ws.close()
+                    ws = None
+                    self._ws.pop(addr, None)
+            self._stop.wait(self.poll_interval)
+
+    def _on_block(self, addr: str, ev: dict) -> None:
+        ns = self.nodes[addr]
+        try:
+            header = ev["data"]["value"]["block"]["header"]
+        except (KeyError, TypeError):
+            return
+        ns.blocks_seen += 1
+        ns.height = max(ns.height, int(header["height"]))
+        block_t_ns = int(header["time"])
+        ns.block_latency_ms = max(
+            (time.time_ns() - block_t_ns) / 1e6, 0.0)
+        ns.last_seen = time.time()
+        ns.online = True
+
+    # -- network health (monitor/network.go:NodeIsDown etc.) -----------
+
+    def health(self) -> str:
+        statuses = list(self.nodes.values())
+        online = [n for n in statuses if n.online]
+        if not online:
+            return HEALTH_DEAD
+        heights = [n.height for n in online]
+        if len(online) == len(statuses) and max(heights) - min(heights) <= 1:
+            return HEALTH_FULL
+        return HEALTH_MODERATE
+
+    def network_height(self) -> int:
+        return max((n.height for n in self.nodes.values()), default=0)
+
+    def snapshot(self) -> dict:
+        return {
+            "health": self.health(),
+            "height": self.network_height(),
+            "nodes": [
+                {
+                    "addr": n.addr,
+                    "moniker": n.moniker,
+                    "online": n.online,
+                    "height": n.height,
+                    "blocks_seen": n.blocks_seen,
+                    "block_latency_ms": round(n.block_latency_ms, 1),
+                }
+                for n in self.nodes.values()
+            ],
+        }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tm-monitor", description="network monitor over RPC")
+    p.add_argument("endpoints",
+                   help="comma-separated host:port RPC endpoints")
+    p.add_argument("-i", "--interval", type=float, default=2.0,
+                   help="print interval seconds")
+    args = p.parse_args(argv)
+    mon = Monitor(args.endpoints.split(","))
+    mon.start()
+    try:
+        while True:
+            time.sleep(args.interval)
+            snap = mon.snapshot()
+            print(f"health={snap['health']} height={snap['height']}")
+            for n in snap["nodes"]:
+                state = "UP" if n["online"] else "DOWN"
+                print(f"  {n['moniker'] or n['addr']:<20} {state:<5} "
+                      f"h={n['height']:<8} blocks={n['blocks_seen']:<6} "
+                      f"lat={n['block_latency_ms']}ms")
+    except KeyboardInterrupt:
+        mon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,179 @@
+"""secp256k1, multisig, symmetric secret-box, armor tests (reference
+crypto/secp256k1/secp256k1_test.go, crypto/multisig/*_test.go,
+crypto/armor/armor_test.go).
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.crypto.armor import (
+    decode_armor,
+    encode_armor,
+    encrypt_armor_privkey,
+    unarmor_decrypt_privkey,
+)
+from tendermint_tpu.crypto.keys import (
+    PrivKeyEd25519,
+    privkey_from_bytes,
+    privkey_to_bytes,
+    pubkey_from_bytes,
+    pubkey_to_bytes,
+)
+from tendermint_tpu.crypto.multisig import (
+    CompactBitArray,
+    Multisignature,
+    PubKeyMultisigThreshold,
+)
+from tendermint_tpu.crypto.secp256k1 import (
+    PrivKeySecp256k1,
+    PubKeySecp256k1,
+)
+from tendermint_tpu.crypto.symmetric import (
+    DecryptError,
+    decrypt_symmetric,
+    encrypt_symmetric,
+    key_from_passphrase,
+)
+
+
+# --- secp256k1 --------------------------------------------------------
+
+
+def test_secp256k1_sign_verify():
+    sk = PrivKeySecp256k1.generate()
+    pk = sk.pub_key()
+    msg = b"hello secp"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    assert pk.verify_bytes(msg, sig)
+    assert not pk.verify_bytes(b"other", sig)
+    assert not pk.verify_bytes(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    assert not pk.verify_bytes(msg, b"short")
+
+
+def test_secp256k1_deterministic_from_secret():
+    a = PrivKeySecp256k1.gen_from_secret(b"seed")
+    b = PrivKeySecp256k1.gen_from_secret(b"seed")
+    assert a.data == b.data
+    assert a.pub_key().data == b.pub_key().data
+    assert len(a.pub_key().data) == 33
+    assert len(a.pub_key().address()) == 20  # RIPEMD160
+
+
+def test_secp256k1_serde_roundtrip():
+    sk = PrivKeySecp256k1.generate()
+    assert privkey_from_bytes(privkey_to_bytes(sk)).data == sk.data
+    pk = sk.pub_key()
+    pk2 = pubkey_from_bytes(pubkey_to_bytes(pk))
+    assert pk2.data == pk.data
+    assert isinstance(pk2, PubKeySecp256k1)
+
+
+# --- compact bit array ------------------------------------------------
+
+
+def test_compact_bit_array():
+    ba = CompactBitArray(10)
+    assert not ba.get_index(3)
+    assert ba.set_index(3, True)
+    assert ba.set_index(9, True)
+    assert ba.get_index(3) and ba.get_index(9)
+    assert not ba.set_index(10, True)  # out of range
+    assert ba.num_true_bits_before(4) == 1
+    assert ba.num_true_bits_before(10) == 2
+    assert ba.count_true() == 2
+    ba2 = CompactBitArray.from_bytes(ba.to_bytes())
+    assert ba2 == ba
+    ba.set_index(3, False)
+    assert ba.count_true() == 1
+
+
+# --- threshold multisig -----------------------------------------------
+
+
+def _multisig_fixture(k=2, n=3):
+    sks = [PrivKeyEd25519.gen_from_secret(b"ms-%d" % i) for i in range(n)]
+    pks = tuple(sk.pub_key() for sk in sks)
+    mpk = PubKeyMultisigThreshold(k=k, pubkeys=pks)
+    return sks, pks, mpk
+
+
+def test_multisig_k_of_n():
+    sks, pks, mpk = _multisig_fixture()
+    msg = b"multisig message"
+    ms = Multisignature(CompactBitArray(3))
+    # one sig: below threshold
+    ms.add_signature_from_pubkey(sks[0].sign(msg), pks[0], list(pks))
+    assert not mpk.verify_bytes(msg, ms.marshal())
+    # two sigs (0, 2): meets 2-of-3
+    ms.add_signature_from_pubkey(sks[2].sign(msg), pks[2], list(pks))
+    assert mpk.verify_bytes(msg, ms.marshal())
+    # wrong message fails
+    assert not mpk.verify_bytes(b"other", ms.marshal())
+
+
+def test_multisig_bad_member_sig_rejected():
+    sks, pks, mpk = _multisig_fixture()
+    msg = b"m"
+    ms = Multisignature(CompactBitArray(3))
+    ms.add_signature_from_pubkey(sks[0].sign(msg), pks[0], list(pks))
+    # signature claimed for member 1 but signed by an outsider
+    outsider = PrivKeyEd25519.gen_from_secret(b"evil")
+    ms.add_signature_from_pubkey(outsider.sign(msg), pks[1], list(pks))
+    assert not mpk.verify_bytes(msg, ms.marshal())
+
+
+def test_multisig_address_and_serde():
+    _, pks, mpk = _multisig_fixture()
+    assert len(mpk.address()) == 20
+    mpk2 = pubkey_from_bytes(pubkey_to_bytes(mpk))
+    assert mpk2.equals(mpk)
+    assert mpk2.address() == mpk.address()
+
+
+def test_multisig_replace_signature():
+    sks, pks, mpk = _multisig_fixture()
+    msg = b"m"
+    ms = Multisignature(CompactBitArray(3))
+    ms.add_signature_from_pubkey(b"\x00" * 64, pks[0], list(pks))
+    ms.add_signature_from_pubkey(sks[1].sign(msg), pks[1], list(pks))
+    # replace the garbage sig for member 0
+    ms.add_signature_from_pubkey(sks[0].sign(msg), pks[0], list(pks))
+    assert len(ms.sigs) == 2
+    assert mpk.verify_bytes(msg, ms.marshal())
+
+
+# --- symmetric + armor ------------------------------------------------
+
+
+def test_symmetric_roundtrip():
+    key = key_from_passphrase("hunter2", b"salt" * 4)
+    ct = encrypt_symmetric(b"secret payload", key)
+    assert decrypt_symmetric(ct, key) == b"secret payload"
+    wrong = key_from_passphrase("hunter3", b"salt" * 4)
+    with pytest.raises(DecryptError):
+        decrypt_symmetric(ct, wrong)
+    with pytest.raises(DecryptError):
+        decrypt_symmetric(ct[:-1] + bytes([ct[-1] ^ 1]), key)
+
+
+def test_armor_roundtrip():
+    data = os.urandom(200)
+    s = encode_armor("TEST BLOCK", {"header": "value", "kdf": "scrypt"}, data)
+    block_type, headers, out = decode_armor(s)
+    assert block_type == "TEST BLOCK"
+    assert headers == {"header": "value", "kdf": "scrypt"}
+    assert out == data
+
+
+def test_encrypt_armor_privkey_roundtrip():
+    sk = PrivKeyEd25519.generate()
+    armored = encrypt_armor_privkey(sk, "passphrase123")
+    assert "BEGIN TENDERMINT PRIVATE KEY" in armored
+    out = unarmor_decrypt_privkey(armored, "passphrase123")
+    assert out.bytes() == sk.bytes()
+    with pytest.raises(DecryptError):
+        unarmor_decrypt_privkey(armored, "wrong")

@@ -1,0 +1,78 @@
+"""Tools tests (reference tools/tm-bench + tm-monitor): run both
+against a live single-validator node.
+"""
+
+import os
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from test_node import init_files, make_config
+
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.tools.bench import run_bench
+from tendermint_tpu.tools.monitor import HEALTH_FULL, Monitor
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+
+@pytest.fixture(scope="module")
+def live_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tools")
+    c = make_config(tmp, "n0")
+    c.rpc.laddr = "tcp://127.0.0.1:0"
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    sub = node.event_bus.subscribe("warm", query_for_event(EVENT_NEW_BLOCK), 8)
+    deadline = time.time() + 30
+    h = 0
+    while h < 2 and time.time() < deadline:
+        m = sub.get(timeout=1.0)
+        if m is not None:
+            h = m.data["block"].header.height
+    assert h >= 2
+    yield node
+    node.stop()
+
+
+def test_bench_generates_load(live_node):
+    stats = run_bench(
+        [live_node.rpc_listen_addr], connections=2, rate=50,
+        duration=3.0, tx_size=64, method="sync",
+    )
+    assert stats["sent"] > 0
+    assert stats["send_errors"] == 0
+    assert stats["total_txs"] > 0, f"no txs committed: {stats}"
+    assert stats["total_blocks"] > 0
+
+
+def test_monitor_tracks_node(live_node):
+    mon = Monitor([live_node.rpc_listen_addr], poll_interval=0.2)
+    mon.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            snap = mon.snapshot()
+            if (snap["health"] == HEALTH_FULL
+                    and snap["nodes"][0]["blocks_seen"] >= 2):
+                break
+            time.sleep(0.2)
+        snap = mon.snapshot()
+        assert snap["health"] == HEALTH_FULL
+        assert snap["nodes"][0]["online"]
+        assert snap["nodes"][0]["blocks_seen"] >= 2
+        assert snap["height"] >= 2
+    finally:
+        mon.stop()
+
+
+def test_monitor_detects_down():
+    mon = Monitor(["127.0.0.1:1"], poll_interval=0.1)
+    mon.start()
+    try:
+        time.sleep(0.5)
+        assert mon.health() == "dead"
+    finally:
+        mon.stop()
