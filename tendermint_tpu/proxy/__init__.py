@@ -38,11 +38,18 @@ def default_client_creator(address: str) -> ClientCreator:
         from ..abci.example.kvstore import KVStoreApplication
 
         return local_client_creator(KVStoreApplication())
-    if address == "persistent_kvstore":
+    if address == "persistent_kvstore" or address.startswith(
+            "persistent_kvstore:"):
+        # "persistent_kvstore:<path>" backs the app with disk so state
+        # survives process restarts — what the crash/restart matrix
+        # needs (reference runs the app in its own process; in-proc +
+        # FileDB gives the same persistence shape)
         from ..abci.example.kvstore import PersistentKVStoreApplication
-        from ..libs.db import MemDB
+        from ..libs.db import FileDB, MemDB
 
-        return local_client_creator(PersistentKVStoreApplication(MemDB()))
+        _, _, path = address.partition(":")
+        db = FileDB(path) if path else MemDB()
+        return local_client_creator(PersistentKVStoreApplication(db))
     if address == "counter":
         from ..abci.example.counter import CounterApplication
 

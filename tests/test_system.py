@@ -1,0 +1,275 @@
+"""System-test tier (reference test/persist/test_failure_indices.sh +
+test/p2p/): subprocess crash/restart matrix over every fail point, and
+a multi-process localnet with node kill/catch-up.
+
+These are the heaviest tests in the suite; each node subprocess uses
+the fast test timeouts written into its config.toml.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.rpc.client import HTTPClient
+
+ENV = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu", JAX_PLATFORMS="cpu")
+
+# the 8 fail-point sites hit during one block commit (libs/fail.py
+# wired at consensus/state.py finalize_commit + state/execution.py
+# apply_block; reference consensus/state.go:1251-1308 +
+# state/execution.go:103-145)
+NUM_FAIL_POINTS = 8
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _write_fast_timeouts(home: str) -> None:
+    from tendermint_tpu import config as cfg
+
+    c = cfg.Config.load(os.path.join(home, "config", "config.toml"))
+    c.set_root(home)
+    t = cfg.test_config().consensus
+    c.consensus = t
+    c.save(os.path.join(home, "config", "config.toml"))
+
+
+def _init_home(home: str, chain_id: str) -> None:
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "--home", home,
+         "init", "--chain-id", chain_id],
+        check=True, env=ENV, capture_output=True,
+    )
+    _write_fast_timeouts(home)
+
+
+def _start_node(home: str, rpc_port: int, p2p_port: int,
+                extra_env=None, proxy_app: str = None):
+    env = dict(ENV)
+    if extra_env:
+        env.update(extra_env)
+    # log to a file, not a pipe: nobody drains a pipe during the long
+    # waits below, and a full pipe buffer would block the node's logging
+    log = open(os.path.join(home, "node.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "--home", home,
+         "node",
+         "--proxy_app", proxy_app or f"persistent_kvstore:{home}/app.db",
+         "--p2p.laddr", f"tcp://127.0.0.1:{p2p_port}",
+         "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}"],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+    )
+    proc.log_path = os.path.join(home, "node.log")
+    log.close()
+    return proc
+
+
+def _node_log(proc) -> str:
+    try:
+        with open(proc.log_path, "rb") as f:
+            return f.read().decode(errors="replace")[-3000:]
+    except OSError:
+        return "<no log>"
+
+
+def _wait_height(port: int, min_height: int, timeout: float,
+                 proc=None) -> int:
+    client = HTTPClient(f"127.0.0.1:{port}", timeout=2.0)
+    deadline = time.time() + timeout
+    height = 0
+    while time.time() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return height  # process exited (expected for crash tests)
+        try:
+            st = client.status()
+            height = int(st["sync_info"]["latest_block_height"])
+            if height >= min_height:
+                return height
+        except Exception:
+            pass
+        time.sleep(0.25)
+    return height
+
+
+@pytest.mark.parametrize("fail_index", range(NUM_FAIL_POINTS))
+def test_crash_restart_matrix(tmp_path, fail_index):
+    """Kill the node at fail point `fail_index` during its first block
+    commit, restart, and require the chain to advance past the crash —
+    WAL replay + ABCI handshake must reconcile whatever half-finished
+    state the crash left (reference test_failure_indices.sh)."""
+    home = str(tmp_path / "home")
+    _init_home(home, f"crash-chain-{fail_index}")
+    rpc, p2p = _free_port(), _free_port()
+
+    proc = _start_node(home, rpc, p2p,
+                       extra_env={"FAIL_TEST_INDEX": str(fail_index)})
+    try:
+        proc.wait(timeout=90)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        pytest.fail(f"node never hit fail point {fail_index}")
+    assert proc.returncode == 1, (
+        f"expected crash exit, got {proc.returncode}:\n{_node_log(proc)}")
+
+    # restart clean: must recover and keep committing
+    rpc2, p2p2 = _free_port(), _free_port()
+    proc2 = _start_node(home, rpc2, p2p2)
+    try:
+        h = _wait_height(rpc2, 3, 90, proc=proc2)
+        if proc2.poll() is not None:
+            pytest.fail(
+                f"restarted node exited rc={proc2.returncode}:\n"
+                f"{_node_log(proc2)}")
+        assert h >= 3, f"chain stuck at {h} after crash at point {fail_index}"
+        # app state and chain state agree (handshake reconciled them)
+        client = HTTPClient(f"127.0.0.1:{rpc2}", timeout=2.0)
+        info = client.abci_info()
+        assert int(info["response"]["last_block_height"]) >= 1
+    finally:
+        proc2.send_signal(signal.SIGTERM)
+        try:
+            proc2.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+
+
+def test_localnet_kill_one_node_and_catchup(tmp_path):
+    """4-validator multi-process localnet (reference test/p2p): all
+    sync; kill one, the rest keep committing (>2/3 power remains);
+    restart it and require it to catch back up."""
+    out = str(tmp_path / "net")
+    n = 4
+    ports = [(_free_port(), _free_port()) for _ in range(n)]
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+         "--v", str(n), "--o", out, "--chain-id", "localnet",
+         "--starting-port", "1"],  # ports rewritten below
+        check=True, env=ENV, capture_output=True,
+    )
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.p2p import NodeKey
+
+    ids = []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        ids.append(NodeKey.load(
+            os.path.join(home, "config", "node_key.json")).id)
+    peers = ",".join(
+        f"{ids[i]}@127.0.0.1:{ports[i][1]}" for i in range(n))
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        c = cfg.Config.load(os.path.join(home, "config", "config.toml"))
+        c.set_root(home)
+        c.consensus = cfg.test_config().consensus
+        c.consensus.timeout_commit = 0.3  # give slow CI some slack
+        c.consensus.skip_timeout_commit = False
+        c.rpc.laddr = f"tcp://127.0.0.1:{ports[i][0]}"
+        c.p2p.laddr = f"tcp://127.0.0.1:{ports[i][1]}"
+        c.p2p.persistent_peers = peers
+        c.save(os.path.join(home, "config", "config.toml"))
+
+    procs = []
+    try:
+        for i in range(n):
+            home = os.path.join(out, f"node{i}")
+            procs.append(_start_node(
+                home, ports[i][0], ports[i][1],
+                proxy_app="kvstore"))
+        # all nodes reach height 3
+        for i in range(n):
+            h = _wait_height(ports[i][0], 3, 120, proc=procs[i])
+            assert h >= 3, f"node{i} stuck at {h}"
+
+        # kill node 3; the remaining 3/4 keep committing
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        h_before = _wait_height(ports[0][0], 1, 10)
+        h_after = _wait_height(ports[0][0], h_before + 2, 60)
+        assert h_after >= h_before + 2, "net stalled after killing 1 of 4"
+
+        # restart node 3: must catch up past the net's height
+        home3 = os.path.join(out, "node3")
+        procs[3] = _start_node(home3, ports[3][0], ports[3][1],
+                               proxy_app="kvstore")
+        target = _wait_height(ports[0][0], 1, 10) + 1
+        h3 = _wait_height(ports[3][0], target, 120, proc=procs[3])
+        assert h3 >= target, f"restarted node stuck at {h3} < {target}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_fuzzed_connection_delivery():
+    """Gossip survives a perturbed transport (reference p2p/fuzz.go +
+    FuzzConnConfig): two switches through delay-mode fuzzed conns
+    (random sleeps injected into reads/writes) still exchange mempool
+    txs."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.mempool.reactor import MempoolReactor
+    from tendermint_tpu.p2p import (
+        MultiplexTransport,
+        NodeInfo,
+        NodeKey,
+        ProtocolVersion,
+        Switch,
+    )
+    from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    fuzz_cfg = FuzzConnConfig(mode="delay", prob_sleep=0.05,
+                              max_delay=0.01)
+
+    nodes = []
+    for i in range(2):
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        conns.start()
+        mp = Mempool(cfg.MempoolConfig(), conns.mempool)
+        nk = NodeKey(PrivKeyEd25519.generate())
+        ni = NodeInfo(
+            protocol_version=ProtocolVersion(), id=nk.id, listen_addr="",
+            network="fuzz-net", version="dev", channels=bytes([0x30]),
+            moniker=f"fuzz{i}",
+        )
+        tr = MultiplexTransport(
+            ni, nk, fuzz_wrap=lambda c: FuzzedConnection(c, fuzz_cfg))
+        tr.listen("127.0.0.1:0")
+        ni.listen_addr = tr.listen_addr
+        sw = Switch(tr)
+        sw.add_reactor("MEMPOOL", MempoolReactor(cfg.MempoolConfig(), mp))
+        nodes.append((sw, mp, conns))
+
+    try:
+        for sw, _, _ in nodes:
+            sw.start()
+        assert nodes[0][0].dial_peer(
+            nodes[1][0].transport.listen_addr) is not None
+        nodes[0][1].check_tx(b"fuzzkey=fuzzval")
+        deadline = time.time() + 30
+        while time.time() < deadline and nodes[1][1].size() == 0:
+            time.sleep(0.1)
+        assert nodes[1][1].size() == 1, "tx not gossiped over fuzzed conn"
+    finally:
+        for sw, _, conns in nodes:
+            sw.stop()
+            conns.stop()
