@@ -217,6 +217,23 @@ def cmd_lite(args) -> int:
     return 0
 
 
+def cmd_priv_val_server(args) -> int:
+    """Standalone remote-signer process (reference
+    cmd/priv_val_server/main.go): dials the node's
+    priv_validator_laddr and serves signing requests from a FilePV."""
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.privval.remote import RemoteSignerServer
+
+    logging.basicConfig(level=logging.INFO)
+    pv = load_or_gen_file_pv(args.priv)
+    print(f"serving validator {pv.get_address().hex()} -> {args.addr}",
+          flush=True)
+    srv = RemoteSignerServer(args.addr, pv)
+    srv.connect()
+    srv.serve_forever()  # returns when the node hangs up
+    return 0
+
+
 def cmd_version(args) -> int:
     from tendermint_tpu import __version__
 
@@ -285,6 +302,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--laddr", default="tcp://localhost:8888")
     sp.add_argument("--chain-id", default="tendermint")
     sp.set_defaults(fn=cmd_lite)
+
+    sp = sub.add_parser("priv_val_server",
+                        help="run a remote signing server")
+    sp.add_argument("--addr", default="tcp://127.0.0.1:26659",
+                    help="node priv_validator_laddr to dial")
+    sp.add_argument("--priv", default="priv_validator.json",
+                    help="priv validator key file")
+    sp.set_defaults(fn=cmd_priv_val_server)
 
     sub.add_parser("version", help="print the version").set_defaults(
         fn=cmd_version)

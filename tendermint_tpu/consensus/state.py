@@ -82,11 +82,15 @@ class ConsensusState:
         event_bus: Optional[EventBus] = None,
         priv_validator=None,
         wal=None,
+        metrics=None,
     ):
         self.config = config
         self.block_exec = block_exec
         self.block_store = block_store
+        from ..metrics import ConsensusMetrics
         from ..types.event_bus import NopEventBus
+
+        self.metrics = metrics if metrics is not None else ConsensusMetrics()
 
         self.mempool = mempool
         self.evpool = evpool
@@ -704,8 +708,34 @@ class ConsensusState:
         fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
 
         self.n_height_committed += 1
+        self._record_metrics(block, block_parts)
         self.update_to_state(state_copy)  # :1306
         self._schedule_round0(self.rs)  # :1312
+
+    def _record_metrics(self, block, block_parts) -> None:
+        """reference consensus/state.go recordMetrics:1320-1350."""
+        m = self.metrics
+        m.height.set(block.header.height)
+        m.committed_height.set(block.header.height)
+        m.rounds.set(self.rs.round)
+        if self.rs.validators is not None:
+            m.validators.set(len(self.rs.validators))
+            m.validators_power.set(self.rs.validators.total_voting_power())
+        if block.last_commit is not None:
+            m.missing_validators.set(
+                sum(1 for v in block.last_commit.precommits if v is None))
+        m.byzantine_validators.set(len(block.evidence.evidence))
+        m.num_txs.set(len(block.data.txs))
+        m.total_txs.add(len(block.data.txs))
+        # the part set already holds the encoded block — no re-encode
+        m.block_size_bytes.set(sum(
+            len(block_parts.get_part(i).bytes)
+            for i in range(block_parts.total())
+            if block_parts.get_part(i) is not None))
+        prev = self.block_store.load_block_meta(block.header.height - 1)
+        if prev is not None:
+            m.block_interval_seconds.observe(
+                max(block.header.time - prev.header.time, 0) / 1e9)
 
     # --- proposal handling --------------------------------------------------
 

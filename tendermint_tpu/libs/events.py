@@ -45,6 +45,8 @@ class _Condition:
     def matches(self, tags: Dict[str, str]) -> bool:
         if self.key not in tags:
             return False
+        if self.op == "EXISTS":
+            return True
         return match_op(self.op, tags[self.key], self.value)
 
 
@@ -64,6 +66,11 @@ class Query:
         parts = re.split(r"\bAND\b(?=(?:[^']*'[^']*')*[^']*$)", s)
         for part in parts:
             part = part.strip()
+            m = re.match(r"^(?P<key>[\w.\-]+)\s+EXISTS$", part)
+            if m:
+                self.conditions.append(
+                    _Condition(key=m.group("key"), op="EXISTS", value=""))
+                continue
             m = re.match(
                 r"^(?P<key>[\w.\-]+)\s*(?P<op>=|<=|>=|<|>|CONTAINS)\s*"
                 r"(?:'(?P<qval>[^']*)'|(?P<val>[\w.\-]+))$",

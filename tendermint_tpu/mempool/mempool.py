@@ -86,10 +86,14 @@ class Mempool:
         config: MempoolConfig,
         proxy_app,  # mempool connection client
         height: int = 0,
+        metrics=None,
     ):
+        from ..metrics import MempoolMetrics
+
         self.config = config
         self.proxy_app = proxy_app
         self.height = height
+        self.metrics = metrics if metrics is not None else MempoolMetrics()
         self._lock = threading.RLock()  # the proxy/update mutex
         self._txs: List[MempoolTx] = []
         self._txs_map: Dict[bytes, MempoolTx] = {}
@@ -196,9 +200,12 @@ class Mempool:
                 self._txs.append(mtx)
                 self._txs_map[_tx_key(tx)] = mtx
                 LOG.debug("added good tx %s (pool=%d)", _tx_key(tx).hex()[:12], len(self._txs))
+                self.metrics.size.set(len(self._txs))
+                self.metrics.tx_size_bytes.observe(len(tx))
                 self._fire_txs_available()
                 self._cond.notify_all()
             else:
+                self.metrics.failed_txs.inc()
                 # ineligible: evict from cache so a future fixed app state
                 # can re-admit it (reference :389-394)
                 self.cache.remove(tx)
@@ -259,7 +266,9 @@ class Mempool:
 
         if kept and self.config.recheck:
             LOG.debug("rechecking %d txs at height %d", len(kept), height)
+            self.metrics.recheck_times.inc(len(kept))
             self._recheck_txs()
+        self.metrics.size.set(len(self._txs))
         if self._txs:
             self._fire_txs_available()
 

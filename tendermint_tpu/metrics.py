@@ -1,0 +1,146 @@
+"""Per-subsystem metrics (reference consensus/metrics.go,
+p2p/metrics.go, mempool/metrics.go, state/metrics.go; wired by the
+MetricsProvider in node/node.go:100-113).
+
+`prometheus_metrics(namespace)` builds live metric sets over one
+Registry; `nop_metrics()` builds no-op sets (NopMetrics in each
+reference metrics.go) so instrumented code never branches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .libs.metrics import Registry
+
+
+class _Nop:
+    """Absorbs inc/set/add/observe/with_labels calls."""
+
+    def __getattr__(self, item):
+        if item == "with_labels":
+            return lambda *a: self
+        return lambda *a, **k: None
+
+
+NOP = _Nop()
+
+
+@dataclass
+class ConsensusMetrics:
+    """consensus/metrics.go:12-57"""
+
+    height: object = NOP
+    rounds: object = NOP
+    validators: object = NOP
+    validators_power: object = NOP
+    missing_validators: object = NOP
+    byzantine_validators: object = NOP
+    block_interval_seconds: object = NOP
+    num_txs: object = NOP
+    block_size_bytes: object = NOP
+    total_txs: object = NOP
+    committed_height: object = NOP
+
+
+@dataclass
+class P2PMetrics:
+    """p2p/metrics.go:12-28"""
+
+    peers: object = NOP
+    peer_receive_bytes_total: object = NOP
+    peer_send_bytes_total: object = NOP
+
+
+@dataclass
+class MempoolMetrics:
+    """mempool/metrics.go:12-25"""
+
+    size: object = NOP
+    tx_size_bytes: object = NOP
+    failed_txs: object = NOP
+    recheck_times: object = NOP
+
+
+@dataclass
+class StateMetrics:
+    """state/metrics.go:10-22"""
+
+    block_processing_time: object = NOP
+
+
+@dataclass
+class NodeMetrics:
+    consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
+    p2p: P2PMetrics = field(default_factory=P2PMetrics)
+    mempool: MempoolMetrics = field(default_factory=MempoolMetrics)
+    state: StateMetrics = field(default_factory=StateMetrics)
+    registry: Optional[Registry] = None
+
+
+def nop_metrics() -> NodeMetrics:
+    return NodeMetrics()
+
+
+def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
+    """DefaultMetricsProvider (each reference metrics.go
+    PrometheusMetrics constructor)."""
+    r = Registry()
+    ns = namespace
+    cons = ConsensusMetrics(
+        height=r.gauge(f"{ns}_consensus_height",
+                       "Height of the chain."),
+        rounds=r.gauge(f"{ns}_consensus_rounds",
+                       "Number of rounds at the latest height."),
+        validators=r.gauge(f"{ns}_consensus_validators",
+                           "Number of validators."),
+        validators_power=r.gauge(f"{ns}_consensus_validators_power",
+                                 "Total voting power of validators."),
+        missing_validators=r.gauge(
+            f"{ns}_consensus_missing_validators",
+            "Validators missing from the last commit."),
+        byzantine_validators=r.gauge(
+            f"{ns}_consensus_byzantine_validators",
+            "Validators with evidence against them."),
+        block_interval_seconds=r.histogram(
+            f"{ns}_consensus_block_interval_seconds",
+            "Time between this and the last block.",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30, 60)),
+        num_txs=r.gauge(f"{ns}_consensus_num_txs",
+                        "Number of transactions in the latest block."),
+        block_size_bytes=r.gauge(f"{ns}_consensus_block_size_bytes",
+                                 "Size of the latest block."),
+        total_txs=r.gauge(f"{ns}_consensus_total_txs",
+                          "Total transactions committed."),
+        committed_height=r.gauge(f"{ns}_consensus_latest_block_height",
+                                 "Latest committed block height."),
+    )
+    p2p = P2PMetrics(
+        peers=r.gauge(f"{ns}_p2p_peers", "Number of connected peers."),
+        peer_receive_bytes_total=r.counter(
+            f"{ns}_p2p_peer_receive_bytes_total",
+            "Bytes received from peers.", ("peer_id",)),
+        peer_send_bytes_total=r.counter(
+            f"{ns}_p2p_peer_send_bytes_total",
+            "Bytes sent to peers.", ("peer_id",)),
+    )
+    mem = MempoolMetrics(
+        size=r.gauge(f"{ns}_mempool_size",
+                     "Number of uncommitted transactions."),
+        tx_size_bytes=r.histogram(
+            f"{ns}_mempool_tx_size_bytes", "Tx sizes in bytes.",
+            buckets=(32, 128, 512, 2048, 8192, 32768, 131072)),
+        failed_txs=r.counter(f"{ns}_mempool_failed_txs",
+                             "Transactions that failed CheckTx."),
+        recheck_times=r.counter(f"{ns}_mempool_recheck_times",
+                                "Times transactions were rechecked."),
+    )
+    state = StateMetrics(
+        block_processing_time=r.histogram(
+            f"{ns}_state_block_processing_time",
+            "Time spent processing a block (s).",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)),
+    )
+    return NodeMetrics(consensus=cons, p2p=p2p, mempool=mem, state=state,
+                       registry=r)

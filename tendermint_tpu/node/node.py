@@ -95,6 +95,17 @@ class Node:
         if backend != "memdb":
             os.makedirs(db_dir, exist_ok=True)
 
+        # MetricsProvider (node/node.go:100-113): live Prometheus
+        # metrics when instrumentation is on, no-ops otherwise
+        from ..metrics import nop_metrics, prometheus_metrics
+
+        if config.instrumentation.prometheus:
+            self.metrics = prometheus_metrics(
+                config.instrumentation.namespace)
+        else:
+            self.metrics = nop_metrics()
+        self._metrics_server = None
+
         # --- storage (node/node.go:162-171) --------------------------
         self.block_store_db = db_provider("blockstore", backend, db_dir)
         self.state_db = db_provider("state", backend, db_dir)
@@ -126,6 +137,7 @@ class Node:
             config.mempool,
             self.proxy_app.mempool,
             height=state.last_block_height,
+            metrics=self.metrics.mempool,
         )
         if config.mempool.wal_path:
             self.mempool.init_wal(os.path.join(root, config.mempool.wal_path))
@@ -148,6 +160,7 @@ class Node:
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            metrics=self.metrics.state,
         )
 
         # --- consensus (node/node.go:309-326) ------------------------
@@ -166,6 +179,7 @@ class Node:
             event_bus=self.event_bus,
             priv_validator=priv_validator,
             wal=wal,
+            metrics=self.metrics.consensus,
         )
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, fast_sync=fast_sync
@@ -218,6 +232,7 @@ class Node:
             mconfig=mconfig,
             max_inbound=config.p2p.max_num_inbound_peers,
             max_outbound=config.p2p.max_num_outbound_peers,
+            metrics=self.metrics.p2p,
         )
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
@@ -262,6 +277,15 @@ class Node:
             self._start_rpc()
         if self.config.base.prof_laddr:
             self._start_prof()
+        if (self.config.instrumentation.prometheus
+                and self.metrics.registry is not None):
+            from ..libs.metrics import MetricsServer
+
+            addr = self.config.instrumentation.prometheus_listen_addr
+            host, _, port = addr.rpartition(":")
+            self._metrics_server = MetricsServer(
+                self.metrics.registry, host or "0.0.0.0", int(port))
+            self._metrics_server.start()
 
         laddr = _split_addr(self.config.p2p.laddr)
         self.transport.listen(laddr)
@@ -314,7 +338,8 @@ class Node:
         if not self._running:
             return
         self._running = False
-        for srv in (self._rpc_server, self._grpc_server, self._prof_server):
+        for srv in (self._rpc_server, self._grpc_server, self._prof_server,
+                    self._metrics_server):
             if srv is not None:
                 srv.stop()
         self.sw.stop()
@@ -324,6 +349,10 @@ class Node:
         self.event_bus.stop()
         self.mempool.close_wal()
         self.proxy_app.stop()
+        # remote signer (SocketPV) holds a conn + listener; hang up so
+        # the signer process sees EOF and the laddr can be re-bound
+        if hasattr(self.priv_validator, "close"):
+            self.priv_validator.close()
         self._stopped.set()
 
     def wait(self, timeout: Optional[float] = None) -> None:
@@ -336,7 +365,16 @@ def default_new_node(config: cfg.Config) -> Node:
     and construct a Node (reference node/node.go:83-98)."""
     cfg.ensure_root(config.root_dir)
     node_key = NodeKey.load_or_gen(config.base.node_key_path())
-    pv = load_or_gen_file_pv(config.base.priv_validator_path())
+    if config.base.priv_validator_laddr:
+        # external signing process dials in (node/node.go:228-236)
+        from ..privval.remote import SocketPV
+
+        pv = SocketPV(config.base.priv_validator_laddr)
+        pv.listen()
+        LOG.info("waiting for remote signer on %s", pv.listen_addr)
+        pv.accept()
+    else:
+        pv = load_or_gen_file_pv(config.base.priv_validator_path())
     genesis_doc = GenesisDoc.load(config.base.genesis_path())
     creator = default_client_creator(config.base.proxy_app)
     return Node(config, pv, node_key, creator, genesis_doc)

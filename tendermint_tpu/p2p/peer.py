@@ -25,7 +25,11 @@ class Peer:
         persistent: bool = False,
         mconfig: Optional[MConnConfig] = None,
         socket_addr: str = "",
+        metrics=None,
     ):
+        from ..metrics import P2PMetrics
+
+        self.metrics = metrics if metrics is not None else P2PMetrics()
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
@@ -58,12 +62,20 @@ class Peer:
     def send(self, ch_id: int, msg_bytes: bytes) -> bool:
         if not self.is_running():
             return False
-        return self.mconn.send(ch_id, msg_bytes)
+        ok = self.mconn.send(ch_id, msg_bytes)
+        if ok:
+            self.metrics.peer_send_bytes_total.with_labels(self.id).inc(
+                len(msg_bytes))
+        return ok
 
     def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
         if not self.is_running():
             return False
-        return self.mconn.try_send(ch_id, msg_bytes)
+        ok = self.mconn.try_send(ch_id, msg_bytes)
+        if ok:
+            self.metrics.peer_send_bytes_total.with_labels(self.id).inc(
+                len(msg_bytes))
+        return ok
 
     def set(self, key: str, value) -> None:
         self.data[key] = value

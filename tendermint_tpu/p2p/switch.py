@@ -37,7 +37,11 @@ class Switch:
         mconfig: Optional[MConnConfig] = None,
         max_inbound: int = 40,
         max_outbound: int = 10,
+        metrics=None,
     ):
+        from ..metrics import P2PMetrics
+
+        self.metrics = metrics if metrics is not None else P2PMetrics()
         self.transport = transport
         self.mconfig = mconfig
         self.reactors: Dict[str, Reactor] = {}
@@ -177,6 +181,7 @@ class Switch:
             persistent=persistent,
             mconfig=self.mconfig,
             socket_addr=remote,
+            metrics=self.metrics,
         )
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
@@ -197,6 +202,7 @@ class Switch:
                 sc.close()
                 return None
         peer.start()
+        self.metrics.peers.set(self.peers.size())
         for reactor in self.reactors.values():
             try:
                 reactor.add_peer(peer)
@@ -208,6 +214,8 @@ class Switch:
     # -- routing -------------------------------------------------------
 
     def _on_peer_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        self.metrics.peer_receive_bytes_total.with_labels(peer.id).inc(
+            len(msg_bytes))
         reactor = self._reactor_by_ch.get(ch_id)
         if reactor is None:
             self.stop_peer_for_error(peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
@@ -239,6 +247,7 @@ class Switch:
         """switch.go:281-299; persistent peers get reconnected."""
         if not self.peers.remove(peer):
             return
+        self.metrics.peers.set(self.peers.size())
         LOG.info("stopping peer %s: %s", peer, reason)
         peer.stop()
         for reactor in self.reactors.values():
@@ -253,6 +262,7 @@ class Switch:
     def stop_peer_gracefully(self, peer: Peer) -> None:
         if not self.peers.remove(peer):
             return
+        self.metrics.peers.set(self.peers.size())
         peer.stop()
         for reactor in self.reactors.values():
             try:

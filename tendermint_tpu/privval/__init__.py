@@ -1,3 +1,8 @@
 """Validator signing (reference privval/)."""
 
 from .file_pv import FilePV, load_or_gen_file_pv  # noqa: F401
+from .remote import (  # noqa: F401
+    RemoteSignerError,
+    RemoteSignerServer,
+    SocketPV,
+)

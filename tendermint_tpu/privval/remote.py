@@ -1,0 +1,300 @@
+"""Remote signer (reference privval/tcp.go + ipc.go +
+remote_signer.go + socket.go message types).
+
+Topology matches the reference: the NODE listens on
+`priv_validator_laddr`; the SIGNER process dials in and serves signing
+requests. TCP connections are wrapped in SecretConnection (X25519 ECDH
++ ChaCha20-Poly1305, ed25519-authenticated — the same transport as
+p2p); unix sockets are plain (local trust boundary, ipc.go).
+
+Wire format: length-prefixed serde frames, request/response pairs:
+  ["pubkey_req"]               -> ["pubkey_res", pubkey_bytes]
+  ["sign_vote_req", chain, v]  -> ["sign_vote_res", vote] | ["err", s]
+  ["sign_prop_req", chain, p]  -> ["sign_prop_res", prop] | ["err", s]
+  ["ping_req"]                 -> ["ping_res"]
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+from ..crypto.keys import PrivKey, PrivKeyEd25519, PubKey, pubkey_from_bytes
+from ..types import serde
+from ..types.basic import Proposal, Vote
+from .file_pv import FilePV
+
+LOG = logging.getLogger("privval.remote")
+
+CONN_TIMEOUT = 5.0  # tcp.go connTimeout (handshake)
+REQUEST_TIMEOUT = 10.0  # per sign/pubkey request deadline (node side)
+MAX_FRAME = 1 << 20
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+def _parse_laddr(laddr: str):
+    """tcp://host:port or unix:///path -> (family, addr)."""
+    if laddr.startswith("unix://"):
+        return socket.AF_UNIX, laddr[len("unix://"):]
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return socket.AF_INET, (host or "127.0.0.1", int(port))
+
+
+class _FrameConn:
+    """Length-prefixed frames over a raw socket or SecretConnection."""
+
+    def __init__(self, sock, secret=None):
+        self._sock = sock
+        self._secret = secret
+        self._wlock = threading.Lock()
+        self._rlock = threading.Lock()
+
+    def _read_exact(self, n: int) -> bytes:
+        if self._secret is not None:
+            return self._secret.read_exact(n)
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("remote signer conn closed")
+            buf += chunk
+        return buf
+
+    def _write_all(self, data: bytes) -> None:
+        if self._secret is not None:
+            self._secret.write(data)
+        else:
+            self._sock.sendall(data)
+
+    def send(self, obj) -> None:
+        payload = serde.pack(obj)
+        if len(payload) > MAX_FRAME:
+            raise ValueError("remote signer frame too big")
+        with self._wlock:
+            self._write_all(struct.pack(">I", len(payload)) + payload)
+
+    def recv(self):
+        with self._rlock:
+            ln = struct.unpack(">I", self._read_exact(4))[0]
+            if ln > MAX_FRAME:
+                raise ConnectionError("remote signer frame too big")
+            return serde.unpack(self._read_exact(ln))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class SocketPV:
+    """Node-side PrivValidator over a socket (reference TCPVal
+    tcp.go:40-120 / IPCVal ipc.go): listens, accepts ONE signer
+    connection, then forwards sign requests to it."""
+
+    def __init__(self, laddr: str,
+                 conn_key: Optional[PrivKey] = None,
+                 accept_timeout: float = 30.0):
+        self.laddr = laddr
+        self.conn_key = conn_key or PrivKeyEd25519.generate()
+        self.accept_timeout = accept_timeout
+        self._conn: Optional[_FrameConn] = None
+        self._pub_key: Optional[PubKey] = None
+        self._lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def listen(self) -> None:
+        family, addr = _parse_laddr(self.laddr)
+        if family == socket.AF_UNIX and isinstance(addr, str):
+            try:
+                os.unlink(addr)
+            except FileNotFoundError:
+                pass
+        self._listener = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_INET:
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(addr)
+        self._listener.listen(1)
+
+    @property
+    def listen_addr(self) -> str:
+        if self._listener.family == socket.AF_UNIX:
+            return self.laddr
+        host, port = self._listener.getsockname()[:2]
+        return f"tcp://{host}:{port}"
+
+    def accept(self) -> None:
+        """Block until the remote signer dials in (tcp.go acceptConnection)."""
+        self._listener.settimeout(self.accept_timeout)
+        sock, _ = self._listener.accept()
+        sock.settimeout(CONN_TIMEOUT)
+        secret = None
+        if self._listener.family != socket.AF_UNIX:
+            from ..p2p.conn.secret_connection import SecretConnection
+
+            secret = SecretConnection(sock, self.conn_key)
+        # per-request deadline: a hung signer must surface as an error,
+        # not freeze the consensus loop inside recv (reference tcp.go
+        # applies connTimeout per request). Requests are strictly
+        # send→recv under _lock, so a socket-level timeout only fires
+        # while a response is outstanding.
+        sock.settimeout(REQUEST_TIMEOUT)
+        self._conn = _FrameConn(sock, secret)
+        # cache the signer's consensus pubkey up front (tcp.go :108)
+        self._pub_key = self._request_pub_key()
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+        if self._listener is not None:
+            self._listener.close()
+
+    # -- PrivValidator interface ---------------------------------------
+
+    def _call(self, req):
+        with self._lock:
+            if self._conn is None:
+                raise RemoteSignerError("remote signer not connected")
+            try:
+                self._conn.send(req)
+                res = self._conn.recv()
+            except socket.timeout:
+                # mid-frame state is unrecoverable: drop the connection
+                self._conn.close()
+                self._conn = None
+                raise RemoteSignerError(
+                    f"remote signer timed out after {REQUEST_TIMEOUT}s")
+            except (ConnectionError, OSError) as e:
+                self._conn.close()
+                self._conn = None
+                raise RemoteSignerError(f"remote signer conn error: {e}")
+        if res and res[0] == "err":
+            raise RemoteSignerError(str(res[1]))
+        return res
+
+    def _request_pub_key(self) -> PubKey:
+        res = self._call(["pubkey_req"])
+        if res[0] != "pubkey_res":
+            raise RemoteSignerError(f"unexpected response {res[0]!r}")
+        return pubkey_from_bytes(bytes(res[1]))
+
+    def get_pub_key(self) -> PubKey:
+        return self._pub_key
+
+    def get_address(self) -> bytes:
+        return self._pub_key.address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        res = self._call(["sign_vote_req", chain_id, serde.vote_obj(vote)])
+        if res[0] != "sign_vote_res":
+            raise RemoteSignerError(f"unexpected response {res[0]!r}")
+        signed = serde.vote_from(res[1])
+        vote.signature = signed.signature
+        vote.timestamp = signed.timestamp
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        res = self._call(
+            ["sign_prop_req", chain_id, serde.proposal_obj(proposal)])
+        if res[0] != "sign_prop_res":
+            raise RemoteSignerError(f"unexpected response {res[0]!r}")
+        signed = serde.proposal_from(res[1])
+        proposal.signature = signed.signature
+        proposal.timestamp = signed.timestamp
+
+    def ping(self) -> None:
+        res = self._call(["ping_req"])
+        if res[0] != "ping_res":
+            raise RemoteSignerError("bad ping response")
+
+
+class RemoteSignerServer:
+    """Signer-side process (reference RemoteSigner remote_signer.go:23-120
+    + cmd/priv_val_server): dials the node and serves its FilePV."""
+
+    def __init__(self, laddr: str, pv: FilePV,
+                 conn_key: Optional[PrivKey] = None):
+        self.laddr = laddr
+        self.pv = pv
+        self.conn_key = conn_key or PrivKeyEd25519.generate()
+        self._conn: Optional[_FrameConn] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def connect(self, timeout: float = 10.0) -> None:
+        family, addr = _parse_laddr(self.laddr)
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(addr)
+        secret = None
+        if family != socket.AF_UNIX:
+            from ..p2p.conn.secret_connection import SecretConnection
+
+            secret = SecretConnection(sock, self.conn_key)
+        sock.settimeout(None)
+        self._conn = _FrameConn(sock, secret)
+
+    def start(self) -> None:
+        """Connect (if not yet) and serve in a background thread. The
+        node's SocketPV.accept() requests the pubkey immediately after
+        the handshake, so the serve loop must be running by then."""
+        self._stop.clear()
+
+        def run():
+            if self._conn is None:
+                self.connect()
+            self.serve_forever()
+
+        self._thread = threading.Thread(
+            target=run, name="remote-signer", daemon=True)
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        """remote_signer.go handleConnection:77-120."""
+        while not self._stop.is_set():
+            try:
+                req = self._conn.recv()
+            except (ConnectionError, OSError, struct.error):
+                LOG.info("remote signer connection closed")
+                return
+            try:
+                res = self._handle(req)
+            except Exception as e:  # noqa: BLE001 - report, keep serving
+                res = ["err", str(e)]
+            try:
+                self._conn.send(res)
+            except (ConnectionError, OSError):
+                return
+
+    def _handle(self, req):
+        from ..crypto.keys import pubkey_to_bytes
+
+        kind = req[0]
+        if kind == "pubkey_req":
+            return ["pubkey_res", pubkey_to_bytes(self.pv.get_pub_key())]
+        if kind == "ping_req":
+            return ["ping_res"]
+        if kind == "sign_vote_req":
+            chain_id, vote = req[1], serde.vote_from(req[2])
+            self.pv.sign_vote(chain_id, vote)
+            return ["sign_vote_res", serde.vote_obj(vote)]
+        if kind == "sign_prop_req":
+            chain_id, prop = req[1], serde.proposal_from(req[2])
+            self.pv.sign_proposal(chain_id, prop)
+            return ["sign_prop_res", serde.proposal_obj(prop)]
+        return ["err", f"unknown request {kind!r}"]
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._conn is not None:
+            self._conn.close()

@@ -123,13 +123,17 @@ class BlockExecutor:
         evidence_pool=None,
         event_bus=None,
         logger: Optional[logging.Logger] = None,
+        metrics=None,
     ):
+        from ..metrics import StateMetrics
+
         self.db = db
         self.proxy_app = proxy_app
         self.mempool = mempool
         self.evidence_pool = evidence_pool
         self.event_bus = event_bus
         self.logger = logger or logging.getLogger("state.BlockExecutor")
+        self.metrics = metrics if metrics is not None else StateMetrics()
 
     def set_event_bus(self, event_bus) -> None:
         self.event_bus = event_bus
@@ -140,6 +144,9 @@ class BlockExecutor:
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
         """Validate → exec against app → update state → commit app →
         fire events. Returns the new State (reference execution.go:89-152)."""
+        import time as _time
+
+        _t0 = _time.monotonic()
         self.validate_block(state, block)
 
         abci_responses = self.exec_block_on_proxy_app(state, block)
@@ -167,6 +174,7 @@ class BlockExecutor:
 
         fail.fail_point("ApplyBlock.AfterSaveState")  # execution.go:145
 
+        self.metrics.block_processing_time.observe(_time.monotonic() - _t0)
         self._fire_events(block, abci_responses, val_updates)
         return state
 

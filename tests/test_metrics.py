@@ -1,0 +1,97 @@
+"""Metrics tests (reference per-package metrics.go + node/node.go
+Prometheus listener): primitive rendering, and a live node exposing
+consensus/mempool metrics at /metrics.
+"""
+
+import os
+import time
+import urllib.request
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.libs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsServer,
+    Registry,
+)
+
+
+def test_counter_gauge_render():
+    r = Registry()
+    c = r.counter("test_total", "a counter")
+    c.inc()
+    c.inc(2)
+    g = r.gauge("test_height", "a gauge", ("chain",))
+    g.with_labels("main").set(7)
+    out = r.render()
+    assert "# TYPE test_total counter" in out
+    assert "test_total 3" in out
+    assert 'test_height{chain="main"} 7' in out
+
+
+def test_histogram_render():
+    r = Registry()
+    h = r.histogram("test_secs", "timings", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    out = r.render()
+    assert 'test_secs_bucket{le="0.1"} 1' in out
+    assert 'test_secs_bucket{le="1"} 2' in out
+    assert 'test_secs_bucket{le="+Inf"} 3' in out
+    assert "test_secs_count 3" in out
+
+
+def test_metrics_server():
+    r = Registry()
+    r.gauge("up", "is up").set(1)
+    srv = MetricsServer(r, "127.0.0.1", 0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.listen_addr}/metrics") as resp:
+            body = resp.read().decode()
+        assert "up 1" in body
+    finally:
+        srv.stop()
+
+
+def test_node_prometheus_endpoint(tmp_path):
+    from test_node import init_files, make_config
+
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK,
+        query_for_event,
+    )
+
+    c = make_config(tmp_path, "n0")
+    c.instrumentation.prometheus = True
+    c.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+    init_files(c)
+    node = default_new_node(c)
+    sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 16)
+    node.start()
+    try:
+        h = 0
+        deadline = time.time() + 30
+        while h < 2 and time.time() < deadline:
+            m = sub.get(timeout=1.0)
+            if m is not None:
+                h = m.data["block"].header.height
+        assert h >= 2
+        addr = node._metrics_server.listen_addr
+        with urllib.request.urlopen(f"http://{addr}/metrics") as resp:
+            body = resp.read().decode()
+        # consensus height tracked and >= 2
+        line = next(
+            l for l in body.splitlines()
+            if l.startswith("tendermint_consensus_height "))
+        assert float(line.split()[-1]) >= 2
+        assert "tendermint_consensus_validators 1" in body
+        assert "tendermint_state_block_processing_time_count" in body
+        assert "tendermint_mempool_size" in body
+    finally:
+        node.stop()
