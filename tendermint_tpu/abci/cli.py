@@ -1,0 +1,207 @@
+"""abci-cli — drive an ABCI application manually (reference
+abci/cmd/abci-cli/abci-cli.go).
+
+Commands: echo, info, set_option, deliver_tx, check_tx, commit, query,
+console (interactive REPL over one connection), batch (same commands
+from stdin), kvstore/counter (run the example apps as socket servers).
+
+Tx/query arguments accept the reference's value syntax: raw string,
+0xHEX, or "quoted string".
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from . import types as abci
+from .client import Client, SocketClient
+
+
+def parse_value(s: str) -> bytes:
+    """abci-cli.go stringOrHexToBytes: 0x-prefixed hex, else quoted or
+    raw string."""
+    if s.startswith("0x") or s.startswith("0X"):
+        return bytes.fromhex(s[2:])
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1].encode()
+    return s.encode()
+
+
+def _print_response(res, *fields) -> None:
+    code = getattr(res, "code", 0)
+    print(f"-> code: {'OK' if code == 0 else code}")
+    for f in fields:
+        v = getattr(res, f, None)
+        if v in (None, b"", "", 0):
+            continue
+        if isinstance(v, bytes):
+            print(f"-> {f}.hex: 0x{v.hex().upper()}")
+            try:
+                print(f"-> {f}: {v.decode()}")
+            except UnicodeDecodeError:
+                pass
+        else:
+            print(f"-> {f}: {v}")
+    log = getattr(res, "log", "")
+    if log:
+        print(f"-> log: {log}")
+
+
+def run_command(client: Client, cmd: str, args: list) -> int:
+    """One command against the app (abci-cli.go cmdXxx funcs)."""
+    if cmd == "echo":
+        msg = args[0] if args else ""
+        print(f"-> data: {client.echo(msg)}")
+        return 0
+    if cmd == "info":
+        res = client.info(abci.RequestInfo(version="abci-cli"))
+        print(f"-> data: {res.data}")
+        print(f"-> last_block_height: {res.last_block_height}")
+        if res.last_block_app_hash:
+            print(f"-> last_block_app_hash: "
+                  f"0x{res.last_block_app_hash.hex().upper()}")
+        return 0
+    if cmd == "set_option":
+        if len(args) < 2:
+            print("usage: set_option <key> <value>", file=sys.stderr)
+            return 1
+        client.set_option(abci.RequestSetOption(key=args[0], value=args[1]))
+        print(f"-> key: {args[0]}\n-> value: {args[1]}")
+        return 0
+    if cmd == "deliver_tx":
+        if not args:
+            print("usage: deliver_tx <tx>", file=sys.stderr)
+            return 1
+        _print_response(client.deliver_tx(parse_value(args[0])), "data")
+        return 0
+    if cmd == "check_tx":
+        if not args:
+            print("usage: check_tx <tx>", file=sys.stderr)
+            return 1
+        _print_response(client.check_tx(parse_value(args[0])), "data")
+        return 0
+    if cmd == "commit":
+        res = client.commit()
+        print(f"-> data.hex: 0x{res.data.hex().upper()}")
+        return 0
+    if cmd == "query":
+        if not args:
+            print("usage: query <key>", file=sys.stderr)
+            return 1
+        res = client.query(abci.RequestQuery(data=parse_value(args[0])))
+        _print_response(res, "key", "value")
+        print(f"-> height: {res.height}")
+        return 0
+    print(f"unknown command {cmd!r}", file=sys.stderr)
+    return 1
+
+
+CONSOLE_COMMANDS = ("echo", "info", "set_option", "deliver_tx",
+                    "check_tx", "commit", "query")
+
+
+def console(client: Client, input_lines=None) -> int:
+    """Interactive REPL / batch runner (abci-cli.go cmdConsole +
+    cmdBatch share this loop)."""
+    interactive = input_lines is None
+
+    def lines():
+        if input_lines is not None:
+            yield from input_lines
+            return
+        while True:
+            try:
+                yield input("> ")
+            except EOFError:
+                return
+
+    for line in lines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = shlex.split(line, posix=False)
+        cmd, args = parts[0], parts[1:]
+        if cmd in ("quit", "exit"):
+            return 0
+        if cmd not in CONSOLE_COMMANDS:
+            print(f"unknown command {cmd!r}; available: "
+                  f"{' '.join(CONSOLE_COMMANDS)}",
+                  file=sys.stderr)
+            if not interactive:
+                return 1
+            continue
+        try:
+            run_command(client, cmd, args)
+        except Exception as e:  # noqa: BLE001 - REPL reports and continues
+            print(f"error: {e}", file=sys.stderr)
+            if not interactive:
+                return 1
+    return 0
+
+
+def serve_app(kind: str, address: str) -> int:
+    """Run an example app as a socket server (abci-cli kvstore/counter
+    subcommands)."""
+    from .server import ABCIServer
+
+    if kind == "kvstore":
+        from .example.kvstore import KVStoreApplication
+
+        app = KVStoreApplication()
+    else:
+        from .example.counter import CounterApplication
+
+        app = CounterApplication(serial=True)
+    srv = ABCIServer(address, app)
+    srv.start()
+    print(f"Serving {kind} on port {srv.local_port()}", flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="abci-cli",
+        description="CLI for driving an ABCI application")
+    p.add_argument("--address", default="tcp://127.0.0.1:26658",
+                   help="ABCI server address")
+    sub = p.add_subparsers(dest="command")
+    for c in CONSOLE_COMMANDS:
+        sp = sub.add_parser(c)
+        sp.add_argument("args", nargs="*")
+    sub.add_parser("console", help="interactive mode")
+    sub.add_parser("batch", help="read commands from stdin")
+    sp = sub.add_parser("kvstore", help="serve the example kvstore app")
+    sp.add_argument("args", nargs="*")
+    sp = sub.add_parser("counter", help="serve the example counter app")
+    sp.add_argument("args", nargs="*")
+
+    args = p.parse_args(argv)
+    if not args.command:
+        p.print_help()
+        return 1
+    if args.command in ("kvstore", "counter"):
+        return serve_app(args.command, args.address)
+
+    client = SocketClient(args.address.split("://")[-1])
+    try:
+        if args.command == "console":
+            return console(client)
+        if args.command == "batch":
+            return console(client, input_lines=sys.stdin)
+        return run_command(client, args.command, list(args.args))
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -234,6 +234,20 @@ def cmd_priv_val_server(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """commands/probe_upnp.go: discover a UPnP gateway and test a
+    port mapping."""
+    from tendermint_tpu.p2p import upnp
+
+    try:
+        out = upnp.probe()
+    except upnp.UPnPError as e:
+        print(f"Probe failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out, indent=2))
+    return 0
+
+
 def cmd_version(args) -> int:
     from tendermint_tpu import __version__
 
@@ -311,6 +325,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="priv validator key file")
     sp.set_defaults(fn=cmd_priv_val_server)
 
+    sub.add_parser("probe_upnp",
+                   help="probe for a UPnP gateway").set_defaults(
+        fn=cmd_probe_upnp)
     sub.add_parser("version", help="print the version").set_defaults(
         fn=cmd_version)
     return p

@@ -196,6 +196,90 @@ def fixed_base_mul(s_limbs):
     return jax.lax.fori_loop(0, 64, body, identity_p3_like(s_limbs))
 
 
+@lru_cache(maxsize=1)
+def _small_base_table_np():
+    """(16, 60) float32 niels rows [j]B for j = 0..15 (row 0 is the
+    identity in niels form: (1, 1, 0)). Used by the Straus joint loop,
+    which shares one doubling chain across both scalars so the base
+    table needs no 16^i positioning."""
+    out = np.zeros((16, 60), dtype=np.float32)
+    out[0, 0] = 1.0
+    out[0, 20] = 1.0
+    base = ref.base_point()
+    for j in range(1, 16):
+        x, y = ref.to_affine(ref.scalar_mult(j, base))
+        yplusx = (y + x) % ref.P
+        yminusx = (y - x) % ref.P
+        xy2d = (x * y % ref.P) * ref.D2 % ref.P
+        out[j, :20] = int_to_limbs(yplusx)
+        out[j, 20:40] = int_to_limbs(yminusx)
+        out[j, 40:] = int_to_limbs(xy2d)
+    return out
+
+
+def _windows_msb_first(s_limbs, bdim):
+    """(64, B) int32 4-bit windows, most-significant first."""
+    bits = scalar_bits(s_limbs, 256)  # (256, B) LSB-first
+    weights = jnp.asarray([1, 2, 4, 8], dtype=jnp.int32)[None, :, None]
+    w = jnp.sum(bits.reshape(64, 4, bdim) * weights, axis=1)  # LSB-first
+    return w[::-1]
+
+
+def straus_mul_sub(s_limbs, k_limbs, neg_a):
+    """[s]B + [k]·neg_a with ONE shared doubling chain (Straus/Shamir,
+    4-bit windows) — the joint form of the verification equation
+    R' = [S]B − [k]A. Replaces fixed_base_mul + var_base_mul + final
+    add: 252 doublings + 64 cached adds + 64 niels adds instead of
+    256 doublings + 256 conditional adds + 64 niels adds + 1 add.
+
+    s_limbs, k_limbs: (20, B) canonical scalars. neg_a: P3 batch.
+    """
+    bdim = s_limbs.shape[-1]
+    s_win = _windows_msb_first(s_limbs, bdim)
+    k_win = _windows_msb_first(k_limbs, bdim)
+
+    # per-item table of cached([j]·neg_a), j = 1..15: odd rows by cached
+    # add, even rows by doubling j/2 (14 point ops total)
+    neg_a_cached = to_cached(neg_a)
+    mults = [neg_a]
+    for j in range(2, 16):
+        if j % 2 == 0:
+            mults.append(double(mults[j // 2 - 1]))
+        else:
+            mults.append(add_cached(mults[j - 2], neg_a_cached))
+    cached = [to_cached(pt) for pt in mults]  # 15 × (4 × (20, B))
+    # stack per component: 4 arrays of (15, 20, B)
+    a_table = tuple(
+        jnp.stack([c[comp] for c in cached], axis=0) for comp in range(4)
+    )
+    b_table = jnp.asarray(_small_base_table_np())  # (16, 60) f32
+
+    def body(i, acc):
+        acc = double(double(double(double(acc))))
+        # variable-base window: masked-sum select of cached([j]negA)
+        kw = k_win[i]  # (B,)
+        mask = (jnp.arange(1, 16, dtype=jnp.int32)[:, None]
+                == kw[None, :])  # (15, B)
+        sel = tuple(
+            jnp.sum(jnp.where(mask[:, None, :], comp, 0), axis=0)
+            for comp in a_table
+        )
+        added = add_cached(acc, sel)
+        acc = select_point(kw != 0, added, acc)
+        # fixed-base window: one-hot × (16, 60) table on the MXU
+        onehot = (s_win[i][None, :]
+                  == jnp.arange(16)[:, None]).astype(jnp.float32)
+        entry = jnp.matmul(
+            b_table.T,
+            onehot,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)  # (60, B)
+        return add_niels(acc, (entry[:20], entry[20:40], entry[40:]))
+
+    return jax.lax.fori_loop(0, 64, body, identity_p3_like(s_limbs))
+
+
 def var_base_mul(p, s_limbs):
     """[s]P by double-and-(conditionally-)add over 256 bits, branch-free.
 

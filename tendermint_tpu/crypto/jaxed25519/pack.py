@@ -94,21 +94,27 @@ def sha512_pad_batch(prefixes: np.ndarray, msgs: list[bytes]):
     correctly in one bucket.
     """
     b = prefixes.shape[0]
-    maxlen = max((len(m) for m in msgs), default=0)
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=b)
+    maxlen = int(lens.max()) if b else 0
     nb = (64 + maxlen + 17 + 127) // 128  # 0x80 byte + 128-bit length field
     buf = np.zeros((b, nb * 128), dtype=np.uint8)
     buf[:, :64] = prefixes
-    nblocks = np.zeros(b, dtype=np.int32)
-    for i, m in enumerate(msgs):
-        if m:
-            buf[i, 64 : 64 + len(m)] = np.frombuffer(m, dtype=np.uint8)
-        mlen = 64 + len(m)
-        buf[i, mlen] = 0x80
-        inb = (mlen + 17 + 127) // 128
-        nblocks[i] = inb
-        bitlen = mlen * 8
-        end = inb * 128
-        buf[i, end - 16 : end] = np.frombuffer(bitlen.to_bytes(16, "big"), dtype=np.uint8)
+    # scatter all message bytes in one vectorized write
+    joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if joined.size:
+        rows = np.repeat(np.arange(b), lens)
+        starts = np.repeat(np.cumsum(lens) - lens, lens)
+        cols = 64 + np.arange(joined.size, dtype=np.int64) - starts
+        buf[rows, cols] = joined
+    mlen = 64 + lens
+    rng = np.arange(b)
+    buf[rng, mlen] = 0x80
+    inb = (mlen + 17 + 127) // 128
+    nblocks = inb.astype(np.int32)
+    bitlen = mlen * 8  # < 2^64: only the low 8 bytes of the field matter
+    end = inb * 128
+    for j in range(8):
+        buf[rng, end - 8 + j] = (bitlen >> (8 * (7 - j))) & 0xFF
     words = buf.reshape(b, nb, 16, 8).astype(np.uint32)
     hi = (words[..., 0] << 24) | (words[..., 1] << 16) | (words[..., 2] << 8) | words[..., 3]
     lo = (words[..., 4] << 24) | (words[..., 5] << 16) | (words[..., 6] << 8) | words[..., 7]

@@ -1,0 +1,158 @@
+"""Peer trust metric (reference p2p/trust/metric.go + store.go).
+
+Tracks good/bad events per peer over sliding time intervals and scores
+trust as a weighted mix of:
+  R  — proportional value: good / total over the history window
+  D  — derivative: recent change in R (penalizes degradation)
+  I  — integral: accumulated history (faithful long-term behavior)
+score = R·w_r + D·w_d·(derivative gain) + I·w_i   (metric.go:120-180)
+
+A TrustMetricStore keys metrics by peer id and persists scores through
+the DB interface (store.go).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+# reference metric.go defaults
+DEFAULT_INTERVAL = 30.0  # seconds per history interval
+DEFAULT_MAX_INTERVALS = 20  # history window = 10 minutes
+PROPORTIONAL_WEIGHT = 0.4
+INTEGRAL_WEIGHT = 0.6
+MAX_SCORE = 100
+
+
+class TrustMetric:
+    """metric.go TrustMetric — one peer's rolling behavior score."""
+
+    def __init__(self, interval: float = DEFAULT_INTERVAL,
+                 max_intervals: int = DEFAULT_MAX_INTERVALS,
+                 now: Optional[float] = None):
+        self.interval = interval
+        self.max_intervals = max_intervals
+        self._lock = threading.Lock()
+        self._good = 0.0
+        self._bad = 0.0
+        self._history: list = []  # per-interval R values, newest last
+        self._history_value = 1.0  # I component seed: start trusting
+        self._last_roll = now if now is not None else time.time()
+        self.paused = False
+
+    # -- event input (metric.go GoodEvents/BadEvents) ------------------
+
+    def good_events(self, n: int = 1, now: Optional[float] = None) -> None:
+        with self._lock:
+            self._maybe_roll(now)
+            self._good += n
+            self.paused = False
+
+    def bad_events(self, n: int = 1, now: Optional[float] = None) -> None:
+        with self._lock:
+            self._maybe_roll(now)
+            self._bad += n
+            self.paused = False
+
+    def pause(self) -> None:
+        """Stop history decay while disconnected (metric.go Pause)."""
+        with self._lock:
+            self.paused = True
+
+    # -- interval roll (metric.go NextTimeInterval) --------------------
+
+    def _current_r(self) -> float:
+        total = self._good + self._bad
+        return self._good / total if total > 0 else 1.0
+
+    def _maybe_roll(self, now: Optional[float]) -> None:
+        now = now if now is not None else time.time()
+        if self.paused:
+            self._last_roll = now
+            return
+        while now - self._last_roll >= self.interval:
+            self._history.append(self._current_r())
+            if len(self._history) > self.max_intervals:
+                self._history.pop(0)
+            # weighted history value: newer intervals weigh more
+            # (metric.go calcHistoryValue's fading weights)
+            weights = [1.0 / (2 ** (len(self._history) - 1 - i))
+                       for i in range(len(self._history))]
+            wsum = sum(weights)
+            self._history_value = sum(
+                w * r for w, r in zip(weights, self._history)) / wsum
+            self._good = 0.0
+            self._bad = 0.0
+            self._last_roll += self.interval
+
+    # -- score (metric.go TrustValue/TrustScore) -----------------------
+
+    def trust_value(self, now: Optional[float] = None) -> float:
+        with self._lock:
+            self._maybe_roll(now)
+            r = self._current_r()
+            i = self._history_value
+            v = r * PROPORTIONAL_WEIGHT + i * INTEGRAL_WEIGHT
+            # derivative penalty only when behavior is degrading
+            d = r - i
+            if d < 0:
+                v += d * (PROPORTIONAL_WEIGHT / 2)
+            return max(0.0, min(1.0, v))
+
+    def trust_score(self, now: Optional[float] = None) -> int:
+        return int(round(self.trust_value(now) * MAX_SCORE))
+
+
+class TrustMetricStore:
+    """store.go TrustMetricStore: metrics by peer id + persistence."""
+
+    def __init__(self, db=None, interval: float = DEFAULT_INTERVAL):
+        self.db = db
+        self.interval = interval
+        self._metrics: Dict[str, TrustMetric] = {}
+        self._lock = threading.Lock()
+        if db is not None:
+            self._load()
+
+    def get_metric(self, peer_id: str) -> TrustMetric:
+        with self._lock:
+            m = self._metrics.get(peer_id)
+            if m is None:
+                m = TrustMetric(interval=self.interval)
+                self._metrics[peer_id] = m
+            return m
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        with self._lock:
+            m = self._metrics.get(peer_id)
+        if m is not None:
+            m.pause()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._metrics)
+
+    _KEY = b"trust_metric_store"
+
+    def save(self) -> None:
+        if self.db is None:
+            return
+        with self._lock:
+            out = {
+                pid: {"history": m._history,
+                      "history_value": m._history_value}
+                for pid, m in self._metrics.items()
+            }
+        self.db.set_sync(self._KEY, json.dumps(out).encode())
+
+    def _load(self) -> None:
+        raw = self.db.get(self._KEY)
+        if not raw:
+            return
+        for pid, o in json.loads(raw).items():
+            m = TrustMetric(interval=self.interval)
+            m._history = list(o.get("history", []))
+            m._history_value = float(o.get("history_value", 1.0))
+            self._metrics[pid] = m

@@ -1,0 +1,173 @@
+"""UPnP NAT discovery + port mapping (reference p2p/upnp/upnp.go +
+probe.go — used by `probe_upnp` and the node's optional
+external-address discovery).
+
+Protocol: SSDP M-SEARCH over UDP multicast finds the gateway's
+description URL; the description XML yields the WANIPConnection
+control URL; SOAP calls do GetExternalIPAddress /
+AddPortMapping / DeletePortMapping.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+from dataclasses import dataclass
+from typing import Optional
+from urllib.parse import urljoin, urlparse
+from urllib.request import Request, urlopen
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+SSDP_ST = "urn:schemas-upnp-org:device:InternetGatewayDevice:1"
+WAN_SERVICES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+@dataclass
+class Gateway:
+    """upnp.go upnpNAT: the discovered gateway's SOAP endpoint."""
+
+    control_url: str
+    service_type: str
+    local_ip: str
+
+
+def _msearch(timeout: float = 3.0,
+             ssdp_addr=SSDP_ADDR) -> Optional[str]:
+    """SSDP discovery -> LOCATION url of the gateway description."""
+    msg = (
+        "M-SEARCH * HTTP/1.1\r\n"
+        f"HOST: {ssdp_addr[0]}:{ssdp_addr[1]}\r\n"
+        'MAN: "ssdp:discover"\r\n'
+        f"ST: {SSDP_ST}\r\n"
+        "MX: 2\r\n\r\n"
+    ).encode()
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        sock.sendto(msg, ssdp_addr)
+        while True:
+            data, _ = sock.recvfrom(4096)
+            m = re.search(rb"(?im)^location:\s*(\S+)", data)
+            if m:
+                return m.group(1).decode()
+    except socket.timeout:
+        return None
+    finally:
+        sock.close()
+
+
+def _local_ip_towards(host: str) -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host, 9))
+        return s.getsockname()[0]
+    finally:
+        s.close()
+
+
+def discover(timeout: float = 3.0, ssdp_addr=SSDP_ADDR) -> Gateway:
+    """upnp.go Discover: SSDP -> description XML -> control URL."""
+    location = _msearch(timeout, ssdp_addr)
+    if location is None:
+        raise UPnPError("no UPnP gateway responded to SSDP discovery")
+    with urlopen(location, timeout=timeout) as resp:
+        desc = resp.read().decode(errors="replace")
+    for svc in WAN_SERVICES:
+        m = re.search(
+            rf"<serviceType>{re.escape(svc)}</serviceType>.*?"
+            r"<controlURL>([^<]+)</controlURL>",
+            desc, re.S,
+        )
+        if m:
+            control = urljoin(location, m.group(1).strip())
+            host = urlparse(location).hostname or ""
+            return Gateway(control_url=control, service_type=svc,
+                           local_ip=_local_ip_towards(host))
+    raise UPnPError("gateway description has no WAN*Connection service")
+
+
+def _soap(gw: Gateway, action: str, body_args: str,
+          timeout: float = 5.0) -> str:
+    envelope = (
+        '<?xml version="1.0"?>'
+        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+        's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+        f'<s:Body><u:{action} xmlns:u="{gw.service_type}">{body_args}'
+        f"</u:{action}></s:Body></s:Envelope>"
+    ).encode()
+    req = Request(
+        gw.control_url, data=envelope,
+        headers={
+            "Content-Type": 'text/xml; charset="utf-8"',
+            "SOAPAction": f'"{gw.service_type}#{action}"',
+        },
+    )
+    with urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode(errors="replace")
+
+
+def get_external_address(gw: Gateway) -> str:
+    """upnp.go GetExternalAddress."""
+    out = _soap(gw, "GetExternalIPAddress", "")
+    m = re.search(r"<NewExternalIPAddress>([^<]*)</NewExternalIPAddress>",
+                  out)
+    if not m or not m.group(1):
+        raise UPnPError("gateway returned no external IP")
+    return m.group(1)
+
+
+def add_port_mapping(gw: Gateway, external_port: int, internal_port: int,
+                     protocol: str = "TCP",
+                     description: str = "tendermint-tpu",
+                     lease_seconds: int = 0) -> None:
+    """upnp.go AddPortMapping."""
+    args = (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+        f"<NewInternalPort>{internal_port}</NewInternalPort>"
+        f"<NewInternalClient>{gw.local_ip}</NewInternalClient>"
+        "<NewEnabled>1</NewEnabled>"
+        f"<NewPortMappingDescription>{description}"
+        "</NewPortMappingDescription>"
+        f"<NewLeaseDuration>{lease_seconds}</NewLeaseDuration>"
+    )
+    _soap(gw, "AddPortMapping", args)
+
+
+def delete_port_mapping(gw: Gateway, external_port: int,
+                        protocol: str = "TCP") -> None:
+    args = (
+        "<NewRemoteHost></NewRemoteHost>"
+        f"<NewExternalPort>{external_port}</NewExternalPort>"
+        f"<NewProtocol>{protocol}</NewProtocol>"
+    )
+    _soap(gw, "DeletePortMapping", args)
+
+
+def probe(timeout: float = 3.0, ssdp_addr=SSDP_ADDR) -> dict:
+    """probe.go Probe: discover, map a test port, report, unmap."""
+    gw = discover(timeout, ssdp_addr)
+    ext_ip = get_external_address(gw)
+    test_port = 26656
+    add_port_mapping(gw, test_port, test_port,
+                     description="tendermint-tpu-probe", lease_seconds=60)
+    try:
+        return {
+            "control_url": gw.control_url,
+            "local_ip": gw.local_ip,
+            "external_ip": ext_ip,
+            "mapped_port": test_port,
+        }
+    finally:
+        try:
+            delete_port_mapping(gw, test_port)
+        except UPnPError:
+            pass

@@ -1,0 +1,82 @@
+"""abci-cli tests (reference abci/tests/test_cli + abci-cli.go):
+drive a kvstore app server through the CLI commands and a batch run.
+"""
+
+import os
+import threading
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.abci.cli import console, main, parse_value
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server import ABCIServer
+
+
+@pytest.fixture
+def kvstore_server():
+    srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApplication())
+    srv.start()
+    yield f"tcp://127.0.0.1:{srv.local_port()}"
+    srv.stop()
+
+
+def test_parse_value():
+    assert parse_value("abc") == b"abc"
+    assert parse_value("0xDEAD") == b"\xde\xad"
+    assert parse_value('"quoted str"') == b"quoted str"
+
+
+def test_cli_commands(kvstore_server, capsys):
+    addr = kvstore_server
+    assert main(["--address", addr, "echo", "hello"]) == 0
+    assert "hello" in capsys.readouterr().out
+
+    assert main(["--address", addr, "deliver_tx", "k=v"]) == 0
+    assert "code: OK" in capsys.readouterr().out
+
+    assert main(["--address", addr, "commit"]) == 0
+    out = capsys.readouterr().out
+    assert "data.hex: 0x" in out
+
+    assert main(["--address", addr, "query", "k"]) == 0
+    out = capsys.readouterr().out
+    assert "value: v" in out
+
+    assert main(["--address", addr, "info"]) == 0
+    out = capsys.readouterr().out
+    assert "last_block_height" in out
+
+    assert main(["--address", addr, "check_tx", "x=y"]) == 0
+    assert "code: OK" in capsys.readouterr().out
+
+
+def test_cli_batch(kvstore_server, capsys):
+    from tendermint_tpu.abci.client import SocketClient
+
+    client = SocketClient(kvstore_server.split("://")[-1])
+    try:
+        rc = console(client, input_lines=[
+            "deliver_tx batchkey=batchval",
+            "commit",
+            "query batchkey",
+            "# a comment",
+            "",
+        ])
+    finally:
+        client.close()
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "batchval" in out
+
+
+def test_cli_batch_bad_command(kvstore_server, capsys):
+    from tendermint_tpu.abci.client import SocketClient
+
+    client = SocketClient(kvstore_server.split("://")[-1])
+    try:
+        rc = console(client, input_lines=["bogus_cmd arg"])
+    finally:
+        client.close()
+    assert rc == 1

@@ -1,0 +1,179 @@
+"""Trust metric + UPnP tests (reference p2p/trust/metric_test.go +
+p2p/upnp). UPnP runs against a fake in-process gateway: a UDP SSDP
+responder + an HTTP server serving the device description and
+answering SOAP calls.
+"""
+
+import http.server
+import os
+import re
+import socket
+import threading
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.p2p import upnp
+from tendermint_tpu.p2p.trust import (
+    TrustMetric,
+    TrustMetricStore,
+)
+
+
+# --- trust metric -----------------------------------------------------
+
+
+def test_trust_metric_good_behavior():
+    t = [0.0]
+    m = TrustMetric(interval=10.0, now=t[0])
+    for _ in range(10):
+        m.good_events(5, now=t[0])
+        t[0] += 10.0
+    assert m.trust_score(now=t[0]) >= 95
+
+
+def test_trust_metric_degrades_and_recovers():
+    t = [0.0]
+    m = TrustMetric(interval=10.0, now=t[0])
+    m.good_events(10, now=t[0])
+    t[0] += 10
+    good = m.trust_score(now=t[0])
+    # a burst of bad behavior drops the score
+    for _ in range(5):
+        m.bad_events(10, now=t[0])
+        t[0] += 10
+    bad = m.trust_score(now=t[0])
+    assert bad < good
+    assert bad < 60
+    # sustained good behavior recovers it
+    for _ in range(20):
+        m.good_events(10, now=t[0])
+        t[0] += 10
+    assert m.trust_score(now=t[0]) > bad + 20
+
+
+def test_trust_metric_pause_freezes():
+    t = [0.0]
+    m = TrustMetric(interval=10.0, now=t[0])
+    m.bad_events(3, now=t[0])
+    m.good_events(1, now=t[0])
+    m.pause()
+    s1 = m.trust_score(now=t[0])
+    t[0] += 1000  # long disconnect: no decay while paused
+    assert m.trust_score(now=t[0]) == s1
+
+
+def test_trust_store_persistence():
+    db = MemDB()
+    store = TrustMetricStore(db=db, interval=10.0)
+    m = store.get_metric("peer1")
+    m.good_events(5, now=0.0)
+    m._maybe_roll(now=20.0)
+    store.save()
+
+    store2 = TrustMetricStore(db=db, interval=10.0)
+    assert store2.size() == 1
+    assert store2.get_metric("peer1")._history_value > 0.9
+    store2.peer_disconnected("peer1")
+    assert store2.get_metric("peer1").paused
+
+
+# --- UPnP against a fake gateway -------------------------------------
+
+
+class _FakeGatewayHTTP(http.server.BaseHTTPRequestHandler):
+    calls = []
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send(self, body: str):
+        raw = body.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self):
+        self._send(
+            "<root><device><serviceList><service>"
+            "<serviceType>urn:schemas-upnp-org:service:WANIPConnection:1"
+            "</serviceType><controlURL>/ctl</controlURL>"
+            "</service></serviceList></device></root>"
+        )
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode()
+        action = self.headers.get("SOAPAction", "")
+        _FakeGatewayHTTP.calls.append((action, body))
+        if "GetExternalIPAddress" in action:
+            self._send(
+                "<Envelope><Body><GetExternalIPAddressResponse>"
+                "<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+                "</GetExternalIPAddressResponse></Body></Envelope>"
+            )
+        else:
+            self._send("<Envelope><Body></Body></Envelope>")
+
+
+@pytest.fixture
+def fake_gateway():
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            _FakeGatewayHTTP)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    http_port = httpd.server_address[1]
+
+    # SSDP responder on a plain unicast UDP port
+    ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ssdp.bind(("127.0.0.1", 0))
+    ssdp_port = ssdp.getsockname()[1]
+
+    def responder():
+        try:
+            data, addr = ssdp.recvfrom(4096)
+            if b"M-SEARCH" in data:
+                resp = (
+                    "HTTP/1.1 200 OK\r\n"
+                    f"LOCATION: http://127.0.0.1:{http_port}/desc.xml\r\n"
+                    f"ST: {upnp.SSDP_ST}\r\n\r\n"
+                ).encode()
+                ssdp.sendto(resp, addr)
+        except OSError:
+            pass
+
+    threading.Thread(target=responder, daemon=True).start()
+    _FakeGatewayHTTP.calls = []
+    yield ("127.0.0.1", ssdp_port)
+    httpd.shutdown()
+    httpd.server_close()
+    ssdp.close()
+
+
+def test_upnp_against_fake_gateway(fake_gateway):
+    gw = upnp.discover(timeout=3.0, ssdp_addr=fake_gateway)
+    assert gw.control_url.endswith("/ctl")
+    assert upnp.get_external_address(gw) == "203.0.113.7"
+    upnp.add_port_mapping(gw, 26656, 26656)
+    upnp.delete_port_mapping(gw, 26656)
+    actions = [a for a, _ in _FakeGatewayHTTP.calls]
+    assert any("AddPortMapping" in a for a in actions)
+    assert any("DeletePortMapping" in a for a in actions)
+    add_body = next(b for a, b in _FakeGatewayHTTP.calls
+                    if "AddPortMapping" in a)
+    assert "<NewExternalPort>26656</NewExternalPort>" in add_body
+    assert re.search(r"<NewInternalClient>[\d.]+</NewInternalClient>",
+                     add_body)
+
+
+def test_upnp_no_gateway_times_out():
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    silent = s.getsockname()
+    try:
+        with pytest.raises(upnp.UPnPError):
+            upnp.discover(timeout=0.5, ssdp_addr=("127.0.0.1", silent[1]))
+    finally:
+        s.close()
