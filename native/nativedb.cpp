@@ -13,6 +13,7 @@
 //
 // Build: g++ -O2 -shared -fPIC -o libnativedb.so nativedb.cpp
 
+#include <fcntl.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -144,20 +145,34 @@ bool DB::compact() {
   FILE* out = fopen(tmp.c_str(), "wb");
   if (!out) return false;
   FILE* old = log;
+  uint64_t old_total = total_bytes;
   log = out;
   bool ok = true;
   total_bytes = 0;
   for (auto& kv : index)
     if (!append(kv.first, &kv.second)) { ok = false; break; }
-  fflush(out);
   log = old;
+  // make the rewritten log durable before the rename makes it live; a
+  // failed flush (e.g. ENOSPC) must not let a truncated file go live
+  if (ok && (fflush(out) != 0 || fsync(fileno(out)) != 0)) ok = false;
   fclose(out);
-  if (!ok) { remove(tmp.c_str()); return false; }
+  if (!ok) {
+    // the old log stays live — restore its accounting too
+    total_bytes = old_total;
+    remove(tmp.c_str());
+    return false;
+  }
   if (log) fclose(log);
   if (rename(tmp.c_str(), path.c_str()) != 0) {
     log = fopen(path.c_str(), "ab");
     return false;
   }
+  // persist the rename itself (directory entry)
+  std::string dir = ".";
+  auto slash = path.find_last_of('/');
+  if (slash != std::string::npos) dir = path.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) { fsync(dfd); close(dfd); }
   log = fopen(path.c_str(), "ab");
   live_bytes = 0;
   for (auto& kv : index) live_bytes += 12 + kv.first.size() + kv.second.size();
@@ -241,7 +256,11 @@ void ndb_free(uint8_t* p) { free(p); }
 int ndb_sync(void* h) {
   auto* db = static_cast<DB*>(h);
   std::lock_guard<std::mutex> g(db->mu);
+  // durable like the reference's LevelDB SetSync: flush userspace
+  // buffers AND force the kernel to persist to the device —
+  // consensus-critical stores rely on surviving power loss
   if (fflush(db->log) != 0) return -1;
+  if (fsync(fileno(db->log)) != 0) return -1;
   return 0;
 }
 

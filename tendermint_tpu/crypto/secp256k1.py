@@ -58,6 +58,11 @@ class PubKeySecp256k1(PubKey):
             return False
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:], "big")
+        if s > _N // 2:
+            # reject malleable high-s signatures like the reference, which
+            # parses into canonical form "to prevent Secp256k1 malleability"
+            # (secp256k1.go:140-152)
+            return False
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self.data)

@@ -30,20 +30,30 @@ class ErrUnknownValidators(ErrLiteVerification):
     """dynamic_verifier.go errUnknownValidators."""
 
 
+class ErrTooMuchChange(ErrLiteVerification):
+    """dynamic_verifier.go errTooMuchChange: too little of the OLD
+    trusted valset signed a valset-changing header. The only error the
+    bisection walk may recover from — anything else (bad signature,
+    malformed commit) must surface immediately."""
+
+
 def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
                             signed_header: SignedHeader,
-                            trust_fraction_num: int = 1,
+                            trust_fraction_num: int = 2,
                             trust_fraction_den: int = 3) -> None:
-    """types/validator_set.go VerifyCommitTrusting-style check: enough
-    of OUR trusted set signed the new header (used while stepping
-    across valset changes). Signature validity rides the batch
-    verifier; power tally over the trusted set."""
+    """types/validator_set.go VerifyFutureCommit-style check: >2/3 of
+    OUR trusted set must have signed the new header (used while
+    stepping across valset changes, validator_set.go:409-434; the
+    reference requires oldVals 2/3, not 1/3). Signature validity rides
+    the batch verifier; power tally over the trusted set, deduping
+    signers like the reference's seen-map."""
     from ..crypto import batch
     from ..types.basic import VOTE_TYPE_PRECOMMIT
 
     commit = signed_header.commit
     bv = batch.new_batch_verifier()
     entries = []
+    seen = set()
     for precommit in commit.precommits:
         if precommit is None:
             continue
@@ -52,6 +62,10 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
         idx, val = vals.get_by_address(precommit.validator_address)
         if val is None:
             continue  # signer not in our trusted set
+        if idx in seen:
+            raise ErrLiteVerification(
+                f"double vote from {val.address.hex()[:12]} in commit")
+        seen.add(idx)
         bv.add(precommit.sign_bytes(chain_id), precommit.signature,
                val.pub_key.bytes())
         entries.append((precommit, val))
@@ -65,7 +79,7 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
             tallied += val.voting_power
     total = vals.total_voting_power()
     if tallied * trust_fraction_den <= total * trust_fraction_num:
-        raise ErrLiteVerification(
+        raise ErrTooMuchChange(
             f"too little trusted power signed: {tallied}/{total}")
 
 
@@ -182,9 +196,10 @@ class DynamicVerifier:
                     trusted_fc.next_validators,
                 ).verify(source_fc.signed_header)
             else:
-                # valset changed (reference VerifyFutureCommit): BOTH
-                # +1/3 of the old trusted set signed it AND +2/3 of
-                # the commit's own claimed valset signed it
+                # valset changed (reference VerifyFutureCommit,
+                # validator_set.go:409-434): BOTH >2/3 of the old
+                # trusted set signed it AND +2/3 of the commit's own
+                # claimed valset signed it
                 _verify_commit_trusting(
                     trusted_fc.next_validators or trusted_fc.validators,
                     self.chain_id, source_fc.signed_header)
@@ -194,7 +209,10 @@ class DynamicVerifier:
                 ).verify(source_fc.signed_header)
             self.trusted.save_full_commit(source_fc)
             return
-        except ErrLiteVerification:
+        except ErrTooMuchChange:
+            # only a too-large valset jump is recoverable by walking
+            # intermediate heights (dynamic_verifier.go:237-249); a
+            # plainly invalid commit must not trigger O(log h) fetches
             pass
         # bisect: trust the midpoint first, then retry
         mid = (trusted_fc.height + source_fc.height) // 2

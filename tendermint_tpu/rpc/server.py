@@ -34,6 +34,10 @@ LOG = logging.getLogger("rpc.server")
 
 WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
+# cap on POST bodies: the RPC port is public, and Content-Length is
+# attacker-controlled (same spirit as the remote-signer MAX_FRAME)
+MAX_BODY_BYTES = 1 << 20
+
 
 class RPCServer:
     def __init__(self, env: RPCEnvironment, host: str, port: int,
@@ -92,7 +96,18 @@ def _make_handler(server: RPCServer):
             self.wfile.write(body)
 
         def do_POST(self):
-            length = int(self.headers.get("Content-Length", 0))
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                length = -1
+            if not 0 <= length <= MAX_BODY_BYTES:
+                # unread body bytes would desync this keep-alive stream
+                self.close_connection = True
+                return self._send_json(
+                    jsonrpc.error_response(
+                        None, jsonrpc.ERR_INVALID_REQUEST,
+                        f"request body exceeds {MAX_BODY_BYTES} bytes"),
+                    status=413)
             raw = self.rfile.read(length)
             try:
                 req = jsonrpc.loads(raw)
