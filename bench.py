@@ -9,16 +9,57 @@ cost is constant).
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
 vs_baseline > 1 means faster than the serial baseline.
+
+Robustness: the TPU platform (axon) is probed in a SUBPROCESS with a hard
+timeout first — its init can hang indefinitely when the chip is held or
+the tunnel is down, and a hung init must not prevent the JSON line. On
+probe failure the kernel runs on an 8-device virtual CPU mesh and the
+line is emitted with "degraded": "cpu8" (honest, slower number). Any
+other failure still emits a parseable line with value -1.
 """
 
 import json
+import os
 import secrets
+import subprocess
 import sys
 import time
 
+try:
+    METRIC_N = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+except ValueError:
+    METRIC_N = 10000
+
+
+def _tpu_available(timeout: float = 240.0) -> bool:
+    """Probe backend init + one tiny op in a subprocess with a timeout."""
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "devs = jax.devices()\n"
+        "assert devs and devs[0].platform.lower() != 'cpu', devs\n"
+        "x = jnp.ones((8, 8))\n"
+        "print(float((x @ x).sum()))\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+    n = METRIC_N
+    degraded = None
+    if os.environ.get("TM_TPU_BENCH_FORCE_CPU") or not _tpu_available():
+        degraded = "cpu8"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+
     from tendermint_tpu.crypto import keys
     from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
 
@@ -46,27 +87,43 @@ def main():
         keys.PubKeyEd25519(pks[i]).verify_bytes(msgs[i], sigs[i])
     serial_ms = (time.perf_counter() - t0) / sub * n * 1000
 
-    # TPU batch path: one warmup (compile), then timed runs
+    # batch path: one warmup (compile; persistent cache warms later runs),
+    # then timed runs — fewer on the slow degraded path
     got = verify_batch(msgs, sigs, pks)
-    assert got == want, "TPU verify mask mismatch vs expected"
+    assert got == want, "batch verify mask mismatch vs expected"
     times = []
-    for _ in range(5):
+    for _ in range(2 if degraded else 5):
         t0 = time.perf_counter()
         verify_batch(msgs, sigs, pks)
         times.append((time.perf_counter() - t0) * 1000)
     batch_ms = min(times)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"verify_commit_{n}_sigs_wall_ms",
-                "value": round(batch_ms, 3),
-                "unit": "ms",
-                "vs_baseline": round(serial_ms / batch_ms, 2),
-            }
-        )
-    )
+    out = {
+        "metric": f"verify_commit_{n}_sigs_wall_ms",
+        "value": round(batch_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(serial_ms / batch_ms, 2),
+    }
+    if degraded:
+        out["degraded"] = degraded
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 - the JSON line must still appear
+        import traceback
+
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": f"verify_commit_{METRIC_N}_sigs_wall_ms",
+                    "value": -1,
+                    "unit": "ms",
+                    "vs_baseline": 0,
+                    "error": str(e)[-200:],
+                }
+            )
+        )

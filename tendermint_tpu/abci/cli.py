@@ -141,11 +141,9 @@ def console(client: Client, input_lines=None) -> int:
     return 0
 
 
-def serve_app(kind: str, address: str) -> int:
-    """Run an example app as a socket server (abci-cli kvstore/counter
-    subcommands)."""
-    from .server import ABCIServer
-
+def serve_app(kind: str, address: str, abci: str = "socket") -> int:
+    """Run an example app as a socket or gRPC server (abci-cli
+    kvstore/counter subcommands; reference abci-cli --abci flag)."""
     if kind == "kvstore":
         from .example.kvstore import KVStoreApplication
 
@@ -154,9 +152,18 @@ def serve_app(kind: str, address: str) -> int:
         from .example.counter import CounterApplication
 
         app = CounterApplication(serial=True)
-    srv = ABCIServer(address, app)
-    srv.start()
-    print(f"Serving {kind} on port {srv.local_port()}", flush=True)
+    if abci == "grpc":
+        from .grpc_app import GRPCApplicationServer
+
+        srv = GRPCApplicationServer(address, app)
+        srv.start()
+        print(f"Serving {kind} on port {srv.port} (grpc)", flush=True)
+    else:
+        from .server import ABCIServer
+
+        srv = ABCIServer(address, app)
+        srv.start()
+        print(f"Serving {kind} on port {srv.local_port()}", flush=True)
     try:
         import threading
 
@@ -174,6 +181,8 @@ def main(argv=None) -> int:
         description="CLI for driving an ABCI application")
     p.add_argument("--address", default="tcp://127.0.0.1:26658",
                    help="ABCI server address")
+    p.add_argument("--abci", choices=("socket", "grpc"), default="socket",
+                   help="ABCI transport (reference abci-cli --abci flag)")
     sub = p.add_subparsers(dest="command")
     for c in CONSOLE_COMMANDS:
         sp = sub.add_parser(c)
@@ -190,9 +199,14 @@ def main(argv=None) -> int:
         p.print_help()
         return 1
     if args.command in ("kvstore", "counter"):
-        return serve_app(args.command, args.address)
+        return serve_app(args.command, args.address, args.abci)
 
-    client = SocketClient(args.address.split("://")[-1])
+    if args.abci == "grpc":
+        from .grpc_app import GRPCClient
+
+        client = GRPCClient(args.address)
+    else:
+        client = SocketClient(args.address.split("://")[-1])
     try:
         if args.command == "console":
             return console(client)

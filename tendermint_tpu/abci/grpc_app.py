@@ -1,0 +1,212 @@
+"""ABCI over gRPC (reference abci/client/grpc_client.go +
+abci/server/grpc_server.go).
+
+The reference's second ABCI transport: the app serves the
+`types.ABCIApplication` gRPC service and the node dials it with one
+channel per app connection. Same method set and payloads as the socket
+transport (abci/codec.py msgpack bodies) registered as generic
+unary-unary handlers over HTTP/2 — no .proto codegen step, mirroring
+rpc/grpc_api.py's approach.
+
+Select with config `[base] abci = "grpc"` + `proxy_app = "tcp://..."`,
+or a `grpc://host:port` proxy-app address.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Optional
+
+import msgpack
+
+from . import types as abci
+from .client import ABCIClientError, Client
+from .codec import REQUEST_CODECS, RESPONSE_CODECS
+
+SERVICE = "types.ABCIApplication"
+
+# method name -> (request codec key or None for raw payloads)
+_METHODS = (
+    "Echo", "Flush", "Info", "SetOption", "DeliverTx", "CheckTx", "Query",
+    "Commit", "InitChain", "BeginBlock", "EndBlock",
+)
+
+
+def _pack(obj) -> bytes:
+    # one-element envelope: grpc's Python runtime treats a DESERIALIZER
+    # RETURNING None as a deserialization failure, so bare nil payloads
+    # (Flush/Commit) would be rejected with INTERNAL; the deserializer
+    # must therefore hand back the (always-truthy) envelope and the
+    # handler/call layer unwraps it
+    return msgpack.packb([obj], use_bin_type=True)
+
+
+def _unpack(raw: bytes):
+    return msgpack.unpackb(raw, raw=False)
+
+
+class GRPCApplicationServer:
+    """Serves an Application over gRPC (grpc_server.go). The reference
+    wraps the app in types.GRPCApplication (application.go:79-138),
+    which serializes nothing extra — calls go straight through; like
+    local_client we serialize with one lock (the app sees the same
+    single-threaded discipline the socket server provides)."""
+
+    def __init__(self, address: str, app: abci.Application):
+        import grpc
+
+        self.app = app
+        self._lock = threading.Lock()
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                getattr(self, f"_{name.lower()}"),
+                request_deserializer=_unpack,
+                response_serializer=_pack,
+            )
+            for name in _METHODS
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        host_port = address.replace("grpc://", "").replace("tcp://", "")
+        self.port = self._server.add_insecure_port(host_port)
+        if self.port == 0:
+            raise OSError(f"cannot bind gRPC ABCI server at {address}")
+
+    @property
+    def listen_addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    # -- handlers ------------------------------------------------------
+
+    def _echo(self, request, context):
+        return request[0]
+
+    def _flush(self, request, context):
+        return None
+
+    def _info(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["info"].encode(
+                self.app.info(REQUEST_CODECS["info"].decode(request[0])))
+
+    def _setoption(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["set_option"].encode(
+                self.app.set_option(REQUEST_CODECS["set_option"].decode(request[0])))
+
+    def _delivertx(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["deliver_tx"].encode(
+                self.app.deliver_tx(request[0]))
+
+    def _checktx(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["check_tx"].encode(
+                self.app.check_tx(request[0]))
+
+    def _query(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["query"].encode(
+                self.app.query(REQUEST_CODECS["query"].decode(request[0])))
+
+    def _commit(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["commit"].encode(self.app.commit())
+
+    def _initchain(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["init_chain"].encode(
+                self.app.init_chain(REQUEST_CODECS["init_chain"].decode(request[0])))
+
+    def _beginblock(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["begin_block"].encode(
+                self.app.begin_block(REQUEST_CODECS["begin_block"].decode(request[0])))
+
+    def _endblock(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["end_block"].encode(
+                self.app.end_block(REQUEST_CODECS["end_block"].decode(request[0])))
+
+
+class GRPCClient(Client):
+    """ABCI client over gRPC (grpc_client.go). One channel; unary calls
+    (the reference's grpc client is synchronous under the hood too —
+    grpc_client.go:179: 'the real implementation [is] synchronous')."""
+
+    def __init__(self, address: str, timeout: float = 10.0):
+        import grpc
+
+        self.address = address.replace("grpc://", "").replace("tcp://", "")
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(self.address)
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        self._calls = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE}/{name}",
+                request_serializer=_pack,
+                response_deserializer=_unpack,
+            )
+            for name in _METHODS
+        }
+
+    def _call(self, name: str, payload):
+        import grpc
+
+        try:
+            return self._calls[name](payload, timeout=self._timeout)[0]
+        except grpc.RpcError as e:  # surface like socket-client errors
+            raise ABCIClientError(f"grpc {name} failed: {e.code()}: {e.details()}")
+
+    def echo(self, msg):
+        return self._call("Echo", msg)
+
+    def flush(self):
+        self._call("Flush", None)
+
+    def info(self, req):
+        return RESPONSE_CODECS["info"].decode(
+            self._call("Info", REQUEST_CODECS["info"].encode(req)))
+
+    def set_option(self, req):
+        return RESPONSE_CODECS["set_option"].decode(
+            self._call("SetOption", REQUEST_CODECS["set_option"].encode(req)))
+
+    def query(self, req):
+        return RESPONSE_CODECS["query"].decode(
+            self._call("Query", REQUEST_CODECS["query"].encode(req)))
+
+    def check_tx(self, tx):
+        return RESPONSE_CODECS["check_tx"].decode(self._call("CheckTx", tx))
+
+    def init_chain(self, req):
+        return RESPONSE_CODECS["init_chain"].decode(
+            self._call("InitChain", REQUEST_CODECS["init_chain"].encode(req)))
+
+    def begin_block(self, req):
+        return RESPONSE_CODECS["begin_block"].decode(
+            self._call("BeginBlock", REQUEST_CODECS["begin_block"].encode(req)))
+
+    def deliver_tx(self, tx):
+        return RESPONSE_CODECS["deliver_tx"].decode(self._call("DeliverTx", tx))
+
+    def end_block(self, req):
+        return RESPONSE_CODECS["end_block"].decode(
+            self._call("EndBlock", REQUEST_CODECS["end_block"].encode(req)))
+
+    def commit(self):
+        return RESPONSE_CODECS["commit"].decode(self._call("Commit", None))
+
+    def close(self):
+        try:
+            self._channel.close()
+        except Exception:
+            pass

@@ -72,6 +72,8 @@ def cmd_node(args) -> int:
     c = _load_config(args.home)
     if args.proxy_app:
         c.base.proxy_app = args.proxy_app
+    if getattr(args, "abci", ""):
+        c.base.abci = args.abci
     if args.p2p_laddr:
         c.p2p.laddr = args.p2p_laddr
     if args.rpc_laddr:
@@ -271,6 +273,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("node", help="run the node")
     sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--abci", choices=("socket", "grpc"), default="",
+                    help="transport for remote ABCI apps")
     sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
     sp.add_argument("--p2p.persistent_peers", dest="persistent_peers",

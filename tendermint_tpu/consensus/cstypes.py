@@ -124,9 +124,10 @@ class HeightVoteSet:
                 self._add_round(r)
             self.round = round_
 
-    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+    def add_vote(self, vote: Vote, peer_id: str = "", verified: bool = False) -> bool:
         """Verify+add; returns added. Unwanted future-round votes (no peer
-        maj23 claim) return False (reference :105-128)."""
+        maj23 claim) return False (reference :105-128). verified=True
+        passes through to VoteSet.add_vote (batched pre-verification)."""
         with self._lock:
             vs = self._get(vote.round, vote.type)
             if vs is None:
@@ -138,7 +139,7 @@ class HeightVoteSet:
                     self._peer_catchup_rounds[peer_id] = rounds
                 else:
                     return False  # punish peer? (reference returns ErrGotVoteFromUnwantedRound)
-            return vs.add_vote(vote)
+            return vs.add_vote(vote, verified=verified)
 
     def prevotes(self, round_: int) -> Optional[VoteSet]:
         with self._lock:

@@ -8,9 +8,12 @@ per-peer gossip threads (gossipDataRoutine :456, gossipVotesRoutine
 NewRoundStep/HasVote ride the node event bus (the reference uses an
 internal event switch, reactor.go:371-395).
 
-Vote gossip is where the TPU batch-verify engine aggregates work: a
-catch-up peer's vote stream lands in VoteSet.add_votes which verifies
-whole batches at once.
+Vote gossip is where the TPU batch-verify engine aggregates work: gossiped
+votes are queued to the consensus receive loop, which drains each
+contiguous run of queued VoteMessages and pre-verifies it as one
+BatchVerifier call (consensus/state.py _handle_vote_msgs) — a catch-up
+peer's vote stream therefore lands on the device in batches, not one
+serial verify per message.
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ from ..types.event_bus import (
     EVENT_VOTE,
     query_for_event,
 )
-from .cstypes import STEP_NEW_HEIGHT, STEP_PREVOTE_WAIT
+from .cstypes import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PREVOTE_WAIT
 from .messages import (
     BlockPartMessage,
     CommitStepMessage,
@@ -334,9 +337,20 @@ class ConsensusReactor(Reactor):
             pass
 
     def switch_to_consensus(self, state, blocks_synced: int = 0) -> None:
-        """Fast-sync handoff (reactor.go:101-123)."""
+        """Fast-sync handoff (reactor.go:101-123).
+
+        Note the reconstruct AFTER update_to_state: the reference (v0.27)
+        calls reconstructLastCommit first and updateToState then clobbers
+        cs.LastCommit back to nil (state.go:497-501,533) — a proposer
+        that fast-synced could then never build a block. Later upstream
+        versions fixed the order; we do the fixed order.
+        """
         self.cs.update_to_state(state)
+        self.cs._reconstruct_last_commit_if_needed(state)
         self.fast_sync = False
+        if blocks_synced > 0:
+            # don't bother with the WAL if we fast synced (reactor.go:114-117)
+            self.cs.do_wal_catchup = False
         self.cs.start()
 
     # -- peers ---------------------------------------------------------
@@ -350,6 +364,9 @@ class ConsensusReactor(Reactor):
         # announce our current state so the peer can gossip to us
         rs = self.cs.get_round_state()
         peer.send(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+        cs_msg = _commit_step_msg(rs)
+        if cs_msg is not None:
+            peer.send(STATE_CHANNEL, encode_msg(cs_msg))
         threads = []
         for fn, nm in (
             (self._gossip_data_routine, "gossip-data"),
@@ -461,6 +478,15 @@ class ConsensusReactor(Reactor):
             if msg is not None:
                 rs = msg.data
                 self._broadcast(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+                cs_msg = _commit_step_msg(rs)
+                if cs_msg is not None:
+                    # reference makeRoundStepMessages (reactor.go:404-412):
+                    # entering commit advertises our block-parts header +
+                    # bitmap so peers can feed us the parts we're missing —
+                    # WITHOUT this a node that enters commit via catch-up
+                    # precommits (e.g. right after the fast-sync handoff)
+                    # deadlocks: peers never learn which parts to send
+                    self._broadcast(STATE_CHANNEL, encode_msg(cs_msg))
             vmsg = sub_vote.get(timeout=0.0)
             if vmsg is not None:
                 vote = vmsg.data["vote"]
@@ -550,17 +576,10 @@ class ConsensusReactor(Reactor):
         if prs.proposal_block_parts_header is None or not (
             prs.proposal_block_parts_header.hash == meta.block_id.parts_header.hash
         ):
-            # peer doesn't know the right parts header yet: tell it
-            peer.try_send(
-                STATE_CHANNEL,
-                encode_msg(
-                    CommitStepMessage(
-                        height=prs.height,
-                        block_parts_header=meta.block_id.parts_header,
-                        block_parts=BitArray(meta.block_id.parts_header.total),
-                    )
-                ),
-            )
+            # the peer hasn't advertised the matching parts header yet —
+            # it will via its CommitStepMessage once catch-up precommits
+            # drive it into the commit step (reactor.go:536-544 just
+            # sleeps here too)
             return False
         if prs.proposal_block_parts is None:
             return False
@@ -718,4 +737,16 @@ def _new_round_step_msg(rs) -> NewRoundStepMessage:
         step=rs.step,
         seconds_since_start_time=max(since, 0),
         last_commit_round=last_commit_round,
+    )
+
+
+def _commit_step_msg(rs) -> Optional[CommitStepMessage]:
+    """reference makeRoundStepMessages (reactor.go:404-412): at commit
+    step, advertise the parts header + which parts we already have."""
+    if rs.step != STEP_COMMIT or rs.proposal_block_parts is None:
+        return None
+    return CommitStepMessage(
+        height=rs.height,
+        block_parts_header=rs.proposal_block_parts.header(),
+        block_parts=rs.proposal_block_parts.bit_array(),
     )

@@ -10,10 +10,15 @@ enterPrecommitWait :1121 → enterCommit :1149 → finalizeCommit :1225 —
 is reproduced exactly, including proposer selection, POL locking rules,
 and the commit fsync ordering with fail points.
 
-Vote ingestion (addVote :1495-1639) is north-star call site #2: the
-machine verifies one vote at a time on the live path (latency-shaped);
-bulk verification happens in VoteSet.add_votes (WAL replay, reactor
-catch-up) and ValidatorSet.verify_commit (fast sync) on the TPU.
+Vote ingestion (addVote :1495-1639) is north-star call site #2, and the
+live path batches ADAPTIVELY: the receive loop drains the contiguous run
+of queued VoteMessages and pre-verifies their signatures as ONE
+BatchVerifier call (per-item masks) before running the per-vote
+transitions (_handle_vote_msgs / _preverify_votes). Light traffic →
+batch of 1 → serial CPU verify, zero added latency; heavy traffic
+(catch-up streams, big valsets) → device-sized batches. Bulk ingestion
+(VoteSet.add_votes for commit reconstruction, ValidatorSet.verify_commit
+for fast sync) rides the same engine.
 """
 
 from __future__ import annotations
@@ -65,6 +70,10 @@ from .wal import NilWAL, WAL, EndHeightMessage, TimedWALMessage
 
 LOG = logging.getLogger("consensus")
 
+# cap on one drained vote batch — bounds the pre-commit-event latency of
+# the first vote in the run and the device bucket size
+MAX_VOTE_BATCH = 1024
+
 
 class ConsensusState:
     """The consensus machine for one node (reference ConsensusState
@@ -109,6 +118,8 @@ class ConsensusState:
         self._done = threading.Event()
         self._stopped = threading.Event()
         self._replay_mode = False
+        # reactor.go:114-117: a fast-synced node skips WAL catchup
+        self.do_wal_catchup = True
 
         # test/reactor hooks (reference :106-108,150-153)
         self.decide_proposal: Callable = self._default_decide_proposal
@@ -129,7 +140,8 @@ class ConsensusState:
     def start(self) -> None:
         self.wal.start()
         self.ticker.start()
-        self._catchup_replay(self.rs.height)
+        if self.do_wal_catchup:
+            self._catchup_replay(self.rs.height)
         self._tock_thread = threading.Thread(
             target=self._tock_forwarder, name="cs-tock", daemon=True
         )
@@ -241,8 +253,14 @@ class ConsensusState:
             state.last_validators,
         )
         votes = [v for v in seen.precommits if v is not None]
-        # bulk path: ONE batched (TPU) verification for the whole commit
-        last_precommits.add_votes(votes)
+        # bulk path: ONE batched (TPU) verification for the whole commit.
+        # add_votes applies per-item — a corrupt signature in the stored
+        # commit must not discard the valid +2/3 riding with it; the
+        # quorum check below is the authoritative gate.
+        try:
+            last_precommits.add_votes(votes)
+        except ErrVoteInvalid as e:
+            LOG.warning("reconstructing LastCommit: %s", e)
         if not last_precommits.has_two_thirds_majority():
             raise RuntimeError("reconstructed LastCommit lacks +2/3")
         self.rs.last_commit = last_precommits
@@ -265,29 +283,122 @@ class ConsensusState:
 
     def _receive_routine(self) -> None:
         """Single-writer loop (reference receiveRoutine :561-622). All
-        state mutation happens on this thread."""
+        state mutation happens on this thread.
+
+        Adaptive vote batching (SURVEY §7 "latency discipline"): when the
+        head of the queue is a VoteMessage, the CONTIGUOUS run of queued
+        VoteMessages behind it is drained and signature-verified as ONE
+        BatchVerifier call before the per-vote state transitions run.
+        Batch size is whatever accumulated while this thread was busy —
+        zero added latency when idle (batch of 1 → serial CPU verify via
+        the adaptive backend), device-sized batches exactly when vote
+        traffic is heavy (catch-up peers, large valsets). Queue order is
+        preserved: draining stops at the first non-vote message."""
         try:
             while not self._done.is_set():
                 try:
-                    kind, payload = self._queue.get(timeout=0.1)
+                    item = self._queue.get(timeout=0.1)
                 except queue.Empty:
                     continue
                 try:
-                    if kind == "msg":
-                        peer_id, msg = payload
-                        if peer_id == "":
-                            self.wal.write_sync((peer_id, msg))  # :604-609
-                        else:
-                            self.wal.write((peer_id, msg))
-                        self._handle_msg(msg, peer_id)
-                    elif kind == "timeout":
-                        ti: TimeoutInfo = payload
-                        self.wal.write(ti)
-                        self._handle_timeout(ti)
+                    if item[0] == "msg" and isinstance(item[1][1], VoteMessage):
+                        votes = [item[1]]
+                        tail = None
+                        while len(votes) < MAX_VOTE_BATCH:
+                            try:
+                                nxt = self._queue.get_nowait()
+                            except queue.Empty:
+                                break
+                            if nxt[0] == "msg" and isinstance(nxt[1][1], VoteMessage):
+                                votes.append(nxt[1])
+                            else:
+                                tail = nxt
+                                break
+                        try:
+                            for peer_id, msg in votes:
+                                if peer_id == "":
+                                    self.wal.write_sync((peer_id, msg))  # :604-609
+                                else:
+                                    self.wal.write((peer_id, msg))
+                            self._handle_vote_msgs(votes)
+                        finally:
+                            # the tail was already dequeued — it must not
+                            # be lost to a WAL or vote-handling exception
+                            if tail is not None:
+                                self._handle_item(tail)
+                    else:
+                        self._handle_item(item)
                 except Exception:
                     LOG.exception("error in consensus receive loop")
         finally:
             self._stopped.set()
+
+    def _handle_item(self, item) -> None:
+        kind, payload = item
+        if kind == "msg":
+            peer_id, msg = payload
+            if peer_id == "":
+                self.wal.write_sync((peer_id, msg))  # :604-609
+            else:
+                self.wal.write((peer_id, msg))
+            self._handle_msg(msg, peer_id)
+        elif kind == "timeout":
+            ti: TimeoutInfo = payload
+            self.wal.write(ti)
+            self._handle_timeout(ti)
+
+    def _handle_vote_msgs(self, items) -> None:
+        """Apply a drained run of VoteMessages: one batched signature
+        verification (per-item masks), then the normal per-vote
+        transition logic with the verify skipped for items that passed."""
+        if len(items) == 1:
+            peer_id, msg = items[0]
+            self._try_add_vote(msg.vote, peer_id)
+            return
+        mask = self._preverify_votes([m.vote for _, m in items])
+        for (peer_id, msg), ok in zip(items, mask):
+            self._try_add_vote(msg.vote, peer_id, verified=ok)
+
+    def _preverify_votes(self, votes) -> List[bool]:
+        """Batch-verify vote signatures against the SAME (valset, chain_id)
+        the per-vote add path would use: rs.validators for the current
+        height, the LastCommit's valset for late precommits. Votes that
+        can't be mapped (wrong height/index/address) come back False and
+        take the serial path's normal rejection."""
+        from ..crypto import batch as crypto_batch
+
+        rs = self.rs
+        chain_id = self.state.chain_id
+        triples = []
+        slots: List[Optional[int]] = []
+        for vote in votes:
+            val_set = None
+            if vote.height == rs.height:
+                val_set = rs.validators
+            elif (
+                vote.height + 1 == rs.height
+                and rs.last_commit is not None
+                and vote.type == VOTE_TYPE_PRECOMMIT
+            ):
+                val_set = rs.last_commit.val_set
+            slot = None
+            if (
+                val_set is not None
+                and 0 <= vote.validator_index < len(val_set)
+                and vote.signature is not None
+                and len(vote.signature) == 64
+            ):
+                addr, val = val_set.get_by_index(vote.validator_index)
+                if addr == vote.validator_address:
+                    slot = len(triples)
+                    triples.append(
+                        (vote.sign_bytes(chain_id), vote.signature, val.pub_key.bytes())
+                    )
+            slots.append(slot)
+        if not triples:
+            return [False] * len(votes)
+        mask = crypto_batch.batch_verify(triples)
+        return [bool(mask[s]) if s is not None else False for s in slots]
 
     def _handle_msg(self, msg, peer_id: str) -> None:
         """reference handleMsg :625-674"""
@@ -635,7 +746,6 @@ class ConsensusState:
             rs.step = STEP_COMMIT
             rs.commit_round = commit_round
             rs.commit_time = time.time()
-            self._new_step()
 
             block_id = rs.votes.precommits(commit_round).two_thirds_majority()
             if block_id is None:
@@ -652,6 +762,11 @@ class ConsensusState:
                     rs.proposal_block = None
                     rs.proposal_block_parts = PartSet(block_id.parts_header)
         finally:
+            # the reference runs newStep in a defer (:1152-1160), i.e.
+            # AFTER ProposalBlockParts is set — the step event carries the
+            # parts header the reactor's CommitStepMessage advertises; an
+            # event fired before the parts are set would deadlock catch-up
+            self._new_step()
             self._try_finalize_commit(height)
 
     def _try_finalize_commit(self, height: int) -> None:
@@ -793,11 +908,12 @@ class ConsensusState:
 
     # --- vote handling ------------------------------------------------------
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _try_add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """reference tryAddVote :1468-1493 — conflicting votes become
-        evidence."""
+        evidence. verified=True: signature already checked by the batched
+        pre-verification in _handle_vote_msgs."""
         try:
-            return self._add_vote(vote, peer_id)
+            return self._add_vote(vote, peer_id, verified=verified)
         except ErrVoteConflictingVotes as e:
             if self.priv_validator is not None and vote.validator_address == self.priv_validator.get_address():
                 LOG.error("found conflicting vote from ourselves: %s", vote)
@@ -815,7 +931,7 @@ class ConsensusState:
             LOG.warning("invalid vote from %s: %s", peer_id or "self", e)
             return False
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _add_vote(self, vote: Vote, peer_id: str, verified: bool = False) -> bool:
         """reference addVote :1495-1639"""
         rs = self.rs
 
@@ -823,7 +939,7 @@ class ConsensusState:
         if vote.height + 1 == rs.height:
             if not (vote.type == VOTE_TYPE_PRECOMMIT and rs.step == STEP_NEW_HEIGHT and rs.last_commit is not None):
                 return False
-            added = rs.last_commit.add_vote(vote)
+            added = rs.last_commit.add_vote(vote, verified=verified)
             if added:
                 LOG.debug("added late precommit to last commit: %s", rs.last_commit)
                 self.event_bus.publish_vote(vote)
@@ -837,7 +953,7 @@ class ConsensusState:
             LOG.debug("vote ignored: wrong height %d vs %d", vote.height, rs.height)
             return False
 
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verified=verified)
         if not added:
             return False
         self.event_bus.publish_vote(vote)

@@ -9,10 +9,14 @@ routes through this registry instead, and per-item validity masks come back
 equations).
 
 Backends:
-  "cpu"  — per-signature verify via OpenSSL (always available; baseline)
-  "jax"  — vectorized Ed25519 verify (decompress → SHA-512 → double
-           scalar mult) under vmap/jit; shards across every visible device
-           with shard_map when more than one is present.
+  "cpu"      — per-signature verify via OpenSSL (always available; baseline)
+  "jax"      — vectorized Ed25519 verify (decompress → SHA-512 → double
+               scalar mult) under vmap/jit; shards across every visible
+               device with shard_map when more than one is present.
+  "adaptive" — (default when jax is importable) routes batches below
+               TM_TPU_BATCH_MIN to "cpu" and the rest to "jax": the
+               latency-shaped live vote path stays serial when traffic is
+               light and rides the device exactly when batching pays.
 
 Select with set_default_backend() or the TM_TPU_CRYPTO_BACKEND env var.
 """
@@ -61,6 +65,32 @@ class CPUBatchVerifier(BatchVerifier):
         return out
 
 
+class AdaptiveBatchVerifier(BatchVerifier):
+    """Latency-shaped dispatch: device batch verification pays a fixed
+    dispatch cost per call, so tiny batches (the live add_vote path when
+    traffic is light) run the serial CPU path and only batches of
+    >= min_device_batch ride the device kernel. The threshold is the
+    crossover point between per-sig CPU cost (~100µs) and device
+    dispatch overhead; tune with TM_TPU_BATCH_MIN."""
+
+    def __init__(self, device_factory: Callable[[], BatchVerifier],
+                 min_device_batch: int | None = None):
+        super().__init__()
+        self._device_factory = device_factory
+        if min_device_batch is None:
+            min_device_batch = int(os.environ.get("TM_TPU_BATCH_MIN", "16"))
+        self._min = min_device_batch
+
+    def verify(self) -> List[bool]:
+        if len(self._items) >= self._min:
+            inner = self._device_factory()
+        else:
+            inner = CPUBatchVerifier()
+        for msg, sig, pk in self._items:
+            inner.add(msg, sig, pk)
+        return inner.verify()
+
+
 _registry: dict[str, Callable[[], BatchVerifier]] = {}
 _default_lock = threading.Lock()
 _default_name: str | None = None
@@ -89,6 +119,8 @@ def default_backend_name() -> str:
             env = os.environ.get("TM_TPU_CRYPTO_BACKEND")
             if env and env in _registry:
                 _default_name = env
+            elif "adaptive" in _registry:
+                _default_name = "adaptive"
             elif "jax" in _registry:
                 _default_name = "jax"
             else:
@@ -130,6 +162,9 @@ def _register_jax_backend():
         )
         return
     register_backend("jax", JAXBatchVerifier)
+    register_backend(
+        "adaptive", lambda: AdaptiveBatchVerifier(JAXBatchVerifier)
+    )
 
 
 _register_jax_backend()

@@ -376,5 +376,5 @@ def default_new_node(config: cfg.Config) -> Node:
     else:
         pv = load_or_gen_file_pv(config.base.priv_validator_path())
     genesis_doc = GenesisDoc.load(config.base.genesis_path())
-    creator = default_client_creator(config.base.proxy_app)
+    creator = default_client_creator(config.base.proxy_app, config.base.abci)
     return Node(config, pv, node_key, creator, genesis_doc)

@@ -24,15 +24,26 @@ def local_client_creator(app: abci.Application) -> ClientCreator:
     return create
 
 
-def remote_client_creator(address: str) -> ClientCreator:
+def remote_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+    """Socket or gRPC remote app connection (reference proxy/client.go
+    NewRemoteClientCreator + abci/client.NewClient transport switch).
+    A "grpc://" address forces gRPC regardless of `transport`."""
+    if transport == "grpc" or address.startswith("grpc://"):
+        def create_grpc() -> Client:
+            from ..abci.grpc_app import GRPCClient
+
+            return GRPCClient(address)
+
+        return create_grpc
+
     def create() -> Client:
         return SocketClient(address)
 
     return create
 
 
-def default_client_creator(address: str) -> ClientCreator:
-    """kvstore/counter/noop in-proc, else socket address
+def default_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+    """kvstore/counter/noop in-proc, else socket/grpc address
     (reference proxy/client.go:65-80)."""
     if address == "kvstore":
         from ..abci.example.kvstore import KVStoreApplication
@@ -60,7 +71,7 @@ def default_client_creator(address: str) -> ClientCreator:
         return local_client_creator(CounterApplication(serial=True))
     if address == "noop":
         return local_client_creator(abci.BaseApplication())
-    return remote_client_creator(address)
+    return remote_client_creator(address, transport)
 
 
 class AppConns:

@@ -103,10 +103,15 @@ def verify_evidence(state: State, evidence, load_validators=None) -> None:
         raise ErrInvalidBlock(
             f"evidence from height {ev_height} is too old (max age {max_age})"
         )
-    if ev_height > height:
+    # equivocation at the in-flight height (ev_height == height+1) is the
+    # NORMAL case for evidence created live from conflicting votes (the
+    # reference checks only the age bound, validation.go:167-199); heights
+    # beyond the in-flight one cannot have legitimate votes yet and would
+    # be verified against a valset we cannot know — reject those
+    if ev_height > height + 1:
         raise ErrInvalidBlock(f"evidence from future height {ev_height}")
 
-    if load_validators is not None:
+    if load_validators is not None and ev_height <= height:
         valset = load_validators(ev_height)
     else:
         valset = state.validators

@@ -46,16 +46,21 @@ class VoteSet:
 
     # --- add ---------------------------------------------------------------
 
-    def add_vote(self, vote: Vote) -> bool:
+    def add_vote(self, vote: Vote, verified: bool = False) -> bool:
         """Verify + add one vote. Returns True if it was added (False =
-        benign duplicate). Raises ErrVoteInvalid / ErrVoteConflictingVotes."""
+        benign duplicate). Raises ErrVoteInvalid / ErrVoteConflictingVotes.
+
+        verified=True means the signature was ALREADY checked against
+        this VoteSet's (chain_id, valset) by a batched pre-verification —
+        only internal callers that ran the BatchVerifier themselves may
+        set it (the live batched vote path in consensus/state.py)."""
         with self._lock:
             self._precheck(vote)
             _, val = self.val_set.get_by_index(vote.validator_index)
             conflict = self._conflict_check(vote)
             if conflict == "dup":
                 return False
-            if not vote.verify(self.chain_id, val.pub_key):
+            if not verified and not vote.verify(self.chain_id, val.pub_key):
                 raise ErrVoteInvalid(f"invalid signature on {vote}")
             if conflict is not None:
                 raise ErrVoteConflictingVotes(conflict, vote)
@@ -64,7 +69,13 @@ class VoteSet:
 
     def add_votes(self, votes: List[Vote]) -> List[bool]:
         """Bulk-add: one batched signature verification for all votes
-        (TPU path), then tally. Invalid items raise after the batch."""
+        (TPU path), then tally with PER-ITEM acceptance — every vote whose
+        signature is valid is applied even when the batch also contains
+        invalid ones, so a peer-supplied batch with one bad signature
+        cannot suppress the valid votes riding with it (the kernel
+        returns per-item masks; use them). After the good votes are
+        applied, the first bad signature raises ErrVoteInvalid and the
+        first conflict raises ErrVoteConflictingVotes (evidence)."""
         with self._lock:
             to_verify = []
             for vote in votes:
@@ -75,23 +86,30 @@ class VoteSet:
             for vote, val in to_verify:
                 bv.add(vote.sign_bytes(self.chain_id), vote.signature, val.pub_key.bytes())
             mask = bv.verify()
-            # reject the ENTIRE batch before mutating any state — one bad
-            # signature must not leave earlier votes half-applied
-            for ok, (vote, _) in zip(mask, to_verify):
-                if not ok:
-                    raise ErrVoteInvalid(f"invalid signature on {vote}")
-            # all signatures valid: apply with the same semantics as N
-            # sequential add_vote calls (conflicts surface as evidence)
             added = []
-            for vote, val in to_verify:
+            first_invalid: Optional[Vote] = None
+            first_conflict = None
+            for ok, (vote, val) in zip(mask, to_verify):
+                if not ok:
+                    if first_invalid is None:
+                        first_invalid = vote
+                    added.append(False)
+                    continue
                 conflict = self._conflict_check(vote)
                 if conflict == "dup":
                     added.append(False)
                     continue
                 if conflict is not None:
-                    raise ErrVoteConflictingVotes(conflict, vote)
+                    if first_conflict is None:
+                        first_conflict = (conflict, vote)
+                    added.append(False)
+                    continue
                 self._add_verified(vote, val.voting_power)
                 added.append(True)
+            if first_conflict is not None:
+                raise ErrVoteConflictingVotes(first_conflict[0], first_conflict[1])
+            if first_invalid is not None:
+                raise ErrVoteInvalid(f"invalid signature on {first_invalid}")
             return added
 
     def _precheck(self, vote: Optional[Vote]) -> None:

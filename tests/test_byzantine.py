@@ -1,0 +1,145 @@
+"""Byzantine network test (reference consensus/byzantine_test.go:1-274).
+
+A 4-validator in-process net over real TCP where one validator
+equivocates: every time it signs a prevote it also broadcasts a
+CONFLICTING prevote (same height/round, different block) to its peers.
+The honest majority must (1) keep committing identical blocks, and
+(2) detect the equivocation, turn it into DuplicateVoteEvidence
+(consensus/state.py _try_add_vote → evpool), gossip it on the evidence
+channel (0x38), and COMMIT it into a block so the application can
+slash (state/execution.py feeds block.evidence to BeginBlock).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_reactor_net import CHAIN_ID, NetNode, collect_blocks
+
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.consensus.reactor import VOTE_CHANNEL, encode_msg
+from tendermint_tpu.libs.events import Query
+from tendermint_tpu.types import (
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    Vote,
+)
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+from tendermint_tpu.types.validator_set import random_validator_set
+
+
+def test_byzantine_double_signer_is_evidenced_and_chain_lives():
+    vs, keys = random_validator_set(4, 10)
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators],
+    )
+    nodes = [NetNode(i, doc, keys[i]) for i in range(4)]
+    byz = nodes[3]
+    byz_key = keys[3]
+    byz_addr = byz_key.pub_key().address()
+
+    # the byzantine behavior: shadow every own prevote with a conflicting
+    # one for a fabricated block, broadcast straight onto the vote channel
+    byz_votes = byz.bus.subscribe("byz", query_for_event("Vote"), 1024)
+    equivocated = []
+
+    def byz_routine(stop_flag):
+        while not stop_flag[0]:
+            m = byz_votes.get(timeout=0.1)
+            if m is None:
+                continue
+            v = m.data["vote"]
+            if v.validator_address != byz_addr or v.type != VOTE_TYPE_PREVOTE:
+                continue
+            if not v.block_id.hash:
+                continue  # conflicting with nil is also fine, but keep it simple
+            evil = Vote(
+                validator_address=v.validator_address,
+                validator_index=v.validator_index,
+                height=v.height,
+                round=v.round,
+                timestamp=v.timestamp,
+                type=v.type,
+                block_id=BlockID(hash=os.urandom(20)),
+            )
+            evil.signature = byz_key.sign(evil.sign_bytes(CHAIN_ID))
+            byz.switch.broadcast(VOTE_CHANNEL, encode_msg(VoteMessage(evil)))
+            equivocated.append(evil)
+
+    subs = [
+        n.bus.subscribe(f"blk{i}", query_for_event(EVENT_NEW_BLOCK), 256)
+        for i, n in enumerate(nodes)
+    ]
+    for n in nodes:
+        n.start()
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1 :]:
+            a.switch.dial_peer(b.switch.transport.listen_addr)
+
+    import threading
+
+    stop_flag = [False]
+    t = threading.Thread(target=byz_routine, args=(stop_flag,), daemon=True)
+    t.start()
+    try:
+        # honest nodes keep committing
+        per_node = [collect_blocks(s, 4, timeout=90.0) for s in subs[:3]]
+        for i, blocks in enumerate(per_node):
+            assert len(blocks) >= 4, f"honest node {i} committed only {len(blocks)}"
+        assert equivocated, "byzantine node never equivocated"
+
+        # all honest nodes agree on block hashes
+        h2hash = {b.header.height: b.hash() for b in per_node[0]}
+        for blocks in per_node[1:]:
+            for b in blocks:
+                assert b.hash() == h2hash.get(b.header.height, b.hash())
+
+        # evidence reached at least one honest pool...
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(n.evpool.pending_evidence() or _stored_evidence(n)
+                   for n in nodes[:3]):
+                break
+            time.sleep(0.2)
+
+        # ...and lands in a committed block within a few more heights
+        deadline = time.time() + 60
+        found = None
+        while found is None and time.time() < deadline:
+            for n in nodes[:3]:
+                for h in range(1, n.bstore.height() + 1):
+                    blk = n.bstore.load_block(h)
+                    if blk is not None and blk.evidence.evidence:
+                        found = (n, h, blk.evidence.evidence)
+                        break
+                if found:
+                    break
+            time.sleep(0.3)
+        assert found is not None, "DuplicateVoteEvidence never committed to a block"
+        _, height, evs = found
+        ev = evs[0]
+        assert ev.vote_a.validator_address == byz_addr
+        assert ev.vote_b.validator_address == byz_addr
+        assert ev.vote_a.block_id != ev.vote_b.block_id
+    finally:
+        stop_flag[0] = True
+        for n in nodes:
+            n.stop()
+
+
+def _stored_evidence(node) -> bool:
+    for h in range(1, node.bstore.height() + 1):
+        blk = node.bstore.load_block(h)
+        if blk is not None and blk.evidence.evidence:
+            return True
+    return False

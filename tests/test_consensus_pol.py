@@ -1,0 +1,529 @@
+"""Adversarial consensus scenarios: the lock/unlock/POL matrix.
+
+Models the reference's consensus/state_test.go harness (cs1 + scripted
+validator stubs vs2-vs4, event-bus oracles): TestStateLockNoPOL,
+TestStateLockPOLRelock, TestStateLockPOLUnlock, round skipping, bad
+proposals, valid-block rule, conflicting-vote evidence. These drive
+every branch of _enter_precommit / _on_prevote_added
+(consensus/state.py, reference state.go:1025-1118, :1539-1601).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus import make_consensus
+
+from tendermint_tpu.consensus.cstypes import (
+    STEP_COMMIT,
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+)
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.libs.events import Query
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    Vote,
+)
+from tendermint_tpu.types.basic import Proposal
+from tendermint_tpu.types.block import make_part_set
+from tendermint_tpu.types.event_bus import (
+    EVENT_LOCK,
+    EVENT_NEW_BLOCK,
+    EVENT_NEW_ROUND,
+    EVENT_POLKA,
+    EVENT_RELOCK,
+    EVENT_UNLOCK,
+    EVENT_VOTE,
+    query_for_event,
+)
+
+CHAIN_ID = "cs-test"
+
+
+class _FakeEvidencePool:
+    def __init__(self):
+        self.evidence = []
+
+    def add_evidence(self, ev):
+        self.evidence.append(ev)
+
+    def pending_evidence(self):
+        return []
+
+
+class Harness:
+    """One real ConsensusState (validator 0) + 3 scripted stubs."""
+
+    def __init__(self, we_propose_first: bool):
+        # with equal powers/priorities the height-1 proposer is validator 0
+        # (priority tie broken by address order), so choosing our privval
+        # index chooses whether we propose first
+        privval_idx = 0 if we_propose_first else 1
+        for _ in range(64):
+            cs, bus, mp, keys, bstore = make_consensus(4, privval_idx=privval_idx)
+            ours = keys[privval_idx].pub_key().address()
+            is_ours = cs.rs.validators.get_proposer().address == ours
+            if is_ours == we_propose_first:
+                break
+            bus.stop()
+        else:  # pragma: no cover
+            raise AssertionError("could not arrange desired first proposer")
+        self.cs, self.bus, self.mp, self.keys, self.bstore = cs, bus, mp, keys, bstore
+        self.our_idx = privval_idx
+        self.our_addr = ours
+        self.cs.evpool = _FakeEvidencePool()
+        self.votes = bus.subscribe("h-votes", query_for_event(EVENT_VOTE), 4096)
+        self.locks = bus.subscribe("h-locks", query_for_event(EVENT_LOCK), 64)
+        self.unlocks = bus.subscribe("h-unlocks", query_for_event(EVENT_UNLOCK), 64)
+        self.relocks = bus.subscribe("h-relocks", query_for_event(EVENT_RELOCK), 64)
+        self.polkas = bus.subscribe("h-polkas", query_for_event(EVENT_POLKA), 64)
+        self.rounds = bus.subscribe("h-rounds", query_for_event(EVENT_NEW_ROUND), 64)
+        self.blocks = bus.subscribe("h-blocks", query_for_event(EVENT_NEW_BLOCK), 64)
+
+    def start(self):
+        self.cs.start()
+        return self
+
+    def stop(self):
+        self.cs.stop()
+        self.bus.stop()
+
+    # -- oracles -------------------------------------------------------
+
+    def wait_our_vote(self, type_, round_, timeout=10.0):
+        """Next vote from OUR validator with the given type/round."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = self.votes.get(timeout=0.1)
+            if m is None:
+                continue
+            v = m.data["vote"]
+            if (v.validator_address == self.our_addr and v.type == type_
+                    and v.round == round_):
+                return v
+        raise AssertionError(f"no own vote type={type_} round={round_}")
+
+    def wait_event(self, sub, timeout=10.0, pred=None):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = sub.get(timeout=0.1)
+            if m is not None and (pred is None or pred(m.data)):
+                return m.data
+        raise AssertionError("event did not arrive")
+
+    # -- scripted stub actions -----------------------------------------
+
+    def stub_vote(self, i, type_, round_, block_id, height=1):
+        addr, _ = self.cs.rs.validators.get_by_index(i)
+        v = Vote(
+            validator_address=addr,
+            validator_index=i,
+            height=height,
+            round=round_,
+            timestamp=1_700_000_000_000_000_000 + round_,
+            type=type_,
+            block_id=block_id,
+        )
+        v.signature = self.keys[i].sign(v.sign_bytes(CHAIN_ID))
+        self.cs.add_peer_message(VoteMessage(v), peer_id=f"stub-{i}")
+        return v
+
+    def stub_votes(self, type_, round_, block_id, idxs=None, height=1):
+        if idxs is None:
+            idxs = tuple(i for i in range(4) if i != self.our_idx)
+        return [self.stub_vote(i, type_, round_, block_id, height) for i in idxs]
+
+    def make_alt_block(self, proposer_idx, txs=(b"alt-tx",), height=1):
+        """A valid competing block, as a byzantine/other proposer would
+        build it (mirrors _create_proposal_block for height 1)."""
+        addr, _ = self.cs.rs.validators.get_by_index(proposer_idx)
+        block = self.cs.state.make_block(
+            height, list(txs), None, [], addr,
+            time_ns=self.cs.state.last_block_time,
+        )
+        block.last_commit = None
+        return block, make_part_set(block)
+
+    def stub_proposal(self, proposer_idx, round_, block, parts, pol_round=-1,
+                      pol_block_id=None, sign_with=None):
+        p = Proposal(
+            height=block.header.height,
+            round=round_,
+            block_parts_header=parts.header(),
+            pol_round=pol_round,
+            pol_block_id=pol_block_id or BlockID(),
+            timestamp=1_700_000_000_000_000_000,
+        )
+        key = self.keys[sign_with if sign_with is not None else proposer_idx]
+        p.signature = key.sign(p.sign_bytes(CHAIN_ID))
+        self.cs.add_peer_message(ProposalMessage(p), peer_id="stub-prop")
+        for i in range(parts.total()):
+            self.cs.add_peer_message(
+                BlockPartMessage(block.header.height, round_, parts.get_part(i)),
+                peer_id="stub-prop",
+            )
+        return p
+
+
+# ---------------------------------------------------------------------------
+# Locking (reference TestStateLockNoPOL)
+# ---------------------------------------------------------------------------
+
+
+class TestLockNoPOL:
+    def test_lock_on_polka_then_stay_locked_without_pol(self):
+        """Round 0: we propose B, stubs prevote B → we lock B and
+        precommit B. Stubs precommit nil → round 1. Round 1 has no
+        proposal: we must STILL prevote B (locked), and precommit nil
+        (no new polka) while staying locked — state.go:1044-1052 via
+        the locked-block prevote rule :977-995."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash, "proposer must prevote its own block"
+            b_hash = pv0.block_id.hash
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+
+            h.wait_event(h.locks)
+            pc0 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            assert pc0.block_id.hash == b_hash
+            assert h.cs.rs.locked_round == 0
+
+            # deny commit: stubs precommit nil → precommit-wait → round 1
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+
+            # round 1, no proposal: prevote the LOCKED block
+            pv1 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            assert pv1.block_id.hash == b_hash
+
+            # stubs prevote nil: nil polka in r1 → we UNLOCK
+            # (state.go:1061-1075) — this is TestStateLockPOLUnlock's core
+            h.stub_votes(VOTE_TYPE_PREVOTE, 1, BlockID())
+            pc1 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            assert pc1.block_id.hash == b""
+            h.wait_event(h.unlocks)
+            assert h.cs.rs.locked_block is None
+        finally:
+            h.stop()
+
+    def test_precommit_nil_without_polka_keeps_lock(self):
+        """After locking B in r0, round 1 prevotes split (no 2/3 for
+        anything): our precommit r1 is nil but the lock SURVIVES —
+        only a polka may unlock (state.go:1044-1052)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_event(h.locks)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+
+            pv1 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            assert pv1.block_id.hash == pv0.block_id.hash
+            # split prevotes: 2 nil + 1 for B (+ ours for B) → 2/3 ANY but
+            # no polka for either → precommit nil, lock intact
+            h.stub_vote(1, VOTE_TYPE_PREVOTE, 1, BlockID())
+            h.stub_vote(2, VOTE_TYPE_PREVOTE, 1, BlockID())
+            h.stub_vote(3, VOTE_TYPE_PREVOTE, 1, pv0.block_id)
+            pc1 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            assert pc1.block_id.hash == b""
+            assert h.cs.rs.locked_block is not None
+            assert h.cs.rs.locked_round == 0
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Relock / unlock on POL (reference TestStateLockPOLRelock / POLUnlock)
+# ---------------------------------------------------------------------------
+
+
+class TestPOLRelockUnlock:
+    def _lock_b_then_reach_round_1(self, h):
+        pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+        h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+        h.wait_event(h.locks)
+        h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+        h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+        h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+        return pv0.block_id
+
+    def test_relock_on_new_polka_for_same_block(self):
+        """r1 polka for the block we're already locked on → RELOCK:
+        locked_round advances, precommit B (state.go:1078-1086)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            b_id = self._lock_b_then_reach_round_1(h)
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)  # locked prevote
+            h.stub_votes(VOTE_TYPE_PREVOTE, 1, b_id)
+            h.wait_event(h.relocks)
+            pc1 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            assert pc1.block_id.hash == b_id.hash
+            assert h.cs.rs.locked_round == 1
+        finally:
+            h.stop()
+
+    def test_relock_to_new_block_with_proposal(self):
+        """r1: another proposer ships block C; stubs polka C; since we
+        SEE C (proposal+parts complete), we switch the lock to C and
+        precommit C (state.go:1089-1103, TestStateLockPOLRelock)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            self._lock_b_then_reach_round_1(h)
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            proposer_idx, _ = h.cs.rs.validators.get_by_address(
+                h.cs.rs.validators.get_proposer().address
+            ), None
+            # build + deliver C from the round-1 proposer
+            r1_proposer = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == r1_proposer
+            )
+            c_block, c_parts = h.make_alt_block(idx, txs=(b"block-c",))
+            h.stub_proposal(idx, 1, c_block, c_parts)
+            c_id = BlockID(hash=c_block.hash(), parts_header=c_parts.header())
+            h.stub_votes(VOTE_TYPE_PREVOTE, 1, c_id)
+            h.wait_event(h.locks, pred=lambda rs: rs.locked_block is not None
+                         and rs.locked_block.hash() == c_block.hash())
+            pc1 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            assert pc1.block_id.hash == c_block.hash()
+            assert h.cs.rs.locked_round == 1
+        finally:
+            h.stop()
+
+    def test_unlock_on_polka_for_unseen_block(self):
+        """r1 polka for a block C we never received → we must UNLOCK,
+        precommit nil, and start fetching C's parts
+        (state.go:1106-1116)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            self._lock_b_then_reach_round_1(h)
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            c_block, c_parts = h.make_alt_block(1, txs=(b"unseen-c",))
+            c_id = BlockID(hash=c_block.hash(), parts_header=c_parts.header())
+            h.stub_votes(VOTE_TYPE_PREVOTE, 1, c_id)  # no proposal sent!
+            h.wait_event(h.unlocks)
+            pc1 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            assert pc1.block_id.hash == b""
+            assert h.cs.rs.locked_block is None
+            # parts holder now targets C
+            assert h.cs.rs.proposal_block_parts is not None
+            assert h.cs.rs.proposal_block_parts.has_header(c_parts.header())
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Round skipping, prevote rules, proposals (reference TestStateFullRound*,
+# TestStateBadProposal, round-skip logic :1585-1601)
+# ---------------------------------------------------------------------------
+
+
+class TestRoundDiscipline:
+    def test_round_skip_on_two_thirds_any_future_round(self):
+        h = Harness(we_propose_first=True).start()
+        try:
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 5, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 5, timeout=10)
+        finally:
+            h.stop()
+
+    def test_prevote_nil_without_proposal(self):
+        """We are NOT the proposer and no proposal arrives → propose
+        timeout → prevote nil (state.go:977-995)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == b""
+        finally:
+            h.stop()
+
+    def test_prevote_received_proposal_block(self):
+        h = Harness(we_propose_first=False).start()
+        try:
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            block, parts = h.make_alt_block(idx, txs=(b"proposed",))
+            h.stub_proposal(idx, 0, block, parts)
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == block.hash()
+        finally:
+            h.stop()
+
+    def test_bad_proposal_signature_is_rejected(self):
+        """Proposal signed with the WRONG key must be discarded → we
+        time out and prevote nil (state.go:1324-1357)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            block, parts = h.make_alt_block(idx, txs=(b"evil",))
+            wrong_signer = (idx + 1) % 4
+            h.stub_proposal(idx, 0, block, parts, sign_with=wrong_signer)
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == b""
+            assert h.cs.rs.proposal is None
+        finally:
+            h.stop()
+
+    def test_invalid_pol_round_is_rejected(self):
+        """pol_round >= round violates the protocol
+        (state.go:1338-1340)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            block, parts = h.make_alt_block(idx)
+            h.stub_proposal(idx, 0, block, parts, pol_round=0)  # == round
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == b""
+            assert h.cs.rs.proposal is None
+        finally:
+            h.stop()
+
+    def test_polka_event_and_valid_block_rule(self):
+        """2/3 prevotes for our proposal → Polka event; the valid-block
+        pointer (valid_round/valid_block) updates (state.go:1561-1581)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_event(h.polkas)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            assert h.cs.rs.valid_round == 0
+            assert h.cs.rs.valid_block is not None
+            assert h.cs.rs.valid_block.hash() == pv0.block_id.hash
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Commit paths and evidence
+# ---------------------------------------------------------------------------
+
+
+class TestCommitAndEvidence:
+    def test_commit_on_two_thirds_precommits(self):
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, pv0.block_id, idxs=(1, 2))
+            blk = h.wait_event(h.blocks)["block"]
+            assert blk.header.height == 1
+            assert blk.hash() == pv0.block_id.hash
+        finally:
+            h.stop()
+
+    def test_late_precommit_joins_last_commit(self):
+        """A precommit for height H arriving after we moved to H+1 is
+        absorbed into LastCommit (state.go:1504-1527)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, pv0.block_id, idxs=(1, 2))
+            h.wait_event(h.blocks)
+            deadline = time.time() + 5
+            while h.cs.rs.height != 2 and time.time() < deadline:
+                time.sleep(0.01)
+            before = h.cs.rs.last_commit.votes_bit_array.num_true()
+            assert before == 3  # ours + stubs 1,2
+            h.stub_vote(3, VOTE_TYPE_PRECOMMIT, 0, pv0.block_id, height=1)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                lc = h.cs.rs.last_commit
+                if lc is not None and lc.votes_bit_array.num_true() == 4:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError("late precommit never joined LastCommit")
+        finally:
+            h.stop()
+
+    def test_conflicting_prevotes_become_evidence(self):
+        """A stub equivocates (two prevotes, same round, different
+        blocks) → DuplicateVoteEvidence lands in the pool
+        (state.go:1476-1482)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_vote(1, VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            alt, alt_parts = h.make_alt_block(1, txs=(b"equivocate",))
+            h.stub_vote(
+                1, VOTE_TYPE_PREVOTE, 0,
+                BlockID(hash=alt.hash(), parts_header=alt_parts.header()),
+            )
+            deadline = time.time() + 8
+            while not h.cs.evpool.evidence and time.time() < deadline:
+                time.sleep(0.01)
+            assert h.cs.evpool.evidence, "no evidence created from equivocation"
+            ev = h.cs.evpool.evidence[0]
+            assert ev.vote_a.block_id != ev.vote_b.block_id
+        finally:
+            h.stop()
+
+    def test_skip_round_then_commit_in_later_round(self):
+        """Liveness across a skipped round: nothing commits in r0/r1;
+        the net commits in round 2."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            # r0: stubs prevote nil → nil polka → precommit nil everywhere
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, BlockID())
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+            # r1: same dance
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 1, BlockID())
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 1)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 1, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 2)
+            # r2: whoever proposes, let it through
+            pv2 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 2, timeout=15)
+            target = pv2.block_id
+            if not target.hash:
+                # we are not r2 proposer and saw nothing: give them a block
+                prop_addr = h.cs.rs.validators.get_proposer().address
+                idx = next(
+                    i for i in range(4)
+                    if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+                )
+                block, parts = h.make_alt_block(idx, txs=(b"r2",))
+                target = BlockID(hash=block.hash(), parts_header=parts.header())
+                h.stub_proposal(idx, 2, block, parts)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 2, target)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 2, target)
+            blk = h.wait_event(h.blocks, timeout=15)["block"]
+            assert blk.header.height == 1
+        finally:
+            h.stop()

@@ -54,6 +54,12 @@ class NetNode:
             db, self.conns.consensus, mempool=self.mempool, event_bus=self.bus
         )
         self.bstore = BlockStore(MemDB())
+        from tendermint_tpu.evidence import EvidencePool, EvidenceStore
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+
+        self.evpool = EvidencePool(EvidenceStore(MemDB()), self.state)
+        self.ev_reactor = EvidenceReactor(self.evpool)
+        block_exec.evidence_pool = self.evpool
         conf = cfg.test_config().consensus
         self.cs = ConsensusState(
             conf,
@@ -61,6 +67,7 @@ class NetNode:
             block_exec,
             self.bstore,
             mempool=self.mempool,
+            evpool=self.evpool,
             event_bus=self.bus,
             priv_validator=FilePV(key, None),
         )
@@ -78,7 +85,7 @@ class NetNode:
             listen_addr="",
             network=CHAIN_ID,
             version="dev",
-            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x40]),
+            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40]),
             moniker=f"node{idx}",
         )
         tr = MultiplexTransport(ni, nk)
@@ -88,6 +95,7 @@ class NetNode:
         self.switch.add_reactor("CONSENSUS", self.cons_reactor)
         self.switch.add_reactor("MEMPOOL", self.mp_reactor)
         self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
+        self.switch.add_reactor("EVIDENCE", self.ev_reactor)
 
     def start(self):
         self.switch.start()
@@ -178,6 +186,40 @@ class TestConsensusNet:
                 while b.bc_reactor.pool.is_running() and time.time() < deadline:
                     time.sleep(0.1)
                 assert not b.bc_reactor.pool.is_running()
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_late_joiner_catches_up_via_consensus_gossip(self):
+        """A non-validator joins LATE with fast-sync OFF: it can only
+        climb via consensus catch-up gossip — stored-commit precommits
+        drive it into the commit step, its CommitStepMessage advertises
+        the parts it needs (reactor.go:404-412), peers feed the parts,
+        repeat per height. This path deadlocks if CommitStep is never
+        broadcast (the round-1 fast-sync handoff stall)."""
+        vs, keys = random_validator_set(1, 10)
+        doc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            genesis_time=time.time_ns() - 10**9,
+            validators=[
+                GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators
+            ],
+        )
+        a = NetNode(0, doc, keys[0])
+        sub_a = a.bus.subscribe("ta", query_for_event(EVENT_NEW_BLOCK), 256)
+        a.start()
+        try:
+            assert len(collect_blocks(sub_a, 5, timeout=30.0)) >= 5
+            b = NetNode(1, doc, PrivKeyEd25519.generate(), fast_sync=False)
+            sub_b = b.bus.subscribe("tb", query_for_event(EVENT_NEW_BLOCK), 256)
+            b.start()
+            try:
+                b.switch.dial_peer(a.switch.transport.listen_addr)
+                blocks_b = collect_blocks(sub_b, 6, timeout=60.0)
+                assert len(blocks_b) >= 6, f"joiner saw only {len(blocks_b)} blocks"
+                for blk in blocks_b[:5]:
+                    assert a.bstore.load_block(blk.header.height).hash() == blk.hash()
             finally:
                 b.stop()
         finally:

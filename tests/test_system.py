@@ -56,20 +56,23 @@ def _init_home(home: str, chain_id: str) -> None:
 
 
 def _start_node(home: str, rpc_port: int, p2p_port: int,
-                extra_env=None, proxy_app: str = None):
+                extra_env=None, proxy_app: str = None,
+                extra_abci: str = ""):
     env = dict(ENV)
     if extra_env:
         env.update(extra_env)
     # log to a file, not a pipe: nobody drains a pipe during the long
     # waits below, and a full pipe buffer would block the node's logging
     log = open(os.path.join(home, "node.log"), "ab")
+    cmd = [sys.executable, "-m", "tendermint_tpu.cmd.main", "--home", home,
+           "node",
+           "--proxy_app", proxy_app or f"persistent_kvstore:{home}/app.db",
+           "--p2p.laddr", f"tcp://127.0.0.1:{p2p_port}",
+           "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}"]
+    if extra_abci:
+        cmd += ["--abci", extra_abci]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "tendermint_tpu.cmd.main", "--home", home,
-         "node",
-         "--proxy_app", proxy_app or f"persistent_kvstore:{home}/app.db",
-         "--p2p.laddr", f"tcp://127.0.0.1:{p2p_port}",
-         "--rpc.laddr", f"tcp://127.0.0.1:{rpc_port}"],
-        env=env, stdout=log, stderr=subprocess.STDOUT,
+        cmd, env=env, stdout=log, stderr=subprocess.STDOUT,
     )
     proc.log_path = os.path.join(home, "node.log")
     log.close()
