@@ -185,6 +185,27 @@ def tallied_power(lo, hi) -> int:
     return int(lo) + (int(hi) << 16)
 
 
+def warmup(buckets=(8, 16, 64), nb: int = 2, devices: int | None = None) -> None:
+    """Compile the hot bucket shapes ahead of time. First-use compile of
+    a bucket costs 20-40s on TPU (persistent cache makes later processes
+    cheap, but the FIRST node on a machine pays it) — a consensus node
+    must not discover that cost inside the live vote path, so node
+    startup calls this from a background thread. Vote sign-bytes pad to
+    2 SHA-512 blocks (nb=2); bucket sizes cover the adaptive batcher's
+    first escalation steps."""
+    import numpy as np
+
+    ndev = devices if devices is not None else len(jax.devices())
+    for b in buckets:
+        bpad = _bucket(b)
+        if ndev > 1:
+            bpad = max(bpad, ndev)
+            bpad = (bpad + ndev - 1) // ndev * ndev
+        rows = nb * 32 + 63
+        fn = _jitted_packed(nb, bpad, ndev)
+        fn(jnp.asarray(np.zeros((rows, bpad), dtype=np.int32)))
+
+
 class JAXBatchVerifier(BatchVerifier):
     """BatchVerifier backend running the vectorized TPU kernel."""
 

@@ -227,12 +227,21 @@ class Node:
             flush_throttle=config.p2p.flush_throttle_timeout,
         )
         self.transport = MultiplexTransport(node_info, node_key)
+        # peer trust scoring (p2p/trust.py; reference p2p/trust/store.go):
+        # persisted per-peer metrics the switch consults on admission and
+        # persistent-peer reconnects
+        from ..p2p.trust import TrustMetricStore
+
+        self.trust_store = TrustMetricStore(
+            db=db_provider("trust_history", backend, db_dir)
+        )
         self.sw = Switch(
             self.transport,
             mconfig=mconfig,
             max_inbound=config.p2p.max_num_inbound_peers,
             max_outbound=config.p2p.max_num_outbound_peers,
             metrics=self.metrics.p2p,
+            trust_store=self.trust_store,
         )
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
@@ -345,6 +354,7 @@ class Node:
         self.sw.stop()
         if self.addr_book is not None:
             self.addr_book.save()
+        self.trust_store.save()
         self.indexer_service.stop()
         self.event_bus.stop()
         self.mempool.close_wal()

@@ -5,12 +5,13 @@ request/addrs messages, ensurePeers routine, seed-mode crawling) and
 p2p/pex/addrbook.go (bucketed new/old address book with biased random
 selection and JSON persistence).
 
-The book keeps two tiers: "new" (heard about, never connected) and
-"old" (we connected at least once — markGood promotes). Unlike the
-reference's bitcoin-style hash buckets, this book is one flat map with
-a global "new"-tier cap and bad-address eviction — the semantics that
-matter here (bounded memory, evict stale failures first, old entries
-never clobbered by gossip) with none of the bucket bookkeeping.
+The book keeps two tiers of HASH BUCKETS like the reference
+(bitcoin-derived): 256 "new" buckets (heard about, never connected) and
+64 "old" buckets (connected at least once — markGood promotes). New
+placement is keyed by (book key, addr group, SOURCE group), so one
+gossiping source — one /16 — can only ever land its addresses in
+newBucketsPerGroup=32 of the 256 buckets and can never evict an old
+(vetted) entry: the poisoning bound of addrbook.go:754-791.
 """
 
 from __future__ import annotations
@@ -36,7 +37,6 @@ DEFAULT_ENSURE_PEERS_PERIOD = 30.0
 MIN_RECEIVE_REQUEST_INTERVAL = 60.0  # per-peer request rate limit
 MAX_MSG_COUNT_BY_PEER = 1000
 
-MAX_NEW_ADDRESSES = 4096  # "new"-tier cap (stands in for bucket math)
 MAX_GET_SELECTION = 250  # addrbook.go getSelection cap
 BIAS_TO_SELECT_NEW_PEERS = 30  # pex_reactor.go:289
 
@@ -56,10 +56,12 @@ class KnownAddress:
     id: str
     addr: str  # host:port
     src: str  # id of the peer that told us
+    src_addr: str = ""  # host:port of the teller (group placement key)
     attempts: int = 0
     last_attempt: float = 0.0
     last_success: float = 0.0
     bucket_type: str = "new"  # new | old
+    buckets: List[int] = field(default_factory=list)
 
     @property
     def net_addr(self) -> str:
@@ -74,17 +76,41 @@ class KnownAddress:
         return self.attempts >= 10 and (now - self.last_success) > 7 * 86400
 
 
+# bucket geometry (reference p2p/pex/params.go)
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_COUNT = 64
+NEW_BUCKET_SIZE = 64
+OLD_BUCKET_SIZE = 64
+NEW_BUCKETS_PER_GROUP = 32
+OLD_BUCKETS_PER_GROUP = 4
+MAX_NEW_BUCKETS_PER_ADDRESS = 4
+
+
+def _dsha(b: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
 class AddrBook:
-    """Two-tier address book (reference p2p/pex/addrbook.go:57-120)."""
+    """Bucketed two-tier address book (reference p2p/pex/addrbook.go).
+
+    `_addrs` is the unique-address lookup (addrLookup); each address
+    additionally lives in up to MAX_NEW_BUCKETS_PER_ADDRESS "new"
+    buckets or exactly one "old" bucket. Placement hashes include a
+    per-book random key so an attacker cannot precompute collisions."""
 
     def __init__(self, file_path: Optional[str] = None, strict: bool = True):
         self.file_path = file_path
         self.strict = strict
         self._lock = threading.RLock()
-        self._addrs: Dict[str, KnownAddress] = {}  # by node id
+        self._addrs: Dict[str, KnownAddress] = {}  # by node id (addrLookup)
+        self._new: List[Dict[str, KnownAddress]] = [dict() for _ in range(NEW_BUCKET_COUNT)]
+        self._old: List[Dict[str, KnownAddress]] = [dict() for _ in range(OLD_BUCKET_COUNT)]
         self._our_ids: Set[str] = set()
         self._our_addrs: Set[str] = set()
         self._rand = random.Random()
+        self._hash_key = os.urandom(24)
         if file_path and os.path.exists(file_path):
             self.load(file_path)
 
@@ -98,6 +124,34 @@ class AddrBook:
     def is_our_address(self, nid: str, addr: str) -> bool:
         return nid.lower() in self._our_ids or addr in self._our_addrs
 
+    # -- bucket math (addrbook.go:754-791) -----------------------------
+
+    @staticmethod
+    def _group(addr: str) -> bytes:
+        """Network group: /16 for IPv4, the host string otherwise
+        (addrbook.go groupKey; "local" for loopback)."""
+        host = addr.rsplit(":", 1)[0] if ":" in addr else addr
+        parts = host.split(".")
+        if len(parts) == 4 and all(p.isdigit() for p in parts):
+            if host.startswith("127.") or host == "0.0.0.0":
+                return b"local"
+            return f"{parts[0]}.{parts[1]}".encode()
+        return host.encode() or b"unroutable"
+
+    def _calc_new_bucket(self, addr: str, src_addr: str) -> int:
+        h1 = int.from_bytes(
+            _dsha(self._hash_key + self._group(addr) + self._group(src_addr))[:8],
+            "big") % NEW_BUCKETS_PER_GROUP
+        h2 = _dsha(self._hash_key + self._group(src_addr) + h1.to_bytes(8, "big"))
+        return int.from_bytes(h2[:8], "big") % NEW_BUCKET_COUNT
+
+    def _calc_old_bucket(self, net_addr: str) -> int:
+        h1 = int.from_bytes(
+            _dsha(self._hash_key + net_addr.encode())[:8],
+            "big") % OLD_BUCKETS_PER_GROUP
+        h2 = _dsha(self._hash_key + self._group(net_addr) + h1.to_bytes(8, "big"))
+        return int.from_bytes(h2[:8], "big") % OLD_BUCKET_COUNT
+
     # -- mutation ------------------------------------------------------
 
     @staticmethod
@@ -106,37 +160,90 @@ class AddrBook:
         non-strict book can hold many id-less addresses distinctly)."""
         return nid or addr
 
-    def add_address(self, addr_str: str, src_id: str = "") -> bool:
-        """addrbook.go AddAddress: record a heard-about address into a
-        'new' bucket. Returns False for self/invalid/duplicate-in-old."""
+    def add_address(self, addr_str: str, src_id: str = "",
+                    src_addr: str = "") -> bool:
+        """addrbook.go addAddress:641-695: record a heard-about address
+        into a 'new' bucket chosen by (addr group, SOURCE group). Returns
+        False for self/invalid/already-old. A repeatedly-heard address is
+        added to extra buckets only probabilistically, capped at
+        MAX_NEW_BUCKETS_PER_ADDRESS; old entries are never touched."""
         nid, addr = parse_net_address(addr_str)
         if (not nid or ":" not in addr) and self.strict:
             return False
         with self._lock:
             if self.is_our_address(nid, addr):
                 return False
-            ka = self._addrs.get(self._key(nid, addr))
+            key = self._key(nid, addr)
+            ka = self._addrs.get(key)
             if ka is not None:
                 if ka.bucket_type == "old":
-                    return False  # already vetted; keep old entry
+                    return False  # already vetted; gossip can't displace
+                if len(ka.buckets) >= MAX_NEW_BUCKETS_PER_ADDRESS:
+                    return False
+                # the more buckets it's in, the less likely to add more
+                if self._rand.randrange(2 * len(ka.buckets)) != 0:
+                    return False
                 ka.addr = addr  # refresh
-                return True
-            # evict a random bad address when the new tier is full
-            news = [a for a in self._addrs.values() if a.bucket_type == "new"]
-            if len(news) >= MAX_NEW_ADDRESSES:
-                now = time.time()
-                bad = [a for a in news if a.is_bad(now)] or news
-                victim = self._rand.choice(bad)
-                del self._addrs[self._key(victim.id, victim.addr)]
-            self._addrs[self._key(nid, addr)] = KnownAddress(
-                id=nid, addr=addr, src=src_id or nid or addr
-            )
+            else:
+                ka = KnownAddress(
+                    id=nid, addr=addr, src=src_id or nid or addr,
+                    src_addr=src_addr,
+                )
+            idx = self._calc_new_bucket(addr, src_addr or src_id or addr)
+            self._add_to_new_bucket(ka, idx)
             return True
+
+    def _add_to_new_bucket(self, ka: KnownAddress, idx: int) -> None:
+        """addrbook.go addToNewBucket:526-556."""
+        bucket = self._new[idx]
+        akey = self._key(ka.id, ka.addr)
+        if akey in bucket:
+            return
+        if len(bucket) >= NEW_BUCKET_SIZE:
+            self._expire_new(idx)
+        bucket[akey] = ka
+        if idx not in ka.buckets:
+            ka.buckets.append(idx)
+        self._addrs[akey] = ka
+
+    def _expire_new(self, idx: int) -> None:
+        """addrbook.go expireNew:697-710: drop a bad entry, else the
+        oldest-attempted one — from THIS bucket only."""
+        bucket = self._new[idx]
+        now = time.time()
+        victim = None
+        for ka in bucket.values():
+            if ka.is_bad(now):
+                victim = ka
+                break
+        if victim is None:
+            victim = min(bucket.values(), key=lambda a: a.last_attempt)
+        self._remove_from_bucket(victim, idx)
+
+    def _remove_from_bucket(self, ka: KnownAddress, idx: int) -> None:
+        akey = self._key(ka.id, ka.addr)
+        self._new[idx].pop(akey, None)
+        if idx in ka.buckets:
+            ka.buckets.remove(idx)
+        if not ka.buckets and ka.bucket_type == "new":
+            self._addrs.pop(akey, None)
+
+    def _remove_from_all_buckets(self, ka: KnownAddress) -> None:
+        akey = self._key(ka.id, ka.addr)
+        for idx in list(ka.buckets):
+            if ka.bucket_type == "new":
+                self._new[idx].pop(akey, None)
+            else:
+                self._old[idx].pop(akey, None)
+        ka.buckets = []
+        self._addrs.pop(akey, None)
 
     def remove_address(self, addr_str: str) -> None:
         nid, addr = parse_net_address(addr_str)
         with self._lock:
-            self._addrs.pop(self._key(nid, addr), None)
+            ka = self._addrs.get(self._key(nid, addr))
+            if ka is not None:
+                self._remove_from_all_buckets(ka)
 
     def mark_attempt(self, addr_str: str) -> None:
         nid, addr = parse_net_address(addr_str)
@@ -147,16 +254,46 @@ class AddrBook:
                 ka.last_attempt = time.time()
 
     def mark_good(self, addr_str: str) -> None:
-        """Promote new → old on successful connect (addrbook.go MarkGood)."""
+        """Promote new → old on successful connect (addrbook.go MarkGood
+        → moveToOld:715-752). If the old bucket is full, its
+        oldest-attempted entry is demoted back to a new bucket."""
         nid, addr = parse_net_address(addr_str)
         with self._lock:
-            ka = self._addrs.get(self._key(nid, addr))
+            key = self._key(nid, addr)
+            ka = self._addrs.get(key)
             if ka is None:
                 ka = KnownAddress(id=nid, addr=addr, src=nid or addr)
-                self._addrs[self._key(nid, addr)] = ka
+                self._addrs[key] = ka
             ka.attempts = 0
             ka.last_success = time.time()
-            ka.bucket_type = "old"
+            ka.last_attempt = time.time()
+            if ka.bucket_type == "old":
+                return
+            self._move_to_old(ka)
+
+    def _move_to_old(self, ka: KnownAddress) -> None:
+        akey = self._key(ka.id, ka.addr)
+        for idx in list(ka.buckets):
+            self._new[idx].pop(akey, None)
+        ka.buckets = []
+        ka.bucket_type = "old"
+        idx = self._calc_old_bucket(ka.net_addr)
+        bucket = self._old[idx]
+        if len(bucket) >= OLD_BUCKET_SIZE:
+            # demote the oldest old entry back to a new bucket
+            demoted = min(bucket.values(), key=lambda a: a.last_attempt)
+            dkey = self._key(demoted.id, demoted.addr)
+            bucket.pop(dkey, None)
+            demoted.buckets = []
+            demoted.bucket_type = "new"
+            self._add_to_new_bucket(
+                demoted,
+                self._calc_new_bucket(demoted.addr,
+                                      demoted.src_addr or demoted.src),
+            )
+        bucket[akey] = ka
+        ka.buckets = [idx]
+        self._addrs[akey] = ka
 
     def mark_bad(self, addr_str: str) -> None:
         self.remove_address(addr_str)
@@ -166,6 +303,14 @@ class AddrBook:
     def size(self) -> int:
         with self._lock:
             return len(self._addrs)
+
+    def n_new(self) -> int:
+        with self._lock:
+            return sum(1 for a in self._addrs.values() if a.bucket_type == "new")
+
+    def n_old(self) -> int:
+        with self._lock:
+            return sum(1 for a in self._addrs.values() if a.bucket_type == "old")
 
     def is_empty(self) -> bool:
         return self.size() == 0
@@ -179,16 +324,20 @@ class AddrBook:
             return self._key(nid, addr) in self._addrs
 
     def pick_address(self, bias_new_pct: int) -> Optional[str]:
-        """Biased random pick (addrbook.go PickAddress): bias% chance of
-        a 'new' address, else 'old' (falling back across tiers)."""
+        """Biased random pick (addrbook.go PickAddress:303-340): bias%
+        chance of the 'new' tier, then a random non-empty bucket of that
+        tier, then a random entry."""
         with self._lock:
             if not self._addrs:
                 return None
-            news = [a for a in self._addrs.values() if a.bucket_type == "new"]
-            olds = [a for a in self._addrs.values() if a.bucket_type == "old"]
-            pool = news if (self._rand.randint(0, 99) < bias_new_pct) else olds
-            pool = pool or news or olds
-            return self._rand.choice(pool).net_addr if pool else None
+            pick_new = self._rand.randint(0, 99) < bias_new_pct
+            tiers = [self._new, self._old] if pick_new else [self._old, self._new]
+            for tier in tiers:
+                nonempty = [b for b in tier if b]
+                if nonempty:
+                    bucket = self._rand.choice(nonempty)
+                    return self._rand.choice(list(bucket.values())).net_addr
+            return None
 
     def get_selection(self) -> List[str]:
         """Random subset for a PEX response (addrbook.go GetSelection:
@@ -214,18 +363,21 @@ class AddrBook:
             return
         with self._lock:
             out = {
+                "key": self._hash_key.hex(),
                 "addrs": [
                     {
                         "id": a.id,
                         "addr": a.addr,
                         "src": a.src,
+                        "src_addr": a.src_addr,
                         "attempts": a.attempts,
                         "last_attempt": a.last_attempt,
                         "last_success": a.last_success,
                         "bucket_type": a.bucket_type,
+                        "buckets": a.buckets,
                     }
                     for a in self._addrs.values()
-                ]
+                ],
             }
         tmp = path + ".tmp"
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -237,16 +389,33 @@ class AddrBook:
         with open(path) as f:
             data = json.load(f)
         with self._lock:
+            if data.get("key"):
+                self._hash_key = bytes.fromhex(data["key"])
             for o in data.get("addrs", []):
-                self._addrs[self._key(o["id"], o["addr"])] = KnownAddress(
+                ka = KnownAddress(
                     id=o["id"],
                     addr=o["addr"],
                     src=o.get("src", o["id"]),
+                    src_addr=o.get("src_addr", ""),
                     attempts=o.get("attempts", 0),
                     last_attempt=o.get("last_attempt", 0.0),
                     last_success=o.get("last_success", 0.0),
                     bucket_type=o.get("bucket_type", "new"),
                 )
+                akey = self._key(ka.id, ka.addr)
+                self._addrs[akey] = ka
+                idxs = o.get("buckets") or []
+                if ka.bucket_type == "old":
+                    for idx in idxs[:1] or [self._calc_old_bucket(ka.net_addr)]:
+                        self._old[idx % OLD_BUCKET_COUNT][akey] = ka
+                        ka.buckets = [idx % OLD_BUCKET_COUNT]
+                else:
+                    if not idxs:
+                        idxs = [self._calc_new_bucket(ka.addr, ka.src_addr or ka.src)]
+                    for idx in idxs:
+                        self._new[idx % NEW_BUCKET_COUNT][akey] = ka
+                        if idx % NEW_BUCKET_COUNT not in ka.buckets:
+                            ka.buckets.append(idx % NEW_BUCKET_COUNT)
 
 
 class PEXReactor(Reactor):
@@ -302,7 +471,8 @@ class PEXReactor(Reactor):
             # record the inbound peer's self-reported listen addr
             la = peer.node_info.listen_addr
             if la:
-                self.book.add_address(f"{peer.id}@{la}", src_id=peer.id)
+                self.book.add_address(f"{peer.id}@{la}", src_id=peer.id,
+                                      src_addr=peer.socket_addr or "")
 
     def remove_peer(self, peer, reason) -> None:
         self._requested.discard(peer.id)
@@ -336,7 +506,8 @@ class PEXReactor(Reactor):
                 )
             self._requested.discard(peer.id)
             for a in obj[1]:
-                self.book.add_address(str(a), src_id=peer.id)
+                self.book.add_address(str(a), src_id=peer.id,
+                                      src_addr=peer.socket_addr or "")
         else:
             raise ValueError(f"unknown pex message {kind!r}")
 

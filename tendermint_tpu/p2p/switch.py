@@ -29,6 +29,10 @@ RECONNECT_BACK_OFF_ATTEMPTS = 10  # switch.go:26
 RECONNECT_BACK_OFF_BASE = 3.0  # switch.go:27
 DIAL_RANDOMIZER_INTERVAL = 3.0  # switch.go:17 randomization of dial start
 
+# minimum trust score (0-100, trust/metric.go TrustValue x100) a peer
+# needs to be admitted or reconnected when a TrustMetricStore is wired
+TRUST_BAN_SCORE = 30
+
 
 class Switch:
     def __init__(
@@ -38,10 +42,15 @@ class Switch:
         max_inbound: int = 40,
         max_outbound: int = 10,
         metrics=None,
+        trust_store=None,
     ):
         from ..metrics import P2PMetrics
 
         self.metrics = metrics if metrics is not None else P2PMetrics()
+        # optional TrustMetricStore (p2p/trust.py; reference
+        # p2p/trust/metric.go): errors decay a peer's score, a
+        # low-scoring peer is refused admission and not reconnected
+        self.trust = trust_store
         self.transport = transport
         self.mconfig = mconfig
         self.reactors: Dict[str, Reactor] = {}
@@ -185,6 +194,10 @@ class Switch:
         )
         for reactor in self.reactors.values():
             reactor.init_peer(peer)
+        if not self._trust_ok(their_info.id):
+            LOG.info("refusing low-trust peer %s", their_info.id[:8])
+            sc.close()
+            return None
         # atomically check limits + dedupe + insert (concurrent upgrade
         # threads must not overshoot max_inbound or double-add an ID)
         with self._lock:
@@ -203,6 +216,8 @@ class Switch:
                 return None
         peer.start()
         self.metrics.peers.set(self.peers.size())
+        if self.trust is not None:
+            self.trust.get_metric(peer.id).good_events(1)
         for reactor in self.reactors.values():
             try:
                 reactor.add_peer(peer)
@@ -210,6 +225,13 @@ class Switch:
                 LOG.exception("reactor %s add_peer failed", reactor.name)
         LOG.info("added peer %s", peer)
         return peer
+
+    def _trust_ok(self, peer_id: str) -> bool:
+        """trust/metric.go TrustValue gate: refuse peers whose history
+        of errors has decayed their score below the ban line."""
+        if self.trust is None or not peer_id:
+            return True
+        return self.trust.get_metric(peer_id).trust_score() >= TRUST_BAN_SCORE
 
     # -- routing -------------------------------------------------------
 
@@ -244,18 +266,25 @@ class Switch:
         self.stop_peer_for_error(peer, err)
 
     def stop_peer_for_error(self, peer: Peer, reason: Exception) -> None:
-        """switch.go:281-299; persistent peers get reconnected."""
+        """switch.go:281-299; persistent peers get reconnected unless
+        their trust score has dropped below the ban line."""
         if not self.peers.remove(peer):
             return
         self.metrics.peers.set(self.peers.size())
         LOG.info("stopping peer %s: %s", peer, reason)
         peer.stop()
+        if self.trust is not None:
+            self.trust.get_metric(peer.id).bad_events(1)
+            self.trust.peer_disconnected(peer.id)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)
             except Exception:
                 LOG.exception("reactor %s remove_peer failed", reactor.name)
         if peer.persistent and self._running.is_set():
+            if not self._trust_ok(peer.id):
+                LOG.info("not reconnecting low-trust peer %s", peer.id[:8])
+                return
             addr = self.persistent_addrs.get(peer.id, peer.socket_addr)
             self._schedule_reconnect(addr, peer.id)
 
@@ -264,6 +293,8 @@ class Switch:
             return
         self.metrics.peers.set(self.peers.size())
         peer.stop()
+        if self.trust is not None:
+            self.trust.peer_disconnected(peer.id)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, None)

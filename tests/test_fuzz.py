@@ -1,0 +1,175 @@
+"""Fuzz/property tests: WAL decoding and the query language
+(reference consensus/wal_fuzz.go and libs/pubsub/query/fuzz_test/main.go).
+
+Invariant under arbitrary corruption: the WAL reader stops iteration —
+it NEVER raises out of iter_messages / search_for_end_height, because a
+crashed node must always be able to replay whatever prefix survived.
+The query parser either returns a Query or raises QueryError — no other
+exception type may escape.
+"""
+
+import os
+import random
+import string
+import struct
+import tempfile
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.consensus import TimeoutInfo
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.libs.events import Query, QueryError
+from tendermint_tpu.types import VOTE_TYPE_PREVOTE, BlockID, Vote
+
+SEED = int(os.environ.get("TM_TPU_FUZZ_SEED", "1337"))
+ROUNDS = int(os.environ.get("TM_TPU_FUZZ_ROUNDS", "200"))
+
+
+def _vote(h):
+    return Vote(
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+        height=h,
+        round=0,
+        timestamp=1_700_000_000_000_000_000,
+        type=VOTE_TYPE_PREVOTE,
+        block_id=BlockID(hash=b"\xab" * 20),
+    )
+
+
+def _write_wal(dirname) -> str:
+    path = os.path.join(dirname, "wal", "wal")
+    w = WAL(path)
+    w.start()
+    for h in range(1, 6):
+        w.write(("peerx", VoteMessage(_vote(h))))
+        w.write(("", VoteMessage(_vote(h))))
+        w.write_sync(TimeoutInfo(0.5, h, 0, 3))
+        w.write_end_height(h)
+    w.stop()
+    return path
+
+
+def _wal_files(path):
+    d = os.path.dirname(path)
+    return sorted(
+        os.path.join(d, f) for f in os.listdir(d) if os.path.isfile(os.path.join(d, f))
+    )
+
+
+class TestWALFuzz:
+    def test_truncation_never_raises(self, tmp_path):
+        rng = random.Random(SEED)
+        for _ in range(ROUNDS // 4):
+            with tempfile.TemporaryDirectory(dir=tmp_path) as d:
+                path = _write_wal(d)
+                f = rng.choice(_wal_files(path))
+                size = os.path.getsize(f)
+                cut = rng.randrange(size + 1)
+                with open(f, "rb+") as fh:
+                    fh.truncate(cut)
+                w = WAL(path)
+                msgs = list(w.iter_messages())  # must not raise
+                assert isinstance(msgs, list)
+                w.search_for_end_height(3)  # must not raise either
+
+    def test_bit_flips_never_raise(self, tmp_path):
+        rng = random.Random(SEED + 1)
+        for _ in range(ROUNDS // 4):
+            with tempfile.TemporaryDirectory(dir=tmp_path) as d:
+                path = _write_wal(d)
+                f = rng.choice(_wal_files(path))
+                data = bytearray(open(f, "rb").read())
+                if not data:
+                    continue
+                for _ in range(rng.randrange(1, 8)):
+                    i = rng.randrange(len(data))
+                    data[i] ^= 1 << rng.randrange(8)
+                open(f, "wb").write(bytes(data))
+                w = WAL(path)
+                list(w.iter_messages())
+                w.search_for_end_height(2)
+
+    def test_garbage_and_hostile_lengths_never_raise(self, tmp_path):
+        """Records claiming absurd lengths (resource-exhaustion shape)
+        and pure garbage must stop iteration, not raise or allocate."""
+        rng = random.Random(SEED + 2)
+        for i in range(ROUNDS // 4):
+            with tempfile.TemporaryDirectory(dir=tmp_path) as d:
+                path = _write_wal(d)
+                f = _wal_files(path)[-1]
+                with open(f, "ab") as fh:
+                    if i % 3 == 0:
+                        # valid-crc header with a huge claimed length
+                        fh.write(struct.pack(">II", 0, 0x7FFFFFFF))
+                    elif i % 3 == 1:
+                        fh.write(os.urandom(rng.randrange(1, 64)))
+                    else:
+                        # truncated header
+                        fh.write(b"\x00\x01")
+                w = WAL(path)
+                msgs = list(w.iter_messages())
+                # the intact prefix must still decode (20 records + opening
+                # ENDHEIGHT marker)
+                assert len(msgs) >= 21
+
+    def test_corrupt_tail_preserves_prefix(self, tmp_path):
+        """Bit-flip ONLY the tail: every record before the flip must
+        still be returned — replay depends on the surviving prefix."""
+        with tempfile.TemporaryDirectory(dir=tmp_path) as d:
+            path = _write_wal(d)
+            w = WAL(path)
+            intact = list(w.iter_messages())
+            f = _wal_files(path)[-1]
+            data = bytearray(open(f, "rb").read())
+            data[-3] ^= 0xFF
+            open(f, "wb").write(bytes(data))
+            w2 = WAL(path)
+            after = list(w2.iter_messages())
+            assert len(after) >= len(intact) - 2
+
+
+class TestQueryFuzz:
+    def test_random_strings_raise_only_query_error(self):
+        rng = random.Random(SEED + 3)
+        alphabet = string.printable
+        for _ in range(ROUNDS * 5):
+            s = "".join(rng.choice(alphabet) for _ in range(rng.randrange(0, 60)))
+            try:
+                q = Query(s)
+            except QueryError:
+                continue
+            # parsed queries must evaluate any tag set without crashing
+            q.matches({"tm.event": "Tx", "tx.height": "5"})
+
+    def test_mutated_valid_queries(self):
+        rng = random.Random(SEED + 4)
+        base = "tm.event = 'Tx' AND tx.height > 5 AND app.key CONTAINS 'x'"
+        for _ in range(ROUNDS * 5):
+            s = list(base)
+            for _ in range(rng.randrange(1, 6)):
+                i = rng.randrange(len(s))
+                op = rng.random()
+                if op < 0.4:
+                    s[i] = rng.choice(string.printable)
+                elif op < 0.7:
+                    del s[i]
+                else:
+                    s.insert(i, rng.choice(string.printable))
+            try:
+                q = Query("".join(s))
+            except QueryError:
+                continue
+            q.matches({"tm.event": "Tx", "tx.height": "nope"})
+
+    def test_valid_queries_still_parse(self):
+        for s in (
+            "tm.event = 'NewBlock'",
+            "tx.height <= 100 AND tx.height >= 1",
+            "app.creator EXISTS",
+            "account.name CONTAINS 'igor'",
+        ):
+            assert Query(s) is not None

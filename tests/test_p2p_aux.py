@@ -177,3 +177,131 @@ def test_upnp_no_gateway_times_out():
             upnp.discover(timeout=0.5, ssdp_addr=("127.0.0.1", silent[1]))
     finally:
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# Bucketed address book (reference p2p/pex/addrbook.go)
+# ---------------------------------------------------------------------------
+
+from tendermint_tpu.p2p.pex import (
+    MAX_NEW_BUCKETS_PER_ADDRESS,
+    NEW_BUCKETS_PER_GROUP,
+    NEW_BUCKET_COUNT,
+    NEW_BUCKET_SIZE,
+    OLD_BUCKET_COUNT,
+    AddrBook,
+)
+
+
+def _nid(i):
+    return f"{i:040x}"
+
+
+def test_addrbook_poisoning_one_source_is_bucket_bounded(tmp_path):
+    """One gossiping source floods thousands of addresses: its influence
+    is capped at newBucketsPerGroup(32) x bucketSize(64) slots of the 256
+    available buckets — the addrbook.go:754-771 placement bound."""
+    book = AddrBook()
+    attacker_src = "6.6.0.1:26656"
+    for i in range(10_000):
+        # spread across many /16s so the addr-group half varies
+        addr = f"{_nid(i)}@{10 + i % 200}.{i % 250}.0.1:26656"
+        book.add_address(addr, src_id="attacker", src_addr=attacker_src)
+    # bound: the attacker's one source group reaches at most 32 buckets
+    touched = [i for i, b in enumerate(book._new) if b]
+    assert len(touched) <= NEW_BUCKETS_PER_GROUP
+    assert book.size() <= NEW_BUCKETS_PER_GROUP * NEW_BUCKET_SIZE
+    # an honest source from a different group still gets its entry in
+    assert book.add_address(f"{_nid(77777)}@99.99.0.1:26656",
+                            src_id="honest", src_addr="8.8.0.1:26656")
+    assert book.has_address(f"{_nid(77777)}@99.99.0.1:26656")
+
+
+def test_addrbook_old_entries_survive_gossip_flood(tmp_path):
+    """Vetted (old) entries are never evicted by new-address gossip."""
+    book = AddrBook()
+    vetted = f"{_nid(1)}@50.60.0.1:26656"
+    book.add_address(vetted, src_id="boot", src_addr="50.60.0.1:26656")
+    book.mark_good(vetted)
+    assert book.n_old() == 1
+    for i in range(5000):
+        book.add_address(f"{_nid(100 + i)}@{20 + i % 100}.{i % 200}.0.1:26656",
+                         src_id="attacker", src_addr="6.6.0.1:26656")
+    assert book.has_address(vetted)
+    ka = book._addrs[_nid(1)]
+    assert ka.bucket_type == "old"
+    # gossiping the same vetted address cannot demote or displace it
+    assert not book.add_address(vetted, src_id="attacker",
+                                src_addr="6.6.0.1:26656")
+    assert book._addrs[_nid(1)].bucket_type == "old"
+
+
+def test_addrbook_new_bucket_eviction_prefers_bad(tmp_path):
+    book = AddrBook()
+    src = "7.7.0.1:26656"
+    # fill one bucket by flooding one (addr-group, src-group) pair
+    added = []
+    for i in range(4000):
+        a = f"{_nid(i)}@33.44.{i // 250}.{i % 250}:26656"
+        if book.add_address(a, src_id="s", src_addr=src):
+            added.append(a)
+    # mark one entry bad: 3 failed attempts, no success
+    bad = added[0]
+    for _ in range(3):
+        book.mark_attempt(bad)
+    before = book.size()
+    # keep flooding until an eviction happens; the bad entry must go first
+    i = 4000
+    while book.has_address(bad) and i < 9000:
+        book.add_address(f"{_nid(i)}@33.44.{i // 250}.{i % 250}:26656",
+                         src_id="s", src_addr=src)
+        i += 1
+    assert not book.has_address(bad), "bad entry should be evicted first"
+
+
+def test_addrbook_max_new_buckets_per_address(tmp_path):
+    book = AddrBook()
+    addr = f"{_nid(5)}@44.55.0.1:26656"
+    # hearing the same address from MANY source groups: bucket refs are
+    # capped (probabilistic add, hard cap MAX_NEW_BUCKETS_PER_ADDRESS)
+    for i in range(500):
+        book.add_address(addr, src_id=f"src{i}",
+                         src_addr=f"{i % 250}.{i // 250}.0.1:26656")
+    ka = book._addrs[_nid(5)]
+    assert 1 <= len(ka.buckets) <= MAX_NEW_BUCKETS_PER_ADDRESS
+
+
+def test_addrbook_promote_demote_and_persistence(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(file_path=path)
+    a1 = f"{_nid(1)}@11.22.0.1:26656"
+    a2 = f"{_nid(2)}@11.23.0.1:26656"
+    book.add_address(a1, src_id="x", src_addr="9.9.0.1:26656")
+    book.add_address(a2, src_id="x", src_addr="9.9.0.1:26656")
+    book.mark_good(a1)
+    assert book.n_old() == 1 and book.n_new() == 1
+    book.save()
+
+    book2 = AddrBook(file_path=path)
+    assert book2.size() == 2
+    assert book2._addrs[_nid(1)].bucket_type == "old"
+    assert book2._addrs[_nid(2)].bucket_type == "new"
+    # old entries live in old buckets after reload
+    assert any(_nid(1) in b for b in book2._old)
+    assert any(_nid(2) in b for b in book2._new)
+    # picks work on both tiers
+    assert book2.pick_address(0) is not None
+    assert book2.pick_address(100) is not None
+
+
+def test_addrbook_pick_bias(tmp_path):
+    book = AddrBook()
+    newa = f"{_nid(1)}@21.21.0.1:26656"
+    olda = f"{_nid(2)}@22.22.0.1:26656"
+    book.add_address(newa, src_id="x", src_addr="9.9.0.1:26656")
+    book.add_address(olda, src_id="x", src_addr="9.9.0.1:26656")
+    book.mark_good(olda)
+    got_new = sum(1 for _ in range(200) if book.pick_address(100) == newa)
+    got_old = sum(1 for _ in range(200) if book.pick_address(0) == olda)
+    assert got_new == 200  # bias 100 -> always the new tier
+    assert got_old == 200  # bias 0 -> always the old tier

@@ -150,3 +150,87 @@ class TestSwitch:
             sw1.stop()
             sw2.stop()
             sw3.stop()
+
+
+def _sink(m):
+    """Drive a metric's score to ~0 with ten all-bad intervals. The
+    timestamps run forward from now so a later real-time trust_score()
+    lands inside the final (all-bad) interval instead of rolling fresh
+    empty intervals into the history."""
+    base = time.time()
+    for k in range(10):
+        m.bad_events(10, now=base + k * m.interval)
+
+
+class TestTrustWiring:
+    """The switch consults the TrustMetricStore (p2p/trust.py) on peer
+    admission and reconnect (reference p2p/trust/metric.go usage)."""
+
+    def test_low_trust_peer_refused(self):
+        from tendermint_tpu.p2p.switch import TRUST_BAN_SCORE
+        from tendermint_tpu.p2p.trust import TrustMetricStore
+
+        a = make_switch("a")
+        b = make_switch("b")
+        store = TrustMetricStore()
+        a.trust = store
+        a.start()
+        b.start()
+        try:
+            # sink b's trust on a's side before any connection: build
+            # several all-bad intervals (simulated timestamps) so the
+            # integral history component collapses too
+            m = store.get_metric(b.transport.node_info.id)
+            _sink(m)
+            assert m.trust_score() < TRUST_BAN_SCORE
+            peer = a.dial_peer(b.transport.listen_addr)
+            assert peer is None, "low-trust peer must be refused"
+            assert a.peers.size() == 0
+            # and the inbound direction: b dials a, a refuses
+            b.dial_peer(a.transport.listen_addr)
+            time.sleep(0.5)
+            assert a.peers.size() == 0
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_good_connection_earns_trust_and_errors_decay_it(self):
+        from tendermint_tpu.p2p.trust import TrustMetricStore
+
+        a = make_switch("a")
+        b = make_switch("b")
+        store = TrustMetricStore()
+        a.trust = store
+        a.start()
+        b.start()
+        try:
+            peer = a.dial_peer(b.transport.listen_addr)
+            assert peer is not None
+            score_after_connect = store.get_metric(peer.id).trust_score()
+            a.stop_peer_for_error(peer, RuntimeError("bad frame"))
+            assert store.get_metric(peer.id).trust_score() <= score_after_connect
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_low_trust_persistent_peer_not_reconnected(self):
+        from tendermint_tpu.p2p.switch import TRUST_BAN_SCORE
+        from tendermint_tpu.p2p.trust import TrustMetricStore
+
+        a = make_switch("a")
+        b = make_switch("b")
+        store = TrustMetricStore()
+        a.trust = store
+        a.start()
+        b.start()
+        try:
+            peer = a.dial_peer(b.transport.listen_addr, persistent=True)
+            assert peer is not None
+            _sink(store.get_metric(peer.id))
+            assert store.get_metric(peer.id).trust_score() < TRUST_BAN_SCORE
+            a.stop_peer_for_error(peer, RuntimeError("bad"))
+            # no reconnect thread must be scheduled for the banned peer
+            assert not a.reconnecting, a.reconnecting
+        finally:
+            a.stop()
+            b.stop()
