@@ -1,0 +1,54 @@
+"""Separate per-call dispatch overhead from device compute on axon.
+
+Times k back-to-back dispatches of the same jitted fn (sync once at the
+end): slope over k = true per-execution cost; intercept = one-time
+dispatch/sync overhead.
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.crypto.jaxed25519 import curve, field
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+
+rng = np.random.default_rng(0)
+a = jnp.asarray(rng.integers(0, 8191, size=(20, B), dtype=np.int32))
+b = jnp.asarray(rng.integers(0, 8191, size=(20, B), dtype=np.int32))
+
+
+@partial(jax.jit, static_argnums=2)
+def mul_chain(a, b, n):
+    def body(i, v):
+        return field.mul(v, b)
+    return jax.lax.fori_loop(0, n, body, a)
+
+
+def run_k(fn, k, *args):
+    out = None
+    for _ in range(k):
+        out = fn(*args)
+    return np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[0]
+
+
+def meas(name, fn, *args, ks=(1, 2, 4, 8)):
+    run_k(fn, 1, *args)  # compile
+    for k in ks:
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run_k(fn, k, *args)
+            ts.append(time.perf_counter() - t0)
+        print(f"{name} k={k}: {min(ts)*1000:9.3f} ms")
+
+
+meas("mul_chain(100)", mul_chain, a, b, 100)
+meas("mul_chain(1000)", mul_chain, a, b, 1000)

@@ -1,0 +1,43 @@
+"""Measure h2d / d2h bandwidth and dispatch latency through the axon tunnel."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+f = jax.jit(lambda x: x * 2 + 1)
+g_scalar = jax.jit(lambda x: (x * 2 + 1).sum())
+
+for size in (1 << 10, 1 << 17, 1 << 20, 1 << 23):
+    host = np.ones(size // 4, dtype=np.int32)
+    # h2d
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        d = jnp.asarray(host)
+        d.block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    h2d = min(ts)
+    # d2h of a FRESH computation result (no host cache)
+    ts = []
+    for _ in range(3):
+        out = f(d)
+        t0 = time.perf_counter()
+        np.asarray(out)
+        ts.append(time.perf_counter() - t0)
+    d2h = min(ts)
+    # dispatch+sync with scalar output only
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(g_scalar(d))
+        ts.append(time.perf_counter() - t0)
+    disp = min(ts)
+    mb = size / 1e6
+    print(f"{mb:8.3f} MB  h2d {h2d*1000:8.2f} ms ({mb/h2d:7.1f} MB/s)   "
+          f"d2h {d2h*1000:8.2f} ms ({mb/d2h:7.1f} MB/s)   scalar-rt {disp*1000:7.2f} ms")
