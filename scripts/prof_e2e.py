@@ -1,0 +1,107 @@
+"""End-to-end verify_batch timing on TPU + transfer variant experiments."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
+
+import secrets
+
+sks = [keys.PrivKeyEd25519.generate() for _ in range(200)]
+msgs, sigs, pks, want = [], [], [], []
+for i in range(N):
+    sk = sks[i % len(sks)]
+    msg = secrets.token_bytes(110)
+    sig = sk.sign(msg)
+    if i % 100 == 37:
+        sig = bytes([sig[0] ^ 1]) + sig[1:]
+        want.append(False)
+    else:
+        want.append(True)
+    msgs.append(msg)
+    sigs.append(sig)
+    pks.append(sk.pub_key().bytes())
+
+t0 = time.perf_counter()
+got = verify_batch(msgs, sigs, pks)
+print(f"first call (compile): {time.perf_counter()-t0:.1f}s")
+assert got == want, "mask mismatch"
+
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    verify_batch(msgs, sigs, pks)
+    ts.append((time.perf_counter() - t0) * 1000)
+print(f"verify_batch e2e: min {min(ts):.1f} ms  all {[round(t) for t in ts]}")
+
+# breakdown: host packing time
+import jax
+import jax.numpy as jnp
+from tendermint_tpu.crypto.jaxed25519 import pack, verify as V
+
+sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(N, 64)
+pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(N, 32)
+
+t0 = time.perf_counter()
+s_ok = pack.lt_const_le_batch(sig_arr[:, 32:], V._ref_L())
+prefixes = np.concatenate([sig_arr[:, :32], pk_arr], axis=1)
+words, nblocks = pack.sha512_pad_batch(prefixes, msgs)
+nb = words.shape[0]
+bpad = V._bucket(N)
+rows = nb * 32 + V.ROWS_AUX
+buf = np.zeros((rows, bpad), dtype=np.int32)
+w = nb * 32
+buf[:w, :N] = words.astype(np.int32).reshape(w, N)
+buf[w, :N] = nblocks
+buf[w + 1 : w + 17, :N] = V._pack_le_rows(sig_arr)
+buf[w + 17 : w + 25, :N] = V._pack_le_rows(pk_arr)
+host_ms = (time.perf_counter() - t0) * 1000
+print(f"host packing: {host_ms:.1f} ms; buf {buf.nbytes/1e6:.2f} MB")
+
+fn = V._jitted_packed(nb, bpad, 1)
+
+# h2d only
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    d = jnp.asarray(buf)
+    d.block_until_ready()
+    ts.append((time.perf_counter() - t0) * 1000)
+print(f"h2d jnp.asarray: {min(ts):.1f} ms")
+
+# device_put async?
+t0 = time.perf_counter()
+d2 = jax.device_put(buf)
+t_submit = (time.perf_counter() - t0) * 1000
+d2.block_until_ready()
+t_total = (time.perf_counter() - t0) * 1000
+print(f"device_put: submit {t_submit:.1f} ms, ready {t_total:.1f} ms")
+
+# dispatch on resident data + fetch mask
+ts = []
+for _ in range(3):
+    t0 = time.perf_counter()
+    mask = fn(d)
+    np.asarray(mask)
+    ts.append((time.perf_counter() - t0) * 1000)
+print(f"dispatch+compute+fetch (data resident): {min(ts):.1f} ms")
+
+# slope device time of the verify kernel itself
+def run_k(k):
+    out = None
+    for _ in range(k):
+        out = fn(d)
+    np.asarray(out)
+
+run_k(1)
+t0 = time.perf_counter(); run_k(1); t1 = time.perf_counter() - t0
+t0 = time.perf_counter(); run_k(8); t8 = time.perf_counter() - t0
+print(f"verify kernel device time (slope): {(t8-t1)/7*1000:.1f} ms")

@@ -1,0 +1,54 @@
+"""Smoke test: does pallas/Mosaic lower and run through axon with the op
+mix the straus kernel needs (concat, roll, int32 mul, fori_loop, dynamic
+row read)?"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel(wsel_ref, a_ref, b_ref, out_ref):
+    a = a_ref[:]
+    b = b_ref[:]
+    blk = a.shape[1]
+
+    def conv_row(i):
+        prod = a[i : i + 1] * b  # (20, blk)
+        padded = jnp.concatenate([prod, jnp.zeros((19, blk), jnp.int32)], axis=0)
+        return pltpu.roll(padded, i, 0)
+
+    def body(w, acc):
+        row = wsel_ref[pl.ds(w, 1), :]  # dynamic row read (1, blk)
+        c = conv_row(0)
+        for i in range(1, 20):
+            c = c + conv_row(i)
+        # fold 39 -> 20 like _reduce_conv
+        r = c >> 13
+        m = c & 8191
+        full = jnp.concatenate([m, jnp.zeros((1, blk), jnp.int32)], axis=0) + \
+               jnp.concatenate([jnp.zeros((1, blk), jnp.int32), r], axis=0)
+        v = full[:20] + 608 * full[20:]
+        return acc + v * row
+
+    out_ref[:] = jax.lax.fori_loop(0, 4, body, jnp.zeros_like(a))
+
+
+B = 512
+rng = np.random.default_rng(0)
+a = jnp.asarray(rng.integers(0, 8191, (20, B), np.int32))
+b = jnp.asarray(rng.integers(0, 8191, (20, B), np.int32))
+wsel = jnp.asarray(rng.integers(0, 3, (8, B), np.int32))
+
+fn = pl.pallas_call(
+    kernel,
+    out_shape=jax.ShapeDtypeStruct((20, B), jnp.int32),
+)
+out = fn(wsel, a, b)
+print("pallas OK:", np.asarray(out).sum() % 100000)

@@ -1,0 +1,82 @@
+"""Correctness + perf check: pallas straus vs XLA curve.straus_mul_sub."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.crypto.jaxed25519 import curve, field, pack, pallas_kernels, ref
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+
+rng = np.random.default_rng(42)
+s_ints = [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % ref.L for _ in range(B)]
+k_ints = [int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % ref.L for _ in range(B)]
+a_ints = [int(rng.integers(0, 2**63)) % ref.L for _ in range(B)]
+
+s_limbs = jnp.asarray(
+    np.stack([pack.int_to_limbs(v) for v in s_ints], axis=1).astype(np.int32))
+k_limbs = jnp.asarray(
+    np.stack([pack.int_to_limbs(v) for v in k_ints], axis=1).astype(np.int32))
+a_limbs = jnp.asarray(
+    np.stack([pack.int_to_limbs(v) for v in a_ints], axis=1).astype(np.int32))
+
+# arbitrary valid curve points: [a]B, negated
+pts = jax.jit(curve.fixed_base_mul)(a_limbs)
+neg_a = jax.jit(curve.negate)(pts)
+
+xla_fn = jax.jit(curve.straus_mul_sub)
+pal_fn = jax.jit(lambda s, k, na: pallas_kernels.straus_mul_sub(s, k, na))
+
+t0 = time.perf_counter()
+ref_out = xla_fn(s_limbs, k_limbs, neg_a)
+ref_np = [np.asarray(c) for c in ref_out]
+print(f"xla compile+run: {time.perf_counter()-t0:.1f}s")
+
+t0 = time.perf_counter()
+pal_out = pal_fn(s_limbs, k_limbs, neg_a)
+pal_np = [np.asarray(c) for c in pal_out]
+print(f"pallas compile+run: {time.perf_counter()-t0:.1f}s")
+
+for name, r, p in zip("XYZT", ref_np, pal_np):
+    if not np.array_equal(r, p):
+        bad = np.argwhere(r != p)
+        print(f"MISMATCH {name}: {bad.shape[0]} cells, first {bad[:5]}")
+        sys.exit(1)
+print("EXACT MATCH")
+
+
+def timeit(name, fn, *args, n=5):
+    np.asarray(fn(*args)[0]).ravel()[0]
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args)[0]).ravel()[0]
+        ts.append(time.perf_counter() - t0)
+    print(f"{name:28s} {min(ts)*1000:9.2f} ms (wall incl. sync)")
+
+
+def device_ms(name, fn, *args, k=8):
+    def run(k):
+        out = None
+        for _ in range(k):
+            out = fn(*args)
+        np.asarray(out[0]).ravel()[0]
+    run(1)
+    ts1, tsk = [], []
+    for _ in range(3):
+        t0 = time.perf_counter(); run(1); ts1.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); run(k); tsk.append(time.perf_counter() - t0)
+    dev = (min(tsk) - min(ts1)) / (k - 1) * 1000
+    print(f"{name:28s} {dev:9.2f} ms (device, slope)")
+
+
+timeit("xla straus", xla_fn, s_limbs, k_limbs, neg_a)
+timeit("pallas straus", pal_fn, s_limbs, k_limbs, neg_a)
+device_ms("xla straus", xla_fn, s_limbs, k_limbs, neg_a)
+device_ms("pallas straus", pal_fn, s_limbs, k_limbs, neg_a)

@@ -83,6 +83,54 @@ def split_pubkeys(pks: np.ndarray):
     return bytes_to_limbs_batch(masked), sign
 
 
+def sha512_pad_rows(prefixes: np.ndarray, msgs: list[bytes]):
+    """Like sha512_pad_batch but returns (rows (B, NB*32) int32, nblocks):
+    each row strip is the big-endian uint32 (hi, lo) word stream in the
+    exact row order the packed verify buffer wants — callers transpose
+    straight into it with no intermediate (NB, 16, 2, B) tensor. A
+    uniform-length fast path skips the ragged scatter (commit vote
+    sign-bytes are near-uniform), cutting host packing ~10x.
+    """
+    b = prefixes.shape[0]
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=b)
+    maxlen = int(lens.max()) if b else 0
+    nb = (64 + maxlen + 17 + 127) // 128
+    buf = np.zeros((b, nb * 128), dtype=np.uint8)
+    buf[:, :64] = prefixes
+    if b and (lens == lens[0]).all():
+        L0 = int(lens[0])
+        if L0:
+            buf[:, 64 : 64 + L0] = np.frombuffer(
+                b"".join(msgs), dtype=np.uint8
+            ).reshape(b, L0)
+        buf[:, 64 + L0] = 0x80
+        inb = (64 + L0 + 17 + 127) // 128
+        nblocks = np.full(b, inb, dtype=np.int32)
+        end = inb * 128
+        buf[:, end - 8 : end] = np.frombuffer(
+            ((64 + L0) * 8).to_bytes(8, "big"), dtype=np.uint8
+        )
+    else:
+        joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+        if joined.size:
+            rows = np.repeat(np.arange(b), lens)
+            starts = np.repeat(np.cumsum(lens) - lens, lens)
+            cols = 64 + np.arange(joined.size, dtype=np.int64) - starts
+            buf[rows, cols] = joined
+        mlen = 64 + lens
+        rng = np.arange(b)
+        buf[rng, mlen] = 0x80
+        inb = (mlen + 17 + 127) // 128
+        nblocks = inb.astype(np.int32)
+        bitlen = mlen * 8
+        end = inb * 128
+        for j in range(8):
+            buf[rng, end - 8 + j] = (bitlen >> (8 * (7 - j))) & 0xFF
+    # LE uint32 view + byteswap = big-endian words, already in row order
+    words = buf.view("<u4").byteswap().view(np.int32)  # (B, NB*32)
+    return words, nblocks
+
+
 def sha512_pad_batch(prefixes: np.ndarray, msgs: list[bytes]):
     """Build padded SHA-512 input blocks for SHA512(prefix || msg) per item.
 
@@ -91,32 +139,10 @@ def sha512_pad_batch(prefixes: np.ndarray, msgs: list[bytes]):
     block count, and nblocks (B,) int32 — each item's own padded block
     count. The device compression loop runs NB blocks but only applies
     updates for block j < nblocks[i], so mixed message lengths hash
-    correctly in one bucket.
+    correctly in one bucket. Thin layout adapter over sha512_pad_rows.
     """
-    b = prefixes.shape[0]
-    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=b)
-    maxlen = int(lens.max()) if b else 0
-    nb = (64 + maxlen + 17 + 127) // 128  # 0x80 byte + 128-bit length field
-    buf = np.zeros((b, nb * 128), dtype=np.uint8)
-    buf[:, :64] = prefixes
-    # scatter all message bytes in one vectorized write
-    joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
-    if joined.size:
-        rows = np.repeat(np.arange(b), lens)
-        starts = np.repeat(np.cumsum(lens) - lens, lens)
-        cols = 64 + np.arange(joined.size, dtype=np.int64) - starts
-        buf[rows, cols] = joined
-    mlen = 64 + lens
-    rng = np.arange(b)
-    buf[rng, mlen] = 0x80
-    inb = (mlen + 17 + 127) // 128
-    nblocks = inb.astype(np.int32)
-    bitlen = mlen * 8  # < 2^64: only the low 8 bytes of the field matter
-    end = inb * 128
-    for j in range(8):
-        buf[rng, end - 8 + j] = (bitlen >> (8 * (7 - j))) & 0xFF
-    words = buf.reshape(b, nb, 16, 8).astype(np.uint32)
-    hi = (words[..., 0] << 24) | (words[..., 1] << 16) | (words[..., 2] << 8) | words[..., 3]
-    lo = (words[..., 4] << 24) | (words[..., 5] << 16) | (words[..., 6] << 8) | words[..., 7]
-    out = np.stack([hi, lo], axis=-1)  # (B, NB, 16, 2)
+    rows, nblocks = sha512_pad_rows(prefixes, msgs)
+    b = rows.shape[0]
+    nb = rows.shape[1] // 32
+    out = rows.view(np.uint32).reshape(b, nb, 16, 2)
     return np.ascontiguousarray(out.transpose(1, 2, 3, 0)), nblocks

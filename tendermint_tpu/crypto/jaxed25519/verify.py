@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..batch import BatchVerifier
-from . import curve, pack, scalar, sha512
+from . import curve, pack, pallas_kernels, scalar, sha512
 
 # persistent compilation cache: the kernel is expensive to compile (~20-40s
 # on TPU) and identical across processes
@@ -37,15 +37,56 @@ except Exception:
     pass
 
 
-def _verify_core(msg_words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs):
+@lru_cache(maxsize=1)
+def on_tpu() -> bool:
+    """True when the default backend is TPU hardware (directly, or via the
+    axon tunnel) — gates the fused pallas kernels, which only lower via
+    Mosaic (a GPU backend must keep the XLA path)."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _verify_core(msg_words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs,
+                 use_pallas: bool = False):
     digest = sha512.sha512_batch(msg_words, nblocks)
     k = scalar.reduce_512(sha512.digest_to_scalar_limbs(digest))
+    if use_pallas:
+        # fused VMEM-resident tail: decompress -> Straus -> encode -> compare
+        # (one Mosaic kernel, no HBM intermediates — see PROFILE.md)
+        return pallas_kernels.verify_tail(a_y, a_sign, r_y, r_sign, s_limbs, k)
     a_pt, ok_a = curve.decompress(a_y, a_sign)
     # R' = [S]B + [k](−A) in ONE Straus chain (shared doublings)
     r_prime = curve.straus_mul_sub(s_limbs, k, curve.negate(a_pt))
     y, parity = curve.encode(r_prime)
     eq = jnp.all(y == r_y, axis=0) & (parity == r_sign)
     return ok_a & eq
+
+
+def _bytes_from_rows(rows_i32, nbytes: int):
+    """(ceil(nbytes/4), B) int32 of 4 packed LE bytes -> (nbytes, B) int32."""
+    parts = [(rows_i32 >> (8 * k)) & 0xFF for k in range(4)]
+    stacked = jnp.stack(parts, axis=1)  # (rows, 4, B)
+    return stacked.reshape(-1, rows_i32.shape[-1])[:nbytes]
+
+
+def _limbs_from_bytes(bts):
+    """(32, B) int32 LE bytes -> (20, B) 13-bit limbs (device twin of
+    pack.bytes_to_limbs_batch)."""
+    bdim = bts.shape[-1]
+    zero = jnp.zeros((1, bdim), dtype=jnp.int32)
+    rows = []
+    for i in range(pack.NLIMB):
+        bit = pack.BITS * i
+        s, o = bit // 8, bit % 8
+        v = bts[s] >> o
+        if s + 1 < 32:
+            v = v | (bts[s + 1] << (8 - o))
+        if s + 2 < 32 and 16 - o < pack.BITS:
+            v = v | (bts[s + 2] << (16 - o))
+        rows.append(v & pack.MASK)
+    return jnp.stack(rows, axis=0)
 
 
 @lru_cache(maxsize=32)
@@ -60,20 +101,30 @@ def _jitted(nb: int, bpad: int, ndev: int):
     return jax.jit(_verify_core)
 
 
-def _verify_packed_core(buf, nb: int):
-    """Unpack ONE (rows, B) int32 buffer into the 7 _verify_core inputs.
-    A single host→device transfer instead of seven — the transfer link
-    (PCIe, or the axon tunnel) charges per round trip."""
+ROWS_AUX = 25  # nblocks row + 16 sig rows + 8 pk rows
+
+
+def _verify_packed_core(buf, nb: int, use_pallas: bool = False):
+    """Unpack ONE (nb*32 + 25, B) int32 buffer into the _verify_core
+    inputs. One host→device transfer instead of seven, and the signature/
+    pubkey bytes ride 4-per-int32 (byte-dense) — limb expansion happens
+    on device, cutting the transfer ~30% vs shipping limbs (the axon
+    tunnel charges ~64 ms latency per round trip plus ~10 ms/MB)."""
     w = nb * 32
     # int32 → uint32 is a bitcast; SHA-512 needs logical shifts
     words = buf[:w].astype(jnp.uint32).reshape(nb, 16, 2, -1)
     nblocks = buf[w]
-    a_y = buf[w + 1 : w + 21]
-    a_sign = buf[w + 21]
-    r_y = buf[w + 22 : w + 42]
-    r_sign = buf[w + 42]
-    s_limbs = buf[w + 43 : w + 63]
-    return _verify_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs)
+    sig_bytes = _bytes_from_rows(buf[w + 1 : w + 17], 64)
+    pk_bytes = _bytes_from_rows(buf[w + 17 : w + 25], 32)
+    r_y = _limbs_from_bytes(sig_bytes[:32])
+    r_sign = (r_y[19] >> 8) & 1
+    r_y = r_y.at[19].set(r_y[19] & 0xFF)
+    s_limbs = _limbs_from_bytes(sig_bytes[32:64])
+    a_y = _limbs_from_bytes(pk_bytes)
+    a_sign = (a_y[19] >> 8) & 1
+    a_y = a_y.at[19].set(a_y[19] & 0xFF)
+    return _verify_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs,
+                        use_pallas=use_pallas)
 
 
 @lru_cache(maxsize=32)
@@ -81,12 +132,29 @@ def _jitted_packed(nb: int, bpad: int, ndev: int):
     if ndev > 1:
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+        # GSPMD cannot auto-partition a Mosaic custom call: the sharded
+        # path stays on the XLA kernel (shard_map+pallas is future work)
         mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
         sh = NamedSharding(mesh, P(None, "dp"))
         out = NamedSharding(mesh, P("dp"))
-        return jax.jit(partial(_verify_packed_core, nb=nb),
+        return jax.jit(partial(_verify_packed_core, nb=nb, use_pallas=False),
                        in_shardings=(sh,), out_shardings=out)
-    return jax.jit(partial(_verify_packed_core, nb=nb))
+    return jax.jit(partial(_verify_packed_core, nb=nb, use_pallas=on_tpu()))
+
+
+@lru_cache(maxsize=1)
+def _ref_L() -> int:
+    from . import ref
+
+    return ref.L
+
+
+def _pack_le_rows(arr: np.ndarray) -> np.ndarray:
+    """(B, nbytes) uint8 -> (nbytes//4, B) int32, 4 LE bytes per word."""
+    b, nbytes = arr.shape
+    w = arr.reshape(b, nbytes // 4, 4).astype(np.uint32)
+    packed = w[..., 0] | (w[..., 1] << 8) | (w[..., 2] << 16) | (w[..., 3] << 24)
+    return np.ascontiguousarray(packed.T).view(np.int32)
 
 
 def _bucket(n: int) -> int:
@@ -115,10 +183,10 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
             if well_formed[i]:
                 sig_arr[i] = np.frombuffer(s, dtype=np.uint8)
                 pk_arr[i] = np.frombuffer(p, dtype=np.uint8)
-    r_y, r_sign, s_limbs, s_ok = pack.split_signatures(sig_arr)
-    a_y, a_sign = pack.split_pubkeys(pk_arr)
+    # canonicity of S (s < L) is a pure host-side byte check — no transfer
+    s_ok = pack.lt_const_le_batch(sig_arr[:, 32:], _ref_L())
     prefixes = np.concatenate([sig_arr[:, :32], pk_arr], axis=1)
-    words, nblocks = pack.sha512_pad_batch(prefixes, [bytes(m) for m in msgs])
+    word_rows, nblocks = pack.sha512_pad_rows(prefixes, [bytes(m) for m in msgs])
 
     ndev = devices if devices is not None else len(jax.devices())
     bpad = _bucket(n)
@@ -126,21 +194,21 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
         bpad = max(bpad, ndev)
         bpad = (bpad + ndev - 1) // ndev * ndev
 
-    # one packed (rows, bpad) int32 buffer = one h2d transfer
-    nb = words.shape[0]
-    rows = nb * 32 + 63
+    # one packed (rows, bpad) int32 buffer = one h2d transfer; sig/pk ride
+    # as raw bytes 4-per-int32 and expand to limbs on device
+    nb = word_rows.shape[1] // 32
+    rows = nb * 32 + ROWS_AUX
     buf = np.zeros((rows, bpad), dtype=np.int32)
     w = nb * 32
-    buf[:w, :n] = words.astype(np.int32).reshape(w, n)
+    buf[:w, :n] = word_rows.T
     buf[w, :n] = nblocks
-    buf[w + 1 : w + 21, :n] = a_y
-    buf[w + 21, :n] = a_sign
-    buf[w + 22 : w + 42, :n] = r_y
-    buf[w + 42, :n] = r_sign
-    buf[w + 43 : w + 63, :n] = s_limbs
+    buf[w + 1 : w + 17, :n] = _pack_le_rows(sig_arr)
+    buf[w + 17 : w + 25, :n] = _pack_le_rows(pk_arr)
 
     fn = _jitted_packed(nb, bpad, ndev)
-    mask = fn(jnp.asarray(buf))
+    # device_put submits the transfer asynchronously; the dispatch and the
+    # mask fetch then ride the same pipeline (one latency leg, not three)
+    mask = fn(jax.device_put(buf))
     out = np.asarray(mask)[:n] & s_ok & well_formed
     return [bool(v) for v in out]
 
@@ -201,7 +269,7 @@ def warmup(buckets=(8, 16, 64), nb: int = 2, devices: int | None = None) -> None
         if ndev > 1:
             bpad = max(bpad, ndev)
             bpad = (bpad + ndev - 1) // ndev * ndev
-        rows = nb * 32 + 63
+        rows = nb * 32 + ROWS_AUX
         fn = _jitted_packed(nb, bpad, ndev)
         fn(jnp.asarray(np.zeros((rows, bpad), dtype=np.int32)))
 
