@@ -52,21 +52,11 @@ pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(N, 32)
 
 t0 = time.perf_counter()
 s_ok = pack.lt_const_le_batch(sig_arr[:, 32:], V._ref_L())
-prefixes = np.concatenate([sig_arr[:, :32], pk_arr], axis=1)
-words, nblocks = pack.sha512_pad_batch(prefixes, msgs)
-nb = words.shape[0]
-bpad = V._bucket(N)
-rows = nb * 32 + V.ROWS_AUX
-buf = np.zeros((rows, bpad), dtype=np.int32)
-w = nb * 32
-buf[:w, :N] = words.astype(np.int32).reshape(w, N)
-buf[w, :N] = nblocks
-buf[w + 1 : w + 17, :N] = V._pack_le_rows(sig_arr)
-buf[w + 17 : w + 25, :N] = V._pack_le_rows(pk_arr)
+buf, nb, mrows, bpad = V.pack_buffer(msgs, sig_arr, pk_arr, 1)
 host_ms = (time.perf_counter() - t0) * 1000
 print(f"host packing: {host_ms:.1f} ms; buf {buf.nbytes/1e6:.2f} MB")
 
-fn = V._jitted_packed(nb, bpad, 1)
+fn = V._jitted_packed(nb, mrows, bpad, 1)
 
 # h2d only
 ts = []

@@ -83,13 +83,30 @@ def split_pubkeys(pks: np.ndarray):
     return bytes_to_limbs_batch(masked), sign
 
 
+def fill_msg_bytes(out: np.ndarray, msgs: list[bytes], lens: np.ndarray,
+                   col0: int = 0) -> None:
+    """Write each msgs[i] into out[i, col0:col0+len(i)] — one vectorized
+    scatter for ragged lengths, a plain reshape when uniform."""
+    b = out.shape[0]
+    if not b or not lens.max():
+        return
+    joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
+    if (lens == lens[0]).all():
+        out[:, col0 : col0 + int(lens[0])] = joined.reshape(b, int(lens[0]))
+        return
+    rows = np.repeat(np.arange(b), lens)
+    starts = np.repeat(np.cumsum(lens) - lens, lens)
+    cols = col0 + np.arange(joined.size, dtype=np.int64) - starts
+    out[rows, cols] = joined
+
+
 def sha512_pad_rows(prefixes: np.ndarray, msgs: list[bytes]):
     """Like sha512_pad_batch but returns (rows (B, NB*32) int32, nblocks):
-    each row strip is the big-endian uint32 (hi, lo) word stream in the
-    exact row order the packed verify buffer wants — callers transpose
-    straight into it with no intermediate (NB, 16, 2, B) tensor. A
-    uniform-length fast path skips the ragged scatter (commit vote
-    sign-bytes are near-uniform), cutting host packing ~10x.
+    each row strip is the big-endian uint32 (hi, lo) word stream in row
+    order. (The production verify path now ships raw message bytes and
+    pads on device — see verify._verify_packed_core; this host padder
+    serves the sharded/test path via sha512_pad_batch.) A uniform-length
+    fast path skips the ragged scatter.
     """
     b = prefixes.shape[0]
     lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=b)
@@ -97,35 +114,16 @@ def sha512_pad_rows(prefixes: np.ndarray, msgs: list[bytes]):
     nb = (64 + maxlen + 17 + 127) // 128
     buf = np.zeros((b, nb * 128), dtype=np.uint8)
     buf[:, :64] = prefixes
-    if b and (lens == lens[0]).all():
-        L0 = int(lens[0])
-        if L0:
-            buf[:, 64 : 64 + L0] = np.frombuffer(
-                b"".join(msgs), dtype=np.uint8
-            ).reshape(b, L0)
-        buf[:, 64 + L0] = 0x80
-        inb = (64 + L0 + 17 + 127) // 128
-        nblocks = np.full(b, inb, dtype=np.int32)
-        end = inb * 128
-        buf[:, end - 8 : end] = np.frombuffer(
-            ((64 + L0) * 8).to_bytes(8, "big"), dtype=np.uint8
-        )
-    else:
-        joined = np.frombuffer(b"".join(msgs), dtype=np.uint8)
-        if joined.size:
-            rows = np.repeat(np.arange(b), lens)
-            starts = np.repeat(np.cumsum(lens) - lens, lens)
-            cols = 64 + np.arange(joined.size, dtype=np.int64) - starts
-            buf[rows, cols] = joined
-        mlen = 64 + lens
-        rng = np.arange(b)
-        buf[rng, mlen] = 0x80
-        inb = (mlen + 17 + 127) // 128
-        nblocks = inb.astype(np.int32)
-        bitlen = mlen * 8
-        end = inb * 128
-        for j in range(8):
-            buf[rng, end - 8 + j] = (bitlen >> (8 * (7 - j))) & 0xFF
+    fill_msg_bytes(buf, msgs, lens, col0=64)
+    mlen = 64 + lens
+    rng = np.arange(b)
+    buf[rng, mlen] = 0x80
+    inb = (mlen + 17 + 127) // 128
+    nblocks = inb.astype(np.int32)
+    bitlen = mlen * 8
+    end = inb * 128
+    for j in range(8):
+        buf[rng, end - 8 + j] = (bitlen >> (8 * (7 - j))) & 0xFF
     # LE uint32 view + byteswap = big-endian words, already in row order
     words = buf.view("<u4").byteswap().view(np.int32)  # (B, NB*32)
     return words, nblocks
