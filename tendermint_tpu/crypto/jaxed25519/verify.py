@@ -223,11 +223,12 @@ def _bucket(n: int) -> int:
     return (n + 511) // 512 * 512
 
 
-def verify_batch(msgs, sigs, pks, devices: int | None = None):
-    """Lists of (msg bytes, 64-byte sig, 32-byte pubkey) -> list[bool]."""
+def _pack_well_formed(msgs, sigs, pks):
+    """Shared validation+packing front end: -> (sig_arr (n,64), pk_arr
+    (n,32), ok_host (n,) bool) where ok_host = well-formed lengths AND
+    canonical S (s < L, a pure host-side byte check — no transfer).
+    Malformed rows are zeroed so downstream vector code stays shape-stable."""
     n = len(msgs)
-    if n == 0:
-        return []
     well_formed = np.array(
         [len(s) == 64 and len(p) == 32 for s, p in zip(sigs, pks)], dtype=bool
     )
@@ -241,8 +242,16 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
             if well_formed[i]:
                 sig_arr[i] = np.frombuffer(s, dtype=np.uint8)
                 pk_arr[i] = np.frombuffer(p, dtype=np.uint8)
-    # canonicity of S (s < L) is a pure host-side byte check — no transfer
     s_ok = pack.lt_const_le_batch(sig_arr[:, 32:], _ref_L())
+    return sig_arr, pk_arr, s_ok & well_formed
+
+
+def verify_batch(msgs, sigs, pks, devices: int | None = None):
+    """Lists of (msg bytes, 64-byte sig, 32-byte pubkey) -> list[bool]."""
+    n = len(msgs)
+    if n == 0:
+        return []
+    sig_arr, pk_arr, ok_host = _pack_well_formed(msgs, sigs, pks)
 
     ndev = devices if devices is not None else len(jax.devices())
     buf, nb, mrows, bpad = pack_buffer(msgs, sig_arr, pk_arr, ndev)
@@ -250,8 +259,140 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
     # device_put submits the transfer asynchronously; the dispatch and the
     # mask fetch then ride the same pipeline (one latency leg, not three)
     mask = fn(jax.device_put(buf))
-    out = np.asarray(mask)[:n] & s_ok & well_formed
+    out = np.asarray(mask)[:n] & ok_host
     return [bool(v) for v in out]
+
+
+# --- aggregate (random-linear-combination) verification --------------------
+
+
+def _rlc_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs, z_limbs,
+              group: int):
+    """Grouped RLC batch check: for each contiguous group g of `group`
+    items, verify Σ_i z_i·s_i · B == Σ_i [z_i]R_i + Σ_i [z_i·k_i]A_i
+    with host-supplied random z_i = 8·u_i (u_i random odd 128-bit). The
+    factor 8 makes the equation COFACTORED: every small-order (torsion)
+    component is annihilated by construction, so acceptance is
+    deterministic (never a coin-flip on torsion sums) and the prime-order
+    part is sound to 2^-128. One doubling chain per GROUP (shared by all
+    members) instead of one per signature — the fast path for valid-heavy
+    batches (fast-sync block commits, reference call site
+    blockchain/reactor.go:310). Returns (ok_pre (B,), ok_g (B//group,)).
+    Items with failed A/R decompress are excluded (their z is zeroed) and
+    reported in ok_pre."""
+    digest = sha512.sha512_batch(words, nblocks)
+    k = scalar.reduce_512(sha512.digest_to_scalar_limbs(digest))
+    a_pt, ok_a = curve.decompress(a_y, a_sign)
+    r_pt, ok_r = curve.decompress(r_y, r_sign)
+    ok_pre = ok_a & ok_r
+    z = jnp.where(ok_pre[None, :], z_limbs, 0)
+    zk = scalar.mul_mod_l(z, k)
+    zs = scalar.mul_mod_l(z, s_limbs)
+    s_g = scalar.sum_mod_l_groups(zs, group)
+    bdim = a_y.shape[-1]
+    zk_win = curve._windows_msb_first(zk, bdim)
+    z_win = curve._windows_msb_first(z, bdim, nbits=132)  # 8*u: 131 bits
+    t_g = curve.msm_groups(r_pt, z_win, a_pt, zk_win, group)
+    rhs = curve.fixed_base_mul(s_g)
+    diff = curve.add_points(t_g, curve.negate(rhs))
+    return ok_pre, curve.is_identity(diff)
+
+
+@lru_cache(maxsize=16)
+def _jitted_rlc(nb: int, bpad: int, group: int):
+    return jax.jit(partial(_rlc_core, group=group))
+
+
+def verify_batch_rlc(msgs, sigs, pks, group: int = 64,
+                     devices: int | None = None):
+    """Aggregate (random-linear-combination) batch verification with a
+    COFACTORED group equation (z_i = 8·u_i; ZIP-215 / ed25519-dalek
+    verify_batch style). Groups whose equation holds are accepted;
+    failed groups fall back to the per-item kernel, so ordinary forgeries
+    (prime-order defects), corrupted signatures, wrong keys, malformed
+    inputs, high-S and non-canonical-R encodings all produce exactly the
+    per-item masks (non-canonical R is pre-rejected host-side because Go
+    compares encode(R') against the RAW R bytes).
+
+    KNOWN, DELIBERATE divergence from the per-item path: a signature
+    whose defect is PURE small-order torsion — R' = R + T with T in the
+    8-torsion subgroup, s computed against H(R'||A||M) — satisfies the
+    cofactored equation but fails Go's cofactorless byte compare. No
+    batch equation can match cofactorless single verification on these
+    (Chalkias et al., "Taming the many EdDSAs"); making them pass
+    deterministically (rather than with probability ~1/8 on torsion-sum
+    cancellation) is the safer, standardized choice. Because of this
+    divergence the consensus-critical paths (verify_commit and friends)
+    use ONLY the per-item kernel; this mode is for throughput-bound,
+    non-consensus batch checks."""
+    import secrets as _secrets
+
+    n = len(msgs)
+    if n == 0:
+        return []
+    sig_arr, pk_arr, ok_host = _pack_well_formed(msgs, sigs, pks)
+    # Go's verify compares encode(R') against the RAW R bytes: a
+    # non-canonical R (y >= p) can never match the canonical encode.
+    # The RLC equation tests point equality, so weed those out up front.
+    r_masked = sig_arr[:, :32].copy()
+    r_masked[:, 31] &= 0x7F
+    ok_host = ok_host & pack.lt_const_le_batch(r_masked, _ref_P())
+
+    r_y, r_sign, s_limbs, _ = pack.split_signatures(sig_arr)
+    a_y, a_sign = pack.split_pubkeys(pk_arr)
+    prefixes = np.concatenate([sig_arr[:, :32], pk_arr], axis=1)
+    words, nblocks = pack.sha512_pad_batch(prefixes, [bytes(m) for m in msgs])
+
+    bpad = _bucket(n)
+    group = min(group, bpad)
+
+    # z_i = 8·u_i with u_i random odd 128-bit: the odd u keeps z nonzero
+    # mod L, the 8 makes the group equation cofactored (see docstring)
+    u_bytes = np.frombuffer(_secrets.token_bytes(16 * n), np.uint8
+                            ).reshape(n, 16).copy()
+    u_bytes[:, 0] |= 1
+    z_bytes = np.zeros((n, 17), dtype=np.uint8)  # u << 3, little-endian
+    z_bytes[:, :16] = u_bytes << 3
+    z_bytes[:, 1:] |= u_bytes >> 5
+    z_limbs = pack.bytes_to_limbs_batch(z_bytes)
+    z_limbs[:, ~ok_host] = 0  # excluded items must not contribute
+
+    def padb(a):
+        padw = [(0, 0)] * (a.ndim - 1) + [(0, bpad - n)]
+        return np.pad(a, padw)
+
+    fn = _jitted_rlc(words.shape[0], bpad, group)
+    ok_pre, ok_g = fn(
+        jnp.asarray(padb(words)), jnp.asarray(padb(nblocks)),
+        jnp.asarray(padb(a_y)), jnp.asarray(padb(a_sign)),
+        jnp.asarray(padb(r_y)), jnp.asarray(padb(r_sign)),
+        jnp.asarray(padb(s_limbs)), jnp.asarray(padb(z_limbs)),
+    )
+    ok_pre = np.asarray(ok_pre)[:n] & ok_host
+    ok_g = np.asarray(ok_g)
+
+    out = np.zeros(n, dtype=bool)
+    retry = []
+    for i in range(n):
+        if not ok_pre[i]:
+            continue  # definitively invalid (malformed/non-canonical/decompress)
+        if ok_g[i // group]:
+            out[i] = True
+        else:
+            retry.append(i)
+    if retry:
+        sub = verify_batch([msgs[i] for i in retry], [sigs[i] for i in retry],
+                           [pks[i] for i in retry], devices=devices)
+        for i, ok in zip(retry, sub):
+            out[i] = ok
+    return [bool(v) for v in out]
+
+
+@lru_cache(maxsize=1)
+def _ref_P() -> int:
+    from . import ref
+
+    return ref.P
 
 
 def make_sharded_commit_step(mesh):
@@ -267,7 +408,11 @@ def make_sharded_commit_step(mesh):
     exact. The authoritative quorum decision in verify_commit additionally
     re-tallies host-side from the mask with unbounded Python ints."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     dp = lambda n: P(*([None] * (n - 1) + ["dp"]))
 
@@ -294,6 +439,62 @@ def tallied_power(lo, hi) -> int:
     return int(lo) + (int(hi) << 16)
 
 
+@lru_cache(maxsize=4)
+def _sharded_commit_fn(ndev: int):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
+    return make_sharded_commit_step(mesh)
+
+
+def sharded_commit_verify(msgs, sigs, pks, powers, for_block,
+                          devices: int | None = None):
+    """Device-parallel commit verification over every visible device:
+    per-signature validity masks (batch sharded on a 1-D 'dp' mesh) plus
+    the 2/3-quorum voting-power tally as an on-device psum — the
+    multi-chip equivalent of the reference's talliedVotingPower loop
+    (types/validator_set.go:345-371).
+
+    powers must each be < 2^31 (the exact lo/hi 16-bit tally bound);
+    callers with larger powers must use the host path. Returns
+    (mask list[bool], psum_tally int). Host-side canonicity (s < L) and
+    well-formedness zero out both the mask and the item's tally weight.
+    """
+    n = len(msgs)
+    ndev = devices if devices is not None else len(jax.devices())
+    if n == 0:
+        return [], 0
+    sig_arr, pk_arr, ok_host = _pack_well_formed(msgs, sigs, pks)
+    r_y, r_sign, s_limbs, _ = pack.split_signatures(sig_arr)
+    a_y, a_sign = pack.split_pubkeys(pk_arr)
+    prefixes = np.concatenate([sig_arr[:, :32], pk_arr], axis=1)
+    words, nblocks = pack.sha512_pad_batch(prefixes, [bytes(m) for m in msgs])
+
+    bpad = max(_bucket(n), ndev)
+    bpad = (bpad + ndev - 1) // ndev * ndev
+
+    def padb(a, fill=0):  # pad batch-last axis to bpad
+        padw = [(0, 0)] * (a.ndim - 1) + [(0, bpad - n)]
+        return np.pad(a, padw, constant_values=fill)
+
+    powers_arr = np.asarray(powers, dtype=np.int64)
+    if (powers_arr >= 2**31).any() or (powers_arr < 0).any():
+        raise ValueError("sharded tally requires 0 <= power < 2^31")
+    counted_powers = np.where(ok_host, powers_arr, 0).astype(np.int32)
+    fb = np.asarray(for_block, dtype=np.int32)
+
+    fn = _sharded_commit_fn(ndev)
+    mask, lo, hi = fn(
+        jnp.asarray(padb(words)), jnp.asarray(padb(nblocks)),
+        jnp.asarray(padb(a_y)), jnp.asarray(padb(a_sign)),
+        jnp.asarray(padb(r_y)), jnp.asarray(padb(r_sign)),
+        jnp.asarray(padb(s_limbs)), jnp.asarray(padb(counted_powers)),
+        jnp.asarray(padb(fb)),
+    )
+    out = np.asarray(mask)[:n] & ok_host
+    return [bool(v) for v in out], tallied_power(lo, hi)
+
+
 def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
            devices: int | None = None) -> None:
     """Compile the hot bucket shapes ahead of time. First-use compile of
@@ -313,6 +514,15 @@ def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
             bpad = (bpad + ndev - 1) // ndev * ndev
         fn = _jitted_packed(nb, mrows, bpad, ndev)
         fn(jnp.asarray(np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)))
+        if ndev > 1:
+            # the multi-device commit path routes through the shard_map
+            # psum step (sharded_commit_verify) — compile it too, or the
+            # first live verify_commit pays the compile
+            step = _sharded_commit_fn(ndev)
+            z20 = np.zeros((20, bpad), np.int32)
+            zrow = np.zeros((bpad,), np.int32)
+            step(np.zeros((nb, 16, 2, bpad), np.uint32), zrow + 1, z20, zrow,
+                 z20, zrow, z20, zrow, zrow)
 
 
 class JAXBatchVerifier(BatchVerifier):

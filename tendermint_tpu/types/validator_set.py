@@ -9,12 +9,15 @@ the whole commit. This is north-star call site #1.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field as dc_field
 from typing import List, Optional
 
 from .. import codec
 from ..crypto import PubKey, batch, tmhash
 from .basic import VOTE_TYPE_PRECOMMIT, BlockID
+
+LOG = logging.getLogger("types.validator_set")
 
 MAX_TOTAL_VOTING_POWER = 2**63 // 8  # overflow guard (reference :19)
 
@@ -126,10 +129,26 @@ class ValidatorSet:
         return None, None
 
     def increment_proposer_priority(self, times: int) -> None:
-        """Advance proposer rotation `times` rounds (reference :76-117)."""
+        """Advance proposer rotation `times` rounds (reference :76-117).
+
+        Deliberate redesign vs the reference: priorities are unbounded
+        Python ints, so the int64-overflow clamps of
+        types/validator_set.go:547-585 are unnecessary for safety — but
+        the reference's *behavioral* bounds are kept so proposer
+        selection matches across implementations: before incrementing,
+        priorities are centered on their average and the spread is
+        clipped to 2*total_voting_power (same window factor, same
+        truncated-division semantics as Go). The per-round loop itself is
+        O(times*n) exactly like the reference; `times` is the round/height
+        delta, which state transitions keep small (capped here as a
+        guard against pathological callers)."""
         if not self.validators:
             return
+        if times > 100_000:
+            raise ValueError(f"increment_proposer_priority: times {times} too large")
         total = self.total_voting_power()
+        self._rescale_priorities(2 * total)
+        self._shift_by_avg_priority()
         for _ in range(times):
             mx = None
             for v in self.validators:
@@ -137,6 +156,34 @@ class ValidatorSet:
                 mx = v if mx is None else mx.compare_proposer_priority(v)
             mx.proposer_priority -= total
             self.proposer = mx
+
+    @staticmethod
+    def _trunc_div(a: int, b: int) -> int:
+        """Go's integer division truncates toward zero; Python's floors."""
+        q = abs(a) // b
+        return -q if a < 0 else q
+
+    def _rescale_priorities(self, diff_max: int) -> None:
+        """Clip the priority spread to diff_max (reference
+        types/validator_set.go:547-585 RescalePriorities)."""
+        if diff_max <= 0:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        dist = max(prios) - min(prios)
+        if dist > diff_max:
+            ratio = (dist + diff_max - 1) // diff_max
+            for v in self.validators:
+                v.proposer_priority = self._trunc_div(v.proposer_priority, ratio)
+
+    def _shift_by_avg_priority(self) -> None:
+        """Center priorities on their average (reference
+        shiftByAvgProposerPriority). The reference computes the average
+        with big.Int.Div — Euclidean division, which for a positive
+        divisor equals Python's floor `//` (NOT Go's truncating `/`)."""
+        n = len(self.validators)
+        avg = sum(v.proposer_priority for v in self.validators) // n
+        for v in self.validators:
+            v.proposer_priority -= avg
 
     def get_proposer(self) -> Validator:
         if self.proposer is None:
@@ -180,7 +227,7 @@ class ValidatorSet:
             bv.add(precommit.sign_bytes(chain_id), precommit.signature, val.pub_key.bytes())
             entries.append((idx, precommit, val))
 
-        mask = bv.verify()
+        mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
         tallied = 0
         for ok, (idx, precommit, val) in zip(mask, entries):
             if not ok:
@@ -190,10 +237,62 @@ class ValidatorSet:
             if precommit.block_id == block_id:
                 tallied += val.voting_power
 
+        if psum_tally is not None and psum_tally != tallied:
+            # the host loop above is authoritative; a differing on-device
+            # psum tally can only mean a kernel defect — surface it loudly
+            LOG.error(
+                "sharded psum tally %d != host tally %d (using host)",
+                psum_tally, tallied,
+            )
+
         if 3 * tallied <= 2 * self.total_voting_power():
             raise ErrNotEnoughVotingPower(
                 f"invalid commit: tallied {tallied} <= 2/3 of {self.total_voting_power()}"
             )
+
+    @staticmethod
+    def _run_batch_verify(bv, entries, block_id):
+        """Run the accumulated signature batch. With more than one device
+        visible and the jax backend active, the batch shards across the
+        'dp' mesh and the 2/3 tally happens on-device via psum
+        (crypto/jaxed25519/verify.sharded_commit_verify); the host tally
+        in verify_commit stays authoritative. Returns (mask, psum_tally
+        or None)."""
+        if entries:
+            try:
+                import os
+
+                # Backend and batch-size checks come FIRST: importing jax /
+                # calling jax.devices() initializes the TPU backend, which
+                # must never happen inside the consensus path when the host
+                # OpenSSL backend is selected or the batch is tiny.
+                backend = batch.default_backend_name()
+                min_batch = (int(os.environ.get("TM_TPU_BATCH_MIN", "16"))
+                             if backend == "adaptive" else 1)
+                if (backend in ("jax", "adaptive")
+                        and len(entries) >= min_batch
+                        and all(0 <= v.voting_power < 2**31
+                                for _, _, v in entries)):
+                    import jax
+
+                    from ..crypto.jaxed25519 import verify as jv
+
+                    if len(jax.devices()) > 1:
+                        msgs, sigs, pks = zip(*bv._items)
+                        powers = [v.voting_power for _, _, v in entries]
+                        for_block = [int(p.block_id == block_id)
+                                     for _, p, _ in entries]
+                        return jv.sharded_commit_verify(
+                            list(msgs), list(sigs), list(pks), powers,
+                            for_block)
+            except ImportError:
+                pass
+            except Exception as e:  # noqa: BLE001 - host path is authoritative
+                # any device-side failure (compile error, OOM, topology
+                # change) must not abort commit verification: the host
+                # batch path below verifies identically
+                LOG.warning("sharded commit verify failed, host fallback: %s", e)
+        return bv.verify(), None
 
     # --- updates (reference :411-472 via state.updateState) ---------------
 

@@ -184,6 +184,159 @@ def test_pallas_verify_tail_matches_xla(batch):
     assert np.array_equal(np.asarray(want), np.asarray(got))
 
 
+def test_rlc_aggregate_exact_masks():
+    """verify_batch_rlc (random-linear-combination aggregate mode) must
+    return exactly the same masks as the per-item path on an adversarial
+    mixed batch: corrupted sigs, wrong msg, bad pk, malformed, high-S,
+    non-canonical R, plus valid items — with group fallback resolving
+    failed groups per-item."""
+    from tendermint_tpu.crypto.jaxed25519 import ref as R
+    from tendermint_tpu.crypto.jaxed25519.verify import (
+        verify_batch,
+        verify_batch_rlc,
+    )
+
+    items = []
+    for i in range(12):
+        sk, pk = _keypair()
+        msg = secrets.token_bytes(60 + i)
+        items.append((msg, sk.sign(msg), pk))
+    sk, pk = _keypair()
+    msg = b"bad"
+    sig = sk.sign(msg)
+    items.append((msg, bytes([sig[0] ^ 1]) + sig[1:], pk))
+    items.append((b"other", sig, pk))
+    items.append((msg, sig, b"\x07" * 32))
+    items.append((msg, b"\x00" * 30, pk))
+    s = int.from_bytes(sig[32:], "little")
+    if s + R.L < 2**256:
+        items.append((msg, sig[:32] + (s + R.L).to_bytes(32, "little"), pk))
+    # non-canonical R: y' = y + p still < 2^255 only if y < 2^255 - p = 19
+    # — craft instead by setting R to p (y=p ≡ 0 mod p, non-canonical)
+    bad_r = (R.P).to_bytes(32, "little")
+    items.append((msg, bad_r + sig[32:], pk))
+
+    msgs = [m for m, _, _ in items]
+    sigs = [s_ for _, s_, _ in items]
+    pks = [p for _, _, p in items]
+    want = verify_batch(msgs, sigs, pks, devices=1)
+    got = verify_batch_rlc(msgs, sigs, pks, group=8, devices=1)
+    assert got == want
+    assert sum(want) == 12  # the 12 honest items
+
+
+def test_rlc_all_valid_no_fallback(monkeypatch):
+    """On an all-valid batch every group passes the aggregate equation —
+    the per-item fallback must not run."""
+    from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+    # 18 items lands in the same (nb=2, bpad=32, group=8) jit key as
+    # test_rlc_aggregate_exact_masks — one shared compile per session
+    items = []
+    for i in range(18):
+        sk, pk = _keypair()
+        msg = secrets.token_bytes(60 + i)
+        items.append((msg, sk.sign(msg), pk))
+    msgs = [m for m, _, _ in items]
+    sigs = [s for _, s, _ in items]
+    pks = [p for _, _, p in items]
+
+    def boom(*a, **kw):
+        raise AssertionError("fallback ran on an all-valid batch")
+
+    monkeypatch.setattr(V, "verify_batch", boom)
+    got = V.verify_batch_rlc(msgs, sigs, pks, group=8, devices=1)
+    assert got == [True] * 18
+
+
+def test_sharded_commit_verify_masks_and_tally():
+    """The psum sharded commit step (production path when >1 device is
+    visible) must produce exact per-item masks and an exact on-device
+    2/3 tally on mixed-validity, uneven-power batches — the device twin
+    of the reference's talliedVotingPower loop
+    (types/validator_set.go:358-366)."""
+    import jax
+
+    from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+    assert len(jax.devices()) == 8
+    rng = np.random.default_rng(11)
+    n = 24
+    msgs, sigs, pks, valid = [], [], [], []
+    for i in range(n):
+        sk, pk = _keypair()
+        msg = secrets.token_bytes(100)
+        sig = sk.sign(msg)
+        ok = True
+        if i % 5 == 3:
+            sig = bytes([sig[3] ^ 0x40]) + sig[1:]  # corrupt
+            ok = False
+        if i == 7:
+            sig = b"\x11" * 30  # malformed length
+            ok = False
+        msgs.append(msg)
+        sigs.append(sig)
+        pks.append(pk)
+        valid.append(ok)
+    powers = [int(rng.integers(1, 1 << 18)) for _ in range(n)]
+    for_block = [int(rng.random() < 0.8) for _ in range(n)]
+
+    mask, tally = V.sharded_commit_verify(msgs, sigs, pks, powers, for_block,
+                                          devices=8)
+    assert mask == valid
+    want = sum(p for p, ok, fb in zip(powers, valid, for_block) if ok and fb)
+    assert tally == want
+
+
+def test_verify_commit_routes_through_psum(monkeypatch):
+    """ValidatorSet.verify_commit must take the sharded psum path when
+    multiple devices are visible and agree with the host tally."""
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.types import validator_set as vsm
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+    )
+    from tendermint_tpu.types.block import Commit
+
+    prev_backend = batch.default_backend_name()
+    monkeypatch.setenv("TM_TPU_CRYPTO_BACKEND", "jax")
+    batch.set_default_backend("jax")
+    try:
+        calls = {}
+        from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+        orig = V.sharded_commit_verify
+
+        def spy(*a, **kw):
+            calls["hit"] = True
+            return orig(*a, **kw)
+
+        monkeypatch.setattr(V, "sharded_commit_verify", spy)
+
+        vs, keys = vsm.random_validator_set(6, power=7)
+        block_id = BlockID(hash=b"\x01" * 20,
+                           parts_header=PartSetHeader(1, b"\x02" * 20))
+        precommits = [None] * 6
+        for key in keys:
+            addr = key.pub_key().address()
+            idx, _ = vs.get_by_address(addr)
+            vote = Vote(
+                validator_address=addr, validator_index=idx, height=5, round=0,
+                timestamp=1_700_000_100_000_000_000, type=VOTE_TYPE_PRECOMMIT,
+                block_id=block_id,
+            )
+            vote.signature = key.sign(vote.sign_bytes("psum-chain"))
+            precommits[idx] = vote
+        commit = Commit(block_id=block_id, precommits=precommits)
+        vs.verify_commit("psum-chain", block_id, 5, commit)
+        assert calls.get("hit"), "sharded psum path was not taken"
+    finally:
+        batch.set_default_backend(prev_backend)
+
+
 def test_jax_backend_registered():
     from tendermint_tpu.crypto.batch import backends
 
@@ -199,3 +352,84 @@ def test_batch_verifier_interface(batch):
     want = [e for _, _, _, e in batch[:5]]
     assert bv.verify() == want
     assert bv.verify_all() == all(want)
+
+
+def test_rlc_is_cofactored_torsion_divergence_pinned():
+    """verify_batch_rlc uses the COFACTORED group equation (z = 8u).
+    This test pins the one documented divergence from the per-item
+    (Go byte-compare) path: a signature whose defect is pure 8-torsion
+    (R' = R + T, s computed against H(R'||A||M)) fails per-item verify
+    but passes the cofactored batch equation deterministically. No batch
+    equation can match cofactorless single verification on such inputs
+    (Chalkias et al.); anything with a prime-order defect must still
+    match the per-item masks exactly (checked here too)."""
+    import hashlib
+
+    from tendermint_tpu.crypto.jaxed25519 import ref as R
+    from tendermint_tpu.crypto.jaxed25519.verify import (
+        verify_batch,
+        verify_batch_rlc,
+    )
+
+    # find a small-order (torsion) point T != identity: [L]P for an
+    # arbitrary decompressable point P kills the prime-order component
+    T = None
+    for y in range(2, 200):
+        pt = R.decompress(y.to_bytes(32, "little"))
+        if pt is None:
+            continue
+        cand = R.scalar_mult(R.L, pt)
+        if not R.equal(cand, R.scalar_mult(0, pt)):  # not identity
+            T = cand
+            break
+    assert T is not None, "no torsion point found"
+    assert R.equal(R.scalar_mult(8, T), R.scalar_mult(0, T))  # order | 8
+
+    # craft the torsion-defect signature
+    a = 0x5DEB3C55C3425C44E57C46E5288AD9D655D7B26A5EA3BE1251A55D6E5BD95A77 % R.L
+    A_pt = R.scalar_mult(a, R.base_point())
+    A = R.compress(A_pt)
+    msg = b"torsion-defect"
+    r = 0x1F19E27C0C3B4A85D7F4C2E8A1B35D9F17A3C5E7091B3D5F7A9BCDEF01234567 % R.L
+    R0 = R.scalar_mult(r, R.base_point())
+    r_bytes = R.compress(R.add(R0, T))
+    k = int.from_bytes(hashlib.sha512(r_bytes + A + msg).digest(),
+                       "little") % R.L
+    s = (r + k * a) % R.L
+    sig = r_bytes + s.to_bytes(32, "little")
+
+    # sanity: defect is pure torsion — cofactorless reject
+    assert not R.verify(A, msg, sig)
+
+    # group 1 (items 0-7): the torsion sig + 7 valid — its group must
+    # PASS the cofactored equation. group 2 (items 8-15): an ordinary
+    # prime-order forgery + 7 valid — its group must FAIL and fall back.
+    items = [(msg, sig, A, "torsion")]
+    for i in range(7):
+        sk, pk = _keypair()
+        m = secrets.token_bytes(80 + i)
+        items.append((m, sk.sign(m), pk, True))
+    sk, pk = _keypair()
+    m = b"ordinary-forgery"
+    bad = sk.sign(m)
+    items.append((m, bytes([bad[0] ^ 4]) + bad[1:], pk, False))
+    for i in range(7):
+        sk, pk = _keypair()
+        m = secrets.token_bytes(90 + i)
+        items.append((m, sk.sign(m), pk, True))
+
+    msgs = [m for m, _, _, _ in items]
+    sigs = [s_ for _, s_, _, _ in items]
+    pks = [p for _, _, p, _ in items]
+
+    per_item = verify_batch(msgs, sigs, pks, devices=1)
+    assert per_item[0] is False  # Go semantics reject the torsion sig
+    assert per_item[1:8] == [True] * 7
+    assert per_item[8] is False
+    assert per_item[9:] == [True] * 7
+
+    got = verify_batch_rlc(msgs, sigs, pks, group=8, devices=1)
+    # the ONLY divergence: the torsion item is accepted (cofactored);
+    # every prime-order defect still matches per-item exactly
+    assert got[0] is True, "cofactored equation must accept pure torsion"
+    assert got[1:] == per_item[1:]
