@@ -535,9 +535,10 @@ class ConsensusState:
         if self._replay_mode:
             return
         rs = self.rs
-        if rs.locked_block is not None:
-            block, block_parts = rs.locked_block, rs.locked_block_parts
-        elif rs.valid_block is not None:
+        if rs.valid_block is not None:
+            # re-propose the valid block (the most recent polka winner;
+            # a locked block is always also the valid block since locking
+            # requires the complete proposal) — reference :855-858
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
             made = self._create_proposal_block()
@@ -545,7 +546,15 @@ class ConsensusState:
                 return
             block, block_parts = made
 
-        pol_round, pol_block_id = rs.votes.pol_info()
+        # POLRound is OUR valid_round (reference :868 NewProposal(...,
+        # cs.ValidRound, ...)), never a live polka query: a nil polka in
+        # the CURRENT round would make pol_round == round, which every
+        # honest node (including us) rejects as an invalid proposal.
+        pol_round = rs.valid_round
+        pol_block_id = (
+            BlockID(hash=block.hash(), parts_header=block_parts.header())
+            if pol_round >= 0 else BlockID()
+        )
         proposal = Proposal(
             height=height,
             round=round_,
@@ -1063,10 +1072,15 @@ class ConsensusState:
         return max(now, min_t)
 
     def _sign_add_vote(self, type_: int, hash_: bytes, header) -> Optional[Vote]:
-        """reference signAddVote :1676-1690; skipped during WAL replay —
-        the WAL already holds the originally-signed votes."""
+        """reference signAddVote :1676-1690. Signing happens during WAL
+        replay too: the privval double-sign filter makes a re-sign of an
+        already-WAL'd vote idempotent (same timestamp restored), and a
+        vote that was never signed before the crash — e.g. killed between
+        completing the proposal and prevoting — gets signed now, which is
+        what un-sticks the height after replay. Sign errors are expected
+        in replay (privval may be ahead) and only logged live."""
         rs = self.rs
-        if self.priv_validator is None or self._replay_mode:
+        if self.priv_validator is None:
             return None
         idx, _ = rs.validators.get_by_address(self.priv_validator.get_address())
         if idx < 0:
@@ -1074,7 +1088,8 @@ class ConsensusState:
         try:
             vote = self._sign_vote(type_, hash_, header)
         except Exception:
-            LOG.exception("failed signing %s vote", "prevote" if type_ == VOTE_TYPE_PREVOTE else "precommit")
+            if not self._replay_mode:
+                LOG.exception("failed signing %s vote", "prevote" if type_ == VOTE_TYPE_PREVOTE else "precommit")
             return None
         self._send_internal(VoteMessage(vote))
         LOG.debug("signed and queued vote %s", vote)

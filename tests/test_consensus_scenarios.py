@@ -1,0 +1,350 @@
+"""Consensus scenario depth: proposer selection, valid-block rule,
+commit paths, and crash-replay — the remainder of the reference's
+consensus/state_test.go matrix not covered by test_consensus_pol.py:
+TestStateProposerSelection0/2, TestStateEnterProposeNoPrivValidator,
+TestStateBadProposal (bad block), TestProposeValidBlock,
+TestSetValidBlockOnDelayedProposal,
+TestEmitNewValidBlockEventOnCommitWithoutBlock,
+TestCommitFromPreviousRound, plus a WAL mid-height crash-replay
+regression (reference consensus/replay.go catchupReplay + signAddVote
+re-signing semantics, state.go:1676-1690).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus import make_consensus
+from test_consensus_pol import CHAIN_ID, Harness
+
+from tendermint_tpu.consensus.cstypes import (
+    STEP_COMMIT,
+    STEP_PREVOTE,
+)
+from tendermint_tpu.consensus.messages import (
+    BlockPartMessage,
+    ProposalMessage,
+)
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+)
+from tendermint_tpu.types.basic import Proposal
+from tendermint_tpu.types.block import make_part_set
+from tendermint_tpu.types.event_bus import (
+    EVENT_NEW_BLOCK,
+    query_for_event,
+)
+
+
+# ---------------------------------------------------------------------------
+# Proposer selection (reference TestStateProposerSelection0/2)
+# ---------------------------------------------------------------------------
+
+
+class TestProposerSelection:
+    def test_proposer_rotates_across_heights(self):
+        """After committing height 1 (proposed by us), the height-2
+        proposer must be a different validator: committing debits the
+        proposer's priority by the total power
+        (types/validator_set.go:76-117; state_test.go:62-93)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, pv0.block_id, idxs=(1, 2))
+            h.wait_event(h.blocks)
+            deadline = time.time() + 5
+            while h.cs.rs.height != 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert h.cs.rs.height == 2
+            assert h.cs.rs.validators.get_proposer().address != h.our_addr
+        finally:
+            h.stop()
+
+    def test_proposer_rotates_across_rounds(self):
+        """Round advance rotates the proposer deterministically: the
+        round-1 proposer must equal what increment_proposer_priority(1)
+        on a copy of the round-0 set predicts (state_test.go:96-124)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            expected = h.cs.rs.validators.copy()
+            expected.increment_proposer_priority(1)
+            want = expected.get_proposer().address
+
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, BlockID())
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+            assert h.cs.rs.validators.get_proposer().address == want
+            assert want != h.our_addr  # equal powers: rotation moves on
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Propose with no privval / bad block (TestStateEnterProposeNoPrivValidator,
+# TestStateBadProposal)
+# ---------------------------------------------------------------------------
+
+
+class TestProposeEdges:
+    def test_no_priv_validator_times_out_to_prevote(self):
+        """Without a privval we never propose; the propose timeout moves
+        the step to PREVOTE with proposal still nil
+        (state_test.go:127-143)."""
+        cs, bus, mp, keys, bstore = make_consensus(1)
+        cs.priv_validator = None
+        cs.start()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if cs.rs.step >= STEP_PREVOTE:
+                    break
+                time.sleep(0.02)
+            assert cs.rs.step >= STEP_PREVOTE
+            assert cs.rs.proposal is None
+        finally:
+            cs.stop()
+            bus.stop()
+
+    def test_bad_block_proposal_gets_nil_prevote(self):
+        """A well-signed proposal whose block fails validation (tampered
+        app_hash) must draw a nil prevote, not a block prevote
+        (state_test.go:176-232; validation.go validateBlock)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            block, _ = h.make_alt_block(idx, txs=(b"bad-app-hash",))
+            block.header.app_hash = b"\xde\xad" * 10  # state says ""
+            parts = make_part_set(block)  # re-pack AFTER tampering
+            h.stub_proposal(idx, 0, block, parts)
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == b""
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Valid-block rule (TestProposeValidBlock, TestSetValidBlockOnDelayedProposal)
+# ---------------------------------------------------------------------------
+
+
+class TestValidBlockRule:
+    def test_propose_valid_block_in_later_round(self):
+        """r0: our block B gets a polka (valid_block=B) but no commit.
+        When we are proposer again at r4 (4 validators, round-robin),
+        the r4 proposal must re-propose B with pol_round=0, NOT build a
+        fresh block (state.go defaultDecideProposal :850-905 valid-block
+        preference; state_test.go:887-971)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            b_hash = pv0.block_id.hash
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_event(h.locks)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            # deny commit, then skip ahead to r4 where we propose again
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 4, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 4)
+
+            deadline = time.time() + 10
+            while h.cs.rs.proposal is None and time.time() < deadline:
+                time.sleep(0.02)
+            assert h.cs.rs.proposal is not None, "no r4 proposal made"
+            assert h.cs.rs.validators.get_proposer().address == h.our_addr
+            assert h.cs.rs.proposal.pol_round == 0
+            assert h.cs.rs.proposal_block.hash() == b_hash
+            pv4 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 4)
+            assert pv4.block_id.hash == b_hash
+        finally:
+            h.stop()
+
+    def test_valid_block_set_on_delayed_proposal(self):
+        """We prevote nil on timeout; a polka for unseen block C lands;
+        THEN C's proposal+parts arrive (same round). Completing the
+        block against an existing polka must set valid_block=C
+        (state.go:903-907 addProposalBlockPart polka check;
+        state_test.go:1033-1083)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash == b""  # nothing proposed yet
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            c_block, c_parts = h.make_alt_block(idx, txs=(b"late-c",))
+            c_id = BlockID(hash=c_block.hash(), parts_header=c_parts.header())
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, c_id)  # polka before proposal
+            h.stub_proposal(idx, 0, c_block, c_parts)  # delayed delivery
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if (h.cs.rs.valid_block is not None
+                        and h.cs.rs.valid_block.hash() == c_block.hash()):
+                    break
+                time.sleep(0.02)
+            assert h.cs.rs.valid_block is not None
+            assert h.cs.rs.valid_block.hash() == c_block.hash()
+            assert h.cs.rs.valid_round == 0
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Commit paths (TestEmitNewValidBlockEventOnCommitWithoutBlock,
+# TestCommitFromPreviousRound)
+# ---------------------------------------------------------------------------
+
+
+class TestCommitPaths:
+    def test_commit_without_block_then_parts_arrive(self):
+        """2/3 precommits for an UNSEEN block C put us in STEP_COMMIT
+        waiting on parts; delivering the proposal+parts afterwards must
+        finalize C (state.go enterCommit :1147-1192 + tryFinalizeCommit;
+        state_test.go:1197-1228)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            prop_addr = h.cs.rs.validators.get_proposer().address
+            idx = next(
+                i for i in range(4)
+                if h.cs.rs.validators.get_by_index(i)[0] == prop_addr
+            )
+            c_block, c_parts = h.make_alt_block(idx, txs=(b"commit-c",))
+            c_id = BlockID(hash=c_block.hash(), parts_header=c_parts.header())
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, c_id)  # 3/4 power, no block
+            deadline = time.time() + 10
+            while h.cs.rs.step != STEP_COMMIT and time.time() < deadline:
+                time.sleep(0.02)
+            assert h.cs.rs.step == STEP_COMMIT
+            assert h.cs.rs.proposal_block_parts.has_header(c_parts.header())
+            h.stub_proposal(idx, 0, c_block, c_parts)
+            blk = h.wait_event(h.blocks)["block"]
+            assert blk.hash() == c_block.hash()
+        finally:
+            h.stop()
+
+    def test_commit_from_previous_round_precommits(self):
+        """We precommit nil in r0 (no polka for us), but the other 3/4
+        of power precommits B at r0: the 2/3 precommit majority must
+        commit B regardless of our nil (state_test.go:1231-1271)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            # stubs prevote nil → our precommit is nil
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, BlockID())
+            pc0 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            assert pc0.block_id.hash == b""
+            # but the stubs all precommit our block B
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, pv0.block_id)
+            blk = h.wait_event(h.blocks)["block"]
+            assert blk.hash() == pv0.block_id.hash
+        finally:
+            h.stop()
+
+    def test_unlock_on_late_polka_from_intermediate_round(self):
+        """Lock B at r0; reach r2 with a SPLIT r1 prevote (no polka);
+        then a late nil polka at r1 completes. lockedRound(0) < 1 <=
+        round(2) and nil != B → must UNLOCK (state.go:1547-1566)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_event(h.locks)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+
+            # r1: only ONE stub prevotes nil (no polka with our B vote),
+            # precommits nil push us to r2
+            h.wait_our_vote(VOTE_TYPE_PREVOTE, 1)
+            h.stub_vote(
+                1 if h.our_idx != 1 else 2, VOTE_TYPE_PREVOTE, 1, BlockID())
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 1, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 2)
+            assert h.cs.rs.locked_block is not None  # still locked on B
+
+            # late r1 nil prevotes complete a nil polka for round 1
+            idxs = [i for i in range(4)
+                    if i != h.our_idx][1:]  # the two that hadn't voted r1
+            for i in idxs:
+                h.stub_vote(i, VOTE_TYPE_PREVOTE, 1, BlockID())
+            h.wait_event(h.unlocks)
+            assert h.cs.rs.locked_block is None
+        finally:
+            h.stop()
+
+
+# ---------------------------------------------------------------------------
+# Crash-replay: killed between completing the proposal and prevoting
+# (reference consensus/replay.go catchupReplay; state.go:1676-1690
+# signAddVote signs during replay, privval dedups)
+# ---------------------------------------------------------------------------
+
+
+class TestWALMidHeightReplay:
+    def test_replay_resigns_and_resumes_mid_height(self, tmp_path):
+        """WAL holds EndHeight(0) + our proposal + its block part but NO
+        votes — the exact state after a crash between 'received complete
+        proposal block' and prevoting. Replay must re-enter prevote AND
+        sign the prevote (replay-mode signing, privval-deduped), or the
+        height deadlocks: the replayed step swallows the rescheduled
+        NEW_HEIGHT timeout and no other timeout is pending."""
+        from tendermint_tpu.consensus.wal import WAL
+
+        cs, bus, mp, keys, bstore = make_consensus(1)
+        sub = bus.subscribe("replay-t", query_for_event(EVENT_NEW_BLOCK), 16)
+
+        # build the height-1 block+proposal exactly as decide_proposal would
+        our_addr = keys[0].pub_key().address()
+        block = cs.state.make_block(
+            1, [], None, [], our_addr, time_ns=cs.state.last_block_time)
+        block.last_commit = None
+        parts = make_part_set(block)
+        prop = Proposal(
+            height=1, round=0, block_parts_header=parts.header(),
+            pol_round=-1, pol_block_id=BlockID(),
+            timestamp=1_700_000_000_000_000_000,
+        )
+        prop.signature = keys[0].sign(prop.sign_bytes(CHAIN_ID))
+
+        wal_dir = str(tmp_path / "wal")
+        w = WAL(wal_dir)
+        w.start()  # writes EndHeight(0)
+        w.write_sync(("", ProposalMessage(prop)))
+        for i in range(parts.total()):
+            w.write_sync(("", BlockPartMessage(1, 0, parts.get_part(i))))
+        w.stop()
+
+        cs.wal = WAL(wal_dir)
+        cs.start()
+        try:
+            deadline = time.time() + 20
+            blk = None
+            while time.time() < deadline:
+                m = sub.get(timeout=0.25)
+                if m is not None:
+                    blk = m.data["block"]
+                    break
+            assert blk is not None, "chain stuck after mid-height WAL replay"
+            assert blk.header.height == 1
+            assert blk.hash() == block.hash()
+        finally:
+            cs.stop()
+            bus.stop()
