@@ -96,12 +96,29 @@ def cmd_node(args) -> int:
     print(f"Started node {node.node_key.id}  "
           f"p2p={node.transport.listen_addr}  "
           f"rpc={node.rpc_listen_addr or '-'}", flush=True)
+    exit_code = 0
     try:
         while not stop["flag"]:
             time.sleep(0.2)
+    except BaseException:
+        # report the crash BEFORE any hard exit below — the supervisor
+        # must see the traceback and a non-zero status
+        import traceback
+
+        traceback.print_exc()
+        exit_code = 1
     finally:
         node.stop()
-    return 0
+        # the verify-warmup daemon thread may be inside a native XLA
+        # compile; normal interpreter teardown while that call is live
+        # can segfault. After node.stop(), exit without running teardown
+        # (DBs/WAL already fsynced) — preserving the exit status.
+        warm = getattr(node, "_verify_warmup_thread", None)
+        if warm is not None and warm.is_alive():
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(exit_code)
+    return exit_code
 
 
 def cmd_testnet(args) -> int:

@@ -65,6 +65,17 @@ def db_provider(name: str, backend: str, db_dir: str) -> DB:
         from ..libs.nativedb import NativeDB
 
         return NativeDB(os.path.join(db_dir, name + ".ndb"))
+    if backend == "remotedb":
+        # gRPC-served stores (reference libs/db/remotedb): the node's
+        # DBs live on a RemoteDBServer at TM_REMOTEDB_ADDR
+        from ..libs.remotedb import RemoteDB
+
+        addr = os.environ.get("TM_REMOTEDB_ADDR")
+        if not addr:
+            raise ValueError("db_backend=remotedb requires TM_REMOTEDB_ADDR")
+        return RemoteDB(
+            addr, name=name,
+            backend=os.environ.get("TM_REMOTEDB_BACKEND", "memdb"))
     return FileDB(os.path.join(db_dir, name + ".db"))
 
 
@@ -226,7 +237,35 @@ class Node:
             max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
             flush_throttle=config.p2p.flush_throttle_timeout,
         )
-        self.transport = MultiplexTransport(node_info, node_key)
+        # ABCI-query-based peer filters (reference node/node.go:378-416):
+        # when filter_peers is set the app vets every connection via
+        # /p2p/filter/addr/<addr> (pre-handshake) and /p2p/filter/id/<id>
+        # (post-handshake); a non-zero response code rejects the peer
+        conn_filters = []
+        peer_filters = []
+        if config.base.filter_peers:
+            from ..abci.types import RequestQuery
+            from ..p2p.transport import RejectedError
+
+            def _abci_addr_filter(_conn, remote: str) -> None:
+                res = self.proxy_app.query.query(
+                    RequestQuery(path=f"/p2p/filter/addr/{remote}"))
+                if res.code != 0:
+                    raise RejectedError(
+                        f"app rejected addr {remote}: code {res.code}")
+
+            def _abci_id_filter(their_info) -> None:
+                res = self.proxy_app.query.query(
+                    RequestQuery(path=f"/p2p/filter/id/{their_info.id}"))
+                if res.code != 0:
+                    raise RejectedError(
+                        f"app rejected id {their_info.id[:8]}: code {res.code}")
+
+            conn_filters.append(_abci_addr_filter)
+            peer_filters.append(_abci_id_filter)
+
+        self.transport = MultiplexTransport(
+            node_info, node_key, conn_filters=conn_filters)
         # peer trust scoring (p2p/trust.py; reference p2p/trust/store.go):
         # persisted per-peer metrics the switch consults on admission and
         # persistent-peer reconnects
@@ -242,6 +281,7 @@ class Node:
             max_outbound=config.p2p.max_num_outbound_peers,
             metrics=self.metrics.p2p,
             trust_store=self.trust_store,
+            peer_filters=peer_filters,
         )
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
@@ -281,6 +321,7 @@ class Node:
         self._stopped.clear()
         self.event_bus.start()
         self.indexer_service.start()
+        self._start_verify_warmup()
 
         if self.config.rpc.laddr:
             self._start_rpc()
@@ -329,6 +370,36 @@ class Node:
             ghost, _, gport = gaddr.rpartition(":")
             self._grpc_server = BroadcastAPIServer(env, ghost or "127.0.0.1", int(gport))
             self._grpc_server.start()
+
+    def _start_verify_warmup(self) -> None:
+        """Pre-compile the hot TPU verify-kernel bucket shapes on a daemon
+        thread so the 20-40s first-compile cost never lands inside the
+        live vote path (crypto/jaxed25519/verify.warmup). Failures are
+        non-fatal: the kernel compiles lazily on first use instead.
+        Skipped entirely when the crypto backend is the host OpenSSL path
+        ("cpu" — the jax kernels would never run) or TM_TPU_WARMUP=0."""
+        def _go():
+            try:
+                from ..crypto import batch as _batch
+                from ..crypto.jaxed25519.verify import warmup
+
+                if (os.environ.get("TM_TPU_WARMUP", "1") == "0"
+                        or _batch.default_backend_name() == "cpu"):
+                    LOG.info("verify warmup disabled (backend/env)")
+                    return
+
+                env = os.environ.get("TM_TPU_WARMUP_BUCKETS")
+                buckets = (tuple(int(x) for x in env.split(",") if x)
+                           if env else (8, 16, 64))
+                warmup(buckets=buckets)
+                self._verify_warmed = True
+            except Exception as e:  # noqa: BLE001 - warmup is best-effort
+                LOG.info("verify warmup skipped: %s", e)
+
+        self._verify_warmed = False
+        t = threading.Thread(target=_go, name="verify-warmup", daemon=True)
+        t.start()
+        self._verify_warmup_thread = t
 
     def _start_prof(self) -> None:
         """pprof-equivalent profile endpoint (reference node/node.go:468-474)."""

@@ -43,6 +43,7 @@ class Switch:
         max_outbound: int = 10,
         metrics=None,
         trust_store=None,
+        peer_filters=None,
     ):
         from ..metrics import P2PMetrics
 
@@ -51,6 +52,10 @@ class Switch:
         # p2p/trust/metric.go): errors decay a peer's score, a
         # low-scoring peer is refused admission and not reconnected
         self.trust = trust_store
+        # post-handshake peer filters (reference node/node.go:399-415
+        # PeerFilterFunc): callables taking NodeInfo, raising to reject —
+        # e.g. the ABCI /p2p/filter/id query when filter_peers is set
+        self.peer_filters = list(peer_filters or [])
         self.transport = transport
         self.mconfig = mconfig
         self.reactors: Dict[str, Reactor] = {}
@@ -179,6 +184,13 @@ class Switch:
     def _add_peer_conn(
         self, sc, their_info: NodeInfo, remote: str, outbound: bool, persistent: bool = False
     ) -> Optional[Peer]:
+        for f in self.peer_filters:
+            try:
+                f(their_info)
+            except Exception as e:  # noqa: BLE001 - any raise means reject
+                LOG.info("peer %s rejected by filter: %s", their_info.id[:8], e)
+                sc.close()
+                return None
         persistent = persistent or their_info.id in self.persistent_addrs
         peer = Peer(
             sc,

@@ -14,6 +14,7 @@ import queue
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 from urllib.request import Request, urlopen
 
@@ -146,6 +147,7 @@ class WSClient:
         self._send_lock = threading.Lock()
         self._closed = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_rx = time.time()
 
     def connect(self, timeout: float = 10.0) -> None:
         self._sock = socket.create_connection((self.host, self.port),
@@ -214,6 +216,7 @@ class WSClient:
             message = b""
             while not self._closed.is_set():
                 hdr = self._recv_exact(2)
+                self._last_rx = time.time()
                 fin = hdr[0] & 0x80
                 opcode = hdr[0] & 0x0F
                 ln = hdr[1] & 0x7F
@@ -276,3 +279,137 @@ class WSClient:
             return self.events.get(timeout=timeout)
         except queue.Empty:
             return None
+
+
+class ReconnectingWSClient(WSClient):
+    """WSClient that survives server restarts (reference
+    rpc/lib/client/ws_client.go:47-62,108): when the read loop dies it
+    redials with exponential backoff + jitter up to
+    max_reconnect_attempts, re-issues every recorded subscription, and
+    invokes on_reconnect — so long-lived consumers (tm-monitor) keep
+    receiving events across node restarts without their own retry
+    plumbing."""
+
+    def __init__(self, addr: str,
+                 on_event: Optional[Callable[[dict], None]] = None,
+                 max_reconnect_attempts: int = 25,
+                 on_reconnect: Optional[Callable[[], None]] = None,
+                 ping_period: float = 5.0,
+                 pong_timeout: float = 12.0,
+                 backoff_scale: float = 1.0):
+        super().__init__(addr, on_event)
+        self.max_reconnect_attempts = max_reconnect_attempts
+        self.on_reconnect = on_reconnect
+        self.ping_period = ping_period
+        self.pong_timeout = pong_timeout
+        self.backoff_scale = backoff_scale
+        self._subs: list[str] = []
+        self._want_close = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._ping_thread: Optional[threading.Thread] = None
+        self.reconnects = 0
+
+    def connect(self, timeout: float = 10.0) -> None:
+        super().connect(timeout)
+        if self._monitor_thread is None:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, name="ws-reconnect", daemon=True)
+            self._monitor_thread.start()
+        if self._ping_thread is None:
+            self._ping_thread = threading.Thread(
+                target=self._ping_loop, name="ws-keepalive", daemon=True)
+            self._ping_thread.start()
+
+    def _ping_loop(self) -> None:
+        """Client-side keepalive (ws_client.go pingPeriod/pongWait): a
+        half-open TCP connection — e.g. the server restarted without our
+        side seeing a FIN — would otherwise never error, so the read loop
+        would wait forever and reconnect would never trigger. Ping every
+        ping_period; if nothing (pong or data) arrives within
+        pong_timeout, kill the socket so the read loop dies and the
+        reconnect monitor takes over."""
+        while not self._want_close.wait(self.ping_period):
+            if self._closed.is_set():
+                continue  # reconnect monitor is on it
+            try:
+                self._send_frame(b"", opcode=0x9)
+            except Exception:  # noqa: BLE001 - send failure = dead conn
+                pass
+            if time.time() - self._last_rx > self.pong_timeout:
+                sock = self._sock
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+    def subscribe(self, query: str, timeout: float = 10.0) -> None:
+        super().subscribe(query, timeout)
+        if query not in self._subs:
+            self._subs.append(query)
+
+    def unsubscribe(self, query: str, timeout: float = 10.0) -> None:
+        super().unsubscribe(query, timeout)
+        if query in self._subs:
+            self._subs.remove(query)
+
+    def close(self) -> None:
+        self._want_close.set()
+        super().close()
+
+    def is_connected(self) -> bool:
+        return not self._closed.is_set()
+
+    # -- reconnect machinery -------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        import random
+
+        while not self._want_close.is_set():
+            self._closed.wait()
+            if self._want_close.is_set():
+                return
+            redialed = False
+            for attempt in range(self.max_reconnect_attempts):
+                # 1<<attempt seconds with jitter, capped at 10s AFTER
+                # scaling (ws_client.go:108); backoff_scale lets latency-
+                # sensitive consumers (monitors, tests) redial faster
+                delay = min(
+                    (1 << min(attempt, 30)) * (0.5 + random.random() * 0.5)
+                    * self.backoff_scale,
+                    10.0,
+                )
+                if self._want_close.wait(delay):
+                    return
+                try:
+                    self._redial()
+                    redialed = True
+                    break
+                except Exception:  # noqa: BLE001 - keep backing off
+                    continue
+            if not redialed:
+                return  # attempts exhausted; stays closed
+            self.reconnects += 1
+            try:
+                for q in list(self._subs):
+                    super().subscribe(q)
+                if self.on_reconnect is not None:
+                    self.on_reconnect()
+            except Exception:  # noqa: BLE001 - next death triggers retry
+                continue
+
+    def _redial(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # drop stale responses so post-reconnect calls pair correctly
+        while True:
+            try:
+                self.responses.get_nowait()
+            except queue.Empty:
+                break
+        self._closed.clear()
+        self._last_rx = time.time()
+        WSClient.connect(self, timeout=5.0)

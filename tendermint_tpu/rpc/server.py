@@ -51,6 +51,13 @@ class RPCServer:
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+        # live websocket connections: ThreadingHTTPServer.shutdown()
+        # only stops the accept loop — established websockets would keep
+        # being served (answering pings!) by their daemon threads, so a
+        # "stopped" node would look alive to subscribed clients and
+        # their auto-reconnect would never fire
+        self._ws_conns: set = set()
+        self._ws_lock = threading.Lock()
 
     @property
     def listen_addr(self) -> str:
@@ -67,6 +74,18 @@ class RPCServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        with self._ws_lock:
+            conns = list(self._ws_conns)
+        for c in conns:
+            c.close()
+
+    def _ws_register(self, conn) -> None:
+        with self._ws_lock:
+            self._ws_conns.add(conn)
+
+    def _ws_unregister(self, conn) -> None:
+        with self._ws_lock:
+            self._ws_conns.discard(conn)
 
     # -- dispatch ------------------------------------------------------
 
@@ -186,7 +205,11 @@ def _make_handler(server: RPCServer):
             self.end_headers()
             self.close_connection = True
             conn = WSConn(self.connection, server)
-            conn.serve()  # blocks for the life of the ws conn
+            server._ws_register(conn)
+            try:
+                conn.serve()  # blocks for the life of the ws conn
+            finally:
+                server._ws_unregister(conn)
 
     return Handler
 
@@ -288,6 +311,19 @@ class WSConn:
                 self.sock.close()
             except OSError:
                 pass
+
+    def close(self) -> None:
+        """Tear the connection down from outside (server stop): a FIN
+        reaches the client so its read loop exits promptly."""
+        self._closed.set()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
     def _dispatch(self, req: dict) -> None:
         if not isinstance(req, dict) or "method" not in req:

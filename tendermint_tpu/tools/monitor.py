@@ -2,9 +2,13 @@
 tools/tm-monitor/).
 
 Tracks N nodes over RPC + websocket NewBlock subscriptions
-(monitor/monitor.go + eventmeter): per-node height/latency/uptime and
-network-wide health (all nodes within one block of each other).
-Library-first (Monitor class) with a small curses-free CLI printer.
+(monitor/monitor.go + eventmeter/eventmeter.go): per-node height,
+block latency (EWMA), event-rate meters, real uptime accounting and
+network-wide health (all nodes online and within one block of each
+other). Websockets auto-reconnect across node restarts
+(rpc.client.ReconnectingWSClient), so a bounced node shows a dip in
+uptime, not a dead monitor. Library-first (Monitor class) with a small
+curses-free CLI printer.
 """
 
 from __future__ import annotations
@@ -15,7 +19,34 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from ..rpc.client import HTTPClient, WSClient
+from ..rpc.client import HTTPClient, ReconnectingWSClient
+
+
+class EventMeter:
+    """Per-event-type rate + latency meter (eventmeter.go:81): counts,
+    a 1-minute EWMA of events/sec, and an EWMA of the supplied latency
+    samples. Thread-safe for one writer."""
+
+    def __init__(self, alpha: float = 0.2):
+        self.count = 0
+        self.rate_1m = 0.0  # events/sec, EWMA
+        self.latency_ms = 0.0  # EWMA of observed latencies
+        self._alpha = alpha
+        self._last_t: Optional[float] = None
+
+    def mark(self, latency_ms: Optional[float] = None) -> None:
+        now = time.time()
+        self.count += 1
+        if self._last_t is not None:
+            dt = max(now - self._last_t, 1e-6)
+            inst = 1.0 / dt
+            self.rate_1m += self._alpha * (inst - self.rate_1m)
+        self._last_t = now
+        if latency_ms is not None:
+            if self.latency_ms == 0.0:
+                self.latency_ms = latency_ms
+            else:
+                self.latency_ms += self._alpha * (latency_ms - self.latency_ms)
 
 
 @dataclass
@@ -27,17 +58,43 @@ class NodeStatus:
     online: bool = False
     height: int = 0
     last_block_time_ns: int = 0
-    block_latency_ms: float = 0.0  # our-clock arrival delta
+    block_latency_ms: float = 0.0  # EWMA of our-clock arrival delta
     blocks_seen: int = 0
+    ws_reconnects: int = 0
     first_seen: float = field(default_factory=time.time)
     last_seen: float = 0.0
+    # real uptime accounting: accumulated online seconds over the
+    # observation window (monitor/node.go Online/Uptime)
+    _online_since: Optional[float] = None
+    _online_accum: float = 0.0
+    block_meter: EventMeter = field(default_factory=EventMeter)
+
+    def mark_online(self) -> None:
+        now = time.time()
+        self.last_seen = now
+        if not self.online:
+            self.online = True
+            self._online_since = now
+
+    def mark_offline(self) -> None:
+        if self.online and self._online_since is not None:
+            self._online_accum += time.time() - self._online_since
+            self._online_since = None
+        self.online = False
 
     @property
     def uptime_pct(self) -> float:
-        if self.last_seen == 0:
-            return 0.0
-        window = max(self.last_seen - self.first_seen, 1e-9)
-        return 100.0 if self.online else 0.0  # simple: online-now
+        now = time.time()
+        window = max(now - self.first_seen, 1e-9)
+        up = self._online_accum
+        if self.online and self._online_since is not None:
+            up += now - self._online_since
+        return min(100.0, 100.0 * up / window)
+
+    @property
+    def avg_block_interval_s(self) -> float:
+        r = self.block_meter.rate_1m
+        return 1.0 / r if r > 1e-9 else 0.0
 
 
 HEALTH_FULL = "full"  # all nodes online + heights within 1
@@ -46,14 +103,15 @@ HEALTH_DEAD = "dead"  # no node responding
 
 
 class Monitor:
-    """monitor/monitor.go: poll status + subscribe to NewBlock."""
+    """monitor/monitor.go: poll status + subscribe to NewBlock with
+    auto-reconnecting websockets."""
 
     def __init__(self, addrs: List[str], poll_interval: float = 1.0):
         self.nodes: Dict[str, NodeStatus] = {
             a: NodeStatus(addr=a) for a in addrs
         }
         self.poll_interval = poll_interval
-        self._ws: Dict[str, WSClient] = {}
+        self._ws: Dict[str, ReconnectingWSClient] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -74,28 +132,38 @@ class Monitor:
     def _watch_node(self, addr: str) -> None:
         ns = self.nodes[addr]
         client = HTTPClient(addr, timeout=2.0)
-        ws: Optional[WSClient] = None
+        ws: Optional[ReconnectingWSClient] = None
         while not self._stop.is_set():
             try:
                 st = client.status()
-                ns.online = True
-                ns.last_seen = time.time()
+                ns.mark_online()
                 ns.moniker = st["node_info"]["moniker"]
+                # trust status: a node wiped/rolled back and restarted
+                # must not be reported at its stale high-water mark
                 ns.height = int(st["sync_info"]["latest_block_height"])
                 ns.last_block_time_ns = int(
                     st["sync_info"]["latest_block_time"])
                 if ws is None:
-                    ws = WSClient(addr, on_event=lambda ev, a=addr:
-                                  self._on_block(a, ev))
-                    ws.connect(timeout=2.0)
-                    ws.subscribe("tm.event = 'NewBlock'")
+                    ws = ReconnectingWSClient(
+                        addr,
+                        on_event=lambda ev, a=addr: self._on_block(a, ev),
+                        max_reconnect_attempts=10**6,
+                        ping_period=2.0, pong_timeout=5.0,
+                        backoff_scale=0.1,  # availability monitor: redial fast
+                    )
+                    try:
+                        ws.connect(timeout=2.0)
+                        ws.subscribe("tm.event = 'NewBlock'")
+                    except Exception:
+                        # a half-set-up client has no reconnect machinery
+                        # running — drop it entirely and retry next poll
+                        ws.close()
+                        ws = None
+                        raise
                     self._ws[addr] = ws
+                ns.ws_reconnects = ws.reconnects
             except Exception:  # noqa: BLE001 - node down: mark + retry
-                ns.online = False
-                if ws is not None:
-                    ws.close()
-                    ws = None
-                    self._ws.pop(addr, None)
+                ns.mark_offline()
             self._stop.wait(self.poll_interval)
 
     def _on_block(self, addr: str, ev: dict) -> None:
@@ -107,10 +175,10 @@ class Monitor:
         ns.blocks_seen += 1
         ns.height = max(ns.height, int(header["height"]))
         block_t_ns = int(header["time"])
-        ns.block_latency_ms = max(
-            (time.time_ns() - block_t_ns) / 1e6, 0.0)
-        ns.last_seen = time.time()
-        ns.online = True
+        latency = max((time.time_ns() - block_t_ns) / 1e6, 0.0)
+        ns.block_meter.mark(latency)
+        ns.block_latency_ms = ns.block_meter.latency_ms
+        ns.mark_online()
 
     # -- network health (monitor/network.go:NodeIsDown etc.) -----------
 
@@ -127,10 +195,16 @@ class Monitor:
     def network_height(self) -> int:
         return max((n.height for n in self.nodes.values()), default=0)
 
+    def avg_block_time_s(self) -> float:
+        vals = [n.avg_block_interval_s for n in self.nodes.values()
+                if n.avg_block_interval_s > 0]
+        return sum(vals) / len(vals) if vals else 0.0
+
     def snapshot(self) -> dict:
         return {
             "health": self.health(),
             "height": self.network_height(),
+            "avg_block_time_s": round(self.avg_block_time_s(), 2),
             "nodes": [
                 {
                     "addr": n.addr,
@@ -139,6 +213,9 @@ class Monitor:
                     "height": n.height,
                     "blocks_seen": n.blocks_seen,
                     "block_latency_ms": round(n.block_latency_ms, 1),
+                    "blocks_per_s": round(n.block_meter.rate_1m, 3),
+                    "uptime_pct": round(n.uptime_pct, 1),
+                    "ws_reconnects": n.ws_reconnects,
                 }
                 for n in self.nodes.values()
             ],
@@ -159,12 +236,14 @@ def main(argv=None) -> int:
         while True:
             time.sleep(args.interval)
             snap = mon.snapshot()
-            print(f"health={snap['health']} height={snap['height']}")
+            print(f"health={snap['health']} height={snap['height']} "
+                  f"avg_block_time={snap['avg_block_time_s']}s")
             for n in snap["nodes"]:
                 state = "UP" if n["online"] else "DOWN"
                 print(f"  {n['moniker'] or n['addr']:<20} {state:<5} "
                       f"h={n['height']:<8} blocks={n['blocks_seen']:<6} "
-                      f"lat={n['block_latency_ms']}ms")
+                      f"lat={n['block_latency_ms']}ms "
+                      f"up={n['uptime_pct']}% rc={n['ws_reconnects']}")
     except KeyboardInterrupt:
         mon.stop()
     return 0

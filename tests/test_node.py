@@ -67,6 +67,37 @@ def test_single_node_commits_blocks(tmp_path):
         node.stop()
 
 
+def test_node_start_warms_verify_kernel(tmp_path, monkeypatch):
+    """Node.start() must pre-compile the hot verify-kernel bucket shapes
+    on a background thread (verify.warmup) so the first live vote batch
+    never pays the device compile inside the consensus path."""
+    from tendermint_tpu.crypto import batch
+    from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+    # one bucket keeps the 8-virtual-device CPU compile inside the timeout
+    monkeypatch.setenv("TM_TPU_WARMUP_BUCKETS", "8")
+    monkeypatch.setenv("TM_TPU_WARMUP", "1")
+    # warmup is gated off for the "cpu" (OpenSSL) backend; other suites in
+    # this process may have pinned it — force the adaptive backend here
+    prev_backend = batch.default_backend_name()
+    batch.set_default_backend("adaptive")
+    c = make_config(tmp_path, "warm")
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    try:
+        node._verify_warmup_thread.join(timeout=240)
+        assert node._verify_warmed
+        # the warmed shape is actually in the jit cache: a warmup() call
+        # for the same bucket must not add compiles
+        before = V._jitted_packed.cache_info().misses
+        V.warmup(buckets=(8,))
+        assert V._jitted_packed.cache_info().misses == before
+    finally:
+        node.stop()
+        batch.set_default_backend(prev_backend)
+
+
 def test_node_restart_resumes(tmp_path):
     """Stop after a few blocks, restart from disk (WAL + stores + app
     handshake), and confirm the chain continues from where it left off."""
@@ -137,6 +168,63 @@ def test_two_node_net(tmp_path):
                 if msg is not None:
                     height = msg.data["block"].header.height
             assert height >= 3, f"two-node net stalled at {height}"
+        finally:
+            n1.stop()
+    finally:
+        n0.stop()
+
+
+def test_abci_peer_filters_reject(tmp_path):
+    """With filter_peers on, a peer whose ID the app rejects via the
+    /p2p/filter/id query must be kept out of the switch (reference
+    node/node.go:378-416)."""
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.proxy import local_client_creator
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    class FilteringApp(KVStoreApplication):
+        def query(self, req):
+            from tendermint_tpu.abci import types as abci
+
+            if req.path.startswith("/p2p/filter/id/"):
+                return abci.ResponseQuery(code=1, log="id banned")
+            if req.path.startswith("/p2p/filter/addr/"):
+                return abci.ResponseQuery(code=0)
+            return super().query(req)
+
+    cs = [make_config(tmp_path, f"f{i}") for i in range(2)]
+    cs[0].base.filter_peers = True
+    pvs = []
+    for c in cs:
+        cfg.ensure_root(c.root_dir)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        pvs.append(load_or_gen_file_pv(c.base.priv_validator_path()))
+    doc = GenesisDoc(
+        chain_id="filter-chain",
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs],
+    )
+    for c in cs:
+        doc.save(c.base.genesis_path())
+
+    nk0 = NodeKey.load_or_gen(cs[0].base.node_key_path())
+    n0 = Node(cs[0], pvs[0], nk0, local_client_creator(FilteringApp()), doc)
+    n0.start()
+    try:
+        cs[1].p2p.persistent_peers = f"{n0.node_key.id}@{n0.transport.listen_addr}"
+        n1 = default_new_node(cs[1])
+        n1.start()
+        try:
+            deadline = time.time() + 8
+            while time.time() < deadline:
+                if n0.sw.peers.size() > 0:
+                    break
+                time.sleep(0.25)
+            assert n0.sw.peers.size() == 0, "banned peer was admitted"
+            assert n1.sw.peers.size() == 0
         finally:
             n1.stop()
     finally:

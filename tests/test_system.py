@@ -19,7 +19,8 @@ import pytest
 
 from tendermint_tpu.rpc.client import HTTPClient
 
-ENV = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu", JAX_PLATFORMS="cpu")
+ENV = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu", JAX_PLATFORMS="cpu",
+           TM_TPU_WARMUP="0")
 
 # the 8 fail-point sites hit during one block commit (libs/fail.py
 # wired at consensus/state.py finalize_commit + state/execution.py

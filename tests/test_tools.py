@@ -76,3 +76,116 @@ def test_monitor_detects_down():
         assert mon.health() == "dead"
     finally:
         mon.stop()
+
+
+def test_monitor_latency_uptime_two_nodes(tmp_path):
+    """eventmeter-style depth over a live 2-node localnet: per-node block
+    latency, block-rate meter and real uptime accounting appear in the
+    snapshot (reference tools/tm-monitor/eventmeter/eventmeter.go:81)."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    cs = [make_config(tmp_path, f"m{i}") for i in range(2)]
+    pvs = []
+    for c in cs:
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.ensure_root(c.root_dir)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        pvs.append(load_or_gen_file_pv(c.base.priv_validator_path()))
+    doc = GenesisDoc(
+        chain_id="mon-chain",
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(pv.get_pub_key(), 10) for pv in pvs],
+    )
+    for c in cs:
+        doc.save(c.base.genesis_path())
+    n0 = default_new_node(cs[0])
+    n0.start()
+    n1 = None
+    mon = None
+    try:
+        cs[1].p2p.persistent_peers = f"{n0.node_key.id}@{n0.transport.listen_addr}"
+        n1 = default_new_node(cs[1])
+        n1.start()
+        mon = Monitor([n0.rpc_listen_addr, n1.rpc_listen_addr],
+                      poll_interval=0.2)
+        mon.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            snap = mon.snapshot()
+            if all(n["blocks_seen"] >= 3 for n in snap["nodes"]):
+                break
+            time.sleep(0.3)
+        snap = mon.snapshot()
+        assert all(n["blocks_seen"] >= 3 for n in snap["nodes"]), snap
+        for n in snap["nodes"]:
+            assert n["online"]
+            assert n["block_latency_ms"] > 0.0
+            assert n["blocks_per_s"] > 0.0
+            assert n["uptime_pct"] > 50.0
+        assert snap["avg_block_time_s"] > 0.0
+    finally:
+        if mon is not None:
+            mon.stop()
+        if n1 is not None:
+            n1.stop()
+        n0.stop()
+
+
+def test_monitor_survives_node_restart(tmp_path):
+    """The monitor's reconnecting websocket must pick the node back up
+    after a restart on the same RPC port and keep counting blocks
+    (reference rpc/lib/client/ws_client.go auto-reconnect)."""
+    import socket as _socket
+
+    from tendermint_tpu.node import default_new_node as new_node
+
+    # pre-pick a fixed free port so the restarted node reuses it
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    c = make_config(tmp_path, "r0")
+    c.rpc.laddr = f"tcp://127.0.0.1:{port}"
+    init_files(c)
+    node = new_node(c)
+    node.start()
+    mon = Monitor([f"127.0.0.1:{port}"], poll_interval=0.2)
+    mon.start()
+    node2 = None
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if mon.snapshot()["nodes"][0]["blocks_seen"] >= 2:
+                break
+            time.sleep(0.2)
+        seen_before = mon.snapshot()["nodes"][0]["blocks_seen"]
+        assert seen_before >= 2
+
+        node.stop()
+        deadline = time.time() + 10
+        while time.time() < deadline and mon.snapshot()["nodes"][0]["online"]:
+            time.sleep(0.2)
+        assert not mon.snapshot()["nodes"][0]["online"]
+
+        node2 = new_node(c)
+        node2.start()
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            snap = mon.snapshot()["nodes"][0]
+            if snap["online"] and snap["blocks_seen"] >= seen_before + 2:
+                break
+            time.sleep(0.3)
+        snap = mon.snapshot()["nodes"][0]
+        assert snap["online"], "monitor never saw the restarted node"
+        assert snap["blocks_seen"] >= seen_before + 2, (
+            f"websocket did not resume after restart: {snap}")
+    finally:
+        mon.stop()
+        if node2 is not None:
+            node2.stop()
+        else:
+            node.stop()
