@@ -17,6 +17,7 @@ import msgpack
 
 from . import types as abci
 from .codec import REQUEST_CODECS, RESPONSE_CODECS
+from .server import MAX_MSG_SIZE
 
 
 class ABCIClientError(Exception):
@@ -130,6 +131,8 @@ class SocketClient(Client):
             if len(hdr) < 4:
                 raise ABCIClientError("connection closed")
             (n,) = struct.unpack(">I", hdr)
+            if n > MAX_MSG_SIZE:
+                raise ABCIClientError(f"response frame too large: {n}")
             data = self._rfile.read(n)
             if len(data) < n:
                 raise ABCIClientError("truncated response")

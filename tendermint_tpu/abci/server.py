@@ -15,6 +15,11 @@ from ..libs.service import BaseService
 from . import types as abci
 from .codec import REQUEST_CODECS, RESPONSE_CODECS
 
+# frame-size ceiling for length-prefixed socket messages (reference
+# abci/types/messages.go maxMsgSize): bounds the allocation a hostile
+# 4-byte header can force on either side of the ABCI socket
+MAX_MSG_SIZE = 104857600
+
 
 class ABCIServer(BaseService):
     def __init__(self, address: str, app: abci.Application):
@@ -69,10 +74,19 @@ class ABCIServer(BaseService):
                 if len(hdr) < 4:
                     return
                 (n,) = struct.unpack(">I", hdr)
+                if n > MAX_MSG_SIZE:
+                    # a hostile 4-byte header must not drive a multi-GB
+                    # allocation (reference abci/types maxMsgSize)
+                    return
                 data = rfile.read(n)
                 if len(data) < n:
                     return
-                method, payload = msgpack.unpackb(data, raw=False)
+                try:
+                    method, payload = msgpack.unpackb(data, raw=False)
+                except Exception:  # noqa: BLE001 - hostile frame: drop conn
+                    return
+                if not isinstance(method, str):
+                    return
                 try:
                     resp = self._dispatch(method, payload)
                     out = msgpack.packb([method, resp], use_bin_type=True)

@@ -1,0 +1,382 @@
+"""Network-surface fuzzing: the byte-level decoders an adversarial peer
+can reach. Complements test_fuzz.py (WAL + query language) with the
+three surfaces it doesn't touch: the MConnection packet decoder, the
+SecretConnection frame/handshake layer, and the ABCI socket codec
+(reference fuzz targets: p2p/conn fuzzing via FuzzedConnection,
+abci/tests, and the maxMsgSize bounds in abci/types/messages.go).
+
+Invariants under hostile bytes:
+- no exception ever escapes to crash a routine thread (errors surface
+  through the connection's on_error / a closed connection),
+- no attacker-controlled length can force an unbounded allocation,
+- authenticated layers never deliver tampered plaintext,
+- the process stays healthy (subsequent good connections still work).
+"""
+
+import os
+import random
+import socket
+import struct
+import sys
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import msgpack
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_p2p_conn import _make_secret_pair, _socket_pair
+
+from tendermint_tpu.abci.client import ABCIClientError, SocketClient
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server import MAX_MSG_SIZE, ABCIServer
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.p2p.base_reactor import ChannelDescriptor
+from tendermint_tpu.p2p.conn.connection import MConnection
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+
+SEED = 0xF22
+
+
+# ---------------------------------------------------------------------------
+# MConnection packet decoder
+# ---------------------------------------------------------------------------
+
+
+class _RawPipe:
+    """Minimal conn shim for MConnection: socket on one side, raw bytes
+    injected from the test on the other."""
+
+    def __init__(self, sock):
+        self.sock = sock
+
+    def read_exact(self, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("EOF")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def write(self, data):
+        self.sock.sendall(data)
+
+    def close(self):
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+    def settimeout(self, t):
+        self.sock.settimeout(t)
+
+
+def _mconn_victim():
+    """An MConnection wired to a raw socket we control; returns
+    (attacker_socket, mconn, received, errors, error_event)."""
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    received, errors = [], []
+    err_ev = threading.Event()
+    m = MConnection(
+        _RawPipe(b),
+        [ChannelDescriptor(id=0x01, priority=1)],
+        lambda ch, msg: received.append((ch, msg)),
+        lambda e: (errors.append(e), err_ev.set()),
+    )
+    m.start()
+    return a, m, received, errors, err_ev
+
+
+class TestMConnectionFuzz:
+    def test_random_garbage_streams_error_cleanly(self):
+        rng = random.Random(SEED)
+        for trial in range(20):
+            a, m, received, errors, err_ev = _mconn_victim()
+            try:
+                blob = rng.randbytes(rng.randrange(1, 4096))
+                try:
+                    a.sendall(blob)
+                except OSError:
+                    pass  # victim already hung up mid-stream
+                # the recv routine must either ignore (short frame still
+                # buffered) or error out — never crash the process, never
+                # deliver a message on a garbage stream
+                time.sleep(0.02)
+                assert received == [] or all(
+                    isinstance(mbytes, bytes) for _, mbytes in received
+                )
+            finally:
+                m.stop()
+                a.close()
+
+    def test_hostile_length_header_is_bounded(self):
+        """A 4-byte header claiming a huge packet must error, not
+        allocate: length is capped near max_packet_msg_payload_size."""
+        a, m, received, errors, err_ev = _mconn_victim()
+        try:
+            a.sendall(struct.pack("<I", 0xFFFFFFFF))
+            assert err_ev.wait(5.0), "oversize header not rejected"
+            assert received == []
+        finally:
+            m.stop()
+            a.close()
+
+    def test_valid_frame_malformed_msgpack_payloads(self):
+        """Well-framed but hostile msgpack bodies: wrong types, unknown
+        packet kinds, unknown channels, truncated arrays."""
+        rng = random.Random(SEED + 1)
+        bodies = [
+            msgpack.packb(None),
+            msgpack.packb(7),
+            msgpack.packb("str"),
+            msgpack.packb([]),
+            msgpack.packb([99]),  # unknown packet type
+            msgpack.packb([3, 0x7F, 1, b"x"]),  # unknown channel
+            msgpack.packb([3, 0x01]),  # truncated PKT_MSG
+            msgpack.packb([3, "ch", 1, b"x"]),  # non-int channel
+            msgpack.packb({"a": 1}),
+            b"\xc1",  # reserved/invalid msgpack byte
+        ]
+        for body in bodies:
+            a, m, received, errors, err_ev = _mconn_victim()
+            try:
+                a.sendall(struct.pack("<I", len(body)) + body)
+                # give the recv routine a beat; every case must end in a
+                # clean connection error (or be a harmless no-op), with
+                # nothing delivered upward
+                time.sleep(0.05)
+                assert received == []
+            finally:
+                m.stop()
+                a.close()
+
+    def test_survivor_after_fuzz_storm(self):
+        """After hostile connections die, a fresh well-behaved
+        MConnection pair still works — no cross-connection damage."""
+        from test_p2p_conn import _mconn_pair
+
+        descs = [ChannelDescriptor(id=0x01, priority=1)]
+        m1, m2, rx1, rx2, ev1, ev2 = _mconn_pair(descs)
+        try:
+            assert m1.send(0x01, b"still-alive")
+            assert ev2.wait(5.0)
+            assert rx2 == [(0x01, b"still-alive")]
+        finally:
+            m1.stop()
+            m2.stop()
+
+
+# ---------------------------------------------------------------------------
+# SecretConnection: handshake + sealed-frame layer
+# ---------------------------------------------------------------------------
+
+
+class TestSecretConnectionFuzz:
+    def test_handshake_garbage_raises_not_hangs(self):
+        rng = random.Random(SEED + 2)
+        for trial in range(8):
+            a, b = socket.socketpair()
+            a.settimeout(3.0)
+            b.settimeout(3.0)
+            result = {}
+
+            def victim():
+                try:
+                    SecretConnection(b, PrivKeyEd25519.generate())
+                    result["ok"] = True
+                except Exception as e:  # noqa: BLE001 - the invariant
+                    result["err"] = e
+
+            t = threading.Thread(target=victim, daemon=True)
+            t.start()
+            try:
+                a.sendall(rng.randbytes(rng.randrange(1, 512)))
+                a.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            t.join(timeout=6.0)
+            assert not t.is_alive(), "handshake hung on garbage"
+            assert "ok" not in result, "handshake accepted garbage"
+            a.close()
+            b.close()
+
+    def test_tampered_frames_never_yield_plaintext(self):
+        """Flip bits anywhere in a sealed frame: the AEAD must reject it
+        (exception) — reading must never return attacker-influenced
+        bytes."""
+        rng = random.Random(SEED + 3)
+        for trial in range(6):
+            sa, sb = _socket_pair()
+
+            class Tamper:
+                def __init__(self, s):
+                    self.s = s
+                    self.armed = False
+
+                def sendall(self, data):
+                    if self.armed:
+                        i = rng.randrange(len(data))
+                        data = bytearray(data)
+                        data[i] ^= 1 << rng.randrange(8)
+                        data = bytes(data)
+                    self.s.sendall(data)
+
+                def recv(self, n):
+                    return self.s.recv(n)
+
+                def settimeout(self, t):
+                    self.s.settimeout(t)
+
+                def close(self):
+                    self.s.close()
+
+                def shutdown(self, how):
+                    self.s.shutdown(how)
+
+            tap = Tamper(sa)
+            out = {}
+
+            def server():
+                try:
+                    sc = SecretConnection(tap, PrivKeyEd25519.generate())
+                    tap.armed = True  # handshake clean; tamper data frames
+                    sc.write(b"secret-payload-" * 10)
+                except Exception as e:  # noqa: BLE001
+                    out["werr"] = e
+
+            t = threading.Thread(target=server, daemon=True)
+            t.start()
+            got = {}
+
+            def client():
+                try:
+                    sc2 = SecretConnection(sb, PrivKeyEd25519.generate())
+                    got["data"] = sc2.read_exact(150)
+                except Exception as e:  # noqa: BLE001
+                    got["rerr"] = e
+
+            t2 = threading.Thread(target=client, daemon=True)
+            t2.start()
+            t.join(6.0)
+            t2.join(6.0)
+            assert "rerr" in got, "tampered frame was accepted"
+            assert "data" not in got
+            sa.close()
+            sb.close()
+
+    def test_truncated_frame_errors(self):
+        """EOF mid-frame surfaces as a clean connection error."""
+        sc1, sc2, _, _ = _make_secret_pair()
+        sc1._conn.sendall(b"\x01" * 100)  # less than one sealed frame
+        sc1._conn.shutdown(socket.SHUT_WR)
+        sc2.settimeout(3.0)
+        with pytest.raises(Exception):
+            sc2.read_exact(1)
+        sc1.close()
+        sc2.close()
+
+
+# ---------------------------------------------------------------------------
+# ABCI socket codec
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def abci_server():
+    srv = ABCIServer("127.0.0.1:0", KVStoreApplication())
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _abci_addr(srv):
+    return f"127.0.0.1:{srv.local_port()}"
+
+
+class TestABCISocketFuzz:
+    def test_garbage_frames_do_not_kill_server(self, abci_server):
+        rng = random.Random(SEED + 4)
+        for trial in range(10):
+            s = socket.create_connection(
+                ("127.0.0.1", abci_server.local_port()), timeout=3.0)
+            body = rng.randbytes(rng.randrange(1, 256))
+            try:
+                s.sendall(struct.pack(">I", len(body)) + body)
+                s.settimeout(0.5)
+                try:
+                    s.recv(4096)
+                except (TimeoutError, OSError):
+                    pass
+            finally:
+                s.close()
+        # the server survives and serves a real client
+        c = SocketClient(_abci_addr(abci_server))
+        assert c.echo("ping") == "ping"
+        c.close()
+
+    def test_hostile_length_is_rejected_not_allocated(self, abci_server):
+        """A 0xFFFFFFFF length must close the connection (MAX_MSG_SIZE),
+        never attempt a 4GB read."""
+        s = socket.create_connection(
+            ("127.0.0.1", abci_server.local_port()), timeout=3.0)
+        s.sendall(struct.pack(">I", 0xFFFFFFFF) + b"x" * 64)
+        s.settimeout(3.0)
+        assert s.recv(4) == b"", "connection not closed on oversize frame"
+        s.close()
+        c = SocketClient(_abci_addr(abci_server))
+        assert c.echo("ok") == "ok"
+        c.close()
+
+    def test_mutated_valid_requests(self, abci_server):
+        """Bit-flip real request frames: the server must answer with an
+        exception frame or drop the connection — and keep serving."""
+        rng = random.Random(SEED + 5)
+        valid = msgpack.packb(["check_tx", b"k=v"], use_bin_type=True)
+        for trial in range(25):
+            frame = bytearray(struct.pack(">I", len(valid)) + valid)
+            i = rng.randrange(4, len(frame))  # keep the length sane
+            frame[i] ^= 1 << rng.randrange(8)
+            s = socket.create_connection(
+                ("127.0.0.1", abci_server.local_port()), timeout=3.0)
+            try:
+                s.sendall(bytes(frame))
+                s.settimeout(0.5)
+                try:
+                    s.recv(4096)
+                except (TimeoutError, OSError):
+                    pass
+            finally:
+                s.close()
+        c = SocketClient(_abci_addr(abci_server))
+        assert c.echo("survivor") == "survivor"
+        c.close()
+
+    def test_client_rejects_oversize_response_header(self):
+        """The CLIENT side is bounded too: a hostile app claiming a
+        multi-GB response must raise, not allocate."""
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+
+        def evil_app():
+            conn, _ = lst.accept()
+            conn.recv(4096)  # swallow the request
+            conn.sendall(struct.pack(">I", 0xFFFFFFFE) + b"z" * 16)
+            time.sleep(0.5)
+            conn.close()
+
+        t = threading.Thread(target=evil_app, daemon=True)
+        t.start()
+        c = SocketClient(f"127.0.0.1:{port}", timeout=3.0)
+        with pytest.raises(ABCIClientError):
+            c.echo("hi")
+        c.close()
+        lst.close()
+        assert MAX_MSG_SIZE < 0xFFFFFFFE
