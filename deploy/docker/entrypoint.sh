@@ -1,0 +1,14 @@
+#!/bin/sh
+# Init-on-first-run entrypoint (reference DOCKER/Dockerfile CMD +
+# docs/examples): a mounted empty $TMHOME gets a fresh single-validator
+# setup; an existing config/genesis.json is left untouched.
+set -e
+
+TMHOME="${TMHOME:-/tendermint_tpu}"
+
+if [ ! -f "$TMHOME/config/genesis.json" ]; then
+    echo "entrypoint: no genesis found, initializing $TMHOME"
+    tendermint-tpu --home "$TMHOME" init ${CHAIN_ID:+--chain-id "$CHAIN_ID"}
+fi
+
+exec tendermint-tpu --home "$TMHOME" "$@"

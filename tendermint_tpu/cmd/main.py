@@ -145,14 +145,46 @@ def cmd_testnet(args) -> int:
         genesis_time=time.time_ns(),
         validators=[GenesisValidator(pv.get_pub_key(), 1) for pv in pvs],
     )
+    # peer layout (reference commands/testnet.go:121-184): one host with
+    # per-node port offsets (default), one IP per node
+    # (--starting-ip-address, docker-compose subnets), or one hostname
+    # per node (--hostname-prefix, k8s StatefulSet pod DNS)
+    if args.starting_ip_address:
+        import ipaddress
+
+        try:
+            base = ipaddress.IPv4Address(args.starting_ip_address)
+        except ipaddress.AddressValueError:
+            print(f"invalid --starting-ip-address "
+                  f"{args.starting_ip_address!r}", file=sys.stderr)
+            return 1
+        if (int(base) & 0xFF) + n - 1 > 255:
+            print(f"--starting-ip-address {base} + {n} nodes overflows "
+                  "the last octet", file=sys.stderr)
+            return 1
+        peer_host = lambda i: str(ipaddress.IPv4Address(int(base) + i))
+        peer_port = lambda i: starting_port
+    elif args.hostname_prefix:
+        peer_host = lambda i: f"{args.hostname_prefix}{i}"
+        peer_port = lambda i: starting_port
+    else:
+        peer_host = lambda i: "127.0.0.1"
+        peer_port = lambda i: starting_port + 2 * i
     peers = ",".join(
-        f"{node_keys[i].id}@127.0.0.1:{starting_port + 2 * i}"
+        f"{node_keys[i].id}@{peer_host(i)}:{peer_port(i)}"
         for i in range(n)
     )
+    per_node_ips = bool(args.starting_ip_address or args.hostname_prefix)
     for i, (root, c) in enumerate(roots):
         c.base.moniker = f"node{i}"
-        c.p2p.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i}"
-        c.rpc.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i + 1}"
+        if per_node_ips:
+            # every node gets its own address, so all bind the same
+            # ports: p2p on starting_port, rpc on the next one
+            c.p2p.laddr = f"tcp://0.0.0.0:{starting_port}"
+            c.rpc.laddr = f"tcp://0.0.0.0:{starting_port + 1}"
+        else:
+            c.p2p.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i}"
+            c.rpc.laddr = f"tcp://0.0.0.0:{starting_port + 2 * i + 1}"
         c.p2p.persistent_peers = peers
         c.p2p.addr_book_strict = False
         c.base.proxy_app = args.proxy_app
@@ -308,6 +340,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--starting-port", type=int, default=26656)
     sp.add_argument("--node-dir-prefix", default="node")
     sp.add_argument("--proxy_app", default="kvstore")
+    sp.add_argument("--starting-ip-address", default="",
+                    help="one IP per node from here (docker subnets)")
+    sp.add_argument("--hostname-prefix", default="",
+                    help="one hostname per node: PREFIX0.. (k8s pods)")
     sp.set_defaults(fn=cmd_testnet)
 
     sub.add_parser("gen_validator",

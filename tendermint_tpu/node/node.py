@@ -360,7 +360,8 @@ class Node:
         host, _, port = addr.rpartition(":")
         host = host or "127.0.0.1"
         self._rpc_server = RPCServer(
-            env, host, int(port), unsafe=self.config.rpc.unsafe
+            env, host, int(port), unsafe=self.config.rpc.unsafe,
+            max_open_connections=self.config.rpc.max_open_connections,
         )
         self._rpc_server.start()
         if self.config.rpc.grpc_laddr:

@@ -48,7 +48,45 @@ class RPCServer:
         if unsafe:
             self.routes.update(UNSAFE_ROUTES)
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+
+        outer = self
+
+        class _LimitedHTTPServer(ThreadingHTTPServer):
+            """Connection-capped server (reference
+            rpc/lib/server/http_server.go StartHTTPServer →
+            netutil.LimitListener): beyond max_open_connections,
+            new connections are closed immediately instead of
+            accumulating unbounded handler threads."""
+
+            def process_request(self, request, client_address):
+                if (outer.max_open_connections > 0
+                        and outer._open_conns_add() is False):
+                    try:
+                        request.close()
+                    except OSError:
+                        pass
+                    return
+                try:
+                    super().process_request(request, client_address)
+                except BaseException:
+                    # thread failed to start (fd/thread exhaustion):
+                    # process_request_thread never runs, so release the
+                    # slot here or it leaks forever
+                    if outer.max_open_connections > 0:
+                        outer._open_conns_done()
+                    raise
+
+            def process_request_thread(self, request, client_address):
+                try:
+                    super().process_request_thread(request, client_address)
+                finally:
+                    if outer.max_open_connections > 0:
+                        outer._open_conns_done()
+
+        self.max_open_connections = max_open_connections
+        self._open_conns = 0
+        self._open_lock = threading.Lock()
+        self._httpd = _LimitedHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
         # live websocket connections: ThreadingHTTPServer.shutdown()
@@ -78,6 +116,17 @@ class RPCServer:
             conns = list(self._ws_conns)
         for c in conns:
             c.close()
+
+    def _open_conns_add(self) -> bool:
+        with self._open_lock:
+            if self._open_conns >= self.max_open_connections:
+                return False
+            self._open_conns += 1
+            return True
+
+    def _open_conns_done(self) -> None:
+        with self._open_lock:
+            self._open_conns -= 1
 
     def _ws_register(self, conn) -> None:
         with self._ws_lock:

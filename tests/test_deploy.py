@@ -1,0 +1,127 @@
+"""Deployment story (reference DOCKER/ + tools/mintnet-kubernetes):
+manifest sanity plus the testnet generator's per-IP / per-hostname peer
+layouts that the compose and k8s manifests rely on."""
+
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", *argv],
+        capture_output=True, text=True, env=env, cwd=REPO, check=True,
+    )
+
+
+class TestManifests:
+    def test_compose_parses_and_wires_four_nodes(self):
+        with open(os.path.join(REPO, "deploy/docker/docker-compose.yml")) as f:
+            doc = yaml.safe_load(f)
+        nodes = {k: v for k, v in doc["services"].items()
+                 if k.startswith("node")}
+        assert len(nodes) == 4
+        ips = set()
+        for name, svc in nodes.items():
+            assert svc["image"] == "tendermint_tpu/localnode"
+            ips.add(svc["networks"]["localnet"]["ipv4_address"])
+            assert any("/tendermint_tpu" in v for v in svc["volumes"])
+        assert len(ips) == 4  # distinct fixed IPs on the subnet
+        assert "localnet" in doc["networks"]
+
+    def test_k8s_manifest_parses_with_quorum_budget(self):
+        with open(os.path.join(
+                REPO, "deploy/kubernetes/tendermint-tpu.yaml")) as f:
+            docs = {d["kind"]: d for d in yaml.safe_load_all(f) if d}
+        assert set(docs) == {"Service", "PodDisruptionBudget", "StatefulSet"}
+        svc, pdb, sts = (docs["Service"], docs["PodDisruptionBudget"],
+                         docs["StatefulSet"])
+        # headless (k8s wants the literal string "None"): stable pod DNS
+        assert svc["spec"]["clusterIP"] == "None"
+        assert sts["apiVersion"] == "apps/v1"
+        replicas = sts["spec"]["replicas"]
+        # the PDB must preserve a >2/3 quorum through voluntary drains
+        assert 3 * pdb["spec"]["minAvailable"] > 2 * replicas
+        ports = {p["name"]: p["containerPort"] for p in
+                 sts["spec"]["template"]["spec"]["containers"][0]["ports"]}
+        assert ports["p2p"] == 26656 and ports["rpc"] == 26657
+        # the StatefulSet name + headless service give tm-N.<svc> DNS,
+        # which is what `testnet --hostname-prefix tm-` wires into peers
+        assert sts["metadata"]["name"] == "tm"
+        assert sts["spec"]["serviceName"] == svc["metadata"]["name"]
+
+    def test_dockerfile_refs_exist(self):
+        with open(os.path.join(REPO, "deploy/docker/Dockerfile")) as f:
+            content = f.read()
+        for path in ("pyproject.toml", "tendermint_tpu", "native",
+                     "deploy/docker/entrypoint.sh"):
+            assert path in content
+            assert os.path.exists(os.path.join(REPO, path)), path
+        assert os.access(
+            os.path.join(REPO, "deploy/docker/entrypoint.sh"), os.X_OK)
+
+
+class TestTestnetLayouts:
+    def test_per_ip_layout(self, tmp_path):
+        out = tmp_path / "net"
+        _run_cli("testnet", "--v", "3", "--o", str(out),
+                 "--starting-ip-address", "192.167.10.2")
+        cfgs = []
+        for i in range(3):
+            with open(out / f"node{i}" / "config" / "config.toml") as f:
+                cfgs.append(f.read())
+        for i, c in enumerate(cfgs):
+            # every node binds the SAME ports (one IP each)...
+            assert 'laddr = "tcp://0.0.0.0:26656"' in c
+            # ...and dials each peer at its own consecutive IP
+            for j in range(3):
+                assert f"192.167.10.{2 + j}:26656" in c
+
+    def test_hostname_prefix_layout(self, tmp_path):
+        out = tmp_path / "net"
+        _run_cli("testnet", "--v", "4", "--o", str(out),
+                 "--hostname-prefix", "tm-")
+        with open(out / "node0" / "config" / "config.toml") as f:
+            c = f.read()
+        for j in range(4):
+            assert f"tm-{j}:26656" in c
+
+    def test_default_layout_same_host_ports(self, tmp_path):
+        out = tmp_path / "net"
+        _run_cli("testnet", "--v", "2", "--o", str(out))
+        with open(out / "node0" / "config" / "config.toml") as f:
+            c = f.read()
+        assert "127.0.0.1:26656" in c and "127.0.0.1:26658" in c
+
+    def test_starting_ip_validation(self, tmp_path):
+        out = tmp_path / "net"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+             "--v", "2", "--o", str(out), "--starting-ip-address", "foo"],
+            capture_output=True, text=True, env=env, cwd=REPO)
+        assert r.returncode == 1 and "invalid" in r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+             "--v", "10", "--o", str(out),
+             "--starting-ip-address", "10.0.0.250"],
+            capture_output=True, text=True, env=env, cwd=REPO)
+        assert r.returncode == 1 and "overflows" in r.stderr
+
+    def test_starting_port_honored_in_per_node_mode(self, tmp_path):
+        out = tmp_path / "net"
+        _run_cli("testnet", "--v", "2", "--o", str(out),
+                 "--hostname-prefix", "pod-", "--starting-port", "30000")
+        with open(out / "node0" / "config" / "config.toml") as f:
+            c = f.read()
+        assert 'laddr = "tcp://0.0.0.0:30000"' in c
+        assert 'laddr = "tcp://0.0.0.0:30001"' in c
+        assert "pod-1:30000" in c

@@ -216,3 +216,53 @@ def test_grpc_broadcast_api(rpc_node):
         cl.close()
     finally:
         srv.stop()
+
+
+def test_rpc_max_open_connections_enforced():
+    """Beyond max_open_connections the server closes new connections
+    immediately (reference rpc/lib/server/http_server.go via
+    netutil.LimitListener) — and frees slots when connections close."""
+    import socket as _socket
+
+    from tendermint_tpu.rpc.core import RPCEnvironment
+    from tendermint_tpu.rpc.server import RPCServer
+
+    class _StubNode:
+        def __getattr__(self, name):  # handlers are never invoked here
+            return None
+
+        class proxy_app:
+            query = None
+
+        config = None
+
+    env = RPCEnvironment.__new__(RPCEnvironment)
+    env.node = _StubNode()
+    env.event_bus = None
+    srv = RPCServer(env, "127.0.0.1", 0, max_open_connections=2)
+    srv.start()
+    host, port = srv.listen_addr.split(":")
+    try:
+        # two long-lived connections occupy both slots
+        held = []
+        for _ in range(2):
+            s = _socket.create_connection((host, int(port)), timeout=3)
+            held.append(s)
+        time.sleep(0.2)  # let the handler threads register
+        # the third is refused (closed without a response)
+        s3 = _socket.create_connection((host, int(port)), timeout=3)
+        s3.settimeout(3)
+        assert s3.recv(1) == b"", "over-limit connection was served"
+        s3.close()
+        # freeing a slot lets a new connection through
+        held.pop().close()
+        time.sleep(0.3)
+        s4 = _socket.create_connection((host, int(port)), timeout=3)
+        s4.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n\r\n")
+        s4.settimeout(3)
+        assert s4.recv(4) == b"HTTP", "freed slot was not reused"
+        s4.close()
+        for s in held:
+            s.close()
+    finally:
+        srv.stop()
