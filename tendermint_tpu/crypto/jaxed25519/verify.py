@@ -187,10 +187,13 @@ def _pack_le_rows(arr: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(packed.T).view(np.int32)
 
 
-def pack_buffer(msgs, sig_arr: np.ndarray, pk_arr: np.ndarray, ndev: int = 1):
+def pack_buffer(msgs, sig_arr: np.ndarray, pk_arr: np.ndarray, ndev: int = 1,
+                dims=None):
     """Build the single packed h2d buffer (see _verify_packed_core layout).
     Returns (buf (ROWS_AUX+mrows, bpad) int32, nb, mrows, bpad). The ONLY
-    place the layout is produced — bench/profiling code reuses it."""
+    place the layout is produced — bench/profiling code reuses it.
+    `dims=(nb, mrows, bpad)` forces the padded shape (chunked dispatch:
+    every chunk must share ONE jit key regardless of its own maxima)."""
     n = len(msgs)
     lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
     maxlen = int(lens.max()) if n else 0
@@ -199,13 +202,16 @@ def pack_buffer(msgs, sig_arr: np.ndarray, pk_arr: np.ndarray, ndev: int = 1):
     # 128 bytes (any realistic chain id) share the mrows=32 compile that
     # warmup() pre-builds — a fresh mrows key would stall the live path
     mrows = max(16, ((maxlen + 3) // 4 + 15) // 16 * 16)
-    msg_mat = np.zeros((n, mrows * 4), dtype=np.uint8)
-    pack.fill_msg_bytes(msg_mat, [bytes(m) for m in msgs], lens)
 
     bpad = _bucket(n)
     if ndev > 1:
         bpad = max(bpad, ndev)
         bpad = (bpad + ndev - 1) // ndev * ndev
+    if dims is not None:
+        nb, mrows, bpad = dims
+
+    msg_mat = np.zeros((n, mrows * 4), dtype=np.uint8)
+    pack.fill_msg_bytes(msg_mat, [bytes(m) for m in msgs], lens)
 
     buf = np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)
     buf[0, :n] = lens
@@ -247,19 +253,53 @@ def _pack_well_formed(msgs, sigs, pks):
 
 
 def verify_batch(msgs, sigs, pks, devices: int | None = None):
-    """Lists of (msg bytes, 64-byte sig, 32-byte pubkey) -> list[bool]."""
+    """Lists of (msg bytes, 64-byte sig, 32-byte pubkey) -> list[bool].
+
+    TM_TPU_VERIFY_CHUNKS=k (default 1) splits large batches into k
+    equal chunks dispatched back-to-back: chunk i+1's host->device
+    transfer overlaps chunk i's kernel, hiding min(transfer, compute)
+    per extra chunk on direct-attached TPU. All chunks share one jit
+    key (same padded shape). Only batches >= 2048 split — below that
+    the extra dispatch overhead outweighs the overlap."""
     n = len(msgs)
     if n == 0:
         return []
     sig_arr, pk_arr, ok_host = _pack_well_formed(msgs, sigs, pks)
 
     ndev = devices if devices is not None else len(jax.devices())
-    buf, nb, mrows, bpad = pack_buffer(msgs, sig_arr, pk_arr, ndev)
+    try:
+        chunks = int(os.environ.get("TM_TPU_VERIFY_CHUNKS", "1"))
+        chunk_min = int(os.environ.get("TM_TPU_VERIFY_CHUNK_MIN", "2048"))
+    except ValueError:
+        # a malformed env var must never take down verification
+        chunks, chunk_min = 1, 2048
+    if chunks < 2 or n < chunk_min or ndev > 1:
+        chunks = 1
+
+    # one jit key for every chunk, derived from GLOBAL maxima: a chunk
+    # with its own (nb, mrows, bpad) would trigger a fresh multi-second
+    # compile inside the live path, which warmup() exists to prevent
+    per = (n + chunks - 1) // chunks
+    maxlen = max((len(m) for m in msgs), default=0)
+    nb = (64 + maxlen + 17 + 127) // 128
+    mrows = max(16, ((maxlen + 3) // 4 + 15) // 16 * 16)
+    bpad = _bucket(per)
+    if ndev > 1:
+        bpad = max(bpad, ndev)
+        bpad = (bpad + ndev - 1) // ndev * ndev
     fn = _jitted_packed(nb, mrows, bpad, ndev)
-    # device_put submits the transfer asynchronously; the dispatch and the
-    # mask fetch then ride the same pipeline (one latency leg, not three)
-    mask = fn(jax.device_put(buf))
-    out = np.asarray(mask)[:n] & ok_host
+
+    masks = []
+    for lo in range(0, n, per):
+        hi = min(lo + per, n)
+        buf, _, _, _ = pack_buffer(
+            msgs[lo:hi], sig_arr[lo:hi], pk_arr[lo:hi], ndev,
+            dims=(nb, mrows, bpad))
+        # device_put + dispatch are async: the NEXT chunk's pack and
+        # h2d transfer overlap this chunk's kernel (with chunks=1 this
+        # is the plain single-dispatch pipeline)
+        masks.append((fn(jax.device_put(buf)), hi - lo))
+    out = np.concatenate([np.asarray(m)[:cn] for m, cn in masks]) & ok_host
     return [bool(v) for v in out]
 
 

@@ -433,3 +433,31 @@ def test_rlc_is_cofactored_torsion_divergence_pinned():
     # every prime-order defect still matches per-item exactly
     assert got[0] is True, "cofactored equation must accept pure torsion"
     assert got[1:] == per_item[1:]
+
+
+def test_chunked_verify_matches_single_dispatch(monkeypatch):
+    """TM_TPU_VERIFY_CHUNKS pipelines transfers against kernels; the
+    masks must be identical to the single-dispatch path, including
+    chunk-boundary alignment of the host-side canonicity bits."""
+    from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+    items = []
+    for i in range(24):
+        sk, pk = _keypair()
+        m = secrets.token_bytes(70 + i)
+        s = sk.sign(m)
+        if i % 6 == 1:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        if i == 13:
+            s = b"\x00" * 10  # malformed: ok_host must stay aligned
+        items.append((m, s, pk))
+    msgs = [m for m, _, _ in items]
+    sigs = [s for _, s, _ in items]
+    pks = [p for _, _, p in items]
+
+    want = V.verify_batch(msgs, sigs, pks, devices=1)
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNKS", "3")
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNK_MIN", "8")
+    got = V.verify_batch(msgs, sigs, pks, devices=1)
+    assert got == want
+    assert sum(want) == 20  # invalid: i in {1,7,13,19} (13 also malformed)

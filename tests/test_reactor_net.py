@@ -42,10 +42,11 @@ CHAIN_ID = "reactor-net"
 
 
 class NetNode:
-    def __init__(self, idx, doc, key, fast_sync=False):
+    def __init__(self, idx, doc, key, fast_sync=False, app_factory=None):
         db = MemDB()
         self.state = sm.load_state_from_db_or_genesis(db, doc)
-        self.conns = AppConns(local_client_creator(KVStoreApplication()))
+        app = app_factory() if app_factory is not None else KVStoreApplication()
+        self.conns = AppConns(local_client_creator(app))
         self.conns.start()
         self.mempool = Mempool(cfg.MempoolConfig(), self.conns.mempool)
         self.bus = EventBus()
@@ -105,14 +106,15 @@ class NetNode:
         self.bus.stop()
 
 
-def make_net(n):
+def make_net(n, app_factory=None):
     vs, keys = random_validator_set(n, 10)
     doc = GenesisDoc(
         chain_id=CHAIN_ID,
         genesis_time=time.time_ns() - 10**9,
         validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vs.validators],
     )
-    nodes = [NetNode(i, doc, keys[i]) for i in range(n)]
+    nodes = [NetNode(i, doc, keys[i], app_factory=app_factory)
+             for i in range(n)]
     subs = [
         node.bus.subscribe(f"t{i}", query_for_event(EVENT_NEW_BLOCK), 64)
         for i, node in enumerate(nodes)
@@ -239,6 +241,85 @@ class TestConsensusNet:
             blocks = collect_blocks(subs[0], 4, timeout=60.0)
             all_txs = [tx for b in blocks for tx in b.data.txs]
             assert b"gossip=works" in all_txs
+        finally:
+            for n in nodes:
+                n.stop()
+
+
+class TestValidatorSetChanges:
+    """Live validator-set mutation over a running network (reference
+    consensus/reactor_test.go TestReactorValidatorSetChanges +
+    TestReactorVotingPowerChange): val:<pkhex>!<power> txs through the
+    persistent kvstore take effect at h+2 while the chain keeps
+    committing."""
+
+    @staticmethod
+    def _val_tx(pub_key, power: int) -> bytes:
+        from tendermint_tpu.crypto import pubkey_to_bytes
+
+        return b"val:" + pubkey_to_bytes(pub_key).hex().encode() + b"!%d" % power
+
+    @staticmethod
+    def _wait_valset(nodes, pred, timeout=45.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(pred(n.cs.rs.validators) for n in nodes):
+                return True
+            time.sleep(0.25)
+        return False
+
+    def test_power_change_add_and_remove_validator(self):
+        from tendermint_tpu.abci.example.kvstore import (
+            PersistentKVStoreApplication,
+        )
+
+        nodes, subs = make_net(
+            4, app_factory=lambda: PersistentKVStoreApplication(MemDB()))
+        try:
+            assert len(collect_blocks(subs[0], 2, 45)) >= 2
+
+            # 1) change an existing validator's power 10 -> 26
+            target = nodes[0].cs.priv_validator
+            pk0 = target.get_pub_key()
+            addr0 = pk0.address()
+            res = nodes[1].mempool.check_tx(self._val_tx(pk0, 26))
+            assert res.code == 0
+            assert self._wait_valset(
+                nodes,
+                lambda vs: (vs.get_by_address(addr0)[1] is not None
+                            and vs.get_by_address(addr0)[1].voting_power == 26),
+            ), "power change never took effect on all nodes"
+
+            # 2) add a brand-new (non-participating) validator with small
+            # power: total 56+2, online 56 still > 2/3 — chain must live
+            new_key = PrivKeyEd25519.generate()
+            new_addr = new_key.pub_key().address()
+            res = nodes[2].mempool.check_tx(self._val_tx(new_key.pub_key(), 2))
+            assert res.code == 0
+            assert self._wait_valset(
+                nodes,
+                lambda vs: vs.get_by_address(new_addr)[1] is not None,
+            ), "new validator never joined the set"
+            assert all(len(n.cs.rs.validators) == 5 for n in nodes)
+
+            # 3) remove it again (power 0)
+            res = nodes[0].mempool.check_tx(self._val_tx(new_key.pub_key(), 0))
+            assert res.code == 0
+            assert self._wait_valset(
+                nodes,
+                lambda vs: vs.get_by_address(new_addr)[1] is None,
+            ), "validator removal never took effect"
+
+            # the chain is still committing NEW blocks on every node
+            h = nodes[0].cs.rs.height
+            for sub in subs:
+                while sub.get(timeout=0.01) is not None:
+                    pass  # drain
+            assert all(len(collect_blocks(s, 1, 30)) >= 1 for s in subs)
+            deadline = time.time() + 20
+            while nodes[0].cs.rs.height <= h and time.time() < deadline:
+                time.sleep(0.1)
+            assert nodes[0].cs.rs.height > h, "chain stalled after removal"
         finally:
             for n in nodes:
                 n.stop()
