@@ -93,7 +93,12 @@ class BaseVerifier:
 
     def verify(self, signed_header: SignedHeader) -> None:
         """Certify: right chain, known valset hash, +2/3 signed."""
-        signed_header.validate_basic(self.chain_id)
+        try:
+            signed_header.validate_basic(self.chain_id)
+        except ValueError as e:
+            # structural failures (wrong chain, commit signs a different
+            # header, ...) are verification failures to lite callers
+            raise ErrLiteVerification(str(e))
         if signed_header.height < self.height:
             raise ErrLiteVerification(
                 f"header height {signed_header.height} < verifier base "
@@ -112,6 +117,15 @@ class BaseVerifier:
             raise ErrLiteVerification(str(e))
 
 
+def _validate_full(fc, chain_id: str) -> None:
+    """validate_full with the lite error contract: structural failures
+    from a (possibly malicious) source are verification failures."""
+    try:
+        fc.validate_full(chain_id)
+    except ValueError as e:
+        raise ErrLiteVerification(str(e))
+
+
 class DynamicVerifier:
     """lite/dynamic_verifier.go:21-68.
 
@@ -126,7 +140,7 @@ class DynamicVerifier:
 
     def init_trust(self, full_commit: FullCommit) -> None:
         """Seed the trusted store (the social-consensus root of trust)."""
-        full_commit.validate_full(self.chain_id)
+        _validate_full(full_commit, self.chain_id)
         self.trusted.save_full_commit(full_commit)
 
     def verify(self, signed_header: SignedHeader) -> None:
@@ -165,7 +179,7 @@ class DynamicVerifier:
         source_fc = self.source.latest_full_commit(self.chain_id, h)
         if source_fc is None:
             raise ErrLiteVerification(f"source has no commit ≤ {h}")
-        source_fc.validate_full(self.chain_id)
+        _validate_full(source_fc, self.chain_id)
         self._verify_and_save(source_fc)
         if source_fc.height < h and signed_header is not None:
             # source is behind the target: nothing more we can do
@@ -203,7 +217,7 @@ class DynamicVerifier:
                 _verify_commit_trusting(
                     trusted_fc.next_validators or trusted_fc.validators,
                     self.chain_id, source_fc.signed_header)
-                source_fc.validate_full(self.chain_id)
+                _validate_full(source_fc, self.chain_id)
                 BaseVerifier(
                     self.chain_id, source_fc.height, source_fc.validators,
                 ).verify(source_fc.signed_header)
@@ -223,6 +237,6 @@ class DynamicVerifier:
         mid_fc = self.source.latest_full_commit(self.chain_id, mid)
         if mid_fc is None or mid_fc.height <= trusted_fc.height:
             raise ErrLiteVerification(f"source has no commit near {mid}")
-        mid_fc.validate_full(self.chain_id)
+        _validate_full(mid_fc, self.chain_id)
         self._verify_and_save(mid_fc)
         self._verify_and_save(source_fc)

@@ -22,7 +22,7 @@ from ..types.event_bus import (
     query_for_event,
 )
 from . import encoding as enc
-from .jsonrpc import ERR_INVALID_PARAMS, ERR_SERVER, RPCError
+from .jsonrpc import ERR_INVALID_PARAMS, ERR_SERVER, QuotedStr, RPCError
 
 SUBSCRIBE_TIMEOUT = 10.0  # reference rpc/core/events.go subscribeTimeout
 
@@ -68,15 +68,28 @@ def _tx_param(params: dict) -> bytes:
     tx = params.get("tx")
     if tx is None:
         raise RPCError(ERR_INVALID_PARAMS, "missing tx param")
+    if isinstance(tx, QuotedStr):
+        return tx.raw_bytes()  # quoted URI value = raw bytes (handlers.go)
     if isinstance(tx, str):
         return enc.unb64(tx)
     return bytes(tx)
+
+
+def _bool(params: dict, key: str, default: bool) -> bool:
+    """URI booleans arrive as strings: 'false'/'0'/'' must be False
+    (the reference's reflection-based URI parser parses bool args)."""
+    v = params.get(key, default)
+    if isinstance(v, str):
+        return v.strip().lower() in ("true", "1", "t")
+    return bool(v)
 
 
 def _hash_param(params: dict, key: str = "hash") -> bytes:
     h = params.get(key)
     if h is None:
         raise RPCError(ERR_INVALID_PARAMS, f"missing {key} param")
+    if isinstance(h, QuotedStr):
+        return h.raw_bytes()  # quoted URI value = raw bytes
     if isinstance(h, str):
         return bytes.fromhex(h)
     return bytes(h)
@@ -462,14 +475,16 @@ def _tx_result_json(r, h: bytes) -> dict:
 
 def abci_query(env: RPCEnvironment, params: dict) -> dict:
     data = params.get("data", "")
-    if isinstance(data, str):
+    if isinstance(data, QuotedStr):
+        data = data.raw_bytes()  # quoted URI value = raw bytes
+    elif isinstance(data, str):
         data = bytes.fromhex(data) if data else b""
     res = env.proxy_app_query.query(
         abci.RequestQuery(
             data=data,
             path=params.get("path", ""),
             height=_int(params, "height", 0) or 0,
-            prove=bool(params.get("prove", False)),
+            prove=_bool(params, "prove", False),
         )
     )
     return {

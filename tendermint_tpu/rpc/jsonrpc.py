@@ -52,3 +52,15 @@ def loads(raw: bytes) -> Any:
         return json.loads(raw)
     except (ValueError, UnicodeDecodeError) as e:
         raise RPCError(ERR_PARSE, f"parse error: {e}")
+
+
+class QuotedStr(str):
+    """A URI parameter that arrived double-quoted. The reference's URI
+    parser (rpc/lib/server/handlers.go) treats quoted values as RAW
+    strings for []byte arguments, while JSON-RPC bodies carry base64 —
+    byte-typed param handlers use this marker to tell them apart.
+    The server decodes the query string as latin-1, so raw_bytes()
+    recovers the exact percent-decoded bytes."""
+
+    def raw_bytes(self) -> bytes:
+        return self.encode("latin-1")

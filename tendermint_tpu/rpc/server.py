@@ -219,10 +219,15 @@ def _make_handler(server: RPCServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return
-            params = dict(parse_qsl(parsed.query))
-            # strip surrounding quotes the reference's URI parser accepts
+            # latin-1 round-trips every percent-decoded byte 1:1 (like
+            # Go's string-of-bytes), so binary payloads in quoted params
+            # survive; utf-8 would fold invalid sequences into U+FFFD
+            params = dict(parse_qsl(parsed.query, encoding="latin-1"))
+            # quoted URI values are RAW strings (reference handlers.go);
+            # keep the marker so byte-typed params skip base64/hex
             params = {
-                k: (v[1:-1] if len(v) >= 2 and v[0] == v[-1] == '"' else v)
+                k: (jsonrpc.QuotedStr(v[1:-1])
+                    if len(v) >= 2 and v[0] == v[-1] == '"' else v)
                 for k, v in params.items()
             }
             try:

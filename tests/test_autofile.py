@@ -1,0 +1,80 @@
+"""Rotating file groups — the WAL substrate (reference
+libs/autofile/group_test.go): rotation at head_size_limit, total-size
+pruning of the oldest chunks, ordered readback across chunk
+boundaries, and reopen-after-restart continuity."""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.libs.autofile import Group, GroupReader
+
+
+def _mk(tmp_path, **kw):
+    return Group(str(tmp_path / "wal" / "wal.log"), **kw)
+
+
+def test_rotation_at_head_size_limit(tmp_path):
+    g = _mk(tmp_path, head_size_limit=100)
+    for i in range(10):
+        g.write(b"x" * 40)
+        g.maybe_rotate()
+    paths = g.paths_in_order()
+    assert len(paths) > 1, "head never rotated"
+    assert paths[-1].endswith("wal.log")  # head last
+    for p in paths[:-1]:
+        assert os.path.getsize(p) >= 100  # rotated only past the limit
+    g.close()
+
+
+def test_readback_spans_chunks_in_order(tmp_path):
+    g = _mk(tmp_path, head_size_limit=64)
+    blob = b"".join(bytes([i]) * 17 for i in range(40))  # 680 bytes
+    for i in range(0, len(blob), 17):
+        g.write(blob[i:i + 17])
+        g.maybe_rotate()
+    r = g.reader()
+    assert r.read(len(blob)) == blob
+    assert r.read(10) == b""  # exhausted
+    g.close()
+
+
+def test_prune_drops_oldest_chunks(tmp_path):
+    g = _mk(tmp_path, head_size_limit=50, total_size_limit=160)
+    for i in range(20):
+        g.write(b"%02d" % i * 25)  # 50 bytes each
+        g.maybe_rotate()
+    paths = g.paths_in_order()
+    total = sum(os.path.getsize(p) for p in paths)
+    assert total <= 160 + 50  # bounded (head may be mid-fill)
+    # the SURVIVING chunks are the newest ones: the first chunk index
+    # present must be > 0 after pruning
+    idx = [int(p.rsplit(".", 1)[1]) for p in paths[:-1]]
+    assert idx and min(idx) > 0, f"oldest chunks not pruned: {idx}"
+    g.close()
+
+
+def test_reopen_appends_after_restart(tmp_path):
+    g = _mk(tmp_path, head_size_limit=1000)
+    g.write(b"before-crash|")
+    g.sync()
+    g.close()
+    g2 = _mk(tmp_path, head_size_limit=1000)
+    g2.write(b"after-restart")
+    g2.flush()
+    r = g2.reader()
+    assert r.read(1 << 16) == b"before-crash|after-restart"
+    g2.close()
+
+
+def test_reader_sees_rotated_history_from_fresh_group(tmp_path):
+    """A NEW Group over an existing dir (post-restart WAL replay) must
+    iterate old chunks + head in order."""
+    g = _mk(tmp_path, head_size_limit=20)
+    for word in (b"alpha,", b"bravo,", b"charlie,", b"delta"):
+        g.write(word)
+        g.maybe_rotate()
+    g.close()
+    g2 = _mk(tmp_path, head_size_limit=20)
+    assert g2.reader().read(1 << 16) == b"alpha,bravo,charlie,delta"
+    g2.close()

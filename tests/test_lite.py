@@ -193,3 +193,84 @@ def test_lite_proxy_against_live_node(tmp_path):
         if srv is not None:
             srv.stop()
         node.stop()
+
+
+def test_bisection_across_multiple_valset_changes():
+    """Rotate the valset at two separate heights; verifying the head
+    from a height-1 root must chain trust through BOTH intermediate
+    full commits (dynamic_verifier.go updateToHeight recursion)."""
+    vs1, k1 = random_validator_set(4, 10)
+    vs2, k2 = random_validator_set(4, 10)
+    vs3, k3 = random_validator_set(4, 10)
+    source = MemProvider()
+    # heights 1-2 signed by vs1; 3-5 by vs2; 6-8 by vs3
+    source.save_full_commit(make_fc(1, vs1, k1))
+    source.save_full_commit(make_fc(2, vs1, k1, next_vals=vs2))
+    source.save_full_commit(make_fc(3, vs2, k2))
+    source.save_full_commit(make_fc(5, vs2, k2, next_vals=vs3))
+    source.save_full_commit(make_fc(6, vs3, k3))
+    head = make_fc(8, vs3, k3)
+    source.save_full_commit(head)
+
+    trusted = DBProvider(MemDB())
+    dv = DynamicVerifier(CHAIN, trusted, source)
+    dv.init_trust(make_fc(1, vs1, k1, next_vals=vs1))
+    dv.verify(head.signed_header)
+    # trust chain landed in the store
+    assert trusted.latest_full_commit(CHAIN, 8).height == 8
+
+
+def test_forged_intermediate_commit_rejected():
+    """A malicious source serving an intermediate commit signed by an
+    ATTACKER valset (hash mismatch vs what the header claims) must not
+    poison the trust store — verification fails."""
+    vs1, k1 = random_validator_set(4, 10)
+    evil, ek = random_validator_set(4, 10)
+    vs3, k3 = random_validator_set(4, 10)
+    source = MemProvider()
+    # the attacker fabricates height 5 with its own valset + sigs
+    source.save_full_commit(make_fc(5, evil, ek, next_vals=vs3))
+    head = make_fc(8, vs3, k3)
+    source.save_full_commit(head)
+
+    trusted = DBProvider(MemDB())
+    dv = DynamicVerifier(CHAIN, trusted, source)
+    dv.init_trust(make_fc(1, vs1, k1))
+    with pytest.raises(ErrLiteVerification):
+        dv.verify(head.signed_header)
+    assert trusted.latest_full_commit(CHAIN, 8).height == 1  # unpoisoned
+
+
+def test_full_rotation_without_intermediates_fails():
+    """Trusted h1 under vs1; the head is signed by a DISJOINT valset and
+    the source offers no bridging commits: verification must fail
+    rather than accept an unprovable valset."""
+    vs1, k1 = random_validator_set(4, 10)
+    vs2, k2 = random_validator_set(4, 10)
+    source = MemProvider()
+    head = make_fc(9, vs2, k2)
+    source.save_full_commit(head)
+    trusted = DBProvider(MemDB())
+    dv = DynamicVerifier(CHAIN, trusted, source)
+    dv.init_trust(make_fc(1, vs1, k1))
+    with pytest.raises(ErrLiteVerification):
+        dv.verify(head.signed_header)
+
+
+def test_tampered_header_rejected():
+    """Bit-flip a header field after signing: the commit's block hash
+    no longer matches, so even the correct valset must reject."""
+    vs, keys = random_validator_set(4, 10)
+    fc = make_fc(3, vs, keys)
+    fc.signed_header.header.app_hash = b"\xEE" * 20
+    bv = BaseVerifier(CHAIN, 3, vs)
+    with pytest.raises(ErrLiteVerification):
+        bv.verify(fc.signed_header)
+
+
+def test_wrong_chain_id_rejected():
+    vs, keys = random_validator_set(4, 10)
+    fc = make_fc(3, vs, keys)
+    bv = BaseVerifier("other-chain", 3, vs)
+    with pytest.raises(ErrLiteVerification):
+        bv.verify(fc.signed_header)

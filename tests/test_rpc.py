@@ -266,3 +266,47 @@ def test_rpc_max_open_connections_enforced():
             s.close()
     finally:
         srv.stop()
+
+
+def test_uri_quoted_params_are_raw_bytes(rpc_node):
+    """Reference rpc/lib URI semantics: a double-quoted value is the RAW
+    string (so `tx=\"name=satoshi\"` works as documented), while unquoted
+    hex serves abci_query data and JSON-RPC POST bodies stay base64."""
+    import base64 as _b64
+    import json as _json
+    import urllib.request as _rq
+
+    node, _ = rpc_node
+    addr = node.rpc_listen_addr
+    # quoted raw tx over URI
+    url = f"http://{addr}/broadcast_tx_commit?tx=%22uri=raw%22"
+    res = _json.load(_rq.urlopen(url, timeout=30))["result"]
+    assert res["deliver_tx"]["code"] == 0
+    # read it back: quoted raw data param
+    url = f"http://{addr}/abci_query?data=%22uri%22"
+    res = _json.load(_rq.urlopen(url, timeout=10))["result"]["response"]
+    assert _b64.b64decode(res.get("value") or "") == b"raw"
+    # and unquoted hex data still works
+    url = f"http://{addr}/abci_query?data={b'uri'.hex()}"
+    res = _json.load(_rq.urlopen(url, timeout=10))["result"]["response"]
+    assert _b64.b64decode(res.get("value") or "") == b"raw"
+
+
+def test_uri_binary_bytes_and_bool_params(rpc_node):
+    """Byte-faithful URI decoding: percent-encoded non-UTF-8 bytes in a
+    quoted param reach the app unchanged (latin-1 round trip), and
+    ?prove=false is actually False."""
+    import json as _json
+    import urllib.request as _rq
+
+    node, _ = rpc_node
+    addr = node.rpc_listen_addr
+    # tx = b'\xff\x01=\xfe' (binary key and value)
+    url = f"http://{addr}/broadcast_tx_commit?tx=%22%FF%01=%FE%22"
+    res = _json.load(_rq.urlopen(url, timeout=30))["result"]
+    assert res["deliver_tx"]["code"] == 0
+    url = f"http://{addr}/abci_query?data=%22%FF%01%22&prove=false"
+    res = _json.load(_rq.urlopen(url, timeout=10))["result"]["response"]
+    import base64 as _b64
+    assert _b64.b64decode(res.get("value") or "") == b"\xfe"
+    assert not res.get("proof")

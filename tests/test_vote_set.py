@@ -1,0 +1,178 @@
+"""VoteSet semantics matrix (reference types/vote_set_test.go — add
+votes, 2/3 tracking across block ids, conflicting-vote evidence, bit
+arrays, badly-keyed votes, make_commit shape)."""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    ErrVoteConflictingVotes,
+    Vote,
+)
+from tendermint_tpu.types.basic import PartSetHeader
+from tendermint_tpu.types.validator_set import random_validator_set
+from tendermint_tpu.types.vote_set import ErrVoteInvalid, VoteSet
+
+CHAIN = "vs-test"
+
+
+def _bid(tag: bytes) -> BlockID:
+    return BlockID(hash=tag * 16, parts_header=PartSetHeader(1, tag * 16))
+
+
+def _vote(keys, vals, i, block_id, type_=VOTE_TYPE_PREVOTE, height=1,
+          round_=0, sign=True):
+    addr, _ = vals.get_by_index(i)
+    v = Vote(
+        validator_address=addr,
+        validator_index=i,
+        height=height,
+        round=round_,
+        timestamp=1_700_000_000_000_000_000 + i,
+        type=type_,
+        block_id=block_id,
+    )
+    if sign:
+        v.signature = keys[i].sign(v.sign_bytes(CHAIN))
+    else:
+        v.signature = b"\x00" * 64
+    return v
+
+
+@pytest.fixture()
+def vs10():
+    vals, keys = random_validator_set(10, 1)
+    return vals, keys, VoteSet(CHAIN, 1, 0, VOTE_TYPE_PREVOTE, vals)
+
+
+class TestAddVote:
+    def test_progressive_majority(self, vs10):
+        """2/3 flips exactly when the 7th of 10 equal-power votes lands
+        (vote_set_test.go TestAddVote / Test2_3Majority)."""
+        vals, keys, vs = vs10
+        b = _bid(b"\x01")
+        for i in range(6):
+            assert vs.add_vote(_vote(keys, vals, i, b))
+            assert not vs.has_two_thirds_majority()
+        assert not vs.has_two_thirds_any()  # 6*3 == 18 !> 20
+        assert vs.add_vote(_vote(keys, vals, 6, b))
+        assert vs.has_two_thirds_majority()
+        assert vs.two_thirds_majority() == b
+        assert vs.has_two_thirds_any()
+
+    def test_majority_split_across_blocks_is_none(self, vs10):
+        """2/3 ANY without 2/3 for a single block: 4 for A, 4 for nil
+        (Test2_3MajorityRedux flavor)."""
+        vals, keys, vs = vs10
+        for i in range(4):
+            vs.add_vote(_vote(keys, vals, i, _bid(b"\x0a")))
+        for i in range(4, 8):
+            vs.add_vote(_vote(keys, vals, i, BlockID()))
+        assert vs.has_two_thirds_any()
+        assert not vs.has_two_thirds_majority()
+        assert vs.two_thirds_majority() is None
+
+    def test_duplicate_is_idempotent(self, vs10):
+        vals, keys, vs = vs10
+        v = _vote(keys, vals, 0, _bid(b"\x01"))
+        assert vs.add_vote(v)
+        assert vs.add_vote(v) is False  # same again: no double count
+        assert vs.bit_array().num_true() == 1
+
+    def test_conflicting_vote_does_not_flip_sum(self, vs10):
+        """A second vote for a DIFFERENT block from the same validator
+        surfaces the conflict and must not add power twice
+        (TestConflicts)."""
+        vals, keys, vs = vs10
+        a, c = _bid(b"\x01"), _bid(b"\x02")
+        vs.add_vote(_vote(keys, vals, 0, a))
+        before = vs.bit_array().num_true()
+        with pytest.raises(ErrVoteConflictingVotes):
+            vs.add_vote(_vote(keys, vals, 0, c))
+        assert vs.bit_array().num_true() == before
+        # the original vote (and only it) still counts
+        assert vs.get_by_index(0).block_id == a
+
+    def test_rejects_bad_keying_and_signature(self, vs10):
+        vals, keys, vs = vs10
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(_vote(keys, vals, 0, _bid(b"\x01"), height=2))
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(_vote(keys, vals, 0, _bid(b"\x01"), round_=1))
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(
+                _vote(keys, vals, 0, _bid(b"\x01"), type_=VOTE_TYPE_PRECOMMIT))
+        v = _vote(keys, vals, 3, _bid(b"\x01"))
+        v.validator_index = 4  # address/index mismatch
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(v)
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(_vote(keys, vals, 5, _bid(b"\x01"), sign=False))
+        assert vs.size() == 0 or vs.bit_array().num_true() == 0
+
+    def test_unweighted_index_out_of_range(self, vs10):
+        vals, keys, vs = vs10
+        v = _vote(keys, vals, 0, _bid(b"\x01"))
+        v.validator_index = 99
+        with pytest.raises(ErrVoteInvalid):
+            vs.add_vote(v)
+
+
+class TestQueriesAndCommit:
+    def test_bit_arrays_track_blocks(self, vs10):
+        vals, keys, vs = vs10
+        a, nil = _bid(b"\x07"), BlockID()
+        for i in (0, 2, 4):
+            vs.add_vote(_vote(keys, vals, i, a))
+        for i in (1, 3):
+            vs.add_vote(_vote(keys, vals, i, nil))
+        ba = vs.bit_array()
+        assert [ba.get_index(i) for i in range(6)] == [
+            True, True, True, True, True, False]
+        ba_a = vs.bit_array_by_block_id(a)
+        assert ba_a.num_true() == 3 and ba_a.get_index(0)
+        assert vs.bit_array_by_block_id(nil).num_true() == 2
+        assert vs.bit_array_by_block_id(_bid(b"\x55")) is None
+
+    def test_get_by_index_and_address(self, vs10):
+        vals, keys, vs = vs10
+        v = _vote(keys, vals, 2, _bid(b"\x01"))
+        vs.add_vote(v)
+        assert vs.get_by_index(2).signature == v.signature
+        addr, _ = vals.get_by_index(2)
+        assert vs.get_by_address(addr).validator_index == 2
+        assert vs.get_by_index(3) is None
+
+    def test_make_commit_requires_precommit_majority(self):
+        vals, keys = random_validator_set(4, 5)
+        pre = VoteSet(CHAIN, 1, 0, VOTE_TYPE_PREVOTE, vals)
+        with pytest.raises(ValueError):
+            pre.make_commit()
+        vs = VoteSet(CHAIN, 1, 0, VOTE_TYPE_PRECOMMIT, vals)
+        b = _bid(b"\x03")
+        vs.add_vote(_vote(keys, vals, 0, b, type_=VOTE_TYPE_PRECOMMIT))
+        with pytest.raises(ValueError):
+            vs.make_commit()  # no majority yet
+        vs.add_vote(_vote(keys, vals, 1, b, type_=VOTE_TYPE_PRECOMMIT))
+        vs.add_vote(_vote(keys, vals, 2, BlockID(),
+                          type_=VOTE_TYPE_PRECOMMIT))
+        vs.add_vote(_vote(keys, vals, 3, b, type_=VOTE_TYPE_PRECOMMIT))
+        commit = vs.make_commit()
+        assert commit.block_id == b
+        # nil-voter's slot is None; block voters carry their precommits
+        assert commit.precommits[2] is None
+        assert sum(1 for p in commit.precommits if p is not None) == 3
+
+    def test_has_all(self, vs10):
+        vals, keys, vs = vs10
+        b = _bid(b"\x01")
+        for i in range(10):
+            vs.add_vote(_vote(keys, vals, i, b))
+        assert vs.has_all()
+        assert vs.size() == 10
