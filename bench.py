@@ -101,15 +101,38 @@ def main():
     serial_ms = (time.perf_counter() - t0) / sub * n * 1000
 
     # batch path: one warmup (compile; persistent cache warms later runs),
-    # then timed runs — fewer on the slow degraded path
-    got = verify_fn(msgs, sigs, pks)
-    assert got == want, "batch verify mask mismatch vs expected"
-    times = []
-    for _ in range(2 if degraded else 7):
-        t0 = time.perf_counter()
-        verify_fn(msgs, sigs, pks)
-        times.append((time.perf_counter() - t0) * 1000)
-    batch_ms = min(times)
+    # then timed runs — fewer on the slow degraded path. On real TPU the
+    # chunked dispatch (TM_TPU_VERIFY_CHUNKS) can hide transfer behind
+    # compute: sweep chunk counts (seeded with any user-set value) and
+    # report the best COMPLETE verify. Sweeping only makes sense where
+    # verify_batch actually chunks: single device, n >= chunk_min.
+    import jax as _jax
+
+    prev_chunks = os.environ.get("TM_TPU_VERIFY_CHUNKS")
+    chunk_min = int(os.environ.get("TM_TPU_VERIFY_CHUNK_MIN", "2048"))
+    can_chunk = (not degraded and not RLC_MODE
+                 and len(_jax.devices()) == 1 and n >= chunk_min)
+    sweep = [1]
+    if can_chunk:
+        sweep = [1, 2, 4]
+        if prev_chunks and prev_chunks.isdigit() and int(prev_chunks) not in sweep:
+            sweep.append(int(prev_chunks))
+    batch_ms, best_chunks = float("inf"), 1
+    for ck in sweep:
+        os.environ["TM_TPU_VERIFY_CHUNKS"] = str(ck)
+        got = verify_fn(msgs, sigs, pks)
+        assert got == want, "batch verify mask mismatch vs expected"
+        times = []
+        for _ in range(2 if degraded else 7):
+            t0 = time.perf_counter()
+            verify_fn(msgs, sigs, pks)
+            times.append((time.perf_counter() - t0) * 1000)
+        if min(times) < batch_ms:
+            batch_ms, best_chunks = min(times), ck
+    if prev_chunks is None:
+        os.environ.pop("TM_TPU_VERIFY_CHUNKS", None)
+    else:
+        os.environ["TM_TPU_VERIFY_CHUNKS"] = prev_chunks
 
     mode = "_rlc" if RLC_MODE else ""
     out = {
@@ -123,6 +146,8 @@ def main():
         # trip + ~10-30ms/MB, none of which exists on direct-attached TPU.
         # device_ms = slope over back-to-back dispatches (pure device time).
         try:
+            if can_chunk:
+                out["chunks"] = best_chunks
             out["device_ms"] = round(_device_ms(msgs, sigs, pks), 1)
             out["tunnel_note"] = "wall includes h2d+latency of remote-TPU tunnel"
         except Exception:

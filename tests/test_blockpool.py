@@ -1,0 +1,227 @@
+"""Fast-sync BlockPool scheduler (reference blockchain/pool_test.go):
+request-window fill, ordered hand-off, peer removal re-dispatch,
+bad-block redo + peer punishment, caught-up detection — plus the
+HeightVoteSet round bookkeeping (consensus/types/height_vote_set_test.go)
+and BitArray ops (libs/common/bit_array_test.go) that ride the same
+gossip paths."""
+
+import os
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.libs.bit_array import BitArray
+
+
+class _FakeBlock:
+    class header:
+        pass
+
+    def __init__(self, h):
+        self.header = type("H", (), {"height": h})()
+
+
+class PoolHarness:
+    def __init__(self, start=1):
+        self.requests = []  # (peer, height)
+        self.errors = []
+        self._cv = threading.Condition()
+        self.pool = BlockPool(start, self._request, self._error)
+
+    def _request(self, peer, height):
+        with self._cv:
+            self.requests.append((peer, height))
+            self._cv.notify_all()
+
+    def _error(self, peer, reason):
+        self.errors.append((peer, reason))
+
+    def wait_requests(self, n, timeout=10.0):
+        deadline = time.time() + timeout
+        with self._cv:
+            while len(self.requests) < n:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+        return True
+
+
+class TestBlockPool:
+    def test_requests_flow_and_ordered_handoff(self):
+        h = PoolHarness(start=1)
+        h.pool.start()
+        try:
+            h.pool.set_peer_height("p1", 5)
+            assert h.wait_requests(5), f"only {len(h.requests)} requests"
+            heights = sorted(hh for _, hh in h.requests[:5])
+            assert heights == [1, 2, 3, 4, 5]
+
+            # serve out of order: 2 first, then 1
+            h.pool.add_block("p1", _FakeBlock(2), 100)
+            first, second = h.pool.peek_two_blocks()
+            assert first is None  # height 1 not here yet: no hand-off
+            h.pool.add_block("p1", _FakeBlock(1), 100)
+            first, second = h.pool.peek_two_blocks()
+            assert first.header.height == 1 and second.header.height == 2
+            h.pool.pop_request()
+            assert h.pool.height == 2
+            first, _ = h.pool.peek_two_blocks()
+            assert first.header.height == 2
+        finally:
+            h.pool.stop()
+
+    def test_unsolicited_and_wrong_peer_blocks_ignored(self):
+        h = PoolHarness(start=1)
+        h.pool.start()
+        try:
+            h.pool.set_peer_height("p1", 3)
+            assert h.wait_requests(3)
+            # block from a peer that was never asked for that height
+            h.pool.add_block("intruder", _FakeBlock(1), 100)
+            first, _ = h.pool.peek_two_blocks()
+            assert first is None
+        finally:
+            h.pool.stop()
+
+    def test_remove_peer_redispatches_to_survivor(self):
+        h = PoolHarness(start=1)
+        h.pool.start()
+        try:
+            h.pool.set_peer_height("p1", 2)
+            h.pool.set_peer_height("p2", 2)
+            assert h.wait_requests(2)
+            victims = {hh for p, hh in h.requests if p == "p1"}
+            h.pool.remove_peer("p1")
+            if victims:
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    redone = {hh for p, hh in h.requests if p == "p2"}
+                    if victims <= redone:
+                        break
+                    time.sleep(0.05)
+                assert victims <= {hh for p, hh in h.requests if p == "p2"}
+        finally:
+            h.pool.stop()
+
+    def test_redo_request_punishes_and_rerequests(self):
+        h = PoolHarness(start=1)
+        h.pool.start()
+        try:
+            h.pool.set_peer_height("bad", 1)
+            h.pool.set_peer_height("good", 1)
+            assert h.wait_requests(1)
+            peer0, _ = h.requests[0]
+            h.pool.add_block(peer0, _FakeBlock(1), 100)
+            h.pool.redo_request(1)  # validation failed upstream
+            assert h.errors and h.errors[0][0] == peer0
+            other = "good" if peer0 == "bad" else "bad"
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if any(p == other and hh == 1 for p, hh in h.requests):
+                    break
+                time.sleep(0.05)
+            assert any(p == other and hh == 1 for p, hh in h.requests), (
+                "height 1 never re-requested from the surviving peer")
+        finally:
+            h.pool.stop()
+
+    def test_caught_up(self):
+        h = PoolHarness(start=5)
+        h.pool.start()
+        try:
+            assert not h.pool.is_caught_up()  # no peers yet
+            h.pool.set_peer_height("p1", 5)
+            assert h.pool.is_caught_up()  # already at max peer height
+            h.pool.set_peer_height("p2", 9)
+            assert not h.pool.is_caught_up()
+            assert h.pool.max_peer_height() == 9
+        finally:
+            h.pool.stop()
+
+
+class TestHeightVoteSet:
+    def _mk(self):
+        from tendermint_tpu.consensus.cstypes import HeightVoteSet
+        from tendermint_tpu.types.validator_set import random_validator_set
+
+        vals, keys = random_validator_set(4, 10)
+        return HeightVoteSet("hvs-test", 1, vals), vals, keys
+
+    def _vote(self, vals, keys, i, round_, type_, block_id):
+        from tendermint_tpu.types import Vote
+        from tendermint_tpu.types.basic import (
+            VOTE_TYPE_PRECOMMIT,
+            VOTE_TYPE_PREVOTE,
+        )
+
+        addr, _ = vals.get_by_index(i)
+        v = Vote(
+            validator_address=addr, validator_index=i, height=1,
+            round=round_, timestamp=1_700_000_000_000_000_000,
+            type=type_, block_id=block_id,
+        )
+        v.signature = keys[i].sign(v.sign_bytes("hvs-test"))
+        return v
+
+    def test_rounds_created_on_demand_and_pol_info(self):
+        from tendermint_tpu.types.basic import (
+            VOTE_TYPE_PREVOTE,
+            BlockID,
+            PartSetHeader,
+        )
+
+        hvs, vals, keys = self._mk()
+        b = BlockID(hash=b"\x01" * 32,
+                    parts_header=PartSetHeader(1, b"\x01" * 32))
+        assert hvs.pol_info() == (-1, BlockID()) or hvs.pol_info()[0] == -1
+        # votes for a FUTURE round are accepted from peers (hvs tracks
+        # round 0..round+1 plus peer-supplied rounds)
+        for i in range(3):
+            hvs.add_vote(self._vote(vals, keys, i, 0, VOTE_TYPE_PREVOTE, b),
+                         peer_id=f"p{i}")
+        assert hvs.prevotes(0).has_two_thirds_majority()
+        pol_round, pol_bid = hvs.pol_info()
+        assert pol_round == 0 and pol_bid == b
+
+    def test_set_round_advances_window(self):
+        from tendermint_tpu.types.basic import VOTE_TYPE_PREVOTE, BlockID
+
+        hvs, vals, keys = self._mk()
+        hvs.set_round(3)
+        assert hvs.prevotes(3) is not None
+        assert hvs.prevotes(4) is not None  # round+1 pre-created
+        v = self._vote(vals, keys, 0, 3, VOTE_TYPE_PREVOTE, BlockID())
+        assert hvs.add_vote(v)
+        assert hvs.prevotes(3).bit_array().num_true() == 1
+
+
+class TestBitArray:
+    def test_ops(self):
+        a = BitArray.from_bools([1, 0, 1, 0, 1, 0, 0, 0, 1])
+        b = BitArray.from_bools([1, 1, 0, 0, 1, 0, 0, 0, 0])
+        assert a.num_true() == 4
+        assert a.or_(b).num_true() == 5  # union {0,1,2,4,8}
+        assert a.and_(b).num_true() == 2
+        assert a.sub(b).num_true() == 2  # in a, not in b: idx 2, 8
+        assert a.not_().num_true() == 9 - 4
+        assert not a.is_empty() and not a.is_full()
+        assert BitArray.from_bools([1, 1]).is_full()
+        assert BitArray(5).is_empty()
+
+    def test_roundtrip_bytes_and_pick(self):
+        a = BitArray.from_bools([0, 1, 0, 0, 0, 0, 0, 0, 0, 1, 1])
+        back = BitArray.from_bytes_size(a.to_bytes(), a.size())
+        assert back == a
+        picks = {a.pick_random() for _ in range(50)}
+        assert picks <= {1, 9, 10}
+        assert {1, 9, 10} <= picks  # all true bits reachable
+
+    def test_set_out_of_range(self):
+        a = BitArray(4)
+        assert not a.set_index(9, True)
+        assert not a.get_index(9)
