@@ -109,13 +109,17 @@ def main():
     import jax as _jax
 
     prev_chunks = os.environ.get("TM_TPU_VERIFY_CHUNKS")
-    chunk_min = int(os.environ.get("TM_TPU_VERIFY_CHUNK_MIN", "2048"))
+    try:
+        chunk_min = int(os.environ.get("TM_TPU_VERIFY_CHUNK_MIN", "2048"))
+    except ValueError:
+        chunk_min = 2048  # same fallback verify_batch uses
     can_chunk = (not degraded and not RLC_MODE
                  and len(_jax.devices()) == 1 and n >= chunk_min)
     sweep = [1]
     if can_chunk:
         sweep = [1, 2, 4]
-        if prev_chunks and prev_chunks.isdigit() and int(prev_chunks) not in sweep:
+        if (prev_chunks and prev_chunks.isdigit() and int(prev_chunks) >= 2
+                and int(prev_chunks) not in sweep):
             sweep.append(int(prev_chunks))
     batch_ms, best_chunks = float("inf"), 1
     for ck in sweep:

@@ -18,9 +18,16 @@ Value-level differences from field.py (pallas-friendly forms only):
 - jnp.pad / .at[] are replaced by concatenate + pltpu.roll with static
   shifts (interpret mode substitutes jnp.roll, which pltpu.roll does
   not support off-TPU).
-- The fixed-base niels table lookup is a masked sum over the 16 rows in
-  int32 instead of a one-hot f32 matmul (exact either way; the masked
-  sum keeps the kernel f32-free).
+- The fixed-base niels table lookup is a one-hot f32 matmul on the MXU
+  (exact: one-hot times 13-bit entries, single-term sums stay far under
+  the 24-bit f32 mantissa), which is otherwise idle in this kernel.
+- The per-item variable-base window select is a 4-level binary tree of
+  lane-broadcast selects on the window bits (half the VPU ops of the
+  15-term masked multiply-accumulate it replaces).
+- Doublings and the per-window niels add skip the extended T coordinate
+  whenever no consumer reads it (T is only needed by the one doubling
+  that feeds add_cached, and by the final window when the caller wants
+  T back): 4 of the ~45 field muls per window are dead and dropped.
 """
 
 from __future__ import annotations
@@ -145,8 +152,8 @@ def _make_ops(interpret: bool):
     add = lambda a, b: _carry(a + b)
     sub = lambda a, b: _carry(a - b)
 
-    def double(p):
-        X1, Y1, Z1, _ = p
+    def _double_efgh(p):
+        X1, Y1, Z1 = p[0], p[1], p[2]
         a = sq(X1)
         b = sq(Y1)
         zz = sq(Z1)
@@ -156,7 +163,17 @@ def _make_ops(interpret: bool):
         e = sub(h, sq(xy))
         g = sub(a, b)
         f = add(c, g)
+        return e, f, g, h
+
+    def double(p):
+        e, f, g, h = _double_efgh(p)
         return (mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+    def double3(p):
+        """Doubling without the extended T output — for chains where the
+        next op is another doubling (which never reads T)."""
+        e, f, g, h = _double_efgh(p)
+        return (mul(e, f), mul(g, h), mul(f, g))
 
     def to_cached(p, d2):
         X, Y, Z, T = p
@@ -176,7 +193,7 @@ def _make_ops(interpret: bool):
         h = add(b, a)
         return (mul(e, f), mul(g, h), mul(f, g), mul(e, h))
 
-    def add_niels(p, n):
+    def _add_niels_efgh(p, n):
         X1, Y1, Z1, T1 = p
         yplusx2, yminusx2, xy2d2 = n
         a = mul(sub(Y1, X1), yminusx2)
@@ -187,7 +204,17 @@ def _make_ops(interpret: bool):
         f = sub(d, c)
         g = add(d, c)
         h = add(b, a)
+        return e, f, g, h
+
+    def add_niels(p, n):
+        e, f, g, h = _add_niels_efgh(p, n)
         return (mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+    def add_niels3(p, n):
+        """Niels add without the extended T output — for window tails
+        where the next consumer is a doubling."""
+        e, f, g, h = _add_niels_efgh(p, n)
+        return (mul(e, f), mul(g, h), mul(f, g))
 
     def pow2k(x, k):
         return jax.lax.fori_loop(0, k, lambda _, v: sq(v), x)
@@ -228,7 +255,8 @@ def _make_ops(interpret: bool):
 
     return types.SimpleNamespace(
         mul=mul, sq=sq, add=add, sub=sub, neg=neg, double=double,
-        to_cached=to_cached, add_cached=add_cached, add_niels=add_niels,
+        double3=double3, to_cached=to_cached, add_cached=add_cached,
+        add_niels=add_niels, add_niels3=add_niels3,
         seq_carry=seq_carry, cond_sub=cond_sub, freeze=freeze,
         pow2k=pow2k, invert=invert, pow22523=pow22523,
     )
@@ -244,9 +272,28 @@ def _btab_np():
     return t
 
 
-def _straus_loop(ops, s_win_ref, k_win_ref, neg_a, d2, btab, blk):
+def _tree_select(idx, entries):
+    """4-level binary-tree select of one of 16 table entries per lane.
+
+    idx: (1, blk) int32 in [0, 16); entries: length-16 list of tuples of
+    (rows, blk) arrays. Costs 15 lane-broadcast selects per component —
+    about half the VPU work of a 16-term masked multiply-accumulate."""
+    level = entries
+    for bit in range(4):
+        b = ((idx >> bit) & 1) != 0  # (1, blk)
+        level = [
+            tuple(jnp.where(b, hi, lo) for lo, hi in zip(level[2 * j], level[2 * j + 1]))
+            for j in range(len(level) // 2)
+        ]
+    return level[0]
+
+
+def _straus_loop(ops, s_win_ref, k_win_ref, neg_a, d2, btab, blk,
+                 want_t: bool = False):
     """The joint [s]B + [k]*neg_a chain on VMEM values (see
-    curve.straus_mul_sub for the algorithm)."""
+    curve.straus_mul_sub for the algorithm). Returns (X, Y, Z) — plus the
+    extended T when want_t (callers that only encode never read T, and
+    skipping it drops 4 dead muls per window)."""
     # per-item table cached([j]*neg_a), j=1..15 — VMEM-resident
     na_cached = ops.to_cached(neg_a, d2)
     mults = [neg_a]
@@ -256,33 +303,46 @@ def _straus_loop(ops, s_win_ref, k_win_ref, neg_a, d2, btab, blk):
         else:
             mults.append(ops.add_cached(mults[j - 2], na_cached))
     table = [ops.to_cached(p, d2) for p in mults]
+    # tree-select domain is 16 entries; index 15 is only produced by the
+    # kw==0 lanes whose add is discarded by the where below — pad with a
+    # duplicate so every index is in range
+    table16 = table + [table[14]]
 
     zero = _zeros(NLIMB, blk)
     one = jnp.concatenate(
         [jnp.ones((1, blk), jnp.int32), _zeros(NLIMB - 1, blk)], axis=0
     )
-    acc0 = (zero, one, one, zero)
+    btab_f = btab[:, :60].astype(jnp.float32)  # (16, 60), loop-invariant
 
-    def body(w, acc):
-        acc = ops.double(ops.double(ops.double(ops.double(acc))))
-        # variable-base window: masked sum over the 15 cached entries
+    def window(w, acc3, tail_t: bool):
+        acc3 = ops.double3(ops.double3(ops.double3(acc3)))
+        acc = ops.double(acc3)  # full: add_cached consumes T
+        # variable-base window: binary-tree select over the cached table
         kw = k_win_ref[pl.ds(w, 1), :]  # (1, blk)
-        sel = [zero, zero, zero, zero]
-        for j in range(15):
-            m = (kw == j + 1).astype(jnp.int32)
-            for comp in range(4):
-                sel[comp] = sel[comp] + table[j][comp] * m
-        added = ops.add_cached(acc, tuple(sel))
+        sel = _tree_select((kw - 1) & 15, table16)
+        added = ops.add_cached(acc, sel)
         acc = tuple(jnp.where(kw != 0, x, y) for x, y in zip(added, acc))
-        # fixed-base window: masked sum over the 16 niels rows of B
+        # fixed-base window: one-hot f32 matmul on the (otherwise idle)
+        # MXU — exact, one-hot times 13-bit entries
         sw = s_win_ref[pl.ds(w, 1), :]  # (1, blk)
-        ent = _zeros(60, blk)
-        for j in range(16):
-            m = (sw == j).astype(jnp.int32)
-            ent = ent + btab[j, :60].reshape(60, 1) * m
-        return ops.add_niels(acc, (ent[:20], ent[20:40], ent[40:60]))
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (16, blk), 0) == sw
+        ).astype(jnp.float32)
+        # HIGHEST precision is required: the TPU MXU's default f32 path
+        # rounds inputs to bf16 (8 mantissa bits), which corrupts 13-bit
+        # table entries; the 3-way bf16 split is exact at these magnitudes
+        ent = jax.lax.dot_general(
+            btab_f, onehot, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)  # (60, blk)
+        n = (ent[:20], ent[20:40], ent[40:60])
+        return ops.add_niels(acc, n) if tail_t else ops.add_niels3(acc, n)
 
-    return jax.lax.fori_loop(0, 64, body, acc0)
+    acc3 = jax.lax.fori_loop(
+        0, 63, lambda w, a: window(w, a, False), (zero, one, one)
+    )
+    return window(63, acc3, want_t)
 
 
 def _make_straus_kernel(interpret: bool):
@@ -296,7 +356,8 @@ def _make_straus_kernel(interpret: bool):
         blk = na[0].shape[1]
         d2 = _const_fe_rows(ref.D2, blk)
         btab = btab_ref[:]  # (16, 64)
-        X, Y, Z, T = _straus_loop(ops, s_win_ref, k_win_ref, na, d2, btab, blk)
+        X, Y, Z, T = _straus_loop(ops, s_win_ref, k_win_ref, na, d2, btab, blk,
+                                  want_t=True)
         ox_ref[:] = X
         oy_ref[:] = Y
         oz_ref[:] = Z
@@ -393,8 +454,8 @@ def _make_verify_tail_kernel(interpret: bool):
         a_pt = tuple(jnp.where(ok, g, i) for g, i in zip(a_pt, ident))
         neg_a = (ops.neg(a_pt[0]), a_pt[1], a_pt[2], ops.neg(a_pt[3]))
 
-        # R' = [S]B + [k](-A), one shared-doubling chain
-        X, Y, Z, _ = _straus_loop(
+        # R' = [S]B + [k](-A), one shared-doubling chain (T never read)
+        X, Y, Z = _straus_loop(
             ops, s_win_ref, k_win_ref, neg_a, d2, btab_ref[:], blk
         )
 

@@ -1,0 +1,143 @@
+"""Query-language + pubsub depth tests, modeled on the reference's
+libs/pubsub/query/query_test.go match table and libs/pubsub/pubsub_test.go
+subscription semantics.
+"""
+
+import pytest
+
+from tendermint_tpu.libs.events import Message, PubSub, Query, QueryError
+
+
+# (query, tags, want_match) — the reference's query_test.go table, adapted
+MATCH_TABLE = [
+    ("tm.event = 'NewBlock'", {"tm.event": "NewBlock"}, True),
+    ("tm.event = 'NewBlock'", {"tm.event": "NewBlockHeader"}, False),
+    ("tm.event = 'NewBlock'", {}, False),
+    # numeric comparisons
+    ("tx.height > 5", {"tx.height": "6"}, True),
+    ("tx.height > 5", {"tx.height": "5"}, False),
+    ("tx.height >= 5", {"tx.height": "5"}, True),
+    ("tx.height < 5", {"tx.height": "4.5"}, True),
+    ("tx.height <= 5", {"tx.height": "5"}, True),
+    ("tx.height <= 5", {"tx.height": "5.1"}, False),
+    # non-numeric tag value never satisfies a numeric comparison
+    ("tx.height > 5", {"tx.height": "high"}, False),
+    # CONTAINS is substring
+    ("tx.hash CONTAINS 'abc'", {"tx.hash": "00abc11"}, True),
+    ("tx.hash CONTAINS 'abc'", {"tx.hash": "00ab1c1"}, False),
+    # EXISTS checks key presence only
+    ("tx.fee EXISTS", {"tx.fee": "anything"}, True),
+    ("tx.fee EXISTS", {"tx.feeX": "anything"}, False),
+    # conjunction: all conditions must hold
+    (
+        "tm.event = 'Tx' AND tx.height > 5 AND tx.hash CONTAINS 'ff'",
+        {"tm.event": "Tx", "tx.height": "100", "tx.hash": "0ff0"},
+        True,
+    ),
+    (
+        "tm.event = 'Tx' AND tx.height > 5",
+        {"tm.event": "Tx", "tx.height": "2"},
+        False,
+    ),
+    # quoted values may contain AND / spaces / operators
+    ("msg = 'a AND b'", {"msg": "a AND b"}, True),
+    ("msg = 'x > y'", {"msg": "x > y"}, True),
+    # unquoted bare values
+    ("app.version = 1.0.5", {"app.version": "1.0.5"}, True),
+    # empty query matches everything
+    ("", {"any": "thing"}, True),
+]
+
+
+@pytest.mark.parametrize("query,tags,want", MATCH_TABLE)
+def test_query_match_table(query, tags, want):
+    assert Query(query).matches(tags) == want
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "tm.event = ",  # missing value
+        "= 'NewBlock'",  # missing key
+        "tm.event ~ 'x'",  # unknown operator
+        "tm.event = 'unterminated",
+        "tm.event = 'a' OR tm.event = 'b'",  # OR is not in the language
+    ],
+)
+def test_query_parse_errors(bad):
+    with pytest.raises(QueryError):
+        Query(bad)
+
+
+def test_query_equality_and_hash():
+    assert Query("a = 'b'") == Query("a = 'b'")
+    assert Query("a = 'b'") != Query("a = 'c'")
+    assert len({Query("a = 'b'"), Query("a = 'b'"), Query("a = 'c'")}) == 2
+
+
+# --- PubSub semantics ------------------------------------------------------
+
+
+def test_pubsub_routes_by_query():
+    ps = PubSub()
+    blocks = ps.subscribe("c1", Query("tm.event = 'NewBlock'"))
+    txs = ps.subscribe("c1", Query("tm.event = 'Tx'"))
+    ps.publish("blk", {"tm.event": "NewBlock"})
+    ps.publish("tx1", {"tm.event": "Tx"})
+    assert blocks.poll().data == "blk"
+    assert blocks.poll() is None
+    assert txs.poll().data == "tx1"
+
+
+def test_pubsub_duplicate_subscription_rejected():
+    ps = PubSub()
+    ps.subscribe("c1", Query("a = 'b'"))
+    with pytest.raises(ValueError, match="already subscribed"):
+        ps.subscribe("c1", Query("a = 'b'"))
+    # same query under a different subscriber is fine
+    ps.subscribe("c2", Query("a = 'b'"))
+    assert ps.num_subscriptions() == 2
+
+
+def test_pubsub_unsubscribe_cancels():
+    ps = PubSub()
+    q = Query("a = 'b'")
+    sub = ps.subscribe("c1", q)
+    ps.unsubscribe("c1", q)
+    assert sub.cancelled
+    assert ps.num_subscriptions() == 0
+    # published messages after unsubscribe are not delivered
+    ps.publish("x", {"a": "b"})
+    assert sub.poll() is None
+
+
+def test_pubsub_unsubscribe_all_only_hits_that_subscriber():
+    ps = PubSub()
+    s1 = ps.subscribe("c1", Query("a = 'b'"))
+    s2 = ps.subscribe("c1", Query("c = 'd'"))
+    s3 = ps.subscribe("c2", Query("a = 'b'"))
+    ps.unsubscribe_all("c1")
+    assert s1.cancelled and s2.cancelled and not s3.cancelled
+    assert ps.num_subscriptions() == 1
+
+
+def test_slow_subscriber_drops_instead_of_blocking():
+    ps = PubSub()
+    sub = ps.subscribe("slow", Query(""), capacity=2)
+    for i in range(5):
+        ps.publish(i, {"k": "v"})
+    got = []
+    while (m := sub.poll()) is not None:
+        got.append(m.data)
+    assert got == [0, 1]  # capacity bound, publisher never blocked
+
+
+def test_cancelled_subscription_refuses_publish():
+    sub = PubSub().subscribe("c", Query(""))
+    sub.cancel()
+    assert not sub.publish(Message("x", {}))
+
+
+def test_get_timeout_returns_none():
+    sub = PubSub().subscribe("c", Query(""))
+    assert sub.get(timeout=0.02) is None

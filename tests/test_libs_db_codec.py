@@ -1,0 +1,140 @@
+"""Depth tests for the db backends and the canonical codec primitives,
+modeled on the reference's libs/db/backend_test.go (shared backend
+matrix: get/set/delete, ordered + range + reverse iterators, batches,
+prefix views) and libs/common varint edge cases.
+"""
+
+import pytest
+
+from tendermint_tpu import codec
+from tendermint_tpu.libs.db import FileDB, MemDB, PrefixDB, new_db
+
+# --- codec primitives ------------------------------------------------------
+
+UVARINT_EDGES = [0, 1, 0x7F, 0x80, 0x3FFF, 0x4000, 2**32 - 1, 2**63 - 1, 2**64 - 1]
+
+
+@pytest.mark.parametrize("n", UVARINT_EDGES)
+def test_uvarint_round_trip(n):
+    enc = codec.uvarint(n)
+    got, pos = codec.read_uvarint(enc)
+    assert got == n and pos == len(enc)
+    # boundary compactness: 7 bits per byte
+    assert len(enc) == max(1, (n.bit_length() + 6) // 7)
+
+
+@pytest.mark.parametrize("n", [0, 1, -1, 63, -64, 64, -65, 2**31, -(2**31), 2**62, -(2**62)])
+def test_svarint_round_trip(n):
+    got, pos = codec.read_svarint(codec.svarint(n))
+    assert got == n
+
+
+def test_uvarint_stream_positioning():
+    buf = codec.uvarint(300) + codec.uvarint(0) + codec.uvarint(2**40)
+    a, p = codec.read_uvarint(buf)
+    b, p = codec.read_uvarint(buf, p)
+    c, p = codec.read_uvarint(buf, p)
+    assert (a, b, c) == (300, 0, 2**40) and p == len(buf)
+
+
+def test_uvarint_truncated_and_overlong():
+    with pytest.raises(ValueError, match="truncated"):
+        codec.read_uvarint(b"")
+    with pytest.raises(ValueError, match="truncated"):
+        codec.read_uvarint(codec.uvarint(2**40)[:-1])
+    with pytest.raises(ValueError, match="too long"):
+        codec.read_uvarint(b"\xff" * 12)
+
+
+# --- db backend matrix -----------------------------------------------------
+
+
+def _backends(tmp_path):
+    yield MemDB()
+    yield FileDB(str(tmp_path / "filedb"))
+
+
+def test_db_crud_and_ordering(tmp_path):
+    for db in _backends(tmp_path):
+        assert db.get(b"missing") is None
+        assert not db.has(b"missing")
+        db.set(b"b", b"2")
+        db.set(b"a", b"1")
+        db.set(b"c", b"3")
+        db.set_sync(b"d", b"4")
+        assert db.get(b"a") == b"1" and db.has(b"d")
+        db.delete(b"b")
+        db.delete(b"nonexistent")  # deleting absent keys is a no-op
+        assert db.get(b"b") is None
+        # iteration is byte-ordered; reverse is the mirror
+        assert [k for k, _ in db.iterator()] == [b"a", b"c", b"d"]
+        assert [k for k, _ in db.reverse_iterator()] == [b"d", b"c", b"a"]
+        db.close()
+
+
+def test_db_range_iterators(tmp_path):
+    for db in _backends(tmp_path):
+        for i in range(10):
+            db.set(b"k%d" % i, b"v%d" % i)
+        # [start, end) range semantics
+        assert [k for k, _ in db.iterator(b"k3", b"k7")] == [b"k3", b"k4", b"k5", b"k6"]
+        assert [k for k, _ in db.iterator(None, b"k2")] == [b"k0", b"k1"]
+        assert [k for k, _ in db.iterator(b"k8", None)] == [b"k8", b"k9"]
+        assert [k for k, _ in db.reverse_iterator(b"k3", b"k7")] == [b"k6", b"k5", b"k4", b"k3"]
+        assert list(db.iterator(b"x", b"y")) == []
+        db.close()
+
+
+def test_db_batch_atomicity(tmp_path):
+    for db in _backends(tmp_path):
+        db.set(b"gone", b"x")
+        b = db.batch()
+        b.set(b"p", b"1")
+        b.set(b"q", b"2")
+        b.delete(b"gone")
+        # nothing visible until write()
+        assert db.get(b"p") is None and db.get(b"gone") == b"x"
+        b.write()
+        assert db.get(b"p") == b"1" and db.get(b"q") == b"2"
+        assert db.get(b"gone") is None
+        db.close()
+
+
+def test_filedb_persistence_across_reopen(tmp_path):
+    path = str(tmp_path / "persist")
+    db = FileDB(path)
+    db.set(b"alive", b"yes")
+    db.set(b"dead", b"soon")
+    db.delete(b"dead")
+    db.set_sync(b"flushed", b"1")
+    db.close()
+
+    db2 = FileDB(path)
+    assert db2.get(b"alive") == b"yes"
+    assert db2.get(b"dead") is None  # tombstone replayed from the log
+    assert db2.get(b"flushed") == b"1"
+    db2.close()
+
+
+def test_prefixdb_view_isolation():
+    base = MemDB()
+    p1 = PrefixDB(base, b"one/")
+    p2 = PrefixDB(base, b"two/")
+    p1.set(b"k", b"v1")
+    p2.set(b"k", b"v2")
+    assert p1.get(b"k") == b"v1" and p2.get(b"k") == b"v2"
+    assert base.get(b"one/k") == b"v1"
+    # iteration stays inside the prefix and yields unprefixed keys
+    p1.set(b"a", b"x")
+    assert [k for k, _ in p1.iterator()] == [b"a", b"k"]
+    assert [k for k, _ in p2.iterator()] == [b"k"]
+    p1.delete(b"k")
+    assert p1.get(b"k") is None and p2.get(b"k") == b"v2"
+
+
+def test_new_db_registry(tmp_path):
+    db = new_db("test", backend="memdb")
+    db.set(b"x", b"1")
+    assert db.get(b"x") == b"1"
+    with pytest.raises(Exception):
+        new_db("test", backend="no-such-backend")

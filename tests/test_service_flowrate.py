@@ -1,0 +1,101 @@
+"""Lifecycle + flow-rate depth tests (reference libs/common/service.go
+BaseService semantics and libs/flowrate monitor behavior).
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.libs.flowrate import Monitor
+from tendermint_tpu.libs.service import (
+    AlreadyStartedError,
+    AlreadyStoppedError,
+    BaseService,
+)
+
+
+class Probe(BaseService):
+    def __init__(self):
+        super().__init__("probe")
+        self.started = 0
+        self.stopped = 0
+
+    def on_start(self):
+        self.started += 1
+
+    def on_stop(self):
+        self.stopped += 1
+
+
+def test_service_lifecycle():
+    s = Probe()
+    assert not s.is_running()
+    s.start()
+    assert s.is_running() and s.started == 1
+    with pytest.raises(AlreadyStartedError):
+        s.start()
+    s.stop()
+    assert not s.is_running() and s.stopped == 1
+    s.stop()  # double stop is an idempotent no-op...
+    assert s.stopped == 1  # ...and must not run on_stop again
+    # a stopped service cannot be restarted without reset (reference
+    # BaseService.Start on a stopped service errors)
+    with pytest.raises((AlreadyStartedError, AlreadyStoppedError)):
+        s.start()
+    s.reset()
+    s.start()
+    assert s.is_running() and s.started == 2
+    s.stop()
+
+
+def test_service_wait_unblocks_on_stop():
+    s = Probe()
+    s.start()
+    t0 = time.monotonic()
+    assert not s.wait(timeout=0.05)  # still running -> times out False
+    s.stop()
+    assert s.wait(timeout=1.0)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_service_on_start_failure_leaves_not_running():
+    class Boom(BaseService):
+        def on_start(self):
+            raise RuntimeError("nope")
+
+    s = Boom("boom")
+    with pytest.raises(RuntimeError):
+        s.start()
+    assert not s.is_running()
+    # a failed start is retryable
+    with pytest.raises(RuntimeError):
+        s.start()
+
+
+def test_flowrate_counts_and_average():
+    m = Monitor(sample_period=0.01, window=0.1)
+    total = 0
+    for _ in range(10):
+        total += m.update(1000)
+        time.sleep(0.005)
+    st = m.status()
+    assert st["bytes"] == 10_000
+    assert m.avg_rate() > 0
+    assert m.rate() >= 0
+
+
+def test_flowrate_limit_caps_quota():
+    m = Monitor(sample_period=0.01, window=0.1)
+    # ask for far more than the rate limit allows in one slice: the
+    # granted quota must be bounded and never negative
+    grant = m.limit(10**9, rate_limit=1000)
+    assert 0 <= grant <= 10**9
+    m.update(grant)
+    # after consuming a full second of quota, the next grant shrinks
+    g2 = m.limit(10**9, rate_limit=1000)
+    assert g2 <= 1000
+
+
+def test_flowrate_zero_limit_means_unlimited():
+    m = Monitor()
+    assert m.limit(12345, rate_limit=0) == 12345

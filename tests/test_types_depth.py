@@ -1,0 +1,325 @@
+"""Depth tests for the core types layer, modeled on the reference's
+heaviest type suites: types/block_test.go, types/part_set_test.go,
+types/evidence_test.go, types/validator_set_test.go
+(TestProposerSelection1-3, rescale/averaging behavior).
+
+All pure-Python (OpenSSL ed25519 only) — no jax, so the tier stays fast
+on small machines.
+"""
+
+import dataclasses
+
+import pytest
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.types import serde
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    PartSetHeader,
+    Vote,
+)
+from tendermint_tpu.types.block import Block, Commit, Header
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, ErrEvidenceInvalid
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+CHAIN = "depth-chain"
+
+
+def _key(i: int):
+    return keys.PrivKeyEd25519.gen_from_secret(b"types-depth-%d" % i)
+
+
+def _vote(sk, idx, height=5, round_=0, type_=VOTE_TYPE_PRECOMMIT,
+          block_hash=b"\x01" * 20, ts=1000 + 7):
+    bid = BlockID(block_hash, PartSetHeader(1, b"\x02" * 20)) if block_hash else BlockID()
+    v = Vote(
+        validator_address=sk.pub_key().address(),
+        validator_index=idx,
+        height=height,
+        round=round_,
+        timestamp=ts,
+        type=type_,
+        block_id=bid,
+    )
+    v.signature = sk.sign(v.sign_bytes(CHAIN))
+    return v
+
+
+def _commit_block(height=2, txs=(b"tx-a", b"tx-b")):
+    sk = _key(0)
+    pre = _vote(sk, 0, height=height - 1)
+    commit = Commit(block_id=pre.block_id, precommits=[pre])
+    block = Block.make(height, list(txs), commit, [])
+    block.header.validators_hash = b"\x05" * 20
+    return block
+
+
+# --- Header / Block --------------------------------------------------------
+
+
+def test_header_hash_sensitive_to_every_field():
+    """Flipping any header field must change the hash (the header hash
+    commits to the full field list — reference Header.Hash)."""
+    base = _commit_block().header
+    h0 = base.hash()
+    assert h0 is not None
+    mutations = dict(
+        chain_id="other",
+        height=base.height + 1,
+        time=base.time + 1,
+        num_txs=base.num_txs + 1,
+        total_txs=base.total_txs + 1,
+        last_block_id=BlockID(b"\x09" * 20, PartSetHeader(3, b"\x0a" * 20)),
+        last_commit_hash=b"\x11" * 20,
+        data_hash=b"\x12" * 20,
+        validators_hash=b"\x13" * 20,
+        next_validators_hash=b"\x14" * 20,
+        consensus_hash=b"\x15" * 20,
+        app_hash=b"\x16" * 20,
+        last_results_hash=b"\x17" * 20,
+        evidence_hash=b"\x18" * 20,
+        proposer_address=b"\x19" * 20,
+    )
+    assert set(mutations) == {f.name for f in dataclasses.fields(base)}
+    for field, val in mutations.items():
+        mutated = dataclasses.replace(base, **{field: val})
+        assert mutated.hash() != h0, f"hash ignores header field {field}"
+
+
+def test_header_hash_none_until_validators_hash():
+    h = Header(chain_id=CHAIN, height=3)
+    assert h.hash() is None
+    h.validators_hash = b"\x01" * 20
+    assert h.hash() is not None
+
+
+def test_block_validate_basic_tamper_matrix():
+    """Each divergence between header and contents must be caught
+    (reference Block.ValidateBasic)."""
+    block = _commit_block()
+    block.validate_basic()  # sane block passes
+
+    b = _commit_block()
+    b.header.height = 0
+    with pytest.raises(ValueError, match="height"):
+        b.validate_basic()
+
+    b = _commit_block()
+    b.last_commit = None
+    with pytest.raises(ValueError, match="last_commit"):
+        b.validate_basic()
+
+    b = _commit_block()
+    b.header.num_txs += 1
+    with pytest.raises(ValueError, match="num_txs"):
+        b.validate_basic()
+
+    b = _commit_block()
+    b.data.txs.append(b"smuggled")
+    b.header.num_txs += 1  # keep the count consistent: the HASH must catch it
+    with pytest.raises(ValueError, match="data_hash"):
+        b.validate_basic()
+
+    b = _commit_block()
+    b.header.last_commit_hash = b"\x00" * 20
+    with pytest.raises(ValueError, match="last_commit_hash"):
+        b.validate_basic()
+
+
+def test_commit_validate_basic():
+    sk = _key(1)
+    good = _vote(sk, 0)
+    Commit(good.block_id, [good, None]).validate_basic()
+
+    with pytest.raises(ValueError, match="zero block id"):
+        Commit(BlockID(), [good]).validate_basic()
+    with pytest.raises(ValueError, match="no precommits"):
+        Commit(good.block_id, []).validate_basic()
+
+    prevote = _vote(sk, 0, type_=VOTE_TYPE_PREVOTE)
+    with pytest.raises(ValueError, match="non-precommit"):
+        Commit(good.block_id, [good, prevote]).validate_basic()
+
+    other_round = _vote(sk, 0, round_=1)
+    with pytest.raises(ValueError, match="wrong height/round"):
+        Commit(good.block_id, [good, other_round]).validate_basic()
+
+
+def test_block_serde_round_trip():
+    block = _commit_block(txs=(b"a", b"", b"c" * 1000))
+    data = block.encode()
+    back = serde.decode_block(data)
+    assert back.encode() == data
+    assert back.hash() == block.hash()
+    assert back.data.txs == block.data.txs
+    assert back.last_commit.precommits[0].signature == block.last_commit.precommits[0].signature
+
+
+# --- PartSet ---------------------------------------------------------------
+
+
+def test_part_set_round_trip_and_proofs():
+    data = bytes(range(256)) * 40  # 10240 bytes
+    ps = PartSet.from_data(data, part_size=1024)
+    assert ps.total() == 10
+    assert ps.is_complete() and ps.assemble() == data
+
+    # rebuild from header by gossiping parts; every part proof verifies
+    rx = PartSet(ps.header())
+    order = [7, 0, 3, 9, 1, 2, 5, 4, 8, 6]
+    for i, idx in enumerate(order):
+        part = ps.get_part(idx)
+        assert part.validate(ps.header())
+        assert rx.add_part(part)
+        assert rx.count() == i + 1
+        assert rx.is_complete() == (i == len(order) - 1)
+    assert rx.assemble() == data
+    assert rx.bit_array().is_full()
+
+
+def test_part_set_rejects_bad_parts():
+    data = b"\xab" * 4000
+    ps = PartSet.from_data(data, part_size=1024)
+    rx = PartSet(ps.header())
+    p0 = ps.get_part(0)
+
+    # duplicate add is a no-op
+    assert rx.add_part(p0)
+    assert not rx.add_part(p0)
+    assert rx.count() == 1
+
+    # tampered bytes fail the merkle proof and are refused loudly
+    p1 = ps.get_part(1)
+    bad = Part(index=1, bytes=p1.bytes[:-1] + b"\x00", proof=p1.proof)
+    assert not bad.validate(ps.header())
+    with pytest.raises(ValueError, match="invalid part proof"):
+        rx.add_part(bad)
+
+    # part presented under the wrong index fails
+    p2 = ps.get_part(2)
+    wrong_idx = Part(index=3, bytes=p2.bytes, proof=p2.proof)
+    assert not wrong_idx.validate(ps.header())
+
+    # index beyond the set is out of range
+    with pytest.raises(ValueError, match="out of range"):
+        rx.add_part(Part(index=4, bytes=p2.bytes, proof=p2.proof))
+    assert rx.get_part(99) is None
+
+    # proof from a different part set fails
+    other = PartSet.from_data(b"\xcd" * 4000, part_size=1024)
+    assert not other.get_part(1).validate(ps.header())
+
+
+def test_part_set_uneven_tail():
+    data = b"z" * (1024 * 3 + 17)
+    ps = PartSet.from_data(data, part_size=1024)
+    assert ps.total() == 4
+    assert len(ps.get_part(3).bytes) == 17
+    assert ps.assemble() == data
+
+
+# --- Evidence --------------------------------------------------------------
+
+
+def test_duplicate_vote_evidence_verify_matrix():
+    sk = _key(2)
+    a = _vote(sk, 3, block_hash=b"\x01" * 20)
+    b = _vote(sk, 3, block_hash=b"\x02" * 20)
+    ev = DuplicateVoteEvidence(sk.pub_key(), a, b)
+    ev.verify(CHAIN)  # genuine equivocation
+
+    with pytest.raises(ErrEvidenceInvalid, match="height/round/type"):
+        DuplicateVoteEvidence(sk.pub_key(), a, _vote(sk, 3, round_=2)).verify(CHAIN)
+
+    other = _key(3)
+    with pytest.raises(ErrEvidenceInvalid, match="different validators"):
+        DuplicateVoteEvidence(sk.pub_key(), a, _vote(other, 4, block_hash=b"\x02" * 20)).verify(CHAIN)
+
+    with pytest.raises(ErrEvidenceInvalid, match="does not match pubkey"):
+        DuplicateVoteEvidence(other.pub_key(), a, b).verify(CHAIN)
+
+    with pytest.raises(ErrEvidenceInvalid, match="same block"):
+        DuplicateVoteEvidence(sk.pub_key(), a, a.copy()).verify(CHAIN)
+
+    forged = b.copy()
+    forged.signature = bytes(64)
+    with pytest.raises(ErrEvidenceInvalid, match="invalid signature"):
+        DuplicateVoteEvidence(sk.pub_key(), a, forged).verify(CHAIN)
+
+    # evidence signed for another chain id does not verify here
+    with pytest.raises(ErrEvidenceInvalid, match="invalid signature"):
+        ev.verify("other-chain")
+
+
+# --- ValidatorSet proposer rotation ---------------------------------------
+
+
+def _valset(powers):
+    vals = [Validator.new(_key(100 + i).pub_key(), p) for i, p in enumerate(powers)]
+    return ValidatorSet(vals)
+
+
+def test_proposer_frequency_proportional_to_power():
+    """Over total_power consecutive rounds each validator proposes
+    exactly voting_power times (reference TestProposerSelection3 /
+    the priority scheme's fairness invariant)."""
+    powers = [1, 2, 3, 10]
+    vs = _valset(powers)
+    by_addr = {v.address: 0 for v in vs.validators}
+    power_of = {v.address: v.voting_power for v in vs.validators}
+    total = vs.total_voting_power()
+    for _ in range(total):
+        by_addr[vs.get_proposer().address] += 1
+        vs.increment_proposer_priority(1)
+    for addr, n in by_addr.items():
+        assert n == power_of[addr], (n, power_of[addr])
+
+
+def test_increment_times_equals_repeated_single():
+    """increment(times=k) must land on the same proposer sequence as k
+    single increments (reference IncrementProposerPriority semantics)."""
+    a, b = _valset([5, 7, 11]), _valset([5, 7, 11])
+    seq_a = []
+    for _ in range(12):
+        a.increment_proposer_priority(1)
+        seq_a.append(a.get_proposer().address)
+    b.increment_proposer_priority(12)
+    assert b.get_proposer().address == seq_a[-1]
+
+
+def test_priorities_stay_centered_and_bounded():
+    """After any number of rounds, priorities remain centered near zero
+    and their spread is clipped to 2*total_power (reference
+    RescalePriorities + shiftByAvgProposerPriority)."""
+    vs = _valset([1, 1000, 5, 250])
+    total = vs.total_voting_power()
+    for _ in range(50):
+        vs.increment_proposer_priority(1)
+        prios = [v.proposer_priority for v in vs.validators]
+        assert abs(sum(prios)) < total, prios
+    vs.increment_proposer_priority(1)
+    prios = [v.proposer_priority for v in vs.validators]
+    assert max(prios) - min(prios) <= 2 * total
+
+
+def test_proposer_tie_breaks_by_address():
+    """Equal priority resolves to the lower address; otherwise the higher
+    priority wins regardless of address order."""
+    a = Validator.new(_key(200).pub_key(), 3)
+    b = Validator.new(_key(201).pub_key(), 3)
+    lo, hi = sorted((a, b), key=lambda v: v.address)
+    assert lo.compare_proposer_priority(hi) is lo
+    assert hi.compare_proposer_priority(lo) is lo
+    hi.proposer_priority = 1
+    assert lo.compare_proposer_priority(hi) is hi
+    hi.proposer_priority = -1
+    assert hi.compare_proposer_priority(lo) is lo
+
+
+def test_increment_rejects_pathological_times():
+    vs = _valset([1, 2])
+    with pytest.raises(ValueError, match="too large"):
+        vs.increment_proposer_priority(100_001)
