@@ -124,6 +124,14 @@ class Harness:
                 return m.data
         raise AssertionError("event did not arrive")
 
+    def wait_evidence(self, timeout=8.0):
+        """Block until the (fake) evidence pool receives something."""
+        deadline = time.time() + timeout
+        while not self.cs.evpool.evidence and time.time() < deadline:
+            time.sleep(0.01)
+        assert self.cs.evpool.evidence, "no evidence arrived"
+        return self.cs.evpool.evidence[0]
+
     # -- scripted stub actions -----------------------------------------
 
     def stub_vote(self, i, type_, round_, block_id, height=1):
@@ -482,11 +490,7 @@ class TestCommitAndEvidence:
                 1, VOTE_TYPE_PREVOTE, 0,
                 BlockID(hash=alt.hash(), parts_header=alt_parts.header()),
             )
-            deadline = time.time() + 8
-            while not h.cs.evpool.evidence and time.time() < deadline:
-                time.sleep(0.01)
-            assert h.cs.evpool.evidence, "no evidence created from equivocation"
-            ev = h.cs.evpool.evidence[0]
+            ev = h.wait_evidence()
             assert ev.vote_a.block_id != ev.vote_b.block_id
         finally:
             h.stop()

@@ -1,0 +1,117 @@
+"""Consensus safety boundaries: quorum strictness, polka-gated locking,
+nil rounds, precommit equivocation — the remaining scenarios of the
+reference's consensus/state_test.go family (TestStateFullRoundNil,
+TestStateLockNoPOL polka gating, TestStateSlashingPrecommits) plus the
+>2/3 commit boundary driven through the LIVE vote path.
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_consensus_pol import Harness
+
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+)
+
+
+class TestFullRoundNil:
+    def test_nil_round_advances_without_lock_or_commit(self):
+        """No proposal ever arrives (the stub proposer stays silent):
+        propose-timeout → we prevote nil; stubs prevote nil → we
+        precommit nil; stubs precommit nil → round 1. Nothing locks,
+        nothing commits (reference TestStateFullRoundNil)."""
+        h = Harness(we_propose_first=False).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0, timeout=15)
+            assert pv0.block_id.hash == b"", "must prevote nil without a proposal"
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, BlockID())
+            pc0 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            assert pc0.block_id.hash == b""
+            h.stub_votes(VOTE_TYPE_PRECOMMIT, 0, BlockID())
+            h.wait_event(h.rounds, pred=lambda rs: rs.round == 1)
+            assert h.cs.rs.locked_block is None
+            assert h.cs.rs.height == 1, "nil round must not commit anything"
+        finally:
+            h.stop()
+
+
+class TestPolkaGating:
+    def test_no_lock_without_two_thirds_prevotes(self):
+        """We propose B but the prevotes split 2-for-B / 2-nil (ours +
+        stub 1 for B, stubs 2 and 3 nil): with all 4 votes in, 2/3-any
+        is reached and prevote-wait fires, yet there is NO polka — we
+        must precommit nil and must NOT lock. Locking on less than +2/3
+        prevotes would be a safety violation (state.go:1044-1052
+        requires the polka)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            assert pv0.block_id.hash
+            h.stub_vote(1, VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.stub_vote(2, VOTE_TYPE_PREVOTE, 0, BlockID())
+            h.stub_vote(3, VOTE_TYPE_PREVOTE, 0, BlockID())
+            pc0 = h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0, timeout=15)
+            assert pc0.block_id.hash == b"", "precommit without polka must be nil"
+            assert h.cs.rs.locked_block is None, "locked without +2/3 prevotes"
+        finally:
+            h.stop()
+
+
+class TestCommitQuorumBoundary:
+    def test_half_precommits_do_not_commit_third_does(self):
+        """With 4 equal validators the commit threshold is 3 (>2/3 of 4).
+        Ours + one stub precommit for B (2/4 = 50%) must NOT commit —
+        assert no NewBlock and height unchanged over a real delay — and
+        the third precommit must then commit immediately (the live-path
+        equivalent of the VoteSet quorum math,
+        types/vote_set.go:263 / validator_set.go:358-366)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+            h.stub_vote(1, VOTE_TYPE_PRECOMMIT, 0, pv0.block_id)
+
+            # 2 of 4 precommits: no commit may happen
+            assert h.blocks.get(timeout=1.5) is None
+            assert h.cs.rs.height == 1
+
+            h.stub_vote(2, VOTE_TYPE_PRECOMMIT, 0, pv0.block_id)
+            blk = h.wait_event(h.blocks)["block"]
+            assert blk.header.height == 1
+            assert blk.hash() == pv0.block_id.hash
+        finally:
+            h.stop()
+
+
+class TestSlashingPrecommits:
+    def test_conflicting_precommits_become_evidence(self):
+        """A stub equivocates at the PRECOMMIT step (same round, two
+        blocks) → DuplicateVoteEvidence with type=precommit lands in the
+        evidence pool (reference TestStateSlashingPrecommits,
+        state.go:1476-1482)."""
+        h = Harness(we_propose_first=True).start()
+        try:
+            pv0 = h.wait_our_vote(VOTE_TYPE_PREVOTE, 0)
+            h.stub_votes(VOTE_TYPE_PREVOTE, 0, pv0.block_id)
+            h.wait_our_vote(VOTE_TYPE_PRECOMMIT, 0)
+
+            h.stub_vote(1, VOTE_TYPE_PRECOMMIT, 0, pv0.block_id)
+            alt, alt_parts = h.make_alt_block(1, txs=(b"equivocate-pc",))
+            h.stub_vote(
+                1, VOTE_TYPE_PRECOMMIT, 0,
+                BlockID(hash=alt.hash(), parts_header=alt_parts.header()),
+            )
+            ev = h.wait_evidence()
+            assert ev.vote_a.type == VOTE_TYPE_PRECOMMIT
+            assert ev.vote_a.block_id != ev.vote_b.block_id
+        finally:
+            h.stop()
