@@ -1,0 +1,106 @@
+"""Break the XLA prelude of the packed verify pipeline into stages and
+slope-time each on the real chip: (a) byte unpack + SHA block build,
+(b) SHA-512 compression, (c) scalar reduce + window extraction.
+"""
+
+import os
+import secrets
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.crypto.jaxed25519 import pack, scalar, sha512
+from tendermint_tpu.crypto.jaxed25519 import verify as V
+from tendermint_tpu.crypto.jaxed25519.curve import _windows_msb_first
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+
+sks = [keys.PrivKeyEd25519.generate() for _ in range(128)]
+msgs, sigs, pks = [], [], []
+for i in range(N):
+    sk = sks[i % len(sks)]
+    m = secrets.token_bytes(110)
+    msgs.append(m)
+    sigs.append(sk.sign(m))
+    pks.append(sk.pub_key().bytes())
+sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(N, 64)
+pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(N, 32)
+buf, nb, mrows, bpad = V.pack_buffer(msgs, sig_arr, pk_arr, 1)
+dbuf = jax.device_put(buf)
+
+
+def unpack_stage(buf):
+    bdim = buf.shape[-1]
+    mlen = buf[0]
+    sig_bytes = V._bytes_from_rows(buf[1:17], 64)
+    pk_bytes = V._bytes_from_rows(buf[17:25], 32)
+    msg_bytes = V._bytes_from_rows(buf[25:], mrows * 4)
+    region_len = nb * 128 - 64
+    if mrows * 4 < region_len:
+        msg_bytes = jnp.concatenate(
+            [msg_bytes, jnp.zeros((region_len - mrows * 4, bdim), jnp.int32)], axis=0)
+    j = jnp.arange(region_len, dtype=jnp.int32)[:, None]
+    inb = (mlen + 64 + 17 + 127) // 128
+    region = jnp.where(j < mlen[None, :], msg_bytes, 0)
+    region = region + jnp.where(j == mlen[None, :], 0x80, 0)
+    bitlen = (mlen + 64) * 8
+    base = inb * 128 - 72
+    for t in range(8):
+        v = (bitlen >> (8 * (7 - t))) & 0xFF
+        region = region + jnp.where(j == (base + t)[None, :], v[None, :], 0)
+    full = jnp.concatenate([sig_bytes[:32], pk_bytes, region], axis=0)
+    f4 = full.astype(jnp.uint32).reshape(nb * 32, 4, bdim)
+    words32 = (f4[:, 0] << 24) | (f4[:, 1] << 16) | (f4[:, 2] << 8) | f4[:, 3]
+    words = words32.reshape(nb, 16, 2, bdim)
+    r_y = V._limbs_from_bytes(sig_bytes[:32])
+    s_limbs = V._limbs_from_bytes(sig_bytes[32:64])
+    a_y = V._limbs_from_bytes(pk_bytes)
+    return words, inb, r_y, s_limbs, a_y
+
+
+def sha_stage(words, inb):
+    return sha512.sha512_batch(words, inb)
+
+
+def reduce_windows_stage(digest, s_limbs):
+    k = scalar.reduce_512(sha512.digest_to_scalar_limbs(digest))
+    bdim = k.shape[-1]
+    return _windows_msb_first(s_limbs, bdim), _windows_msb_first(k, bdim)
+
+
+u_j = jax.jit(unpack_stage)
+s_j = jax.jit(sha_stage)
+r_j = jax.jit(reduce_windows_stage)
+
+
+def slope(fn, args, k=8):
+    out = fn(*args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])
+    ests = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        t1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        tk = time.perf_counter() - t0
+        ests.append((tk - t1) / (k - 1) * 1000)
+    return sorted(ests)[1]
+
+
+u_ms = slope(u_j, (dbuf,))
+words, inb, r_y, s_limbs, a_y = [jnp.asarray(x) for x in u_j(dbuf)]
+sh_ms = slope(s_j, (words, inb))
+digest = jnp.asarray(s_j(words, inb))
+rw_ms = slope(r_j, (digest, s_limbs))
+print(f"N={N}: unpack+blocks {u_ms:.1f} ms, sha512 {sh_ms:.1f} ms, "
+      f"reduce+windows {rw_ms:.1f} ms")
