@@ -1,0 +1,175 @@
+"""Error matrix for ValidatorSet.verify_commit — the north-star API
+(reference types/validator_set.go:330-378 VerifyCommit semantics:
+structural checks, per-signature validity, and the strict >2/3 tally of
+votes FOR the block).
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    PartSetHeader,
+    Vote,
+)
+from tendermint_tpu.types.block import Commit
+from tendermint_tpu.types.validator_set import (
+    ErrInvalidCommit,
+    ErrInvalidCommitSignatures,
+    ErrNotEnoughVotingPower,
+    Validator,
+    ValidatorSet,
+)
+
+CHAIN = "vc-chain"
+HEIGHT = 7
+BLOCK_ID = BlockID(b"\x0b" * 20, PartSetHeader(2, b"\x0c" * 20))
+NIL_ID = BlockID()
+
+
+def _net(powers=(10, 10, 10, 10)):
+    sks = [keys.PrivKeyEd25519.gen_from_secret(b"vc-%d" % i)
+           for i in range(len(powers))]
+    vals = [Validator.new(sk.pub_key(), p) for sk, p in zip(sks, powers)]
+    vs = ValidatorSet(vals)
+    # map secret keys to the set's address-sorted order
+    by_addr = {sk.pub_key().address(): sk for sk in sks}
+    sorted_sks = [by_addr[v.address] for v in vs.validators]
+    return vs, sorted_sks
+
+
+def _precommit(vs, sks, idx, block_id=BLOCK_ID, height=HEIGHT, round_=0,
+               type_=VOTE_TYPE_PRECOMMIT, tamper_sig=False):
+    v = Vote(
+        validator_address=vs.validators[idx].address,
+        validator_index=idx,
+        height=height,
+        round=round_,
+        timestamp=1_700_000_000_000_000_000 + idx,
+        type=type_,
+        block_id=block_id,
+    )
+    v.signature = sks[idx].sign(v.sign_bytes(CHAIN))
+    if tamper_sig:
+        v.signature = bytes([v.signature[0] ^ 1]) + v.signature[1:]
+    return v
+
+
+def _commit(vs, sks, votes_for=(0, 1, 2, 3), **kw):
+    pre = [None] * len(vs.validators)
+    for i in votes_for:
+        pre[i] = _precommit(vs, sks, i, **kw)
+    return Commit(BLOCK_ID, pre)
+
+
+def test_valid_commit_passes():
+    vs, sks = _net()
+    vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, _commit(vs, sks))
+
+
+def test_absent_validator_still_quorum():
+    vs, sks = _net()
+    vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, _commit(vs, sks, votes_for=(0, 1, 2)))
+
+
+def test_size_mismatch_rejected():
+    vs, sks = _net()
+    c = _commit(vs, sks)
+    c.precommits.append(None)
+    with pytest.raises(ErrInvalidCommit, match="precommits for"):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, c)
+
+
+def test_wrong_height_rejected():
+    vs, sks = _net()
+    with pytest.raises(ErrInvalidCommit, match="height"):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT + 1, _commit(vs, sks))
+
+
+def test_mixed_round_rejected():
+    vs, sks = _net()
+    pre = [
+        _precommit(vs, sks, 0, round_=0),
+        _precommit(vs, sks, 1, round_=1),  # different round
+        _precommit(vs, sks, 2, round_=0),
+        _precommit(vs, sks, 3, round_=0),
+    ]
+    with pytest.raises(ErrInvalidCommit, match="round"):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, Commit(BLOCK_ID, pre))
+
+
+def test_prevote_in_commit_rejected():
+    vs, sks = _net()
+    pre = [_precommit(vs, sks, i) for i in range(4)]
+    pre[2] = _precommit(vs, sks, 2, type_=VOTE_TYPE_PREVOTE)
+    with pytest.raises(ErrInvalidCommit, match="vote type"):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, Commit(BLOCK_ID, pre))
+
+
+def test_bad_signature_names_the_validator():
+    vs, sks = _net()
+    pre = [_precommit(vs, sks, i, tamper_sig=(i == 2)) for i in range(4)]
+    with pytest.raises(ErrInvalidCommitSignatures, match="validator 2"):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, Commit(BLOCK_ID, pre))
+
+
+def test_signature_for_other_chain_rejected():
+    vs, sks = _net()
+    pre = [_precommit(vs, sks, i) for i in range(4)]
+    v = Vote(
+        validator_address=vs.validators[1].address,
+        validator_index=1,
+        height=HEIGHT,
+        round=0,
+        timestamp=1_700_000_000_000_000_001,
+        type=VOTE_TYPE_PRECOMMIT,
+        block_id=BLOCK_ID,
+    )
+    v.signature = sks[1].sign(v.sign_bytes("other-chain"))
+    pre[1] = v
+    with pytest.raises(ErrInvalidCommitSignatures):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, Commit(BLOCK_ID, pre))
+
+
+def test_nil_votes_count_for_validity_but_not_quorum():
+    """Valid precommits for nil/another block pass the signature check
+    but do NOT count toward the +2/3 tally for block_id (reference
+    :358-371): 2 for-block + 2 nil = no quorum."""
+    vs, sks = _net()
+    pre = [
+        _precommit(vs, sks, 0),
+        _precommit(vs, sks, 1),
+        _precommit(vs, sks, 2, block_id=NIL_ID),
+        _precommit(vs, sks, 3, block_id=NIL_ID),
+    ]
+    with pytest.raises(ErrNotEnoughVotingPower):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, Commit(BLOCK_ID, pre))
+
+
+def test_exactly_two_thirds_is_not_enough():
+    """The rule is STRICTLY greater than 2/3: with powers (1,1,1) two
+    votes tally 2 == 2/3*3 and must fail; with a third it passes."""
+    vs, sks = _net(powers=(1, 1, 1))
+    with pytest.raises(ErrNotEnoughVotingPower):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT,
+                         _commit(vs, sks, votes_for=(0, 1)))
+    vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT, _commit(vs, sks, votes_for=(0, 1, 2)))
+
+
+def test_quorum_weighted_by_power_not_count():
+    """One whale validator with >2/3 of the power carries the commit
+    alone; three minnows together do not."""
+    vs, sks = _net(powers=(100, 1, 1, 1))
+    whale = next(i for i, v in enumerate(vs.validators) if v.voting_power == 100)
+    minnows = tuple(i for i in range(4) if i != whale)
+    vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT,
+                     _commit(vs, sks, votes_for=(whale,)))
+    with pytest.raises(ErrNotEnoughVotingPower):
+        vs.verify_commit(CHAIN, BLOCK_ID, HEIGHT,
+                         _commit(vs, sks, votes_for=minnows))
