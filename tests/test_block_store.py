@@ -1,0 +1,129 @@
+"""BlockStore unit matrix (reference blockchain/store_test.go):
+save/load round trips for blocks, metas, parts, canonical vs seen
+commits; contiguity and completeness guards; persistence across reopen.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    PartSetHeader,
+    Vote,
+)
+from tendermint_tpu.types.block import Block, Commit, make_part_set
+from tendermint_tpu.types.part_set import PartSet
+
+CHAIN = "bs-chain"
+SK = keys.PrivKeyEd25519.gen_from_secret(b"block-store")
+
+
+def _commit_for(height, block_hash=b"\x01" * 20):
+    bid = BlockID(block_hash, PartSetHeader(1, b"\x02" * 20))
+    v = Vote(
+        validator_address=SK.pub_key().address(),
+        validator_index=0,
+        height=height,
+        round=0,
+        timestamp=1000 + height,
+        type=VOTE_TYPE_PRECOMMIT,
+        block_id=bid,
+    )
+    v.signature = SK.sign(v.sign_bytes(CHAIN))
+    return Commit(bid, [v])
+
+
+def _block(height, last_commit, txs=(b"tx",)):
+    b = Block.make(height, list(txs), last_commit, [])
+    b.header.validators_hash = b"\x05" * 20
+    return b
+
+
+def _save_chain(store, n, part_size=256):
+    blocks = []
+    last_commit = None
+    for h in range(1, n + 1):
+        blk = _block(h, last_commit, txs=(b"tx-%d" % h, b"x" * 300))
+        parts = make_part_set(blk, part_size)
+        seen = _commit_for(h, blk.hash())
+        store.save_block(blk, parts, seen)
+        blocks.append((blk, parts, seen))
+        last_commit = seen
+    return blocks
+
+
+def test_round_trip_blocks_metas_parts_commits():
+    db = MemDB()
+    store = BlockStore(db)
+    assert store.height() == 0
+    blocks = _save_chain(store, 3)
+    assert store.height() == 3
+
+    for h, (blk, parts, seen) in enumerate(blocks, start=1):
+        got = store.load_block(h)
+        assert got.hash() == blk.hash()
+        assert got.data.txs == blk.data.txs
+        meta = store.load_block_meta(h)
+        assert meta.block_id.hash == blk.hash()
+        assert meta.block_id.parts_header == parts.header()
+        for i in range(parts.total()):
+            p = store.load_block_part(h, i)
+            assert p.bytes == parts.get_part(i).bytes
+            assert p.validate(parts.header())
+        sc = store.load_seen_commit(h)
+        assert sc.precommits[0].signature == seen.precommits[0].signature
+
+    # canonical commit for h is persisted when h+1 is saved
+    assert store.load_block_commit(1) is not None
+    assert store.load_block_commit(2) is not None
+    assert store.load_block_commit(3) is None  # no block 4 yet
+
+
+def test_missing_heights_return_none():
+    store = BlockStore(MemDB())
+    _save_chain(store, 1)
+    assert store.load_block(2) is None
+    assert store.load_block_meta(99) is None
+    assert store.load_block_part(1, 999) is None
+    assert store.load_seen_commit(5) is None
+
+
+def test_non_contiguous_save_rejected():
+    store = BlockStore(MemDB())
+    blocks = _save_chain(store, 1)
+    blk3 = _block(3, blocks[-1][2])
+    with pytest.raises(ValueError, match="expected 2"):
+        store.save_block(blk3, make_part_set(blk3, 256), _commit_for(3, blk3.hash()))
+    # re-saving the current height is equally rejected
+    blk1, parts1, seen1 = blocks[0]
+    with pytest.raises(ValueError, match="expected 2"):
+        store.save_block(blk1, parts1, seen1)
+
+
+def test_incomplete_part_set_rejected():
+    store = BlockStore(MemDB())
+    blk = _block(1, None, txs=(b"big" * 200,))  # guarantee multiple parts
+    full = make_part_set(blk, 128)
+    assert full.total() > 1
+    partial = PartSet(full.header())
+    partial.add_part(full.get_part(0))
+    with pytest.raises(ValueError, match="incomplete"):
+        store.save_block(blk, partial, _commit_for(1, blk.hash()))
+    with pytest.raises(ValueError, match="nil block"):
+        store.save_block(None, full, _commit_for(1))
+
+
+def test_height_persists_across_reopen():
+    db = MemDB()
+    store = BlockStore(db)
+    _save_chain(store, 2)
+    again = BlockStore(db)  # fresh instance over the same db
+    assert again.height() == 2
+    assert again.load_block(2) is not None
