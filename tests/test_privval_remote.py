@@ -132,7 +132,9 @@ def test_node_with_remote_signer(tmp_path):
     node_holder = {}
 
     def start_signer():
-        deadline = time.time() + 15
+        # generous: on a loaded 1-core box node construction before
+        # listen() can take tens of seconds (jax import, DB setup)
+        deadline = time.time() + 60
         while not os.path.exists(sock_path) and time.time() < deadline:
             time.sleep(0.05)
         srv = RemoteSignerServer(f"unix://{sock_path}", signer_pv)
@@ -146,7 +148,7 @@ def test_node_with_remote_signer(tmp_path):
     node.start()
     try:
         h = 0
-        deadline = time.time() + 30
+        deadline = time.time() + 90
         while h < 3 and time.time() < deadline:
             m = sub.get(timeout=1.0)
             if m is not None:
