@@ -112,9 +112,21 @@ class PeerState:
         """reactor.go:1091-1137."""
         with self._lock:
             prs = self.prs
+            # ignore duplicates or decreases (reference CompareHRS guard,
+            # reactor.go:1096-1099): a stale/replayed round-step must not
+            # regress our view of the peer and wipe its vote bit arrays
+            if (msg.height, msg.round, msg.step) <= (prs.height, prs.round, prs.step):
+                return
             ps_height, ps_round = prs.height, prs.round
             ps_catchup_round = prs.catchup_commit_round
-            ps_last_commit, ps_last_commit_round = prs.last_commit, prs.last_commit_round
+            ps_catchup_commit = prs.catchup_commit
+            # snapshot BEFORE the wipe below: v0.27's reactor.go:1131
+            # reads Precommits after nil-ing it, losing the peer's
+            # last-commit knowledge on every height transition (fixed in
+            # later upstream); we keep the fixed semantics — the bits are
+            # genuine peer knowledge and skipping them avoids re-sending
+            # every precommit the peer already has
+            ps_precommits = prs.precommits
 
             prs.height = msg.height
             prs.round = msg.round
@@ -129,12 +141,12 @@ class PeerState:
                 prs.prevotes = None
                 prs.precommits = None
             if ps_height == msg.height and ps_round != msg.round and msg.round == ps_catchup_round:
-                prs.precommits = prs.catchup_commit
+                prs.precommits = ps_catchup_commit
             if ps_height != msg.height:
-                # peer moved a height: shift commit tracking
+                # peer moved a height: shift precommits to last_commit
                 if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
                     prs.last_commit_round = msg.last_commit_round
-                    prs.last_commit = prs.precommits
+                    prs.last_commit = ps_precommits
                 else:
                     prs.last_commit_round = msg.last_commit_round
                     prs.last_commit = None

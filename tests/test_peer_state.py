@@ -1,0 +1,219 @@
+"""PeerState unit matrix — the reactor's per-peer knowledge tracker
+(reference consensus/reactor.go:895-1334): round-step transitions reset
+the right fields, vote bit arrays route by (height, round, type),
+VoteSetBits unions with our knowledge, and pick_vote_to_send never
+repeats or picks votes the peer has.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.consensus.messages import (
+    CommitStepMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    ProposalPOLMessage,
+    VoteSetBitsMessage,
+)
+from tendermint_tpu.consensus.reactor import PeerState
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    Vote,
+)
+from tendermint_tpu.types.basic import PartSetHeader
+from tendermint_tpu.types.validator_set import random_validator_set
+from tendermint_tpu.types.vote_set import VoteSet
+
+CHAIN = "ps-chain"
+
+
+def _ps(height=5, round_=0):
+    ps = PeerState(peer=None)
+    ps.apply_new_round_step(NewRoundStepMessage(height=height, round=round_, step=1))
+    return ps
+
+
+def test_new_round_resets_round_scoped_fields():
+    ps = _ps(5, 0)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.apply_has_vote(HasVoteMessage(height=5, round=0, type=VOTE_TYPE_PREVOTE, index=2))
+    assert ps.prs.prevotes.get_index(2)
+
+    # same height, new round: prevotes/precommits/proposal state reset
+    ps.apply_new_round_step(NewRoundStepMessage(height=5, round=1, step=1))
+    assert ps.prs.prevotes is None and ps.prs.precommits is None
+    assert ps.prs.proposal is False and ps.prs.proposal_pol_round == -1
+
+
+def test_height_advance_shifts_precommits_into_last_commit():
+    """On a height+1 transition with matching last_commit_round, the
+    peer's tracked precommits become its last_commit knowledge (v0.27's
+    reactor.go:1131 loses these bits by reading the wiped array — later
+    upstream fixed it; we keep the fixed semantics so gossip does not
+    re-send precommits the peer already has)."""
+    ps = _ps(5, 3)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.ensure_catchup_commit_round(5, 2, 4)
+    ps.apply_has_vote(HasVoteMessage(5, 3, VOTE_TYPE_PRECOMMIT, 1))
+    ps.apply_new_round_step(
+        NewRoundStepMessage(height=6, round=0, step=1, last_commit_round=3)
+    )
+    assert ps.prs.height == 6
+    assert ps.prs.last_commit_round == 3
+    assert ps.prs.prevotes is None and ps.prs.precommits is None
+    assert ps.prs.catchup_commit_round == -1 and ps.prs.catchup_commit is None
+    # the precommit bit carried over into last_commit
+    assert ps.prs.last_commit is not None and ps.prs.last_commit.get_index(1)
+    # vote routing targets it for (height, last_commit_round, precommit)
+    from types import SimpleNamespace
+
+    ps.set_has_vote(SimpleNamespace(height=5, round=3,
+                                    type=VOTE_TYPE_PRECOMMIT,
+                                    validator_index=2))
+    assert ps.prs.last_commit.get_index(2)
+
+    # a skipped-round transition (last_commit_round mismatch) drops them
+    ps2 = _ps(5, 3)
+    ps2.ensure_vote_bit_arrays(5, 4)
+    ps2.apply_has_vote(HasVoteMessage(5, 3, VOTE_TYPE_PRECOMMIT, 1))
+    ps2.apply_new_round_step(
+        NewRoundStepMessage(height=6, round=0, step=1, last_commit_round=2)
+    )
+    assert ps2.prs.last_commit is None
+
+
+def test_stale_round_step_is_ignored():
+    """Duplicates or HRS decreases must not regress peer state
+    (reference CompareHRS guard, reactor.go:1096-1099)."""
+    ps = _ps(5, 2)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.apply_has_vote(HasVoteMessage(5, 2, VOTE_TYPE_PREVOTE, 1))
+    before = ps.prs.prevotes
+    # exact duplicate
+    ps.apply_new_round_step(NewRoundStepMessage(height=5, round=2, step=1))
+    assert ps.prs.prevotes is before and before.get_index(1)
+    # lower round
+    ps.apply_new_round_step(NewRoundStepMessage(height=5, round=1, step=1))
+    assert ps.prs.round == 2 and ps.prs.prevotes is before
+    # lower height
+    ps.apply_new_round_step(NewRoundStepMessage(height=4, round=9, step=3))
+    assert ps.prs.height == 5 and ps.prs.prevotes is before
+
+
+def test_commit_step_ignored_at_wrong_height():
+    ps = _ps(5)
+    psh = PartSetHeader(4, b"\x01" * 20)
+    ps.apply_commit_step(CommitStepMessage(height=4, block_parts_header=psh,
+                                           block_parts=BitArray(4)))
+    assert ps.prs.proposal_block_parts_header is None
+    ps.apply_commit_step(CommitStepMessage(height=5, block_parts_header=psh,
+                                           block_parts=BitArray(4)))
+    assert ps.prs.proposal_block_parts_header == psh
+
+
+def test_vote_bit_array_routing():
+    """has-vote updates land in the array matching (height, round, type)
+    and nowhere else."""
+    ps = _ps(5, 1)
+    ps.ensure_vote_bit_arrays(5, 8)
+    ps.apply_has_vote(HasVoteMessage(5, 1, VOTE_TYPE_PREVOTE, 0))
+    ps.apply_has_vote(HasVoteMessage(5, 1, VOTE_TYPE_PRECOMMIT, 1))
+    ps.apply_has_vote(HasVoteMessage(5, 0, VOTE_TYPE_PREVOTE, 2))  # old round: no array
+    ps.apply_has_vote(HasVoteMessage(9, 1, VOTE_TYPE_PREVOTE, 3))  # wrong height
+    assert ps.prs.prevotes.get_index(0)
+    assert not ps.prs.prevotes.get_index(2)
+    assert ps.prs.precommits.get_index(1)
+    assert ps.prs.prevotes.num_true() == 1 and ps.prs.precommits.num_true() == 1
+
+
+def test_vote_set_bits_unions_with_ours():
+    ps = _ps(5, 0)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.apply_has_vote(HasVoteMessage(5, 0, VOTE_TYPE_PREVOTE, 0))
+    claim = BitArray.from_bools([False, True, False, True])
+    ours = BitArray.from_bools([True, False, False, False])
+    ps.apply_vote_set_bits(
+        VoteSetBitsMessage(5, 0, VOTE_TYPE_PREVOTE, BlockID(), claim), ours
+    )
+    got = [ps.prs.prevotes.get_index(i) for i in range(4)]
+    assert got == [True, True, False, True]  # union of prior + claim
+
+    # without our_votes the claim REPLACES tracked knowledge
+    ps2 = _ps(5, 0)
+    ps2.ensure_vote_bit_arrays(5, 4)
+    ps2.apply_has_vote(HasVoteMessage(5, 0, VOTE_TYPE_PREVOTE, 0))
+    ps2.apply_vote_set_bits(
+        VoteSetBitsMessage(5, 0, VOTE_TYPE_PREVOTE, BlockID(), claim), None
+    )
+    got2 = [ps2.prs.prevotes.get_index(i) for i in range(4)]
+    assert got2 == [False, True, False, True]
+
+
+def test_proposal_pol_requires_matching_round():
+    ps = _ps(5, 2)
+    pol = BitArray.from_bools([True] * 4)
+    ps.apply_proposal_pol(ProposalPOLMessage(5, 1, pol))
+    assert ps.prs.proposal_pol is None  # pol round not announced yet
+    ps.prs.proposal_pol_round = 1
+    ps.apply_proposal_pol(ProposalPOLMessage(5, 1, pol))
+    assert ps.prs.proposal_pol is pol
+
+
+def test_catchup_commit_round_tracking():
+    ps = _ps(5, 4)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.ensure_catchup_commit_round(5, 2, 4)
+    assert ps.prs.catchup_commit_round == 2
+    assert ps.prs.catchup_commit is not None
+    # catchup at the CURRENT round aliases the live precommit array
+    ps2 = _ps(5, 4)
+    ps2.ensure_vote_bit_arrays(5, 4)
+    ps2.ensure_catchup_commit_round(5, 4, 4)
+    assert ps2.prs.catchup_commit is ps2.prs.precommits
+
+
+def _voteset_with(chain, n_votes):
+    vals, keys = random_validator_set(4, 10)
+    vs = VoteSet(chain, 5, 0, VOTE_TYPE_PREVOTE, vals)
+    bid = BlockID(b"\x0a" * 20, PartSetHeader(1, b"\x0b" * 20))
+    for i in range(n_votes):
+        addr, _ = vals.get_by_index(i)
+        v = Vote(
+            validator_address=addr,
+            validator_index=i,
+            height=5,
+            round=0,
+            timestamp=1000 + i,
+            type=VOTE_TYPE_PREVOTE,
+            block_id=bid,
+        )
+        v.signature = keys[i].sign(v.sign_bytes(chain))
+        vs.add_vote(v)
+    return vs
+
+
+def test_pick_vote_to_send_covers_all_without_repeats():
+    vs = _voteset_with(CHAIN, 3)
+    ps = _ps(5, 0)
+    picked = set()
+    for _ in range(3):
+        v = ps.pick_vote_to_send(vs)
+        assert v is not None
+        assert v.validator_index not in picked, "vote picked twice"
+        picked.add(v.validator_index)
+    assert ps.pick_vote_to_send(vs) is None  # peer now has everything we do
+    assert picked == {0, 1, 2}
+
+
+def test_pick_vote_skips_votes_peer_already_has():
+    vs = _voteset_with(CHAIN, 2)
+    ps = _ps(5, 0)
+    ps.ensure_vote_bit_arrays(5, 4)
+    ps.apply_has_vote(HasVoteMessage(5, 0, VOTE_TYPE_PREVOTE, 0))
+    v = ps.pick_vote_to_send(vs)
+    assert v is not None and v.validator_index == 1
+    assert ps.pick_vote_to_send(vs) is None
