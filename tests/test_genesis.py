@@ -1,0 +1,115 @@
+"""GenesisDoc + ConsensusParams matrix (reference types/genesis.go,
+types/params.go): validation errors, JSON round trip, ABCI param
+updates, params hash sensitivity.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.types.genesis import (
+    MAX_CHAIN_ID_LEN,
+    BlockSizeParams,
+    ConsensusParams,
+    EvidenceParams,
+    GenesisDoc,
+    GenesisValidator,
+)
+
+PK = keys.PrivKeyEd25519.gen_from_secret(b"genesis").pub_key()
+
+
+def _doc(**kw):
+    base = dict(
+        chain_id="gen-chain",
+        validators=[GenesisValidator(PK, 10, "v0")],
+        app_hash=b"\x01\x02",
+        app_state={"k": "v"},
+    )
+    base.update(kw)
+    return GenesisDoc(**base)
+
+
+def test_validate_matrix():
+    _doc().validate_and_complete()
+    with pytest.raises(ValueError, match="chain_id"):
+        _doc(chain_id="").validate_and_complete()
+    with pytest.raises(ValueError, match="chain_id length"):
+        _doc(chain_id="c" * (MAX_CHAIN_ID_LEN + 1)).validate_and_complete()
+    with pytest.raises(ValueError, match="zero voting power"):
+        _doc(validators=[GenesisValidator(PK, 0)]).validate_and_complete()
+
+    bad_params = ConsensusParams(BlockSizeParams(max_bytes=0))
+    with pytest.raises(ValueError, match="max_bytes"):
+        _doc(consensus_params=bad_params).validate_and_complete()
+    too_big = ConsensusParams(BlockSizeParams(max_bytes=104857600 + 1))
+    with pytest.raises(ValueError, match="max_bytes"):
+        _doc(consensus_params=too_big).validate_and_complete()
+    bad_ev = ConsensusParams(evidence=EvidenceParams(max_age=0))
+    with pytest.raises(ValueError, match="max_age"):
+        _doc(consensus_params=bad_ev).validate_and_complete()
+
+
+def test_json_round_trip():
+    doc = _doc()
+    back = GenesisDoc.from_json(doc.to_json())
+    assert back.chain_id == doc.chain_id
+    assert back.app_hash == doc.app_hash
+    assert back.app_state == {"k": "v"}
+    assert len(back.validators) == 1
+    assert back.validators[0].power == 10
+    assert back.validators[0].pub_key.address() == PK.address()
+    assert back.consensus_params.hash() == doc.consensus_params.hash()
+    # an empty-validator genesis is allowed at load (validators may come
+    # from the app via InitChain) and round-trips
+    empty = GenesisDoc.from_json(_doc(validators=[]).to_json())
+    assert empty.validators == []
+
+
+def test_from_json_rejects_invalid():
+    doc = _doc(chain_id="ok")
+    broken = doc.to_json().replace('"ok"', '""')
+    with pytest.raises(ValueError, match="chain_id"):
+        GenesisDoc.from_json(broken)
+
+
+def test_save_load_file(tmp_path):
+    p = str(tmp_path / "genesis.json")
+    doc = _doc()
+    doc.save(p)
+    assert GenesisDoc.load(p).chain_id == doc.chain_id
+
+
+def test_params_update_and_hash():
+    base = ConsensusParams()
+    assert base.update(None).hash() == base.hash()
+
+    class _BS:
+        max_bytes = 1024
+        max_gas = 55
+
+    class _EV:
+        max_age = 7
+
+    class _Upd:
+        block_size = _BS
+        evidence = _EV
+
+    upd = base.update(_Upd)
+    assert upd.block_size.max_bytes == 1024
+    assert upd.block_size.max_gas == 55
+    assert upd.evidence.max_age == 7
+    assert upd.hash() != base.hash()
+    # original untouched (update is copy-on-write)
+    assert base.block_size.max_bytes == 22020096
+
+    class _Partial:
+        block_size = None
+        evidence = _EV
+
+    part = base.update(_Partial)
+    assert part.block_size.max_bytes == base.block_size.max_bytes
+    assert part.evidence.max_age == 7

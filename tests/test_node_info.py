@@ -1,0 +1,56 @@
+"""NodeInfo validation/compatibility matrix (reference
+p2p/node_info.go:103-173 Validate + CompatibleWith) and wire round trip.
+"""
+
+import pytest
+
+from tendermint_tpu.p2p.node_info import MAX_NUM_CHANNELS, NodeInfo, ProtocolVersion
+
+
+def _ni(**kw):
+    base = dict(
+        protocol_version=ProtocolVersion(1, 1, 0),
+        id="ab" * 20,
+        listen_addr="127.0.0.1:26656",
+        network="chain-A",
+        version="0.1.0",
+        channels=bytes([0x20, 0x21, 0x22]),
+        moniker="node",
+    )
+    base.update(kw)
+    return NodeInfo(**base)
+
+
+def test_validate_ok_and_errors():
+    _ni().validate()
+    with pytest.raises(ValueError, match="too many channels"):
+        _ni(channels=bytes(range(MAX_NUM_CHANNELS + 1))).validate()
+    with pytest.raises(ValueError, match="duplicate"):
+        _ni(channels=bytes([0x20, 0x20])).validate()
+    with pytest.raises(ValueError, match="too long"):
+        _ni(moniker="m" * 256).validate()
+    with pytest.raises(ValueError, match="too long"):
+        _ni(network="n" * 256).validate()
+
+
+def test_compatible_with_matrix():
+    a = _ni()
+    a.compatible_with(_ni())  # identical: fine
+    # different p2p/app versions are tolerated; block version is not
+    a.compatible_with(_ni(protocol_version=ProtocolVersion(9, 1, 7)))
+    with pytest.raises(ValueError, match="block version"):
+        a.compatible_with(_ni(protocol_version=ProtocolVersion(1, 2, 0)))
+    with pytest.raises(ValueError, match="network"):
+        a.compatible_with(_ni(network="chain-B"))
+    with pytest.raises(ValueError, match="no common channels"):
+        a.compatible_with(_ni(channels=bytes([0x40])))
+    # one overlapping channel suffices
+    a.compatible_with(_ni(channels=bytes([0x40, 0x22])))
+
+
+def test_wire_round_trip():
+    a = _ni(rpc_address="tcp://0.0.0.0:26657", tx_index="off")
+    b = NodeInfo.decode(a.encode())
+    assert b == a
+    assert b.channels == bytes([0x20, 0x21, 0x22])
+    assert b.protocol_version == ProtocolVersion(1, 1, 0)
