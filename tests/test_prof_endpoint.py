@@ -1,0 +1,56 @@
+"""Profiling endpoint tests (reference mounts net/http/pprof at
+ProfListenAddress, node/node.go:468-474; ours serves the pprof-style
+routes from rpc/prof.py).
+"""
+
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.rpc.prof import ProfServer
+
+
+@pytest.fixture()
+def prof():
+    srv = ProfServer("127.0.0.1", 0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"http://{srv.listen_addr}{path}", timeout=10) as r:
+        return r.status, r.read().decode(errors="replace")
+
+
+def test_index_lists_routes(prof):
+    status, body = _get(prof, "/debug/pprof/")
+    assert status == 200
+    for route in ("goroutine", "heap", "profile"):
+        assert route in body
+
+
+def test_goroutine_dump_contains_this_thread(prof):
+    status, body = _get(prof, "/debug/pprof/goroutine")
+    assert status == 200
+    # the server thread and the main thread both appear with stacks
+    assert "prof-http" in body
+    assert "MainThread" in body
+
+
+def test_heap_snapshot(prof):
+    status, body = _get(prof, "/debug/pprof/heap")
+    assert status == 200
+    assert body.strip(), "heap snapshot must not be empty"
+
+
+def test_cpu_profile_short_window(prof):
+    status, body = _get(prof, "/debug/pprof/profile?seconds=1")
+    assert status == 200
+    assert "function calls" in body or "ncalls" in body
+
+
+def test_unknown_route_404(prof):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(prof, "/debug/pprof/nope")
+    assert ei.value.code == 404
