@@ -1,0 +1,87 @@
+"""WAL-file replay command tests (reference consensus/replay_file.go,
+`tendermint replay` / `replay_console`): a real node's WAL replays
+through a rebuilt ConsensusState, and the console stepper honors
+next/rs/quit.
+"""
+
+import io
+import os
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from test_node import init_files, make_config
+
+from tendermint_tpu.consensus.replay_file import _console_prompt, run_replay_file
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+
+def _run_node_for_blocks(c, n=2, timeout=45):
+    node = default_new_node(c)
+    sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 16)
+    node.start()
+    try:
+        h = 0
+        deadline = time.time() + timeout
+        while h < n and time.time() < deadline:
+            m = sub.get(timeout=1.0)
+            if m is not None:
+                h = m.data["block"].header.height
+        assert h >= n, "node did not commit enough blocks"
+    finally:
+        node.stop()
+
+
+def test_replay_runs_full_wal(tmp_path, capsys):
+    c = make_config(tmp_path, "rp0")
+    init_files(c)
+    _run_node_for_blocks(c, 2)
+
+    run_replay_file(c, console=False)
+    out = capsys.readouterr().out
+    assert "replaying" in out and "WAL records" in out
+    assert "#ENDHEIGHT" in out
+    assert "replayed" in out
+    # it actually processed records, not an empty WAL
+    n_records = int(out.split("replaying ")[1].split()[0])
+    assert n_records > 0
+
+
+def test_replay_missing_wal_is_graceful(tmp_path, capsys):
+    c = make_config(tmp_path, "rp1")
+    init_files(c)
+    _run_node_for_blocks(c, 1)
+    os.remove(c.consensus.wal_file(c.root_dir))
+    run_replay_file(c, console=False)
+    err = capsys.readouterr().err
+    assert "no WAL" in err
+
+
+def test_console_prompt_commands(monkeypatch, capsys):
+    class _RS:
+        height, round, step = 7, 1, 3
+
+    class _CS:
+        rs = _RS()
+
+    feed = io.StringIO("rs\nbogus\nnext 5\n")
+    monkeypatch.setattr("builtins.input", lambda prompt="": feed.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    assert _console_prompt(_CS()) == 5
+    out = capsys.readouterr().out
+    assert "height=7" in out  # rs printed state
+    assert "commands:" in out  # unknown command help
+
+    feed2 = io.StringIO("next\n")
+    monkeypatch.setattr("builtins.input", lambda prompt="": feed2.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    assert _console_prompt(_CS()) == 1
+
+    feed3 = io.StringIO("quit\n")
+    monkeypatch.setattr("builtins.input", lambda prompt="": feed3.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    assert _console_prompt(_CS()) == -1
+
+    # EOF ends the console
+    monkeypatch.setattr("builtins.input", lambda prompt="": (_ for _ in ()).throw(EOFError))
+    assert _console_prompt(_CS()) == -1
