@@ -4,7 +4,7 @@ through a rebuilt ConsensusState, and the console stepper honors
 next/rs/quit.
 """
 
-import io
+
 import os
 import time
 
@@ -60,6 +60,20 @@ def test_replay_missing_wal_is_graceful(tmp_path, capsys):
     assert "no WAL" in err
 
 
+def _feed_input(monkeypatch, *lines):
+    """Stub input() to yield `lines` then raise EOFError, like a closed
+    stdin. Preserves empty-line semantics (bare Enter = 'next 1')."""
+    it = iter(lines)
+
+    def fake_input(prompt=""):
+        try:
+            return next(it)
+        except StopIteration:
+            raise EOFError
+
+    monkeypatch.setattr("builtins.input", fake_input)
+
+
 def test_console_prompt_commands(monkeypatch, capsys):
     class _RS:
         height, round, step = 7, 1, 3
@@ -67,21 +81,21 @@ def test_console_prompt_commands(monkeypatch, capsys):
     class _CS:
         rs = _RS()
 
-    feed = io.StringIO("rs\nbogus\nnext 5\n")
-    monkeypatch.setattr("builtins.input", lambda prompt="": feed.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    _feed_input(monkeypatch, "rs", "bogus", "next 5")
     assert _console_prompt(_CS()) == 5
     out = capsys.readouterr().out
     assert "height=7" in out  # rs printed state
     assert "commands:" in out  # unknown command help
 
-    feed2 = io.StringIO("next\n")
-    monkeypatch.setattr("builtins.input", lambda prompt="": feed2.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    _feed_input(monkeypatch, "next")
     assert _console_prompt(_CS()) == 1
 
-    feed3 = io.StringIO("quit\n")
-    monkeypatch.setattr("builtins.input", lambda prompt="": feed3.readline().rstrip("\n") or (_ for _ in ()).throw(EOFError))
+    _feed_input(monkeypatch, "")  # bare Enter steps once
+    assert _console_prompt(_CS()) == 1
+
+    _feed_input(monkeypatch, "quit")
     assert _console_prompt(_CS()) == -1
 
     # EOF ends the console
-    monkeypatch.setattr("builtins.input", lambda prompt="": (_ for _ in ()).throw(EOFError))
+    _feed_input(monkeypatch)
     assert _console_prompt(_CS()) == -1
