@@ -1,10 +1,18 @@
 """Benchmark: the north-star hot path — VerifyCommit at 10k validators.
 
-BASELINE.json config 5: "10k-validator mega-commit VerifyCommit on TPU,
-mixed valid/invalid sigs". Baseline stand-in for the reference's serial Go
-ed25519 path (types/validator_set.go:345-371): a serial OpenSSL
-verify loop (measured on a subset, extrapolated linearly — per-signature
-cost is constant).
+Default run = BASELINE.json config 5: "10k-validator mega-commit
+VerifyCommit on TPU, mixed valid/invalid sigs". Baseline stand-in for the
+reference's serial Go ed25519 path (types/validator_set.go:345-371): a
+serial OpenSSL verify loop (measured on a subset, extrapolated linearly —
+per-signature cost is constant).
+
+The other BASELINE.json configs map to modes:
+  1 "VerifyCommit on a 4-validator genesis commit"  -> `bench.py commit4`
+  2 "1k random triples, serial vs JAX-CPU backend"  ->
+        `TM_TPU_BENCH_FORCE_CPU=1 python bench.py 1000`
+  3 "150-validator prevote+precommit round replay"  -> `bench.py votes`
+  4 "fast-sync block validation, 500-val commits"   -> `bench.py fastsync`
+  5 "10k-validator mega-commit, mixed validity"     -> default
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
@@ -28,7 +36,9 @@ import time
 RLC_MODE = "rlc" in sys.argv[1:]
 VOTES_MODE = "votes" in sys.argv[1:]  # BASELINE.json config 3
 FASTSYNC_MODE = "fastsync" in sys.argv[1:]  # BASELINE.json config 4 (scaled)
-_args = [a for a in sys.argv[1:] if a not in ("rlc", "votes", "fastsync")]
+COMMIT4_MODE = "commit4" in sys.argv[1:]  # BASELINE.json config 1
+_args = [a for a in sys.argv[1:]
+         if a not in ("rlc", "votes", "fastsync", "commit4")]
 try:
     METRIC_N = int(_args[0]) if _args else 10000
 except ValueError:
@@ -40,6 +50,7 @@ VOTES_NVAL = 150
 VOTES_METRIC = f"voteset_replay_{VOTES_NVAL}val_2rounds_wall_ms"
 FS_NVAL, FS_NBLOCKS = 500, 20
 FS_METRIC = f"fastsync_{FS_NBLOCKS}x{FS_NVAL}val_wall_ms"
+COMMIT4_METRIC = "verify_commit_4val_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -159,28 +170,17 @@ def fastsync_main(degraded):
     validation — sequential verify_commit of 20 blocks x 500-validator
     commits (10k signatures), the blockchain/reactor.go:310 loop.
     Baseline stand-in: serial OpenSSL verifies extrapolated."""
-    from tendermint_tpu.crypto import keys as ck
-    from tendermint_tpu.types import VOTE_TYPE_PRECOMMIT, BlockID
+    from tendermint_tpu.types import BlockID
     from tendermint_tpu.types.basic import PartSetHeader
-    from tendermint_tpu.types.block import Commit
-    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
 
     chain = "bench-fastsync"
     nval, nblocks = FS_NVAL, FS_NBLOCKS
-    sks = [ck.PrivKeyEd25519.gen_from_secret(b"fs-%d" % i) for i in range(nval)]
-    vals = [Validator.new(sk.pub_key(), 10) for sk in sks]
-    vs = ValidatorSet(vals)
-    by_addr = {sk.pub_key().address(): sk for sk in sks}
-    sorted_sks = [by_addr[v.address] for v in vs.validators]
+    vs, sorted_sks = _build_valset(nval, b"fs")
 
     commits = []
     for h in range(1, nblocks + 1):
         bid = BlockID(bytes([h % 256]) * 20, PartSetHeader(1, b"\x0c" * 20))
-        pre = [
-            _signed_vote(chain, sorted_sks, vs, i, h, 0, VOTE_TYPE_PRECOMMIT, bid)
-            for i in range(nval)
-        ]
-        commits.append((h, bid, Commit(bid, pre)))
+        commits.append((h, bid, _build_commit(chain, vs, sorted_sks, h, bid)))
 
     # serial baseline (subset of 300 verifies, extrapolated to all sigs;
     # best-of-3 like the batch path)
@@ -216,8 +216,70 @@ def fastsync_main(degraded):
     print(json.dumps(out))
 
 
+def _build_valset(nval: int, seed: bytes):
+    """(validator_set, secret keys aligned to address-sorted order) —
+    fixture shared by the commit4 and fastsync modes."""
+    from tendermint_tpu.crypto import keys as ck
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+    sks = [ck.PrivKeyEd25519.gen_from_secret(seed + b"-%d" % i)
+           for i in range(nval)]
+    vs = ValidatorSet([Validator.new(sk.pub_key(), 10) for sk in sks])
+    by_addr = {sk.pub_key().address(): sk for sk in sks}
+    return vs, [by_addr[v.address] for v in vs.validators]
+
+
+def _build_commit(chain: str, vs, sorted_sks, height: int, bid):
+    """A full commit for `bid` at `height`, every validator signing."""
+    from tendermint_tpu.types import VOTE_TYPE_PRECOMMIT
+    from tendermint_tpu.types.block import Commit
+
+    pre = [
+        _signed_vote(chain, sorted_sks, vs, i, height, 0,
+                     VOTE_TYPE_PRECOMMIT, bid)
+        for i in range(len(sorted_sks))
+    ]
+    return Commit(bid, pre)
+
+
+def commit4_main():
+    """BASELINE.json config 1: VerifyCommit on a 4-validator genesis-style
+    commit. At 4 signatures the serial CPU path is the point — this
+    measures the small-commit common case every block pays, not the
+    batch kernel. The cpu backend is FORCED so no env tuning
+    (TM_TPU_BATCH_MIN, TM_TPU_CRYPTO_BACKEND=jax) can route the
+    benchmarked call into an unguarded jax init (this mode skips the
+    TPU probe and its hang protection)."""
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.types import BlockID
+    from tendermint_tpu.types.basic import PartSetHeader
+
+    crypto_batch.set_default_backend("cpu")
+    chain = "bench-commit4"
+    bid = BlockID(b"\x04" * 20, PartSetHeader(1, b"\x0c" * 20))
+    vs, sorted_sks = _build_valset(4, b"c4")
+    commit = _build_commit(chain, vs, sorted_sks, 1, bid)
+
+    def run():
+        vs.verify_commit(chain, bid, 1, commit)
+
+    run()
+    reps = 50
+    best = _best_of(lambda: [run() for _ in range(reps)], 3) / reps
+    print(json.dumps({
+        "metric": COMMIT4_METRIC,
+        "value": round(best, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "note": "serial CPU path forced by design at 4 sigs",
+    }))
+
+
 def main():
     n = METRIC_N
+    if COMMIT4_MODE:
+        # pure host path: never touch (or wait for) the TPU backend
+        return commit4_main()
     degraded = None
     if os.environ.get("TM_TPU_BENCH_FORCE_CPU") or not _tpu_available():
         degraded = "cpu8"
@@ -368,6 +430,8 @@ if __name__ == "__main__":
             metric = VOTES_METRIC
         elif FASTSYNC_MODE:
             metric = FS_METRIC
+        elif COMMIT4_MODE:
+            metric = COMMIT4_METRIC
         else:
             mode = "_rlc" if RLC_MODE else ""
             metric = f"verify_commit_{METRIC_N}_sigs{mode}_wall_ms"
