@@ -158,6 +158,31 @@ def test_block_serde_round_trip():
     assert back.last_commit.precommits[0].signature == block.last_commit.precommits[0].signature
 
 
+def test_vote_verify_matrix():
+    """Single-vote verify (reference types/vote.go:102-111): address must
+    match the pubkey, signature must cover the canonical sign-bytes of
+    THIS chain/height/round/type/block/timestamp."""
+    sk, other = _key(7), _key(8)
+    v = _vote(sk, 0)
+    assert v.verify(CHAIN, sk.pub_key())
+    # wrong pubkey: address mismatch short-circuits
+    assert not v.verify(CHAIN, other.pub_key())
+    # wrong chain id changes sign-bytes
+    assert not v.verify("other-chain", sk.pub_key())
+    # any field tamper invalidates
+    for field, val in (("height", 6), ("round", 1), ("timestamp", 999),
+                       ("type", VOTE_TYPE_PREVOTE)):
+        t = v.copy()
+        setattr(t, field, val)
+        assert not t.verify(CHAIN, sk.pub_key()), field
+    t = v.copy()
+    t.block_id = BlockID(b"\x07" * 20, PartSetHeader(1, b"\x02" * 20))
+    assert not t.verify(CHAIN, sk.pub_key())
+    t = v.copy()
+    t.signature = bytes(64)
+    assert not t.verify(CHAIN, sk.pub_key())
+
+
 # --- PartSet ---------------------------------------------------------------
 
 
