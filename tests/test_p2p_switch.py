@@ -234,3 +234,22 @@ class TestTrustWiring:
         finally:
             a.stop()
             b.stop()
+
+
+class TestSelfDial:
+    def test_dialing_ourselves_is_rejected(self):
+        """Connecting to our own listener must fail the upgrade: the
+        remote NodeInfo carries our own ID (reference transport
+        dial-to-self / dup-ID rejection, p2p/transport.go:71)."""
+        sw = make_switch("selfie")
+        r = EchoReactor("echo")
+        sw.add_reactor("echo", r)
+        sw.start()
+        try:
+            peer = sw.dial_peer(sw.transport.listen_addr)
+            assert peer is None, "self-dial must not produce a peer"
+            time.sleep(0.2)
+            assert sw.peers.size() == 0
+            assert not r.peers_added
+        finally:
+            sw.stop()
