@@ -348,3 +348,39 @@ def test_increment_rejects_pathological_times():
     vs = _valset([1, 2])
     with pytest.raises(ValueError, match="too large"):
         vs.increment_proposer_priority(100_001)
+
+
+def test_update_with_changes_matrix():
+    """Add / power-change / remove semantics (reference validator_set.go
+    Update/Add/Remove): power change keeps accumulated priority, removal
+    by power 0, unknown removal rejected, negative power rejected, set
+    stays address-sorted, total power cache refreshed."""
+    vs = _valset([5, 7])
+    vs.increment_proposer_priority(3)  # accumulate some priorities
+    a, b = vs.validators[0], vs.validators[1]
+    prio_a = a.proposer_priority
+
+    # power change preserves priority; new validator starts at 0
+    newcomer = Validator.new(_key(300).pub_key(), 4)
+    changed = Validator(a.address, a.pub_key, 9)
+    vs.update_with_changes([changed, newcomer])
+    assert len(vs) == 3
+    assert vs.total_voting_power() == 9 + b.voting_power + 4
+    got_a = next(v for v in vs.validators if v.address == a.address)
+    assert got_a.voting_power == 9 and got_a.proposer_priority == prio_a
+    got_new = next(v for v in vs.validators if v.address == newcomer.address)
+    assert got_new.proposer_priority == 0
+    addrs = [v.address for v in vs.validators]
+    assert addrs == sorted(addrs)
+
+    # removal via power 0; removing the proposer clears it for re-election
+    vs.proposer = got_a
+    vs.update_with_changes([Validator(a.address, a.pub_key, 0)])
+    assert len(vs) == 2
+    assert all(v.address != a.address for v in vs.validators)
+    assert vs.get_proposer() is not None  # re-elected from the remainder
+
+    with pytest.raises(ValueError, match="unknown validator"):
+        vs.update_with_changes([Validator(a.address, a.pub_key, 0)])
+    with pytest.raises(ValueError, match="negative"):
+        vs.update_with_changes([Validator(b.address, b.pub_key, -1)])
