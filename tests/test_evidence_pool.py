@@ -1,0 +1,134 @@
+"""EvidencePool lifecycle matrix (reference evidence/pool.go:17-151 +
+state/validation.go:167-199 VerifyEvidence): admit/duplicate/reject,
+committed-by-block removal, age-based pruning, new-evidence callbacks.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import state as sm
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.evidence import EvidencePool, EvidenceStore
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.state.validation import ErrInvalidBlock
+from tendermint_tpu.types import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    PartSetHeader,
+    Vote,
+)
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+CHAIN = "evpool-chain"
+SK = keys.PrivKeyEd25519.gen_from_secret(b"evpool-val")
+OUTSIDER = keys.PrivKeyEd25519.gen_from_secret(b"evpool-outsider")
+
+
+def _state():
+    doc = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time=1,
+        validators=[GenesisValidator(SK.pub_key(), 10)],
+    )
+    return sm.load_state_from_db_or_genesis(MemDB(), doc)
+
+
+def _equivocation(sk, height=1):
+    def vote(block_hash):
+        v = Vote(
+            validator_address=sk.pub_key().address(),
+            validator_index=0,
+            height=height,
+            round=0,
+            timestamp=1000,
+            type=VOTE_TYPE_PRECOMMIT,
+            block_id=BlockID(block_hash, PartSetHeader(1, b"\x02" * 20)),
+        )
+        v.signature = sk.sign(v.sign_bytes(CHAIN))
+        return v
+
+    return DuplicateVoteEvidence(sk.pub_key(), vote(b"\x01" * 20), vote(b"\x03" * 20))
+
+
+def test_admit_pending_and_duplicate_noop():
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    seen = []
+    pool.notify_new_evidence(seen.append)
+
+    ev = _equivocation(SK)
+    pool.add_evidence(ev)
+    assert [e.hash() for e in pool.pending_evidence()] == [ev.hash()]
+    assert [e.hash() for e in pool.evidence_snapshot()] == [ev.hash()]
+    assert seen and seen[0].hash() == ev.hash()
+    assert not pool.is_committed(ev)
+
+    pool.add_evidence(ev)  # duplicate: no growth
+    assert len(pool.pending_evidence()) == 1
+
+
+def test_rejects_non_validator_and_stale_and_future():
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+
+    with pytest.raises(ErrInvalidBlock, match="not a validator"):
+        pool.add_evidence(_equivocation(OUTSIDER))
+    assert pool.pending_evidence() == []
+
+    # too old: age > max_age relative to the pool's current state
+    aged = state.copy()
+    aged.last_block_height = state.consensus_params.evidence.max_age + 50
+    pool.update_state(aged)
+    with pytest.raises(ErrInvalidBlock, match="too old"):
+        pool.add_evidence(_equivocation(SK, height=1))
+
+    with pytest.raises(ErrInvalidBlock, match="future height"):
+        pool.add_evidence(_equivocation(SK, height=aged.last_block_height + 2))
+
+
+def test_block_inclusion_marks_committed():
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = _equivocation(SK)
+    pool.add_evidence(ev)
+
+    post = state.copy()
+    post.last_block_height = 2
+    block = Block.make(2, [], None, [ev])
+    pool.update(block, post)
+
+    assert pool.is_committed(ev)
+    assert pool.pending_evidence() == []
+    assert pool.evidence_snapshot() == []
+    # committed evidence cannot re-enter the pending list
+    pool.add_evidence(ev)
+    assert pool.pending_evidence() == []
+
+
+def test_update_height_mismatch_rejected():
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    post = state.copy()
+    post.last_block_height = 3
+    with pytest.raises(ValueError, match="non-matching state height"):
+        pool.update(Block.make(2, [], None, []), post)
+
+
+def test_expired_pending_is_pruned():
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = _equivocation(SK, height=1)
+    pool.add_evidence(ev)
+
+    max_age = state.consensus_params.evidence.max_age
+    post = state.copy()
+    post.last_block_height = max_age + 2
+    pool.update(Block.make(max_age + 2, [], None, []), post)
+
+    assert pool.pending_evidence() == []
+    assert not pool.is_committed(ev)  # pruned, never included
