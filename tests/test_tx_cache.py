@@ -1,0 +1,46 @@
+"""TxCache LRU semantics (reference mempool/mempool.go:613-675
+mapTxCache): dedupe, capacity eviction of the least-recently-used entry,
+refresh-on-hit, explicit remove, reset.
+"""
+
+from tendermint_tpu.mempool.mempool import TxCache
+
+
+def test_push_dedupes():
+    c = TxCache(4)
+    assert c.push(b"a")
+    assert not c.push(b"a")
+    assert c.push(b"b")
+
+
+def test_capacity_evicts_lru():
+    c = TxCache(3)
+    for tx in (b"1", b"2", b"3"):
+        assert c.push(tx)
+    assert c.push(b"4")  # evicts b"1"
+    assert c.push(b"1"), "oldest entry should have been evicted"
+    # b"2" was evicted by re-adding b"1"; b"3"/b"4" remain cached
+    assert not c.push(b"3")
+    assert not c.push(b"4")
+
+
+def test_hit_refreshes_recency():
+    c = TxCache(3)
+    for tx in (b"1", b"2", b"3"):
+        c.push(tx)
+    c.push(b"1")  # duplicate hit: refreshes b"1" to most-recent
+    c.push(b"4")  # evicts b"2" (now the oldest), not b"1"
+    assert not c.push(b"1")
+    assert c.push(b"2")
+
+
+def test_remove_and_reset():
+    c = TxCache(4)
+    c.push(b"x")
+    c.remove(b"x")
+    assert c.push(b"x"), "removed tx must be re-admittable"
+    c.push(b"y")
+    c.reset()
+    assert c.push(b"x") and c.push(b"y")
+    # removing an absent tx is a no-op
+    c.remove(b"never-seen")
