@@ -1,0 +1,63 @@
+"""Config TOML round-trip matrix (reference config/toml.go + the
+section structs of config/config.go): every section survives
+save→load, overrides persist, round-scaled consensus timeouts behave.
+"""
+
+from tendermint_tpu import config as cfg
+
+
+def test_default_round_trip_all_sections(tmp_path):
+    c = cfg.default_config()
+    # touch a field in every section
+    c.base.moniker = "rt-node"
+    c.base.proxy_app = "kvstore"
+    c.base.fast_sync = False
+    c.base.filter_peers = True
+    c.rpc.laddr = "tcp://0.0.0.0:36657"
+    c.rpc.max_open_connections = 123
+    c.p2p.laddr = "tcp://0.0.0.0:36656"
+    c.p2p.persistent_peers = "id1@h1:1,id2@h2:2"
+    c.p2p.seed_mode = True
+    c.mempool.size = 777
+    c.mempool.recheck = False
+    c.consensus.timeout_propose = 1.25
+    c.consensus.create_empty_blocks = False
+    c.tx_index.indexer = "kv"
+    c.instrumentation.prometheus = True
+
+    path = str(tmp_path / "config.toml")
+    c.save(path)
+    c2 = cfg.Config.load(path)
+
+    assert c2.base.moniker == "rt-node"
+    assert c2.base.proxy_app == "kvstore"
+    assert c2.base.fast_sync is False
+    assert c2.base.filter_peers is True
+    assert c2.rpc.laddr == "tcp://0.0.0.0:36657"
+    assert c2.rpc.max_open_connections == 123
+    assert c2.p2p.persistent_peers == "id1@h1:1,id2@h2:2"
+    assert c2.p2p.seed_mode is True
+    assert c2.mempool.size == 777
+    assert c2.mempool.recheck is False
+    assert c2.consensus.timeout_propose == 1.25
+    assert c2.consensus.create_empty_blocks is False
+    assert c2.tx_index.indexer == "kv"
+    assert c2.instrumentation.prometheus is True
+
+
+def test_round_scaled_timeouts_grow():
+    """Consensus timeouts scale with the round (reference
+    config/config.go:569-598 Propose(round) etc.) so liveness survives
+    asynchronous periods."""
+    c = cfg.test_config().consensus
+    assert c.propose(1) > c.propose(0)
+    assert c.prevote(3) > c.prevote(0)
+    assert c.precommit(5) > c.precommit(1)
+
+
+def test_paths_derive_from_root(tmp_path):
+    c = cfg.default_config().set_root(str(tmp_path / "home"))
+    for p in (c.base.genesis_path(), c.base.priv_validator_path(),
+              c.base.node_key_path(), c.base.db_path()):
+        assert p.startswith(str(tmp_path / "home"))
+    assert c.consensus.wal_file(c.root_dir).startswith(str(tmp_path / "home"))
