@@ -64,23 +64,117 @@ def _best_of(fn, reps: int) -> float:
     return best
 
 
-def _tpu_available(timeout: float = 240.0) -> bool:
-    """Probe backend init + one tiny op in a subprocess with a timeout."""
+def _tpu_probe_once(timeout: float) -> str:
+    """Probe backend init + one tiny op in a subprocess with a timeout.
+
+    Returns "ok", "no-tpu" (deterministic: backend came up CPU-only, no
+    TPU plugin — retrying is futile), or "down" (init hang/timeout or
+    backend error such as axon UNAVAILABLE — the tunnel-outage signature,
+    worth retrying)."""
     code = (
         "import jax, jax.numpy as jnp\n"
         "devs = jax.devices()\n"
-        "assert devs and devs[0].platform.lower() != 'cpu', devs\n"
+        "assert devs and devs[0].platform.lower() != 'cpu', 'CPU-ONLY'\n"
         "x = jnp.ones((8, 8))\n"
         "print(float((x @ x).sum()))\n"
     )
     try:
         r = subprocess.run(
             [sys.executable, "-c", code], timeout=timeout,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
         )
-        return r.returncode == 0
+        if r.returncode == 0:
+            return "ok"
+        err = r.stderr or b""
+        if (b"CPU-ONLY" in err or b"ImportError" in err
+                or b"ModuleNotFoundError" in err):
+            return "no-tpu"  # deterministic, not a tunnel flap
+        return "down"
     except Exception:
-        return False
+        return "down"
+
+
+def _tpu_available(timeout: float = 240.0, retry_timeout: float = 60.0,
+                   retries: int = 3, wait: float = 30.0) -> bool:
+    """Bounded retry window so a flapping tunnel doesn't degrade the
+    official number on a single failed first probe. The FIRST probe keeps
+    the generous 240s budget (cold backend init on this box can
+    legitimately take minutes); later probes only need to catch a tunnel
+    that has come back, so they get 60s. Worst case ~7 min total.
+    TM_TPU_BENCH_PROBE_RETRIES=1 restores single-probe behavior for
+    quick local iteration."""
+    try:
+        retries = int(os.environ.get("TM_TPU_BENCH_PROBE_RETRIES", retries))
+    except ValueError:
+        pass
+    for attempt in range(max(1, retries)):
+        got = _tpu_probe_once(timeout if attempt == 0 else retry_timeout)
+        if got == "ok":
+            return True
+        if got == "no-tpu":
+            return False  # deterministic: no TPU plugin; don't burn waits
+        if attempt < retries - 1:
+            time.sleep(wait)
+    return False
+
+
+# Last good-TPU results, committed to the repo so a tunnel outage in a
+# later round never erases the perf story: degraded output embeds the
+# most recent clean TPU record for the same metric with stale=true.
+LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_GOOD.json")
+
+
+def _load_last_good(metric: str):
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            rec = json.load(f).get(metric)
+        return rec if isinstance(rec, dict) else None
+    except Exception:
+        return None
+
+
+def _save_last_good(out: dict) -> None:
+    try:
+        try:
+            with open(LAST_GOOD_PATH) as f:
+                store = json.load(f)
+        except Exception:
+            store = {}
+        rec = {k: v for k, v in out.items() if k != "tunnel_note"}
+        if rec.get("device_ms", 1) <= 0:
+            # a failed device-only measurement must not overwrite the
+            # stored record's real device number
+            rec.pop("device_ms", None)
+            prev = store.get(out["metric"]) or {}
+            if prev.get("device_ms", 0) > 0:
+                rec["device_ms"] = prev["device_ms"]
+                rec["device_ms_stale"] = True
+        rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        store[out["metric"]] = rec
+        tmp = LAST_GOOD_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(store, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, LAST_GOOD_PATH)  # atomic: no torn store on kill
+    except Exception:
+        pass  # the JSON line matters more than the cache
+
+
+def _emit(out: dict, degraded) -> None:
+    """Print the one JSON line; maintain the last-good-TPU store.
+
+    Forced-CPU runs ("cpu8-forced", BASELINE config 2) are a by-design
+    mode, not an outage — no last_good_tpu embedding for them."""
+    if degraded:
+        out["degraded"] = degraded
+        if not degraded.endswith("-forced"):
+            last = _load_last_good(out["metric"])
+            if last:
+                out["last_good_tpu"] = dict(last, stale=True)
+    elif out.get("value", -1) > 0:
+        _save_last_good(out)
+    print(json.dumps(out))
 
 
 def _signed_vote(chain_id, keys_list, vals, idx, height, round_, type_, block_id):
@@ -156,13 +250,11 @@ def votes_main(degraded):
         "unit": "ms",
         "vs_baseline": round(serial_ms / best, 2),
     }
-    if degraded:
-        out["degraded"] = degraded
-    else:
+    if not degraded:
         # 2 dispatches x ~64ms tunnel latency dominate at 150-vote scale;
         # on direct-attached TPU the batch path wins (see PROFILE.md)
         out["tunnel_note"] = "wall includes 2 remote-TPU round trips"
-    print(json.dumps(out))
+    _emit(out, degraded)
 
 
 def fastsync_main(degraded):
@@ -209,11 +301,9 @@ def fastsync_main(degraded):
         "vs_baseline": round(serial_ms / best, 2),
         "per_block_ms": round(best / nblocks, 2),
     }
-    if degraded:
-        out["degraded"] = degraded
-    else:
+    if not degraded:
         out["tunnel_note"] = f"wall includes {nblocks} remote-TPU round trips"
-    print(json.dumps(out))
+    _emit(out, degraded)
 
 
 def _build_valset(nval: int, seed: bytes):
@@ -281,8 +371,11 @@ def main():
         # pure host path: never touch (or wait for) the TPU backend
         return commit4_main()
     degraded = None
-    if os.environ.get("TM_TPU_BENCH_FORCE_CPU") or not _tpu_available():
+    if os.environ.get("TM_TPU_BENCH_FORCE_CPU"):
+        degraded = "cpu8-forced"  # BASELINE config 2: by-design CPU mode
+    elif not _tpu_available():
         degraded = "cpu8"
+    if degraded:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -325,7 +418,7 @@ def main():
         pks.append(sk.pub_key().bytes())
 
     # serial CPU baseline (subset of 300, extrapolated)
-    sub = 300
+    sub = min(300, n)
     t0 = time.perf_counter()
     for i in range(sub):
         keys.PubKeyEd25519(pks[i]).verify_bytes(msgs[i], sigs[i])
@@ -376,20 +469,25 @@ def main():
         "unit": "ms",
         "vs_baseline": round(serial_ms / batch_ms, 2),
     }
-    if not degraded and not RLC_MODE:
+    if not RLC_MODE:
         # breakdown: the axon tunnel charges ~64ms latency per sync round
         # trip + ~10-30ms/MB, none of which exists on direct-attached TPU.
         # device_ms = slope over back-to-back dispatches (pure device time).
-        try:
-            if can_chunk:
-                out["chunks"] = best_chunks
-            out["device_ms"] = round(_device_ms(msgs, sigs, pks), 1)
+        # The key is ALWAYS in the parsed line; -1 = not measured (degraded
+        # runs skip the ~8 extra full dispatches — a CPU number under the
+        # TPU device key would mislead anyway; the real TPU device_ms
+        # arrives via last_good_tpu) or measurement failed.
+        if can_chunk:
+            out["chunks"] = best_chunks
+        if degraded == "cpu8":
+            out["device_ms"] = -1.0
+        elif not degraded:
+            try:
+                out["device_ms"] = round(_device_ms(msgs, sigs, pks), 1)
+            except Exception:
+                out["device_ms"] = -1.0
             out["tunnel_note"] = "wall includes h2d+latency of remote-TPU tunnel"
-        except Exception:
-            pass
-    if degraded:
-        out["degraded"] = degraded
-    print(json.dumps(out))
+    _emit(out, degraded)
 
 
 def _device_ms(msgs, sigs, pks, k: int = 6) -> float:
