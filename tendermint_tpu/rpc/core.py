@@ -233,16 +233,51 @@ def block_results(env: RPCEnvironment, params: dict) -> dict:
     res = load_abci_responses(env.state_db, h)
     if res is None:
         raise RPCError(ERR_SERVER, f"no results for height {h}")
+    eb = res.end_block
     return {
         "height": str(h),
         "results": {
             "DeliverTx": [enc.tx_response_json(r) for r in res.deliver_tx],
             "EndBlock": {
-                "validator_updates": [],
-                "consensus_param_updates": None,
+                "validator_updates": [
+                    _validator_update_json(u)
+                    for u in (eb.validator_updates if eb else [])
+                ],
+                "consensus_param_updates": (
+                    _param_updates_json(eb.consensus_param_updates)
+                    if eb is not None else None
+                ),
             },
         },
     }
+
+
+def _validator_update_json(u) -> dict:
+    """abci.ValidatorUpdate (type-tagged pubkey bytes + power)."""
+    from ..crypto import pubkey_from_bytes
+    from ..crypto.keys import PubKeyEd25519
+
+    pk = pubkey_from_bytes(u.pub_key)
+    typ = "ed25519" if isinstance(pk, PubKeyEd25519) else "secp256k1"
+    return {
+        "pub_key": {"type": typ, "value": enc.b64(pk.bytes())},
+        "power": str(u.power),
+    }
+
+
+def _param_updates_json(pu) -> Optional[dict]:
+    """abci.ConsensusParamUpdates: only the sections the app set."""
+    if pu is None:
+        return None
+    out: dict = {}
+    if pu.block_size is not None:
+        out["block_size"] = {
+            "max_bytes": str(pu.block_size.max_bytes),
+            "max_gas": str(pu.block_size.max_gas),
+        }
+    if pu.evidence is not None:
+        out["evidence"] = {"max_age": str(pu.evidence.max_age)}
+    return out
 
 
 def commit(env: RPCEnvironment, params: dict) -> dict:
@@ -332,6 +367,46 @@ def _round_state_json(rs, full: bool) -> dict:
     if full and rs.votes is not None:
         out["height_vote_set"] = str(rs.votes)
     return out
+
+
+def consensus_params(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/consensus.go:319-330 ConsensusParams: the historical
+    consensus params in effect at `height` (default: the params for the
+    next block, LastBlockHeight+1 — they are stored ahead of execution)."""
+    from ..state import load_consensus_params
+    from ..state.store import NoConsensusParamsForHeightError
+
+    latest = env.latest_state().last_block_height + 1
+    h = _int(params, "height", None)
+    if h is None or h == 0:
+        h = latest
+    elif h <= 0:
+        raise RPCError(ERR_INVALID_PARAMS, "height must be greater than 0")
+    elif h > latest:
+        # params are stored through the NEXT block's height
+        raise RPCError(
+            ERR_SERVER, f"height {h} must be less than or equal to the "
+            f"next block height {latest}"
+        )
+    try:
+        cp = load_consensus_params(env.state_db, h)
+    except NoConsensusParamsForHeightError:
+        raise RPCError(ERR_SERVER, f"no consensus params for height {h}")
+    return {
+        "block_height": str(h),
+        "consensus_params": _consensus_params_json(cp),
+    }
+
+
+def _consensus_params_json(cp) -> dict:
+    """types/params.go JSON shape (block_size/evidence sections)."""
+    return {
+        "block_size": {
+            "max_bytes": str(cp.block_size.max_bytes),
+            "max_gas": str(cp.block_size.max_gas),
+        },
+        "evidence": {"max_age": str(cp.evidence.max_age)},
+    }
 
 
 def unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
@@ -594,6 +669,7 @@ ROUTES: Dict[str, Callable[[RPCEnvironment, dict], dict]] = {
     "validators": validators,
     "dump_consensus_state": dump_consensus_state,
     "consensus_state": consensus_state,
+    "consensus_params": consensus_params,
     "unconfirmed_txs": unconfirmed_txs,
     "num_unconfirmed_txs": num_unconfirmed_txs,
     "broadcast_tx_commit": broadcast_tx_commit,
@@ -605,7 +681,14 @@ ROUTES: Dict[str, Callable[[RPCEnvironment, dict], dict]] = {
     "abci_info": abci_info,
 }
 
+def unsafe_flush_mempool(env: RPCEnvironment, params: dict) -> dict:
+    """rpc/core/dev.go UnsafeFlushMempool."""
+    env.mempool.flush()
+    return {}
+
+
 UNSAFE_ROUTES: Dict[str, Callable[[RPCEnvironment, dict], dict]] = {
     "dial_seeds": dial_seeds,
     "dial_peers": dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
 }

@@ -310,3 +310,110 @@ def test_uri_binary_bytes_and_bool_params(rpc_node):
     import base64 as _b64
     assert _b64.b64decode(res.get("value") or "") == b"\xfe"
     assert not res.get("proof")
+
+
+def test_routes_parity_with_reference():
+    """Every route in the reference table (rpc/core/routes.go:11-52)
+    exists here: safe HTTP routes in ROUTES, the WS trio on WSConn, the
+    unsafe control routes in UNSAFE_ROUTES, and the unsafe profiler trio
+    as the documented redesign (the dedicated prof endpoint, rpc/prof.py)."""
+    from tendermint_tpu.rpc import prof
+    from tendermint_tpu.rpc.core import ROUTES, UNSAFE_ROUTES
+    from tendermint_tpu.rpc.server import WSConn
+
+    safe_http = [
+        # info API (routes.go:17-32)
+        "health", "status", "net_info", "blockchain", "genesis", "block",
+        "block_results", "commit", "tx", "tx_search", "validators",
+        "dump_consensus_state", "consensus_state", "consensus_params",
+        "unconfirmed_txs", "num_unconfirmed_txs",
+        # broadcast API (routes.go:35-37)
+        "broadcast_tx_commit", "broadcast_tx_sync", "broadcast_tx_async",
+        # abci API (routes.go:40-41)
+        "abci_query", "abci_info",
+    ]
+    missing = [r for r in safe_http if r not in ROUTES]
+    assert not missing, f"safe routes missing from ROUTES: {missing}"
+
+    # subscribe/unsubscribe/unsubscribe_all are websocket-reserved
+    # (routes.go:12-14); they live on the WS session, not the HTTP table
+    assert hasattr(WSConn, "_subscribe") and hasattr(WSConn, "_unsubscribe")
+
+    # control API (routes.go:46-48)
+    for r in ("dial_seeds", "dial_peers", "unsafe_flush_mempool"):
+        assert r in UNSAFE_ROUTES, f"unsafe route {r} missing"
+
+    # profiler API (routes.go:50-52): redesigned as the standalone prof
+    # endpoint — assert the replacement actually exposes CPU profiling
+    assert hasattr(prof, "ProfServer")
+
+
+def test_consensus_params_route(rpc_node):
+    node, c = rpc_node
+    out = c.call("consensus_params")
+    gp = node.genesis_doc.consensus_params
+    got = out["consensus_params"]
+    assert got["block_size"]["max_bytes"] == str(gp.block_size.max_bytes)
+    assert got["evidence"]["max_age"] == str(gp.evidence.max_age)
+    assert int(out["block_height"]) >= 1
+
+    at1 = c.call("consensus_params", {"height": 1})
+    assert at1["block_height"] == "1"
+    assert at1["consensus_params"] == got  # params never changed
+
+    from tendermint_tpu.rpc.jsonrpc import RPCError
+
+    with pytest.raises(RPCError):
+        c.call("consensus_params", {"height": 10_000_000})
+
+
+def test_unsafe_flush_mempool_route(rpc_node):
+    node, c = rpc_node
+    c.broadcast_tx_async(b"flushme=1")
+    assert c.call("unsafe_flush_mempool") == {}
+    assert int(c.num_unconfirmed_txs()["n_txs"]) == 0
+
+
+def test_block_results_renders_persisted_end_block():
+    """block_results must surface the PERSISTED EndBlock data
+    (validator_updates + consensus_param_updates), not hardcoded empties
+    (reference rpc/core/blocks.go BlockResults)."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.crypto import pubkey_to_bytes
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.rpc.core import RPCEnvironment, block_results
+    from tendermint_tpu.state import ABCIResponses
+    from tendermint_tpu.state.store import save_abci_responses
+
+    pk = PrivKeyEd25519.generate().pub_key()
+    res = ABCIResponses(
+        deliver_tx=[abci.ResponseDeliverTx(code=0)],
+        end_block=abci.ResponseEndBlock(
+            validator_updates=[
+                abci.ValidatorUpdate(pub_key=pubkey_to_bytes(pk), power=7)
+            ],
+            consensus_param_updates=abci.ConsensusParamUpdates(
+                block_size=abci.BlockSizeParams(max_bytes=12345, max_gas=-1)
+            ),
+        ),
+    )
+    db = MemDB()
+    save_abci_responses(db, 3, res)
+
+    class _Store:
+        def height(self):
+            return 3
+
+    env = RPCEnvironment.__new__(RPCEnvironment)
+    env.state_db = db
+    env.block_store = _Store()
+    out = block_results(env, {"height": 3})
+    eb = out["results"]["EndBlock"]
+    assert eb["validator_updates"] == [{
+        "pub_key": {"type": "ed25519", "value": base64.b64encode(pk.bytes()).decode()},
+        "power": "7",
+    }]
+    assert eb["consensus_param_updates"] == {
+        "block_size": {"max_bytes": "12345", "max_gas": "-1"},
+    }
