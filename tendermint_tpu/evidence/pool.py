@@ -32,6 +32,11 @@ class EvidencePool:
         with self._lock:
             self._state = state
 
+    def state(self):
+        """Latest sm.State (reference pool.go State() :76-79)."""
+        with self._lock:
+            return self._state
+
     def pending_evidence(self) -> List[object]:
         return self.store.pending_evidence()
 

@@ -49,12 +49,25 @@ class EvidenceReactor(Reactor):
         ).start()
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
-        """reactor.go:64-84."""
+        """reactor.go:64-99.
+
+        Deviation from the pinned reference: evidence from a height WE
+        have not reached yet (we are catching up) is ignored rather than
+        punished — with send-side gating (below) an honest peer should
+        never send it, but a racing height update must not cost a peer
+        its connection."""
         obj = serde.unpack(msg_bytes)
         if not (isinstance(obj, (list, tuple)) and obj and obj[0] == "evlist"):
             raise ValueError("bad evidence message")
+        our_height = self.evpool.state().last_block_height
         for eo in obj[1]:
             ev = evidence_from_obj(eo)
+            if ev.height() > our_height + 1:
+                LOG.info(
+                    "ignoring evidence from future height %d (ours %d)",
+                    ev.height(), our_height,
+                )
+                continue
             try:
                 self.evpool.add_evidence(ev)
             except Exception as e:
@@ -62,11 +75,24 @@ class EvidenceReactor(Reactor):
                 raise ValueError(f"peer sent invalid evidence: {e}") from e
 
     def _broadcast_routine(self, peer) -> None:
-        """reactor.go:88-147: resend the pending list; the pool dedupes."""
+        """reactor.go:88-147: walk the pending list, gating each item on
+        the peer's consensus height (checkSendEvidenceMessage :160-190):
+        send only when ev_height <= peer_height <= ev_height + max_age.
+        A catching-up peer gets the evidence once its reported height
+        reaches the evidence height, instead of a from-the-future item
+        it would have to reject."""
         sent: set = set()
         while peer.is_running() and not self._stop.is_set():
-            pending = self.evpool.pending_evidence()
-            batch = [e for e in pending if e.hash() not in sent]
+            batch = []
+            max_age = self.evpool.state().consensus_params.evidence.max_age
+            for e in self.evpool.pending_evidence():
+                if e.hash() in sent:
+                    continue
+                send_now, retry = self._check_send(peer, e, max_age)
+                if send_now:
+                    batch.append(e)
+                elif not retry:
+                    sent.add(e.hash())  # too old for this peer: skip for good
             if batch:
                 ok = peer.send(
                     EVIDENCE_CHANNEL,
@@ -75,3 +101,19 @@ class EvidenceReactor(Reactor):
                 if ok:
                     sent.update(e.hash() for e in batch)
             time.sleep(BROADCAST_SLEEP)
+
+    def _check_send(self, peer, ev, max_age: int) -> tuple:
+        """(send_now, retry_later) — reference checkSendEvidenceMessage
+        (reactor.go:160-190)."""
+        ps = peer.get("consensus_peer_state")
+        if ps is None:
+            return False, True  # consensus reactor hasn't attached yet
+        peer_height = ps.get_height()
+        ev_height = ev.height()
+        if peer_height < ev_height:
+            return False, True  # peer is behind; wait for it to catch up
+        if peer_height > ev_height + max_age:
+            # too old for an honest peer: it is committed there or never
+            # will be (reference :178-184)
+            return False, False
+        return True, False

@@ -132,3 +132,104 @@ def test_expired_pending_is_pruned():
 
     assert pool.pending_evidence() == []
     assert not pool.is_committed(ev)  # pruned, never included
+
+
+class _StubPeerState:
+    def __init__(self, height=0):
+        self.height = height
+
+    def get_height(self):
+        return self.height
+
+
+class _StubPeer:
+    def __init__(self, ps=None):
+        self.ps = ps
+        self.sent = []
+        self.running = True
+        self.id = "stubpeer0000"
+
+    def is_running(self):
+        return self.running
+
+    def get(self, key):
+        return self.ps if key == "consensus_peer_state" else None
+
+    def send(self, ch_id, msg_bytes):
+        self.sent.append(msg_bytes)
+        return True
+
+
+def test_evidence_send_gated_on_peer_height():
+    """reference evidence/reactor.go:160-190 checkSendEvidenceMessage:
+    only send when ev_height <= peer_height <= ev_height + max_age."""
+    from tendermint_tpu.evidence.reactor import EvidenceReactor
+
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = _equivocation(SK, height=1)
+    pool.add_evidence(ev)
+    r = EvidenceReactor(pool)
+    max_age = state.consensus_params.evidence.max_age
+
+    # no consensus peer state attached yet: retry
+    assert r._check_send(_StubPeer(ps=None), ev, max_age) == (False, True)
+    # peer behind the evidence height: retry until it catches up
+    assert r._check_send(_StubPeer(_StubPeerState(0)), ev, max_age) == (False, True)
+    # peer exactly at the evidence height: send
+    assert r._check_send(_StubPeer(_StubPeerState(1)), ev, max_age) == (True, False)
+    # in-window: send
+    assert r._check_send(_StubPeer(_StubPeerState(50)), ev, max_age) == (True, False)
+    # beyond max_age: skip permanently (no retry)
+    maxed = 1 + max_age + 1
+    assert r._check_send(_StubPeer(_StubPeerState(maxed)), ev, max_age) == (False, False)
+
+
+def test_broadcast_routine_waits_for_catching_up_peer(monkeypatch):
+    """A catching-up peer receives evidence only once its reported
+    height reaches the evidence height."""
+    import threading
+    import time as _t
+
+    from tendermint_tpu.evidence import reactor as evr
+
+    monkeypatch.setattr(evr, "BROADCAST_SLEEP", 0.02)
+    state = _state()
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    ev = _equivocation(SK, height=1)
+    pool.add_evidence(ev)
+    r = evr.EvidenceReactor(pool)
+    peer = _StubPeer(_StubPeerState(0))
+
+    t = threading.Thread(target=r._broadcast_routine, args=(peer,), daemon=True)
+    t.start()
+    _t.sleep(0.2)
+    assert peer.sent == [], "evidence sent to a peer below the evidence height"
+    peer.ps.height = 1  # peer caught up
+    deadline = _t.time() + 5
+    while not peer.sent and _t.time() < deadline:
+        _t.sleep(0.02)
+    r.stop()
+    peer.running = False
+    t.join(timeout=2)
+    assert len(peer.sent) == 1, "evidence not sent after the peer caught up"
+
+
+def test_receive_ignores_future_evidence_without_punishing():
+    """Evidence from a height we have not reached is ignored (no raise =
+    no stop_peer_for_error), not punished: we may be the one catching up."""
+    from tendermint_tpu.evidence.reactor import EvidenceReactor
+    from tendermint_tpu.types import serde
+
+    state = _state()  # last_block_height == 0
+    pool = EvidencePool(EvidenceStore(MemDB()), state)
+    r = EvidenceReactor(pool)
+    future = _equivocation(SK, height=5)
+    msg = serde.pack(["evlist", [serde.evidence_obj(future)]])
+    r.receive(0x38, _StubPeer(), msg)  # must not raise
+    assert pool.pending_evidence() == []
+
+    # genuinely invalid evidence still raises (sender is punished)
+    bad = _equivocation(OUTSIDER, height=1)
+    with pytest.raises(ValueError, match="invalid evidence"):
+        r.receive(0x38, _StubPeer(), serde.pack(["evlist", [serde.evidence_obj(bad)]]))
