@@ -65,11 +65,20 @@ def cmd_node(args) -> int:
     """commands/run_node.go: build + run the node until signalled."""
     from tendermint_tpu.node import default_new_node
 
-    logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
-    )
     c = _load_config(args.home)
+    # per-module "module:level,*:level" syntax + plain/json format
+    # (reference libs/cli/flags/log_level.go, libs/log/tm_json_logger.go);
+    # the --log_level flag overrides the config file
+    from tendermint_tpu.libs.log import setup_logging
+
+    try:
+        setup_logging(
+            log_level=args.log_level or c.base.log_level or "info",
+            log_format=c.base.log_format or "plain",
+        )
+    except ValueError as e:
+        print(f"bad logging config: {e}", file=sys.stderr)
+        return 1
     if args.proxy_app:
         c.base.proxy_app = args.proxy_app
     if getattr(args, "abci", ""):
@@ -330,7 +339,9 @@ def build_parser() -> argparse.ArgumentParser:
                     default="")
     sp.add_argument("--p2p.seeds", dest="seeds", default="")
     sp.add_argument("--fast_sync", choices=("true", "false"), default=None)
-    sp.add_argument("--log_level", default="info")
+    sp.add_argument("--log_level", default="",
+                    help='"module:level,*:level" pairs or a bare level; '
+                         "empty = use the config file")
     sp.set_defaults(fn=cmd_node)
 
     sp = sub.add_parser("testnet", help="generate testnet config dirs")

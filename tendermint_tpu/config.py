@@ -20,7 +20,10 @@ class BaseConfig:
     fast_sync: bool = True
     db_backend: str = "filedb"  # memdb | filedb | native
     db_dir: str = "data"
+    # "module:level,*:level" list or a bare level (reference
+    # libs/cli/flags/log_level.go); format "plain"|"json" (config.go:18-21)
     log_level: str = "info"
+    log_format: str = "plain"
     genesis_file: str = "config/genesis.json"
     priv_validator_file: str = "config/priv_validator.json"
     priv_validator_laddr: str = ""  # remote signer listen addr

@@ -1,0 +1,120 @@
+"""Structured logging: per-module log levels + optional JSON output.
+
+Reference parity: libs/cli/flags/log_level.go ParseLogLevel (the
+"module:level,*:level" comma list), libs/log/filter.go (per-module
+filtering), libs/log/tm_json_logger.go (JSON format), config.go
+LogFormatPlain/LogFormatJSON.
+
+Python's stdlib logging is already hierarchical per-logger, so the
+reference's filter wrapper maps to setting levels on the named loggers
+the packages use ("consensus", "p2p.switch", ...): "consensus:debug"
+covers "consensus.reactor" etc. through normal propagation, and "*"
+sets the root level for everything unnamed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Dict, Optional, TextIO
+
+# reference filter.go levels; "none" squelches everything, same as
+# AllowNoneWith
+LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "error": logging.ERROR,
+    "none": logging.CRITICAL + 10,
+}
+
+DEFAULT_KEY = "*"  # log_level.go defaultLogLevelKey
+
+
+def parse_log_level(spec: str, default: str = "info") -> Dict[str, int]:
+    """"module:level,*:level" -> {module_or_star: stdlib levelno}.
+
+    A bare level ("info") means "*:info" (log_level.go:29-31); if no
+    "*" pair is given, `default` fills it in (:77-83). Raises
+    ValueError on malformed pairs or unknown levels, matching the
+    reference's error cases."""
+    if not spec:
+        raise ValueError("empty log level")
+    if ":" not in spec:
+        spec = f"{DEFAULT_KEY}:{spec}"
+    out: Dict[str, int] = {}
+    for item in spec.split(","):
+        parts = item.split(":")
+        if len(parts) != 2 or not parts[0]:
+            raise ValueError(
+                f'expected "module:level" pairs, got {item!r} in {spec!r}'
+            )
+        module, level = parts
+        if level not in LEVELS:
+            raise ValueError(
+                f'expected "debug", "info", "error" or "none", got '
+                f"{level!r} in pair {item!r}"
+            )
+        out[module] = LEVELS[level]
+    if DEFAULT_KEY not in out:
+        if default not in LEVELS:
+            raise ValueError(f"bad default log level {default!r}")
+        out[DEFAULT_KEY] = LEVELS[default]
+    return out
+
+
+class TMJSONFormatter(logging.Formatter):
+    """One JSON object per event (tm_json_logger.go): level, module
+    (logger name), ts, msg; exceptions under "err"."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "level": record.levelname.lower(),
+            "module": record.name,
+            "ts": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(record.created)
+            ),
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            obj["err"] = self.formatException(record.exc_info)
+        return json.dumps(obj, sort_keys=True)
+
+
+PLAIN_FORMAT = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+
+# module loggers explicitly leveled by the last setup_logging call, so a
+# reconfiguration can reset them — otherwise stale per-module overrides
+# from a previous spec would survive
+_TOUCHED_MODULES: set = set()
+
+
+def setup_logging(
+    log_level: str = "info",
+    log_format: str = "plain",
+    stream: Optional[TextIO] = None,
+    default: str = "info",
+) -> None:
+    """Install the root handler + per-module levels.
+
+    log_format: "plain" (one-line text) or "json" (one object per line),
+    matching config.go LogFormatPlain/LogFormatJSON."""
+    levels = parse_log_level(log_level, default)
+    if log_format == "json":
+        formatter: logging.Formatter = TMJSONFormatter()
+    elif log_format == "plain":
+        formatter = logging.Formatter(PLAIN_FORMAT)
+    else:
+        raise ValueError(f'log_format must be "plain" or "json", got {log_format!r}')
+    handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+    handler.setFormatter(formatter)
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(levels.pop(DEFAULT_KEY))
+    for module in _TOUCHED_MODULES - set(levels):
+        logging.getLogger(module).setLevel(logging.NOTSET)
+    _TOUCHED_MODULES.clear()
+    for module, levelno in levels.items():
+        logging.getLogger(module).setLevel(levelno)
+        _TOUCHED_MODULES.add(module)

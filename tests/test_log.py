@@ -1,0 +1,152 @@
+"""Structured logging (reference libs/cli/flags/log_level.go ParseLogLevel
++ log_level_test.go, libs/log/filter.go, tm_json_logger.go): per-module
+levels, JSON format, config wiring.
+"""
+
+import io
+import json
+import logging
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.libs.log import (
+    LEVELS,
+    TMJSONFormatter,
+    parse_log_level,
+    setup_logging,
+)
+
+
+class TestParseLogLevel:
+    def test_bare_level_means_star(self):
+        assert parse_log_level("info") == {"*": logging.INFO}
+        assert parse_log_level("debug") == {"*": logging.DEBUG}
+
+    def test_module_pairs_with_star(self):
+        got = parse_log_level("consensus:debug,mempool:debug,*:error")
+        assert got == {
+            "consensus": logging.DEBUG,
+            "mempool": logging.DEBUG,
+            "*": logging.ERROR,
+        }
+
+    def test_missing_star_uses_default(self):
+        got = parse_log_level("state:debug", default="error")
+        assert got == {"state": logging.DEBUG, "*": logging.ERROR}
+
+    def test_none_level_squelches(self):
+        got = parse_log_level("p2p:none,*:info")
+        assert got["p2p"] > logging.CRITICAL
+
+    @pytest.mark.parametrize("bad", [
+        "",                       # empty (log_level.go:23-25)
+        "state:debug,*:",         # empty level
+        ":debug",                 # empty module
+        "state:debug:extra",      # 3-part item
+        "state:warn",             # unknown level name
+        "state=debug",            # wrong separator
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            parse_log_level(bad)
+
+
+class TestSetupLogging:
+    def _fresh_loggers(self):
+        # reset the module loggers this test touches so per-test state
+        # doesn't leak through the global logging registry
+        for name in ("tlog_state", "tlog_state.store", "tlog_p2p"):
+            lg = logging.getLogger(name)
+            lg.setLevel(logging.NOTSET)
+
+    def test_per_module_filtering(self):
+        self._fresh_loggers()
+        buf = io.StringIO()
+        setup_logging("tlog_state:debug,*:error", "plain", stream=buf)
+        logging.getLogger("tlog_state.store").debug("child-debug-visible")
+        logging.getLogger("tlog_p2p").info("default-info-squelched")
+        logging.getLogger("tlog_p2p").error("default-error-visible")
+        out = buf.getvalue()
+        assert "child-debug-visible" in out       # hierarchy: state covers state.store
+        assert "default-info-squelched" not in out
+        assert "default-error-visible" in out
+
+    def test_json_format_one_object_per_line(self):
+        self._fresh_loggers()
+        buf = io.StringIO()
+        setup_logging("tlog_state:debug,*:error", "json", stream=buf)
+        logging.getLogger("tlog_state").info("hello %s", "world")
+        lines = [ln for ln in buf.getvalue().splitlines() if ln]
+        assert len(lines) == 1
+        obj = json.loads(lines[0])
+        assert obj["msg"] == "hello world"
+        assert obj["module"] == "tlog_state"
+        assert obj["level"] == "info"
+        assert "ts" in obj
+
+    def test_json_exception_field(self):
+        buf = io.StringIO()
+        setup_logging("*:info", "json", stream=buf)
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            logging.getLogger("tlog_state").exception("failed")
+        obj = json.loads(buf.getvalue().splitlines()[0])
+        assert obj["level"] == "error"
+        assert "boom" in obj["err"]
+
+    def test_bad_format_raises(self):
+        with pytest.raises(ValueError, match="log_format"):
+            setup_logging("info", "yaml", stream=io.StringIO())
+
+    def teardown_method(self):
+        # restore a sane root so later tests' logging goes to stderr
+        root = logging.getLogger()
+        root.handlers[:] = []
+        root.setLevel(logging.WARNING)
+
+
+def test_config_carries_log_format_through_toml(tmp_path):
+    from tendermint_tpu import config as cfg
+
+    c = cfg.Config()
+    c.base.log_level = "state:debug,*:error"
+    c.base.log_format = "json"
+    p = str(tmp_path / "config.toml")
+    c.save(p)
+    back = cfg.Config.load(p)
+    assert back.base.log_level == "state:debug,*:error"
+    assert back.base.log_format == "json"
+
+
+def test_formatter_is_parseable_for_all_levels():
+    fmt = TMJSONFormatter()
+    for name, levelno in LEVELS.items():
+        if name == "none":
+            continue
+        rec = logging.LogRecord(
+            "mod", levelno if levelno else logging.INFO, "f.py", 1,
+            "m%d", (7,), None,
+        )
+        obj = json.loads(fmt.format(rec))
+        assert obj["msg"] == "m7"
+
+
+def test_setup_logging_reconfiguration_resets_stale_module_levels():
+    """A second setup_logging call must clear per-module overrides set by
+    the first (config reload must not leave ghost levels)."""
+    buf1 = io.StringIO()
+    setup_logging("tlog_re:debug,*:error", "plain", stream=buf1)
+    assert logging.getLogger("tlog_re").level == logging.DEBUG
+    buf2 = io.StringIO()
+    setup_logging("info", "plain", stream=buf2)
+    assert logging.getLogger("tlog_re").level == logging.NOTSET
+    logging.getLogger("tlog_re").info("now-visible-at-info")
+    assert "now-visible-at-info" in buf2.getvalue()
+    # restore
+    root = logging.getLogger()
+    root.handlers[:] = []
+    root.setLevel(logging.WARNING)
