@@ -6,7 +6,10 @@ tags; subscribers filter with a small query language:
 
     tm.event = 'NewBlock' AND tx.height > 5
 
-supporting =, <, <=, >, >=, CONTAINS over tag values, combined with AND.
+supporting =, <, <=, >, >=, CONTAINS over tag values, plus typed
+`DATE 2006-01-02` / `TIME 2006-01-02T15:04:05Z` operands
+(reference libs/pubsub/query/query.go:81-83 DateLayout/TimeLayout),
+combined with AND.
 """
 
 from __future__ import annotations
@@ -14,6 +17,7 @@ from __future__ import annotations
 import re
 import threading
 from dataclasses import dataclass, field
+from datetime import date, datetime, timezone
 from typing import Callable, Dict, List, Optional
 
 
@@ -36,18 +40,57 @@ def match_op(op: str, have: str, want: str) -> bool:
     return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b}[op]
 
 
+def _parse_tag_time(value: str) -> Optional[float]:
+    """Tag value -> epoch seconds, trying RFC3339 then the date layout
+    (reference query.go:251-263 match's time conversion). None if the
+    value is not a time — the reference panics; we just don't match.
+
+    RFC3339 requires an explicit offset: an offset-less "...T14:45:00"
+    is rejected (Go's time.Parse(RFC3339) parity) rather than being
+    interpreted in the machine's local timezone, which would make query
+    matches timezone-dependent. Date-only values are midnight UTC."""
+    try:
+        if "T" in value:
+            dt = datetime.fromisoformat(value.replace("Z", "+00:00"))
+            if dt.tzinfo is None:
+                return None
+            return dt.timestamp()
+        d = date.fromisoformat(value)
+        return datetime(d.year, d.month, d.day, tzinfo=timezone.utc).timestamp()
+    except ValueError:
+        return None
+
+
+def _compare_typed(op: str, a: float, b: float) -> bool:
+    return {
+        "=": a == b, "<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
+    }.get(op, False)
+
+
 @dataclass(frozen=True)
 class _Condition:
     key: str
     op: str
     value: str
+    # "str" (untyped; numeric comparison attempted for </>), or the typed
+    # operand kinds "date"/"time" with the parsed epoch in tvalue
+    kind: str = "str"
+    tvalue: float = 0.0
 
     def matches(self, tags: Dict[str, str]) -> bool:
         if self.key not in tags:
             return False
         if self.op == "EXISTS":
             return True
-        return match_op(self.op, tags[self.key], self.value)
+        return self.compare_value(tags[self.key])
+
+    def compare_value(self, have: str) -> bool:
+        """Compare one tag value against the operand, honoring the
+        operand's type (shared by pubsub matching and the kv indexer)."""
+        if self.kind in ("date", "time"):
+            t = _parse_tag_time(have)
+            return t is not None and _compare_typed(self.op, t, self.tvalue)
+        return match_op(self.op, have, self.value)
 
 
 class Query:
@@ -73,11 +116,32 @@ class Query:
                 continue
             m = re.match(
                 r"^(?P<key>[\w.\-]+)\s*(?P<op>=|<=|>=|<|>|CONTAINS)\s*"
-                r"(?:'(?P<qval>[^']*)'|(?P<val>[\w.\-]+))$",
+                r"(?:(?P<kind>DATE|TIME)\s+(?P<tval>[\w:+.\-]+)"
+                r"|'(?P<qval>[^']*)'|(?P<val>[\w.\-]+))$",
                 part,
             )
             if not m:
                 raise QueryError(f"cannot parse query condition {part!r}")
+            if m.group("kind") is not None:
+                # typed operand: `DATE 2006-01-02` / `TIME <RFC3339>`
+                # (reference query.go:81-83; layouts per query.peg)
+                kind = m.group("kind").lower()
+                raw = m.group("tval")
+                op = m.group("op")
+                if op == "CONTAINS":
+                    raise QueryError(
+                        f"CONTAINS does not apply to {kind.upper()} operands")
+                if (kind == "time") != ("T" in raw):
+                    raise QueryError(
+                        f"{kind.upper()} operand has the wrong layout: {raw!r}")
+                t = _parse_tag_time(raw)
+                if t is None:
+                    raise QueryError(f"bad {kind.upper()} operand {raw!r}")
+                self.conditions.append(
+                    _Condition(key=m.group("key"), op=op, value=raw,
+                               kind=kind, tvalue=t)
+                )
+                continue
             self.conditions.append(
                 _Condition(
                     key=m.group("key"),

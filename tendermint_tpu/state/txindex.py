@@ -13,7 +13,7 @@ from typing import List, Optional
 
 from ..abci import types as abci
 from ..libs.db import DB
-from ..libs.events import Query, match_op
+from ..libs.events import Query
 from ..libs.service import BaseService
 from ..types import serde
 from ..types.block import tx_hash
@@ -145,7 +145,7 @@ class KVTxIndexer(TxIndexer):
                     val, _h, _i = serde.unpack(k[len(prefix):])
                 except (ValueError, TypeError):
                     continue
-                if match_op(c.op, val, c.value):
+                if c.compare_value(val):
                     matching.add(bytes(v))
             hashes = matching if hashes is None else hashes & matching
             if not hashes:

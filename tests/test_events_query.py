@@ -46,6 +46,33 @@ MATCH_TABLE = [
     ("app.version = 1.0.5", {"app.version": "1.0.5"}, True),
     # empty query matches everything
     ("", {"any": "thing"}, True),
+    # typed DATE/TIME operands (reference query_test.go:38-43; tag values
+    # may be either the date or the RFC3339 time layout)
+    ("tx.date > DATE 2017-01-01", {"tx.date": "2026-07-30"}, True),
+    ("tx.date = DATE 2017-01-01", {"tx.date": "2017-01-01"}, True),
+    ("tx.date = DATE 2018-01-01", {"tx.date": "2017-01-01"}, False),
+    ("tx.date > DATE 2017-01-01", {"tx.date": "2016-05-03"}, False),
+    ("tx.time >= TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2026-07-30T00:00:00Z"}, True),
+    ("tx.time = TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2013-05-03T14:45:00Z"}, True),
+    ("tx.time = TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2013-05-03T14:45:01Z"}, False),
+    ("tx.time < TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2013-05-03T13:45:00Z"}, True),
+    # RFC3339 offsets normalize: 16:45+02:00 == 14:45Z
+    ("tx.time = TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2013-05-03T16:45:00+02:00"}, True),
+    # a DATE operand matches RFC3339 tag values too (midnight UTC cut)
+    ("block.time > DATE 2017-01-01",
+     {"block.time": "2017-01-01T00:00:01Z"}, True),
+    # non-time tag value never satisfies a typed comparison
+    ("tx.date > DATE 2017-01-01", {"tx.date": "not-a-date"}, False),
+    ("tx.time > TIME 2013-05-03T14:45:00Z", {"tx.time": "17"}, False),
+    # an offset-less tag value is NOT RFC3339: no match regardless of TZ
+    # (matching must not depend on the node's local timezone)
+    ("tx.time > TIME 2013-05-03T14:45:00Z",
+     {"tx.time": "2020-05-03T14:45:00"}, False),
 ]
 
 
@@ -62,11 +89,30 @@ def test_query_match_table(query, tags, want):
         "tm.event ~ 'x'",  # unknown operator
         "tm.event = 'unterminated",
         "tm.event = 'a' OR tm.event = 'b'",  # OR is not in the language
+        "tx.date = DATE xyz",  # malformed date operand
+        "tx.date = DATE 2017-13-40",  # invalid calendar date
+        "tx.date = DATE 2017-01-01T00:00:00Z",  # DATE must be date-only
+        "tx.time = TIME 2013-05-03",  # TIME needs full RFC3339
+        "tx.time = TIME 2013-05-03T14:45:00",  # RFC3339 requires an offset
+        "tx.time = TIME nope",  # malformed time operand
+        "tx.date CONTAINS DATE 2017-01-01",  # CONTAINS is untyped-only
+        "tx.time CONTAINS TIME 2013-05-03T14:45:00Z",
     ],
 )
 def test_query_parse_errors(bad):
     with pytest.raises(QueryError):
         Query(bad)
+
+
+def test_typed_conditions_parse_shape():
+    """Conditions carry the typed operand (query_test.go:78 analogue)."""
+    q = Query("tx.time >= TIME 2013-05-03T14:45:00Z")
+    (c,) = q.conditions
+    assert (c.key, c.op, c.kind) == ("tx.time", ">=", "time")
+    from datetime import datetime, timezone
+
+    want = datetime(2013, 5, 3, 14, 45, tzinfo=timezone.utc).timestamp()
+    assert c.tvalue == want
 
 
 def test_query_equality_and_hash():
@@ -141,3 +187,17 @@ def test_cancelled_subscription_refuses_publish():
 def test_get_timeout_returns_none():
     sub = PubSub().subscribe("c", Query(""))
     assert sub.get(timeout=0.02) is None
+
+
+def test_subscription_with_typed_time_query():
+    """A subscriber with a TIME-typed query only receives events whose
+    tag falls in range (the WS subscribe path builds the same Query)."""
+    ps = PubSub()
+    sub = ps.subscribe(
+        "t", Query("tm.event = 'NewBlock' AND block.time >= TIME 2017-01-01T00:00:00Z"))
+    ps.publish("old", {"tm.event": "NewBlock", "block.time": "2016-12-31T23:59:59Z"})
+    ps.publish("new", {"tm.event": "NewBlock", "block.time": "2017-06-01T00:00:00Z"})
+    got = []
+    while (m := sub.poll()) is not None:
+        got.append(m.data)
+    assert got == ["new"]

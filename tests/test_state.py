@@ -338,6 +338,30 @@ class TestTxIndexer:
         assert len(idx.search(Query("acct.path = 'foo/bar'"))) == 1
         assert idx.search(Query("acct.path = 'foo'")) == []
 
+    def test_search_typed_date_time_conditions(self):
+        """DATE/TIME operands work through the kv secondary-index scan
+        (reference query.go:81-83 + kv.go Search)."""
+        idx = sm.KVTxIndexer(MemDB(), index_all_tags=True)
+        txs = {
+            b"early": b"2016-05-03T10:00:00Z",
+            b"edge":  b"2017-01-01T00:00:00Z",
+            b"late":  b"2026-07-30T12:00:00Z",
+        }
+        for i, (tx, ts) in enumerate(sorted(txs.items())):
+            idx.index(sm.TxResult(
+                height=i + 1, index=0, tx=tx,
+                result=abci.ResponseDeliverTx(
+                    code=0, tags=[abci.KVPair(b"block.time", ts)]),
+            ))
+        hits = idx.search(Query("block.time >= TIME 2017-01-01T00:00:00Z"))
+        assert sorted(r.tx for r in hits) == [b"edge", b"late"]
+        hits = idx.search(Query("block.time > DATE 2017-01-01"))
+        assert [r.tx for r in hits] == [b"late"]
+        # typed + numeric conjunction intersects correctly
+        hits = idx.search(
+            Query("block.time >= TIME 2017-01-01T00:00:00Z AND tx.height > 1"))
+        assert sorted(r.tx for r in hits) == [b"edge", b"late"]
+
 
 class TestABCIResponsesSerde:
     def test_consensus_param_updates_roundtrip(self):
