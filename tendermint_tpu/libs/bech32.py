@@ -1,0 +1,105 @@
+"""bech32 address encoding (BIP-173).
+
+Reference parity: libs/bech32/bech32.go — convert_and_encode /
+decode_and_convert over an 8<->5 bit regroup plus the standard bech32
+checksum. The reference delegates to btcsuite's implementation; this is
+a self-contained one following the BIP-173 specification.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+_CHARSET = "qpzry9x8gf2tvdw0s3jn54khce6mua7l"
+_GEN = (0x3B6A57B2, 0x26508E6D, 0x1EA119FA, 0x3D4233DD, 0x2A1462B3)
+
+
+def _polymod(values) -> int:
+    chk = 1
+    for v in values:
+        top = chk >> 25
+        chk = ((chk & 0x1FFFFFF) << 5) ^ v
+        for i in range(5):
+            if (top >> i) & 1:
+                chk ^= _GEN[i]
+    return chk
+
+
+def _hrp_expand(hrp: str) -> List[int]:
+    return [ord(c) >> 5 for c in hrp] + [0] + [ord(c) & 31 for c in hrp]
+
+
+def _create_checksum(hrp: str, data: List[int]) -> List[int]:
+    poly = _polymod(_hrp_expand(hrp) + data + [0] * 6) ^ 1
+    return [(poly >> 5 * (5 - i)) & 31 for i in range(6)]
+
+
+def _verify_checksum(hrp: str, data: List[int]) -> bool:
+    return _polymod(_hrp_expand(hrp) + data) == 1
+
+
+def convert_bits(data, from_bits: int, to_bits: int, pad: bool) -> List[int]:
+    """Regroup a bit stream between symbol widths (BIP-173 reference
+    algorithm; btcutil bech32.ConvertBits analogue)."""
+    acc = 0
+    bits = 0
+    out: List[int] = []
+    maxv = (1 << to_bits) - 1
+    for value in data:
+        if value < 0 or value >> from_bits:
+            raise ValueError(f"invalid value {value} for {from_bits}-bit group")
+        acc = (acc << from_bits) | value
+        bits += from_bits
+        while bits >= to_bits:
+            bits -= to_bits
+            out.append((acc >> bits) & maxv)
+    if pad:
+        if bits:
+            out.append((acc << (to_bits - bits)) & maxv)
+    elif bits >= from_bits or ((acc << (to_bits - bits)) & maxv):
+        raise ValueError("invalid padding in bit conversion")
+    return out
+
+
+def encode(hrp: str, data: List[int]) -> str:
+    """5-bit groups + hrp -> bech32 string (lowercase)."""
+    if not hrp or any(ord(c) < 33 or ord(c) > 126 for c in hrp):
+        raise ValueError(f"invalid human-readable part {hrp!r}")
+    hrp = hrp.lower()
+    combined = data + _create_checksum(hrp, data)
+    if len(hrp) + 1 + len(combined) > 90:
+        raise ValueError("bech32 string too long")
+    return hrp + "1" + "".join(_CHARSET[d] for d in combined)
+
+
+def decode(bech: str) -> Tuple[str, List[int]]:
+    """bech32 string -> (hrp, 5-bit groups), verifying the checksum."""
+    if len(bech) > 90:
+        raise ValueError("bech32 string too long")
+    if bech.lower() != bech and bech.upper() != bech:
+        raise ValueError("mixed-case bech32 string")
+    bech = bech.lower()
+    pos = bech.rfind("1")
+    if pos < 1 or pos + 7 > len(bech):
+        raise ValueError("invalid bech32 separator position")
+    hrp, rest = bech[:pos], bech[pos + 1:]
+    if any(ord(c) < 33 or ord(c) > 126 for c in hrp):
+        raise ValueError(f"invalid human-readable part {hrp!r}")
+    try:
+        data = [_CHARSET.index(c) for c in rest]
+    except ValueError:
+        raise ValueError("invalid character in bech32 data part")
+    if not _verify_checksum(hrp, data):
+        raise ValueError("invalid bech32 checksum")
+    return hrp, data[:-6]
+
+
+def convert_and_encode(hrp: str, data: bytes) -> str:
+    """bytes -> bech32 (reference bech32.go ConvertAndEncode)."""
+    return encode(hrp, convert_bits(data, 8, 5, True))
+
+
+def decode_and_convert(bech: str) -> Tuple[str, bytes]:
+    """bech32 -> (hrp, bytes) (reference bech32.go DecodeAndConvert)."""
+    hrp, data = decode(bech)
+    return hrp, bytes(convert_bits(data, 5, 8, False))

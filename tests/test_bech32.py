@@ -1,0 +1,67 @@
+"""bech32 (reference libs/bech32/bech32.go + bech32_test.go, BIP-173
+test vectors)."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.libs.bech32 import (
+    convert_and_encode,
+    decode,
+    decode_and_convert,
+    encode,
+)
+
+
+def test_roundtrip_shasum():
+    """reference bech32_test.go TestEncodeAndDecode."""
+    digest = hashlib.sha256(b"hello world\n").digest()
+    bech = convert_and_encode("shasum", digest)
+    hrp, data = decode_and_convert(bech)
+    assert hrp == "shasum"
+    assert data == digest
+
+
+# BIP-173 valid test vectors (public specification)
+@pytest.mark.parametrize("valid", [
+    "A12UEL5L",
+    "an83characterlonghumanreadablepartthatcontainsthenumber1andtheexcludedcharactersbio1tt5tgs",
+    "abcdef1qpzry9x8gf2tvdw0s3jn54khce6mua7lmqqqxw",
+    "11qqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqqc8247j",
+    "split1checkupstagehandshakeupstreamerranterredcaperred2y9e3w",
+])
+def test_bip173_valid_vectors(valid):
+    hrp, data = decode(valid)
+    # re-encoding canonicalizes to lowercase and round-trips
+    assert encode(hrp, data) == valid.lower()
+
+
+@pytest.mark.parametrize("invalid", [
+    "pzry9x0s0muk",        # no separator
+    "1pzry9x0s0muk",       # empty hrp
+    "x1b4n0q5v",           # invalid data char
+    "li1dgmt3",            # too-short checksum
+    "A1G7SGD8",            # checksum error
+    "10a06t8",             # empty hrp (separator first)
+    "1qzzfhee",            # empty hrp
+    "abcdef1Qpzry9x8gf2tvdw0s3jn54khce6mua7lmqqqxw",  # mixed case
+    "an84characterslonghumanreadablepartthatcontainsthenumber1andtheexcludedcharactersbio1569pvx",  # >90 chars
+])
+def test_bip173_invalid_vectors(invalid):
+    with pytest.raises(ValueError):
+        decode(invalid)
+
+
+def test_convert_bits_strict_unpad_rejects_nonzero_padding():
+    from tendermint_tpu.libs.bech32 import convert_bits
+
+    with pytest.raises(ValueError):
+        convert_bits([0b11111], 5, 8, False)  # leftover non-zero bits
+
+
+def test_roundtrip_various_lengths():
+    for n in (0, 1, 19, 20, 32, 33):
+        payload = bytes(range(n % 256))[:n] or b""
+        bech = convert_and_encode("tm", payload)
+        hrp, back = decode_and_convert(bech)
+        assert (hrp, back) == ("tm", payload)
