@@ -469,6 +469,11 @@ def main():
         "unit": "ms",
         "vs_baseline": round(serial_ms / batch_ms, 2),
     }
+    if RLC_MODE:
+        out["note"] = (
+            "experimental: dispatch-bound, slower than the per-item "
+            "kernel at this scale (PROFILE.md); not used on consensus paths"
+        )
     if not RLC_MODE:
         # breakdown: the axon tunnel charges ~64ms latency per sync round
         # trip + ~10-30ms/MB, none of which exists on direct-attached TPU.
