@@ -1,0 +1,118 @@
+"""Real-TPU-gated kernel tests.
+
+The interpret-mode pallas parity tests run on CPU, where f32 matmuls are
+trivially exact — they cannot catch an XLA/Mosaic precision regression
+on real hardware (the MXU's default f32 matmul rounds inputs to bf16 and
+silently corrupts 13-bit limbs; the kernel relies on
+Precision.HIGHEST pass-splitting). This tier re-checks bit-identity of
+the fused pallas path vs the XLA path ON THE CHIP, and is skipped when
+no TPU is reachable.
+
+Runs in a subprocess because conftest.py pins in-process JAX to the CPU
+platform for the rest of the suite.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_PROBE_TIMEOUT = float(os.environ.get("TM_TPU_HW_PROBE_TIMEOUT", "60"))
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    for k in ("JAX_PLATFORMS", "TM_TPU_CRYPTO_BACKEND"):
+        env.pop(k, None)
+    return env
+
+
+def _tpu_reachable() -> bool:
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "devs = jax.devices()\n"
+        "assert devs and devs[0].platform.lower() != 'cpu'\n"
+        "print(float((jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()))\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=_PROBE_TIMEOUT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            env=_tpu_env(),
+        )
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+_TPU_LIVE = None
+
+
+def tpu_live() -> bool:
+    global _TPU_LIVE
+    if _TPU_LIVE is None:
+        _TPU_LIVE = _tpu_reachable()
+    return _TPU_LIVE
+
+
+_BIT_IDENTITY_SCRIPT = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+from tendermint_tpu.crypto import keys
+from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+assert jax.devices()[0].platform.lower() != "cpu", jax.devices()
+
+n = 512
+sks = [keys.PrivKeyEd25519.gen_from_secret(b"tpuhw-%d" % i) for i in range(64)]
+msgs, sigs, pks = [], [], []
+rng = np.random.default_rng(42)
+for i in range(n):
+    sk = sks[i % len(sks)]
+    msg = rng.integers(0, 256, size=int(rng.integers(1, 200)),
+                       dtype=np.uint8).tobytes()
+    sig = sk.sign(msg)
+    if i % 17 == 3:  # sprinkle invalid items so both mask polarities occur
+        sig = bytes([sig[0] ^ 1]) + sig[1:]
+    msgs.append(msg)
+    sigs.append(sig)
+    pks.append(sk.pub_key().bytes())
+
+sig_arr = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(n, 64)
+pk_arr = np.frombuffer(b"".join(pks), dtype=np.uint8).reshape(n, 32)
+buf, nb, mrows, bpad = V.pack_buffer(msgs, sig_arr, pk_arr, 1)
+d = jax.device_put(buf)
+
+fn_pallas = jax.jit(partial(V._verify_packed_core, nb=nb, mrows=mrows,
+                            use_pallas=True))
+fn_xla = jax.jit(partial(V._verify_packed_core, nb=nb, mrows=mrows,
+                         use_pallas=False))
+mask_p = np.asarray(fn_pallas(d))
+mask_x = np.asarray(fn_xla(d))
+assert mask_p.dtype == mask_x.dtype and mask_p.shape == mask_x.shape
+assert (mask_p == mask_x).all(), (
+    "pallas/XLA mask divergence at %s" % np.nonzero(mask_p != mask_x)[0][:10])
+assert int(mask_x[:n].sum()) == sum(1 for i in range(n) if i % 17 != 3), \
+    "XLA path masks wrong vs ground truth"
+print("BIT-IDENTITY-OK", int(mask_x[:n].sum()), n)
+"""
+
+
+@pytest.mark.skipif(not tpu_live(), reason="no TPU reachable (tunnel down?)")
+def test_pallas_vs_xla_bit_identity_on_tpu():
+    """The fused pallas kernel and the XLA path must produce identical
+    verify masks on REAL TPU hardware — this is the tier that would
+    catch an MXU precision regression (bf16 input rounding) that
+    interpret-mode CPU tests cannot see."""
+    r = subprocess.run(
+        [sys.executable, "-c", _BIT_IDENTITY_SCRIPT],
+        capture_output=True, timeout=600, env=_tpu_env(),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    out = r.stdout.decode()
+    assert r.returncode == 0, f"stdout={out[-2000:]}\nstderr={r.stderr.decode()[-2000:]}"
+    assert "BIT-IDENTITY-OK" in out
