@@ -188,32 +188,61 @@ def pow22523(z):
 # --- canonical form --------------------------------------------------------
 
 
-def _seq_carry(v):
-    """Exact sequential carry chain; returns (limbs in [0, 2^13), carry_out).
+_CIN = (-2, -1, 0, 1)  # carry domain: |limb| <= 2*MASK ⇒ carry-out ∈ [-2, 1]
 
-    Works for signed inputs (arithmetic shift keeps value invariant).
+
+def _shift_limbs(x, s, fill):
+    """out[i] = x[i-s] for i >= s; bottom s rows = identity fill."""
+    pad = jnp.full((s,) + x.shape[1:], fill, jnp.int32)
+    return jnp.concatenate([pad, x[:-s]], axis=0)
+
+
+def _sel4(quad, x):
+    """Evaluate the carry-function quad at carry values x ∈ {-2,-1,0,1}."""
+    return jnp.where(
+        x < -1, quad[0],
+        jnp.where(x < 0, quad[1], jnp.where(x < 1, quad[2], quad[3])))
+
+
+def _seq_carry(v):
+    """Exact carry resolve; returns (limbs in [0, 2^13), carry_out as a
+    keepdims (1, ...) row — 2-D shapes lower in Mosaic too, so the pallas
+    kernels reuse this exact implementation).
+
+    Works for signed inputs with |limb| <= 2*MASK (the LIMB_BOUND
+    regime). Not a 20-step sequential chain: each limb's carry-out is a
+    function of its carry-in, tabulated on the 4-value carry domain and
+    composed with a Kogge-Stone parallel-prefix scan — log2(20)=5 rounds
+    of full-width selects instead of 20 dependent (1, B) ops.
     """
-    outs = []
-    carry = jnp.zeros(v.shape[1:], dtype=jnp.int32)
-    for i in range(v.shape[0]):
-        t = v[i] + carry
-        carry = t >> BITS
-        outs.append(t & MASK)
-    return jnp.stack(outs), carry
+    quad = [(v + c) >> BITS for c in _CIN]
+    s = 1
+    while s < NLIMB:
+        low = [_shift_limbs(quad[e], s, _CIN[e]) for e in range(4)]
+        quad = [_sel4(quad, low[e]) for e in range(4)]
+        s <<= 1
+    # carry INTO limb i = prefix over limbs [0..i-1] evaluated at 0
+    zero = jnp.zeros((1,) + v.shape[1:], jnp.int32)
+    cin = jnp.concatenate([zero, quad[2][:-1]], axis=0)
+    return (v + cin) & MASK, quad[2][NLIMB - 1:]
 
 
 def _cond_sub(v, const_limbs):
-    """v - const if that's >= 0, else v. Both canonical 20-limb."""
+    """v - const if that's >= 0, else v. Both canonical 20-limb.
+    Borrow domain is {-1, 0}, so a function PAIR suffices."""
     t = v - const_limbs
-    outs = []
-    borrow = jnp.zeros(v.shape[1:], dtype=jnp.int32)
-    for i in range(NLIMB):
-        x = t[i] + borrow
-        borrow = x >> BITS
-        outs.append(x & MASK)
-    t_norm = jnp.stack(outs)
-    underflow = borrow < 0
-    return jnp.where(underflow[None, :], v, t_norm)
+    pair = [(t - 1) >> BITS, t >> BITS]
+    s = 1
+    while s < NLIMB:
+        low0 = _shift_limbs(pair[0], s, -1)
+        low1 = _shift_limbs(pair[1], s, 0)
+        pair = [jnp.where(low0 < 0, pair[0], pair[1]),
+                jnp.where(low1 < 0, pair[0], pair[1])]
+        s <<= 1
+    zero = jnp.zeros((1,) + t.shape[1:], jnp.int32)
+    bin_ = jnp.concatenate([zero, pair[1][:-1]], axis=0)
+    t_norm = (t + bin_) & MASK
+    return jnp.where(pair[1][NLIMB - 1:] < 0, v, t_norm)
 
 
 def _p_multiples():
@@ -232,12 +261,12 @@ def _const_np_raw(v: int):
 
 
 def freeze(a):
-    """Fully canonical limbs in [0, p). Sequential — use once per encode."""
+    """Fully canonical limbs in [0, p)."""
     v = a
     for _ in range(2):
         limbs, carry = _seq_carry(v)
-        v = limbs.at[0].add(608 * carry)
-    limbs, carry = _seq_carry(v)  # carry is 0 now; value < 32p
+        v = jnp.concatenate([limbs[:1] + 608 * carry, limbs[1:]], axis=0)
+    limbs, _ = _seq_carry(v)  # carry is 0 now; value < 32p
     v = limbs
     for m in _p_multiples():
         v = _cond_sub(v, m)

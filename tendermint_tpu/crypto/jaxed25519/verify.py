@@ -49,13 +49,15 @@ def on_tpu() -> bool:
 
 
 def _verify_core(msg_words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, pallas_interpret: bool = False):
     digest = sha512.sha512_batch(msg_words, nblocks)
     k = scalar.reduce_512(sha512.digest_to_scalar_limbs(digest))
     if use_pallas:
         # fused VMEM-resident tail: decompress -> Straus -> encode -> compare
-        # (one Mosaic kernel, no HBM intermediates — see PROFILE.md)
-        return pallas_kernels.verify_tail(a_y, a_sign, r_y, r_sign, s_limbs, k)
+        # (one Mosaic kernel, no HBM intermediates — see PROFILE.md);
+        # interpret=True runs the SAME kernel path on a CPU mesh (dryrun)
+        return pallas_kernels.verify_tail(a_y, a_sign, r_y, r_sign, s_limbs, k,
+                                          interpret=pallas_interpret)
     a_pt, ok_a = curve.decompress(a_y, a_sign)
     # R' = [S]B + [k](−A) in ONE Straus chain (shared doublings)
     r_prime = curve.straus_mul_sub(s_limbs, k, curve.negate(a_pt))
@@ -104,7 +106,8 @@ def _jitted(nb: int, bpad: int, ndev: int):
 ROWS_AUX = 25  # mlen row + 16 sig rows + 8 pk rows
 
 
-def _verify_packed_core(buf, nb: int, mrows: int, use_pallas: bool = False):
+def _verify_packed_core(buf, nb: int, mrows: int, use_pallas: bool = False,
+                        pallas_interpret: bool = False):
     """Unpack ONE (25 + mrows, B) int32 buffer into the _verify_core
     inputs. One host→device transfer; everything rides byte-dense
     (signature/pubkey/message bytes 4-per-int32) and the SHA-512 block
@@ -152,24 +155,73 @@ def _verify_packed_core(buf, nb: int, mrows: int, use_pallas: bool = False):
     a_sign = (a_y[19] >> 8) & 1
     a_y = a_y.at[19].set(a_y[19] & 0xFF)
     return _verify_core(words, inb, a_y, a_sign, r_y, r_sign, s_limbs,
-                        use_pallas=use_pallas)
+                        use_pallas=use_pallas,
+                        pallas_interpret=pallas_interpret)
+
+
+def _pallas_flags(force_pallas=None) -> tuple:
+    """(use_pallas, pallas_interpret) for the current backend.
+
+    Default: the fused Mosaic kernel on TPU, the XLA kernel elsewhere.
+    force_pallas=True additionally enables INTERPRET mode on non-TPU
+    backends so a CPU mesh exercises the exact pallas-in-shard_map code
+    path (dryrun_multichip does this); it is far too slow for general
+    CPU testing, hence opt-in. TM_TPU_FORCE_PALLAS=0/1 fills in the
+    DEFAULT only — an explicit force_pallas argument always wins, so a
+    caller that claims to validate the pallas path cannot be silently
+    rerouted by the environment."""
+    if force_pallas is None:
+        env = os.environ.get("TM_TPU_FORCE_PALLAS")
+        if env in ("0", "1"):
+            force_pallas = env == "1"
+    if force_pallas is None:
+        return on_tpu(), False
+    if not force_pallas:
+        return False, False
+    return True, not on_tpu()
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        # pallas_call out_shapes don't declare vma; skip the check so the
+        # fused kernel can live inside the shard_map body
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax without check_vma
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs)
+
+
+def _jitted_packed(nb: int, mrows: int, bpad: int, ndev: int,
+                   force_pallas=None):
+    # resolve env/backend flags BEFORE the cache so flipping
+    # TM_TPU_FORCE_PALLAS between calls can't return a stale kernel path
+    use_pallas, interp = _pallas_flags(force_pallas)
+    return _jitted_packed_impl(nb, mrows, bpad, ndev, use_pallas, interp)
 
 
 @lru_cache(maxsize=32)
-def _jitted_packed(nb: int, mrows: int, bpad: int, ndev: int):
+def _jitted_packed_impl(nb: int, mrows: int, bpad: int, ndev: int,
+                        use_pallas: bool, interp: bool):
     if ndev > 1:
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from jax.sharding import Mesh, PartitionSpec as P
 
-        # GSPMD cannot auto-partition a Mosaic custom call: the sharded
-        # path stays on the XLA kernel (shard_map+pallas is future work)
+        # GSPMD cannot auto-partition a Mosaic custom call, but shard_map
+        # hands the body per-device blocks — exactly the shape the pallas
+        # kernel wants — so the fused kernel runs per chip with no
+        # cross-device traffic except the output concat
         mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
-        sh = NamedSharding(mesh, P(None, "dp"))
-        out = NamedSharding(mesh, P("dp"))
-        return jax.jit(partial(_verify_packed_core, nb=nb, mrows=mrows,
-                               use_pallas=False),
-                       in_shardings=(sh,), out_shardings=out)
+        body = partial(_verify_packed_core, nb=nb, mrows=mrows,
+                       use_pallas=use_pallas, pallas_interpret=interp)
+        return jax.jit(_shard_map(body, mesh,
+                                  in_specs=(P(None, "dp"),),
+                                  out_specs=P("dp")))
     return jax.jit(partial(_verify_packed_core, nb=nb, mrows=mrows,
-                           use_pallas=on_tpu()))
+                           use_pallas=use_pallas, pallas_interpret=interp))
 
 
 @lru_cache(maxsize=1)
@@ -435,11 +487,15 @@ def _ref_P() -> int:
     return ref.P
 
 
-def make_sharded_commit_step(mesh):
+def make_sharded_commit_step(mesh, force_pallas=None):
     """Sharded verify-commit step over a 1-D 'dp' mesh: per-signature
     validity masks (sharded) plus the 2/3-quorum voting-power tally via a
     psum collective — the device-parallel equivalent of the reference's
-    talliedVotingPower loop (types/validator_set.go:358-366).
+    talliedVotingPower loop (types/validator_set.go:358-366). Each device
+    runs the fused pallas kernel on its own block when on TPU (shard_map
+    hands the body per-device shapes, so the Mosaic call never meets
+    GSPMD); force_pallas=True exercises the same path in interpret mode
+    on a CPU mesh.
 
     The tally is exact int32 arithmetic in 2^16 limbs (powers split into
     lo/hi 16-bit halves, summed separately, recombined on host as Python
@@ -449,15 +505,12 @@ def make_sharded_commit_step(mesh):
     re-tallies host-side from the mask with unbounded Python ints."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
-
+    use_pallas, interp = _pallas_flags(force_pallas)
     dp = lambda n: P(*([None] * (n - 1) + ["dp"]))
 
     def step(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs, powers, for_block):
-        mask = _verify_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs)
+        mask = _verify_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs,
+                            use_pallas=use_pallas, pallas_interpret=interp)
         powers = powers.astype(jnp.int32)
         counted = jnp.where(mask & (for_block == 1), powers, 0)
         lo = jnp.sum(counted & 0xFFFF)
@@ -465,9 +518,9 @@ def make_sharded_commit_step(mesh):
         return mask, jax.lax.psum(lo, "dp"), jax.lax.psum(hi, "dp")
 
     return jax.jit(
-        shard_map(
+        _shard_map(
             step,
-            mesh=mesh,
+            mesh,
             in_specs=(dp(4), dp(1), dp(2), dp(1), dp(2), dp(1), dp(2), dp(1), dp(1)),
             out_specs=(dp(1), P(), P()),
         )

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from tendermint_tpu.crypto.jaxed25519 import field, pack, ref
+import jax.numpy as jnp
 import jax
 
 # jit the expensive chains once — eager dispatch of ~300-op muls is slow
@@ -155,3 +156,90 @@ def test_lt_const():
     )
     got = pack.lt_const_le_batch(arr, L)
     assert list(got) == [True, True, False, False, False]
+
+
+class TestKoggeStoneCarry:
+    """The Kogge-Stone carry/borrow resolves (field._seq_carry/_cond_sub
+    and their pallas twins) must match a plain sequential oracle across
+    the full LIMB_BOUND input range, including all-propagate rows."""
+
+    @staticmethod
+    def _seq_carry_oracle(v):
+        v = np.asarray(v)
+        carry = np.zeros(v.shape[1], np.int64)
+        out = np.zeros_like(v)
+        for i in range(v.shape[0]):
+            t = v[i].astype(np.int64) + carry
+            carry = t >> field.BITS
+            out[i] = t & field.MASK
+        return out, carry
+
+    @staticmethod
+    def _cond_sub_oracle(v, c):
+        v = np.asarray(v)
+        t = (v - np.asarray(c)).astype(np.int64)
+        borrow = np.zeros(t.shape[1], np.int64)
+        out = np.zeros_like(t)
+        for i in range(field.NLIMB):
+            x = t[i] + borrow
+            borrow = x >> field.BITS
+            out[i] = x & field.MASK
+        return np.where(borrow < 0, v, out)
+
+    def _adversarial_batch(self, rng, lo, hi, b=96):
+        v = rng.integers(lo, hi + 1, size=(field.NLIMB, b)).astype(np.int32)
+        v[:, 0] = field.MASK   # all-propagate carries
+        v[:, 1] = lo
+        v[:, 2] = hi
+        v[:, 3] = 0
+        v[:, 4] = -1 if lo < 0 else 1
+        return v
+
+    def test_field_seq_carry_matches_oracle(self):
+        rng = np.random.default_rng(11)
+        bound = field.LIMB_BOUND
+        v = self._adversarial_batch(rng, -bound, bound)
+        got_l, got_c = field._seq_carry(jnp.asarray(v))
+        ref_l, ref_c = self._seq_carry_oracle(v)
+        assert (np.asarray(got_l) == ref_l).all()
+        assert (np.asarray(got_c) == ref_c).all()
+
+    def test_field_cond_sub_matches_oracle(self):
+        rng = np.random.default_rng(12)
+        v = rng.integers(0, field.MASK + 1,
+                         size=(field.NLIMB, 96)).astype(np.int32)
+        c = rng.integers(0, field.MASK + 1,
+                         size=(field.NLIMB, 96)).astype(np.int32)
+        v[:, 0] = c[:, 0]              # exact equality -> zero
+        v[:, 1] = 0; c[:, 1] = field.MASK  # guaranteed underflow
+        got = np.asarray(field._cond_sub(jnp.asarray(v), jnp.asarray(c)))
+        assert (got == self._cond_sub_oracle(v, c)).all()
+
+    def test_pallas_ops_carry_matches_oracle(self):
+        from tendermint_tpu.crypto.jaxed25519.pallas_kernels import _make_ops
+
+        ops = _make_ops(interpret=True)
+        rng = np.random.default_rng(13)
+        bound = field.LIMB_BOUND
+        v = self._adversarial_batch(rng, -bound, bound)
+        got_l, got_c = ops.seq_carry(jnp.asarray(v))
+        ref_l, ref_c = self._seq_carry_oracle(v)
+        assert (np.asarray(got_l) == ref_l).all()
+        assert (np.asarray(got_c)[0] == ref_c).all()
+
+    def test_freeze_canonicalizes_mod_p(self):
+        rng = np.random.default_rng(14)
+        bound = field.LIMB_BOUND
+        v = self._adversarial_batch(rng, -bound, bound, b=32)
+        got = np.asarray(field.freeze(jnp.asarray(v)))
+        for col in range(v.shape[1]):
+            want = sum(
+                int(v[i, col]) << (field.BITS * i)
+                for i in range(field.NLIMB)
+            ) % ref.P
+            have = sum(
+                int(got[i, col]) << (field.BITS * i)
+                for i in range(field.NLIMB)
+            )
+            assert have == want
+            assert got[:, col].min() >= 0 and got[:, col].max() <= field.MASK
