@@ -234,6 +234,16 @@ def votes_main(degraded):
 
     serial_ms = _best_of(serial, 3)
 
+    if not degraded:
+        # production flow: warmup compiles the bucket this batch uses AND
+        # calibrates the adaptive cutoff to the measured dispatch-vs-serial
+        # break-even — through a high-latency tunnel the 150-vote batch
+        # correctly DECLINES the device (vs_baseline ≈ 1.0 instead of the
+        # old guaranteed loss); on direct-attached TPU it rides the device
+        from tendermint_tpu.crypto.jaxed25519.verify import warmup
+
+        warmup(buckets=(nval,))
+
     # batched path (warm once, then best of N)
     def run():
         for type_, votes in rounds:
@@ -251,9 +261,18 @@ def votes_main(degraded):
         "vs_baseline": round(serial_ms / best, 2),
     }
     if not degraded:
-        # 2 dispatches x ~64ms tunnel latency dominate at 150-vote scale;
-        # on direct-attached TPU the batch path wins (see PROFILE.md)
-        out["tunnel_note"] = "wall includes 2 remote-TPU round trips"
+        from tendermint_tpu.crypto import batch as crypto_batch
+
+        # effective_batch_min already folds in env-override precedence, so
+        # the reported cutoff always matches the actual routing decision
+        eff = crypto_batch.effective_batch_min()
+        out["batch_cutoff"] = eff
+        if nval >= eff:
+            # 2 dispatches x ~64ms tunnel latency dominate at 150-vote
+            # scale when the device is used
+            out["tunnel_note"] = "wall includes 2 remote-TPU round trips"
+        else:
+            out["note"] = "calibrated cutoff routed this batch to host CPU"
     _emit(out, degraded)
 
 

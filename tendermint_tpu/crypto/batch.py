@@ -78,7 +78,7 @@ class AdaptiveBatchVerifier(BatchVerifier):
         super().__init__()
         self._device_factory = device_factory
         if min_device_batch is None:
-            min_device_batch = int(os.environ.get("TM_TPU_BATCH_MIN", "16"))
+            min_device_batch = effective_batch_min()
         self._min = min_device_batch
 
     def verify(self) -> List[bool]:
@@ -94,6 +94,39 @@ class AdaptiveBatchVerifier(BatchVerifier):
 _registry: dict[str, Callable[[], BatchVerifier]] = {}
 _default_lock = threading.Lock()
 _default_name: str | None = None
+_calibrated_min: int | None = None
+
+
+def set_calibrated_batch_min(n: int) -> None:
+    """Record the MEASURED device break-even (verify.warmup calibrates:
+    one compiled-dispatch round trip vs the serial per-signature cost on
+    the hardware actually attached). Consulted whenever TM_TPU_BATCH_MIN
+    is not explicitly set, so the device is only used where it wins —
+    e.g. a remote-tunnel TPU with ~64ms round trips calibrates to
+    hundreds, while direct-attached hardware calibrates to ~tens."""
+    global _calibrated_min
+    with _default_lock:
+        _calibrated_min = max(1, int(n))
+
+
+def calibrated_batch_min() -> int | None:
+    with _default_lock:
+        return _calibrated_min
+
+
+def effective_batch_min(default: int = 16) -> int:
+    """The adaptive cutoff: explicit TM_TPU_BATCH_MIN wins, then the
+    warmup-measured calibration, then the static default."""
+    env = os.environ.get("TM_TPU_BATCH_MIN")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass  # malformed env must never take down verification
+    with _default_lock:
+        if _calibrated_min is not None:
+            return _calibrated_min
+    return default
 
 
 def register_backend(name: str, factory: Callable[[], BatchVerifier]) -> None:

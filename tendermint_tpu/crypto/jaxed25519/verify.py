@@ -532,12 +532,22 @@ def tallied_power(lo, hi) -> int:
     return int(lo) + (int(hi) << 16)
 
 
-@lru_cache(maxsize=4)
-def _sharded_commit_fn(ndev: int):
+def _sharded_commit_fn(ndev: int, force_pallas=None):
+    # resolve flags BEFORE the cache (same staleness fix as
+    # _jitted_packed): flipping TM_TPU_FORCE_PALLAS must not return a
+    # kernel compiled for the previous setting
+    use_pallas, interp = _pallas_flags(force_pallas)
+    return _sharded_commit_fn_impl(ndev, use_pallas, interp)
+
+
+@lru_cache(maxsize=8)
+def _sharded_commit_fn_impl(ndev: int, use_pallas: bool, interp: bool):
     from jax.sharding import Mesh
 
     mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
-    return make_sharded_commit_step(mesh)
+    # interp is True only when use_pallas is, and make_sharded_commit_step
+    # re-derives it identically from the boolean
+    return make_sharded_commit_step(mesh, force_pallas=use_pallas)
 
 
 def sharded_commit_verify(msgs, sigs, pks, powers, for_block,
@@ -589,17 +599,26 @@ def sharded_commit_verify(msgs, sigs, pks, powers, for_block,
 
 
 def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
-           devices: int | None = None) -> None:
+           devices: int | None = None, calibrate: bool = True):
     """Compile the hot bucket shapes ahead of time. First-use compile of
     a bucket costs 20-40s on TPU (persistent cache makes later processes
     cheap, but the FIRST node on a machine pays it) — a consensus node
     must not discover that cost inside the live vote path, so node
     startup calls this from a background thread. Vote sign-bytes are
     ~97-128 bytes (nb=2 blocks, mrows=32 message rows); bucket sizes
-    cover the adaptive batcher's first escalation steps."""
+    cover the adaptive batcher's first escalation steps.
+
+    With calibrate=True (default; TM_TPU_CALIBRATE=0 disables), also
+    measures the compiled-dispatch round trip vs the serial per-sig
+    host cost and installs the break-even as the adaptive batch cutoff
+    (crypto.batch.set_calibrated_batch_min) — the device is then only
+    chosen where it wins on the latency of the hardware actually
+    attached (a ~64ms-RTT tunnel calibrates to hundreds; direct-attach
+    to tens). Returns the calibrated cutoff, or None."""
     import numpy as np
 
     ndev = devices if devices is not None else len(jax.devices())
+    small_fn, small_shape = None, None
     for b in buckets:
         bpad = _bucket(b)
         if ndev > 1:
@@ -607,6 +626,8 @@ def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
             bpad = (bpad + ndev - 1) // ndev * ndev
         fn = _jitted_packed(nb, mrows, bpad, ndev)
         fn(jnp.asarray(np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)))
+        if small_fn is None or bpad < small_shape[1]:
+            small_fn, small_shape = fn, (ROWS_AUX + mrows, bpad)
         if ndev > 1:
             # the multi-device commit path routes through the shard_map
             # psum step (sharded_commit_verify) — compile it too, or the
@@ -616,6 +637,50 @@ def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
             zrow = np.zeros((bpad,), np.int32)
             step(np.zeros((nb, 16, 2, bpad), np.uint32), zrow + 1, z20, zrow,
                  z20, zrow, z20, zrow, zrow)
+    if (calibrate and small_fn is not None
+            and os.environ.get("TM_TPU_CALIBRATE", "1") != "0"):
+        return _calibrate_batch_min(small_fn, small_shape)
+    return None
+
+
+def _calibrate_batch_min(fn, shape) -> int | None:
+    """Measure break-even between one device dispatch (round trip incl.
+    transfer + any tunnel latency) and serial host verifies; install it
+    via crypto.batch.set_calibrated_batch_min. Median-of-3 on the
+    dispatch (tunnel variance is large); small margin toward serial so
+    borderline batches stay on the predictable host path."""
+    import time
+
+    import numpy as np
+
+    from ..batch import set_calibrated_batch_min
+    from ..keys import PrivKeyEd25519
+
+    try:
+        d = jax.device_put(np.zeros(shape, dtype=np.int32))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(fn(d))
+            ts.append(time.perf_counter() - t0)
+        dispatch_ms = sorted(ts)[1] * 1e3
+
+        sk = PrivKeyEd25519.gen_from_secret(b"tm-tpu-calibration")
+        msg = b"\xa5" * 110
+        sig = sk.sign(msg)
+        pk = sk.pub_key()
+        reps = 32
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pk.verify_bytes(msg, sig)
+        serial_ms = (time.perf_counter() - t0) / reps * 1e3
+        if serial_ms <= 0:
+            return None
+        n_star = int(min(max(round(dispatch_ms / serial_ms * 1.1), 4), 4096))
+        set_calibrated_batch_min(n_star)
+        return n_star
+    except Exception:
+        return None  # calibration is best-effort; the static default stands
 
 
 class JAXBatchVerifier(BatchVerifier):

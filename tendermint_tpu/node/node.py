@@ -392,7 +392,13 @@ class Node:
                 env = os.environ.get("TM_TPU_WARMUP_BUCKETS")
                 buckets = (tuple(int(x) for x in env.split(",") if x)
                            if env else (8, 16, 64))
-                warmup(buckets=buckets)
+                cutoff = warmup(buckets=buckets)
+                if cutoff is not None:
+                    LOG.info(
+                        "verify warmup: adaptive batch cutoff calibrated "
+                        "to %d (measured dispatch vs serial break-even)",
+                        cutoff,
+                    )
                 self._verify_warmed = True
             except Exception as e:  # noqa: BLE001 - warmup is best-effort
                 LOG.info("verify warmup skipped: %s", e)

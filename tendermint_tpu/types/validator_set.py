@@ -260,14 +260,12 @@ class ValidatorSet:
         or None)."""
         if entries:
             try:
-                import os
-
                 # Backend and batch-size checks come FIRST: importing jax /
                 # calling jax.devices() initializes the TPU backend, which
                 # must never happen inside the consensus path when the host
                 # OpenSSL backend is selected or the batch is tiny.
                 backend = batch.default_backend_name()
-                min_batch = (int(os.environ.get("TM_TPU_BATCH_MIN", "16"))
+                min_batch = (batch.effective_batch_min()
                              if backend == "adaptive" else 1)
                 if (backend in ("jax", "adaptive")
                         and len(entries) >= min_batch

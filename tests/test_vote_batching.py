@@ -204,3 +204,71 @@ class TestLiveVoteBatching:
             assert mask == [True, False, True, False]
         finally:
             bus.stop()
+
+
+class TestCalibratedCutoff:
+    """Auto-calibrated adaptive cutoff (verify.warmup measures the
+    dispatch-vs-serial break-even; crypto.batch stores it)."""
+
+    def _reset(self):
+        crypto_batch._calibrated_min = None
+
+    def test_effective_batch_min_precedence(self, monkeypatch):
+        self._reset()
+        # default when nothing is set
+        monkeypatch.delenv("TM_TPU_BATCH_MIN", raising=False)
+        assert crypto_batch.effective_batch_min() == 16
+        # calibration installs a measured value
+        crypto_batch.set_calibrated_batch_min(700)
+        assert crypto_batch.effective_batch_min() == 700
+        # explicit env ALWAYS wins over calibration
+        monkeypatch.setenv("TM_TPU_BATCH_MIN", "8")
+        assert crypto_batch.effective_batch_min() == 8
+        # malformed env falls back to calibration, not a crash
+        monkeypatch.setenv("TM_TPU_BATCH_MIN", "lots")
+        assert crypto_batch.effective_batch_min() == 700
+        self._reset()
+
+    def test_adaptive_verifier_uses_calibration(self, monkeypatch):
+        self._reset()
+        monkeypatch.delenv("TM_TPU_BATCH_MIN", raising=False)
+        calls = []
+
+        class FakeDevice(crypto_batch.BatchVerifier):
+            def verify(self):
+                calls.append(len(self._items))
+                return [True] * len(self._items)
+
+        crypto_batch.set_calibrated_batch_min(10)
+        bv = crypto_batch.AdaptiveBatchVerifier(FakeDevice)
+        for _ in range(9):
+            bv.add(b"m", b"s" * 64, b"p" * 32)
+        bv.verify()
+        assert calls == []  # 9 < calibrated 10: host path
+        bv2 = crypto_batch.AdaptiveBatchVerifier(FakeDevice)
+        for _ in range(10):
+            bv2.add(b"m", b"s" * 64, b"p" * 32)
+        assert bv2.verify() == [True] * 10
+        assert calls == [10]
+        self._reset()
+
+    def test_warmup_calibrates_on_this_backend(self, monkeypatch):
+        """warmup(calibrate=True) measures REAL dispatch + serial costs on
+        the attached backend (CPU here) and installs a sane cutoff."""
+        self._reset()
+        monkeypatch.delenv("TM_TPU_BATCH_MIN", raising=False)
+        from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+        got = V.warmup(buckets=(8,), calibrate=True)
+        assert got is not None and 4 <= got <= 4096
+        assert crypto_batch.calibrated_batch_min() == got
+        assert crypto_batch.effective_batch_min() == got
+        self._reset()
+
+    def test_calibrate_env_disable(self, monkeypatch):
+        self._reset()
+        monkeypatch.setenv("TM_TPU_CALIBRATE", "0")
+        from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+        assert V.warmup(buckets=(8,), calibrate=True) is None
+        assert crypto_batch.calibrated_batch_min() is None
