@@ -148,12 +148,17 @@ class TxIndexConfig:
 
 @dataclass
 class InstrumentationConfig:
-    """reference config/config.go:767-800"""
+    """reference config/config.go:767-800 (+ tracing, ours: the
+    libs/tracing.py span recorder behind /debug/trace on prof_laddr)"""
 
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
     max_open_connections: int = 3
     namespace: str = "tendermint"
+    # ring-buffered span tracing of the consensus/crypto/WAL hot path;
+    # exported as chrome://tracing JSON from the prof server
+    tracing: bool = False
+    tracing_buffer_size: int = 65536
 
 
 @dataclass
@@ -204,7 +209,10 @@ class Config:
 
     @classmethod
     def from_toml(cls, text: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
+        except ImportError:  # Python < 3.11: the vendored backport
+            import tomli as tomllib
 
         o = tomllib.loads(text)
         cfg = cls()

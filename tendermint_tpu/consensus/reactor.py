@@ -464,7 +464,7 @@ class ConsensusReactor(Reactor):
             return
         our_votes = vs.bit_array_by_block_id(msg.block_id)
         if our_votes is None:
-            our_votes = BitArray(vs.val_set.size())
+            our_votes = BitArray(len(vs.val_set))
         peer.try_send(
             VOTE_SET_BITS_CHANNEL,
             encode_msg(

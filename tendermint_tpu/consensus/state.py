@@ -27,10 +27,11 @@ import logging
 import queue
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, List, Optional
 
 from ..config import ConsensusConfig
-from ..libs import fail
+from ..libs import fail, tracing
 from ..state import BlockExecutor
 from ..state import state as sm_state
 from ..types.basic import (
@@ -106,6 +107,8 @@ class ConsensusState:
         self.event_bus = event_bus or NopEventBus()
         self.priv_validator = priv_validator
         self.wal = wal if wal is not None else NilWAL()
+        # process-global tracer (libs/tracing.py): disabled → no-op spans
+        self.tracer = tracing.get_tracer()
 
         self.rs = RoundState()
         self.state = None  # set by update_to_state
@@ -271,6 +274,22 @@ class ConsensusState:
         if self.on_new_round_step is not None:
             self.on_new_round_step(rs)
 
+    @contextmanager
+    def _step_span(self, span_name: str, step: str, height: int, round_: int):
+        """Wraps the effective body of one step transition (after its
+        height/round/step gate passed): a tracer span named after the
+        reference transition (enterPropose, …) plus one sample in the
+        consensus_step_duration_seconds{step=...} histogram. Both are
+        no-ops until the node enables instrumentation."""
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span("consensus." + span_name, cat="consensus",
+                                  height=height, round=round_):
+                yield
+        finally:
+            self.metrics.step_duration.with_labels(step).observe(
+                time.perf_counter() - t0)
+
     # --- the receive loop ---------------------------------------------------
 
     def _tock_forwarder(self) -> None:
@@ -365,6 +384,11 @@ class ConsensusState:
         height, the LastCommit's valset for late precommits. Votes that
         can't be mapped (wrong height/index/address) come back False and
         take the serial path's normal rejection."""
+        with self.tracer.span("consensus.preverifyVotes", cat="consensus",
+                              n=len(votes), height=self.rs.height):
+            return self._preverify_votes_inner(votes)
+
+    def _preverify_votes_inner(self, votes) -> List[bool]:
         from ..crypto import batch as crypto_batch
 
         rs = self.rs
@@ -454,45 +478,46 @@ class ConsensusState:
             return
         LOG.debug("enterNewRound(%d/%d) cur=%s", height, round_, rs)
 
-        # round advance: rotate proposer (reference :747-753)
-        validators = rs.validators
-        if rs.round < round_:
-            validators = validators.copy()
-            validators.increment_proposer_priority(round_ - rs.round)
+        with self._step_span("enterNewRound", "new_round", height, round_):
+            # round advance: rotate proposer (reference :747-753)
+            validators = rs.validators
+            if rs.round < round_:
+                validators = validators.copy()
+                validators.increment_proposer_priority(round_ - rs.round)
 
-        rs.round = round_
-        rs.step = STEP_NEW_ROUND
-        rs.validators = validators
-        if round_ != 0:
-            # round 0 fields were set in update_to_state (reference :760-768)
-            rs.proposal = None
-            rs.proposal_block = None
-            rs.proposal_block_parts = None
-        rs.votes.set_round(round_ + 1)
-        rs.triggered_timeout_precommit = False
-        self.event_bus.publish_new_round(self.get_round_state())
-        self._new_step()
+            rs.round = round_
+            rs.step = STEP_NEW_ROUND
+            rs.validators = validators
+            if round_ != 0:
+                # round 0 fields were set in update_to_state (reference :760-768)
+                rs.proposal = None
+                rs.proposal_block = None
+                rs.proposal_block_parts = None
+            rs.votes.set_round(round_ + 1)
+            rs.triggered_timeout_precommit = False
+            self.event_bus.publish_new_round(self.get_round_state())
+            self._new_step()
 
-        # WaitForTxs semantics (reference :775-792 + config.WaitForTxs):
-        # with create_empty_blocks off (or paced by an interval), an empty
-        # mempool waits — except when a proof block is needed (app hash
-        # changed; needProofBlock :713-721)
-        wait_for_txs = (
-            (not self.config.create_empty_blocks or self.config.create_empty_blocks_interval > 0)
-            and round_ == 0
-            and self.mempool is not None
-            and self.mempool.size() == 0
-            and not self._need_proof_block(height)
-        )
-        if wait_for_txs:
-            if self.config.create_empty_blocks_interval > 0:
-                self._schedule_timeout(
-                    self.config.create_empty_blocks_interval, height, round_, STEP_NEW_ROUND
-                )
-            self.mempool.notify_txs_available(
-                lambda: self._queue.put(("timeout", TimeoutInfo(0, height, round_, STEP_NEW_ROUND)))
+            # WaitForTxs semantics (reference :775-792 + config.WaitForTxs):
+            # with create_empty_blocks off (or paced by an interval), an empty
+            # mempool waits — except when a proof block is needed (app hash
+            # changed; needProofBlock :713-721)
+            wait_for_txs = (
+                (not self.config.create_empty_blocks or self.config.create_empty_blocks_interval > 0)
+                and round_ == 0
+                and self.mempool is not None
+                and self.mempool.size() == 0
+                and not self._need_proof_block(height)
             )
-            return
+            if wait_for_txs:
+                if self.config.create_empty_blocks_interval > 0:
+                    self._schedule_timeout(
+                        self.config.create_empty_blocks_interval, height, round_, STEP_NEW_ROUND
+                    )
+                self.mempool.notify_txs_available(
+                    lambda: self._queue.put(("timeout", TimeoutInfo(0, height, round_, STEP_NEW_ROUND)))
+                )
+                return
         self._enter_propose(height, round_)
 
     def _need_proof_block(self, height: int) -> bool:
@@ -511,20 +536,22 @@ class ConsensusState:
         ):
             return
         LOG.debug("enterPropose(%d/%d)", height, round_)
-        rs.round = round_
-        rs.step = STEP_PROPOSE
-        self._new_step()
-
         # if we already have the complete proposal, go straight to prevote
-        # (guarded at the end, reference :812-820)
+        # (guarded at the end, reference :812-820); the cascade runs
+        # OUTSIDE the step span so 'propose' never includes prevote time
         try:
-            self._schedule_timeout(self.config.propose(round_), height, round_, STEP_PROPOSE)
+            with self._step_span("enterPropose", "propose", height, round_):
+                rs.round = round_
+                rs.step = STEP_PROPOSE
+                self._new_step()
 
-            if self.priv_validator is None:
-                return
-            if not self.is_proposer():
-                return
-            self.decide_proposal(height, round_)
+                self._schedule_timeout(self.config.propose(round_), height, round_, STEP_PROPOSE)
+
+                if self.priv_validator is None:
+                    return
+                if not self.is_proposer():
+                    return
+                self.decide_proposal(height, round_)
         finally:
             if self._is_proposal_complete():
                 self._enter_prevote(height, round_)
@@ -625,10 +652,11 @@ class ConsensusState:
         ):
             return
         LOG.debug("enterPrevote(%d/%d)", height, round_)
-        rs.round = round_
-        rs.step = STEP_PREVOTE
-        self._new_step()
-        self.do_prevote(height, round_)
+        with self._step_span("enterPrevote", "prevote", height, round_):
+            rs.round = round_
+            rs.step = STEP_PREVOTE
+            self._new_step()
+            self.do_prevote(height, round_)
 
     def _default_do_prevote(self, height: int, round_: int) -> None:
         """reference defaultDoPrevote :977-995"""
@@ -660,10 +688,11 @@ class ConsensusState:
         if prevotes is None or not prevotes.has_two_thirds_any():
             raise RuntimeError("enter_prevote_wait without +2/3 prevotes (any)")
         LOG.debug("enterPrevoteWait(%d/%d)", height, round_)
-        rs.round = round_
-        rs.step = STEP_PREVOTE_WAIT
-        self._new_step()
-        self._schedule_timeout(self.config.prevote(round_), height, round_, STEP_PREVOTE_WAIT)
+        with self._step_span("enterPrevoteWait", "prevote_wait", height, round_):
+            rs.round = round_
+            rs.step = STEP_PREVOTE_WAIT
+            self._new_step()
+            self._schedule_timeout(self.config.prevote(round_), height, round_, STEP_PREVOTE_WAIT)
 
     def _enter_precommit(self, height: int, round_: int) -> None:
         """reference enterPrecommit :1025-1118 — the POL lock/unlock
@@ -674,61 +703,62 @@ class ConsensusState:
         ):
             return
         LOG.debug("enterPrecommit(%d/%d)", height, round_)
-        rs.round = round_
-        rs.step = STEP_PRECOMMIT
-        self._new_step()
+        with self._step_span("enterPrecommit", "precommit", height, round_):
+            rs.round = round_
+            rs.step = STEP_PRECOMMIT
+            self._new_step()
 
-        prevotes = rs.votes.prevotes(round_)
-        block_id = prevotes.two_thirds_majority() if prevotes else None
+            prevotes = rs.votes.prevotes(round_)
+            block_id = prevotes.two_thirds_majority() if prevotes else None
 
-        # no polka: precommit nil (reference :1044-1052)
-        if block_id is None:
+            # no polka: precommit nil (reference :1044-1052)
+            if block_id is None:
+                self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+                return
+
+            self.event_bus.publish_polka(self.get_round_state())
+
+            # polka for nil: unlock if locked (reference :1061-1075)
+            if not block_id.hash:
+                if rs.locked_block is not None:
+                    rs.locked_round = -1
+                    rs.locked_block = None
+                    rs.locked_block_parts = None
+                    self.event_bus.publish_unlock(self.get_round_state())
+                self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
+                return
+
+            # polka for our locked block: re-lock (reference :1078-1086)
+            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                rs.locked_round = round_
+                self.event_bus.publish_relock(self.get_round_state())
+                self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+                return
+
+            # polka for our proposal block: lock it (reference :1089-1103)
+            if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                try:
+                    self.block_exec.validate_block(self.state, rs.proposal_block)
+                except Exception as e:
+                    raise RuntimeError(f"enter_precommit: +2/3 prevoted an invalid block: {e}")
+                rs.locked_round = round_
+                rs.locked_block = rs.proposal_block
+                rs.locked_block_parts = rs.proposal_block_parts
+                self.event_bus.publish_lock(self.get_round_state())
+                self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+                return
+
+            # polka for a block we don't have: unlock, fetch (reference :1106-1116)
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                block_id.parts_header
+            ):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.parts_header)
+            self.event_bus.publish_unlock(self.get_round_state())
             self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
-            return
-
-        self.event_bus.publish_polka(self.get_round_state())
-
-        # polka for nil: unlock if locked (reference :1061-1075)
-        if not block_id.hash:
-            if rs.locked_block is not None:
-                rs.locked_round = -1
-                rs.locked_block = None
-                rs.locked_block_parts = None
-                self.event_bus.publish_unlock(self.get_round_state())
-            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
-            return
-
-        # polka for our locked block: re-lock (reference :1078-1086)
-        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
-            rs.locked_round = round_
-            self.event_bus.publish_relock(self.get_round_state())
-            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
-            return
-
-        # polka for our proposal block: lock it (reference :1089-1103)
-        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
-            try:
-                self.block_exec.validate_block(self.state, rs.proposal_block)
-            except Exception as e:
-                raise RuntimeError(f"enter_precommit: +2/3 prevoted an invalid block: {e}")
-            rs.locked_round = round_
-            rs.locked_block = rs.proposal_block
-            rs.locked_block_parts = rs.proposal_block_parts
-            self.event_bus.publish_lock(self.get_round_state())
-            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
-            return
-
-        # polka for a block we don't have: unlock, fetch (reference :1106-1116)
-        rs.locked_round = -1
-        rs.locked_block = None
-        rs.locked_block_parts = None
-        if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
-            block_id.parts_header
-        ):
-            rs.proposal_block = None
-            rs.proposal_block_parts = PartSet(block_id.parts_header)
-        self.event_bus.publish_unlock(self.get_round_state())
-        self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", None)
 
     def _enter_precommit_wait(self, height: int, round_: int) -> None:
         """reference enterPrecommitWait :1121-1146"""
@@ -741,9 +771,10 @@ class ConsensusState:
         if precommits is None or not precommits.has_two_thirds_any():
             raise RuntimeError("enter_precommit_wait without +2/3 precommits (any)")
         LOG.debug("enterPrecommitWait(%d/%d)", height, round_)
-        rs.triggered_timeout_precommit = True
-        self._new_step()
-        self._schedule_timeout(self.config.precommit(round_), height, round_, STEP_PRECOMMIT_WAIT)
+        with self._step_span("enterPrecommitWait", "precommit_wait", height, round_):
+            rs.triggered_timeout_precommit = True
+            self._new_step()
+            self._schedule_timeout(self.config.precommit(round_), height, round_, STEP_PRECOMMIT_WAIT)
 
     def _enter_commit(self, height: int, commit_round: int) -> None:
         """reference enterCommit :1149-1198"""
@@ -752,29 +783,32 @@ class ConsensusState:
             return
         LOG.debug("enterCommit(%d/%d)", height, commit_round)
         try:
-            rs.step = STEP_COMMIT
-            rs.commit_round = commit_round
-            rs.commit_time = time.time()
+            with self._step_span("enterCommit", "commit", height, commit_round):
+                rs.step = STEP_COMMIT
+                rs.commit_round = commit_round
+                rs.commit_time = time.time()
 
-            block_id = rs.votes.precommits(commit_round).two_thirds_majority()
-            if block_id is None:
-                raise RuntimeError("enter_commit without +2/3 precommit majority")
-            # our locked block IS the committed block (reference :1168-1174)
-            if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
-                rs.proposal_block = rs.locked_block
-                rs.proposal_block_parts = rs.locked_block_parts
-            if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
-                if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
-                    block_id.parts_header
-                ):
-                    # need to fetch the committed block (reference :1180-1190)
-                    rs.proposal_block = None
-                    rs.proposal_block_parts = PartSet(block_id.parts_header)
+                block_id = rs.votes.precommits(commit_round).two_thirds_majority()
+                if block_id is None:
+                    raise RuntimeError("enter_commit without +2/3 precommit majority")
+                # our locked block IS the committed block (reference :1168-1174)
+                if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+                    rs.proposal_block = rs.locked_block
+                    rs.proposal_block_parts = rs.locked_block_parts
+                if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+                    if rs.proposal_block_parts is None or not rs.proposal_block_parts.has_header(
+                        block_id.parts_header
+                    ):
+                        # need to fetch the committed block (reference :1180-1190)
+                        rs.proposal_block = None
+                        rs.proposal_block_parts = PartSet(block_id.parts_header)
         finally:
             # the reference runs newStep in a defer (:1152-1160), i.e.
             # AFTER ProposalBlockParts is set — the step event carries the
             # parts header the reactor's CommitStepMessage advertises; an
-            # event fired before the parts are set would deadlock catch-up
+            # event fired before the parts are set would deadlock catch-up.
+            # Both run OUTSIDE the step span so 'commit' never includes
+            # finalize_commit time (that has its own histogram label).
             self._new_step()
             self._try_finalize_commit(height)
 
@@ -796,45 +830,46 @@ class ConsensusState:
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
-        block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
-        block, block_parts = rs.proposal_block, rs.proposal_block_parts
-        if block is None or block.hash() != block_id.hash:
-            raise RuntimeError("cannot finalize: no proposal block / hash mismatch")
+        with self._step_span("finalizeCommit", "finalize_commit", height, rs.commit_round):
+            block_id = rs.votes.precommits(rs.commit_round).two_thirds_majority()
+            block, block_parts = rs.proposal_block, rs.proposal_block_parts
+            if block is None or block.hash() != block_id.hash:
+                raise RuntimeError("cannot finalize: no proposal block / hash mismatch")
 
-        self.block_exec.validate_block(self.state, block)  # :1243
+            self.block_exec.validate_block(self.state, block)  # :1243
 
-        LOG.info(
-            "finalizing commit of block h=%d hash=%s txs=%d",
-            block.header.height,
-            (block.hash() or b"").hex()[:12],
-            len(block.data.txs),
-        )
-
-        fail.fail_point("FinalizeCommit.BeforeSave")  # :1251
-        if self.block_store.height() < block.header.height:
-            seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
-            self.block_store.save_block(block, block_parts, seen_commit)  # :1254-1259
-        fail.fail_point("FinalizeCommit.AfterSave")  # :1265
-
-        # WAL EndHeight BEFORE ApplyBlock: on crash we replay from here and
-        # the handshake re-applies the block to the app (reference :1271-1285)
-        self.wal.write_end_height(height)
-        fail.fail_point("FinalizeCommit.AfterWAL")  # :1282
-
-        state_copy = self.state.copy()
-        try:
-            state_copy = self.block_exec.apply_block(
-                state_copy, BlockID(block.hash(), block_parts.header()), block
+            LOG.info(
+                "finalizing commit of block h=%d hash=%s txs=%d",
+                block.header.height,
+                (block.hash() or b"").hex()[:12],
+                len(block.data.txs),
             )
-        except Exception:
-            LOG.exception("failed to apply block; exiting consensus")
-            raise
-        fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
 
-        self.n_height_committed += 1
-        self._record_metrics(block, block_parts)
-        self.update_to_state(state_copy)  # :1306
-        self._schedule_round0(self.rs)  # :1312
+            fail.fail_point("FinalizeCommit.BeforeSave")  # :1251
+            if self.block_store.height() < block.header.height:
+                seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+                self.block_store.save_block(block, block_parts, seen_commit)  # :1254-1259
+            fail.fail_point("FinalizeCommit.AfterSave")  # :1265
+
+            # WAL EndHeight BEFORE ApplyBlock: on crash we replay from here and
+            # the handshake re-applies the block to the app (reference :1271-1285)
+            self.wal.write_end_height(height)
+            fail.fail_point("FinalizeCommit.AfterWAL")  # :1282
+
+            state_copy = self.state.copy()
+            try:
+                state_copy = self.block_exec.apply_block(
+                    state_copy, BlockID(block.hash(), block_parts.header()), block
+                )
+            except Exception:
+                LOG.exception("failed to apply block; exiting consensus")
+                raise
+            fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
+
+            self.n_height_committed += 1
+            self._record_metrics(block, block_parts)
+            self.update_to_state(state_copy)  # :1306
+            self._schedule_round0(self.rs)  # :1312
 
     def _record_metrics(self, block, block_parts) -> None:
         """reference consensus/state.go recordMetrics:1320-1350."""

@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass
 from typing import Iterator, Optional, Tuple
 
+from ..libs import tracing
 from ..libs.autofile import Group
 from ..types import serde
 
@@ -71,14 +72,16 @@ class WAL:
 
     def write(self, msg) -> None:
         """Log a message (no fsync; reference Save → Write)."""
-        payload = serde.pack(_msg_obj(msg))
-        self.group.write(_encode_record(payload))
+        with tracing.span("wal.write", cat="wal"):
+            payload = serde.pack(_msg_obj(msg))
+            self.group.write(_encode_record(payload))
 
     def write_sync(self, msg) -> None:
         """Log + fsync — used for self-originated messages and EndHeight
         (reference consensus/state.go:609,1280)."""
-        self.write(msg)
-        self.group.sync()
+        with tracing.span("wal.writeSync", cat="wal"):
+            self.write(msg)
+            self.group.sync()
 
     def flush(self) -> None:
         self.group.flush()

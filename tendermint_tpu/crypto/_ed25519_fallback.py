@@ -1,0 +1,235 @@
+"""Pure-Python Ed25519 (RFC 8032) — import-compatible fallback for the
+`cryptography` package's Ed25519PrivateKey / Ed25519PublicKey.
+
+Used only when OpenSSL bindings are absent from the environment
+(crypto/keys.py gates the import). Orders of magnitude slower than
+OpenSSL (~ms per op) but mathematically identical; bulk verification
+still routes through crypto/batch.py, where the jax backend does the
+heavy lifting. Not constant-time — acceptable for a fallback whose
+alternative is no signatures at all; production deployments install
+`cryptography`.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+import hashlib
+import os
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+
+# base point B (RFC 8032 §5.1)
+_BY = (4 * pow(5, P - 2, P)) % P
+_BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+_B = (_BX, _BY, 1, (_BX * _BY) % P)  # extended coords (X, Y, Z, T)
+_IDENT = (0, 1, 1, 0)
+
+_SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+
+class InvalidSignature(Exception):
+    """Mirror of cryptography.exceptions.InvalidSignature."""
+
+
+def _pt_add(p1, p2):
+    # add-2008-hwcd-3 (complete for a=-1 twisted Edwards)
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2 = p2
+    a = ((y1 - x1) * (y2 - x2)) % P
+    b = ((y1 + x1) * (y2 + x2)) % P
+    c = (2 * t1 * t2 * D) % P
+    dd = (2 * z1 * z2) % P
+    e, f, g, h = b - a, dd - c, dd + c, b + a
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def _pt_dbl(p):
+    # dbl-2008-hwcd (a=-1): 4M+4S, ~2x faster than the unified add
+    x1, y1, z1, _ = p
+    a = (x1 * x1) % P
+    b = (y1 * y1) % P
+    c = (2 * z1 * z1) % P
+    e = ((x1 + y1) * (x1 + y1) - a - b) % P
+    g = (b - a) % P  # D + B with D = -A
+    f = (g - c) % P
+    h = (-a - b) % P  # D - B
+    return ((e * f) % P, (g * h) % P, (f * g) % P, (e * h) % P)
+
+
+def _pt_mul(s, pt):
+    q = _IDENT
+    while s > 0:
+        if s & 1:
+            q = _pt_add(q, pt)
+        pt = _pt_dbl(pt)
+        s >>= 1
+    return q
+
+
+# Window tables — table[i][j] = j * 16**i * P — turn a 256-bit scalar
+# mult into a ≤64-add lookup sum with no doublings. Built lazily (≈1k
+# adds, ~10 ms) for the base point on first sign/verify, and per
+# public key under an LRU: consensus verifies hundreds of votes from
+# the same handful of validator keys, so the build amortizes fast.
+
+
+def _build_table(pt):
+    table, base = [], pt
+    for _ in range(64):
+        row, acc = [_IDENT], _IDENT
+        for _ in range(15):
+            acc = _pt_add(acc, base)
+            row.append(acc)
+        table.append(row)
+        base = _pt_add(acc, base)  # 16**(i+1) * pt
+    return table
+
+
+def _table_mul(table, s):
+    q = _IDENT
+    i = 0
+    while s > 0:
+        nib = s & 15
+        if nib:
+            q = _pt_add(q, table[i][nib])
+        s >>= 4
+        i += 1
+    return q
+
+
+_B_TABLE = None
+
+
+def _fixed_base_mul(s):
+    global _B_TABLE
+    if _B_TABLE is None:
+        _B_TABLE = _build_table(_B)
+    return _table_mul(_B_TABLE, s)
+
+
+@_functools.lru_cache(maxsize=64)
+def _pub_key_table(pub_bytes):
+    """Window table for a public key, or None if it fails to decompress.
+    maxsize bounds worst-case memory at a few MB; any real validator set
+    fits with room to spare."""
+    a = _decompress(pub_bytes)
+    return None if a is None else _build_table(a)
+
+
+def _pt_equal(p1, p2):
+    x1, y1, z1, _ = p1
+    x2, y2, z2, _ = p2
+    return (x1 * z2 - x2 * z1) % P == 0 and (y1 * z2 - y2 * z1) % P == 0
+
+
+def _compress(pt) -> bytes:
+    x, y, z, _ = pt
+    zinv = pow(z, P - 2, P)
+    x, y = (x * zinv) % P, (y * zinv) % P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def _decompress(data: bytes):
+    if len(data) != 32:
+        return None
+    val = int.from_bytes(data, "little")
+    y = val & ((1 << 255) - 1)
+    sign = val >> 255
+    if y >= P:
+        return None
+    y2 = (y * y) % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    # sqrt(u/v) per RFC 8032 §5.1.3
+    x = (u * v**3 * pow(u * v**7, (P - 5) // 8, P)) % P
+    vxx = (v * x * x) % P
+    if vxx == u % P:
+        pass
+    elif vxx == (-u) % P:
+        x = (x * _SQRT_M1) % P
+    else:
+        return None
+    if x == 0 and sign:
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return (x, y, 1, (x * y) % P)
+
+
+def _sha512_mod_l(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little") % L
+
+
+def _clamp(h32: bytes) -> int:
+    a = int.from_bytes(h32, "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+class Ed25519PublicKey:
+    def __init__(self, data: bytes):
+        self._data = bytes(data)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "Ed25519PublicKey":
+        if len(data) != 32:
+            raise ValueError("ed25519 public key must be 32 bytes")
+        return cls(data)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._data
+
+    def verify(self, signature: bytes, data: bytes) -> None:
+        if len(signature) != 64:
+            raise InvalidSignature("bad signature length")
+        a_table = _pub_key_table(self._data)
+        if a_table is None:
+            raise InvalidSignature("malformed public key")
+        r = _decompress(signature[:32])
+        if r is None:
+            raise InvalidSignature("malformed R point")
+        s = int.from_bytes(signature[32:], "little")
+        if s >= L:
+            raise InvalidSignature("non-canonical S")
+        k = _sha512_mod_l(signature[:32], self._data, data)
+        if not _pt_equal(_fixed_base_mul(s),
+                         _pt_add(r, _table_mul(a_table, k))):
+            raise InvalidSignature("signature mismatch")
+
+
+class Ed25519PrivateKey:
+    def __init__(self, seed: bytes):
+        self._seed = bytes(seed)
+        h = hashlib.sha512(self._seed).digest()
+        self._a = _clamp(h[:32])
+        self._prefix = h[32:]
+        self._pub = _compress(_fixed_base_mul(self._a))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "Ed25519PrivateKey":
+        if len(data) != 32:
+            raise ValueError("ed25519 private key must be 32 bytes")
+        return cls(data)
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivateKey":
+        return cls(os.urandom(32))
+
+    def private_bytes_raw(self) -> bytes:
+        return self._seed
+
+    def public_key(self) -> Ed25519PublicKey:
+        return Ed25519PublicKey(self._pub)
+
+    def sign(self, data: bytes) -> bytes:
+        r = _sha512_mod_l(self._prefix, data)
+        r_enc = _compress(_fixed_base_mul(r))
+        k = _sha512_mod_l(r_enc, self._pub, data)
+        s = (r + k * self._a) % L
+        return r_enc + int.to_bytes(s, 32, "little")

@@ -1,0 +1,133 @@
+"""Pure-Python secp256k1 ECDSA (SEC 2 curve, RFC 6979 nonces) —
+fallback backend for crypto/secp256k1.py when the `cryptography`
+package's OpenSSL bindings are absent, the same arrangement as
+_ed25519_fallback.py / _aead_fallback.py.
+
+Deterministic RFC 6979 signing (OpenSSL's random-k path isn't
+reproducible anyway, and a misbehaving RNG here would leak the key).
+Affine double-and-add, ~10 ms per scalar mult — secp256k1 keys are an
+account-key convenience in this codebase, never the consensus hot path.
+Not constant-time; production deployments install `cryptography`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Optional, Tuple
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+_G = (_GX, _GY)
+
+_Point = Optional[Tuple[int, int]]  # None is the point at infinity
+
+
+def _pt_add(p1: _Point, p2: _Point) -> _Point:
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None
+        m = (3 * x1 * x1) * pow(2 * y1, P - 2, P) % P
+    else:
+        m = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (m * m - x1 - x2) % P
+    return (x3, (m * (x1 - x3) - y1) % P)
+
+
+def _pt_mul(k: int, pt: _Point) -> _Point:
+    acc: _Point = None
+    while k > 0:
+        if k & 1:
+            acc = _pt_add(acc, pt)
+        pt = _pt_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def _compress(pt: Tuple[int, int]) -> bytes:
+    x, y = pt
+    return bytes([2 | (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decompress(data: bytes) -> _Point:
+    if len(data) != 33 or data[0] not in (2, 3):
+        return None
+    x = int.from_bytes(data[1:], "big")
+    if x >= P:
+        return None
+    y2 = (x * x * x + 7) % P
+    y = pow(y2, (P + 1) // 4, P)  # P ≡ 3 (mod 4)
+    if (y * y) % P != y2:
+        return None  # x is not on the curve
+    if y & 1 != data[0] & 1:
+        y = P - y
+    return (x, y)
+
+
+def _rfc6979_k(d: int, e: int):
+    """RFC 6979 §3.2 deterministic nonce stream, HMAC-SHA256,
+    qlen = 256. Yields candidate nonces; the caller pulls another on a
+    vanishing r or s (§3.2 step h.3)."""
+    h1 = e.to_bytes(32, "big")
+    x = d.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < N:
+            yield cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def gen_scalar() -> int:
+    while True:
+        d = int.from_bytes(os.urandom(32), "big")
+        if 1 <= d < N:
+            return d
+
+
+def pub_from_scalar(d: int) -> bytes:
+    """33-byte compressed SEC1 public key for the scalar d."""
+    return _compress(_pt_mul(d, _G))
+
+
+def ecdsa_sign(d: int, msg: bytes) -> Tuple[int, int]:
+    """SHA256-ECDSA, RFC 6979 nonce. Returns raw (r, s) — the caller
+    applies low-s normalization (matching the OpenSSL path's shape)."""
+    e = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    for k in _rfc6979_k(d, e):
+        pt = _pt_mul(k, _G)
+        r = pt[0] % N
+        if r == 0:
+            continue
+        s = pow(k, N - 2, N) * (e + r * d) % N
+        if s == 0:
+            continue
+        return r, s
+
+
+def ecdsa_verify(pub33: bytes, msg: bytes, r: int, s: int) -> bool:
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    q = _decompress(pub33)
+    if q is None:
+        return False
+    e = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    s_inv = pow(s, N - 2, N)
+    pt = _pt_add(_pt_mul(e * s_inv % N, _G), _pt_mul(r * s_inv % N, q))
+    return pt is not None and pt[0] % N == r

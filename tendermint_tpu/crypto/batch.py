@@ -25,13 +25,51 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, List, Sequence, Tuple
+
+from ..libs import tracing
 
 Triple = Tuple[bytes, bytes, bytes]  # (message, signature, pubkey)
 
+# Process-wide CryptoMetrics sink (tendermint_tpu.metrics.CryptoMetrics).
+# None (the default) costs one load+is-check per verify() call; a Node
+# with instrumentation on wires its live metric set here so EVERY call
+# site — VoteSet, ValidatorSet.verify_commit, fast-sync, lite client —
+# is measured without plumbing a metrics object through each of them.
+_metrics = None
+_metrics_lock = threading.Lock()
+
+
+def set_metrics(metrics) -> None:
+    """Install (or, with None, remove) the process-wide CryptoMetrics."""
+    global _metrics
+    with _metrics_lock:
+        _metrics = metrics
+
+
+def get_metrics():
+    return _metrics
+
+
+def record_device_split(transfer_s: float, compute_s: float) -> None:
+    """Called by the jax backend with the last batch's host->device
+    pack+transfer time vs on-device compute/wait time."""
+    m = _metrics
+    if m is not None:
+        m.device_transfer_seconds.set(transfer_s)
+        m.device_compute_seconds.set(compute_s)
+
 
 class BatchVerifier:
-    """Accumulate (msg, sig, pubkey) triples, then verify all at once."""
+    """Accumulate (msg, sig, pubkey) triples, then verify all at once.
+
+    Backends implement _verify(); the public verify() wraps it with
+    latency/batch-size/validity telemetry (no-op until set_metrics) and
+    a tracing span. Subclasses may still override verify() wholesale
+    (test fakes do) — they just opt out of the built-in telemetry."""
+
+    BACKEND = "unknown"
 
     def __init__(self):
         self._items: List[Triple] = []
@@ -42,9 +80,30 @@ class BatchVerifier:
     def __len__(self) -> int:
         return len(self._items)
 
+    def _verify(self) -> List[bool]:
+        raise NotImplementedError
+
     def verify(self) -> List[bool]:
         """Returns one validity flag per added triple, in add order."""
-        raise NotImplementedError
+        m = _metrics
+        tracer = tracing.get_tracer()
+        if m is None and not tracer.enabled:
+            return self._verify()
+        n = len(self._items)
+        with tracer.span("crypto.batchVerify", cat="crypto",
+                         backend=self.BACKEND, n=n):
+            t0 = time.perf_counter()
+            mask = self._verify()
+            dt = time.perf_counter() - t0
+        if m is not None:
+            m.batch_verify_seconds.with_labels(self.BACKEND).observe(dt)
+            m.batch_size.observe(n)
+            ok = sum(1 for b in mask if b)
+            if ok:
+                m.signatures_verified.inc(ok)
+            if n - ok:
+                m.signatures_invalid.inc(n - ok)
+        return mask
 
     def verify_all(self) -> bool:
         return all(self.verify())
@@ -53,7 +112,9 @@ class BatchVerifier:
 class CPUBatchVerifier(BatchVerifier):
     """Serial per-signature verification — the reference semantics."""
 
-    def verify(self) -> List[bool]:
+    BACKEND = "cpu"
+
+    def _verify(self) -> List[bool]:
         from .keys import PubKeyEd25519
 
         out = []
@@ -73,6 +134,8 @@ class AdaptiveBatchVerifier(BatchVerifier):
     crossover point between per-sig CPU cost (~100µs) and device
     dispatch overhead; tune with TM_TPU_BATCH_MIN."""
 
+    BACKEND = "adaptive"
+
     def __init__(self, device_factory: Callable[[], BatchVerifier],
                  min_device_batch: int | None = None):
         super().__init__()
@@ -82,10 +145,16 @@ class AdaptiveBatchVerifier(BatchVerifier):
         self._min = min_device_batch
 
     def verify(self) -> List[bool]:
-        if len(self._items) >= self._min:
-            inner = self._device_factory()
-        else:
-            inner = CPUBatchVerifier()
+        # overrides verify() (not _verify) on purpose: the inner
+        # verifier's own verify() records the latency/size telemetry
+        # under its leaf backend label — a template here would double
+        # count every batch. Adaptive only adds the routing decision.
+        use_device = len(self._items) >= self._min
+        m = _metrics
+        if m is not None:
+            m.routing_decisions.with_labels(
+                "device" if use_device else "cpu").inc()
+        inner = self._device_factory() if use_device else CPUBatchVerifier()
         for msg, sig, pk in self._items:
             inner.add(msg, sig, pk)
         return inner.verify()

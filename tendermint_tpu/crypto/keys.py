@@ -13,11 +13,18 @@ from __future__ import annotations
 import hmac
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+except ImportError:  # no OpenSSL bindings: pure-Python RFC 8032 fallback
+    from ._ed25519_fallback import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        InvalidSignature,
+    )
 
 from . import tmhash
 

@@ -13,13 +13,21 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    _FALLBACK = None
+except ImportError:  # no OpenSSL bindings: pure-Python ECDSA fallback
+    from . import _secp256k1_fallback as _FALLBACK
+
+    class InvalidSignature(Exception):  # keeps except-clauses importable
+        pass
 
 from .keys import PrivKey, PubKey
 
@@ -63,6 +71,8 @@ class PubKeySecp256k1(PubKey):
             # parses into canonical form "to prevent Secp256k1 malleability"
             # (secp256k1.go:140-152)
             return False
+        if _FALLBACK is not None:
+            return _FALLBACK.ecdsa_verify(self.data, msg, r, s)
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self.data)
@@ -87,6 +97,8 @@ class PrivKeySecp256k1(PrivKey):
 
     @classmethod
     def generate(cls) -> "PrivKeySecp256k1":
+        if _FALLBACK is not None:
+            return cls(_FALLBACK.gen_scalar().to_bytes(32, "big"))
         key = ec.generate_private_key(ec.SECP256K1())
         d = key.private_numbers().private_value
         return cls(d.to_bytes(32, "big"))
@@ -107,13 +119,20 @@ class PrivKeySecp256k1(PrivKey):
             int.from_bytes(self.data, "big"), ec.SECP256K1())
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
-        r, s = decode_dss_signature(der)
+        if _FALLBACK is not None:
+            r, s = _FALLBACK.ecdsa_sign(
+                int.from_bytes(self.data, "big"), msg)
+        else:
+            der = self._key().sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
         if s > _N // 2:  # low-s, like btcec
             s = _N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> PubKeySecp256k1:
+        if _FALLBACK is not None:
+            return PubKeySecp256k1(
+                _FALLBACK.pub_from_scalar(int.from_bytes(self.data, "big")))
         pub = self._key().public_key()
         from cryptography.hazmat.primitives.serialization import (
             Encoding,

@@ -16,8 +16,11 @@ from __future__ import annotations
 import hashlib
 import os
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # no OpenSSL bindings: pure-Python RFC 8439 fallback
+    from ._aead_fallback import ChaCha20Poly1305, InvalidTag
 
 NONCE_SIZE = 12
 KEY_SIZE = 32

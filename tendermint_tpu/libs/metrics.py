@@ -71,7 +71,13 @@ class Counter(_Metric):
             items = sorted(self._vals.items())
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} {self.TYPE}"]
-        for labels, v in items or [((), 0.0)]:
+        if not items and not self.label_names:
+            # a label-less metric legitimately exposes 0 before first use;
+            # a labeled one with no children must render NO samples — a
+            # bare `name 0` line under a labeled family is invalid
+            # exposition (and Prometheus would ingest a phantom series)
+            items = [((), 0.0)]
+        for labels, v in items:
             out.append(
                 f"{self.name}{_fmt_labels(self.label_names, labels)} {v:g}")
         return out
@@ -103,7 +109,9 @@ class Gauge(_Metric):
             items = sorted(self._vals.items())
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} {self.TYPE}"]
-        for labels, v in items or [((), 0.0)]:
+        if not items and not self.label_names:
+            items = [((), 0.0)]  # see Counter.render
+        for labels, v in items:
             out.append(
                 f"{self.name}{_fmt_labels(self.label_names, labels)} {v:g}")
         return out

@@ -1,0 +1,201 @@
+"""Span tracer — hot-path timeline visibility (chrome://tracing).
+
+The reference has no tracing subsystem; PROFILE.md's round-4 findings
+(h2d transfer vs device compute vs dispatch latency) had to be
+reverse-engineered with one-off scripts. This module gives the
+consensus step machine, the WAL, block execution, and the crypto
+batch-verify engine always-available spans:
+
+- Ring-buffered: a bounded deque of finished spans; steady-state
+  tracing never grows memory, the newest `capacity` spans win.
+- Thread-safe: appends, snapshot, clear and enable (which may swap the
+  buffer for a capacity change) all share one uncontended lock.
+- Near-zero overhead when disabled: `span()` returns one shared no-op
+  context manager — no allocation, no clock read, no lock.
+
+Export is Chrome trace event format ("X" complete events, µs units),
+loadable in chrome://tracing or https://ui.perfetto.dev, served from
+the ProfServer's /debug/trace route (rpc/prof.py).
+
+Like logging, there is one process-global default tracer
+(`get_tracer()`), disabled until `node.Node` enables it from
+config.instrumentation.tracing — call sites never branch.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 65536
+
+
+@dataclass(frozen=True)
+class SpanRecord:
+    """One finished span. Times from time.perf_counter_ns (monotonic)."""
+
+    name: str
+    cat: str
+    start_ns: int
+    dur_ns: int
+    thread_id: int
+    thread_name: str
+    args: Optional[Dict] = None
+
+    @property
+    def end_ns(self) -> int:
+        return self.start_ns + self.dur_ns
+
+
+class _NopSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOP_SPAN = _NopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_start_ns")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self):
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end = time.perf_counter_ns()
+        t = threading.current_thread()
+        rec = SpanRecord(
+            name=self._name,
+            cat=self._cat,
+            start_ns=self._start_ns,
+            dur_ns=end - self._start_ns,
+            thread_id=t.ident or 0,
+            thread_name=t.name,
+            args=self._args or None,
+        )
+        tracer = self._tracer
+        # under the lock so an enable(capacity) buffer swap can't strand
+        # this record in the discarded deque
+        with tracer._lock:
+            tracer._buf.append(rec)
+        return False
+
+
+class Tracer:
+    """Ring-buffered span recorder; one per process is the norm."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = False):
+        self._lock = threading.Lock()
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._enabled = enabled
+        # epoch pins perf_counter to the wall clock once, so exported
+        # timestamps are comparable across processes' traces
+        self._epoch_wall_us = time.time() * 1e6
+        self._epoch_perf_ns = time.perf_counter_ns()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen or 0
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._buf.maxlen:
+                self._buf = collections.deque(self._buf, maxlen=capacity)
+            self._enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self._enabled = False
+
+    def span(self, name: str, cat: str = "", **args):
+        """Context manager timing one operation. Keyword args become the
+        chrome-trace event's `args` payload (keep them cheap: scalars)."""
+        if not self._enabled:
+            return _NOP_SPAN
+        return _Span(self, name, cat, args)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def events(self) -> List[SpanRecord]:
+        """Snapshot of recorded spans, oldest first."""
+        with self._lock:
+            return list(self._buf)
+
+    # --- export -------------------------------------------------------------
+
+    def _ts_us(self, t_ns: int) -> float:
+        return self._epoch_wall_us + (t_ns - self._epoch_perf_ns) / 1e3
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace event format: {"traceEvents": [...]} with "X"
+        (complete) events plus thread-name metadata, ts/dur in µs."""
+        pid = os.getpid()
+        events = []
+        seen_threads: Dict[int, str] = {}
+        for rec in self.events():
+            if rec.thread_id not in seen_threads:
+                seen_threads[rec.thread_id] = rec.thread_name
+            ev = {
+                "name": rec.name,
+                "cat": rec.cat or "default",
+                "ph": "X",
+                "ts": self._ts_us(rec.start_ns),
+                "dur": rec.dur_ns / 1e3,
+                "pid": pid,
+                "tid": rec.thread_id,
+            }
+            if rec.args:
+                ev["args"] = rec.args
+            events.append(ev)
+        meta = [
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": tname},
+            }
+            for tid, tname in seen_threads.items()
+        ]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def chrome_trace_json(self) -> str:
+        return json.dumps(self.chrome_trace(), separators=(",", ":"))
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (disabled until a Node enables it)."""
+    return _GLOBAL
+
+
+def span(name: str, cat: str = "", **args):
+    """Convenience: a span on the global tracer."""
+    return _GLOBAL.span(name, cat, **args)

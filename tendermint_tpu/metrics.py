@@ -29,7 +29,8 @@ NOP = _Nop()
 
 @dataclass
 class ConsensusMetrics:
-    """consensus/metrics.go:12-57"""
+    """consensus/metrics.go:12-57 (+ step_duration, ours: wall time of
+    each step-machine transition, labeled step=new_round|propose|...)"""
 
     height: object = NOP
     rounds: object = NOP
@@ -42,6 +43,26 @@ class ConsensusMetrics:
     block_size_bytes: object = NOP
     total_txs: object = NOP
     committed_height: object = NOP
+    step_duration: object = NOP
+
+
+@dataclass
+class CryptoMetrics:
+    """Batch-verify engine telemetry (crypto/batch.py — the north-star
+    hot path; no reference equivalent). Every BatchVerifier.verify()
+    call reports here once batch.set_metrics() is wired."""
+
+    # wall time of one verify() call, labeled by the backend that ran it
+    batch_verify_seconds: object = NOP
+    # signatures per verify() call
+    batch_size: object = NOP
+    signatures_verified: object = NOP
+    signatures_invalid: object = NOP
+    # adaptive router choices, labeled route=cpu|device
+    routing_decisions: object = NOP
+    # last jax call's host->device transfer vs on-device compute split
+    device_transfer_seconds: object = NOP
+    device_compute_seconds: object = NOP
 
 
 @dataclass
@@ -76,6 +97,7 @@ class NodeMetrics:
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
     mempool: MempoolMetrics = field(default_factory=MempoolMetrics)
     state: StateMetrics = field(default_factory=StateMetrics)
+    crypto: CryptoMetrics = field(default_factory=CryptoMetrics)
     registry: Optional[Registry] = None
 
 
@@ -115,6 +137,12 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
                           "Total transactions committed."),
         committed_height=r.gauge(f"{ns}_consensus_latest_block_height",
                                  "Latest committed block height."),
+        step_duration=r.histogram(
+            f"{ns}_consensus_step_duration_seconds",
+            "Wall time of each consensus step transition.",
+            ("step",),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1, 5)),
     )
     p2p = P2PMetrics(
         peers=r.gauge(f"{ns}_p2p_peers", "Number of connected peers."),
@@ -142,5 +170,33 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Time spent processing a block (s).",
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)),
     )
+    crypto = CryptoMetrics(
+        batch_verify_seconds=r.histogram(
+            f"{ns}_crypto_batch_verify_seconds",
+            "Wall time of one batch-verify call, by backend.",
+            ("backend",),
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 1)),
+        batch_size=r.histogram(
+            f"{ns}_crypto_batch_size",
+            "Signatures per batch-verify call.",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                     4096)),
+        signatures_verified=r.counter(
+            f"{ns}_crypto_signatures_verified_total",
+            "Signatures that verified valid."),
+        signatures_invalid=r.counter(
+            f"{ns}_crypto_signatures_invalid_total",
+            "Signatures that failed verification."),
+        routing_decisions=r.counter(
+            f"{ns}_crypto_batch_routing_total",
+            "Adaptive batch-verify routing decisions.", ("route",)),
+        device_transfer_seconds=r.gauge(
+            f"{ns}_crypto_device_transfer_seconds",
+            "Host->device pack+transfer time of the last jax batch."),
+        device_compute_seconds=r.gauge(
+            f"{ns}_crypto_device_compute_seconds",
+            "On-device compute/wait time of the last jax batch."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, mempool=mem, state=state,
-                       registry=r)
+                       crypto=crypto, registry=r)

@@ -117,6 +117,25 @@ class Node:
             self.metrics = nop_metrics()
         self._metrics_server = None
 
+        # observability plumbing (ours; the reference's MetricsProvider
+        # stops at per-reactor metrics): the crypto BatchVerifier sink
+        # is process-global so every call site — VoteSet, verify_commit,
+        # fast-sync, lite — reports without threading a metrics object
+        # through each, and the span tracer feeds /debug/trace on the
+        # prof server. Both are unwired/disabled again in stop().
+        from ..crypto import batch as crypto_batch
+        from ..libs import tracing
+
+        if config.instrumentation.prometheus:
+            crypto_batch.set_metrics(self.metrics.crypto)
+        self._enabled_tracing = False
+        if config.instrumentation.tracing:
+            tracer = tracing.get_tracer()
+            # the first enabler owns the global tracer; a node that finds
+            # it already on leaves it alone in stop() too
+            self._enabled_tracing = not tracer.enabled
+            tracer.enable(config.instrumentation.tracing_buffer_size)
+
         # --- storage (node/node.go:162-171) --------------------------
         self.block_store_db = db_provider("blockstore", backend, db_dir)
         self.state_db = db_provider("state", backend, db_dir)
@@ -429,6 +448,19 @@ class Node:
                     self._metrics_server):
             if srv is not None:
                 srv.stop()
+        # unwire the process-global observability hooks this node set up
+        # so back-to-back nodes (tests) don't report into a dead registry.
+        # Only if the installed sink is still OURS — a second instrumented
+        # node in the same process may have re-wired them since.
+        if self.config.instrumentation.prometheus:
+            from ..crypto import batch as crypto_batch
+
+            if crypto_batch.get_metrics() is self.metrics.crypto:
+                crypto_batch.set_metrics(None)
+        if self._enabled_tracing:
+            from ..libs import tracing
+
+            tracing.get_tracer().disable()
         self.sw.stop()
         if self.addr_book is not None:
             self.addr_book.save()

@@ -19,13 +19,23 @@ import struct
 from typing import Optional
 
 import msgpack
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # no OpenSSL bindings: pure-Python RFC 7748/8439 fallback
+    from ...crypto._aead_fallback import (
+        HKDF,
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+    )
 
 from ...crypto.keys import PrivKey, PubKey, pubkey_from_bytes, pubkey_to_bytes
 

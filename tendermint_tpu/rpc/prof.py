@@ -6,6 +6,8 @@ Serves:
 - /debug/pprof/goroutine   all thread stacks (goroutine-dump analogue)
 - /debug/pprof/heap        tracemalloc snapshot (top allocations)
 - /debug/pprof/profile?seconds=N  statistical CPU profile via cProfile
+- /debug/trace[?clear=1]   chrome://tracing JSON of the span ring buffer
+                           (libs/tracing.py; no reference equivalent)
 """
 
 from __future__ import annotations
@@ -20,11 +22,16 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qsl, urlparse
 
+from ..libs import tracing
+
 
 class ProfServer:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 tracer: Optional[tracing.Tracer] = None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
+        # the handler reaches the tracer through the server instance
+        self._httpd.tracer = tracer if tracer is not None else tracing.get_tracer()
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -65,6 +72,12 @@ def _heap_dump() -> str:
     return "\n".join(str(s) for s in stats)
 
 
+# cProfile hooks the process-global interpreter profile slot: two
+# overlapping Profile.enable() calls corrupt each other's state (and the
+# second enable() raises on some versions). One profile at a time.
+_profile_lock = threading.Lock()
+
+
 def _cpu_profile(seconds: float) -> str:
     prof = cProfile.Profile()
     prof.enable()
@@ -81,10 +94,11 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         pass
 
-    def _text(self, body: str, status: int = 200) -> None:
+    def _text(self, body: str, status: int = 200,
+              content_type: str = "text/plain; charset=utf-8") -> None:
         raw = body.encode()
         self.send_response(status)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
         self.wfile.write(raw)
@@ -93,7 +107,7 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         path = parsed.path.rstrip("/")
         if path in ("", "/debug/pprof"):
-            self._text("profiles: goroutine heap profile\n")
+            self._text("profiles: goroutine heap profile trace\n")
         elif path == "/debug/pprof/goroutine":
             self._text(_thread_dump())
         elif path == "/debug/pprof/heap":
@@ -101,6 +115,19 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/pprof/profile":
             q = dict(parse_qsl(parsed.query))
             secs = min(float(q.get("seconds", 5)), 60.0)
-            self._text(_cpu_profile(secs))
+            if not _profile_lock.acquire(blocking=False):
+                self._text("a CPU profile is already running\n", status=429)
+                return
+            try:
+                body = _cpu_profile(secs)
+            finally:
+                _profile_lock.release()
+            self._text(body)
+        elif path == "/debug/trace":
+            tracer: tracing.Tracer = self.server.tracer
+            body = tracer.chrome_trace_json()
+            if dict(parse_qsl(parsed.query)).get("clear"):
+                tracer.clear()
+            self._text(body, content_type="application/json")
         else:
             self._text("not found", status=404)

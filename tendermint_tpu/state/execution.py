@@ -146,7 +146,18 @@ class BlockExecutor:
         fire events. Returns the new State (reference execution.go:89-152)."""
         import time as _time
 
+        from ..libs import tracing
+
         _t0 = _time.monotonic()
+        with tracing.span("state.applyBlock", cat="state",
+                          height=block.header.height,
+                          txs=len(block.data.txs)):
+            return self._apply_block_inner(state, block_id, block, _t0)
+
+    def _apply_block_inner(self, state: State, block_id: BlockID,
+                           block: Block, _t0: float) -> State:
+        import time as _time
+
         self.validate_block(state, block)
 
         abci_responses = self.exec_block_on_proxy_app(state, block)

@@ -14,6 +14,7 @@ curses-free CLI printer.
 from __future__ import annotations
 
 import argparse
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -25,14 +26,33 @@ from ..rpc.client import HTTPClient, ReconnectingWSClient
 class EventMeter:
     """Per-event-type rate + latency meter (eventmeter.go:81): counts,
     a 1-minute EWMA of events/sec, and an EWMA of the supplied latency
-    samples. Thread-safe for one writer."""
+    samples. Thread-safe for one writer.
+
+    The EWMA only advances on mark(), so a stalled source would report
+    its last rate forever; rate_1m therefore decays on READ once the
+    silence outlasts the interval the rate itself implies (tau = 60s,
+    matching the meter's 1-minute horizon). A node that stops producing
+    blocks drifts to ~0 within a few minutes instead of lying."""
+
+    DECAY_TAU_S = 60.0
 
     def __init__(self, alpha: float = 0.2):
         self.count = 0
-        self.rate_1m = 0.0  # events/sec, EWMA
+        self._rate = 0.0  # events/sec, EWMA (updated on mark)
         self.latency_ms = 0.0  # EWMA of observed latencies
         self._alpha = alpha
         self._last_t: Optional[float] = None
+
+    @property
+    def rate_1m(self) -> float:
+        if self._last_t is None or self._rate <= 0.0:
+            return 0.0
+        silence = time.time() - self._last_t
+        # no decay while we're still inside the expected inter-event gap
+        overdue = silence - 1.0 / self._rate
+        if overdue <= 0.0:
+            return self._rate
+        return self._rate * math.exp(-overdue / self.DECAY_TAU_S)
 
     def mark(self, latency_ms: Optional[float] = None) -> None:
         now = time.time()
@@ -40,7 +60,7 @@ class EventMeter:
         if self._last_t is not None:
             dt = max(now - self._last_t, 1e-6)
             inst = 1.0 / dt
-            self.rate_1m += self._alpha * (inst - self.rate_1m)
+            self._rate += self._alpha * (inst - self._rate)
         self._last_t = now
         if latency_ms is not None:
             if self.latency_ms == 0.0:

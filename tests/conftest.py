@@ -9,7 +9,19 @@ jax_platforms at registration), so env vars are NOT enough — we override
 the jax config itself before any backend initialization.
 """
 
+import os
+
+# older jax (< 0.5) has no jax_num_cpu_devices config option; the
+# XLA_FLAGS knob predates it and must be set before backend init
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # XLA_FLAGS above already provides the 8 virtual devices

@@ -1,0 +1,184 @@
+"""Pure-Python crypto fallbacks (_ed25519_fallback, _aead_fallback)
+against the official RFC test vectors.
+
+These modules only run when the `cryptography` package is absent, so
+CI environments WITH OpenSSL would otherwise never execute them; the
+tests import the fallbacks directly to pin them to RFC 8032 / 7748 /
+5869 / 8439 regardless of which implementation the rest of the node
+picked up.
+"""
+
+import pytest
+
+from tendermint_tpu.crypto import _aead_fallback as aead
+from tendermint_tpu.crypto import _ed25519_fallback as ed
+from tendermint_tpu.crypto import _secp256k1_fallback as secp
+
+
+# -- Ed25519 (RFC 8032 §7.1) ----------------------------------------------
+
+
+def test_ed25519_rfc8032_vector_2():
+    seed = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb")
+    pub = bytes.fromhex(
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c")
+    msg = bytes.fromhex("72")
+    sig = bytes.fromhex(
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00")
+    sk = ed.Ed25519PrivateKey.from_private_bytes(seed)
+    assert sk.public_key().public_bytes_raw() == pub
+    assert sk.sign(msg) == sig
+    ed.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+    with pytest.raises(ed.InvalidSignature):
+        ed.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg + b"x")
+
+
+# -- secp256k1 ECDSA (RFC 6979 test vectors from the bitcoin ecosystem) ---
+
+
+def test_secp256k1_rfc6979_known_vectors():
+    # pubkey of d = 1 is the compressed generator point
+    assert secp.pub_from_scalar(1).hex() == (
+        "0279be667ef9dcbbac55a06295ce870b07029bfcdb2dce28d959f2815b16f81798")
+    # d = 1, "Satoshi Nakamoto": the widely-published RFC 6979 vector
+    # (k = 0x8f8a276c...d15); published s is the low-s normalization
+    r, s = secp.ecdsa_sign(1, b"Satoshi Nakamoto")
+    assert r == 0x934B1EA10A4B3C1757E2B0C017D0B6143CE3C9A7E6A4A49860D7A6AB210EE3D8
+    low_s = s if s <= secp.N // 2 else secp.N - s
+    assert low_s == 0x2442CE9D2B916064108014783E923EC36B49743E2FFA1C4496F01A512AAFD9E5
+    assert secp.ecdsa_verify(secp.pub_from_scalar(1),
+                             b"Satoshi Nakamoto", r, low_s)
+    assert not secp.ecdsa_verify(secp.pub_from_scalar(1),
+                                 b"satoshi nakamoto", r, low_s)
+
+
+# -- X25519 (RFC 7748 §5.2 / §6.1) ----------------------------------------
+
+
+def test_x25519_rfc7748_scalarmult_vector():
+    k = bytes.fromhex(
+        "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4")
+    u = bytes.fromhex(
+        "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c")
+    assert aead._x25519(k, u) == bytes.fromhex(
+        "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552")
+
+
+def test_x25519_rfc7748_diffie_hellman():
+    ka = aead.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+        "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"))
+    kb = aead.X25519PrivateKey.from_private_bytes(bytes.fromhex(
+        "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"))
+    assert ka.public_key().public_bytes_raw() == bytes.fromhex(
+        "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a")
+    assert kb.public_key().public_bytes_raw() == bytes.fromhex(
+        "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f")
+    shared = bytes.fromhex(
+        "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742")
+    assert ka.exchange(kb.public_key()) == shared
+    assert kb.exchange(ka.public_key()) == shared
+
+
+# -- HKDF-SHA256 (RFC 5869 A.1) -------------------------------------------
+
+
+def test_hkdf_rfc5869_case_1():
+    okm = aead.HKDF(
+        algorithm=aead.hashes.SHA256(), length=42,
+        salt=bytes.fromhex("000102030405060708090a0b0c"),
+        info=bytes.fromhex("f0f1f2f3f4f5f6f7f8f9"),
+    ).derive(bytes.fromhex("0b" * 22))
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865")
+
+
+# -- ChaCha20-Poly1305 (RFC 8439 §2.8.2) ----------------------------------
+
+_KEY = bytes(range(0x80, 0xA0))
+_NONCE = bytes.fromhex("070000004041424344454647")
+_AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+_PT = (b"Ladies and Gentlemen of the class of '99: If I could offer you "
+       b"only one tip for the future, sunscreen would be it.")
+_CT = bytes.fromhex(
+    "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+    "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+    "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+    "3ff4def08e4b7a9de576d26586cec64b6116")
+_TAG = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+
+
+def test_chacha20poly1305_rfc8439_aead_vector():
+    c = aead.ChaCha20Poly1305(_KEY)
+    assert c.encrypt(_NONCE, _PT, _AAD) == _CT + _TAG
+    assert c.decrypt(_NONCE, _CT + _TAG, _AAD) == _PT
+
+
+def test_chacha20poly1305_rejects_tampering():
+    c = aead.ChaCha20Poly1305(_KEY)
+    sealed = c.encrypt(_NONCE, _PT, _AAD)
+    for corrupt in (
+        sealed[:-1] + bytes([sealed[-1] ^ 1]),   # tag flip
+        bytes([sealed[0] ^ 1]) + sealed[1:],     # ciphertext flip
+        sealed[:15],                             # shorter than a tag
+    ):
+        with pytest.raises(aead.InvalidTag):
+            c.decrypt(_NONCE, corrupt, _AAD)
+    with pytest.raises(aead.InvalidTag):
+        c.decrypt(_NONCE, sealed, b"different aad")
+
+
+def test_chacha20poly1305_empty_and_unaligned_roundtrip():
+    c = aead.ChaCha20Poly1305(_KEY)
+    for pt in (b"", b"x", b"y" * 63, b"z" * 64, b"w" * 65, b"q" * 1028):
+        assert c.decrypt(_NONCE, c.encrypt(_NONCE, pt, None), None) == pt
+
+
+def test_secret_connection_handshake_on_fallback_primitives():
+    """Full STS handshake + frame traffic over a socketpair, forcing
+    the fallback primitives regardless of whether OpenSSL is present
+    (this is exactly what a cryptography-less node runs for p2p)."""
+    import socket
+    import threading
+
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.p2p.conn import secret_connection as sc_mod
+
+    forced = {
+        "X25519PrivateKey": aead.X25519PrivateKey,
+        "X25519PublicKey": aead.X25519PublicKey,
+        "ChaCha20Poly1305": aead.ChaCha20Poly1305,
+        "HKDF": aead.HKDF,
+        "hashes": aead.hashes,
+    }
+    saved = {k: getattr(sc_mod, k) for k in forced}
+    for k, v in forced.items():
+        setattr(sc_mod, k, v)
+    try:
+        a, b = socket.socketpair()
+        ka, kb = PrivKeyEd25519.generate(), PrivKeyEd25519.generate()
+        out = {}
+
+        def server():
+            out["sc_b"] = sc_mod.SecretConnection(b, kb)
+
+        t = threading.Thread(target=server)
+        t.start()
+        sc_a = sc_mod.SecretConnection(a, ka)
+        t.join(timeout=30)
+        sc_b = out["sc_b"]
+
+        assert sc_a.remote_pub_key() == kb.pub_key()
+        assert sc_b.remote_pub_key() == ka.pub_key()
+        msg = b"m" * 3000  # spans multiple 1024-byte frames
+        sc_a.write(msg)
+        assert sc_b.read_exact(len(msg)) == msg
+        sc_b.write_msg(b"pong")
+        assert sc_a.read_msg() == b"pong"
+        sc_a.close()
+        sc_b.close()
+    finally:
+        for k, v in saved.items():
+            setattr(sc_mod, k, v)

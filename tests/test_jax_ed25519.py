@@ -145,6 +145,7 @@ def test_pallas_straus_matches_xla():
         assert np.array_equal(np.asarray(w), np.asarray(g))
 
 
+@pytest.mark.slow  # pallas interpret mode: minutes on CPU-only hosts
 def test_pallas_verify_tail_matches_xla(batch):
     """The fused verify-tail kernel (decompress -> straus -> encode ->
     compare, production path on TPU) must agree item-for-item with the
@@ -184,6 +185,7 @@ def test_pallas_verify_tail_matches_xla(batch):
     assert np.array_equal(np.asarray(want), np.asarray(got))
 
 
+@pytest.mark.slow  # fresh XLA compile: minutes on CPU-only hosts
 def test_rlc_aggregate_exact_masks():
     """verify_batch_rlc (random-linear-combination aggregate mode) must
     return exactly the same masks as the per-item path on an adversarial
@@ -225,6 +227,7 @@ def test_rlc_aggregate_exact_masks():
     assert sum(want) == 12  # the 12 honest items
 
 
+@pytest.mark.slow  # fresh XLA compile: minutes on CPU-only hosts
 def test_rlc_all_valid_no_fallback(monkeypatch):
     """On an all-valid batch every group passes the aggregate equation —
     the per-item fallback must not run."""
@@ -249,6 +252,7 @@ def test_rlc_all_valid_no_fallback(monkeypatch):
     assert got == [True] * 18
 
 
+@pytest.mark.slow  # fresh XLA compile: minutes on CPU-only hosts
 def test_sharded_commit_verify_masks_and_tally():
     """The psum sharded commit step (production path when >1 device is
     visible) must produce exact per-item masks and an exact on-device

@@ -67,6 +67,7 @@ def test_single_node_commits_blocks(tmp_path):
         node.stop()
 
 
+@pytest.mark.slow  # 8-device XLA warmup compile: minutes on CPU-only hosts
 def test_node_start_warms_verify_kernel(tmp_path, monkeypatch):
     """Node.start() must pre-compile the hot verify-kernel bucket shapes
     on a background thread (verify.warmup) so the first live vote batch

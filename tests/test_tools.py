@@ -189,3 +189,36 @@ def test_monitor_survives_node_restart(tmp_path):
             node2.stop()
         else:
             node.stop()
+
+
+def test_event_meter_rate_decays_when_stale(monkeypatch):
+    """A node that stops producing blocks must not report its last EWMA
+    forever: rate_1m decays on read based on the time since the last
+    event (tau = 60s past the expected inter-event gap)."""
+    from tendermint_tpu.tools import monitor as monitor_mod
+
+    now = [1000.0]
+    monkeypatch.setattr(monitor_mod.time, "time", lambda: now[0])
+
+    m = monitor_mod.EventMeter()
+    for _ in range(50):  # steady 1 event/sec
+        now[0] += 1.0
+        m.mark()
+    steady = m.rate_1m
+    assert steady == pytest.approx(1.0, rel=0.05)
+
+    # within the expected gap: unchanged
+    now[0] += 0.5
+    assert m.rate_1m == steady
+
+    # one minute of silence: visibly decayed; ten minutes: ~zero
+    now[0] += 60.0
+    assert m.rate_1m < steady * 0.5
+    now[0] += 540.0
+    assert m.rate_1m < 0.001
+    assert m.count == 50  # decay is read-side only
+
+    # a fresh event restores the meter's normal EWMA path
+    now[0] += 1.0
+    m.mark()
+    assert m.rate_1m > 0.0

@@ -1,0 +1,286 @@
+"""libs/tracing.py — the span tracer behind /debug/trace.
+
+Covers: span recording + nesting, Chrome-trace JSON schema, ring-buffer
+bounds, the disabled path's no-op guarantees (shared context manager,
+empty buffer, no measurable overhead on BatchVerifier.verify), and the
+ProfServer /debug/trace route.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+from tendermint_tpu.libs.tracing import Tracer, get_tracer
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer()
+    assert not t.enabled
+    with t.span("ignored", cat="x"):
+        pass
+    assert t.events() == []
+
+
+def test_disabled_span_is_shared_noop():
+    # the disabled fast path must not allocate per call
+    t = Tracer()
+    assert t.span("a") is t.span("b")
+
+
+def test_enabled_spans_record_and_nest():
+    t = Tracer(enabled=True)
+    with t.span("outer", cat="test", height=5):
+        with t.span("inner", cat="test"):
+            time.sleep(0.001)
+    evs = t.events()
+    # inner finishes first (records are appended at span exit)
+    assert [e.name for e in evs] == ["inner", "outer"]
+    inner, outer = evs
+    assert outer.start_ns <= inner.start_ns
+    assert inner.end_ns <= outer.end_ns
+    assert outer.dur_ns >= inner.dur_ns >= 1_000_000  # slept 1ms
+    assert outer.args == {"height": 5}
+
+
+def test_ring_buffer_keeps_newest():
+    t = Tracer(capacity=4, enabled=True)
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    assert [e.name for e in t.events()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_chrome_trace_schema():
+    t = Tracer(enabled=True)
+    with t.span("alpha", cat="consensus", height=3, round=0):
+        pass
+    doc = json.loads(t.chrome_trace_json())
+    assert isinstance(doc["traceEvents"], list)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert metas and metas[0]["name"] == "thread_name"
+    (ev,) = spans
+    assert ev["name"] == "alpha"
+    assert ev["cat"] == "consensus"
+    assert ev["args"] == {"height": 3, "round": 0}
+    # complete events carry µs timestamps + duration and pid/tid ints
+    for key in ("ts", "dur"):
+        assert isinstance(ev[key], float)
+    for key in ("pid", "tid"):
+        assert isinstance(ev[key], int)
+
+
+def test_enable_disable_and_clear():
+    t = Tracer()
+    t.enable(capacity=128)
+    assert t.enabled and t.capacity == 128
+    with t.span("kept"):
+        pass
+    t.disable()
+    with t.span("dropped"):
+        pass
+    assert [e.name for e in t.events()] == ["kept"]
+    t.clear()
+    assert t.events() == []
+
+
+def test_global_tracer_is_disabled_by_default():
+    assert get_tracer() is get_tracer()
+    assert not get_tracer().enabled
+
+
+def test_disabled_instrumentation_adds_no_overhead_to_verify():
+    """BatchVerifier.verify with no metrics sink and tracing off must
+    stay within noise of the raw backend call (the hot-path guarantee
+    that always-on instrumentation is free until enabled)."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+    assert B.get_metrics() is None
+    assert not get_tracer().enabled
+
+    priv = PrivKeyEd25519.generate()
+    pub = priv.pub_key().bytes()
+    msg = b"overhead-probe"
+    sig = priv.sign(msg)
+
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            v = B.CPUBatchVerifier()
+            v.add(msg, sig, pub)
+            assert v.verify() == [True]
+        return time.perf_counter() - t0
+
+    run(10)  # warm
+    instrumented = run(200)
+
+    class Raw(B.CPUBatchVerifier):
+        verify = B.CPUBatchVerifier._verify  # bypass the telemetry wrapper
+
+    def run_raw(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            v = Raw()
+            v.add(msg, sig, pub)
+            assert v.verify() == [True]
+        return time.perf_counter() - t0
+
+    run_raw(10)
+    raw = run_raw(200)
+    # generous bound — the wrapper is one module-global load, one
+    # attribute read and one branch per call; 2x covers CI noise
+    assert instrumented < raw * 2 + 0.05, (instrumented, raw)
+
+
+def test_crypto_metrics_recorded_via_global_sink():
+    """batch.set_metrics wires every verifier call site at once."""
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("t_trace")
+    priv = PrivKeyEd25519.generate()
+    pub = priv.pub_key().bytes()
+    sig = priv.sign(b"m1")
+    B.set_metrics(m.crypto)
+    try:
+        v = B.CPUBatchVerifier()
+        v.add(b"m1", sig, pub)
+        v.add(b"m2", sig, pub)  # wrong message: invalid
+        assert v.verify() == [True, False]
+    finally:
+        B.set_metrics(None)
+    out = m.registry.render()
+    assert "t_trace_crypto_signatures_verified_total 1" in out
+    assert "t_trace_crypto_signatures_invalid_total 1" in out
+    assert 't_trace_crypto_batch_verify_seconds_count{backend="cpu"} 1' in out
+    assert 't_trace_crypto_batch_size_count 1' in out
+
+
+def test_adaptive_routing_decision_counter():
+    from tendermint_tpu.crypto import batch as B
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("t_route")
+    B.set_metrics(m.crypto)
+    try:
+        v = B.AdaptiveBatchVerifier(B.CPUBatchVerifier, min_device_batch=4)
+        assert v.verify() == []  # empty → below cutoff → cpu route
+    finally:
+        B.set_metrics(None)
+    assert ('t_route_crypto_batch_routing_total{route="cpu"} 1'
+            in m.registry.render())
+
+
+def test_prof_server_debug_trace_route():
+    from tendermint_tpu.rpc.prof import ProfServer
+
+    tracer = Tracer(enabled=True)
+    with tracer.span("consensus.enterPropose", cat="consensus", height=1):
+        pass
+    srv = ProfServer("127.0.0.1", 0, tracer=tracer)
+    srv.start()
+    try:
+        url = f"http://{srv.listen_addr}/debug/trace"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read().decode())
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert names == ["consensus.enterPropose"]
+        # ?clear=1 returns the buffer then empties it
+        with urllib.request.urlopen(url + "?clear=1", timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        assert [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert tracer.events() == []
+    finally:
+        srv.stop()
+
+
+def test_concurrent_cpu_profile_returns_429():
+    from tendermint_tpu.rpc import prof as prof_mod
+    from tendermint_tpu.rpc.prof import ProfServer
+
+    srv = ProfServer("127.0.0.1", 0)
+    srv.start()
+    try:
+        url = f"http://{srv.listen_addr}/debug/pprof/profile?seconds=1"
+        results = {}
+
+        def first():
+            with urllib.request.urlopen(url, timeout=15) as r:
+                results["first"] = r.status
+
+        t = threading.Thread(target=first)
+        t.start()
+        # wait until the first request holds the profiler
+        deadline = time.time() + 5
+        while not prof_mod._profile_lock.locked() and time.time() < deadline:
+            time.sleep(0.01)
+        assert prof_mod._profile_lock.locked()
+        try:
+            urllib.request.urlopen(url, timeout=15)
+            raise AssertionError("second concurrent profile did not 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+        t.join()
+        assert results["first"] == 200
+    finally:
+        srv.stop()
+
+
+def test_node_tracing_end_to_end(tmp_path):
+    """config.instrumentation.tracing + prof_laddr: after 3 committed
+    blocks the prof server returns a non-empty Chrome-trace JSON with
+    consensus-step, WAL and state spans, and stop() disables the
+    global tracer again."""
+    from test_node import init_files, make_config
+
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK,
+        query_for_event,
+    )
+
+    c = make_config(tmp_path, "n0")
+    c.base.prof_laddr = "tcp://127.0.0.1:0"
+    c.instrumentation.tracing = True
+    c.instrumentation.tracing_buffer_size = 8192
+    init_files(c)
+    node = default_new_node(c)
+    sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 16)
+    node.start()
+    try:
+        h = 0
+        deadline = time.time() + 30
+        while h < 3 and time.time() < deadline:
+            m = sub.get(timeout=1.0)
+            if m is not None:
+                h = m.data["block"].header.height
+        assert h >= 3
+        addr = node._prof_server.listen_addr
+        with urllib.request.urlopen(
+                f"http://{addr}/debug/trace", timeout=10) as r:
+            doc = json.loads(r.read().decode())
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert spans, "trace buffer empty after 3 blocks"
+        names = {e["name"] for e in spans}
+        assert "consensus.enterPropose" in names
+        assert "consensus.finalizeCommit" in names
+        assert "wal.write" in names
+        assert "state.applyBlock" in names
+        # spans nest sanely: every applyBlock sits inside finalizeCommit
+        fin = [e for e in spans if e["name"] == "consensus.finalizeCommit"]
+        apply_spans = [e for e in spans if e["name"] == "state.applyBlock"]
+        for a in apply_spans:
+            assert any(f["ts"] <= a["ts"] and
+                       a["ts"] + a["dur"] <= f["ts"] + f["dur"] + 1e-3
+                       for f in fin)
+    finally:
+        node.stop()
+    assert not get_tracer().enabled
