@@ -187,6 +187,12 @@ REQUIRED_FAMILIES = (
     "crypto_batch_verify_seconds",
     "crypto_batch_size",
     "crypto_signatures_verified_total",
+    # PR-2 async/cache families (declaration only: a node that commits
+    # blocks without duplicate gossip may legitimately record no hits)
+    "crypto_sig_cache_hits_total",
+    "crypto_sig_cache_misses_total",
+    "crypto_inflight_batches",
+    "crypto_pipeline_overlap_seconds",
     "state_block_processing_time",
 )
 

@@ -6,7 +6,9 @@ LastCommit — ★ the second north-star call site (:310): one
 `validators.verify_commit` per block, which our build routes through
 the TPU batch verifier so a 500-validator commit is one device batch,
 not 500 serial verifies — then applies and stores it, finally handing
-off to consensus once caught up (:258-274).
+off to consensus once caught up (:258-274). With async dispatch on,
+the sync loop pipelines: block k+1's commit batch is on the device
+while block k's apply runs on the host (_try_sync_batch_pipelined).
 
 Messages (["kind", ...] over serde): block_request(height),
 block_response(block), no_block_response(height), status_request,
@@ -37,6 +39,23 @@ SYNC_BATCH = 10  # blocks applied per didProcess burst
 
 def _enc(obj) -> bytes:
     return serde.pack(obj)
+
+
+class _SpeculativeVerify:
+    """One in-flight pipelined block verification: the block pair, its
+    part set / BlockID, the pending (possibly async) commit verify, and
+    the validator-set hash it was dispatched under."""
+
+    __slots__ = ("first", "second", "parts", "block_id", "pending",
+                 "val_hash")
+
+    def __init__(self, first, second, parts, block_id, pending, val_hash):
+        self.first = first
+        self.second = second
+        self.parts = parts
+        self.block_id = block_id
+        self.pending = pending
+        self.val_hash = val_hash
 
 
 class BlockchainReactor(Reactor):
@@ -171,7 +190,17 @@ class BlockchainReactor(Reactor):
 
     def _try_sync_batch(self) -> bool:
         """reactor.go:283-353: verify-then-apply up to SYNC_BATCH blocks.
-        Returns True if at least one block was processed."""
+        Returns True if at least one block was processed. With async
+        dispatch enabled (config [crypto] async_dispatch) the loop runs
+        as a two-stage pipeline — block k+1's commit verifies on-device
+        while block k applies on the host."""
+        from ..crypto import batch as crypto_batch
+
+        if crypto_batch.async_enabled():
+            return self._try_sync_batch_pipelined()
+        return self._try_sync_batch_serial()
+
+    def _try_sync_batch_serial(self) -> bool:
         processed = 0
         for _ in range(SYNC_BATCH):
             first, second = self.pool.peek_two_blocks()
@@ -198,3 +227,91 @@ class BlockchainReactor(Reactor):
             if self.blocks_synced % 100 == 0:
                 LOG.info("fast sync at height %d", self.state.last_block_height)
         return processed > 0
+
+    # -- pipelined sync (verify k+1 on-device while k applies) ---------
+
+    def _try_sync_batch_pipelined(self) -> bool:
+        """Two-stage pipeline over the serial loop above: after block k
+        verifies, block k+1's commit batch is dispatched (async) BEFORE
+        apply(k) runs, so the device round trip hides behind host-side
+        block execution — per-block wall drops from verify+apply toward
+        max(verify, apply). Ordering and failure semantics match the
+        serial path: a block is only popped/saved/applied after ITS
+        commit verified; a failed verify redos that height and leaves
+        the already-applied prefix in place."""
+        processed = 0
+        spec = None
+        while processed < SYNC_BATCH:
+            if spec is None:
+                first, second = self.pool.peek_two_blocks()
+                if first is None or second is None:
+                    break
+                spec = self._begin_block_verify(first, second)
+            err = self._resolve_block_verify(spec)
+            if err is not None:
+                LOG.warning(
+                    "invalid block %d during fast sync: %s",
+                    spec.first.header.height, err,
+                )
+                self.pool.redo_request(spec.first.header.height)
+                return processed > 0
+            self.pool.pop_request()
+            self.store.save_block(spec.first, spec.parts, spec.second.last_commit)
+            # dispatch verify(k+1) before apply(k): the pool head moved
+            # to k+1 after pop, so peek now yields the next pair
+            nxt = None
+            if processed + 1 < SYNC_BATCH:
+                nfirst, nsecond = self.pool.peek_two_blocks()
+                if nfirst is not None and nsecond is not None:
+                    nxt = self._begin_block_verify(nfirst, nsecond)
+            self.state = self.block_exec.apply_block(
+                self.state, spec.block_id, spec.first)
+            self.blocks_synced += 1
+            processed += 1
+            if self.blocks_synced % 100 == 0:
+                LOG.info("fast sync at height %d", self.state.last_block_height)
+            spec = nxt
+        return processed > 0
+
+    def _begin_block_verify(self, first, second) -> "_SpeculativeVerify":
+        """Start (async) commit verification of `first` against
+        second.last_commit, recording the validator-set hash it was
+        dispatched under so _resolve_block_verify can detect a set that
+        changed while the batch was in flight."""
+        from ..types.validator_set import PendingCommitVerify
+
+        parts = make_part_set(first)
+        block_id = BlockID(hash=first.hash(), parts_header=parts.header())
+        vals = self.state.validators
+        try:
+            pending = vals.begin_verify_commit(
+                self.state.chain_id, block_id, first.header.height,
+                second.last_commit,
+            )
+        except Exception as e:  # structural pre-check failed synchronously
+            pending = PendingCommitVerify(exc=e)
+        return _SpeculativeVerify(first, second, parts, block_id, pending,
+                                  vals.hash())
+
+    def _resolve_block_verify(self, spec) -> Optional[Exception]:
+        """Wait for a speculative verification; returns the failure (or
+        None). If apply(k) changed the validator set while verify(k+1)
+        was in flight, the speculative result is discarded — neither
+        trusted nor assumed wrong — and the commit re-verifies
+        synchronously against the CURRENT set (validator updates are
+        rare; the speculation wins every other block)."""
+        vals = self.state.validators
+        if spec.val_hash != vals.hash():
+            try:
+                vals.verify_commit(
+                    self.state.chain_id, spec.block_id,
+                    spec.first.header.height, spec.second.last_commit,
+                )
+            except Exception as e:
+                return e
+            return None
+        try:
+            spec.pending.result()
+        except Exception as e:
+            return e
+        return None

@@ -1,7 +1,8 @@
 """Configuration — all 8 sections of the reference config
 (config/config.go:50-60): Base, RPC, P2P, Mempool, Consensus, TxIndex,
-Instrumentation (+ privval paths in Base). TOML-persisted
-(config/toml.go); tests use in-memory defaults via TestConfig.
+Instrumentation (+ privval paths in Base), plus our [crypto] section
+for the batch-verification engine. TOML-persisted (config/toml.go);
+tests use in-memory defaults via TestConfig.
 """
 
 from __future__ import annotations
@@ -138,6 +139,20 @@ class ConsensusConfig:
 
 
 @dataclass
+class CryptoConfig:
+    """[crypto] — batch-verification engine knobs (ours; the reference
+    has no crypto section). async_dispatch gates the PIPELINED call
+    sites — fast-sync overlapping verify(k+1) with apply(k), and the
+    consensus receive loop overlapping a vote run's WAL write with its
+    device dispatch; BatchVerifier.verify() itself stays synchronous
+    either way. sig_cache_size bounds the verified-signature LRU
+    (crypto/sigcache.py) in entries; 0 disables the cache."""
+
+    async_dispatch: bool = True
+    sig_cache_size: int = 65536
+
+
+@dataclass
 class TxIndexConfig:
     """reference config/config.go:723-760"""
 
@@ -168,6 +183,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -202,6 +218,7 @@ class Config:
             emit("p2p", self.p2p),
             emit("mempool", self.mempool),
             emit("consensus", self.consensus),
+            emit("crypto", self.crypto),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
         ]
@@ -221,6 +238,7 @@ class Config:
             "p2p": cfg.p2p,
             "mempool": cfg.mempool,
             "consensus": cfg.consensus,
+            "crypto": cfg.crypto,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,
         }

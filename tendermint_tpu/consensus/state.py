@@ -18,7 +18,9 @@ transitions (_handle_vote_msgs / _preverify_votes). Light traffic →
 batch of 1 → serial CPU verify, zero added latency; heavy traffic
 (catch-up streams, big valsets) → device-sized batches. Bulk ingestion
 (VoteSet.add_votes for commit reconstruction, ValidatorSet.verify_commit
-for fast sync) rides the same engine.
+for fast sync) rides the same engine. With [crypto] async_dispatch on,
+the drained run's batch is dispatched (verify_async) BEFORE its WAL
+writes, so the fsync overlaps the device round trip.
 """
 
 from __future__ import annotations
@@ -333,13 +335,20 @@ class ConsensusState:
                             else:
                                 tail = nxt
                                 break
+                        # dispatch the batched signature verification
+                        # BEFORE the WAL writes: the (fsync'd) write of
+                        # the drained run overlaps the device round trip
+                        finish = None
+                        if len(votes) > 1:
+                            finish = self._preverify_votes_begin(
+                                [m.vote for _, m in votes])
                         try:
                             for peer_id, msg in votes:
                                 if peer_id == "":
                                     self.wal.write_sync((peer_id, msg))  # :604-609
                                 else:
                                     self.wal.write((peer_id, msg))
-                            self._handle_vote_msgs(votes)
+                            self._handle_vote_msgs(votes, finish)
                         finally:
                             # the tail was already dequeued — it must not
                             # be lost to a WAL or vote-handling exception
@@ -366,15 +375,20 @@ class ConsensusState:
             self.wal.write(ti)
             self._handle_timeout(ti)
 
-    def _handle_vote_msgs(self, items) -> None:
+    def _handle_vote_msgs(self, items, finish=None) -> None:
         """Apply a drained run of VoteMessages: one batched signature
         verification (per-item masks), then the normal per-vote
-        transition logic with the verify skipped for items that passed."""
+        transition logic with the verify skipped for items that passed.
+        `finish` is the callable returned by _preverify_votes_begin when
+        the receive loop already dispatched the batch (to overlap the
+        WAL write with the device round trip)."""
         if len(items) == 1:
             peer_id, msg = items[0]
             self._try_add_vote(msg.vote, peer_id)
             return
-        mask = self._preverify_votes([m.vote for _, m in items])
+        if finish is None:
+            finish = self._preverify_votes_begin([m.vote for _, m in items])
+        mask = finish()
         for (peer_id, msg), ok in zip(items, mask):
             self._try_add_vote(msg.vote, peer_id, verified=ok)
 
@@ -384,13 +398,50 @@ class ConsensusState:
         height, the LastCommit's valset for late precommits. Votes that
         can't be mapped (wrong height/index/address) come back False and
         take the serial path's normal rejection."""
-        with self.tracer.span("consensus.preverifyVotes", cat="consensus",
-                              n=len(votes), height=self.rs.height):
-            return self._preverify_votes_inner(votes)
+        return self._preverify_votes_begin(votes)()
 
-    def _preverify_votes_inner(self, votes) -> List[bool]:
+    def _preverify_votes_begin(self, votes) -> Callable[[], List[bool]]:
+        """Start batched signature verification for a drained vote run.
+        The triples are collected synchronously — they read RoundState,
+        which this (receive) thread owns — and the batch is dispatched
+        async when [crypto] async_dispatch is on, so the caller can
+        overlap the run's WAL writes with the device round trip. The
+        returned callable blocks for and returns the per-vote mask."""
         from ..crypto import batch as crypto_batch
 
+        triples, slots = self._collect_vote_triples(votes)
+        n = len(votes)
+        if not triples:
+            return lambda: [False] * n
+
+        def _map(mask) -> List[bool]:
+            return [bool(mask[s]) if s is not None else False for s in slots]
+
+        tracer = self.tracer
+        height = self.rs.height
+        if crypto_batch.async_enabled():
+            bv = crypto_batch.new_batch_verifier()
+            for t in triples:
+                bv.add(*t)
+            fut = bv.verify_async()
+
+            def finish() -> List[bool]:
+                with tracer.span("consensus.preverifyVotes", cat="consensus",
+                                 n=n, height=height):
+                    return _map(fut.result())
+
+            return finish
+
+        def finish_sync() -> List[bool]:
+            with tracer.span("consensus.preverifyVotes", cat="consensus",
+                             n=n, height=height):
+                return _map(crypto_batch.batch_verify(triples))
+
+        return finish_sync
+
+    def _collect_vote_triples(self, votes):
+        """Map each vote to its (sign_bytes, sig, pubkey) triple, or to
+        no slot when it can't be mapped (wrong height/index/address)."""
         rs = self.rs
         chain_id = self.state.chain_id
         triples = []
@@ -419,10 +470,7 @@ class ConsensusState:
                         (vote.sign_bytes(chain_id), vote.signature, val.pub_key.bytes())
                     )
             slots.append(slot)
-        if not triples:
-            return [False] * len(votes)
-        mask = crypto_batch.batch_verify(triples)
-        return [bool(mask[s]) if s is not None else False for s in slots]
+        return triples, slots
 
     def _handle_msg(self, msg, peer_id: str) -> None:
         """reference handleMsg :625-674"""

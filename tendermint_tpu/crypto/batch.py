@@ -19,14 +19,29 @@ Backends:
                light and rides the device exactly when batching pays.
 
 Select with set_default_backend() or the TM_TPU_CRYPTO_BACKEND env var.
+
+Two cross-cutting layers sit in front of every backend:
+
+- Verified-signature cache (sigcache.SigCache, installed process-wide
+  via set_sig_cache / configure): verify() consults it first and only
+  the cache-miss subset reaches the backend; the per-item mask is
+  re-interleaved in add order. Duplicate triples within one batch are
+  dispatched once.
+- Async dispatch: verify_async() runs the exact verify() pipeline on a
+  dedicated per-backend dispatch thread and returns a VerifyFuture, so
+  callers overlap verification with other work (fast-sync applies block
+  k while block k+1's commit verifies; the consensus receive loop WALs
+  a vote run while its batch is on the device). Backend exceptions
+  surface at .result(), never in the dispatch thread.
 """
 
 from __future__ import annotations
 
 import os
+import queue as _queue
 import threading
 import time
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..libs import tracing
 
@@ -61,6 +76,192 @@ def record_device_split(transfer_s: float, compute_s: float) -> None:
         m.device_compute_seconds.set(compute_s)
 
 
+# --- process-wide [crypto] configuration (sig cache + async flag) ------
+#
+# Like the metrics sink above, these are process-global so every call
+# site — VoteSet, ValidatorSet.verify_commit, fast-sync, consensus —
+# picks them up without plumbing. node.Node wires them from the
+# config.py [crypto] section; library users call the setters directly.
+
+_sig_cache = None  # sigcache.SigCache or None (cache disabled)
+_async_enabled = True  # gates the PIPELINED call sites, not verify_async
+
+
+def set_sig_cache(cache) -> None:
+    """Install (or, with None, remove) the process-wide verified-
+    signature cache consulted by every BatchVerifier.verify()."""
+    global _sig_cache
+    _sig_cache = cache
+
+
+def get_sig_cache():
+    return _sig_cache
+
+
+def set_async_enabled(on: bool) -> None:
+    global _async_enabled
+    _async_enabled = bool(on)
+
+
+def async_enabled() -> bool:
+    """Whether pipelined call sites (fast-sync verify/apply overlap, the
+    consensus WAL/dispatch overlap) should use verify_async. The
+    verify_async API itself always works regardless."""
+    return _async_enabled
+
+
+def configure(async_dispatch: Optional[bool] = None,
+              sig_cache_size: Optional[int] = None) -> None:
+    """Apply the [crypto] config section (config.CryptoConfig)."""
+    if async_dispatch is not None:
+        set_async_enabled(async_dispatch)
+    if sig_cache_size is not None:
+        if sig_cache_size > 0:
+            from .sigcache import SigCache
+
+            set_sig_cache(SigCache(sig_cache_size))
+        else:
+            set_sig_cache(None)
+
+
+# --- async dispatch ----------------------------------------------------
+
+
+class VerifyFuture:
+    """Handle for one verify_async() call. result() returns exactly what
+    verify() would have (per-item mask in add order) or re-raises the
+    backend exception — errors never die in the dispatch thread."""
+
+    __slots__ = ("_event", "_mask", "_exc", "_t_submit", "_t_done",
+                 "_overlap_recorded")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._mask: Optional[List[bool]] = None
+        self._exc: Optional[BaseException] = None
+        self._t_submit = time.perf_counter()
+        self._t_done: Optional[float] = None
+        self._overlap_recorded = False
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _set_result(self, mask) -> None:
+        self._t_done = time.perf_counter()
+        self._mask = mask
+        self._event.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        self._t_done = time.perf_counter()
+        self._exc = exc
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[bool]:
+        t_ask = time.perf_counter()
+        if not self._event.wait(timeout):
+            raise TimeoutError("verify_async result not ready")
+        if not self._overlap_recorded:
+            # pipeline overlap = wall time the caller spent elsewhere
+            # while the batch was in flight: submit -> first result()
+            # call, capped at completion (waiting inside result() is not
+            # overlap). One sample per future.
+            self._overlap_recorded = True
+            m = _metrics
+            if m is not None:
+                overlap = max(0.0, min(t_ask, self._t_done) - self._t_submit)
+                m.pipeline_overlap_seconds.observe(overlap)
+        if self._exc is not None:
+            raise self._exc
+        return self._mask
+
+
+class _Dispatcher:
+    """One daemon thread draining verify jobs for one backend name.
+    stop() enqueues a sentinel, so queued jobs complete (their futures
+    always resolve) before the thread exits."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._q: "_queue.Queue" = _queue.Queue()
+        # guards the stopping flag so a submit racing stop() can never
+        # land behind the sentinel (its future would never resolve and
+        # result() callers block forever) — it runs inline instead
+        self._stop_lock = threading.Lock()
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"crypto-dispatch-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], List[bool]]) -> VerifyFuture:
+        fut = VerifyFuture()
+        # capture the metrics sink ONCE: increment and decrement must hit
+        # the same gauge even if set_metrics re-wires the process-wide
+        # sink while this batch is in flight
+        m = _metrics
+        if m is not None:
+            m.inflight_batches.add(1)
+        with self._stop_lock:
+            if not self._stopping:
+                self._q.put((fn, fut, m))
+                return fut
+        self._execute(fn, fut, m)  # stopping: run inline, future resolves
+        return fut
+
+    @staticmethod
+    def _execute(fn, fut: VerifyFuture, m) -> None:
+        try:
+            fut._set_result(fn())
+        except BaseException as e:  # noqa: BLE001 - surfaces at result()
+            fut._set_exception(e)
+        finally:
+            if m is not None:
+                m.inflight_batches.add(-1)
+
+    def _run(self) -> None:
+        while True:
+            task = self._q.get()
+            if task is None:
+                return
+            self._execute(*task)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._stop_lock:
+            if not self._stopping:
+                self._stopping = True
+                self._q.put(None)
+        self._thread.join(timeout)
+
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+_dispatchers: dict = {}
+_dispatchers_lock = threading.Lock()
+
+
+def _dispatcher(name: str) -> _Dispatcher:
+    with _dispatchers_lock:
+        d = _dispatchers.get(name)
+        if d is None or not d.alive():
+            d = _Dispatcher(name)
+            _dispatchers[name] = d
+        return d
+
+
+def shutdown_dispatchers(timeout: float = 10.0) -> None:
+    """Stop every dispatch thread after draining its queue: in-flight
+    futures complete, then the threads join. Called by Node.stop; a
+    verify_async() issued afterwards lazily spawns a fresh dispatcher,
+    so concurrent nodes in one process stay correct (at worst a thread
+    respawn)."""
+    with _dispatchers_lock:
+        ds = list(_dispatchers.values())
+        _dispatchers.clear()
+    for d in ds:
+        d.stop(timeout)
+
+
 class BatchVerifier:
     """Accumulate (msg, sig, pubkey) triples, then verify all at once.
 
@@ -84,7 +285,56 @@ class BatchVerifier:
         raise NotImplementedError
 
     def verify(self) -> List[bool]:
-        """Returns one validity flag per added triple, in add order."""
+        """Returns one validity flag per added triple, in add order.
+
+        Consults the process-wide verified-signature cache first: cached
+        triples never reach the backend, duplicate triples within the
+        batch are dispatched once, and only the cache-miss subset runs
+        _verify(); the mask is re-interleaved in add order."""
+        cache = _sig_cache
+        if cache is None or not self._items:
+            return self._verify_instrumented()
+        items = self._items
+        keys = [cache.key(msg, sig, pk) for msg, sig, pk in items]
+        verdicts: List[Optional[bool]] = [None] * len(items)
+        miss_pos: dict = {}  # key -> index into miss_idx (in-batch dedup)
+        miss_idx: List[int] = []
+        hits = 0
+        for i, k in enumerate(keys):
+            if k in miss_pos:
+                continue  # duplicate of an in-batch miss: filled below
+            v = cache.get(k)
+            if v is None:
+                miss_pos[k] = len(miss_idx)
+                miss_idx.append(i)
+            else:
+                verdicts[i] = v
+                hits += 1
+        m = _metrics
+        if m is not None:
+            if hits:
+                m.sig_cache_hits.inc(hits)
+            if miss_idx:
+                m.sig_cache_misses.inc(len(miss_idx))
+        if miss_idx:
+            # _verify() reads self._items; narrow it to the miss subset
+            # for the dispatch (single-caller contract, like add/verify)
+            self._items = [items[i] for i in miss_idx]
+            try:
+                submask = self._verify_instrumented()
+            finally:
+                self._items = items
+            for pos, i in enumerate(miss_idx):
+                ok = bool(submask[pos])
+                verdicts[i] = ok
+                cache.put(keys[i], ok)
+        for i, k in enumerate(keys):
+            if verdicts[i] is None:  # in-batch duplicate of a miss
+                verdicts[i] = verdicts[miss_idx[miss_pos[k]]]
+        return verdicts
+
+    def _verify_instrumented(self) -> List[bool]:
+        """_verify() wrapped with latency/size/validity telemetry."""
         m = _metrics
         tracer = tracing.get_tracer()
         if m is None and not tracer.enabled:
@@ -104,6 +354,13 @@ class BatchVerifier:
             if n - ok:
                 m.signatures_invalid.inc(n - ok)
         return mask
+
+    def verify_async(self) -> VerifyFuture:
+        """Dispatch verify() of the CURRENT items on this backend's
+        dedicated dispatch thread. The caller must not add() to this
+        verifier while the future is in flight; result() returns the
+        per-item mask (add order) or re-raises the backend error."""
+        return _dispatcher(self.BACKEND).submit(self.verify)
 
     def verify_all(self) -> bool:
         return all(self.verify())
@@ -149,7 +406,16 @@ class AdaptiveBatchVerifier(BatchVerifier):
         # verifier's own verify() records the latency/size telemetry
         # under its leaf backend label — a template here would double
         # count every batch. Adaptive only adds the routing decision.
-        use_device = len(self._items) >= self._min
+        n = len(self._items)
+        cache = _sig_cache
+        if cache is not None and n:
+            # route on the CACHE-MISS count (stats-neutral peek): the
+            # leaf verifier will only dispatch the misses, so a mostly-
+            # cached batch must not pay the fixed device dispatch for a
+            # handful of stragglers
+            n = sum(1 for msg, sig, pk in self._items
+                    if cache.peek(cache.key(msg, sig, pk)) is None)
+        use_device = n >= self._min
         m = _metrics
         if m is not None:
             m.routing_decisions.with_labels(

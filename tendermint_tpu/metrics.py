@@ -63,6 +63,15 @@ class CryptoMetrics:
     # last jax call's host->device transfer vs on-device compute split
     device_transfer_seconds: object = NOP
     device_compute_seconds: object = NOP
+    # verified-signature cache (crypto/sigcache.py): triples served from
+    # cache vs dispatched to a backend
+    sig_cache_hits: object = NOP
+    sig_cache_misses: object = NOP
+    # async dispatch (verify_async): batches submitted but not completed
+    inflight_batches: object = NOP
+    # wall time a caller overlapped with an in-flight async batch
+    # (submit -> first result() call, capped at batch completion)
+    pipeline_overlap_seconds: object = NOP
 
 
 @dataclass
@@ -197,6 +206,20 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
         device_compute_seconds=r.gauge(
             f"{ns}_crypto_device_compute_seconds",
             "On-device compute/wait time of the last jax batch."),
+        sig_cache_hits=r.counter(
+            f"{ns}_crypto_sig_cache_hits_total",
+            "Triples served from the verified-signature cache."),
+        sig_cache_misses=r.counter(
+            f"{ns}_crypto_sig_cache_misses_total",
+            "Triples that missed the cache and reached a backend."),
+        inflight_batches=r.gauge(
+            f"{ns}_crypto_inflight_batches",
+            "Async verify batches dispatched and not yet completed."),
+        pipeline_overlap_seconds=r.histogram(
+            f"{ns}_crypto_pipeline_overlap_seconds",
+            "Wall time callers overlapped with an in-flight async batch.",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 1)),
     )
     return NodeMetrics(consensus=cons, p2p=p2p, mempool=mem, state=state,
                        crypto=crypto, registry=r)

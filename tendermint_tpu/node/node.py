@@ -128,6 +128,16 @@ class Node:
 
         if config.instrumentation.prometheus:
             crypto_batch.set_metrics(self.metrics.crypto)
+        # [crypto] section: async dispatch flag + verified-signature
+        # cache, process-wide like the metrics sink (every BatchVerifier
+        # call site picks them up). The cache object is remembered so
+        # stop() only uninstalls OUR cache — a second node in the same
+        # process may have re-wired it since.
+        crypto_batch.configure(
+            async_dispatch=config.crypto.async_dispatch,
+            sig_cache_size=config.crypto.sig_cache_size,
+        )
+        self._installed_sig_cache = crypto_batch.get_sig_cache()
         self._enabled_tracing = False
         if config.instrumentation.tracing:
             tracer = tracing.get_tracer()
@@ -452,16 +462,23 @@ class Node:
         # so back-to-back nodes (tests) don't report into a dead registry.
         # Only if the installed sink is still OURS — a second instrumented
         # node in the same process may have re-wired them since.
-        if self.config.instrumentation.prometheus:
-            from ..crypto import batch as crypto_batch
+        from ..crypto import batch as crypto_batch
 
+        if self.config.instrumentation.prometheus:
             if crypto_batch.get_metrics() is self.metrics.crypto:
                 crypto_batch.set_metrics(None)
+        if (self._installed_sig_cache is not None
+                and crypto_batch.get_sig_cache() is self._installed_sig_cache):
+            crypto_batch.set_sig_cache(None)
         if self._enabled_tracing:
             from ..libs import tracing
 
             tracing.get_tracer().disable()
         self.sw.stop()
+        # join the async verify dispatch threads AFTER the reactors are
+        # down (queued batches drain first; futures always complete). A
+        # concurrently running node respawns its dispatcher lazily.
+        crypto_batch.shutdown_dispatchers()
         if self.addr_book is not None:
             self.addr_book.save()
         self.trust_store.save()

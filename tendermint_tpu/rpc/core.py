@@ -253,14 +253,15 @@ def block_results(env: RPCEnvironment, params: dict) -> dict:
 
 
 def _validator_update_json(u) -> dict:
-    """abci.ValidatorUpdate (type-tagged pubkey bytes + power)."""
+    """abci.ValidatorUpdate (type-tagged pubkey bytes + power). The
+    reference marshals abci.PubKey with json tag "data", not "value"."""
     from ..crypto import pubkey_from_bytes
     from ..crypto.keys import PubKeyEd25519
 
     pk = pubkey_from_bytes(u.pub_key)
     typ = "ed25519" if isinstance(pk, PubKeyEd25519) else "secp256k1"
     return {
-        "pub_key": {"type": typ, "value": enc.b64(pk.bytes())},
+        "pub_key": {"type": typ, "data": enc.b64(pk.bytes())},
         "power": str(u.power),
     }
 
@@ -378,9 +379,11 @@ def consensus_params(env: RPCEnvironment, params: dict) -> dict:
 
     latest = env.latest_state().last_block_height + 1
     h = _int(params, "height", None)
-    if h is None or h == 0:
+    if h is None:
         h = latest
     elif h <= 0:
+        # an EXPLICITLY supplied height=0 is invalid (reference
+        # getHeight); only an omitted height defaults to latest
         raise RPCError(ERR_INVALID_PARAMS, "height must be greater than 0")
     elif h > latest:
         # params are stored through the NEXT block's height

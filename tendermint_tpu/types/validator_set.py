@@ -34,6 +34,31 @@ class ErrNotEnoughVotingPower(ErrInvalidCommit):
     pass
 
 
+class PendingCommitVerify:
+    """Handle for an in-flight begin_verify_commit. result() blocks on
+    the dispatched signature batch, finishes the tally, and raises
+    exactly what verify_commit would have raised. Idempotent: the
+    outcome is computed once and replayed on repeat calls."""
+
+    __slots__ = ("_finish", "_exc", "_done")
+
+    def __init__(self, finish=None, exc=None):
+        self._finish = finish
+        self._exc = exc
+        self._done = finish is None
+
+    def result(self) -> None:
+        if not self._done:
+            self._done = True
+            finish, self._finish = self._finish, None
+            try:
+                finish()
+            except Exception as e:  # noqa: BLE001 - replayed to every caller
+                self._exc = e
+        if self._exc is not None:
+            raise self._exc
+
+
 @dataclass
 class Validator:
     address: bytes
@@ -204,6 +229,41 @@ class ValidatorSet:
         Reference types/validator_set.go:330-378, except the per-signature
         loop becomes one BatchVerifier call (TPU-batched).
         """
+        bv, entries = self._prepare_commit_verify(chain_id, block_id, height, commit)
+        mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
+        self._finish_commit_verify(mask, psum_tally, entries, block_id)
+
+    def begin_verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit
+    ) -> "PendingCommitVerify":
+        """verify_commit with the signature batch dispatched ASYNC
+        (BatchVerifier.verify_async): structural pre-checks run — and
+        raise — here; .result() blocks on the device batch, completes
+        the tally, and raises exactly what verify_commit would have.
+        The fast-sync pipeline uses this to verify block k+1's commit
+        on-device while block k applies on the host. When async dispatch
+        is disabled the whole verification runs synchronously here and
+        .result() just replays the outcome. (The multi-device psum tally
+        path is sync-only; the host tally is authoritative either way.)"""
+        bv, entries = self._prepare_commit_verify(chain_id, block_id, height, commit)
+        if entries and batch.async_enabled():
+            fut = bv.verify_async()
+            return PendingCommitVerify(
+                lambda: self._finish_commit_verify(
+                    fut.result(), None, entries, block_id)
+            )
+        try:
+            mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
+            self._finish_commit_verify(mask, psum_tally, entries, block_id)
+        except ErrInvalidCommit as e:
+            return PendingCommitVerify(exc=e)
+        return PendingCommitVerify()
+
+    def _prepare_commit_verify(self, chain_id: str, block_id: BlockID,
+                               height: int, commit):
+        """Structural pre-checks + batch assembly (raises ErrInvalidCommit
+        on malformed commits). Returns (bv, entries) with entries =
+        [(index, precommit, validator)] aligned to the batch."""
         if len(self.validators) != len(commit.precommits):
             raise ErrInvalidCommit(
                 f"invalid commit: {len(commit.precommits)} precommits for {len(self.validators)} validators"
@@ -226,8 +286,11 @@ class ValidatorSet:
             _, val = self.get_by_index(idx)
             bv.add(precommit.sign_bytes(chain_id), precommit.signature, val.pub_key.bytes())
             entries.append((idx, precommit, val))
+        return bv, entries
 
-        mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
+    def _finish_commit_verify(self, mask, psum_tally, entries,
+                              block_id: BlockID) -> None:
+        """Tally the verified mask and enforce the +2/3 threshold."""
         tallied = 0
         for ok, (idx, precommit, val) in zip(mask, entries):
             if not ok:
@@ -269,6 +332,12 @@ class ValidatorSet:
                              if backend == "adaptive" else 1)
                 if (backend in ("jax", "adaptive")
                         and len(entries) >= min_batch
+                        # the fused psum path reads the raw batch and
+                        # would bypass the verified-signature cache; with
+                        # a cache installed, bv.verify() below serves
+                        # hits and device-dispatches only the misses
+                        # (host tally is authoritative either way)
+                        and batch.get_sig_cache() is None
                         and all(0 <= v.voting_power < 2**31
                                 for _, _, v in entries)):
                     import jax

@@ -25,3 +25,27 @@ try:
     jax.config.update("jax_num_cpu_devices", 8)
 except AttributeError:
     pass  # XLA_FLAGS above already provides the 8 virtual devices
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _crypto_async_hygiene():
+    """Async-dispatch hygiene after every test: the per-backend
+    crypto-dispatch threads must join cleanly (shutdown drains queued
+    futures first — a hung or leaked thread fails the test), and the
+    process-wide sig cache / async flag are reset so tests stay
+    isolated."""
+    yield
+    import threading
+
+    from tendermint_tpu.crypto import batch as crypto_batch
+
+    crypto_batch.shutdown_dispatchers()
+    crypto_batch.set_sig_cache(None)
+    crypto_batch.set_async_enabled(True)
+    leaked = [
+        t for t in threading.enumerate()
+        if t.name.startswith("crypto-dispatch") and t.is_alive()
+    ]
+    assert not leaked, f"leaked crypto dispatch threads: {leaked}"

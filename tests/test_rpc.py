@@ -366,6 +366,13 @@ def test_consensus_params_route(rpc_node):
     with pytest.raises(RPCError):
         c.call("consensus_params", {"height": 10_000_000})
 
+    # an EXPLICIT height=0 must be rejected (reference getHeight) — only
+    # an omitted height defaults to latest
+    with pytest.raises(RPCError, match="height must be greater than 0"):
+        c.call("consensus_params", {"height": 0})
+    with pytest.raises(RPCError, match="height must be greater than 0"):
+        c.call("consensus_params", {"height": -3})
+
 
 def test_unsafe_flush_mempool_route(rpc_node):
     node, c = rpc_node
@@ -411,7 +418,8 @@ def test_block_results_renders_persisted_end_block():
     out = block_results(env, {"height": 3})
     eb = out["results"]["EndBlock"]
     assert eb["validator_updates"] == [{
-        "pub_key": {"type": "ed25519", "value": base64.b64encode(pk.bytes()).decode()},
+        # reference marshals abci.PubKey bytes under "data", not "value"
+        "pub_key": {"type": "ed25519", "data": base64.b64encode(pk.bytes()).decode()},
         "power": "7",
     }]
     assert eb["consensus_param_updates"] == {

@@ -119,13 +119,16 @@ class TestLiveVoteBatching:
         from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
 
         batch_sizes = []
-        real_batch_verify = crypto_batch.batch_verify
+        real_verify = crypto_batch.BatchVerifier.verify
 
-        def spy_batch_verify(triples, backend=None):
-            batch_sizes.append(len(triples))
-            return real_batch_verify(triples, backend)
+        # spy at the BatchVerifier.verify funnel: both the sync path
+        # (batch_verify) and the async pipeline (verify_async dispatches
+        # self.verify on the crypto-dispatch thread) go through it
+        def spy_verify(self):
+            batch_sizes.append(len(self._items))
+            return real_verify(self)
 
-        monkeypatch.setattr(crypto_batch, "batch_verify", spy_batch_verify)
+        monkeypatch.setattr(crypto_batch.BatchVerifier, "verify", spy_verify)
 
         cs, bus, mp, keys, bstore = make_consensus(4, privval_idx=0)
         sub = bus.subscribe("blocks", query_for_event(EVENT_NEW_BLOCK), 64)
