@@ -102,13 +102,19 @@ def parse_exposition(text: str) -> dict:
         if line.startswith("#"):
             m = _HELP_RE.match(line)
             if m:
-                families.setdefault(
-                    m.group("name"), {"type": None, "samples": {}})
+                fam = families.setdefault(
+                    m.group("name"),
+                    {"type": None, "help": None, "samples": {}})
+                if fam["help"] is not None:
+                    raise ExpositionError(
+                        f"line {lineno}: second HELP for {m.group('name')}")
+                fam["help"] = m.group("doc")
                 continue
             m = _TYPE_RE.match(line)
             if m:
                 fam = families.setdefault(
-                    m.group("name"), {"type": None, "samples": {}})
+                    m.group("name"),
+                    {"type": None, "help": None, "samples": {}})
                 if fam["type"] is not None:
                     raise ExpositionError(
                         f"line {lineno}: second TYPE for {m.group('name')}")
@@ -194,6 +200,16 @@ REQUIRED_FAMILIES = (
     "crypto_inflight_batches",
     "crypto_pipeline_overlap_seconds",
     "state_block_processing_time",
+    # PR-3 watchdog + per-peer network telemetry (peer-labeled families
+    # legitimately render no samples on a peerless node — declaration
+    # presence is the contract; pruning removes series, never families)
+    "consensus_round_dwell_seconds",
+    "consensus_stalls_total",
+    "p2p_peers",
+    "p2p_peer_receive_bytes_total",
+    "p2p_peer_send_bytes_total",
+    "p2p_peer_msg_recv_total",
+    "p2p_peer_lag_blocks",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples
@@ -218,6 +234,13 @@ def check_body(body: str, namespace: str = "tendermint",
                if f"{namespace}_{f}" not in families]
     if missing:
         raise ExpositionError(f"missing metric families: {missing}")
+    # help-text lint: every registered family must document itself —
+    # a scrape full of nameless numbers is unusable at 3am
+    undocumented = [name for name, fam in families.items()
+                    if not (fam.get("help") or "").strip()]
+    if undocumented:
+        raise ExpositionError(
+            f"metric families without help text: {undocumented}")
     if require_live:
         dead = [f"{namespace}_{f}" for f in REQUIRED_LIVE_FAMILIES
                 if not any(v > 0 for v in

@@ -31,6 +31,13 @@ LOG = logging.getLogger("blockchain.reactor")
 
 BLOCKCHAIN_CHANNEL = 0x40
 
+# valid wire message kinds; the per-peer msg_type metric label is drawn
+# from this set so a peer can't mint arbitrary label values
+_KNOWN_MSG_KINDS = frozenset((
+    "block_request", "block_response", "no_block_response",
+    "status_request", "status_response",
+))
+
 TRY_SYNC_INTERVAL = 0.01  # reactor.go:31 trySyncIntervalMS
 STATUS_UPDATE_INTERVAL = 10.0  # reactor.go:34
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0  # reactor.go:37
@@ -118,6 +125,12 @@ class BlockchainReactor(Reactor):
         """reactor.go:174-214."""
         obj = serde.unpack(msg_bytes)
         kind = obj[0]
+        if self.switch is not None and peer.is_running():
+            # label from the whitelist only — `kind` is raw wire input
+            # and must not name an unbounded (or malformed) series
+            label = kind if kind in _KNOWN_MSG_KINDS else "unknown"
+            self.switch.metrics.peer_msg_recv_total.with_labels(
+                peer.id, f"{ch_id:#04x}", label).inc()
         if kind == "block_request":
             height = obj[1]
             block = self.store.load_block(height)

@@ -174,6 +174,14 @@ class InstrumentationConfig:
     # exported as chrome://tracing JSON from the prof server
     tracing: bool = False
     tracing_buffer_size: int = 65536
+    # consensus stall watchdog (ours): a round dwelling past this many
+    # seconds increments consensus_stalls_total{reason} and snapshots a
+    # diagnostic bundle served at /debug/consensus on prof_laddr;
+    # 0 disables detection (the dwell gauge still updates)
+    stall_threshold_s: float = 30.0
+    # per-height lifecycle timelines (libs/timeline.py) kept for the
+    # newest N heights, served at /debug/timeline?height=N; 0 disables
+    timeline_heights: int = 64
 
 
 @dataclass

@@ -400,6 +400,9 @@ class ConsensusReactor(Reactor):
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         """reactor.go:199-320."""
         msg = decode_msg(msg_bytes)
+        if self.switch is not None and peer.is_running():
+            self.switch.metrics.peer_msg_recv_total.with_labels(
+                peer.id, f"{ch_id:#04x}", type(msg).__name__).inc()
         ps: Optional[PeerState] = peer.get("consensus_peer_state")
         if ps is None:
             return

@@ -25,6 +25,7 @@ writes, so the fsync overlaps the device round trip.
 
 from __future__ import annotations
 
+import collections
 import logging
 import queue
 import threading
@@ -33,7 +34,7 @@ from contextlib import contextmanager
 from typing import Callable, List, Optional
 
 from ..config import ConsensusConfig
-from ..libs import fail, tracing
+from ..libs import fail, timeline as timeline_mod, tracing
 from ..state import BlockExecutor
 from ..state import state as sm_state
 from ..types.basic import (
@@ -111,6 +112,14 @@ class ConsensusState:
         self.wal = wal if wal is not None else NilWAL()
         # process-global tracer (libs/tracing.py): disabled → no-op spans
         self.tracer = tracing.get_tracer()
+        # per-height lifecycle recorder (libs/timeline.py), disabled until
+        # the node enables it. Per-instance (unlike the tracer): each
+        # node's marks and peer attribution must stay its own, even with
+        # several in-process nodes (tests, sim harnesses)
+        self.timeline = timeline_mod.Timeline()
+        # wall clock of the last (height, round) change — the stall
+        # watchdog's dwell anchor; written only by the receive thread
+        self._round_entered = time.time()
 
         self.rs = RoundState()
         self.state = None  # set by update_to_state
@@ -237,6 +246,8 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
 
+        self._round_entered = time.time()
+        self.timeline.mark(height, "new_height")
         self.state = state
         self._new_step()
 
@@ -476,6 +487,15 @@ class ConsensusState:
         """reference handleMsg :625-674"""
         if isinstance(msg, ProposalMessage):
             self.set_proposal_fn(msg.proposal)
+            # mark AFTER set_proposal accepted it (signature verified,
+            # height/round matched): a byzantine peer must not steal the
+            # first-wins attribution with a garbage proposal, nor churn
+            # the bounded timeline window with unvalidated heights.
+            # "" peer_id = our own signed proposal.
+            if self.rs.proposal is msg.proposal:
+                self.timeline.mark(self.rs.height, "proposal_received",
+                                   peer_id=peer_id,
+                                   round_=msg.proposal.round)
         elif isinstance(msg, BlockPartMessage):
             self._add_proposal_block_part(msg, peer_id)
         elif isinstance(msg, VoteMessage):
@@ -533,6 +553,8 @@ class ConsensusState:
                 validators = validators.copy()
                 validators.increment_proposer_priority(round_ - rs.round)
 
+            if rs.round != round_:
+                self._round_entered = time.time()
             rs.round = round_
             rs.step = STEP_NEW_ROUND
             rs.validators = validators
@@ -830,6 +852,7 @@ class ConsensusState:
         if rs.height != height or rs.step >= STEP_COMMIT:
             return
         LOG.debug("enterCommit(%d/%d)", height, commit_round)
+        self.timeline.mark(height, "commit", round_=commit_round)
         try:
             with self._step_span("enterCommit", "commit", height, commit_round):
                 rs.step = STEP_COMMIT
@@ -902,6 +925,7 @@ class ConsensusState:
             # WAL EndHeight BEFORE ApplyBlock: on crash we replay from here and
             # the handshake re-applies the block to the app (reference :1271-1285)
             self.wal.write_end_height(height)
+            self.timeline.mark(height, "wal_fsync", round_=rs.commit_round)
             fail.fail_point("FinalizeCommit.AfterWAL")  # :1282
 
             state_copy = self.state.copy()
@@ -912,6 +936,7 @@ class ConsensusState:
             except Exception:
                 LOG.exception("failed to apply block; exiting consensus")
                 raise
+            self.timeline.mark(height, "apply_block", round_=rs.commit_round)
             fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
 
             self.n_height_committed += 1
@@ -1034,6 +1059,9 @@ class ConsensusState:
             added = rs.last_commit.add_vote(vote, verified=verified)
             if added:
                 LOG.debug("added late precommit to last commit: %s", rs.last_commit)
+                self.timeline.mark_vote(vote.height, "precommit",
+                                        vote.validator_index, peer_id,
+                                        round_=vote.round)
                 self.event_bus.publish_vote(vote)
                 if self.on_vote_added is not None:
                     self.on_vote_added(vote)
@@ -1048,6 +1076,10 @@ class ConsensusState:
         added = rs.votes.add_vote(vote, peer_id, verified=verified)
         if not added:
             return False
+        self.timeline.mark_vote(
+            vote.height,
+            "prevote" if vote.type == VOTE_TYPE_PREVOTE else "precommit",
+            vote.validator_index, peer_id, round_=vote.round)
         self.event_bus.publish_vote(vote)
         if self.on_vote_added is not None:
             self.on_vote_added(vote)
@@ -1065,6 +1097,8 @@ class ConsensusState:
         block_id = prevotes.two_thirds_majority()
 
         if block_id is not None:
+            self.timeline.mark(rs.height, "prevote_23", peer_id="",
+                               round_=vote.round)
             # unlock on newer polka (reference :1547-1558)
             if (
                 rs.locked_block is not None
@@ -1108,6 +1142,8 @@ class ConsensusState:
         precommits = rs.votes.precommits(vote.round)
         block_id = precommits.two_thirds_majority()
         if block_id is not None:
+            self.timeline.mark(rs.height, "precommit_23", peer_id="",
+                               round_=vote.round)
             self._enter_new_round(rs.height, vote.round)
             self._enter_precommit(rs.height, vote.round)
             if block_id.hash:
@@ -1178,6 +1214,71 @@ class ConsensusState:
         LOG.debug("signed and queued vote %s", vote)
         return vote
 
+    # --- stall diagnostics --------------------------------------------------
+
+    def round_dwell_seconds(self) -> float:
+        """Wall seconds since the machine entered the current
+        (height, round) — the watchdog's primary signal."""
+        return max(0.0, time.time() - self._round_entered)
+
+    def stall_snapshot(self, switch=None, reason: str = "",
+                       dwell_s: float = 0.0) -> dict:
+        """Structured diagnostic bundle for the current round: RoundState
+        summary, vote bit arrays, the validators we're missing votes
+        from, per-peer PeerState, and the crypto engine's in-flight
+        batch count. Read-only over shallow snapshots, so it is safe to
+        call from the watchdog thread while the receive loop runs."""
+        from ..crypto import batch as crypto_batch
+
+        rs = self.get_round_state()
+        out = {
+            "reason": reason,
+            "dwell_s": round(dwell_s, 3),
+            "time": time.time(),
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": RoundStepType.name(rs.step),
+                "start_time": rs.start_time,
+                "have_proposal": rs.proposal is not None,
+                "have_proposal_block": rs.proposal_block is not None,
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+            },
+            "votes": {},
+            "missing_validators": [],
+            "peers": [],
+            "inflight_verify_batches": crypto_batch.inflight_count(),
+        }
+        try:
+            if rs.votes is not None and rs.validators is not None:
+                n_vals = len(rs.validators)
+                missing: set = set()
+                for name, vs in (("prevotes", rs.votes.prevotes(rs.round)),
+                                 ("precommits", rs.votes.precommits(rs.round))):
+                    if vs is None:
+                        continue
+                    ba = vs.bit_array()
+                    out["votes"][name] = {
+                        "bits": _bits_str(ba),
+                        "have": ba.num_true(),
+                        "total": n_vals,
+                    }
+                    missing.update(
+                        i for i in range(n_vals) if not ba.get_index(i))
+                for i in sorted(missing):
+                    addr, _ = rs.validators.get_by_index(i)
+                    out["missing_validators"].append(
+                        {"index": i, "address": (addr or b"").hex()})
+        except Exception:  # noqa: BLE001 - diagnostics must not raise
+            LOG.exception("stall snapshot: vote section failed")
+        if switch is not None:
+            try:
+                out["peers"] = _peer_states_json(switch, rs.height)
+            except Exception:  # noqa: BLE001
+                LOG.exception("stall snapshot: peer section failed")
+        return out
+
     # --- WAL catchup replay -------------------------------------------------
 
     def _catchup_replay(self, height: int) -> None:
@@ -1210,5 +1311,161 @@ class ConsensusState:
                 self._handle_msg(m, peer_id)
             except Exception:
                 LOG.exception("error replaying WAL message")
+
+
+# --- stall watchdog ---------------------------------------------------------
+
+
+def _bits_str(ba) -> str:
+    """BitArray as a compact '1011…' string for diagnostic bundles."""
+    if ba is None:
+        return ""
+    return "".join("1" if ba.get_index(i) else "0" for i in range(ba.bits))
+
+
+def _peer_states_json(switch, our_height: int) -> List[dict]:
+    """Per-peer consensus PeerState summaries (heights, steps, vote bit
+    arrays, lag vs our height) for /debug/consensus and the monitor."""
+    peers = []
+    for p in switch.peers.list():
+        ps = p.get("consensus_peer_state")
+        entry = {"peer_id": p.id, "moniker": p.node_info.moniker}
+        if ps is not None:
+            prs = ps.get_round_state()
+            entry.update({
+                "height": prs.height,
+                "round": prs.round,
+                "step": prs.step,
+                "prevotes": _bits_str(prs.prevotes),
+                "precommits": _bits_str(prs.precommits),
+                "lag_blocks": max(0, our_height - prs.height)
+                if prs.height > 0 else 0,
+            })
+        peers.append(entry)
+    return peers
+
+
+def classify_stall(rs: RoundState) -> str:
+    """Map the stuck round's state to a coarse diagnosis, used as the
+    consensus_stalls_total{reason} label (bounded cardinality)."""
+    if rs.step in (STEP_NEW_HEIGHT, STEP_NEW_ROUND):
+        return "slow_round_start"
+    if rs.step == STEP_PROPOSE:
+        return "no_proposal" if rs.proposal is None else "incomplete_proposal"
+    if rs.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
+        return "no_prevote_quorum"
+    if rs.step in (STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT):
+        return "no_precommit_quorum"
+    if rs.step == STEP_COMMIT:
+        return "commit_not_finalized"
+    return "unknown"
+
+
+class StallWatchdog:
+    """Detects a consensus machine dwelling too long in one
+    (height, round) and snapshots why (no reference equivalent; the
+    reference leaves operators to diff dump_consensus_state by hand).
+
+    A daemon thread samples ConsensusState.round_dwell_seconds() every
+    `interval`, publishes it as consensus_round_dwell_seconds, and —
+    once the dwell crosses `threshold_s` — increments
+    consensus_stalls_total{reason} and captures a structured diagnostic
+    bundle (RoundState, vote BitArrays, missing validators, per-peer
+    PeerState, in-flight verify batches). One trip per (height, round):
+    a round that stays stuck doesn't spam bundles. Bundles + a live
+    snapshot are served at /debug/consensus on the ProfServer. on_tick
+    callables run every sample — the node hooks per-peer gauge refresh
+    (flow rates, queue depths, p2p_peer_lag_blocks) here so peer
+    telemetry shares the watchdog's cadence."""
+
+    def __init__(self, cs: ConsensusState, threshold_s: float = 30.0,
+                 switch=None, interval: Optional[float] = None,
+                 max_bundles: int = 8):
+        self.cs = cs
+        self.switch = switch
+        self.threshold_s = threshold_s
+        if interval is None:
+            interval = min(1.0, threshold_s / 4.0) if threshold_s > 0 else 1.0
+        self.interval = max(0.05, interval)
+        self.on_tick: List[Callable[[], None]] = []
+        self._bundles: collections.deque = collections.deque(
+            maxlen=max_bundles)
+        self._stalls_total = 0
+        self._flagged: Optional[tuple] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="cs-watchdog", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 - watchdog must outlive bugs
+                LOG.exception("stall watchdog tick failed")
+
+    # -- sampling ------------------------------------------------------
+
+    def _tick(self) -> None:
+        dwell = self.cs.round_dwell_seconds()
+        self.cs.metrics.round_dwell.set(dwell)
+        for fn in self.on_tick:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                LOG.exception("watchdog on_tick hook failed")
+        if self.threshold_s <= 0 or dwell < self.threshold_s:
+            return
+        rs = self.cs.rs
+        key = (rs.height, rs.round)
+        if self._flagged == key:
+            return
+        self._flagged = key
+        reason = classify_stall(rs)
+        self.cs.metrics.stalls.with_labels(reason).inc()
+        self._stalls_total += 1
+        bundle = self.cs.stall_snapshot(
+            switch=self.switch, reason=reason, dwell_s=dwell)
+        self._bundles.append(bundle)
+        LOG.warning(
+            "consensus stall: h=%d r=%d dwelt %.1fs (> %.1fs) reason=%s",
+            rs.height, rs.round, dwell, self.threshold_s, reason)
+
+    # -- export (/debug/consensus) -------------------------------------
+
+    @property
+    def stalls_total(self) -> int:
+        return self._stalls_total
+
+    def stall_bundles(self) -> List[dict]:
+        return list(self._bundles)
+
+    def status(self) -> dict:
+        """The /debug/consensus payload: live diagnostics + the bundles
+        captured at stall time."""
+        dwell = self.cs.round_dwell_seconds()
+        rs = self.cs.rs
+        return {
+            "height": rs.height,
+            "round": rs.round,
+            "step": RoundStepType.name(rs.step),
+            "dwell_s": round(dwell, 3),
+            "threshold_s": self.threshold_s,
+            "stalls_total": self._stalls_total,
+            "stalls": list(self._bundles),
+            "live": self.cs.stall_snapshot(
+                switch=self.switch, reason="live", dwell_s=dwell),
+        }
 
 

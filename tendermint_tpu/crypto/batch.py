@@ -175,6 +175,24 @@ class VerifyFuture:
         return self._mask
 
 
+# live async-batch count, readable without a metrics registry — the
+# consensus stall watchdog includes it in /debug/consensus bundles (a
+# stall with batches in flight points at the device, not the network)
+_inflight = 0
+_inflight_lock = threading.Lock()
+
+
+def _inflight_add(d: int) -> None:
+    global _inflight
+    with _inflight_lock:
+        _inflight += d
+
+
+def inflight_count() -> int:
+    """Async verify batches dispatched and not yet completed."""
+    return _inflight
+
+
 class _Dispatcher:
     """One daemon thread draining verify jobs for one backend name.
     stop() enqueues a sentinel, so queued jobs complete (their futures
@@ -199,6 +217,7 @@ class _Dispatcher:
         # the same gauge even if set_metrics re-wires the process-wide
         # sink while this batch is in flight
         m = _metrics
+        _inflight_add(1)
         if m is not None:
             m.inflight_batches.add(1)
         with self._stop_lock:
@@ -215,6 +234,7 @@ class _Dispatcher:
         except BaseException as e:  # noqa: BLE001 - surfaces at result()
             fut._set_exception(e)
         finally:
+            _inflight_add(-1)
             if m is not None:
                 m.inflight_batches.add(-1)
 

@@ -16,6 +16,10 @@ import threading
 _lock = threading.Lock()
 _counter = 0
 _names: list[str] = []
+# programmatic fault injection (ours): tests hook a named fail point to
+# run arbitrary code — e.g. a sleep that stalls the consensus thread so
+# the stall watchdog can be exercised without a crash/restart cycle
+_hooks: dict = {}
 
 
 def env_index() -> int:
@@ -23,10 +27,30 @@ def env_index() -> int:
     return int(v) if v is not None else -1
 
 
+def set_hook(name: str, fn) -> None:
+    """Run `fn()` whenever fail_point(name) is hit (in-process fault
+    injection: delays, drops, state capture)."""
+    with _lock:
+        _hooks[name] = fn
+
+
+def clear_hook(name: str = "") -> None:
+    """Remove one hook, or all of them when name is empty."""
+    with _lock:
+        if name:
+            _hooks.pop(name, None)
+        else:
+            _hooks.clear()
+
+
 def fail_point(name: str = "") -> None:
     """Crash the process if this is the FAIL_TEST_INDEX'th fail point hit
-    (reference fail.Fail: libs/fail/fail.go:34-43)."""
+    (reference fail.Fail: libs/fail/fail.go:34-43); programmatic hooks
+    run first (set_hook)."""
     global _counter
+    hook = _hooks.get(name)
+    if hook is not None:
+        hook()
     idx = env_index()
     if idx < 0:
         return
@@ -45,3 +69,4 @@ def reset() -> None:
     with _lock:
         _counter = 0
         _names.clear()
+        _hooks.clear()

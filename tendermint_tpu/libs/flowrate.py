@@ -26,6 +26,7 @@ class Monitor:
         self._acc = 0  # bytes in the current sample
         self._sample_start = self.start
         self._rate = 0.0  # EWMA bytes/sec
+        self._peak = 0.0  # highest single-sample rate seen
         self.samples = 0
         # token-bucket origin for limit(); kept separate from the stats
         # epoch `start` so credit-forfeiture can't corrupt avg_rate()
@@ -50,6 +51,8 @@ class Monitor:
                 self._rate = sample_rate
             else:
                 self._rate += self._weight * (sample_rate - self._rate)
+            if sample_rate > self._peak:
+                self._peak = sample_rate
             self.samples += 1
             self._acc = 0
             self._sample_start += self.sample_period
@@ -97,6 +100,8 @@ class Monitor:
             return {
                 "bytes": self.total,
                 "duration": elapsed,
+                "samples": self.samples,
                 "cur_rate": self._rate,
                 "avg_rate": self.total / elapsed if elapsed > 0 else 0.0,
+                "peak_rate": self._peak,
             }

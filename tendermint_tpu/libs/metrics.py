@@ -13,12 +13,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+def _esc_label(v) -> str:
+    """Prometheus label-value escaping: backslash, quote, and newline —
+    a newline smuggled into a label value must not break the line-based
+    exposition format."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
     if not names:
         return ""
     inner = ",".join(
-        f'{n}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92)+chr(34))}"'
-        for n, v in zip(names, values)
+        f'{n}="{_esc_label(v)}"' for n, v in zip(names, values)
     )
     return "{" + inner + "}"
 
@@ -35,6 +42,40 @@ class _Metric:
             raise ValueError(
                 f"{self.name}: expected {len(self.label_names)} labels")
         return _Child(self, tuple(str(v) for v in values))
+
+    def _label_selector(self, by_name: Dict[str, str]):
+        """Predicate over stored label-value tuples matching every given
+        name=value pair, or None if this family lacks one of the names
+        (then nothing can match and callers skip the scan)."""
+        if not by_name:
+            return None
+        try:
+            keys = [(self.label_names.index(n), str(v))
+                    for n, v in by_name.items()]
+        except ValueError:
+            return None
+        return lambda values: all(values[i] == v for i, v in keys)
+
+    def _series_maps(self) -> Sequence[Dict]:
+        """The per-labelset storage dicts to prune (subclass-specific)."""
+        raise NotImplementedError
+
+    def remove_labels(self, **by_name) -> int:
+        """Drop every series whose labels match all name=value pairs;
+        returns the number of series removed. Families without one of
+        the names are untouched — so a registry-wide prune by peer_id
+        is safe to broadcast. This is the churn valve: without it a
+        labeled family keeps series for disconnected peers forever."""
+        sel = self._label_selector(by_name)
+        if sel is None:
+            return 0
+        with self._lock:
+            maps = self._series_maps()
+            doomed = {k for k in maps[0] if sel(k)}
+            for m in maps:
+                for k in doomed:
+                    m.pop(k, None)
+        return len(doomed)
 
     def render(self) -> List[str]:
         raise NotImplementedError
@@ -65,6 +106,9 @@ class Counter(_Metric):
     def _inc(self, labels: Tuple[str, ...], amount: float = 1.0) -> None:
         with self._lock:
             self._vals[labels] = self._vals.get(labels, 0.0) + amount
+
+    def _series_maps(self):
+        return (self._vals,)
 
     def render(self) -> List[str]:
         with self._lock:
@@ -103,6 +147,9 @@ class Gauge(_Metric):
     def _add(self, labels: Tuple[str, ...], amount: float) -> None:
         with self._lock:
             self._vals[labels] = self._vals.get(labels, 0.0) + amount
+
+    def _series_maps(self):
+        return (self._vals,)
 
     def render(self) -> List[str]:
         with self._lock:
@@ -145,6 +192,9 @@ class Histogram(_Metric):
             self._sums[labels] = self._sums.get(labels, 0.0) + value
             self._totals[labels] = self._totals.get(labels, 0) + 1
 
+    def _series_maps(self):
+        return (self._totals, self._counts, self._sums)
+
     def render(self) -> List[str]:
         with self._lock:
             items = sorted(self._totals.items())
@@ -185,6 +235,15 @@ class Registry:
     def histogram(self, name, help_="", label_names=(),
                   buckets=DEFAULT_BUCKETS) -> Histogram:
         return self.register(Histogram(name, help_, label_names, buckets))
+
+    def remove_labels(self, **by_name) -> int:
+        """Prune matching series from EVERY registered family (families
+        lacking one of the label names are untouched); returns the total
+        series removed. Called on peer disconnect so peer-labeled
+        cardinality tracks the live peer set, not its history."""
+        with self._lock:
+            metrics = list(self._metrics)
+        return sum(m.remove_labels(**by_name) for m in metrics)
 
     def render(self) -> str:
         with self._lock:

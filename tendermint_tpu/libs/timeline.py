@@ -1,0 +1,192 @@
+"""Per-height block-lifecycle timeline (no reference equivalent).
+
+The span tracer (libs/tracing.py) answers "what is this thread doing";
+this module answers "where did height N spend its time, and who fed us
+the pieces". The consensus machine drops explicit wall-clock marks —
+proposal received, first/last prevote, +2/3 prevote, first precommit,
++2/3 precommit, commit, WAL fsync, applyBlock — into one bounded
+per-height record, each mark carrying the peer that delivered the
+triggering message (empty peer_id = ourselves). Vote marks additionally
+record, per validator index, which peer delivered that validator's vote
+first — the gossip-attribution data Handel-style analyses need.
+
+Like the tracer there is one process-global recorder (`get_timeline()`),
+disabled until a Node enables it from `[instrumentation]
+timeline_heights`; disabled marks are one attribute load + compare.
+Records are exported as JSON at `/debug/timeline?height=N` on the
+ProfServer, stitched with the tracer spans tagged with the same height.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_HEIGHTS = 64
+
+# canonical phase order, for readers of the exported record; marks land
+# first-wins except the last_* phases, which track the newest occurrence
+PHASES = (
+    "new_height",
+    "proposal_received",
+    "first_prevote",
+    "last_prevote",
+    "prevote_23",
+    "first_precommit",
+    "last_precommit",
+    "precommit_23",
+    "commit",
+    "wal_fsync",
+    "apply_block",
+)
+
+# the marks every committed height must carry (used by tests and the
+# acceptance gate; last_precommit may trail in after commit via late
+# precommits, so it is not required)
+COMMITTED_PHASES = (
+    "proposal_received",
+    "first_prevote",
+    "last_prevote",
+    "prevote_23",
+    "first_precommit",
+    "precommit_23",
+    "commit",
+    "wal_fsync",
+    "apply_block",
+)
+
+
+class _HeightRecord:
+    __slots__ = ("height", "marks", "votes", "max_round")
+
+    def __init__(self, height: int):
+        self.height = height
+        # phase -> {"t": wall_s, "peer_id": str|None, ...extras}
+        self.marks: Dict[str, dict] = {}
+        # kind ("prevote"/"precommit") -> validator_index -> first-seen
+        self.votes: Dict[str, Dict[int, dict]] = {}
+        self.max_round = 0
+
+
+class Timeline:
+    """Bounded per-height lifecycle recorder; one per process."""
+
+    def __init__(self, capacity: int = DEFAULT_HEIGHTS,
+                 enabled: bool = False):
+        self._lock = threading.Lock()
+        self._capacity = max(1, capacity)
+        self._heights: "collections.OrderedDict[int, _HeightRecord]" = (
+            collections.OrderedDict())
+        self._enabled = enabled
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._lock:
+            if capacity is not None and capacity > 0:
+                self._capacity = capacity
+                while len(self._heights) > self._capacity:
+                    self._heights.popitem(last=False)
+            self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._heights.clear()
+
+    # -- recording -----------------------------------------------------
+
+    def _rec_locked(self, height: int) -> _HeightRecord:
+        rec = self._heights.get(height)
+        if rec is None:
+            rec = _HeightRecord(height)
+            self._heights[height] = rec
+            while len(self._heights) > self._capacity:
+                self._heights.popitem(last=False)
+        return rec
+
+    def mark(self, height: int, phase: str, peer_id: str = "",
+             update: bool = False, round_: int = 0, **extra) -> None:
+        """Drop one wall-clock mark. First occurrence wins unless
+        `update` (used by the last_* phases)."""
+        if not self._enabled or height <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            rec = self._rec_locked(height)
+            if round_ > rec.max_round:
+                rec.max_round = round_
+            if update or phase not in rec.marks:
+                m = {"t": now, "peer_id": peer_id}
+                if extra:
+                    m.update(extra)
+                rec.marks[phase] = m
+
+    def mark_vote(self, height: int, kind: str, validator_index: int,
+                  peer_id: str = "", round_: int = 0) -> None:
+        """One added vote: sets first_<kind> (first wins), last_<kind>
+        (always), and the per-validator first-delivery attribution."""
+        if not self._enabled or height <= 0:
+            return
+        now = time.time()
+        with self._lock:
+            rec = self._rec_locked(height)
+            if round_ > rec.max_round:
+                rec.max_round = round_
+            m = {"t": now, "peer_id": peer_id,
+                 "validator_index": validator_index}
+            rec.marks.setdefault(f"first_{kind}", m)
+            rec.marks[f"last_{kind}"] = m
+            by_val = rec.votes.setdefault(kind, {})
+            by_val.setdefault(validator_index,
+                              {"t": now, "peer_id": peer_id})
+
+    # -- export --------------------------------------------------------
+
+    def heights(self) -> List[int]:
+        with self._lock:
+            return list(self._heights)
+
+    def latest_height(self) -> int:
+        with self._lock:
+            return next(reversed(self._heights)) if self._heights else 0
+
+    def record(self, height: int) -> Optional[dict]:
+        """JSON-able lifecycle record for one height, or None."""
+        with self._lock:
+            rec = self._heights.get(height)
+            if rec is None:
+                return None
+            marks = {p: dict(m) for p, m in rec.marks.items()}
+            votes = {
+                kind: {str(i): dict(m) for i, m in by_val.items()}
+                for kind, by_val in rec.votes.items()
+            }
+            max_round = rec.max_round
+        ts = [m["t"] for m in marks.values()]
+        return {
+            "height": height,
+            "max_round": max_round,
+            "marks": marks,
+            "votes": votes,
+            "phases_present": [p for p in PHASES if p in marks],
+            "duration_s": round(max(ts) - min(ts), 6) if ts else 0.0,
+        }
+
+
+_GLOBAL = Timeline()
+
+
+def get_timeline() -> Timeline:
+    """The process-global timeline (disabled until a Node enables it)."""
+    return _GLOBAL

@@ -187,6 +187,25 @@ class Tracer:
     def chrome_trace_json(self) -> str:
         return json.dumps(self.chrome_trace(), separators=(",", ":"))
 
+    def spans_where(self, **match) -> List[dict]:
+        """Finished spans whose args carry every given key=value, as
+        JSON-able dicts with wall-clock µs timestamps. The timeline
+        endpoint uses this to stitch a height's tracer spans into its
+        lifecycle record (spans are tagged height=N at the call sites)."""
+        out = []
+        for rec in self.events():
+            if rec.args and all(
+                    rec.args.get(k) == v for k, v in match.items()):
+                out.append({
+                    "name": rec.name,
+                    "cat": rec.cat,
+                    "ts_us": self._ts_us(rec.start_ns),
+                    "dur_us": rec.dur_ns / 1e3,
+                    "thread": rec.thread_name,
+                    "args": dict(rec.args),
+                })
+        return out
+
 
 _GLOBAL = Tracer()
 

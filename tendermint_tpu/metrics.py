@@ -44,6 +44,11 @@ class ConsensusMetrics:
     total_txs: object = NOP
     committed_height: object = NOP
     step_duration: object = NOP
+    # stall watchdog (consensus/state.py StallWatchdog): wall seconds the
+    # machine has dwelt in the current (height, round), refreshed each
+    # watchdog tick, and stalls past the threshold labeled by diagnosis
+    round_dwell: object = NOP
+    stalls: object = NOP
 
 
 @dataclass
@@ -76,11 +81,45 @@ class CryptoMetrics:
 
 @dataclass
 class P2PMetrics:
-    """p2p/metrics.go:12-28"""
+    """p2p/metrics.go:12-28, grown per-peer/per-channel: byte counters
+    are labeled (peer_id, chID), received messages additionally by
+    decoded msg_type, and gauges track each peer's flow rates, pending
+    send queue, and consensus height lag. Every peer-labeled family is
+    pruned on disconnect (prune_peer_series) so churn can't leak series."""
 
     peers: object = NOP
-    peer_receive_bytes_total: object = NOP
-    peer_send_bytes_total: object = NOP
+    peer_receive_bytes_total: object = NOP  # (peer_id, chID)
+    peer_send_bytes_total: object = NOP  # (peer_id, chID)
+    peer_msg_recv_total: object = NOP  # (peer_id, chID, msg_type)
+    peer_send_rate: object = NOP  # (peer_id) flowrate EWMA, bytes/s
+    peer_recv_rate: object = NOP  # (peer_id)
+    peer_pending_send: object = NOP  # (peer_id) msgs queued across chans
+    peer_lag_blocks: object = NOP  # (peer_id) our height - peer height
+
+
+# the P2PMetrics families carrying a peer_id label; prune_peer_series
+# walks exactly these on peer removal
+_P2P_PEER_LABELED = (
+    "peer_receive_bytes_total",
+    "peer_send_bytes_total",
+    "peer_msg_recv_total",
+    "peer_send_rate",
+    "peer_recv_rate",
+    "peer_pending_send",
+    "peer_lag_blocks",
+)
+
+
+def prune_peer_series(p2p: P2PMetrics, peer_id: str) -> int:
+    """Drop every series labeled with a disconnected peer's id; returns
+    the number removed (0 for nop metrics). Called from the switch's
+    peer-removal paths — without it labeled families keep series for
+    every peer that ever connected (unbounded cardinality under churn)."""
+    removed = 0
+    for fname in _P2P_PEER_LABELED:
+        m = getattr(p2p, fname, NOP)
+        removed += int(m.remove_labels(peer_id=peer_id) or 0)
+    return removed
 
 
 @dataclass
@@ -152,15 +191,43 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             ("step",),
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
                      0.5, 1, 5)),
+        round_dwell=r.gauge(
+            f"{ns}_consensus_round_dwell_seconds",
+            "Seconds spent in the current consensus (height, round)."),
+        stalls=r.counter(
+            f"{ns}_consensus_stalls_total",
+            "Rounds that dwelt past the stall threshold, by diagnosis.",
+            ("reason",)),
     )
     p2p = P2PMetrics(
         peers=r.gauge(f"{ns}_p2p_peers", "Number of connected peers."),
         peer_receive_bytes_total=r.counter(
             f"{ns}_p2p_peer_receive_bytes_total",
-            "Bytes received from peers.", ("peer_id",)),
+            "Bytes received from peers, per channel.",
+            ("peer_id", "chID")),
         peer_send_bytes_total=r.counter(
             f"{ns}_p2p_peer_send_bytes_total",
-            "Bytes sent to peers.", ("peer_id",)),
+            "Bytes sent to peers, per channel.", ("peer_id", "chID")),
+        peer_msg_recv_total=r.counter(
+            f"{ns}_p2p_peer_msg_recv_total",
+            "Messages received from peers, by channel and decoded type.",
+            ("peer_id", "chID", "msg_type")),
+        peer_send_rate=r.gauge(
+            f"{ns}_p2p_peer_send_rate_bytes",
+            "Current send rate to the peer (flowrate EWMA, bytes/s).",
+            ("peer_id",)),
+        peer_recv_rate=r.gauge(
+            f"{ns}_p2p_peer_recv_rate_bytes",
+            "Current receive rate from the peer (flowrate EWMA, bytes/s).",
+            ("peer_id",)),
+        peer_pending_send=r.gauge(
+            f"{ns}_p2p_peer_pending_send_msgs",
+            "Messages queued to the peer across all channels.",
+            ("peer_id",)),
+        peer_lag_blocks=r.gauge(
+            f"{ns}_p2p_peer_lag_blocks",
+            "Blocks the peer's consensus height trails ours.",
+            ("peer_id",)),
     )
     mem = MempoolMetrics(
         size=r.gauge(f"{ns}_mempool_size",

@@ -221,6 +221,12 @@ class Node:
             wal=wal,
             metrics=self.metrics.consensus,
         )
+        # per-height lifecycle timelines (libs/timeline.py): the recorder
+        # lives on the ConsensusState (per-node, not process-global);
+        # marks are a dict write per consensus event, so this defaults on
+        if config.instrumentation.timeline_heights > 0:
+            self.consensus_state.timeline.enable(
+                config.instrumentation.timeline_heights)
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, fast_sync=fast_sync
         )
@@ -337,6 +343,20 @@ class Node:
             )
             self.sw.add_reactor("PEX", self.pex_reactor)
 
+        # consensus stall watchdog (consensus/state.py StallWatchdog):
+        # publishes round dwell, trips on threshold with a diagnostic
+        # bundle at /debug/consensus, and carries the per-peer network
+        # telemetry refresh (flow rates, queue depth, height lag) on its
+        # tick so peer gauges update even between scrapes
+        from ..consensus.state import StallWatchdog
+
+        self.watchdog = StallWatchdog(
+            self.consensus_state,
+            threshold_s=config.instrumentation.stall_threshold_s,
+            switch=self.sw,
+        )
+        self.watchdog.on_tick.append(self._refresh_peer_telemetry)
+
         self._rpc_server = None
         self._grpc_server = None
         self._prof_server = None
@@ -379,6 +399,35 @@ class Node:
         ]
         if peers:
             self.sw.dial_peers_async(peers, persistent=True)
+        self.watchdog.start()
+
+    def _refresh_peer_telemetry(self) -> None:
+        """Per-peer network gauges, refreshed each watchdog tick: the
+        MConnection flowrate monitors (send/recv EWMA), pending send
+        queue depth, and consensus height lag from PeerState."""
+        m = self.metrics.p2p
+        our_height = self.consensus_state.rs.height
+        for p in self.sw.peers.list():
+            if not p.is_running():
+                # racing removal: writing now would re-create series the
+                # removal path just pruned
+                continue
+            try:
+                st = p.status()
+            except Exception:  # noqa: BLE001 - peer may be tearing down
+                continue
+            m.peer_send_rate.with_labels(p.id).set(
+                st["SendMonitor"]["CurRate"])
+            m.peer_recv_rate.with_labels(p.id).set(
+                st["RecvMonitor"]["CurRate"])
+            m.peer_pending_send.with_labels(p.id).set(
+                sum(ch["SendQueueSize"] for ch in st["Channels"]))
+            ps = p.get("consensus_peer_state")
+            if ps is not None:
+                peer_h = ps.get_height()
+                if peer_h > 0:
+                    m.peer_lag_blocks.with_labels(p.id).set(
+                        max(0, our_height - peer_h))
 
     def _start_rpc(self) -> None:
         from ..rpc.core import RPCEnvironment
@@ -438,12 +487,19 @@ class Node:
         self._verify_warmup_thread = t
 
     def _start_prof(self) -> None:
-        """pprof-equivalent profile endpoint (reference node/node.go:468-474)."""
+        """pprof-equivalent profile endpoint (reference node/node.go:468-474)
+        plus the node-scoped debug routes: /debug/consensus (stall
+        watchdog bundle) rides here next to /debug/trace and
+        /debug/timeline."""
         from ..rpc.prof import ProfServer
 
         addr = _split_addr(self.config.base.prof_laddr)
         host, _, port = addr.rpartition(":")
-        self._prof_server = ProfServer(host or "127.0.0.1", int(port))
+        self._prof_server = ProfServer(
+            host or "127.0.0.1", int(port),
+            timeline=self.consensus_state.timeline,
+            providers={"/debug/consensus": lambda q: self.watchdog.status()},
+        )
         self._prof_server.start()
 
     @property
@@ -454,6 +510,7 @@ class Node:
         if not self._running:
             return
         self._running = False
+        self.watchdog.stop()
         for srv in (self._rpc_server, self._grpc_server, self._prof_server,
                     self._metrics_server):
             if srv is not None:

@@ -1,2 +1,2 @@
-from .connection import ChannelStatus, MConnConfig, MConnection  # noqa: F401
+from .connection import MConnConfig, MConnection  # noqa: F401
 from .secret_connection import SecretConnection  # noqa: F401

@@ -49,15 +49,6 @@ class MConnConfig:
     recv_message_capacity: int = 22020096  # 21MB
 
 
-@dataclass
-class ChannelStatus:
-    id: int
-    send_queue_size: int
-    send_queue_capacity: int
-    recently_sent: int
-    priority: int
-
-
 class _Channel:
     """connection.go:570-680: bounded send queue + packetizer +
     reassembly buffer, with a recently-sent counter for scheduling."""
@@ -297,19 +288,37 @@ class MConnection:
 
     # -- introspection -------------------------------------------------
 
-    def status(self) -> dict:
+    @staticmethod
+    def _monitor_status(mon: Monitor) -> dict:
+        """flowrate.Status field names (libs/flowrate/flowrate.go)."""
+        st = mon.status()
         return {
-            "send_monitor": self.send_monitor.status(),
-            "recv_monitor": self.recv_monitor.status(),
-            "last_pong": self._last_pong,
-            "channels": [
-                ChannelStatus(
-                    id=ch.desc.id,
-                    send_queue_size=ch.send_queue.qsize(),
-                    send_queue_capacity=ch.send_queue.maxsize,
-                    recently_sent=ch.recently_sent,
-                    priority=ch.desc.priority,
-                ).__dict__
+            "Duration": st["duration"],
+            "Bytes": st["bytes"],
+            "Samples": st["samples"],
+            "InstRate": st["cur_rate"],
+            "CurRate": st["cur_rate"],
+            "AvgRate": st["avg_rate"],
+            "PeakRate": st["peak_rate"],
+        }
+
+    def status(self) -> dict:
+        """p2p.ConnectionStatus shape (reference conn/connection.go
+        Status + p2p/peer.go Status): flowrate monitors for both
+        directions plus per-channel queue depths — the per-peer network
+        telemetry net_info and the node watchdog report from."""
+        return {
+            "Duration": time.monotonic() - self.send_monitor.start,
+            "SendMonitor": self._monitor_status(self.send_monitor),
+            "RecvMonitor": self._monitor_status(self.recv_monitor),
+            "Channels": [
+                {
+                    "ID": ch.desc.id,
+                    "SendQueueCapacity": ch.send_queue.maxsize,
+                    "SendQueueSize": ch.send_queue.qsize(),
+                    "Priority": ch.desc.priority,
+                    "RecentlySent": ch.recently_sent,
+                }
                 for ch in self.channels.values()
             ],
         }

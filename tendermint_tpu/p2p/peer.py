@@ -64,8 +64,8 @@ class Peer:
             return False
         ok = self.mconn.send(ch_id, msg_bytes)
         if ok:
-            self.metrics.peer_send_bytes_total.with_labels(self.id).inc(
-                len(msg_bytes))
+            self.metrics.peer_send_bytes_total.with_labels(
+                self.id, f"{ch_id:#04x}").inc(len(msg_bytes))
         return ok
 
     def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
@@ -73,8 +73,8 @@ class Peer:
             return False
         ok = self.mconn.try_send(ch_id, msg_bytes)
         if ok:
-            self.metrics.peer_send_bytes_total.with_labels(self.id).inc(
-                len(msg_bytes))
+            self.metrics.peer_send_bytes_total.with_labels(
+                self.id, f"{ch_id:#04x}").inc(len(msg_bytes))
         return ok
 
     def set(self, key: str, value) -> None:

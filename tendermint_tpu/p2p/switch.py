@@ -248,8 +248,11 @@ class Switch:
     # -- routing -------------------------------------------------------
 
     def _on_peer_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
-        self.metrics.peer_receive_bytes_total.with_labels(peer.id).inc(
-            len(msg_bytes))
+        # is_running gate: a message racing peer removal must not
+        # re-create series the removal path just pruned
+        if peer.is_running():
+            self.metrics.peer_receive_bytes_total.with_labels(
+                peer.id, f"{ch_id:#04x}").inc(len(msg_bytes))
         reactor = self._reactor_by_ch.get(ch_id)
         if reactor is None:
             self.stop_peer_for_error(peer, ValueError(f"msg on unknown channel {ch_id:#x}"))
@@ -274,6 +277,17 @@ class Switch:
 
     # -- peer removal --------------------------------------------------
 
+    def _prune_peer_metrics(self, peer: Peer) -> None:
+        """Metric-label hygiene: drop every series labeled with the
+        departing peer's id so churn can't grow cardinality unboundedly
+        (a reconnecting peer re-creates its series on first use)."""
+        from ..metrics import prune_peer_series
+
+        try:
+            prune_peer_series(self.metrics, peer.id)
+        except Exception:  # noqa: BLE001 - telemetry must never kill removal
+            LOG.exception("pruning metrics for %s failed", peer.id[:8])
+
     def _on_peer_error(self, peer: Peer, err: Exception) -> None:
         self.stop_peer_for_error(peer, err)
 
@@ -284,7 +298,11 @@ class Switch:
             return
         self.metrics.peers.set(self.peers.size())
         LOG.info("stopping peer %s: %s", peer, reason)
+        # stop BEFORE pruning: the peer's recv thread and the telemetry
+        # tick gate their metric writes on peer.is_running(), so pruning
+        # after the flag drops can't race a re-created series
         peer.stop()
+        self._prune_peer_metrics(peer)
         if self.trust is not None:
             self.trust.get_metric(peer.id).bad_events(1)
             self.trust.peer_disconnected(peer.id)
@@ -304,7 +322,8 @@ class Switch:
         if not self.peers.remove(peer):
             return
         self.metrics.peers.set(self.peers.size())
-        peer.stop()
+        peer.stop()  # before pruning — see stop_peer_for_error
+        self._prune_peer_metrics(peer)
         if self.trust is not None:
             self.trust.peer_disconnected(peer.id)
         for reactor in self.reactors.values():

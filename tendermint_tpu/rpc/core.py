@@ -170,9 +170,15 @@ def status(env: RPCEnvironment, params: dict) -> dict:
 
 
 def net_info(env: RPCEnvironment, params: dict) -> dict:
-    """rpc/core/net.go NetInfo"""
+    """rpc/core/net.go NetInfo; each peer carries its live
+    p2p.ConnectionStatus (flowrate monitors + per-channel queue depths,
+    reference rpc/core/types/responses.go Peer.ConnectionStatus)."""
     peers = []
     for p in env.p2p_switch.peers.list():
+        try:
+            conn_status = p.status()
+        except Exception:  # noqa: BLE001 - peer may be tearing down
+            conn_status = None
         peers.append({
             "node_info": {
                 "id": p.node_info.id,
@@ -181,6 +187,7 @@ def net_info(env: RPCEnvironment, params: dict) -> dict:
                 "moniker": p.node_info.moniker,
             },
             "is_outbound": p.outbound,
+            "connection_status": conn_status,
             "remote_ip": p.socket_addr,
         })
     return {

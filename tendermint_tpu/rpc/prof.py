@@ -8,30 +8,46 @@ Serves:
 - /debug/pprof/profile?seconds=N  statistical CPU profile via cProfile
 - /debug/trace[?clear=1]   chrome://tracing JSON of the span ring buffer
                            (libs/tracing.py; no reference equivalent)
+- /debug/timeline?height=N block-lifecycle record for one height
+                           (libs/timeline.py marks stitched with the
+                           tracer spans tagged height=N)
+- plus any `providers` routes the node mounts (e.g. /debug/consensus,
+  the stall watchdog's diagnostic bundle)
 """
 
 from __future__ import annotations
 
 import cProfile
 import io
+import json
 import pstats
 import sys
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 from urllib.parse import parse_qsl, urlparse
 
+from ..libs import timeline as timeline_mod
 from ..libs import tracing
 
 
 class ProfServer:
     def __init__(self, host: str, port: int,
-                 tracer: Optional[tracing.Tracer] = None):
+                 tracer: Optional[tracing.Tracer] = None,
+                 timeline: Optional[timeline_mod.Timeline] = None,
+                 providers: Optional[Dict[str, Callable]] = None):
+        """`timeline` is the node's per-instance lifecycle recorder
+        (falls back to the process-global one for standalone servers);
+        `providers` maps a path (e.g. "/debug/consensus") to a
+        callable(query_params: dict) -> JSON-able object."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         # the handler reaches the tracer through the server instance
         self._httpd.tracer = tracer if tracer is not None else tracing.get_tracer()
+        self._httpd.timeline = (timeline if timeline is not None
+                                else timeline_mod.get_timeline())
+        self._httpd.providers = dict(providers or {})
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -107,7 +123,10 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         path = parsed.path.rstrip("/")
         if path in ("", "/debug/pprof"):
-            self._text("profiles: goroutine heap profile trace\n")
+            extra = "".join(f" {p.rsplit('/', 1)[-1]}"
+                            for p in sorted(self.server.providers))
+            self._text(
+                f"profiles: goroutine heap profile trace timeline{extra}\n")
         elif path == "/debug/pprof/goroutine":
             self._text(_thread_dump())
         elif path == "/debug/pprof/heap":
@@ -129,5 +148,42 @@ class _Handler(BaseHTTPRequestHandler):
             if dict(parse_qsl(parsed.query)).get("clear"):
                 tracer.clear()
             self._text(body, content_type="application/json")
+        elif path == "/debug/timeline":
+            self._serve_timeline(dict(parse_qsl(parsed.query)))
+        elif path in self.server.providers:
+            q = dict(parse_qsl(parsed.query))
+            try:
+                obj = self.server.providers[path](q)
+            except Exception as e:  # noqa: BLE001 - surface, don't kill
+                self._json({"error": str(e)}, status=500)
+                return
+            self._json(obj)
         else:
             self._text("not found", status=404)
+
+    def _json(self, obj, status: int = 200) -> None:
+        self._text(json.dumps(obj, separators=(",", ":"), default=str),
+                   status=status, content_type="application/json")
+
+    def _serve_timeline(self, q: dict) -> None:
+        """One height's lifecycle record, stitched with the tracer spans
+        tagged with that height."""
+        tl: timeline_mod.Timeline = self.server.timeline
+        try:
+            height = int(q.get("height", 0))
+        except ValueError:
+            self._json({"error": f"bad height {q.get('height')!r}"},
+                       status=400)
+            return
+        if height <= 0:
+            height = tl.latest_height()
+        rec = tl.record(height)
+        if rec is None:
+            self._json(
+                {"error": f"no timeline for height {height}",
+                 "heights": tl.heights()},
+                status=404)
+            return
+        tracer: tracing.Tracer = self.server.tracer
+        rec["spans"] = tracer.spans_where(height=height)
+        self._json(rec)

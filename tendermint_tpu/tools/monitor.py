@@ -9,14 +9,22 @@ other). Websockets auto-reconnect across node restarts
 (rpc.client.ReconnectingWSClient), so a bounced node shows a dip in
 uptime, not a dead monitor. Library-first (Monitor class) with a small
 curses-free CLI printer.
+
+With `debug_addrs` (CLI: --debug-endpoints), the monitor additionally
+scrapes each node's /debug/consensus watchdog endpoint (rpc/prof.py)
+and surfaces round dwell, stall alerts and per-peer block lag in
+snapshot()/health() — a stalled or lagging validator drops network
+health to "moderate" even while every node still answers /status.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import math
 import threading
 import time
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -88,6 +96,29 @@ class NodeStatus:
     _online_since: Optional[float] = None
     _online_accum: float = 0.0
     block_meter: EventMeter = field(default_factory=EventMeter)
+    # consensus watchdog view (from /debug/consensus when a debug addr
+    # is configured): current round dwell, trip count, captured stall
+    # bundles and the worst per-peer height lag the node reports
+    round_dwell_s: float = 0.0
+    stall_threshold_s: float = 0.0
+    stalls_total: int = 0
+    stall_alerts: List[dict] = field(default_factory=list)
+    max_peer_lag: int = 0
+
+    @property
+    def stalled(self) -> bool:
+        """The node's current round has dwelt past its own threshold."""
+        return (self.stall_threshold_s > 0
+                and self.round_dwell_s >= self.stall_threshold_s)
+
+    def clear_debug_view(self) -> None:
+        """Forget the watchdog-derived state when the debug endpoint
+        stops answering — stale stalled/lag flags must not pin health()
+        at moderate after the network (or the endpoint) recovers."""
+        self.round_dwell_s = 0.0
+        self.stall_threshold_s = 0.0
+        self.stall_alerts = []
+        self.max_peer_lag = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -126,10 +157,19 @@ class Monitor:
     """monitor/monitor.go: poll status + subscribe to NewBlock with
     auto-reconnecting websockets."""
 
-    def __init__(self, addrs: List[str], poll_interval: float = 1.0):
+    def __init__(self, addrs: List[str], poll_interval: float = 1.0,
+                 debug_addrs: Optional[List[str]] = None):
+        """`debug_addrs` pairs index-wise with `addrs`: each entry is
+        that node's ProfServer host:port (prof_laddr), scraped for
+        /debug/consensus every poll; None/"" entries are skipped."""
         self.nodes: Dict[str, NodeStatus] = {
             a: NodeStatus(addr=a) for a in addrs
         }
+        self.debug_addrs: Dict[str, str] = {}
+        if debug_addrs:
+            for a, d in zip(addrs, debug_addrs):
+                if d:
+                    self.debug_addrs[a] = d
         self.poll_interval = poll_interval
         self._ws: Dict[str, ReconnectingWSClient] = {}
         self._stop = threading.Event()
@@ -184,7 +224,27 @@ class Monitor:
                 ns.ws_reconnects = ws.reconnects
             except Exception:  # noqa: BLE001 - node down: mark + retry
                 ns.mark_offline()
+            daddr = self.debug_addrs.get(addr)
+            if daddr:
+                try:
+                    self._poll_debug(ns, daddr)
+                except Exception:  # noqa: BLE001 - debug scrape optional
+                    ns.clear_debug_view()
             self._stop.wait(self.poll_interval)
+
+    def _poll_debug(self, ns: NodeStatus, daddr: str) -> None:
+        """Scrape one node's /debug/consensus watchdog endpoint into its
+        NodeStatus (dwell, stall bundles, worst peer lag)."""
+        with urllib.request.urlopen(
+                f"http://{daddr}/debug/consensus", timeout=2.0) as r:
+            data = json.load(r)
+        ns.round_dwell_s = float(data.get("dwell_s", 0.0))
+        ns.stall_threshold_s = float(data.get("threshold_s", 0.0))
+        ns.stalls_total = int(data.get("stalls_total", 0))
+        ns.stall_alerts = list(data.get("stalls", []))[-3:]
+        peers = (data.get("live") or {}).get("peers", [])
+        ns.max_peer_lag = max(
+            (int(p.get("lag_blocks", 0)) for p in peers), default=0)
 
     def _on_block(self, addr: str, ev: dict) -> None:
         ns = self.nodes[addr]
@@ -208,7 +268,14 @@ class Monitor:
         if not online:
             return HEALTH_DEAD
         heights = [n.height for n in online]
-        if len(online) == len(statuses) and max(heights) - min(heights) <= 1:
+        if (len(online) == len(statuses)
+                and max(heights) - min(heights) <= 1
+                # watchdog view: a node whose round has dwelt past its
+                # stall threshold, or that reports a peer trailing by
+                # more than one block, is not "full" health even though
+                # every /status still answers
+                and not any(n.stalled for n in online)
+                and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
 
@@ -220,11 +287,21 @@ class Monitor:
                 if n.avg_block_interval_s > 0]
         return sum(vals) / len(vals) if vals else 0.0
 
+    def stall_alerts(self) -> List[dict]:
+        """Every stall bundle the watched nodes currently report,
+        tagged with the reporting node's address."""
+        alerts = []
+        for n in self.nodes.values():
+            for b in n.stall_alerts:
+                alerts.append({"addr": n.addr, **b})
+        return alerts
+
     def snapshot(self) -> dict:
         return {
             "health": self.health(),
             "height": self.network_height(),
             "avg_block_time_s": round(self.avg_block_time_s(), 2),
+            "stall_alerts": self.stall_alerts(),
             "nodes": [
                 {
                     "addr": n.addr,
@@ -236,6 +313,10 @@ class Monitor:
                     "blocks_per_s": round(n.block_meter.rate_1m, 3),
                     "uptime_pct": round(n.uptime_pct, 1),
                     "ws_reconnects": n.ws_reconnects,
+                    "round_dwell_s": round(n.round_dwell_s, 2),
+                    "stalled": n.stalled,
+                    "stalls_total": n.stalls_total,
+                    "max_peer_lag": n.max_peer_lag,
                 }
                 for n in self.nodes.values()
             ],
@@ -249,8 +330,14 @@ def main(argv=None) -> int:
                    help="comma-separated host:port RPC endpoints")
     p.add_argument("-i", "--interval", type=float, default=2.0,
                    help="print interval seconds")
+    p.add_argument("-d", "--debug-endpoints", default="",
+                   help="comma-separated host:port ProfServer endpoints "
+                        "(prof_laddr), index-paired with `endpoints`; "
+                        "enables /debug/consensus stall + peer-lag alerts")
     args = p.parse_args(argv)
-    mon = Monitor(args.endpoints.split(","))
+    debug = (args.debug_endpoints.split(",")
+             if args.debug_endpoints else None)
+    mon = Monitor(args.endpoints.split(","), debug_addrs=debug)
     mon.start()
     try:
         while True:
@@ -260,10 +347,20 @@ def main(argv=None) -> int:
                   f"avg_block_time={snap['avg_block_time_s']}s")
             for n in snap["nodes"]:
                 state = "UP" if n["online"] else "DOWN"
-                print(f"  {n['moniker'] or n['addr']:<20} {state:<5} "
-                      f"h={n['height']:<8} blocks={n['blocks_seen']:<6} "
-                      f"lat={n['block_latency_ms']}ms "
-                      f"up={n['uptime_pct']}% rc={n['ws_reconnects']}")
+                line = (f"  {n['moniker'] or n['addr']:<20} {state:<5} "
+                        f"h={n['height']:<8} blocks={n['blocks_seen']:<6} "
+                        f"lat={n['block_latency_ms']}ms "
+                        f"up={n['uptime_pct']}% rc={n['ws_reconnects']}")
+                if debug:
+                    line += (f" dwell={n['round_dwell_s']}s"
+                             f" lag={n['max_peer_lag']}"
+                             f" stalls={n['stalls_total']}")
+                    if n["stalled"]:
+                        line += " [STALLED]"
+                print(line)
+            for a in snap["stall_alerts"]:
+                print(f"  ALERT {a['addr']}: stall h={a.get('round_state', {}).get('height')} "
+                      f"reason={a.get('reason')} dwell={a.get('dwell_s')}s")
     except KeyboardInterrupt:
         mon.stop()
     return 0
