@@ -45,10 +45,11 @@ VOTES_MODE = "votes" in sys.argv[1:]  # BASELINE.json config 3
 FASTSYNC_MODE = "fastsync" in sys.argv[1:]  # BASELINE.json config 4 (scaled)
 COMMIT4_MODE = "commit4" in sys.argv[1:]  # BASELINE.json config 1
 CACHE_MODE = "cache" in sys.argv[1:]  # duplicate-heavy sig-cache mode
+STATESYNC_MODE = "statesync" in sys.argv[1:]  # restore vs replay (PR 4)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
-                      "--pipeline")]
+                      "statesync", "--pipeline")]
 try:
     METRIC_N = int(_args[0]) if _args else 10000
 except ValueError:
@@ -75,6 +76,9 @@ FS_PIPE_METRIC = f"fastsync_pipeline_{FS_NBLOCKS}x{FS_NVAL}val_wall_ms"
 COMMIT4_METRIC = "verify_commit_4val_wall_ms"
 CACHE_NVAL, CACHE_DUPS = 500, 3
 CACHE_METRIC = f"sig_cache_{CACHE_DUPS}x{CACHE_NVAL}dup_wall_ms"
+SS_NBLOCKS = _env_int("TM_TPU_BENCH_SS_BLOCKS", 20)
+SS_NVAL = _env_int("TM_TPU_BENCH_SS_NVAL", 100)
+SS_METRIC = f"statesync_restore_vs_replay_{SS_NBLOCKS}x{SS_NVAL}val_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -495,6 +499,126 @@ def fastsync_main(degraded):
     _emit(out, degraded)
 
 
+def statesync_main(degraded):
+    """`bench.py statesync` — bootstrap-cost comparison: restoring a
+    fresh node from a chunked snapshot at height N (light-verify the
+    anchor via DynamicVerifier — a handful of batched verify_commits —
+    then hash-check + apply chunks) vs replaying blocks 1..N (one
+    verify_commit per block plus tx re-execution). This is the whole
+    point of the subsystem: replay cost grows linearly in chain height,
+    restore cost doesn't."""
+    from tendermint_tpu.abci import types as abci
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.lite import (
+        DynamicVerifier,
+        FullCommit,
+        MemProvider,
+        SignedHeader,
+    )
+    from tendermint_tpu.statesync import chunker
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader
+    from tendermint_tpu.types.block import Header
+
+    chain = "bench-statesync"
+    nval, nblocks = SS_NVAL, SS_NBLOCKS
+    txs_per_block = 10
+    chunk_size = 4096
+    vs, sorted_sks = _build_valset(nval, b"ss")
+
+    # the sig cache would let the restore path ride verifications the
+    # replay path already paid for — disable it for a fair comparison
+    crypto_batch.set_sig_cache(None)
+
+    def _header(h):
+        return Header(
+            chain_id=chain, height=h,
+            time=1_700_000_000_000_000_000 + h,
+            num_txs=txs_per_block, total_txs=txs_per_block * h,
+            last_commit_hash=b"\x02" * 32,
+            data_hash=merkle.hash_from_byte_slices([]),
+            validators_hash=vs.hash(), next_validators_hash=vs.hash(),
+            consensus_hash=b"\x03" * 32, app_hash=b"",
+            last_results_hash=b"", evidence_hash=b"",
+            proposer_address=vs.validators[0].address,
+        )
+
+    # synthetic chain: header+commit per height, same valset throughout
+    commits, source = [], MemProvider()
+    for h in range(1, nblocks + 1):
+        hdr = _header(h)
+        bid = BlockID(hdr.hash(), PartSetHeader(1, b"\x0c" * 20))
+        commit = _build_commit(chain, vs, sorted_sks, h, bid)
+        commits.append((h, bid, commit))
+        source.save_full_commit(FullCommit(
+            signed_header=SignedHeader(header=hdr, commit=commit),
+            validators=vs, next_validators=vs))
+
+    block_txs = [[b"k%d-%d=v" % (h, i) for i in range(txs_per_block)]
+                 for h in range(1, nblocks + 1)]
+
+    # producer app at height N, snapshotted
+    producer = KVStoreApplication()
+    producer.snapshot_interval = nblocks
+    producer.snapshot_chunk_size = chunk_size
+    for txs in block_txs:
+        for tx in txs:
+            producer.deliver_tx(tx)
+        producer.commit()
+    snap = producer.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+
+    def replay_run():
+        app = KVStoreApplication()
+        for (h, bid, commit), txs in zip(commits, block_txs):
+            vs.verify_commit(chain, bid, h, commit)  # fast-sync's check
+            for tx in txs:
+                app.deliver_tx(tx)
+            app.commit()
+        return app
+
+    def restore_run():
+        verifier = DynamicVerifier(chain, MemProvider(), source)
+        verifier.init_trust(source.latest_full_commit(chain, 1))
+        # the real restore light-verifies headers H and H+1 (the anchor
+        # pair); each is one batched verify_commit
+        for h in (nblocks - 1, nblocks):
+            verifier.verify(
+                source.latest_full_commit(chain, h).signed_header)
+        app = KVStoreApplication()
+        res = app.offer_snapshot(abci.RequestOfferSnapshot(
+            snapshot=snap, app_hash=producer.app_hash))
+        assert res.result == abci.OFFER_ACCEPT
+        for i in range(snap.chunks):
+            data = producer.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=snap.height, format=snap.format, chunk=i)).chunk
+            assert chunker.verify_chunk(data, i, snap.chunk_hashes)
+            r = app.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+                index=i, chunk=data, sender="bench"))
+            assert r.result == abci.APPLY_ACCEPT
+        return app
+
+    # warm (compiles, key tables), then sanity: both paths land on the
+    # producer's app hash
+    assert replay_run().app_hash == producer.app_hash
+    assert restore_run().app_hash == producer.app_hash
+
+    reps = 2 if degraded else 3
+    replay_ms = _best_of(replay_run, reps)
+    restore_ms = _best_of(restore_run, reps)
+
+    _emit({
+        "metric": SS_METRIC,
+        "value": round(restore_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(replay_ms / restore_ms, 2),
+        "replay_ms": round(replay_ms, 3),
+        "chunks": snap.chunks,
+        "note": "baseline = fast-sync replay of the same height range",
+    }, degraded)
+
+
 def _build_valset(nval: int, seed: bytes):
     """(validator_set, secret keys aligned to address-sorted order) —
     fixture shared by the commit4 and fastsync modes."""
@@ -582,6 +706,8 @@ def main():
         return fastsync_main(degraded)
     if CACHE_MODE:
         return cache_main(degraded)
+    if STATESYNC_MODE:
+        return statesync_main(degraded)
 
     from tendermint_tpu.crypto import keys
     from tendermint_tpu.crypto.jaxed25519.verify import (

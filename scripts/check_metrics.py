@@ -210,6 +210,15 @@ REQUIRED_FAMILIES = (
     "p2p_peer_send_bytes_total",
     "p2p_peer_msg_recv_total",
     "p2p_peer_lag_blocks",
+    # PR-4 state sync (declaration presence: a node that never produces
+    # or restores snapshots legitimately records no samples)
+    "statesync_snapshots",
+    "statesync_snapshot_height",
+    "statesync_chunks_served_total",
+    "statesync_chunks_received_total",
+    "statesync_chunks_rejected_total",
+    "statesync_restore_chunks_applied",
+    "statesync_restore_phase_seconds",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

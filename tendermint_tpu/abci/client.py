@@ -61,6 +61,26 @@ class Client:
     def commit(self) -> abci.ResponseCommit:
         raise NotImplementedError
 
+    def list_snapshots(
+        self, req: abci.RequestListSnapshots
+    ) -> abci.ResponseListSnapshots:
+        raise NotImplementedError
+
+    def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        raise NotImplementedError
+
+    def offer_snapshot(
+        self, req: abci.RequestOfferSnapshot
+    ) -> abci.ResponseOfferSnapshot:
+        raise NotImplementedError
+
+    def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -112,6 +132,22 @@ class LocalClient(Client):
     def commit(self):
         with self._lock:
             return self.app.commit()
+
+    def list_snapshots(self, req):
+        with self._lock:
+            return self.app.list_snapshots(req)
+
+    def load_snapshot_chunk(self, req):
+        with self._lock:
+            return self.app.load_snapshot_chunk(req)
+
+    def offer_snapshot(self, req):
+        with self._lock:
+            return self.app.offer_snapshot(req)
+
+    def apply_snapshot_chunk(self, req):
+        with self._lock:
+            return self.app.apply_snapshot_chunk(req)
 
 
 class SocketClient(Client):
@@ -183,6 +219,30 @@ class SocketClient(Client):
 
     def commit(self):
         return RESPONSE_CODECS["commit"].decode(self._call("commit", None))
+
+    def list_snapshots(self, req):
+        return RESPONSE_CODECS["list_snapshots"].decode(
+            self._call("list_snapshots",
+                       REQUEST_CODECS["list_snapshots"].encode(req))
+        )
+
+    def load_snapshot_chunk(self, req):
+        return RESPONSE_CODECS["load_snapshot_chunk"].decode(
+            self._call("load_snapshot_chunk",
+                       REQUEST_CODECS["load_snapshot_chunk"].encode(req))
+        )
+
+    def offer_snapshot(self, req):
+        return RESPONSE_CODECS["offer_snapshot"].decode(
+            self._call("offer_snapshot",
+                       REQUEST_CODECS["offer_snapshot"].encode(req))
+        )
+
+    def apply_snapshot_chunk(self, req):
+        return RESPONSE_CODECS["apply_snapshot_chunk"].decode(
+            self._call("apply_snapshot_chunk",
+                       REQUEST_CODECS["apply_snapshot_chunk"].encode(req))
+        )
 
     def close(self):
         try:

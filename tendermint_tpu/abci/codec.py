@@ -79,6 +79,22 @@ def _header_from(o):
     return serde.header_from(o)
 
 
+def _snapshot_obj(s):
+    if s is None:
+        return None
+    return [s.height, s.format, s.chunks, s.hash, list(s.chunk_hashes),
+            s.metadata]
+
+
+def _snapshot_from(o):
+    if o is None:
+        return None
+    return abci.Snapshot(
+        height=o[0], format=o[1], chunks=o[2], hash=o[3],
+        chunk_hashes=[bytes(h) for h in o[4]], metadata=o[5],
+    )
+
+
 @dataclass
 class Codec:
     encode: Callable
@@ -136,6 +152,23 @@ REQUEST_CODECS = {
         ),
     ),
     "end_block": Codec(lambda r: [r.height], lambda o: abci.RequestEndBlock(height=o[0])),
+    "list_snapshots": Codec(
+        lambda r: [], lambda o: abci.RequestListSnapshots()),
+    "load_snapshot_chunk": Codec(
+        lambda r: [r.height, r.format, r.chunk],
+        lambda o: abci.RequestLoadSnapshotChunk(
+            height=o[0], format=o[1], chunk=o[2]),
+    ),
+    "offer_snapshot": Codec(
+        lambda r: [_snapshot_obj(r.snapshot), r.app_hash],
+        lambda o: abci.RequestOfferSnapshot(
+            snapshot=_snapshot_from(o[0]), app_hash=o[1]),
+    ),
+    "apply_snapshot_chunk": Codec(
+        lambda r: [r.index, r.chunk, r.sender],
+        lambda o: abci.RequestApplySnapshotChunk(
+            index=o[0], chunk=o[1], sender=o[2]),
+    ),
 }
 
 RESPONSE_CODECS = {
@@ -186,4 +219,23 @@ RESPONSE_CODECS = {
         ),
     ),
     "commit": Codec(lambda r: [r.data], lambda o: abci.ResponseCommit(data=o[0])),
+    "list_snapshots": Codec(
+        lambda r: [[_snapshot_obj(s) for s in r.snapshots]],
+        lambda o: abci.ResponseListSnapshots(
+            snapshots=[_snapshot_from(s) for s in o[0]]),
+    ),
+    "load_snapshot_chunk": Codec(
+        lambda r: [r.chunk],
+        lambda o: abci.ResponseLoadSnapshotChunk(chunk=o[0]),
+    ),
+    "offer_snapshot": Codec(
+        lambda r: [r.result],
+        lambda o: abci.ResponseOfferSnapshot(result=o[0]),
+    ),
+    "apply_snapshot_chunk": Codec(
+        lambda r: [r.result, list(r.refetch_chunks), list(r.reject_senders)],
+        lambda o: abci.ResponseApplySnapshotChunk(
+            result=o[0], refetch_chunks=list(o[1]),
+            reject_senders=list(o[2])),
+    ),
 }

@@ -4,6 +4,16 @@
 Txs are "key=value" (or raw bytes stored under themselves). State is a
 merkle-ized kv map; commit returns the app hash. The persistent variant
 survives restarts and accepts validator-update txs "val:pubkeyhex!power".
+
+State sync: with `snapshot_interval` set (directly or via ABCI
+SetOption "snapshot_interval"), commit() captures a full-state snapshot
+every interval heights — the whole DB (kv pairs + valset records)
+serialized deterministically, split into `snapshot_chunk_size` chunks
+whose SHA-256s are bound by a Merkle root (statesync/chunker.py). The
+last `snapshot_keep` snapshots are served via ListSnapshots/
+LoadSnapshotChunk; OfferSnapshot/ApplySnapshotChunk restore a fresh
+instance and cross-check the resulting app hash against the
+light-verified hash the node passes in the offer.
 """
 
 from __future__ import annotations
@@ -11,11 +21,15 @@ from __future__ import annotations
 import json
 import os
 import struct
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ...crypto import merkle
 from ...libs.db import DB, MemDB
+from ...statesync import chunker
+from ...types import serde
 from .. import types as abci
+
+SNAPSHOT_FORMAT = 1  # version of the serialized payload below
 
 
 class KVStoreApplication(abci.Application):
@@ -24,6 +38,18 @@ class KVStoreApplication(abci.Application):
         self.size = 0
         self.height = 0
         self.app_hash = b""
+        # state-sync knobs (SetOption-tunable; 0 = no snapshots).
+        # snapshot_keep must comfortably cover a restorer's
+        # discover->fetch window in block-intervals, or the snapshot it
+        # chose is evicted mid-download on a fast chain
+        self.snapshot_interval = 0
+        self.snapshot_chunk_size = 65536
+        self.snapshot_keep = 4
+        # (height, format) -> (abci.Snapshot, [chunk bytes]) of the
+        # snapshots this app can serve, newest-last
+        self._snapshots: Dict[Tuple[int, int], Tuple[abci.Snapshot, List[bytes]]] = {}
+        # in-flight restore: offered snapshot + expected hash + chunks
+        self._restore: Optional[dict] = None
         self._load_state()
 
     def _load_state(self):
@@ -65,14 +91,144 @@ class KVStoreApplication(abci.Application):
     def check_tx(self, tx: bytes):
         return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
 
-    def commit(self):
-        self.height += 1
-        # app hash: merkle root over sorted kv pairs + size (cheap, deterministic)
+    def _compute_app_hash(self) -> bytes:
+        # app hash: merkle root over sorted kv pairs + size (cheap,
+        # deterministic) — also recomputed after a snapshot restore
         items = [k + b"\x00" + v for k, v in self.db.iterator(b"kv:", b"kv;")]
         root = merkle.hash_from_byte_slices(items)
-        self.app_hash = root + struct.pack(">Q", self.size)
+        return root + struct.pack(">Q", self.size)
+
+    def commit(self):
+        self.height += 1
+        self.app_hash = self._compute_app_hash()
         self._save_state()
+        if self.snapshot_interval and self.height % self.snapshot_interval == 0:
+            self._take_snapshot()
         return abci.ResponseCommit(data=self.app_hash)
+
+    def set_option(self, req):
+        """SetOption carries the node's [statesync] producer knobs so
+        in-proc and out-of-process apps configure the same way."""
+        if req.key in ("snapshot_interval", "snapshot_chunk_size",
+                       "snapshot_keep"):
+            try:
+                value = int(req.value)
+            except ValueError:
+                return abci.ResponseSetOption(
+                    code=1, log=f"bad int for {req.key}: {req.value!r}")
+            if value < 0:
+                return abci.ResponseSetOption(
+                    code=1, log=f"{req.key} must be >= 0")
+            if req.key == "snapshot_interval":
+                self.snapshot_interval = value
+            elif req.key == "snapshot_keep":
+                self.snapshot_keep = max(1, value)
+            else:
+                self.snapshot_chunk_size = max(1, value)
+            return abci.ResponseSetOption(code=0)
+        return abci.ResponseSetOption()
+
+    # --- state-sync snapshot surface ---------------------------------
+
+    def _serialize_state(self) -> bytes:
+        """Deterministic full-DB payload (every key except the
+        __state__ bookkeeping record, which is rebuilt on restore)."""
+        items = [[k, v] for k, v in self.db.iterator(None, None)
+                 if k != b"__state__"]
+        return serde.pack([self.height, self.size, self.app_hash, items])
+
+    def _take_snapshot(self) -> None:
+        payload = self._serialize_state()
+        chunks = chunker.chunk_bytes(payload, self.snapshot_chunk_size)
+        hashes = chunker.chunk_hashes(chunks)
+        snap = abci.Snapshot(
+            height=self.height,
+            format=SNAPSHOT_FORMAT,
+            chunks=len(chunks),
+            hash=chunker.root_of(hashes),
+            chunk_hashes=hashes,
+        )
+        self._snapshots[(self.height, SNAPSHOT_FORMAT)] = (snap, chunks)
+        while len(self._snapshots) > max(1, self.snapshot_keep):
+            oldest = min(self._snapshots)
+            del self._snapshots[oldest]
+
+    def list_snapshots(self, req):
+        snaps = [s for s, _ in sorted(self._snapshots.values(),
+                                      key=lambda sc: sc[0].height)]
+        return abci.ResponseListSnapshots(snapshots=snaps)
+
+    def load_snapshot_chunk(self, req):
+        entry = self._snapshots.get((req.height, req.format))
+        if entry is None or not (0 <= req.chunk < len(entry[1])):
+            return abci.ResponseLoadSnapshotChunk()
+        return abci.ResponseLoadSnapshotChunk(chunk=entry[1][req.chunk])
+
+    def offer_snapshot(self, req):
+        s = req.snapshot
+        if s is None or s.chunks <= 0 or s.chunks != len(s.chunk_hashes):
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_REJECT)
+        if s.format != SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_REJECT_FORMAT)
+        if not chunker.verify_hashes(s.chunk_hashes, s.hash):
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_REJECT)
+        self._restore = {
+            "snapshot": s,
+            "app_hash": req.app_hash,
+            "chunks": [None] * s.chunks,
+        }
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_ACCEPT)
+
+    def apply_snapshot_chunk(self, req):
+        r = self._restore
+        if r is None:
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_ABORT)
+        s: abci.Snapshot = r["snapshot"]
+        if not chunker.verify_chunk(req.chunk, req.index, s.chunk_hashes):
+            # bad or out-of-range chunk: ask for a refetch and name the
+            # sender so the node can ban it
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_RETRY,
+                refetch_chunks=[req.index],
+                reject_senders=[req.sender] if req.sender else [],
+            )
+        r["chunks"][req.index] = req.chunk
+        if any(c is None for c in r["chunks"]):
+            return abci.ResponseApplySnapshotChunk(result=abci.APPLY_ACCEPT)
+        # final chunk: install the full state
+        try:
+            height, size, app_hash, items = serde.unpack(
+                chunker.reassemble(r["chunks"]))
+            items = [(bytes(k), bytes(v)) for k, v in items]
+        except Exception:  # noqa: BLE001 - hostile payload must not raise
+            self._restore = None
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_REJECT_SNAPSHOT)
+        expected = r["app_hash"]
+        self._restore = None
+        # validate EVERYTHING against the payload before touching the
+        # DB: a rejected snapshot must leave the current state intact
+        # (the node's fallback path replays from whatever state the app
+        # still holds — wiping first would strand it unrecoverable).
+        # The app hash doesn't cover the height, so a payload lying
+        # about its height is checked explicitly.
+        kv_items = sorted(k + b"\x00" + v for k, v in items
+                          if k.startswith(b"kv:"))
+        computed = (merkle.hash_from_byte_slices(kv_items)
+                    + struct.pack(">Q", size))
+        if (height != s.height
+                or computed != bytes(app_hash)
+                or (expected and computed != expected)):
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_REJECT_SNAPSHOT)
+        for k, _ in list(self.db.iterator(None, None)):
+            self.db.delete(k)
+        for k, v in items:
+            self.db.set(k, v)
+        self.height, self.size = height, size
+        self.app_hash = computed
+        self._save_state()
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_ACCEPT)
 
     def query(self, req):
         if req.path == "/store" or req.path == "":

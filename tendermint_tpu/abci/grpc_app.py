@@ -30,6 +30,8 @@ SERVICE = "types.ABCIApplication"
 _METHODS = (
     "Echo", "Flush", "Info", "SetOption", "DeliverTx", "CheckTx", "Query",
     "Commit", "InitChain", "BeginBlock", "EndBlock",
+    "ListSnapshots", "LoadSnapshotChunk", "OfferSnapshot",
+    "ApplySnapshotChunk",
 )
 
 
@@ -136,6 +138,30 @@ class GRPCApplicationServer:
             return RESPONSE_CODECS["end_block"].encode(
                 self.app.end_block(REQUEST_CODECS["end_block"].decode(request[0])))
 
+    def _listsnapshots(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["list_snapshots"].encode(
+                self.app.list_snapshots(
+                    REQUEST_CODECS["list_snapshots"].decode(request[0])))
+
+    def _loadsnapshotchunk(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["load_snapshot_chunk"].encode(
+                self.app.load_snapshot_chunk(
+                    REQUEST_CODECS["load_snapshot_chunk"].decode(request[0])))
+
+    def _offersnapshot(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["offer_snapshot"].encode(
+                self.app.offer_snapshot(
+                    REQUEST_CODECS["offer_snapshot"].decode(request[0])))
+
+    def _applysnapshotchunk(self, request, context):
+        with self._lock:
+            return RESPONSE_CODECS["apply_snapshot_chunk"].encode(
+                self.app.apply_snapshot_chunk(
+                    REQUEST_CODECS["apply_snapshot_chunk"].decode(request[0])))
+
 
 class GRPCClient(Client):
     """ABCI client over gRPC (grpc_client.go). One channel; unary calls
@@ -204,6 +230,26 @@ class GRPCClient(Client):
 
     def commit(self):
         return RESPONSE_CODECS["commit"].decode(self._call("Commit", None))
+
+    def list_snapshots(self, req):
+        return RESPONSE_CODECS["list_snapshots"].decode(
+            self._call("ListSnapshots",
+                       REQUEST_CODECS["list_snapshots"].encode(req)))
+
+    def load_snapshot_chunk(self, req):
+        return RESPONSE_CODECS["load_snapshot_chunk"].decode(
+            self._call("LoadSnapshotChunk",
+                       REQUEST_CODECS["load_snapshot_chunk"].encode(req)))
+
+    def offer_snapshot(self, req):
+        return RESPONSE_CODECS["offer_snapshot"].decode(
+            self._call("OfferSnapshot",
+                       REQUEST_CODECS["offer_snapshot"].encode(req)))
+
+    def apply_snapshot_chunk(self, req):
+        return RESPONSE_CODECS["apply_snapshot_chunk"].decode(
+            self._call("ApplySnapshotChunk",
+                       REQUEST_CODECS["apply_snapshot_chunk"].encode(req)))
 
     def close(self):
         try:

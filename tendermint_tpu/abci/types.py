@@ -4,7 +4,12 @@ Request/response types as dataclasses (replacing the generated protobuf
 types.pb.go); the wire codec for socket/grpc connections is msgpack-framed
 (see abci/server.py, abci/client.py). Method set is the v0.27 surface:
 Echo/Flush/Info/SetOption/Query + CheckTx + InitChain/BeginBlock/DeliverTx/
-EndBlock/Commit.
+EndBlock/Commit — plus the state-sync snapshot surface (ListSnapshots/
+LoadSnapshotChunk/OfferSnapshot/ApplySnapshotChunk) that upstream only
+grew in v0.34, with one deviation: our Snapshot carries the per-chunk
+SHA-256 list alongside the Merkle root so the NODE can verify chunks at
+the p2p boundary (and ban the sending peer) instead of waiting for the
+app's apply verdict.
 """
 
 from __future__ import annotations
@@ -177,6 +182,84 @@ class ResponseEndBlock:
 
 
 @dataclass
+class Snapshot:
+    """One application snapshot (reference abci/types.proto Snapshot,
+    v0.34+). `hash` is the Merkle root over `chunk_hashes`
+    (statesync/chunker.py); `metadata` stays app-opaque."""
+
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    chunk_hashes: List[bytes] = field(default_factory=list)
+    metadata: bytes = b""
+
+
+@dataclass
+class RequestListSnapshots:
+    pass
+
+
+@dataclass
+class ResponseListSnapshots:
+    snapshots: List[Snapshot] = field(default_factory=list)
+
+
+@dataclass
+class RequestLoadSnapshotChunk:
+    height: int = 0
+    format: int = 0
+    chunk: int = 0  # chunk index
+
+
+@dataclass
+class ResponseLoadSnapshotChunk:
+    chunk: bytes = b""
+
+
+# ResponseOfferSnapshot.result (reference abci/types.proto Result enum)
+OFFER_UNKNOWN = 0
+OFFER_ACCEPT = 1
+OFFER_ABORT = 2
+OFFER_REJECT = 3
+OFFER_REJECT_FORMAT = 4
+
+
+@dataclass
+class RequestOfferSnapshot:
+    snapshot: Optional[Snapshot] = None
+    # light-verified app hash the restored state must land on
+    app_hash: bytes = b""
+
+
+@dataclass
+class ResponseOfferSnapshot:
+    result: int = OFFER_UNKNOWN
+
+
+# ResponseApplySnapshotChunk.result
+APPLY_UNKNOWN = 0
+APPLY_ACCEPT = 1
+APPLY_ABORT = 2
+APPLY_RETRY = 3
+APPLY_REJECT_SNAPSHOT = 5
+
+
+@dataclass
+class RequestApplySnapshotChunk:
+    index: int = 0
+    chunk: bytes = b""
+    sender: str = ""  # p2p id of the peer that supplied the chunk
+
+
+@dataclass
+class ResponseApplySnapshotChunk:
+    result: int = APPLY_UNKNOWN
+    refetch_chunks: List[int] = field(default_factory=list)
+    reject_senders: List[str] = field(default_factory=list)
+
+
+@dataclass
 class ResponseCommit:
     data: bytes = b""  # app hash
 
@@ -211,6 +294,25 @@ class Application:
 
     def commit(self) -> ResponseCommit:
         return ResponseCommit()
+
+    # --- state-sync snapshot surface (no-op defaults: an app that
+    # doesn't implement snapshots serves none and rejects offers) -----
+
+    def list_snapshots(self, req: RequestListSnapshots) -> ResponseListSnapshots:
+        return ResponseListSnapshots()
+
+    def load_snapshot_chunk(
+        self, req: RequestLoadSnapshotChunk
+    ) -> ResponseLoadSnapshotChunk:
+        return ResponseLoadSnapshotChunk()
+
+    def offer_snapshot(self, req: RequestOfferSnapshot) -> ResponseOfferSnapshot:
+        return ResponseOfferSnapshot(result=OFFER_REJECT)
+
+    def apply_snapshot_chunk(
+        self, req: RequestApplySnapshotChunk
+    ) -> ResponseApplySnapshotChunk:
+        return ResponseApplySnapshotChunk(result=APPLY_ABORT)
 
 
 BaseApplication = Application

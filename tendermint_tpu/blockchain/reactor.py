@@ -98,11 +98,38 @@ class BlockchainReactor(Reactor):
 
     def start(self) -> None:
         if self.fast_sync:
-            self.pool.start()
-            self._pool_thread = threading.Thread(
-                target=self._pool_routine, name="bc-pool", daemon=True
-            )
-            self._pool_thread.start()
+            self._start_pool()
+
+    def _start_pool(self) -> None:
+        self.pool.start()
+        self._pool_thread = threading.Thread(
+            target=self._pool_routine, name="bc-pool", daemon=True
+        )
+        self._pool_thread.start()
+
+    def resume_fast_sync(self, state) -> None:
+        """State-sync hand-off: the restore path installed `state` at
+        the snapshot height and seeded the block store, so fast sync
+        now covers only the residual tail. Rebuilds the pool at the
+        store's (post-seed) height and starts the sync routine — the
+        reactor must have been constructed with fast_sync=False so the
+        original start() was a no-op."""
+        from .pool import BlockPool
+
+        if self.fast_sync:
+            return  # already syncing
+        self.state = state
+        self.initial_state = state
+        self.fast_sync = True
+        self.pool = BlockPool(
+            start_height=self.store.height() + 1,
+            request_fn=self._send_block_request,
+            error_fn=self._on_peer_error,
+        )
+        self._start_pool()
+        # peers connected before the hand-off never saw our status
+        # request routed to the (dead) pool; re-ask immediately
+        self._broadcast_status_request()
 
     def stop(self) -> None:
         self._stop.set()

@@ -6,7 +6,14 @@ Reference parity: blockchain/store.go. Layout:
   C:<height>        -> commit FOR block at height (from block height+1's
                        LastCommit)
   SC:<height>       -> "seen commit" (the local +2/3 precommits)
-  blockStore        -> json {"height": N}
+  blockStore        -> json {"height": N, "base": B}
+
+`base` is the lowest height with a full block still on disk (0 when the
+store is empty). It moves up via prune(retain_height) — long-running
+producers drop history they no longer serve — and is set past `height`
+by seed_anchor(), the state-sync bootstrap that installs only the
+anchor commit at H so fast sync can resume at H+1 without blocks 1..H
+ever existing locally.
 """
 
 from __future__ import annotations
@@ -53,11 +60,30 @@ class BlockStore:
         self._db = db
         self._lock = threading.RLock()
         raw = db.get(_STORE_KEY)
-        self._height = json.loads(raw)["height"] if raw else 0
+        if raw:
+            o = json.loads(raw)
+            self._height = o["height"]
+            # stores written before base-tracking hold full history
+            self._base = o.get("base", 1 if self._height > 0 else 0)
+        else:
+            self._height = 0
+            self._base = 0
 
     def height(self) -> int:
         with self._lock:
             return self._height
+
+    def base(self) -> int:
+        """Lowest height with a full block available (0 = empty store;
+        reference blockchain/store.go Base, v0.33+)."""
+        with self._lock:
+            return self._base
+
+    def _persist_meta_locked(self) -> None:
+        self._db.set_sync(
+            _STORE_KEY,
+            json.dumps({"height": self._height, "base": self._base}).encode(),
+        )
 
     # --- save ---------------------------------------------------------------
 
@@ -85,7 +111,59 @@ class BlockStore:
                 )
             self._db.set(_seen_commit_key(height), serde.encode_commit(seen_commit))
             self._height = height
-            self._db.set_sync(_STORE_KEY, json.dumps({"height": height}).encode())
+            if self._base == 0:
+                self._base = height
+            self._persist_meta_locked()
+
+    def seed_anchor(self, height: int, commit: Commit) -> None:
+        """State-sync bootstrap (no reference equivalent; upstream v0.34
+        statesync stores only the seen commit too): record the
+        light-verified commit FOR `height` in an EMPTY store and move
+        height there, with base = height+1 — no block bytes exist below
+        it. Fast sync then resumes at height+1 and consensus can
+        reconstruct LastCommit from the seen commit."""
+        if commit is None:
+            raise ValueError("cannot seed anchor with nil commit")
+        with self._lock:
+            if self._height != 0:
+                raise ValueError(
+                    f"cannot seed anchor at {height}: store already at "
+                    f"height {self._height}")
+            self._db.set(_seen_commit_key(height), serde.encode_commit(commit))
+            self._db.set(_commit_key(height), serde.encode_commit(commit))
+            self._height = height
+            self._base = height + 1
+            self._persist_meta_locked()
+
+    def prune(self, retain_height: int) -> int:
+        """Drop all blocks below `retain_height` (reference
+        blockchain/store.go PruneBlocks, v0.33+): metas, parts and
+        commits for heights [base, retain_height) are deleted and base
+        moves up. Returns the number of blocks pruned. The commit FOR
+        retain_height-1 (C:) is kept — block retain_height's LastCommit
+        validation and RPC /commit still need it."""
+        with self._lock:
+            if retain_height <= 0:
+                raise ValueError(f"retain height must be positive, got {retain_height}")
+            if retain_height > self._height + 1:
+                raise ValueError(
+                    f"cannot retain beyond store height+1 "
+                    f"({retain_height} > {self._height + 1})")
+            pruned = 0
+            for h in range(max(self._base, 1), retain_height):
+                meta = self.load_block_meta(h)
+                if meta is not None:
+                    for i in range(meta.block_id.parts_header.total):
+                        self._db.delete(_part_key(h, i))
+                    self._db.delete(_meta_key(h))
+                    pruned += 1
+                self._db.delete(_seen_commit_key(h))
+                if h < retain_height - 1:
+                    self._db.delete(_commit_key(h))
+            if retain_height > self._base:
+                self._base = retain_height
+                self._persist_meta_locked()
+            return pruned
 
     # --- load ---------------------------------------------------------------
 

@@ -153,6 +153,38 @@ class CryptoConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """[statesync] — snapshot production + light-verified bootstrap
+    (ours; upstream only grew state sync in v0.34).
+
+    enable: bootstrap a FRESH node (state at genesis) from a peer
+    snapshot instead of replaying from height 1; falls back to fast
+    sync when no usable snapshot is offered. snapshot_interval: take an
+    app snapshot every N heights (0 = don't produce; pushed to the app
+    via ABCI SetOption). chunk_size: snapshot chunk bytes.
+    trust_height/trust_hash: optional operator pin — the header at
+    trust_height must hash to trust_hash (hex); when unset, trust roots
+    at the LOCAL genesis validator set over the height-1 commit.
+    discovery_time_s: how long to keep collecting peer offers once the
+    first one lands (more peers offering = parallel chunk sources).
+    restore_timeout_s: overall restore budget before falling back.
+    chunk_send_rate: serve-side flowrate ceiling, bytes/s."""
+
+    enable: bool = False
+    snapshot_interval: int = 0
+    chunk_size: int = 65536
+    # snapshots the app retains; must cover a restorer's discover->fetch
+    # window in block-intervals or the chosen snapshot is evicted
+    # mid-download on a fast chain
+    snapshot_keep: int = 4
+    trust_height: int = 0
+    trust_hash: str = ""
+    discovery_time_s: float = 5.0
+    restore_timeout_s: float = 60.0
+    chunk_send_rate: int = 5120000
+
+
+@dataclass
 class TxIndexConfig:
     """reference config/config.go:723-760"""
 
@@ -192,6 +224,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -227,6 +260,7 @@ class Config:
             emit("mempool", self.mempool),
             emit("consensus", self.consensus),
             emit("crypto", self.crypto),
+            emit("statesync", self.statesync),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
         ]
@@ -247,6 +281,7 @@ class Config:
             "mempool": cfg.mempool,
             "consensus": cfg.consensus,
             "crypto": cfg.crypto,
+            "statesync": cfg.statesync,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,
         }

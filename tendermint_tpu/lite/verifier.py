@@ -69,7 +69,15 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
         bv.add(precommit.sign_bytes(chain_id), precommit.signature,
                val.pub_key.bytes())
         entries.append((precommit, val))
-    mask = bv.verify()
+    # one batched dispatch for the whole commit — through the process
+    # BatchVerifier (sig cache + vectorized backend); with async
+    # dispatch on, it rides the dedicated dispatch thread like every
+    # other pipelined call site (state-sync bisection issues several of
+    # these back-to-back, so cached duplicate precommits are free)
+    if batch.async_enabled():
+        mask = bv.verify_async().result()
+    else:
+        mask = bv.verify()
     tallied = 0
     for ok, (precommit, val) in zip(mask, entries):
         if not ok:

@@ -123,6 +123,26 @@ def prune_peer_series(p2p: P2PMetrics, peer_id: str) -> int:
 
 
 @dataclass
+class StateSyncMetrics:
+    """State-sync telemetry (statesync/ — no reference equivalent):
+    producer-side snapshot inventory + chunk serving, restore-side
+    chunk intake and per-phase durations."""
+
+    # local snapshots currently advertisable / newest snapshot height
+    snapshots: object = NOP
+    snapshot_height: object = NOP
+    # chunk flow: served to peers / received and verified / rejected
+    # (reason=hash_mismatch|timeout)
+    chunks_served: object = NOP
+    chunks_received: object = NOP
+    chunks_rejected: object = NOP
+    # restore progress + per-phase wall time
+    # (phase=discover|verify|fetch|apply|finalize)
+    restore_chunks_applied: object = NOP
+    restore_phase_seconds: object = NOP
+
+
+@dataclass
 class MempoolMetrics:
     """mempool/metrics.go:12-25"""
 
@@ -146,6 +166,7 @@ class NodeMetrics:
     mempool: MempoolMetrics = field(default_factory=MempoolMetrics)
     state: StateMetrics = field(default_factory=StateMetrics)
     crypto: CryptoMetrics = field(default_factory=CryptoMetrics)
+    statesync: StateSyncMetrics = field(default_factory=StateSyncMetrics)
     registry: Optional[Registry] = None
 
 
@@ -288,5 +309,31 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
                      0.1, 0.25, 1)),
     )
+    statesync = StateSyncMetrics(
+        snapshots=r.gauge(
+            f"{ns}_statesync_snapshots",
+            "Local snapshots available to serve."),
+        snapshot_height=r.gauge(
+            f"{ns}_statesync_snapshot_height",
+            "Height of the newest local snapshot."),
+        chunks_served=r.counter(
+            f"{ns}_statesync_chunks_served_total",
+            "Snapshot chunks served to peers."),
+        chunks_received=r.counter(
+            f"{ns}_statesync_chunks_received_total",
+            "Snapshot chunks received and hash-verified during restore."),
+        chunks_rejected=r.counter(
+            f"{ns}_statesync_chunks_rejected_total",
+            "Snapshot chunk requests that failed, by reason.",
+            ("reason",)),
+        restore_chunks_applied=r.gauge(
+            f"{ns}_statesync_restore_chunks_applied",
+            "Chunks applied through ABCI in the current restore."),
+        restore_phase_seconds=r.histogram(
+            f"{ns}_statesync_restore_phase_seconds",
+            "Wall time of each state-sync restore phase.",
+            ("phase",),
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 300)),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, mempool=mem, state=state,
-                       crypto=crypto, registry=r)
+                       crypto=crypto, statesync=statesync, registry=r)

@@ -51,9 +51,11 @@ from ..types.event_bus import EventBus
 
 LOG = logging.getLogger("node")
 
-# p2p channel ids advertised in NodeInfo (reference node/node.go:795-800);
-# the PEX channel 0x00 is appended only when PEX is enabled
-NODE_CHANNELS = bytes([0x40, 0x20, 0x21, 0x22, 0x23, 0x30, 0x38])
+# p2p channel ids advertised in NodeInfo (reference node/node.go:795-800,
+# + our state-sync channels 0x60/0x61); the PEX channel 0x00 is appended
+# only when PEX is enabled
+NODE_CHANNELS = bytes([0x40, 0x20, 0x21, 0x22, 0x23, 0x30, 0x38,
+                       0x60, 0x61])
 
 
 def db_provider(name: str, backend: str, db_dir: str) -> DB:
@@ -172,6 +174,13 @@ class Node:
             if state.validators.has_address(addr):
                 fast_sync = False
 
+        # state-sync bootstrap: only a FRESH node (state still at
+        # genesis) restores from a snapshot; anyone else already has
+        # history and fast-syncs the difference
+        state_sync = (config.statesync.enable
+                      and state.last_block_height == 0
+                      and fast_sync)
+
         # --- mempool (node/node.go:255-271) --------------------------
         self.mempool = Mempool(
             config.mempool,
@@ -227,14 +236,17 @@ class Node:
         if config.instrumentation.timeline_heights > 0:
             self.consensus_state.timeline.enable(
                 config.instrumentation.timeline_heights)
+        # while state sync runs, consensus must stay parked (fast_sync
+        # mode) and the blockchain pool must NOT start at height 1 —
+        # resume_fast_sync re-arms it at the restored height
         self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, fast_sync=fast_sync
+            self.consensus_state, fast_sync=fast_sync or state_sync
         )
         self.blockchain_reactor = BlockchainReactor(
             state,
             self.block_exec,
             self.block_store,
-            fast_sync,
+            fast_sync and not state_sync,
             consensus_reactor=self.consensus_reactor,
         )
 
@@ -323,6 +335,33 @@ class Node:
         self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
         self.sw.add_reactor("EVIDENCE", self.evidence_reactor)
 
+        # --- state sync (statesync/; upstream v0.34 leapfrog) --------
+        # the snapshot reactor always serves (discovery + chunks);
+        # the StateSyncer restore pipeline only exists on a fresh node
+        # that opted in via [statesync] enable
+        from ..statesync.reactor import SnapshotReactor
+        from ..statesync.store import SnapshotStore
+
+        self.statesync_db = db_provider("statesync", backend, db_dir)
+        self.snapshot_store = SnapshotStore(
+            self.statesync_db, self.proxy_app.query,
+            metrics=self.metrics.statesync)
+        self.snapshot_reactor = SnapshotReactor(
+            self.snapshot_store, self.block_store, self.state_db,
+            chunk_send_rate=config.statesync.chunk_send_rate,
+            metrics=self.metrics.statesync)
+        self.sw.add_reactor("STATESYNC", self.snapshot_reactor)
+        self._boot_state = state
+        self.state_syncer = None
+        if state_sync:
+            from ..statesync.restore import StateSyncer
+
+            self.state_syncer = StateSyncer(
+                self.snapshot_reactor, genesis_doc, self.state_db,
+                self.block_store, self.proxy_app.query,
+                config.statesync, metrics=self.metrics.statesync,
+                on_complete=self._on_statesync_complete)
+
         # PEX reactor + address book (node/node.go:417-464)
         self.pex_reactor = None
         self.addr_book = None
@@ -400,6 +439,40 @@ class Node:
         if peers:
             self.sw.dial_peers_async(peers, persistent=True)
         self.watchdog.start()
+
+        # snapshot production: push the [statesync] producer knobs to
+        # the app over ABCI SetOption (works for in-proc and remote
+        # apps alike); the app snapshots at commit() on its own
+        if self.config.statesync.snapshot_interval > 0:
+            from ..abci.types import RequestSetOption
+
+            for key, value in (
+                ("snapshot_interval",
+                 self.config.statesync.snapshot_interval),
+                ("snapshot_chunk_size", self.config.statesync.chunk_size),
+                ("snapshot_keep", self.config.statesync.snapshot_keep),
+            ):
+                try:
+                    res = self.proxy_app.query.set_option(
+                        RequestSetOption(key=key, value=str(value)))
+                    if res.code != 0:
+                        LOG.warning("app refused %s=%s: %s",
+                                    key, value, res.log)
+                except Exception:  # noqa: BLE001 - optional capability
+                    LOG.warning("app does not accept %s; snapshots "
+                                "disabled app-side", key)
+        if self.state_syncer is not None:
+            self.state_syncer.start()
+
+    def _on_statesync_complete(self, state) -> None:
+        """Restore finished (state holds the snapshot-height State) or
+        gave up (None): either way fast sync takes over — from the
+        anchor height or, on fallback, from genesis."""
+        if state is None:
+            LOG.warning("state sync did not complete; fast-syncing the "
+                        "whole chain instead")
+            state = self._boot_state
+        self.blockchain_reactor.resume_fast_sync(state)
 
     def _refresh_peer_telemetry(self) -> None:
         """Per-peer network gauges, refreshed each watchdog tick: the
@@ -498,9 +571,21 @@ class Node:
         self._prof_server = ProfServer(
             host or "127.0.0.1", int(port),
             timeline=self.consensus_state.timeline,
-            providers={"/debug/consensus": lambda q: self.watchdog.status()},
+            providers={
+                "/debug/consensus": lambda q: self.watchdog.status(),
+                "/debug/statesync": lambda q: self._statesync_status(),
+            },
         )
         self._prof_server.start()
+
+    def _statesync_status(self) -> dict:
+        """The /debug/statesync bundle: serve-side snapshot inventory +
+        chunk counters, plus restore progress when this node is (or
+        was) bootstrapping."""
+        out = self.snapshot_reactor.status()
+        if self.state_syncer is not None:
+            out["restore"] = self.state_syncer.status()
+        return out
 
     @property
     def rpc_listen_addr(self) -> Optional[str]:
@@ -510,6 +595,8 @@ class Node:
         if not self._running:
             return
         self._running = False
+        if self.state_syncer is not None:
+            self.state_syncer.stop()
         self.watchdog.stop()
         for srv in (self._rpc_server, self._grpc_server, self._prof_server,
                     self._metrics_server):

@@ -155,6 +155,9 @@ def status(env: RPCEnvironment, params: dict) -> dict:
             "latest_app_hash": enc.hexu(latest_app_hash),
             "latest_block_height": str(latest_height),
             "latest_block_time": str(latest_time),
+            # lowest height with a full block on disk: > 1 on pruned or
+            # state-synced nodes (reference v0.34 earliest_* fields)
+            "earliest_block_height": str(env.block_store.base()),
             "catching_up": catching_up,
         },
         "validator_info": {

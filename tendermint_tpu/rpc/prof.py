@@ -11,8 +11,9 @@ Serves:
 - /debug/timeline?height=N block-lifecycle record for one height
                            (libs/timeline.py marks stitched with the
                            tracer spans tagged height=N)
-- plus any `providers` routes the node mounts (e.g. /debug/consensus,
-  the stall watchdog's diagnostic bundle)
+- plus any `providers` routes the node mounts: /debug/consensus (the
+  stall watchdog's diagnostic bundle) and /debug/statesync (snapshot
+  inventory, chunk counters, and live restore progress)
 """
 
 from __future__ import annotations

@@ -1,0 +1,44 @@
+"""State sync — bootstrap a fresh node from a peer snapshot instead of
+replaying the chain from genesis (upstream only grew this in v0.34).
+
+Modules:
+  chunker — fixed-size snapshot chunks bound by a Merkle root
+  store   — SnapshotStore: node-side registry over the app's ABCI
+            snapshot surface, metadata persisted in libs/db
+  reactor — SnapshotReactor: p2p discovery + chunk serving/fetching on
+            two dedicated channels, with flowrate-limited serving and
+            per-peer ban on bad chunks
+  restore — StateSyncer: the restore path — discover, light-verify the
+            anchor via lite.DynamicVerifier (all commit signatures
+            through crypto/batch.BatchVerifier), apply chunks, install
+            state.State, seed the block store, hand off to fast sync
+"""
+
+from .chunker import (  # noqa: F401
+    chunk_bytes,
+    chunk_hash,
+    chunk_hashes,
+    chunk_proof,
+    reassemble,
+    root_of,
+    verify_chunk,
+    verify_hashes,
+)
+
+
+def __getattr__(name):
+    # reactor/restore/store pull in p2p + lite + state; load lazily so
+    # `from ...statesync import chunker` (the kvstore app) stays cheap
+    if name in ("SnapshotStore",):
+        from .store import SnapshotStore
+
+        return SnapshotStore
+    if name in ("SnapshotReactor", "SNAPSHOT_CHANNEL", "CHUNK_CHANNEL"):
+        from . import reactor
+
+        return getattr(reactor, name)
+    if name in ("StateSyncer", "RestoreError"):
+        from . import restore
+
+        return getattr(restore, name)
+    raise AttributeError(name)

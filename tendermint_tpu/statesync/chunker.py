@@ -1,0 +1,71 @@
+"""Snapshot chunk codec — fixed-size chunks bound by a Merkle root.
+
+A snapshot's payload is split into `chunk_size` slices; the snapshot's
+`hash` is the Merkle root (crypto/merkle, RFC-6962 style) over the
+SHA-256 of each chunk. The chunk-hash LIST travels with the snapshot
+metadata, so a restorer validates it once against the root (O(chunks)
+hashing) and then checks each arriving chunk with a single SHA-256 —
+no per-chunk proof bytes on the wire. `chunk_proof` still produces a
+standalone merkle.SimpleProof for callers that want position-binding
+proofs (tests, external verifiers).
+
+Trust model: the root itself is only as good as the snapshot offer; the
+end-to-end authority is the light-verified app hash the restorer checks
+after applying every chunk (statesync/restore.py). The chunk hashes
+exist so ONE malicious peer in a multi-peer download is caught at the
+chunk boundary — and banned — instead of poisoning the whole restore.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+from ..crypto import merkle
+
+
+def chunk_bytes(data: bytes, chunk_size: int) -> List[bytes]:
+    """Split `data` into chunk_size slices (last one short). Empty data
+    is one empty chunk so every snapshot has at least one chunk to
+    carry — and one hash to verify."""
+    if chunk_size <= 0:
+        raise ValueError(f"chunk_size must be positive, got {chunk_size}")
+    if not data:
+        return [b""]
+    return [data[i:i + chunk_size] for i in range(0, len(data), chunk_size)]
+
+
+def chunk_hash(chunk: bytes) -> bytes:
+    return hashlib.sha256(chunk).digest()
+
+
+def chunk_hashes(chunks: Sequence[bytes]) -> List[bytes]:
+    return [chunk_hash(c) for c in chunks]
+
+
+def root_of(hashes: Sequence[bytes]) -> bytes:
+    """Merkle root over the chunk-hash leaves."""
+    return merkle.hash_from_byte_slices(list(hashes))
+
+
+def verify_hashes(hashes: Sequence[bytes], root: bytes) -> bool:
+    """The metadata-level check: does this chunk-hash list commit to
+    the advertised snapshot hash?"""
+    return bool(hashes) and root_of(hashes) == root
+
+
+def verify_chunk(chunk: bytes, index: int,
+                 hashes: Sequence[bytes]) -> bool:
+    """The per-chunk check against an already-root-verified hash list."""
+    return 0 <= index < len(hashes) and chunk_hash(chunk) == hashes[index]
+
+
+def chunk_proof(chunks: Sequence[bytes], index: int):
+    """(root, merkle.SimpleProof) binding chunk `index`'s hash to the
+    snapshot root — proof-carrying alternative to the hash-list path."""
+    root, proofs = merkle.proofs_from_byte_slices(chunk_hashes(chunks))
+    return root, proofs[index]
+
+
+def reassemble(chunks: Sequence[bytes]) -> bytes:
+    return b"".join(chunks)

@@ -1,0 +1,394 @@
+"""SnapshotReactor — state-sync p2p on two dedicated channels.
+
+Channel 0x60 (SNAPSHOT): discovery + trust data. A joining node
+broadcasts `snapshots_request`; producers answer with validated
+metadata. The restore path then pulls the anchor bundle (FullCommits at
+H and H+1 plus consensus params) and, during light-client bisection,
+arbitrary intermediate FullCommits (`commit_request`).
+
+Channel 0x61 (CHUNK): bulk transfer. Chunk serving is flowrate-limited
+(libs/flowrate.Monitor) so a restoring fleet can't starve the
+producer's consensus traffic; chunk REQUESTS fan out across every peer
+offering the snapshot (blockchain/pool.py's parallel-download shape,
+collapsed to per-index workers in restore.py), and a peer that serves
+a chunk whose SHA-256 doesn't match the root-verified hash list is
+banned (switch.stop_peer_for_error → trust score decay) and the chunk
+re-requested from another peer.
+
+Wire messages are serde-packed lists like every other reactor.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .. import state as sm
+from ..abci import types as abci
+from ..libs import flowrate
+from ..lite.types import FullCommit, SignedHeader
+from ..p2p.base_reactor import ChannelDescriptor, Reactor
+from ..types import serde
+
+LOG = logging.getLogger("statesync.reactor")
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+# a snapshot at H is only advertised once the producer holds the commit
+# for H+1 (stored when block H+2 saved): the restorer's anchor bundle
+# needs light-verifiable headers at BOTH H and H+1
+ANCHOR_LEAD = 2
+
+_KNOWN_MSG_KINDS = frozenset((
+    "snapshots_request", "snapshots_response",
+    "anchor_request", "anchor_response",
+    "commit_request", "commit_response",
+    "chunk_request", "chunk_response", "no_chunk",
+))
+
+
+def _enc(obj) -> bytes:
+    return serde.pack(obj)
+
+
+# -- wire codecs -------------------------------------------------------
+
+
+def snapshot_obj(s: abci.Snapshot):
+    return [s.height, s.format, s.chunks, s.hash,
+            [bytes(h) for h in s.chunk_hashes], s.metadata]
+
+
+def snapshot_from(o) -> abci.Snapshot:
+    return abci.Snapshot(
+        height=o[0], format=o[1], chunks=o[2], hash=bytes(o[3]),
+        chunk_hashes=[bytes(h) for h in o[4]], metadata=bytes(o[5]),
+    )
+
+
+def fc_obj(fc: Optional[FullCommit]):
+    if fc is None:
+        return None
+    return [
+        serde.header_obj(fc.signed_header.header),
+        serde.commit_obj(fc.signed_header.commit),
+        serde.valset_obj(fc.validators),
+        serde.valset_obj(fc.next_validators)
+        if fc.next_validators is not None else None,
+    ]
+
+
+def fc_from(o) -> Optional[FullCommit]:
+    if o is None:
+        return None
+    return FullCommit(
+        signed_header=SignedHeader(
+            header=serde.header_from(o[0]),
+            commit=serde.commit_from(o[1]),
+        ),
+        validators=serde.valset_from(o[2]),
+        next_validators=serde.valset_from(o[3]) if o[3] is not None else None,
+    )
+
+
+class _Pending:
+    """One outstanding request keyed by (peer_id, kind, *args)."""
+
+    __slots__ = ("event", "value")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.value = None
+
+
+class SnapshotReactor(Reactor):
+    def __init__(self, snapshot_store, block_store, state_db,
+                 chunk_send_rate: int = 5120000, metrics=None):
+        super().__init__("SnapshotReactor")
+        self.snapshots = snapshot_store
+        self.block_store = block_store
+        self.state_db = state_db
+        self.chunk_send_rate = chunk_send_rate
+        self.metrics = metrics
+        self._serve_monitor = flowrate.Monitor()
+        self._lock = threading.Lock()
+        # peer_id -> snapshots that peer advertised (restore side)
+        self._offers: Dict[str, List[abci.Snapshot]] = {}
+        self._pending: Dict[Tuple, _Pending] = {}
+        # local observability counters (mirrored into metrics when wired)
+        self.chunks_served = 0
+        self.chunks_received = 0
+        self.chunks_rejected = 0
+        self._want_offers = False
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=SNAPSHOT_CHANNEL, priority=5,
+                send_queue_capacity=16,
+                recv_message_capacity=16 * 1024 * 1024,
+            ),
+            ChannelDescriptor(
+                id=CHUNK_CHANNEL, priority=3,
+                send_queue_capacity=32,
+                recv_message_capacity=32 * 1024 * 1024,
+            ),
+        ]
+
+    # -- peers ---------------------------------------------------------
+
+    def add_peer(self, peer) -> None:
+        # a restore in progress asks every newcomer directly — the
+        # periodic broadcast alone would miss peers that connect
+        # between discovery ticks
+        if self._want_offers:
+            peer.try_send(SNAPSHOT_CHANNEL, _enc(["snapshots_request"]))
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._lock:
+            self._offers.pop(peer.id, None)
+            # fail every request outstanding against the departed peer
+            for key, p in list(self._pending.items()):
+                if key[0] == peer.id:
+                    p.event.set()
+                    self._pending.pop(key, None)
+
+    # -- inbound -------------------------------------------------------
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        obj = serde.unpack(msg_bytes)
+        kind = obj[0]
+        if self.switch is not None and peer.is_running():
+            label = kind if kind in _KNOWN_MSG_KINDS else "unknown"
+            self.switch.metrics.peer_msg_recv_total.with_labels(
+                peer.id, f"{ch_id:#04x}", label).inc()
+        handler = getattr(self, f"_on_{kind}", None) \
+            if kind in _KNOWN_MSG_KINDS else None
+        if handler is None:
+            raise ValueError(f"unknown statesync message {kind!r}")
+        handler(peer, obj)
+
+    # -- discovery (server) --------------------------------------------
+
+    def _advertisable(self) -> List[abci.Snapshot]:
+        """Local snapshots whose anchor data we can actually serve."""
+        self.snapshots.refresh()
+        tip = self.block_store.height()
+        return [s for s in self.snapshots.local_snapshots()
+                if s.height + ANCHOR_LEAD <= tip]
+
+    def _on_snapshots_request(self, peer, obj) -> None:
+        snaps = self._advertisable()
+        peer.try_send(SNAPSHOT_CHANNEL, _enc(
+            ["snapshots_response", [snapshot_obj(s) for s in snaps]]))
+
+    def _on_snapshots_response(self, peer, obj) -> None:
+        try:
+            snaps = [snapshot_from(o) for o in obj[1]]
+        except Exception:
+            raise ValueError("malformed snapshots_response")
+        with self._lock:
+            self._offers[peer.id] = snaps
+
+    # -- anchor + commits (server) -------------------------------------
+
+    def _full_commit_at(self, height: int) -> Optional[FullCommit]:
+        """FullCommit(height) from local storage: header from the block
+        meta, the canonical commit, valsets from the state db."""
+        if height < max(1, self.block_store.base()):
+            return None
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            return None
+        try:
+            vals = sm.load_validators(self.state_db, height)
+        except Exception:  # noqa: BLE001 - NoValSetForHeightError et al
+            return None
+        try:
+            nvals = sm.load_validators(self.state_db, height + 1)
+        except Exception:  # noqa: BLE001
+            nvals = None
+        return FullCommit(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validators=vals, next_validators=nvals,
+        )
+
+    def _on_anchor_request(self, peer, obj) -> None:
+        height = int(obj[1])
+        bundle = None
+        fc_h = self._full_commit_at(height)
+        fc_h1 = self._full_commit_at(height + 1)
+        if fc_h is not None and fc_h1 is not None:
+            try:
+                params = sm.load_consensus_params(self.state_db, height + 1)
+                params_obj = [params.block_size.max_bytes,
+                              params.block_size.max_gas,
+                              params.evidence.max_age]
+                bundle = [fc_obj(fc_h), fc_obj(fc_h1), params_obj]
+            except Exception:  # noqa: BLE001 - no params record
+                bundle = None
+        peer.try_send(SNAPSHOT_CHANNEL, _enc(["anchor_response", height, bundle]))
+
+    def _on_commit_request(self, peer, obj) -> None:
+        """Serve the FullCommit at the greatest height <= the requested
+        one (lite.Provider.latest_full_commit semantics: bisection asks
+        for midpoints that must resolve to SOME verifiable height)."""
+        want = int(obj[1])
+        # commits are stored for h once block h+1 is saved
+        h = min(want, self.block_store.height() - 1)
+        fc = self._full_commit_at(h) if h >= 1 else None
+        peer.try_send(SNAPSHOT_CHANNEL,
+                      _enc(["commit_response", want, fc_obj(fc)]))
+
+    # -- chunks (server) -----------------------------------------------
+
+    def _on_chunk_request(self, peer, obj) -> None:
+        height, format_, index = int(obj[1]), int(obj[2]), int(obj[3])
+        data = self.snapshots.load_chunk(height, format_, index)
+        if data is None:
+            peer.try_send(CHUNK_CHANNEL,
+                          _enc(["no_chunk", height, format_, index]))
+            return
+        # flowrate limit: trickle the allowance until the whole chunk
+        # is budgeted, then send — serving restores must not crowd out
+        # consensus traffic on this box
+        remaining = len(data)
+        while remaining > 0:
+            n = self._serve_monitor.limit(remaining, self.chunk_send_rate)
+            self._serve_monitor.update(n)
+            remaining -= n
+        self.chunks_served += 1
+        if self.metrics is not None:
+            self.metrics.chunks_served.inc()
+        peer.try_send(CHUNK_CHANNEL,
+                      _enc(["chunk_response", height, format_, index, data]))
+
+    # -- responses (restore side) --------------------------------------
+
+    def _resolve(self, key: Tuple, value) -> None:
+        with self._lock:
+            p = self._pending.get(key)
+            if p is None:
+                return  # unsolicited/late; drop
+            p.value = value
+            p.event.set()
+
+    def _on_anchor_response(self, peer, obj) -> None:
+        self._resolve((peer.id, "anchor", int(obj[1])), obj[2])
+
+    def _on_commit_response(self, peer, obj) -> None:
+        self._resolve((peer.id, "commit", int(obj[1])), obj[2])
+
+    def _on_chunk_response(self, peer, obj) -> None:
+        self._resolve((peer.id, "chunk", int(obj[1]), int(obj[2]),
+                       int(obj[3])), bytes(obj[4]))
+
+    def _on_no_chunk(self, peer, obj) -> None:
+        self._resolve((peer.id, "chunk", int(obj[1]), int(obj[2]),
+                       int(obj[3])), None)
+
+    # -- restore-side request API --------------------------------------
+
+    def request_snapshots(self) -> None:
+        self._want_offers = True
+        if self.switch is not None:
+            self.switch.broadcast(SNAPSHOT_CHANNEL, _enc(["snapshots_request"]))
+
+    def end_discovery(self) -> None:
+        """Restore is over (either way): stop soliciting offers from
+        newcomers and drop the collected advertisements — nothing reads
+        them again, and each entry pins a peer's full chunk-hash lists."""
+        self._want_offers = False
+        with self._lock:
+            self._offers.clear()
+
+    def offers(self) -> Dict[str, List[abci.Snapshot]]:
+        with self._lock:
+            return {pid: list(snaps) for pid, snaps in self._offers.items()}
+
+    def _request(self, peer_id: str, key: Tuple, ch_id: int, msg,
+                 timeout: float):
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return None
+        p = _Pending()
+        with self._lock:
+            self._pending[key] = p
+        try:
+            if not peer.try_send(ch_id, _enc(msg)):
+                return None
+            p.event.wait(timeout)
+            return p.value
+        finally:
+            with self._lock:
+                self._pending.pop(key, None)
+
+    def fetch_anchor(self, peer_id: str, height: int,
+                     timeout: float = 10.0):
+        """-> (FullCommit(H), FullCommit(H+1), ConsensusParams) or None."""
+        bundle = self._request(
+            peer_id, (peer_id, "anchor", height), SNAPSHOT_CHANNEL,
+            ["anchor_request", height], timeout)
+        if bundle is None:
+            return None
+        from ..types.genesis import (
+            BlockSizeParams,
+            ConsensusParams,
+            EvidenceParams,
+        )
+
+        try:
+            fch, fch1 = fc_from(bundle[0]), fc_from(bundle[1])
+            p = bundle[2]
+            params = ConsensusParams(BlockSizeParams(p[0], p[1]),
+                                     EvidenceParams(p[2]))
+        except Exception:
+            raise ValueError(f"malformed anchor bundle from {peer_id[:8]}")
+        if fch is None or fch1 is None:
+            return None
+        return fch, fch1, params
+
+    def fetch_commit(self, peer_id: str, max_height: int,
+                     timeout: float = 10.0) -> Optional[FullCommit]:
+        o = self._request(
+            peer_id, (peer_id, "commit", max_height), SNAPSHOT_CHANNEL,
+            ["commit_request", max_height], timeout)
+        if o is None:
+            return None
+        try:
+            return fc_from(o)
+        except Exception:
+            raise ValueError(f"malformed commit_response from {peer_id[:8]}")
+
+    def fetch_chunk(self, peer_id: str, height: int, format_: int,
+                    index: int, timeout: float = 10.0) -> Optional[bytes]:
+        return self._request(
+            peer_id, (peer_id, "chunk", height, format_, index),
+            CHUNK_CHANNEL, ["chunk_request", height, format_, index],
+            timeout)
+
+    def ban_peer(self, peer_id: str, reason: str) -> None:
+        """Bad chunk / poisoned trust data: disconnect with an error so
+        the switch decays the peer's trust score (repeat offenders fall
+        below the admission ban line) and reactors drop its state."""
+        if self.switch is None:
+            return
+        peer = self.switch.peers.get(peer_id)
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, RuntimeError(reason))
+
+    def status(self) -> dict:
+        with self._lock:
+            offers = {pid[:12]: [s.height for s in snaps]
+                      for pid, snaps in self._offers.items()}
+        return {
+            "chunks_served": self.chunks_served,
+            "chunks_received": self.chunks_received,
+            "chunks_rejected": self.chunks_rejected,
+            "peer_offers": offers,
+            "serve_rate": self._serve_monitor.status(),
+            **self.snapshots.status(),
+        }

@@ -1,0 +1,579 @@
+"""StateSyncer — bootstrap a fresh node from a peer snapshot.
+
+The restore pipeline (each phase traced as a `statesync.<phase>` span
+and timed into statesync_restore_phase_seconds{phase}):
+
+  discover  broadcast `snapshots_request`, collect per-peer offers,
+            rank candidates (height desc, then #peers offering)
+  verify    establish a root of trust — the LOCAL genesis validator set
+            (or the [statesync] trust_height/trust_hash pin) — then
+            light-verify the anchor SignedHeaders at H and H+1 with
+            lite.DynamicVerifier bisection over a peer-backed source
+            provider. Every commit check lands in the pluggable
+            crypto/batch.BatchVerifier (ValidatorSet.verify_commit and
+            _verify_commit_trusting both route there), so the
+            vectorized Ed25519 path + PR-2 sig cache carry the
+            bootstrap's dominant cost.
+  fetch     OfferSnapshot to the app with the light-verified app hash,
+            then pull chunks from every offering peer in parallel;
+            a chunk whose SHA-256 misses the root-verified hash list
+            bans the sender and re-queues the index for another peer
+  apply     ApplySnapshotChunk in index order; the app's final-chunk
+            verdict plus an Info round trip gate on (height == H,
+            app_hash == header(H+1).app_hash)
+  finalize  reconstruct state.State at H from VERIFIED material only —
+            valsets from the FullCommits (hash-checked against the
+            headers), app/results/last-block fields from header H+1 —
+            persist it plus full historical valset/params records, and
+            seed the block store with the anchor commit
+
+On success `on_complete(state)` hands off to fast sync for the tail;
+on failure (no offers, no verifiable anchor, every peer banned)
+`on_complete(None)` falls back to full fast sync from genesis.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..abci import types as abci
+from ..libs import tracing
+from ..lite.provider import MemProvider, Provider
+from ..lite.types import FullCommit
+from ..lite.verifier import BaseVerifier, DynamicVerifier, ErrLiteVerification
+from ..state import store as sm_store
+from ..state.state import State
+from ..types.validator_set import ValidatorSet
+from . import chunker
+
+LOG = logging.getLogger("statesync.restore")
+
+# per-request network timeouts; the overall budget is restore_timeout_s
+CHUNK_TIMEOUT = 10.0
+COMMIT_TIMEOUT = 10.0
+# consecutive unanswered chunk requests before a worker gives its peer up
+MAX_PEER_TIMEOUTS = 3
+MAX_FETCH_WORKERS = 4
+
+
+class RestoreError(Exception):
+    pass
+
+
+class _PeerSource(Provider):
+    """lite source Provider over the snapshot channel: bisection's
+    latest_full_commit(chain, h) becomes a commit_request to one of the
+    offering peers, rotating past peers that don't answer and BANNING
+    peers that answer garbage (a malformed reply must cost the sender
+    its connection, not the whole restore)."""
+
+    def __init__(self, reactor, peer_ids: List[str], on_bad_peer=None):
+        self.reactor = reactor
+        self.peer_ids = list(peer_ids)
+        self._on_bad_peer = on_bad_peer
+
+    def latest_full_commit(self, chain_id: str,
+                           max_height: int) -> Optional[FullCommit]:
+        for pid in list(self.peer_ids):
+            try:
+                fc = self.reactor.fetch_commit(pid, max_height,
+                                               timeout=COMMIT_TIMEOUT)
+            except ValueError as e:
+                if pid in self.peer_ids:
+                    self.peer_ids.remove(pid)
+                if self._on_bad_peer is not None:
+                    self._on_bad_peer(pid, str(e))
+                continue
+            if fc is not None:
+                return fc
+        return None
+
+
+class StateSyncer:
+    def __init__(self, reactor, genesis_doc, state_db, block_store,
+                 app_conn, statesync_config, metrics=None,
+                 on_complete=None):
+        self.reactor = reactor
+        self.genesis_doc = genesis_doc
+        self.state_db = state_db
+        self.block_store = block_store
+        self.app = app_conn
+        self.cfg = statesync_config
+        self.metrics = metrics
+        self.on_complete = on_complete
+        self.chain_id = genesis_doc.chain_id
+
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._phase = "idle"
+        self._phase_since = time.monotonic()
+        self._started_at: Optional[float] = None
+        self._snapshot: Optional[abci.Snapshot] = None
+        self._chunks_applied = 0
+        self._error: Optional[str] = None
+        self._banned: set = set()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="statesync-restore", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- observability -------------------------------------------------
+
+    def _set_phase(self, phase: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            prev, since = self._phase, self._phase_since
+            self._phase, self._phase_since = phase, now
+        if self.metrics is not None and prev not in ("idle", "done", "failed"):
+            self.metrics.restore_phase_seconds.with_labels(prev).observe(
+                now - since)
+        LOG.info("state sync phase: %s -> %s", prev, phase)
+
+    def status(self) -> dict:
+        with self._lock:
+            s = self._snapshot
+            return {
+                "phase": self._phase,
+                "phase_elapsed_s": round(
+                    time.monotonic() - self._phase_since, 3),
+                "elapsed_s": round(
+                    time.monotonic() - self._started_at, 3)
+                if self._started_at else 0.0,
+                "snapshot": {
+                    "height": s.height, "format": s.format,
+                    "chunks": s.chunks, "hash": s.hash.hex()[:16],
+                } if s is not None else None,
+                "chunks_applied": self._chunks_applied,
+                "chunks_total": s.chunks if s is not None else 0,
+                "banned_peers": sorted(p[:12] for p in self._banned),
+                "error": self._error,
+            }
+
+    # -- the pipeline --------------------------------------------------
+
+    def _run(self) -> None:
+        state = None
+        try:
+            state = self._restore()
+        except RestoreError as e:
+            LOG.warning("state sync failed: %s — falling back to fast "
+                        "sync from genesis", e)
+            with self._lock:
+                self._error = str(e)
+            self._set_phase("failed")
+        except Exception as e:  # noqa: BLE001 - never kill the node boot
+            LOG.exception("state sync crashed — falling back to fast sync")
+            with self._lock:
+                self._error = f"{type(e).__name__}: {e}"
+            self._set_phase("failed")
+        else:
+            self._set_phase("done")
+        self.reactor.end_discovery()
+        if self.on_complete is not None:
+            self.on_complete(state)
+
+    def _check_stop(self) -> None:
+        if self._stop.is_set():
+            raise RestoreError("stopped")
+
+    def _restore(self) -> State:
+        deadline = time.monotonic() + max(1.0, self.cfg.restore_timeout_s)
+
+        # discovery rounds until the deadline: a failed candidate set is
+        # re-discovered FRESH, because on a fast chain the snapshots a
+        # peer advertised seconds ago may already be evicted from its
+        # app's keep-recent window — retrying stale offers cannot win
+        last_err: Optional[Exception] = None
+        saw_offer = False
+        while time.monotonic() < deadline:
+            self._check_stop()
+            self._set_phase("discover")
+            with tracing.span("statesync.discover", cat="statesync"):
+                candidates = self._discover(deadline)
+            if not candidates:
+                continue
+            saw_offer = True
+            for snap, peer_ids in candidates:
+                self._check_stop()
+                with self._lock:
+                    self._snapshot = snap
+                    self._chunks_applied = 0
+                try:
+                    return self._restore_one(snap, peer_ids)
+                except (RestoreError, ValueError) as e:
+                    # ValueError = hostile wire data that slipped past a
+                    # handler; worth the next candidate, not a crash
+                    LOG.warning("snapshot h=%d unusable: %s", snap.height, e)
+                    last_err = e
+        if not saw_offer:
+            raise RestoreError("no snapshots offered by any peer")
+        raise RestoreError(f"all candidate snapshots failed: {last_err}")
+
+    def _restore_one(self, snap: abci.Snapshot,
+                     peer_ids: List[str]) -> State:
+        self._set_phase("verify")
+        with tracing.span("statesync.verify", cat="statesync",
+                          height=snap.height):
+            fc_h, fc_h1, params = self._verify_anchor(snap, peer_ids)
+        trusted_app_hash = fc_h1.signed_header.header.app_hash
+
+        self._set_phase("fetch")
+        with tracing.span("statesync.fetch", cat="statesync",
+                          chunks=snap.chunks):
+            self._offer(snap, trusted_app_hash)
+            self._fetch_and_apply(snap, peer_ids)
+
+        self._set_phase("apply")
+        with tracing.span("statesync.apply", cat="statesync"):
+            self._check_app(snap, trusted_app_hash)
+
+        self._set_phase("finalize")
+        with tracing.span("statesync.finalize", cat="statesync"):
+            state = self._build_state(snap, fc_h, fc_h1, params)
+            self._install(state, fc_h, fc_h1, params)
+        return state
+
+    # -- discover ------------------------------------------------------
+
+    def _discover(self, deadline: float
+                  ) -> List[Tuple[abci.Snapshot, List[str]]]:
+        """Collect offers for at least discovery_time_s once the first
+        one lands (more offering peers = more parallel chunk sources
+        and a better shot at surviving a ban), bounded by the restore
+        deadline; then rank: height desc, peer count desc."""
+        grace = max(0.5, getattr(self.cfg, "discovery_time_s", 5.0))
+        self.reactor.request_snapshots()
+        first_offer_at = None
+        while not self._stop.is_set():
+            now = time.monotonic()
+            offers = self.reactor.offers()
+            if any(offers.values()):
+                # keep the window open for `grace` after the FIRST offer
+                # so slower peers still make the candidate peer lists
+                if first_offer_at is None:
+                    first_offer_at = now
+                if now >= min(deadline, first_offer_at + grace):
+                    break
+            if now >= deadline:
+                break
+            self.reactor.request_snapshots()  # late-connecting peers
+            self._stop.wait(min(0.5, max(0.05, deadline - now)))
+        offers = self.reactor.offers()
+        by_key: Dict[tuple, Tuple[abci.Snapshot, List[str]]] = {}
+        for pid, snaps in offers.items():
+            for s in snaps:
+                if s.chunks <= 0 or s.chunks != len(s.chunk_hashes):
+                    continue
+                if not chunker.verify_hashes(s.chunk_hashes, s.hash):
+                    continue
+                key = (s.height, s.format, s.hash)
+                entry = by_key.setdefault(key, (s, []))
+                entry[1].append(pid)
+        ranked = sorted(
+            by_key.values(),
+            key=lambda sp: (sp[0].height, len(sp[1])), reverse=True)
+        return ranked
+
+    # -- verify --------------------------------------------------------
+
+    def _live_peers(self, peer_ids: List[str]) -> List[str]:
+        sw = self.reactor.switch
+        return [p for p in peer_ids
+                if p not in self._banned
+                and (sw is None or sw.peers.has(p))]
+
+    def _verify_anchor(self, snap: abci.Snapshot, peer_ids: List[str]):
+        """Light-verify headers H and H+1; returns (fc_H, fc_H1,
+        consensus_params) with every cross-hash checked."""
+        h = snap.height
+        peers = self._live_peers(peer_ids)
+        if not peers:
+            raise RestoreError("no live peers for snapshot")
+        bundle = None
+        for pid in peers:
+            try:
+                bundle = self.reactor.fetch_anchor(pid, h,
+                                                   timeout=COMMIT_TIMEOUT)
+            except ValueError as e:  # garbage bundle: ban, try the next
+                self._ban(pid, str(e))
+                continue
+            if bundle is not None:
+                break
+        if bundle is None:
+            raise RestoreError(f"no peer served the anchor bundle at {h}")
+        fc_h, fc_h1, params = bundle
+
+        source = _PeerSource(self.reactor, self._live_peers(peer_ids),
+                             on_bad_peer=self._ban)
+        trusted = MemProvider()
+        verifier = DynamicVerifier(self.chain_id, trusted, source)
+        self._init_trust(verifier, source)
+        try:
+            for fc in (fc_h, fc_h1):
+                try:
+                    fc.validate_full(self.chain_id)
+                except ValueError as e:
+                    raise ErrLiteVerification(str(e))
+                verifier.verify(fc.signed_header)
+                trusted.save_full_commit(fc)
+        except ErrLiteVerification as e:
+            raise RestoreError(f"anchor light-verification failed: {e}")
+
+        hdr_h = fc_h.signed_header.header
+        hdr_h1 = fc_h1.signed_header.header
+        if hdr_h.height != h or hdr_h1.height != h + 1:
+            raise RestoreError("anchor heights don't match snapshot")
+        if hdr_h1.last_block_id.hash != fc_h.signed_header.header_hash():
+            raise RestoreError("anchor headers don't chain")
+        if fc_h.next_validators is None or \
+                fc_h.next_validators.hash() != hdr_h1.validators_hash:
+            raise RestoreError("anchor next-validators don't match H+1")
+        if fc_h1.next_validators is None:
+            raise RestoreError("anchor bundle missing valset at H+2")
+        if params.hash() != hdr_h1.consensus_hash:
+            raise RestoreError("anchor consensus params don't match header")
+        return fc_h, fc_h1, params
+
+    def _init_trust(self, verifier: DynamicVerifier,
+                    source: _PeerSource) -> None:
+        """Seed the trusted store: either the operator's
+        trust_height/trust_hash pin, or +2/3 of the LOCAL genesis
+        validator set over the block-1 commit."""
+        if self.cfg.trust_height > 0 and self.cfg.trust_hash:
+            want = bytes.fromhex(self.cfg.trust_hash)
+            fc = source.latest_full_commit(self.chain_id,
+                                           self.cfg.trust_height)
+            if fc is None or fc.height != self.cfg.trust_height:
+                raise RestoreError(
+                    f"no peer served trusted height {self.cfg.trust_height}")
+            if fc.signed_header.header_hash() != want:
+                raise RestoreError(
+                    f"header at trust height {self.cfg.trust_height} is "
+                    f"{fc.signed_header.header_hash().hex()[:16]}, config "
+                    f"pins {self.cfg.trust_hash[:16]}")
+            try:
+                fc.validate_full(self.chain_id)
+            except ValueError as e:
+                raise RestoreError(f"pinned trust commit malformed: {e}")
+            verifier.init_trust(fc)
+            return
+        fc1 = source.latest_full_commit(self.chain_id, 1)
+        if fc1 is None or fc1.height != 1:
+            raise RestoreError("no peer served the height-1 commit "
+                               "(pruned history? set trust_height/trust_hash)")
+        genesis_vals = ValidatorSet(self.genesis_doc.validator_set_validators())
+        if fc1.validators.hash() != genesis_vals.hash():
+            raise RestoreError("height-1 validators don't match our genesis")
+        try:
+            fc1.validate_full(self.chain_id)
+            # ★ +2/3 of the genesis set over block 1 — the commit check
+            # rides ValidatorSet.verify_commit's batched TPU path
+            BaseVerifier(self.chain_id, 1, genesis_vals).verify(
+                fc1.signed_header)
+        except (ValueError, ErrLiteVerification) as e:
+            raise RestoreError(f"genesis trust root rejected: {e}")
+        verifier.init_trust(fc1)
+
+    # -- fetch + apply -------------------------------------------------
+
+    def _offer(self, snap: abci.Snapshot, app_hash: bytes) -> None:
+        res = self.app.offer_snapshot(abci.RequestOfferSnapshot(
+            snapshot=snap, app_hash=app_hash))
+        if res.result != abci.OFFER_ACCEPT:
+            raise RestoreError(
+                f"app rejected snapshot h={snap.height} (result "
+                f"{res.result})")
+
+    def _ban(self, peer_id: str, reason: str) -> None:
+        # _banned is read by the HTTP status() thread and written by
+        # fetch workers — mutate under the same lock status() holds
+        with self._lock:
+            self._banned.add(peer_id)
+        self.reactor.ban_peer(peer_id, reason)
+
+    def _fetch_and_apply(self, snap: abci.Snapshot,
+                         peer_ids: List[str]) -> None:
+        """Parallel multi-peer chunk download feeding a strictly-ordered
+        ABCI apply loop (blockchain/pool.py's shape: per-height
+        requesters + ordered hand-off, collapsed to chunk indices)."""
+        todo = deque(range(snap.chunks))
+        fetched: Dict[int, Tuple[bytes, str]] = {}
+        cond = threading.Condition()
+        workers_alive = [0]
+        failed = [None]  # worker-side fatal error
+
+        def worker(pid: str) -> None:
+            timeouts = 0
+            try:
+                while True:
+                    with cond:
+                        if failed[0] or self._stop.is_set():
+                            return
+                        if not todo:
+                            return
+                        i = todo.popleft()
+                    data = self.reactor.fetch_chunk(
+                        pid, snap.height, snap.format, i,
+                        timeout=CHUNK_TIMEOUT)
+                    ok = (data is not None
+                          and chunker.verify_chunk(data, i,
+                                                   snap.chunk_hashes))
+                    with cond:
+                        if ok:
+                            fetched[i] = (data, pid)
+                            self.reactor.chunks_received += 1
+                            if self.metrics is not None:
+                                self.metrics.chunks_received.inc()
+                            cond.notify_all()
+                            timeouts = 0
+                            continue
+                        todo.append(i)
+                        cond.notify_all()
+                    if data is not None:
+                        # a WRONG chunk is malice, not lag: ban + requeue
+                        self.reactor.chunks_rejected += 1
+                        if self.metrics is not None:
+                            self.metrics.chunks_rejected.with_labels(
+                                "hash_mismatch").inc()
+                        LOG.warning("peer %s served bad chunk %d — banning",
+                                    pid[:8], i)
+                        self._ban(pid, f"bad snapshot chunk {i}")
+                        return
+                    timeouts += 1
+                    if self.metrics is not None:
+                        self.metrics.chunks_rejected.with_labels(
+                            "timeout").inc()
+                    if timeouts >= MAX_PEER_TIMEOUTS:
+                        LOG.warning("peer %s timed out %d chunk requests — "
+                                    "giving it up", pid[:8], timeouts)
+                        return
+            finally:
+                with cond:
+                    workers_alive[0] -= 1
+                    cond.notify_all()
+
+        peers = self._live_peers(peer_ids)[:MAX_FETCH_WORKERS]
+        if not peers:
+            raise RestoreError("no live peers to fetch chunks from")
+        with cond:
+            workers_alive[0] = len(peers)
+        for pid in peers:
+            threading.Thread(target=worker, args=(pid,),
+                             name=f"statesync-fetch-{pid[:8]}",
+                             daemon=True).start()
+
+        # ordered apply loop
+        for i in range(snap.chunks):
+            with cond:
+                while i not in fetched:
+                    self._check_stop()
+                    if failed[0]:
+                        raise RestoreError(failed[0])
+                    if workers_alive[0] == 0 and i not in fetched:
+                        raise RestoreError(
+                            f"chunk {i} unfetchable: every peer timed out "
+                            "or was banned")
+                    cond.wait(0.25)
+                data, sender = fetched[i]
+            res = self.app.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+                index=i, chunk=data, sender=sender))
+            if res.result == abci.APPLY_ACCEPT:
+                with self._lock:
+                    self._chunks_applied = i + 1
+                if self.metrics is not None:
+                    self.metrics.restore_chunks_applied.set(i + 1)
+                continue
+            with cond:
+                if res.result == abci.APPLY_RETRY:
+                    for j in res.refetch_chunks or [i]:
+                        fetched.pop(j, None)
+                        todo.appendleft(j)
+                    for pid in res.reject_senders:
+                        self._ban(pid, "app rejected snapshot chunk sender")
+                    cond.notify_all()
+                    # unreachable in practice (chunk hashes were checked
+                    # at fetch time) but honor the ABCI contract
+                    raise RestoreError("app asked to refetch a verified "
+                                       "chunk")
+                failed[0] = f"app aborted chunk apply (result {res.result})"
+                cond.notify_all()
+            raise RestoreError(failed[0])
+
+    def _check_app(self, snap: abci.Snapshot,
+                   trusted_app_hash: bytes) -> None:
+        info = self.app.info(abci.RequestInfo(version="statesync"))
+        if info.last_block_height != snap.height:
+            raise RestoreError(
+                f"restored app reports height {info.last_block_height}, "
+                f"snapshot was {snap.height}")
+        if info.last_block_app_hash != trusted_app_hash:
+            raise RestoreError(
+                "restored app hash doesn't match the light-verified "
+                f"header: {info.last_block_app_hash.hex()[:16]} != "
+                f"{trusted_app_hash.hex()[:16]}")
+
+    # -- finalize ------------------------------------------------------
+
+    def _build_state(self, snap: abci.Snapshot, fc_h: FullCommit,
+                     fc_h1: FullCommit, params) -> State:
+        """state.State at H from light-verified material only: valsets
+        from the FullCommits (their hashes were checked against the
+        verified headers), app/results/last-block fields from header
+        H+1 (the header that COMMITS to block H's outcome)."""
+        h = snap.height
+        hdr_h = fc_h.signed_header.header
+        hdr_h1 = fc_h1.signed_header.header
+        return State(
+            chain_id=self.chain_id,
+            last_block_height=h,
+            last_block_total_tx=hdr_h.total_txs,
+            last_block_id=hdr_h1.last_block_id,
+            last_block_time=hdr_h.time,
+            next_validators=fc_h1.next_validators.copy(),
+            validators=fc_h.next_validators.copy(),
+            last_validators=fc_h.validators.copy(),
+            # we cannot prove anything earlier than the anchor, so the
+            # changed-pointers land ON the heights we hold full records
+            # for (the installs below write those records)
+            last_height_validators_changed=h + 2,
+            consensus_params=params,
+            last_height_consensus_params_changed=h + 1,
+            last_results_hash=hdr_h1.last_results_hash,
+            app_hash=hdr_h1.app_hash,
+        )
+
+    def _install(self, state: State, fc_h: FullCommit, fc_h1: FullCommit,
+                 params) -> None:
+        h = state.last_block_height
+        # full historical records at H..H+2 so load_validators works for
+        # every height the node can be asked about (evidence, lite, RPC);
+        # save_state re-writes H+2/H+1 as FULL records because the
+        # changed-pointers above equal those heights
+        sm_store.save_validators_info(self.state_db, h, h, fc_h.validators)
+        sm_store.save_validators_info(self.state_db, h + 1, h + 1,
+                                      fc_h.next_validators)
+        sm_store.save_consensus_params_info(self.state_db, h + 1, h + 1,
+                                            params)
+        sm_store.save_state(self.state_db, state)
+        self.block_store.seed_anchor(h, fc_h.signed_header.commit)
+        elapsed = time.monotonic() - (self._started_at or time.monotonic())
+        self.reactor.snapshots.record_restored(self._snapshot, elapsed)
+        LOG.info("state sync complete: restored to height %d in %.1fs "
+                 "(%d chunks), fast sync takes the tail", h, elapsed,
+                 self._snapshot.chunks)

@@ -1,0 +1,149 @@
+"""SnapshotStore — the node-side snapshot registry.
+
+The APP owns snapshot creation (it serializes its own state; see the
+kvstore example); this store is the node's window onto that surface:
+it polls ListSnapshots over the ABCI query connection, validates each
+advertised snapshot (chunk-hash list must commit to the Merkle root),
+persists the metadata in libs/db (key `snap:<height>:<format>`), and
+serves LoadSnapshotChunk to the p2p reactor. It also records which
+snapshot this node restored FROM, for /debug/statesync and /status.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from ..abci import types as abci
+from ..libs.db import DB
+from . import chunker
+
+LOG = logging.getLogger("statesync.store")
+
+_RESTORED_KEY = b"statesync:restored"
+
+
+def _snap_key(height: int, format_: int) -> bytes:
+    return f"snap:{height:020d}:{format_}".encode()
+
+
+class SnapshotStore:
+    # min seconds between ListSnapshots polls — discovery requests from
+    # many peers must not hammer the app connection
+    REFRESH_MIN_INTERVAL = 2.0
+
+    def __init__(self, db: DB, app_conn, metrics=None):
+        """`app_conn` is an abci Client (the node passes its query
+        connection); `metrics` a StateSyncMetrics or None."""
+        self._db = db
+        self._app = app_conn
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._snapshots: List[abci.Snapshot] = []
+        self._last_refresh = 0.0
+
+    # -- local snapshots (producer side) -------------------------------
+
+    def refresh(self, force: bool = False) -> None:
+        """Poll the app's ListSnapshots; drop advertisements whose
+        chunk-hash list doesn't commit to the claimed root (a buggy or
+        hostile out-of-process app must not make US serve garbage)."""
+        with self._lock:
+            now = time.monotonic()
+            if not force and now - self._last_refresh < self.REFRESH_MIN_INTERVAL:
+                return
+            self._last_refresh = now
+        try:
+            res = self._app.list_snapshots(abci.RequestListSnapshots())
+        except Exception as e:  # noqa: BLE001 - app conn may be down
+            LOG.debug("list_snapshots failed: %s", e)
+            return
+        valid = []
+        for s in res.snapshots:
+            if s.chunks <= 0 or s.chunks != len(s.chunk_hashes):
+                LOG.warning("app snapshot h=%d has inconsistent chunk count",
+                            s.height)
+                continue
+            if not chunker.verify_hashes(s.chunk_hashes, s.hash):
+                LOG.warning("app snapshot h=%d chunk hashes don't match root",
+                            s.height)
+                continue
+            valid.append(s)
+        self._sync_meta(valid)
+        with self._lock:
+            self._snapshots = sorted(valid, key=lambda s: (s.height, s.format))
+        if self._metrics is not None:
+            self._metrics.snapshots.set(len(valid))
+            if valid:
+                self._metrics.snapshot_height.set(valid[-1].height)
+
+    def _sync_meta(self, snapshots: List[abci.Snapshot]) -> None:
+        """Mirror the app's CURRENT snapshot set into the metadata db:
+        write records for new snapshots, delete records the app has
+        evicted — without the prune, a producer snapshotting for months
+        accumulates one orphan key per snapshot ever taken."""
+        want = {_snap_key(s.height, s.format): s for s in snapshots}
+        have = {k for k, _ in self._db.iterator(b"snap:", b"snap;")}
+        for k in have - set(want):
+            self._db.delete(k)
+        for k, s in want.items():
+            if k in have:
+                continue  # identical record already on disk
+            self._db.set(k, json.dumps({
+                "height": s.height,
+                "format": s.format,
+                "chunks": s.chunks,
+                "hash": s.hash.hex(),
+            }).encode())
+
+    def local_snapshots(self) -> List[abci.Snapshot]:
+        """Validated snapshots the app can currently serve, oldest
+        first (refresh() first for a live view)."""
+        with self._lock:
+            return list(self._snapshots)
+
+    def load_chunk(self, height: int, format_: int, index: int) -> Optional[bytes]:
+        with self._lock:
+            snaps = list(self._snapshots)
+        if not any(s.height == height and s.format == format_
+                   and 0 <= index < s.chunks for s in snaps):
+            return None
+        try:
+            res = self._app.load_snapshot_chunk(
+                abci.RequestLoadSnapshotChunk(
+                    height=height, format=format_, chunk=index))
+        except Exception as e:  # noqa: BLE001
+            LOG.debug("load_snapshot_chunk failed: %s", e)
+            return None
+        return res.chunk if res.chunk else None
+
+    # -- restore record (consumer side) --------------------------------
+
+    def record_restored(self, snapshot: abci.Snapshot, elapsed_s: float) -> None:
+        self._db.set_sync(_RESTORED_KEY, json.dumps({
+            "height": snapshot.height,
+            "format": snapshot.format,
+            "chunks": snapshot.chunks,
+            "hash": snapshot.hash.hex(),
+            "elapsed_s": round(elapsed_s, 3),
+            "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }).encode())
+
+    def restored(self) -> Optional[dict]:
+        raw = self._db.get(_RESTORED_KEY)
+        return json.loads(raw) if raw else None
+
+    def status(self) -> dict:
+        with self._lock:
+            snaps = list(self._snapshots)
+        return {
+            "snapshots": [
+                {"height": s.height, "format": s.format, "chunks": s.chunks,
+                 "hash": s.hash.hex()[:16]}
+                for s in snaps
+            ],
+            "restored_from": self.restored(),
+        }

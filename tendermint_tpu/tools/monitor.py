@@ -15,6 +15,13 @@ scrapes each node's /debug/consensus watchdog endpoint (rpc/prof.py)
 and surfaces round dwell, stall alerts and per-peer block lag in
 snapshot()/health() — a stalled or lagging validator drops network
 health to "moderate" even while every node still answers /status.
+
+The same debug address also serves /debug/statesync: a node mid-restore
+reports its phase and chunks applied/total; a node whose restore makes
+NO progress for RESTORE_STUCK_S seconds is flagged restore_stuck and
+degrades network health to "moderate" (a bootstrapping node wedged in
+`fetch` looks perfectly healthy to /status alone — it answers, at
+height 0, forever).
 """
 
 from __future__ import annotations
@@ -104,12 +111,46 @@ class NodeStatus:
     stalls_total: int = 0
     stall_alerts: List[dict] = field(default_factory=list)
     max_peer_lag: int = 0
+    # state-sync restore view (from /debug/statesync): the live phase,
+    # chunk progress, and when that progress last ADVANCED — a restore
+    # that stops advancing is a wedged bootstrap, not a healthy node
+    restore_phase: str = ""
+    restore_chunks_applied: int = 0
+    restore_chunks_total: int = 0
+    _restore_progress_key: tuple = ()
+    _restore_progress_at: float = 0.0
+
+    RESTORE_STUCK_S = 30.0
+    # phases during which "no progress" means wedged (idle/done/failed
+    # are terminal — done hands off to fast sync, failed falls back)
+    _RESTORE_ACTIVE = ("discover", "verify", "fetch", "apply", "finalize")
 
     @property
     def stalled(self) -> bool:
         """The node's current round has dwelt past its own threshold."""
         return (self.stall_threshold_s > 0
                 and self.round_dwell_s >= self.stall_threshold_s)
+
+    @property
+    def restoring(self) -> bool:
+        return self.restore_phase in self._RESTORE_ACTIVE
+
+    @property
+    def restore_stuck(self) -> bool:
+        """Mid-restore with no phase/chunk advance for RESTORE_STUCK_S."""
+        return (self.restoring
+                and self._restore_progress_at > 0
+                and time.time() - self._restore_progress_at
+                >= self.RESTORE_STUCK_S)
+
+    def note_restore(self, phase: str, applied: int, total: int) -> None:
+        self.restore_phase = phase
+        self.restore_chunks_applied = applied
+        self.restore_chunks_total = total
+        key = (phase, applied)
+        if key != self._restore_progress_key:
+            self._restore_progress_key = key
+            self._restore_progress_at = time.time()
 
     def clear_debug_view(self) -> None:
         """Forget the watchdog-derived state when the debug endpoint
@@ -119,6 +160,9 @@ class NodeStatus:
         self.stall_threshold_s = 0.0
         self.stall_alerts = []
         self.max_peer_lag = 0
+        self.restore_phase = ""
+        self._restore_progress_key = ()
+        self._restore_progress_at = 0.0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -234,7 +278,8 @@ class Monitor:
 
     def _poll_debug(self, ns: NodeStatus, daddr: str) -> None:
         """Scrape one node's /debug/consensus watchdog endpoint into its
-        NodeStatus (dwell, stall bundles, worst peer lag)."""
+        NodeStatus (dwell, stall bundles, worst peer lag), plus
+        /debug/statesync restore progress."""
         with urllib.request.urlopen(
                 f"http://{daddr}/debug/consensus", timeout=2.0) as r:
             data = json.load(r)
@@ -245,6 +290,19 @@ class Monitor:
         peers = (data.get("live") or {}).get("peers", [])
         ns.max_peer_lag = max(
             (int(p.get("lag_blocks", 0)) for p in peers), default=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://{daddr}/debug/statesync", timeout=2.0) as r:
+                ss = json.load(r)
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.note_restore("", 0, 0)
+            return
+        restore = ss.get("restore") or {}
+        ns.note_restore(
+            str(restore.get("phase", "")),
+            int(restore.get("chunks_applied", 0)),
+            int(restore.get("chunks_total", 0)),
+        )
 
     def _on_block(self, addr: str, ev: dict) -> None:
         ns = self.nodes[addr]
@@ -268,6 +326,10 @@ class Monitor:
         if not online:
             return HEALTH_DEAD
         heights = [n.height for n in online]
+        if any(n.restore_stuck for n in online):
+            # a bootstrap wedged mid-restore answers /status at height 0
+            # forever; that is degraded, not full
+            return HEALTH_MODERATE
         if (len(online) == len(statuses)
                 and max(heights) - min(heights) <= 1
                 # watchdog view: a node whose round has dwelt past its
@@ -317,6 +379,11 @@ class Monitor:
                     "stalled": n.stalled,
                     "stalls_total": n.stalls_total,
                     "max_peer_lag": n.max_peer_lag,
+                    "restore_phase": n.restore_phase,
+                    "restore_chunks": f"{n.restore_chunks_applied}/"
+                                      f"{n.restore_chunks_total}"
+                                      if n.restoring else "",
+                    "restore_stuck": n.restore_stuck,
                 }
                 for n in self.nodes.values()
             ],
@@ -357,6 +424,11 @@ def main(argv=None) -> int:
                              f" stalls={n['stalls_total']}")
                     if n["stalled"]:
                         line += " [STALLED]"
+                    if n["restore_phase"]:
+                        line += (f" restore={n['restore_phase']}"
+                                 f" {n['restore_chunks']}")
+                    if n["restore_stuck"]:
+                        line += " [RESTORE STUCK]"
                 print(line)
             for a in snap["stall_alerts"]:
                 print(f"  ALERT {a['addr']}: stall h={a.get('round_state', {}).get('height')} "

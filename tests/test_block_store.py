@@ -127,3 +127,79 @@ def test_height_persists_across_reopen():
     again = BlockStore(db)  # fresh instance over the same db
     assert again.height() == 2
     assert again.load_block(2) is not None
+
+
+# --- base tracking / prune / state-sync seed (PR 4) -------------------
+
+
+def test_base_tracks_first_block_and_persists():
+    db = MemDB()
+    store = BlockStore(db)
+    assert store.base() == 0 and store.height() == 0
+    _save_chain(store, 3)
+    assert store.base() == 1
+    # reopen: base survives alongside height
+    store2 = BlockStore(db)
+    assert store2.base() == 1 and store2.height() == 3
+
+
+def test_legacy_store_json_defaults_base_to_one():
+    """Stores written before base-tracking (json without "base") hold
+    full history: base must read as 1, not 0."""
+    import json as _json
+
+    db = MemDB()
+    store = BlockStore(db)
+    _save_chain(store, 2)
+    db.set(b"blockStore", _json.dumps({"height": 2}).encode())
+    assert BlockStore(db).base() == 1
+
+
+def test_prune_drops_history_and_moves_base():
+    store = BlockStore(MemDB())
+    blocks = _save_chain(store, 6)
+    pruned = store.prune(4)
+    assert pruned == 3
+    assert store.base() == 4 and store.height() == 6
+    for h in (1, 2, 3):
+        assert store.load_block(h) is None
+        assert store.load_block_meta(h) is None
+        assert store.load_seen_commit(h) is None
+    # the commit FOR base-1 is kept: block 4's LastCommit validation
+    # and /commit?height=3 still need it
+    assert store.load_block_commit(3) is not None
+    # blocks from base up are intact
+    for h in (4, 5, 6):
+        assert store.load_block(h).hash() == blocks[h - 1][0].hash()
+    # pruning is idempotent / monotonic
+    assert store.prune(4) == 0
+    with pytest.raises(ValueError):
+        store.prune(store.height() + 2)
+    with pytest.raises(ValueError):
+        store.prune(0)
+
+
+def test_seed_anchor_sets_height_base_and_commits():
+    store = BlockStore(MemDB())
+    commit = _commit_for(10)
+    store.seed_anchor(10, commit)
+    assert store.height() == 10
+    assert store.base() == 11
+    # both the seen and canonical commit slots carry the anchor so
+    # consensus LastCommit reconstruction and fast-sync validation work
+    assert store.load_seen_commit(10) is not None
+    assert store.load_block_commit(10) is not None
+    assert store.load_block(10) is None  # no block bytes below base
+    # a seeded store only accepts the NEXT height
+    with pytest.raises(ValueError):
+        blk = _block(1, None)
+        store.save_block(blk, make_part_set(blk, 256), _commit_for(1))
+
+
+def test_seed_anchor_refuses_nonempty_store():
+    store = BlockStore(MemDB())
+    _save_chain(store, 2)
+    with pytest.raises(ValueError):
+        store.seed_anchor(10, _commit_for(10))
+    with pytest.raises(ValueError):
+        BlockStore(MemDB()).seed_anchor(5, None)

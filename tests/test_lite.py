@@ -274,3 +274,72 @@ def test_wrong_chain_id_rejected():
     bv = BaseVerifier("other-chain", 3, vs)
     with pytest.raises(ErrLiteVerification):
         bv.verify(fc.signed_header)
+
+
+def test_verify_commit_trusting_batched_equals_sequential():
+    """Property (PR 4 satellite): _verify_commit_trusting's batched
+    verdict — through the process BatchVerifier, async dispatch on and
+    off — must agree exactly with a sequential per-signature loop, for
+    randomized commits with mixed validity (corrupted signatures,
+    absent votes, signers outside the trusted set)."""
+    import random
+
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.lite.verifier import (
+        ErrTooMuchChange,
+        _verify_commit_trusting,
+    )
+    from tendermint_tpu.types.validator_set import random_validator_set as rvs
+
+    rng = random.Random(0xC0FFEE)
+    for trial in range(8):
+        n = rng.randint(4, 10)
+        vals, keys = rvs(n, 10)
+        h = make_header(5, vals, vals)
+        commit = sign_header(h, vals, keys)
+        # mutate: drop some votes, corrupt some signatures
+        n_bad = 0
+        for i, v in enumerate(commit.precommits):
+            r = rng.random()
+            if r < 0.2:
+                commit.precommits[i] = None
+            elif r < 0.4 and v is not None:
+                v.signature = bytes([v.signature[0] ^ 1]) + v.signature[1:]
+                n_bad += 1
+        sh = SignedHeader(header=h, commit=commit)
+
+        # sequential ground truth: first invalid signature fails the
+        # commit; otherwise tally power and apply the >2/3 rule
+        def sequential():
+            tallied = 0
+            for v in commit.precommits:
+                if v is None:
+                    continue
+                idx, val = vals.get_by_address(v.validator_address)
+                if val is None:
+                    continue
+                if not val.pub_key.verify_bytes(
+                        v.sign_bytes(CHAIN), v.signature):
+                    return "invalid"
+                if v.block_id == commit.block_id:
+                    tallied += val.voting_power
+            total = vals.total_voting_power()
+            return "ok" if tallied * 3 > total * 2 else "too_little"
+
+        want = sequential()
+        for async_on in (False, True):
+            prev = crypto_batch.async_enabled()
+            crypto_batch.set_async_enabled(async_on)
+            try:
+                try:
+                    _verify_commit_trusting(vals, CHAIN, sh)
+                    got = "ok"
+                except ErrTooMuchChange:
+                    got = "too_little"
+                except ErrLiteVerification:
+                    got = "invalid"
+            finally:
+                crypto_batch.set_async_enabled(prev)
+            assert got == want, (
+                f"trial {trial} async={async_on}: batched verdict "
+                f"{got!r} != sequential {want!r} ({n} vals, {n_bad} bad)")

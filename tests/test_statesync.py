@@ -1,0 +1,658 @@
+"""State-sync tests (statesync/): chunk codec + Merkle binding, the
+kvstore app's ABCI snapshot surface, and the e2e contract — a fresh
+node bootstraps from a peer snapshot at H WITHOUT replaying 1..H,
+light-verifies the anchor through the batch-verifier path, fast-syncs
+the residual tail, and keeps committing; a peer serving corrupted
+chunks is banned and its chunks re-fetched from an honest peer.
+"""
+
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.statesync import chunker
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+CHAIN = "statesync-chain"
+
+
+# --- chunk codec ------------------------------------------------------
+
+
+def test_chunk_roundtrip_and_root():
+    data = os.urandom(10_000)
+    chunks = chunker.chunk_bytes(data, 1024)
+    assert len(chunks) == 10
+    assert chunker.reassemble(chunks) == data
+    hashes = chunker.chunk_hashes(chunks)
+    root = chunker.root_of(hashes)
+    assert chunker.verify_hashes(hashes, root)
+    for i, c in enumerate(chunks):
+        assert chunker.verify_chunk(c, i, hashes)
+    # empty payload still yields one verifiable chunk
+    empty = chunker.chunk_bytes(b"", 1024)
+    assert empty == [b""]
+    assert chunker.verify_hashes(chunker.chunk_hashes(empty),
+                                 chunker.root_of(chunker.chunk_hashes(empty)))
+    with pytest.raises(ValueError):
+        chunker.chunk_bytes(b"x", 0)
+
+
+def test_corrupted_chunk_rejected():
+    chunks = chunker.chunk_bytes(os.urandom(4096), 512)
+    hashes = chunker.chunk_hashes(chunks)
+    bad = bytes([chunks[3][0] ^ 0xFF]) + chunks[3][1:]
+    assert not chunker.verify_chunk(bad, 3, hashes)
+    assert not chunker.verify_chunk(chunks[3], 4, hashes)  # wrong index
+    assert not chunker.verify_chunk(chunks[3], 99, hashes)  # out of range
+    # a tampered hash list no longer commits to the root
+    root = chunker.root_of(hashes)
+    tampered = list(hashes)
+    tampered[2] = b"\x00" * 32
+    assert not chunker.verify_hashes(tampered, root)
+
+
+def test_chunk_merkle_proof_rejects_corruption():
+    """Proof-carrying variant: the SimpleProof for a chunk's hash binds
+    position and content to the snapshot root."""
+    chunks = chunker.chunk_bytes(os.urandom(4096), 512)
+    root, proof = chunker.chunk_proof(chunks, 5)
+    assert root == chunker.root_of(chunker.chunk_hashes(chunks))
+    assert proof.verify(root, chunker.chunk_hash(chunks[5]))
+    bad = bytes([chunks[5][0] ^ 1]) + chunks[5][1:]
+    assert not proof.verify(root, chunker.chunk_hash(bad))
+    # proof for chunk 5 must not verify chunk 6's hash (position-binding)
+    assert not proof.verify(root, chunker.chunk_hash(chunks[6]))
+
+
+# --- ABCI codec -------------------------------------------------------
+
+
+def test_snapshot_abci_codec_roundtrip():
+    from tendermint_tpu.abci.codec import REQUEST_CODECS, RESPONSE_CODECS
+
+    snap = abci.Snapshot(height=42, format=1, chunks=3, hash=b"\x01" * 32,
+                         chunk_hashes=[b"\x02" * 32] * 3, metadata=b"m")
+    for key, req in (
+        ("list_snapshots", abci.RequestListSnapshots()),
+        ("load_snapshot_chunk",
+         abci.RequestLoadSnapshotChunk(height=42, format=1, chunk=2)),
+        ("offer_snapshot",
+         abci.RequestOfferSnapshot(snapshot=snap, app_hash=b"\x03" * 28)),
+        ("apply_snapshot_chunk",
+         abci.RequestApplySnapshotChunk(index=1, chunk=b"data", sender="p1")),
+    ):
+        assert REQUEST_CODECS[key].decode(REQUEST_CODECS[key].encode(req)) == req
+    for key, res in (
+        ("list_snapshots", abci.ResponseListSnapshots(snapshots=[snap])),
+        ("load_snapshot_chunk", abci.ResponseLoadSnapshotChunk(chunk=b"d")),
+        ("offer_snapshot",
+         abci.ResponseOfferSnapshot(result=abci.OFFER_ACCEPT)),
+        ("apply_snapshot_chunk",
+         abci.ResponseApplySnapshotChunk(result=abci.APPLY_RETRY,
+                                         refetch_chunks=[1, 2],
+                                         reject_senders=["p1"])),
+    ):
+        assert RESPONSE_CODECS[key].decode(RESPONSE_CODECS[key].encode(res)) == res
+
+
+def test_snapshot_surface_over_socket():
+    """The new methods cross the ABCI process boundary intact."""
+    from tendermint_tpu.abci.client import SocketClient
+    from tendermint_tpu.abci.server import ABCIServer
+
+    app = KVStoreApplication()
+    app.snapshot_interval, app.snapshot_chunk_size = 1, 64
+    app.deliver_tx(b"a=1")
+    app.commit()
+    srv = ABCIServer("tcp://127.0.0.1:0", app)
+    srv.start()
+    try:
+        client = SocketClient(f"tcp://127.0.0.1:{srv.local_port()}")
+        snaps = client.list_snapshots(abci.RequestListSnapshots()).snapshots
+        assert len(snaps) == 1 and snaps[0].height == 1
+        c0 = client.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+            height=1, format=snaps[0].format, chunk=0)).chunk
+        assert chunker.verify_chunk(c0, 0, snaps[0].chunk_hashes)
+        client.close()
+    finally:
+        srv.stop()
+
+
+# --- kvstore snapshot surface -----------------------------------------
+
+
+def _producer_app(blocks=5, interval=2, chunk_size=64):
+    a = KVStoreApplication()
+    a.snapshot_interval, a.snapshot_chunk_size = interval, chunk_size
+    for i in range(blocks):
+        a.deliver_tx(b"key-%d=value-%d" % (i, i))
+        a.commit()
+    return a
+
+
+def test_kvstore_snapshot_interval_and_keep():
+    a = _producer_app(blocks=10, interval=2)
+    a.snapshot_keep = 2
+    a.deliver_tx(b"x=y")
+    a.commit()  # height 11: no snapshot, but keep is enforced next take
+    a.commit()  # height 12: snapshot + eviction down to keep=2
+    snaps = a.list_snapshots(abci.RequestListSnapshots()).snapshots
+    # keep=2: only the newest two interval heights survive
+    assert [s.height for s in snaps] == [10, 12]
+    for s in snaps:
+        assert s.chunks == len(s.chunk_hashes) > 0
+        assert chunker.verify_hashes(s.chunk_hashes, s.hash)
+    # unknown chunk coordinates answer empty
+    assert a.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+        height=99, format=1, chunk=0)).chunk == b""
+
+
+def _restore_into_fresh(a, snap, sender="peer-a", corrupt_index=None):
+    b = KVStoreApplication()
+    res = b.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=snap, app_hash=a.app_hash))
+    assert res.result == abci.OFFER_ACCEPT
+    results = []
+    for i in range(snap.chunks):
+        data = a.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+            height=snap.height, format=snap.format, chunk=i)).chunk
+        if i == corrupt_index:
+            data = b"\xff" + data[1:]
+        results.append(b.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+            index=i, chunk=data, sender=sender)))
+    return b, results
+
+
+def test_kvstore_restore_roundtrip_matches_app_hash():
+    a = _producer_app(blocks=6, interval=3, chunk_size=48)
+    snap = a.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+    assert snap.height == 6
+    b, results = _restore_into_fresh(a, snap)
+    assert all(r.result == abci.APPLY_ACCEPT for r in results)
+    assert (b.height, b.size, b.app_hash) == (a.height, a.size, a.app_hash)
+    # restored app serves queries like the original
+    q = b.query(abci.RequestQuery(data=b"key-3", path="/store"))
+    assert q.value == b"value-3"
+
+
+def test_kvstore_bad_chunk_asks_refetch_and_names_sender():
+    a = _producer_app(blocks=4, interval=2, chunk_size=32)
+    snap = a.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+    b, results = _restore_into_fresh(a, snap, sender="evil-peer",
+                                     corrupt_index=1)
+    r = results[1]
+    assert r.result == abci.APPLY_RETRY
+    assert r.refetch_chunks == [1]
+    assert r.reject_senders == ["evil-peer"]
+
+
+def test_kvstore_offer_rejects_garbage():
+    b = KVStoreApplication()
+    s = abci.Snapshot(height=4, format=1, chunks=2, hash=b"\x01" * 32,
+                      chunk_hashes=[b"\x02" * 32, b"\x03" * 32])
+    # hash list doesn't commit to root
+    assert b.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=s)).result == abci.OFFER_REJECT
+    # unknown format
+    hashes = chunker.chunk_hashes([b"x", b"y"])
+    s2 = abci.Snapshot(height=4, format=9, chunks=2,
+                       hash=chunker.root_of(hashes), chunk_hashes=hashes)
+    assert b.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=s2)).result == abci.OFFER_REJECT_FORMAT
+    # chunkless
+    assert b.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=abci.Snapshot())).result == abci.OFFER_REJECT
+    # apply without an accepted offer aborts
+    assert b.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+        index=0, chunk=b"")).result == abci.APPLY_ABORT
+
+
+def test_kvstore_rejects_payload_lying_about_height():
+    """The kvstore app hash covers kv data + size but NOT height, so a
+    payload claiming a different height than the offered snapshot must
+    be rejected at apply time, not discovered post-install."""
+    a = _producer_app(blocks=4, interval=2, chunk_size=10_000)
+    snap = a.list_snapshots(abci.RequestListSnapshots()).snapshots[-1]
+    assert snap.chunks == 1
+    data = a.load_snapshot_chunk(abci.RequestLoadSnapshotChunk(
+        height=snap.height, format=snap.format, chunk=0)).chunk
+    from tendermint_tpu.types import serde
+
+    height, size, app_hash, items = serde.unpack(data)
+    forged = serde.pack([height + 7, size, app_hash, items])
+    forged_hashes = chunker.chunk_hashes([forged])
+    forged_snap = abci.Snapshot(
+        height=snap.height, format=snap.format, chunks=1,
+        hash=chunker.root_of(forged_hashes), chunk_hashes=forged_hashes)
+    b = KVStoreApplication()
+    assert b.offer_snapshot(abci.RequestOfferSnapshot(
+        snapshot=forged_snap,
+        app_hash=a.app_hash)).result == abci.OFFER_ACCEPT
+    r = b.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
+        index=0, chunk=forged, sender="liar"))
+    assert r.result == abci.APPLY_REJECT_SNAPSHOT
+
+
+# --- e2e: full nodes --------------------------------------------------
+
+
+def _make_config(tmp_path, name, snapshot_interval=0, statesync_enable=False,
+                 persistent_peers=""):
+    c = cfg.test_config()
+    c.set_root(str(tmp_path / name))
+    c.base.proxy_app = "kvstore"
+    c.base.moniker = name
+    c.rpc.laddr = ""
+    c.p2p.laddr = "tcp://127.0.0.1:0"
+    c.p2p.pex = False
+    c.p2p.persistent_peers = persistent_peers
+    c.consensus.wal_path = "data/cs.wal/wal"
+    # a realistic block cadence: at full test speed (~10 empty blocks/s)
+    # a producer evicts its keep-recent snapshot window faster than any
+    # restorer can discover + fetch it
+    c.consensus.create_empty_blocks_interval = 0.25
+    c.statesync.snapshot_interval = snapshot_interval
+    c.statesync.chunk_size = 64  # many chunks -> multi-peer fetch
+    c.statesync.enable = statesync_enable
+    c.statesync.discovery_time_s = 1.0
+    c.statesync.restore_timeout_s = 45.0
+    return c
+
+
+def _init_files(c, genesis_doc=None):
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    cfg.ensure_root(c.root_dir)
+    NodeKey.load_or_gen(c.base.node_key_path())
+    pv = load_or_gen_file_pv(c.base.priv_validator_path())
+    if genesis_doc is None:
+        genesis_doc = GenesisDoc(
+            chain_id=CHAIN,
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+    genesis_doc.save(c.base.genesis_path())
+    return genesis_doc
+
+
+def _p2p_addr(node) -> str:
+    return f"{node.node_key.id}@{node.transport.listen_addr}"
+
+
+def _feed_txs(node, n, prefix=b"seed"):
+    """Put real data in the producer's app so snapshots span MANY
+    64-byte chunks — the multi-peer fetch paths need a work queue
+    deeper than the worker count."""
+    for i in range(n):
+        node.mempool.check_tx(prefix + b"-%d=%s" % (i, b"v" * 40))
+
+
+def _wait_height(node, h, timeout, sub=None):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if node.block_store.height() >= h:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _collect_new_heights(sub, want, timeout):
+    got = []
+    deadline = time.time() + timeout
+    while len(got) < want and time.time() < deadline:
+        msg = sub.get(timeout=0.25)
+        if msg is not None:
+            got.append(msg.data["block"].header.height)
+    return got
+
+
+def test_e2e_fresh_node_restores_from_snapshot_then_fast_syncs(tmp_path):
+    """The acceptance-criteria e2e: producer snapshots at interval
+    heights; a fresh node state-syncs to the snapshot height H without
+    ever holding blocks 1..H, fast-syncs the residual tail, and keeps
+    committing new heights. The anchor trust chain runs through
+    lite.DynamicVerifier whose commit checks all land in
+    crypto/batch.BatchVerifier (BaseVerifier -> verify_commit)."""
+    ca = _make_config(tmp_path, "producer", snapshot_interval=2)
+    genesis = _init_files(ca)
+    a = default_new_node(ca)
+    a.start()
+    b = None
+    try:
+        _feed_txs(a, 40)
+        # producer needs height >= snapshot+ANCHOR_LEAD to advertise
+        assert _wait_height(a, 7, timeout=60), \
+            f"producer stuck at {a.block_store.height()}"
+        cb = _make_config(tmp_path, "joiner", statesync_enable=True,
+                          persistent_peers=_p2p_addr(a))
+        _init_files(cb, genesis_doc=genesis)
+        b = default_new_node(cb)
+        assert b.state_syncer is not None, "fresh node must bootstrap"
+        sub_b = b.event_bus.subscribe(
+            "tb", query_for_event(EVENT_NEW_BLOCK), 256)
+        b.start()
+
+        # restore completes: block store seeded past genesis
+        deadline = time.time() + 60
+        while time.time() < deadline and b.block_store.base() <= 1:
+            time.sleep(0.2)
+        assert b.block_store.base() > 1, (
+            f"restore never finished: {b.state_syncer.status()}")
+        restored_h = b.block_store.base() - 1
+        assert restored_h >= 2 and restored_h % 2 == 0
+
+        # the whole point: blocks 1..H were never replayed or stored
+        for h in range(1, restored_h + 1):
+            assert b.block_store.load_block(h) is None
+        # ...but the anchor commit is installed for consensus handoff
+        assert b.block_store.load_seen_commit(restored_h) is not None
+
+        # fast sync covers the tail and the node keeps committing NEW
+        # heights past the producer's tip at restore time
+        heights = _collect_new_heights(sub_b, 3, timeout=60)
+        assert len(heights) >= 3, f"joiner saw only {heights}"
+        assert all(h > restored_h for h in heights)
+        # joiner agrees with the producer's chain on a fast-synced block
+        hb = heights[0]
+        assert a.block_store.load_block(hb).hash() == \
+            b.block_store.load_block(hb).hash()
+
+        # the restored app actually carries the producer's data
+        q = b.proxy_app.query.query(abci.RequestQuery(
+            data=b"seed-0", path="/store"))
+        assert q.value == b"v" * 40
+
+        # restore bookkeeping: phase done, record persisted, /debug
+        # payload well-formed JSON
+        st = b.state_syncer.status()
+        assert st["phase"] == "done" and st["error"] is None
+        assert st["chunks_applied"] == st["chunks_total"] > 0
+        assert b.snapshot_store.restored()["height"] == restored_h
+        assert a.snapshot_reactor.chunks_served > 0
+        assert b.snapshot_reactor.chunks_received > 0
+        json.dumps(b._statesync_status(), default=str)
+        # satellite: /status sync_info exposes the pruned base
+        assert b.block_store.base() == restored_h + 1
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+class _AbsorbReactor:
+    """Owns the non-statesync channels on the malicious switch and
+    swallows everything — the restorer's consensus/blockchain/mempool
+    reactors greet new peers on those channels, and an unowned channel
+    would make the malicious switch drop the connection before any
+    chunk request arrives."""
+
+    def __init__(self, ids):
+        from tendermint_tpu.p2p.base_reactor import Reactor
+
+        self._base = Reactor("Absorb")
+        self.name = "Absorb"
+        self.switch = None
+        self._ids = ids
+
+    def set_switch(self, sw):
+        self.switch = sw
+
+    def get_channels(self):
+        from tendermint_tpu.p2p.base_reactor import ChannelDescriptor
+
+        return [ChannelDescriptor(id=i, priority=1) for i in self._ids]
+
+    def init_peer(self, peer):
+        pass
+
+    def add_peer(self, peer):
+        pass
+
+    def remove_peer(self, peer, reason):
+        pass
+
+    def receive(self, ch_id, peer, msg_bytes):
+        pass
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+@pytest.mark.slow  # three-party p2p setup: ~25s of wall clock
+def test_e2e_malicious_chunk_peer_banned_then_restore_succeeds(tmp_path):
+    """Two peers offer the SAME snapshot; one serves corrupted chunk
+    bytes. The restorer must catch the hash mismatch at the p2p
+    boundary, ban the malicious peer, re-request its chunks from the
+    honest one, and still finish the restore."""
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.p2p import (
+        MultiplexTransport,
+        NodeInfo,
+        NodeKey,
+        ProtocolVersion,
+        Switch,
+    )
+    from tendermint_tpu.statesync.reactor import SnapshotReactor
+
+    ca = _make_config(tmp_path, "honest", snapshot_interval=2)
+    genesis = _init_files(ca)
+    a = default_new_node(ca)
+    a.start()
+    msw = c_node = None
+    try:
+        _feed_txs(a, 60)
+        assert _wait_height(a, 7, timeout=60)
+
+        # malicious peer: a bare switch whose snapshot reactor serves
+        # from the HONEST node's stores (guaranteed-identical offers)
+        # but flips a byte in every chunk it sends
+        class EvilSnapshotReactor(SnapshotReactor):
+            def _on_chunk_request(self, peer, obj):
+                height, format_, index = int(obj[1]), int(obj[2]), int(obj[3])
+                data = self.snapshots.load_chunk(height, format_, index)
+                if data is None:
+                    return
+                evil = bytes([data[0] ^ 0xFF]) + data[1:]
+                from tendermint_tpu.statesync.reactor import (
+                    CHUNK_CHANNEL,
+                    _enc,
+                )
+
+                peer.try_send(CHUNK_CHANNEL, _enc(
+                    ["chunk_response", height, format_, index, evil]))
+
+        mk = NodeKey(PrivKeyEd25519.generate())
+        mi = NodeInfo(
+            protocol_version=ProtocolVersion(), id=mk.id, listen_addr="",
+            network=genesis.chain_id, version="dev",
+            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40,
+                            0x60, 0x61]),
+            moniker="evil",
+        )
+        mt = MultiplexTransport(mi, mk)
+        mt.listen("127.0.0.1:0")
+        mi.listen_addr = mt.listen_addr
+        msw = Switch(mt)
+        msw.add_reactor("ABSORB", _AbsorbReactor(
+            [0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40]))
+        evil = EvilSnapshotReactor(a.snapshot_store, a.block_store,
+                                   a.state_db)
+        msw.add_reactor("STATESYNC", evil)
+        msw.start()
+
+        cc = _make_config(tmp_path, "restorer", statesync_enable=True)
+        cc.statesync.discovery_time_s = 3.0
+        _init_files(cc, genesis_doc=genesis)
+        c_node = default_new_node(cc)
+        c_node.start()
+        # deterministic wiring: dial both sources synchronously
+        assert c_node.sw.dial_peer(a.transport.listen_addr,
+                                   expect_id=a.node_key.id) is not None
+        assert c_node.sw.dial_peer(mt.listen_addr,
+                                   expect_id=mk.id) is not None
+
+        deadline = time.time() + 90
+        while time.time() < deadline and c_node.block_store.base() <= 1:
+            time.sleep(0.2)
+        st = c_node.state_syncer.status()
+        assert c_node.block_store.base() > 1, f"restore failed: {st}"
+        # the malicious peer served >= 1 bad chunk, got banned, and the
+        # restore completed anyway via the honest peer
+        assert c_node.snapshot_reactor.chunks_rejected >= 1
+        assert mk.id[:12] in st["banned_peers"]
+        assert not c_node.sw.peers.has(mk.id)
+        assert st["phase"] == "done"
+        assert a.snapshot_reactor.chunks_served > 0
+    finally:
+        if c_node is not None:
+            c_node.stop()
+        if msw is not None:
+            msw.stop()
+        a.stop()
+
+
+@pytest.mark.slow  # burns the full restore_timeout before falling back
+def test_e2e_no_snapshots_falls_back_to_fast_sync(tmp_path):
+    """A statesync-enabled joiner whose peers offer nothing must fall
+    back to plain fast sync from genesis, not hang at height 0."""
+    ca = _make_config(tmp_path, "plain-producer")  # no snapshots
+    genesis = _init_files(ca)
+    a = default_new_node(ca)
+    a.start()
+    b = None
+    try:
+        assert _wait_height(a, 4, timeout=60)
+        cb = _make_config(tmp_path, "fallback-joiner", statesync_enable=True,
+                          persistent_peers=_p2p_addr(a))
+        cb.statesync.restore_timeout_s = 4.0
+        _init_files(cb, genesis_doc=genesis)
+        b = default_new_node(cb)
+        b.start()
+        assert _wait_height(b, 4, timeout=60), \
+            f"fallback never synced: {b.state_syncer.status()}"
+        # full history present — this was a replay, not a restore
+        assert b.block_store.base() == 1
+        assert b.block_store.load_block(1) is not None
+        assert b.state_syncer.status()["phase"] == "failed"
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+# --- monitor surfaces restore progress --------------------------------
+
+
+def _stub_debug_server(payloads: dict):
+    """Serve per-path JSON payloads (/debug/consensus, /debug/statesync)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            payload = payloads.get(self.path.split("?")[0])
+            if payload is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host, port = srv.server_address[:2]
+    return srv, f"{host}:{port}"
+
+
+def test_monitor_surfaces_restore_progress_and_stuck_health():
+    from tendermint_tpu.tools.monitor import (
+        HEALTH_FULL,
+        HEALTH_MODERATE,
+        Monitor,
+    )
+
+    payloads = {
+        "/debug/consensus": {"dwell_s": 0.1, "threshold_s": 30.0,
+                             "stalls_total": 0, "stalls": [],
+                             "live": {"peers": []}},
+        "/debug/statesync": {"chunks_served": 0,
+                             "restore": {"phase": "fetch",
+                                         "chunks_applied": 3,
+                                         "chunks_total": 10}},
+    }
+    srv, daddr = _stub_debug_server(payloads)
+    try:
+        mon = Monitor(["rpc"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc"]
+        ns.mark_online()
+        mon._poll_debug(ns, daddr)
+        assert ns.restoring and ns.restore_phase == "fetch"
+        assert (ns.restore_chunks_applied, ns.restore_chunks_total) == (3, 10)
+        snap = mon.snapshot()
+        node = snap["nodes"][0]
+        assert node["restore_phase"] == "fetch"
+        assert node["restore_chunks"] == "3/10"
+        # fresh progress: not stuck, health stays full
+        assert not ns.restore_stuck
+        assert mon.health() == HEALTH_FULL
+
+        # progress freezes past the stuck threshold -> degraded health
+        ns._restore_progress_at = time.time() - ns.RESTORE_STUCK_S - 1
+        mon._poll_debug(ns, daddr)  # same (phase, applied) -> no advance
+        assert ns.restore_stuck
+        assert mon.health() == HEALTH_MODERATE
+
+        # progress resumes -> healthy again
+        payloads["/debug/statesync"]["restore"]["chunks_applied"] = 7
+        mon._poll_debug(ns, daddr)
+        assert not ns.restore_stuck
+        assert mon.health() == HEALTH_FULL
+
+        # terminal phase is not "restoring" at all
+        payloads["/debug/statesync"]["restore"]["phase"] = "done"
+        mon._poll_debug(ns, daddr)
+        assert not ns.restoring and not ns.restore_stuck
+        # endpoint vanishes -> view cleared, no stale stuck flag
+        del payloads["/debug/statesync"]
+        mon._poll_debug(ns, daddr)
+        assert ns.restore_phase == "" and mon.health() == HEALTH_FULL
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_statesync_config_toml_roundtrip():
+    c = cfg.Config()
+    c.statesync.enable = True
+    c.statesync.snapshot_interval = 100
+    c.statesync.chunk_size = 4096
+    c.statesync.trust_height = 7
+    c.statesync.trust_hash = "ab" * 32
+    c2 = cfg.Config.from_toml(c.to_toml())
+    assert c2.statesync.enable is True
+    assert c2.statesync.snapshot_interval == 100
+    assert c2.statesync.chunk_size == 4096
+    assert c2.statesync.trust_height == 7
+    assert c2.statesync.trust_hash == "ab" * 32
