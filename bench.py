@@ -46,10 +46,11 @@ FASTSYNC_MODE = "fastsync" in sys.argv[1:]  # BASELINE.json config 4 (scaled)
 COMMIT4_MODE = "commit4" in sys.argv[1:]  # BASELINE.json config 1
 CACHE_MODE = "cache" in sys.argv[1:]  # duplicate-heavy sig-cache mode
 STATESYNC_MODE = "statesync" in sys.argv[1:]  # restore vs replay (PR 4)
+CHAOS_MODE = "chaos" in sys.argv[1:]  # ABCI reconnect recovery (PR 5)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
-                      "statesync", "--pipeline")]
+                      "statesync", "chaos", "--pipeline")]
 try:
     METRIC_N = int(_args[0]) if _args else 10000
 except ValueError:
@@ -79,6 +80,8 @@ CACHE_METRIC = f"sig_cache_{CACHE_DUPS}x{CACHE_NVAL}dup_wall_ms"
 SS_NBLOCKS = _env_int("TM_TPU_BENCH_SS_BLOCKS", 20)
 SS_NVAL = _env_int("TM_TPU_BENCH_SS_NVAL", 100)
 SS_METRIC = f"statesync_restore_vs_replay_{SS_NBLOCKS}x{SS_NVAL}val_wall_ms"
+CHAOS_ROUNDS = _env_int("TM_TPU_BENCH_CHAOS_ROUNDS", 10)
+CHAOS_METRIC = f"abci_reconnect_recovery_{CHAOS_ROUNDS}rounds_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -678,11 +681,92 @@ def commit4_main():
     }))
 
 
+def chaos_main():
+    """`bench.py chaos` — ABCI reconnect recovery latency: a real
+    kvstore socket app, a ResilientClient(retry) supervising the
+    connection, and a ChaosClient injecting a hard disconnect each
+    round. Measures wall from the failed in-flight call to the first
+    call served on the redialed connection (the window in which a
+    mempool/query conn fails soft). Pure host path: no TPU."""
+    import threading
+
+    from tendermint_tpu.abci import types as abci_types
+    from tendermint_tpu.abci.chaos import ChaosClient, ChaosRule
+    from tendermint_tpu.abci.client import ABCIClientError, SocketClient
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.server import ABCIServer
+    from tendermint_tpu.proxy.resilient import ResilientClient
+
+    srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApplication())
+    srv.start()
+    addr = f"tcp://127.0.0.1:{srv.local_port()}"
+
+    chaos_handle = []
+
+    def creator():
+        c = ChaosClient(SocketClient(addr, request_timeout=2.0), seed=7)
+        chaos_handle.append(c)
+        return c
+
+    client = ResilientClient(
+        "bench", creator, policy="retry",
+        backoff_base_s=0.005, backoff_max_s=0.05, retry_budget=5)
+    client.start()
+
+    recoveries_ms = []
+    try:
+        for round_i in range(CHAOS_ROUNDS):
+            # healthy steady state
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    client.check_tx(b"k%d=v" % round_i)
+                    break
+                except ABCIClientError:
+                    time.sleep(0.002)
+            else:
+                raise RuntimeError("conn never became healthy")
+            # one-shot hard disconnect on the CURRENT transport
+            chaos_handle[-1].rules.append(
+                ChaosRule("disconnect", methods=("echo",), max_fires=1))
+            t0 = time.perf_counter()
+            try:
+                client.echo("boom")
+            except ABCIClientError:
+                pass  # the in-flight call fails soft by design
+            while True:
+                try:
+                    client.echo("recovered?")
+                    break
+                except ABCIClientError:
+                    time.sleep(0.001)
+            recoveries_ms.append((time.perf_counter() - t0) * 1000)
+    finally:
+        client.close()
+        srv.stop()
+
+    mean_ms = sum(recoveries_ms) / len(recoveries_ms)
+    print(json.dumps({
+        "metric": CHAOS_METRIC,
+        "value": round(mean_ms, 3),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "note": ("mean wall from injected disconnect to first call on "
+                 "the redialed conn; best %.3f worst %.3f over %d rounds"
+                 % (min(recoveries_ms), max(recoveries_ms),
+                    len(recoveries_ms))),
+        "reconnects": client.reconnects,
+    }))
+    return 0
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
         # pure host path: never touch (or wait for) the TPU backend
         return commit4_main()
+    if CHAOS_MODE:
+        return chaos_main()
     degraded = None
     if os.environ.get("TM_TPU_BENCH_FORCE_CPU"):
         degraded = "cpu8-forced"  # BASELINE config 2: by-design CPU mode

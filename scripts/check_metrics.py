@@ -219,6 +219,15 @@ REQUIRED_FAMILIES = (
     "statesync_chunks_rejected_total",
     "statesync_restore_chunks_applied",
     "statesync_restore_phase_seconds",
+    # PR-5 ABCI resilience: per-request deadlines + supervised reconnect
+    # (timeouts/reconnects legitimately record nothing on a healthy
+    # node; conn_state and request durations are always live)
+    "abci_request_duration_seconds",
+    "abci_request_timeouts_total",
+    "abci_reconnects_total",
+    "abci_conn_state",
+    "mempool_recheck_failures_total",
+    "wal_corrupted_records_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -1,0 +1,141 @@
+"""Chaos proxy — deterministic fault injection for the ABCI link.
+
+No reference equivalent (the closest is p2p/fuzz.go, which perturbs the
+p2p transport); this wraps an ABCI `Client` and injects the failure
+modes a real out-of-process app exhibits, so the resilience layer
+(proxy.resilient.ResilientClient, request deadlines, mempool fail-soft)
+can be exercised deterministically in-process:
+
+- ``delay``       sleep `delay_s`, then pass the call through
+- ``timeout``     the request deadline fires: close the inner transport
+                  (a timed-out socket is desynchronized) and raise
+                  ABCITimeoutError
+- ``disconnect``  the app process died mid-request: close the inner
+                  transport and raise ABCIConnectionError
+- ``exception``   the app raised (socket server's exception frame):
+                  raise plain ABCIClientError — the conn stays usable
+- ``garbage``     an undecodable/mismatched response frame: raise
+                  ABCIConnectionError carrying seeded random bytes
+
+Faults fire per-method via `ChaosRule`s from a seeded PRNG, so a given
+(seed, rule set, call sequence) replays identically. With no rules the
+wrapper is a pure pass-through (byte-identical responses).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Sequence
+
+from .client import (
+    METHODS,
+    ABCIClientError,
+    ABCIConnectionError,
+    ABCITimeoutError,
+    Client,
+)
+
+FAULT_KINDS = ("delay", "timeout", "disconnect", "exception", "garbage")
+
+
+@dataclass
+class ChaosRule:
+    """One per-method fault rule. `methods` is a tuple of ABCI method
+    names (or `("*",)` for all); `probability` is evaluated per matching
+    call against the client's seeded PRNG; `max_fires` bounds how many
+    times the rule triggers (-1 = unlimited)."""
+
+    fault: str
+    methods: Sequence[str] = ("*",)
+    probability: float = 1.0
+    delay_s: float = 0.0
+    max_fires: int = -1
+    fired: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.fault not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault {self.fault!r}; one of {FAULT_KINDS}")
+
+    def matches(self, method: str) -> bool:
+        if self.max_fires >= 0 and self.fired >= self.max_fires:
+            return False
+        return "*" in self.methods or method in self.methods
+
+
+class ChaosClient(Client):
+    """Fault-injecting ABCI client wrapper (see module doc)."""
+
+    def __init__(self, inner: Client, rules: Sequence[ChaosRule] = (),
+                 seed: int = 0):
+        self.inner = inner
+        self.rules = list(rules)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # fault kind -> times injected, for tests/bench introspection
+        self.injected: Dict[str, int] = {k: 0 for k in FAULT_KINDS}
+
+    # -- fault engine --------------------------------------------------
+
+    def _pick_fault(self, method: str):
+        """First matching rule that passes its probability roll wins.
+        The PRNG is consumed ONLY for probabilistic rules (p < 1), so
+        deterministic rule sets replay regardless of call interleaving."""
+        with self._lock:
+            for rule in self.rules:
+                if not rule.matches(method):
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                rule.fired += 1
+                self.injected[rule.fault] += 1
+                return rule
+        return None
+
+    def _invoke(self, method: str, *args):
+        rule = self._pick_fault(method)
+        if rule is not None:
+            if rule.fault == "delay":
+                time.sleep(rule.delay_s)
+            elif rule.fault == "timeout":
+                if rule.delay_s > 0:
+                    time.sleep(rule.delay_s)
+                self.inner.close()
+                raise ABCITimeoutError(
+                    f"chaos: injected request timeout on {method}")
+            elif rule.fault == "disconnect":
+                self.inner.close()
+                raise ABCIConnectionError(
+                    f"chaos: injected disconnect on {method}")
+            elif rule.fault == "exception":
+                raise ABCIClientError(
+                    f"app exception: chaos injected on {method}")
+            elif rule.fault == "garbage":
+                junk = bytes(self._rng.getrandbits(8) for _ in range(8))
+                raise ABCIConnectionError(
+                    f"chaos: garbage response for {method}: "
+                    f"0x{junk.hex()}")
+        return getattr(self.inner, method)(*args)
+
+    # Client interface: a uniform pass-through generated over METHODS
+    # (see below), plus close
+
+    def close(self):
+        self.inner.close()
+
+
+def _make_method(name: str):
+    def call(self, *args):
+        return self._invoke(name, *args)
+
+    call.__name__ = name
+    return call
+
+
+for _m in METHODS:
+    setattr(ChaosClient, _m, _make_method(_m))
+del _m

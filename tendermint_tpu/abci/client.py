@@ -11,6 +11,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+from time import monotonic as time_monotonic
 from typing import Optional
 
 import msgpack
@@ -20,8 +21,44 @@ from .codec import REQUEST_CODECS, RESPONSE_CODECS
 from .server import MAX_MSG_SIZE
 
 
+# the full ABCI method surface, in one place: the Client interface below,
+# the chaos proxy and the resilient supervisor all interpose on exactly
+# this list (adding an ABCI method = add it here + a Client method)
+METHODS = (
+    "echo", "flush", "info", "set_option", "query", "check_tx",
+    "init_chain", "begin_block", "deliver_tx", "end_block", "commit",
+    "list_snapshots", "load_snapshot_chunk", "offer_snapshot",
+    "apply_snapshot_chunk",
+)
+
+
 class ABCIClientError(Exception):
-    pass
+    """Any ABCI client failure (base; reference abci/client errors)."""
+
+
+class ABCIConnectionError(ABCIClientError):
+    """Transport-level failure: dial refused, EOF mid-frame, reset,
+    truncated/oversized/garbage frame. The connection is unusable and a
+    supervisor (proxy.resilient.ResilientClient) may redial; an app
+    EXCEPTION frame is deliberately NOT this class — the conn is fine,
+    the app raised."""
+
+
+class ABCITimeoutError(ABCIConnectionError):
+    """A per-request deadline ([abci] request_timeout_s) expired. A
+    timed-out socket is desynchronized (the response may still arrive
+    and would be mis-matched to the next request), so this is a
+    connection-level error: the client closes the socket and a
+    supervisor must redial."""
+
+
+class ABCIAppRestartedError(ABCIClientError):
+    """Raised by the resilient consensus connection after it reconnected
+    to a restarted app and re-synced it (on_failure = "handshake"): the
+    app is back at the last committed height, but the in-flight request
+    died with the old process. The caller must re-drive its whole unit
+    of work (BlockExecutor.apply_block retries the full block) — never
+    resume mid-block, so a half-applied block can't be committed twice."""
 
 
 class Client:
@@ -151,32 +188,86 @@ class LocalClient(Client):
 
 
 class SocketClient(Client):
-    """Length-prefixed msgpack frames over TCP or unix socket."""
+    """Length-prefixed msgpack frames over TCP or unix socket.
 
-    def __init__(self, address: str, timeout: float = 10.0):
+    `request_timeout` > 0 arms a per-request deadline on every call
+    (the reference's socket client has none — a wedged app blocks
+    forever); on expiry the socket is closed (it is desynchronized) and
+    ABCITimeoutError raised for a supervisor to redial."""
+
+    def __init__(self, address: str, timeout: float = 10.0,
+                 request_timeout: float = 0.0):
         self.address = address
+        self.request_timeout = request_timeout
         self._lock = threading.Lock()
-        self._sock = _dial(address, timeout)
-        self._rfile = self._sock.makefile("rb")
+        self._sock = _dial(address, timeout,
+                           request_timeout if request_timeout > 0 else None)
+        self._broken = False
+
+    def _recv_exact(self, n: int, deadline) -> bytes:
+        """Read exactly n bytes, re-arming the socket timeout with the
+        REMAINING request budget before every recv — the deadline is
+        absolute per request, so a trickling app cannot reset the clock
+        with each byte."""
+        buf = bytearray()
+        while len(buf) < n:
+            if deadline is not None:
+                remaining = deadline - time_monotonic()
+                if remaining <= 0:
+                    raise socket.timeout("request deadline expired")
+                self._sock.settimeout(remaining)
+            chunk = self._sock.recv(min(n - len(buf), 65536))
+            if not chunk:
+                raise ABCIConnectionError("connection closed")
+            buf += chunk
+        return bytes(buf)
 
     def _call(self, method: str, payload):
         with self._lock:
-            frame = msgpack.packb([method, payload], use_bin_type=True)
-            self._sock.sendall(struct.pack(">I", len(frame)) + frame)
-            hdr = self._rfile.read(4)
-            if len(hdr) < 4:
-                raise ABCIClientError("connection closed")
-            (n,) = struct.unpack(">I", hdr)
-            if n > MAX_MSG_SIZE:
-                raise ABCIClientError(f"response frame too large: {n}")
-            data = self._rfile.read(n)
-            if len(data) < n:
-                raise ABCIClientError("truncated response")
-            kind, body = msgpack.unpackb(data, raw=False)
+            if self._broken:
+                raise ABCIConnectionError(
+                    f"connection to {self.address} is broken (earlier "
+                    f"timeout/error); redial required")
+            deadline = (time_monotonic() + self.request_timeout
+                        if self.request_timeout > 0 else None)
+            try:
+                if deadline is not None:
+                    # reset from any remaining-budget value a previous
+                    # call's _recv_exact left armed
+                    self._sock.settimeout(self.request_timeout)
+                frame = msgpack.packb([method, payload], use_bin_type=True)
+                self._sock.sendall(struct.pack(">I", len(frame)) + frame)
+                hdr = self._recv_exact(4, deadline)
+                (n,) = struct.unpack(">I", hdr)
+                if n > MAX_MSG_SIZE:
+                    raise ABCIConnectionError(f"response frame too large: {n}")
+                data = self._recv_exact(n, deadline)
+            except socket.timeout:
+                self._broken = True
+                self.close()
+                raise ABCITimeoutError(
+                    f"ABCI {method} exceeded request_timeout_s="
+                    f"{self.request_timeout:g} to {self.address}")
+            except ABCIConnectionError:
+                self._broken = True
+                raise
+            except OSError as e:
+                self._broken = True
+                raise ABCIConnectionError(f"ABCI {method} failed: {e}")
+            try:
+                kind, body = msgpack.unpackb(data, raw=False)
+            except Exception:
+                self._broken = True
+                raise ABCIConnectionError(
+                    f"undecodable response frame for {method!r}")
             if kind == "exception":
                 raise ABCIClientError(f"app exception: {body}")
             if kind != method:
-                raise ABCIClientError(f"response {kind!r} for request {method!r}")
+                # a mismatched kind means the stream is desynchronized
+                # (e.g. a stale response from before a timeout)
+                self._broken = True
+                raise ABCIConnectionError(
+                    f"response {kind!r} for request {method!r}")
             return body
 
     def echo(self, msg):
@@ -251,14 +342,21 @@ class SocketClient(Client):
             pass
 
 
-def _dial(address: str, timeout: float) -> socket.socket:
-    if address.startswith("unix://"):
-        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        s.settimeout(timeout)
-        s.connect(address[len("unix://") :])
-    else:
-        host, _, port = address.replace("tcp://", "").rpartition(":")
-        s = socket.create_connection((host or "127.0.0.1", int(port)), timeout=timeout)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    s.settimeout(None)
+def _dial(address: str, timeout: float,
+          request_timeout: Optional[float] = None) -> socket.socket:
+    try:
+        if address.startswith("unix://"):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(timeout)
+            s.connect(address[len("unix://") :])
+        else:
+            host, _, port = address.replace("tcp://", "").rpartition(":")
+            s = socket.create_connection(
+                (host or "127.0.0.1", int(port)), timeout=timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError as e:
+        raise ABCIConnectionError(f"cannot dial {address}: {e}")
+    # None = legacy blocking socket; a float arms the per-request
+    # deadline every subsequent send/recv inherits
+    s.settimeout(request_timeout)
     return s

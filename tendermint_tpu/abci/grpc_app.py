@@ -21,7 +21,12 @@ from typing import Optional
 import msgpack
 
 from . import types as abci
-from .client import ABCIClientError, Client
+from .client import (
+    ABCIClientError,
+    ABCIConnectionError,
+    ABCITimeoutError,
+    Client,
+)
 from .codec import REQUEST_CODECS, RESPONSE_CODECS
 
 SERVICE = "types.ABCIApplication"
@@ -168,13 +173,27 @@ class GRPCClient(Client):
     (the reference's grpc client is synchronous under the hood too —
     grpc_client.go:179: 'the real implementation [is] synchronous')."""
 
-    def __init__(self, address: str, timeout: float = 10.0):
+    def __init__(self, address: str, timeout: float = 10.0,
+                 request_timeout: float = 0.0):
+        """`timeout` bounds the initial channel-ready wait ONLY (a
+        refused/absent server surfaces as ABCIConnectionError so the
+        shared retry/backoff dialer in proxy.resilient can supervise
+        boot instead of crashing node start); `request_timeout` > 0 arms
+        a per-request gRPC deadline, 0 means no deadline — the same
+        block-forever semantics as the socket client, so a long InitChain
+        is never cut off by an unrelated dial knob."""
         import grpc
 
         self.address = address.replace("grpc://", "").replace("tcp://", "")
         self._timeout = timeout
+        self.request_timeout = request_timeout
         self._channel = grpc.insecure_channel(self.address)
-        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        except grpc.FutureTimeoutError:
+            self._channel.close()
+            raise ABCIConnectionError(
+                f"gRPC app at {self.address} not ready within {timeout:g}s")
         self._calls = {
             name: self._channel.unary_unary(
                 f"/{SERVICE}/{name}",
@@ -187,10 +206,20 @@ class GRPCClient(Client):
     def _call(self, name: str, payload):
         import grpc
 
+        deadline = self.request_timeout if self.request_timeout > 0 else None
         try:
-            return self._calls[name](payload, timeout=self._timeout)[0]
+            return self._calls[name](payload, timeout=deadline)[0]
         except grpc.RpcError as e:  # surface like socket-client errors
-            raise ABCIClientError(f"grpc {name} failed: {e.code()}: {e.details()}")
+            code = e.code()
+            if code == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise ABCITimeoutError(
+                    f"ABCI {name} exceeded request_timeout_s="
+                    f"{deadline or 0:g} to {self.address}")
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.CANCELLED):
+                raise ABCIConnectionError(
+                    f"grpc {name} failed: {code}: {e.details()}")
+            raise ABCIClientError(f"grpc {name} failed: {code}: {e.details()}")
 
     def echo(self, msg):
         return self._call("Echo", msg)

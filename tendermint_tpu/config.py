@@ -139,6 +139,34 @@ class ConsensusConfig:
 
 
 @dataclass
+class ABCIConfig:
+    """[abci] — app-connection resilience knobs (ours; the reference has
+    a single blocking socket with no deadlines or reconnect).
+
+    request_timeout_s: per-request deadline on the socket/gRPC clients;
+    a wedged app trips ABCITimeoutError instead of hanging consensus.
+    0 keeps the legacy block-forever behavior. dial_timeout_s: TOTAL
+    budget (attempts + backoff) for establishing an app connection at
+    boot — a late-starting app delays boot, it no longer aborts it.
+    retry_backoff_base_s/_max_s: the bounded exponential backoff every
+    redial shares. retry_budget: consecutive failed reconnect attempts
+    before the consensus conn gives up (and mempool/query conns report
+    state "down" — they keep retrying in the background regardless).
+    on_failure: what the CONSENSUS conn does when its in-flight request
+    dies with the app process — "halt" stops the node cleanly (the
+    legacy fatal behavior, default), "handshake" redials and re-runs the
+    handshake replay to re-sync the app, then re-drives the in-flight
+    block from scratch (never resumes mid-block)."""
+
+    request_timeout_s: float = 0.0
+    dial_timeout_s: float = 10.0
+    retry_backoff_base_s: float = 0.1
+    retry_backoff_max_s: float = 2.0
+    retry_budget: int = 5
+    on_failure: str = "halt"  # halt | handshake
+
+
+@dataclass
 class CryptoConfig:
     """[crypto] — batch-verification engine knobs (ours; the reference
     has no crypto section). async_dispatch gates the PIPELINED call
@@ -223,6 +251,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    abci: ABCIConfig = field(default_factory=ABCIConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
@@ -253,12 +282,19 @@ class Config:
                 lines.append(f"{k} = {val}")
             return "\n".join(lines)
 
+        # the transport selector lives in code as base.abci (reference
+        # config keeps a top-level `abci` key), but TOML cannot hold both
+        # a top-level `abci` value and an `[abci]` table — emit it inside
+        # the section as `transport`; from_toml accepts either spelling
+        abci_section = emit("abci", self.abci).replace(
+            "[abci]", f'[abci]\ntransport = "{self.base.abci}"', 1)
         parts = [
-            emit("", self.base, skip=("root_dir",)),
+            emit("", self.base, skip=("root_dir", "abci")),
             emit("rpc", self.rpc),
             emit("p2p", self.p2p),
             emit("mempool", self.mempool),
             emit("consensus", self.consensus),
+            abci_section,
             emit("crypto", self.crypto),
             emit("statesync", self.statesync),
             emit("tx_index", self.tx_index),
@@ -286,11 +322,20 @@ class Config:
             "instrumentation": cfg.instrumentation,
         }
         for k, v in o.items():
-            if k in sections:
+            if k == "abci" and isinstance(v, dict):
+                # our [abci] section: `transport` is base.abci, the rest
+                # are ABCIConfig resilience knobs
+                for kk, vv in v.items():
+                    if kk == "transport":
+                        cfg.base.abci = vv
+                    elif hasattr(cfg.abci, kk):
+                        setattr(cfg.abci, kk, vv)
+            elif k in sections:
                 for kk, vv in v.items():
                     if hasattr(sections[k], kk):
                         setattr(sections[k], kk, vv)
             elif hasattr(cfg.base, k):
+                # includes the reference's top-level `abci = "socket"`
                 setattr(cfg.base, k, v)
         return cfg
 

@@ -217,6 +217,57 @@ def _exec_block_on_app(app_conn, block, state_db) -> bytes:
     return res.data
 
 
+def resync_app(app_conn, state, block_store, state_db, genesis_doc) -> bytes:
+    """Re-sync a RESTARTED app to the already-committed chain state,
+    app-only — the mid-flight counterpart of Handshaker.replay_blocks,
+    run by the resilient consensus conn's on_failure = "handshake"
+    policy after a reconnect (proxy/resilient.py).
+
+    Unlike the boot handshake this NEVER mutates chain state: the
+    in-flight block application re-drives itself from scratch once this
+    returns (BlockExecutor.apply_block retries on ABCIAppRestartedError),
+    so mutating here would race it. A fresh app (height 0) is InitChained
+    from genesis, then replayed up to `state.last_block_height` through
+    BeginBlock→DeliverTx→EndBlock→Commit only. An app AHEAD of chain
+    state (it committed the in-flight block before dying) cannot be
+    reconciled without mutating state — that is the boot handshake's
+    app==store case — so we refuse and let the supervisor halt; a node
+    restart recovers it."""
+    res = app_conn.info(abci.RequestInfo(version="tendermint-tpu"))
+    app_height = res.last_block_height
+    target = state.last_block_height
+    LOG.warning("re-syncing restarted app: app=%d chain=%d",
+                app_height, target)
+    if app_height > target:
+        raise HandshakeError(
+            f"restarted app at height {app_height} is ahead of chain "
+            f"state {target}; restart the node to reconcile via the "
+            f"boot handshake")
+    if app_height == 0:
+        validators = [
+            abci.ValidatorUpdate(pub_key=pubkey_to_bytes(v.pub_key),
+                                 power=v.power)
+            for v in genesis_doc.validators
+        ]
+        app_conn.init_chain(abci.RequestInitChain(
+            time=genesis_doc.genesis_time,
+            chain_id=genesis_doc.chain_id,
+            validators=validators,
+            app_state_bytes=b"",
+        ))
+    app_hash = res.last_block_app_hash
+    for height in range(app_height + 1, target + 1):
+        LOG.info("re-applying block %d to restarted app (app-only)", height)
+        block = block_store.load_block(height)
+        app_hash = _exec_block_on_app(app_conn, block, state_db)
+    if target > 0 and app_hash != state.app_hash:
+        raise HandshakeError(
+            f"restarted app re-synced to height {target} but hashes "
+            f"diverge: app {app_hash.hex()[:16]} != state "
+            f"{state.app_hash.hex()[:16]}")
+    return app_hash
+
+
 class _MockProxyApp:
     """Serves stored ABCI responses instead of re-executing (reference
     newMockProxyApp :446-481)."""

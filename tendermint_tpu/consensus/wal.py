@@ -10,6 +10,7 @@ markers delimit heights for catchup replay (SearchForEndHeight :159).
 from __future__ import annotations
 
 import binascii
+import logging
 import struct
 import time
 from dataclasses import dataclass
@@ -18,6 +19,8 @@ from typing import Iterator, Optional, Tuple
 from ..libs import tracing
 from ..libs.autofile import Group
 from ..types import serde
+
+LOG = logging.getLogger("consensus.wal")
 
 MAX_MSG_SIZE = 1048576  # 1MB (reference wal.go:32)
 
@@ -49,11 +52,21 @@ def _encode_record(payload: bytes) -> bytes:
 
 
 class WAL:
-    """File-backed WAL over a rotating Group (reference baseWAL :69)."""
+    """File-backed WAL over a rotating Group (reference baseWAL :69).
 
-    def __init__(self, path: str):
+    `corrupted_counter` is a Counter-like sink (metrics
+    wal_corrupted_records_total) bumped when iter_messages drops a
+    CORRUPT record — bad CRC, absurd length, undecodable payload —
+    as opposed to the expected truncated crash tail."""
+
+    def __init__(self, path: str, corrupted_counter=None):
+        from ..metrics import NOP
+
         self.group = Group(path)
         self._started = False
+        self._corrupted_counter = (corrupted_counter
+                                   if corrupted_counter is not None else NOP)
+        self._corruption_warned = False
 
     def start(self) -> None:
         self._started = True
@@ -92,27 +105,50 @@ class WAL:
 
     # --- read ---------------------------------------------------------------
 
+    def _note_corruption(self, offset: int, why: str) -> None:
+        """Count + one-shot warn: the WAL tolerates a bad record (replay
+        stops there, the crash-recovery contract), but silently eaten
+        records used to be invisible to operators."""
+        self._corrupted_counter.inc()
+        if not self._corruption_warned:
+            self._corruption_warned = True
+            LOG.warning(
+                "WAL corruption at byte offset %d: %s; replay stops here "
+                "(records beyond this point are lost). Check the disk.",
+                offset, why)
+
     def iter_messages(self) -> Iterator[object]:
         """All decodable messages oldest → newest; stops at the first
-        corrupt/truncated record (crash tail)."""
+        corrupt/truncated record. A short read at the very end is the
+        expected crash tail; a CRC/length/decode failure is disk
+        corruption and is counted + warned (wal_corrupted_records_total)."""
         r = self.group.reader()
+        offset = 0
         try:
             while True:
                 hdr = r.read(8)
                 if len(hdr) < 8:
-                    return
+                    return  # clean EOF or truncated crash tail
                 crc, ln = struct.unpack(">II", hdr)
                 if ln > MAX_MSG_SIZE:
+                    self._note_corruption(
+                        offset, f"record length {ln} exceeds "
+                                f"{MAX_MSG_SIZE} (garbage header)")
                     return
                 payload = r.read(ln)
                 if len(payload) < ln:
-                    return
+                    return  # truncated crash tail
                 if binascii.crc32(payload) & 0xFFFFFFFF != crc:
+                    self._note_corruption(offset, "CRC mismatch")
                     return
                 try:
-                    yield _msg_from(serde.unpack(payload))
-                except (ValueError, TypeError, IndexError):
+                    msg = _msg_from(serde.unpack(payload))
+                except (ValueError, TypeError, IndexError) as e:
+                    self._note_corruption(
+                        offset, f"undecodable payload ({e})")
                     return
+                offset += 8 + ln
+                yield msg
         finally:
             r.close()
 

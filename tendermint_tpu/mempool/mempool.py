@@ -13,6 +13,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
@@ -21,6 +22,10 @@ from ..abci import types as abci
 from ..config import MempoolConfig
 
 LOG = logging.getLogger("mempool")
+
+# rate limit for app-failure warnings: recheck runs after EVERY commit,
+# so a down app would otherwise log once per block (or per pending tx)
+_APP_WARN_INTERVAL_S = 10.0
 
 
 class ErrTxInCache(Exception):
@@ -103,6 +108,18 @@ class Mempool:
         self._txs_available_cbs: List[Callable[[], None]] = []
         self._cond = threading.Condition(self._lock)
         self._wal = None
+        self._last_app_warn = 0.0
+
+    def _warn_app_failure(self, what: str, err: Exception) -> None:
+        """Count + rate-limited warn: a failing app used to be silently
+        absorbed by the recheck/flush paths (txs quietly dropped)."""
+        self.metrics.recheck_failures.inc()
+        now = time.monotonic()
+        if now - self._last_app_warn >= _APP_WARN_INTERVAL_S:
+            self._last_app_warn = now
+            LOG.warning("mempool app connection failing during %s: %s "
+                        "(further failures suppressed for %.0fs)",
+                        what, err, _APP_WARN_INTERVAL_S)
 
     # --- WAL (reference mempool/mempool.go:221-258 InitWAL) -----------------
 
@@ -137,7 +154,14 @@ class Mempool:
         self._lock.release()
 
     def flush_app_conn(self) -> None:
-        self.proxy_app.flush()
+        """Flush the mempool conn. Called from the consensus-critical
+        commit path (BlockExecutor.commit) — a down mempool conn must
+        degrade, not halt consensus, so transport failures are absorbed
+        (counted + rate-limited warning)."""
+        try:
+            self.proxy_app.flush()
+        except Exception as e:  # noqa: BLE001 - fail soft off the hot path
+            self._warn_app_failure("flush", e)
 
     def flush(self) -> None:
         """Remove everything (reference Flush :450)."""
@@ -188,7 +212,13 @@ class Mempool:
                 self._wal.write(tx + b"\n")
                 self._wal.flush()
 
-            res = self.proxy_app.check_tx(tx)
+            try:
+                res = self.proxy_app.check_tx(tx)
+            except Exception:
+                # conn-level failure (not an app verdict): evict from the
+                # cache so the tx can be resubmitted once the app is back
+                self.cache.remove(tx)
+                raise
             if self.post_check is not None:
                 try:
                     self.post_check(tx, res)
@@ -274,10 +304,18 @@ class Mempool:
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on everything still pending (reference
-        recheckTxs :569-585 + resCbRecheck :399-442)."""
+        recheckTxs :569-585 + resCbRecheck :399-442). Runs inside the
+        commit path: a transport-level failure aborts the recheck and
+        KEEPS the remaining txs (they are rechecked after the next
+        commit) instead of propagating into — and halting — consensus."""
         still: List[MempoolTx] = []
-        for mtx in self._txs:
-            res = self.proxy_app.check_tx(mtx.tx)
+        for i, mtx in enumerate(self._txs):
+            try:
+                res = self.proxy_app.check_tx(mtx.tx)
+            except Exception as e:  # noqa: BLE001 - fail soft, keep txs
+                self._warn_app_failure("recheck", e)
+                still.extend(self._txs[i:])
+                break
             if res.code == abci.CODE_TYPE_OK:
                 still.append(mtx)
             else:

@@ -49,6 +49,10 @@ class ConsensusMetrics:
     # watchdog tick, and stalls past the threshold labeled by diagnosis
     round_dwell: object = NOP
     stalls: object = NOP
+    # WAL records dropped as corrupt (bad CRC / absurd length / decode
+    # failure) by consensus/wal.py iter_messages — an operator signal
+    # that the disk is eating records, not a code path that can recover
+    wal_corrupted: object = NOP
 
 
 @dataclass
@@ -143,13 +147,35 @@ class StateSyncMetrics:
 
 
 @dataclass
+class ABCIMetrics:
+    """App-connection resilience telemetry (proxy/resilient.py; no
+    reference equivalent — the reference's app conns have no deadlines,
+    no reconnect, and no health model). Every request through a
+    supervised conn reports here."""
+
+    # wall time of one ABCI request, labeled (conn, method)
+    request_duration: object = NOP
+    # requests that tripped [abci] request_timeout_s, (conn, method)
+    request_timeouts: object = NOP
+    # successful redials, labeled conn
+    reconnects: object = NOP
+    # 2=healthy 1=degraded 0=down, labeled conn
+    conn_state: object = NOP
+
+
+@dataclass
 class MempoolMetrics:
-    """mempool/metrics.go:12-25"""
+    """mempool/metrics.go:12-25 (+ recheck_failures, ours: recheck/flush
+    app errors that previously vanished silently)"""
 
     size: object = NOP
     tx_size_bytes: object = NOP
     failed_txs: object = NOP
     recheck_times: object = NOP
+    # post-commit recheck (or commit-path flush) calls the app refused
+    # at the TRANSPORT level — a failing/app-down signal, distinct from
+    # failed_txs (txs the app rejected by code)
+    recheck_failures: object = NOP
 
 
 @dataclass
@@ -163,6 +189,7 @@ class StateMetrics:
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
+    abci: ABCIMetrics = field(default_factory=ABCIMetrics)
     mempool: MempoolMetrics = field(default_factory=MempoolMetrics)
     state: StateMetrics = field(default_factory=StateMetrics)
     crypto: CryptoMetrics = field(default_factory=CryptoMetrics)
@@ -219,6 +246,10 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_consensus_stalls_total",
             "Rounds that dwelt past the stall threshold, by diagnosis.",
             ("reason",)),
+        wal_corrupted=r.counter(
+            f"{ns}_wal_corrupted_records_total",
+            "WAL records dropped due to corruption (bad CRC/length/"
+            "decode)."),
     )
     p2p = P2PMetrics(
         peers=r.gauge(f"{ns}_p2p_peers", "Number of connected peers."),
@@ -250,6 +281,25 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Blocks the peer's consensus height trails ours.",
             ("peer_id",)),
     )
+    abci_m = ABCIMetrics(
+        request_duration=r.histogram(
+            f"{ns}_abci_request_duration_seconds",
+            "Wall time of one ABCI request, by connection and method.",
+            ("conn", "method"),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1, 5, 30)),
+        request_timeouts=r.counter(
+            f"{ns}_abci_request_timeouts_total",
+            "ABCI requests that exceeded the configured request "
+            "deadline.", ("conn", "method")),
+        reconnects=r.counter(
+            f"{ns}_abci_reconnects_total",
+            "Successful app-connection redials.", ("conn",)),
+        conn_state=r.gauge(
+            f"{ns}_abci_conn_state",
+            "App-connection health (2=healthy 1=degraded 0=down).",
+            ("conn",)),
+    )
     mem = MempoolMetrics(
         size=r.gauge(f"{ns}_mempool_size",
                      "Number of uncommitted transactions."),
@@ -260,6 +310,10 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
                              "Transactions that failed CheckTx."),
         recheck_times=r.counter(f"{ns}_mempool_recheck_times",
                                 "Times transactions were rechecked."),
+        recheck_failures=r.counter(
+            f"{ns}_mempool_recheck_failures_total",
+            "Recheck/flush app calls that failed at the transport "
+            "level (app down or erroring)."),
     )
     state = StateMetrics(
         block_processing_time=r.histogram(
@@ -335,5 +389,6 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             ("phase",),
             buckets=(0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 300)),
     )
-    return NodeMetrics(consensus=cons, p2p=p2p, mempool=mem, state=state,
-                       crypto=crypto, statesync=statesync, registry=r)
+    return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
+                       state=state, crypto=crypto, statesync=statesync,
+                       registry=r)

@@ -156,8 +156,15 @@ class Node:
         state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
 
         # --- proxy app + handshake (node/node.go:193-206) ------------
-        self.proxy_app = AppConns(client_creator)
+        # every conn rides a ResilientClient supervisor ([abci] config):
+        # request deadlines + duration metrics, backoff redial, and the
+        # consensus-conn failure policy (halt cleanly, or re-run the
+        # handshake replay on reconnect and re-drive the in-flight block)
+        self.proxy_app = AppConns(
+            client_creator, config=config.abci, metrics=self.metrics.abci,
+            on_fatal=self._on_abci_fatal)
         self.proxy_app.start()
+        self.proxy_app.set_consensus_resync(self._resync_app)
         self.event_bus = EventBus()
         handshaker = Handshaker(
             self.state_db, state, self.block_store, genesis_doc, self.event_bus
@@ -217,7 +224,8 @@ class Node:
         if config.consensus.wal_path:
             wal_path = config.consensus.wal_file(root)
             os.makedirs(os.path.dirname(wal_path), exist_ok=True)
-            wal = WAL(wal_path)
+            wal = WAL(wal_path,
+                      corrupted_counter=self.metrics.consensus.wal_corrupted)
         self.consensus_state = ConsensusState(
             config.consensus,
             state,
@@ -464,6 +472,29 @@ class Node:
         if self.state_syncer is not None:
             self.state_syncer.start()
 
+    def _on_abci_fatal(self, exc: Exception) -> None:
+        """The consensus app connection is unrecoverable ([abci]
+        on_failure = "halt", or a failed handshake re-sync): stop the
+        node cleanly — WALs sync, stores close, peers get hangups —
+        instead of wedging with a dead app. Runs on a separate thread:
+        the failure surfaces inside the consensus thread, and stop()
+        joins reactors that may be waiting on that very thread."""
+        LOG.error("consensus app connection unrecoverable: %s; "
+                  "halting node cleanly", exc)
+        threading.Thread(target=self.stop, name="abci-fatal-stop",
+                         daemon=True).start()
+
+    def _resync_app(self, client) -> None:
+        """on_failure = "handshake": re-sync a restarted app (app-only
+        replay against the RAW reconnected client; chain state is never
+        touched — the in-flight block re-drives itself afterwards)."""
+        from ..consensus.replay import resync_app
+
+        state = sm.load_state_from_db_or_genesis(
+            self.state_db, self.genesis_doc)
+        resync_app(client, state, self.block_store, self.state_db,
+                   self.genesis_doc)
+
     def _on_statesync_complete(self, state) -> None:
         """Restore finished (state holds the snapshot-height State) or
         gave up (None): either way fast sync takes over — from the
@@ -574,6 +605,7 @@ class Node:
             providers={
                 "/debug/consensus": lambda q: self.watchdog.status(),
                 "/debug/statesync": lambda q: self._statesync_status(),
+                "/debug/abci": lambda q: self.proxy_app.status(),
             },
         )
         self._prof_server.start()
@@ -657,5 +689,8 @@ def default_new_node(config: cfg.Config) -> Node:
     else:
         pv = load_or_gen_file_pv(config.base.priv_validator_path())
     genesis_doc = GenesisDoc.load(config.base.genesis_path())
-    creator = default_client_creator(config.base.proxy_app, config.base.abci)
+    creator = default_client_creator(
+        config.base.proxy_app, config.base.abci,
+        request_timeout=config.abci.request_timeout_s,
+        dial_timeout=config.abci.dial_timeout_s)
     return Node(config, pv, node_key, creator, genesis_doc)

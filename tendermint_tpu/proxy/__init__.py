@@ -2,6 +2,12 @@
 
 multi_app_conn.go:12 wires consensus/mempool/query clients from one
 ClientCreator; local creators share a single mutex like local_client.go.
+Each connection is supervised by a ResilientClient (proxy/resilient.py):
+per-request deadlines and duration metrics, a healthy→degraded→down
+state machine, and bounded-backoff reconnect with per-conn policy —
+mempool/query fail soft and redial in the background, the consensus
+conn either halts cleanly or re-runs the handshake replay on reconnect
+([abci] on_failure).
 """
 
 from __future__ import annotations
@@ -11,8 +17,22 @@ from typing import Callable, Optional
 
 from ..abci import types as abci
 from ..abci.client import Client, LocalClient, SocketClient
+from .resilient import (
+    STATE_DEGRADED,
+    STATE_DOWN,
+    STATE_HEALTHY,
+    ResilientClient,
+    dial_with_backoff,
+)
 
 ClientCreator = Callable[[], Client]
+
+__all__ = [
+    "AppConns", "ClientCreator", "ResilientClient", "dial_with_backoff",
+    "STATE_HEALTHY", "STATE_DEGRADED", "STATE_DOWN",
+    "local_client_creator", "remote_client_creator",
+    "default_client_creator",
+]
 
 
 def local_client_creator(app: abci.Application) -> ClientCreator:
@@ -24,25 +44,34 @@ def local_client_creator(app: abci.Application) -> ClientCreator:
     return create
 
 
-def remote_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+def remote_client_creator(address: str, transport: str = "socket",
+                          request_timeout: float = 0.0,
+                          dial_timeout: float = 10.0) -> ClientCreator:
     """Socket or gRPC remote app connection (reference proxy/client.go
     NewRemoteClientCreator + abci/client.NewClient transport switch).
-    A "grpc://" address forces gRPC regardless of `transport`."""
+    A "grpc://" address forces gRPC regardless of `transport`.
+    `request_timeout` > 0 arms the per-request deadline ([abci]
+    request_timeout_s); `dial_timeout` bounds ONE dial attempt (the
+    ResilientClient supervisor loops attempts within its own budget)."""
     if transport == "grpc" or address.startswith("grpc://"):
         def create_grpc() -> Client:
             from ..abci.grpc_app import GRPCClient
 
-            return GRPCClient(address)
+            return GRPCClient(address, timeout=dial_timeout,
+                              request_timeout=request_timeout)
 
         return create_grpc
 
     def create() -> Client:
-        return SocketClient(address)
+        return SocketClient(address, timeout=dial_timeout,
+                            request_timeout=request_timeout)
 
     return create
 
 
-def default_client_creator(address: str, transport: str = "socket") -> ClientCreator:
+def default_client_creator(address: str, transport: str = "socket",
+                           request_timeout: float = 0.0,
+                           dial_timeout: float = 10.0) -> ClientCreator:
     """kvstore/counter/noop in-proc, else socket/grpc address
     (reference proxy/client.go:65-80)."""
     if address == "kvstore":
@@ -71,24 +100,79 @@ def default_client_creator(address: str, transport: str = "socket") -> ClientCre
         return local_client_creator(CounterApplication(serial=True))
     if address == "noop":
         return local_client_creator(abci.BaseApplication())
-    return remote_client_creator(address, transport)
+    return remote_client_creator(address, transport,
+                                 request_timeout=request_timeout,
+                                 dial_timeout=dial_timeout)
 
 
 class AppConns:
-    """consensus + mempool + query connections (proxy/app_conn.go:11-41)."""
+    """consensus + mempool + query connections (proxy/app_conn.go:11-41),
+    each wrapped in a ResilientClient supervisor.
 
-    def __init__(self, creator: ClientCreator):
+    `config` is an ABCIConfig (falls back to defaults); `on_fatal(exc)`
+    is invoked when the consensus conn becomes unrecoverable (the node
+    installs a clean stop); `set_consensus_resync` installs the
+    handshake-replay callback run against the RAW reconnected client
+    before the consensus conn is re-adopted."""
+
+    def __init__(self, creator: ClientCreator, config=None, metrics=None,
+                 on_fatal: Optional[Callable[[Exception], None]] = None):
+        from ..config import ABCIConfig
+
         self._creator = creator
-        self.consensus: Optional[Client] = None
-        self.mempool: Optional[Client] = None
-        self.query: Optional[Client] = None
+        self._config = config if config is not None else ABCIConfig()
+        self._metrics = metrics
+        self._on_fatal = on_fatal
+        self.consensus: Optional[ResilientClient] = None
+        self.mempool: Optional[ResilientClient] = None
+        self.query: Optional[ResilientClient] = None
+
+    def _wrap(self, name: str, policy: str) -> ResilientClient:
+        c = self._config
+        return ResilientClient(
+            name,
+            self._creator,
+            policy=policy,
+            dial_timeout_s=c.dial_timeout_s,
+            backoff_base_s=c.retry_backoff_base_s,
+            backoff_max_s=c.retry_backoff_max_s,
+            retry_budget=c.retry_budget,
+            on_failure=c.on_failure,
+            metrics=self._metrics,
+            on_fatal=self._on_fatal if policy == "consensus" else None,
+        )
+
+    def set_consensus_resync(self, cb: Callable[[Client], None]) -> None:
+        if self.consensus is not None:
+            self.consensus.set_resync(cb)
 
     def start(self) -> None:
-        self.consensus = self._creator()
-        self.mempool = self._creator()
-        self.query = self._creator()
+        self.consensus = self._wrap("consensus", "consensus")
+        self.mempool = self._wrap("mempool", "retry")
+        self.query = self._wrap("query", "retry")
+        for c in (self.consensus, self.mempool, self.query):
+            c.start()
 
     def stop(self) -> None:
         for c in (self.consensus, self.mempool, self.query):
             if c is not None:
                 c.close()
+
+    def status(self) -> dict:
+        """The /debug/abci bundle: per-conn supervisor state plus the
+        effective resilience config."""
+        return {
+            "config": {
+                "request_timeout_s": self._config.request_timeout_s,
+                "dial_timeout_s": self._config.dial_timeout_s,
+                "retry_budget": self._config.retry_budget,
+                "on_failure": self._config.on_failure,
+            },
+            "conns": {
+                name: c.status()
+                for name, c in (("consensus", self.consensus),
+                                ("mempool", self.mempool),
+                                ("query", self.query))
+                if c is not None
+            },
+        }

@@ -1,0 +1,380 @@
+"""ResilientClient — supervised ABCI connections.
+
+No reference equivalent: the reference's proxy.AppConns treats any app
+connection error as fatal (multi_app_conn.go kills the node). Here each
+of the three app conns is wrapped in a supervisor with a
+healthy → degraded → down state machine, per-request metrics, and a
+bounded exponential-backoff redial shared by the socket and gRPC
+transports (proxy/client.go's one-shot dial becomes a budgeted loop, so
+a late-starting app delays boot instead of aborting it).
+
+Per-connection policy:
+
+- ``retry`` (mempool, query): a connection failure fails the in-flight
+  call soft (the caller sees the error — CheckTx is rejected, a Query
+  errors) while a background thread redials with backoff forever. After
+  `retry_budget` consecutive failed attempts the conn reports state
+  "down" (and calls fail fast), but it keeps trying — a recovered app is
+  re-adopted transparently. Consensus never notices.
+
+- ``consensus``: the block pipeline cannot fail soft — a lost request
+  mid-block leaves the app half-applied. on_failure = "halt" (default,
+  the legacy fatal behavior made clean) stops the node via `on_fatal`.
+  on_failure = "handshake" redials inline (retry_budget attempts), runs
+  the `resync` callback against the RAW new client (the node re-runs the
+  handshake replay: InitChain a fresh app, replay the blocks it missed —
+  chain state is never mutated), then raises ABCIAppRestartedError so
+  the caller re-drives its whole unit of work from scratch
+  (BlockExecutor.apply_block retries the full block). A half-applied
+  block is therefore never resumed, and never committed twice.
+
+  The handshake policy applies ONLY to transport loss (EOF/reset/
+  refused): for a direct app connection that means the app process died,
+  taking its uncommitted working state with it, so re-driving the block
+  is safe. A request TIMEOUT proves nothing of the sort — the app may be
+  slow-but-alive, still holding the first drive's half-applied state, and
+  re-driving on top of it would double-apply — so a consensus-conn
+  timeout always halts, regardless of on_failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ..abci.client import (
+    METHODS,
+    ABCIAppRestartedError,
+    ABCIClientError,
+    ABCIConnectionError,
+    ABCITimeoutError,
+    Client,
+)
+
+LOG = logging.getLogger("proxy.resilient")
+
+STATE_HEALTHY = "healthy"
+STATE_DEGRADED = "degraded"
+STATE_DOWN = "down"
+# gauge encoding for abci_conn_state{conn}
+STATE_VALUE = {STATE_DOWN: 0, STATE_DEGRADED: 1, STATE_HEALTHY: 2}
+
+
+def dial_with_backoff(creator: Callable[[], Client], *,
+                      budget_s: Optional[float] = None,
+                      attempts: Optional[int] = None,
+                      backoff_base_s: float = 0.1,
+                      backoff_max_s: float = 2.0,
+                      should_stop: Optional[Callable[[], bool]] = None,
+                      name: str = "abci") -> Client:
+    """The shared retry/backoff dialer: call `creator()` until it
+    returns a client, sleeping a doubling (capped) backoff between
+    failures. Gives up after `attempts` tries or once `budget_s` wall
+    seconds elapse (whichever is set; both unset = one try), re-raising
+    the last ABCIConnectionError."""
+    deadline = (time.monotonic() + budget_s) if budget_s else None
+    backoff = backoff_base_s
+    tried = 0
+    while True:
+        try:
+            return creator()
+        except (ABCIConnectionError, OSError) as e:
+            tried += 1
+            out_of_budget = (
+                (attempts is not None and tried >= attempts)
+                or (deadline is not None and time.monotonic() >= deadline)
+                or (attempts is None and deadline is None)
+            )
+            if out_of_budget or (should_stop is not None and should_stop()):
+                if isinstance(e, ABCIConnectionError):
+                    raise
+                raise ABCIConnectionError(f"dial {name} failed: {e}")
+            LOG.warning("dial %s failed (attempt %d): %s; retrying in %.2fs",
+                        name, tried, e, backoff)
+            time.sleep(backoff)
+            backoff = min(backoff * 2, backoff_max_s)
+
+
+class ResilientClient(Client):
+    """Supervises one app connection (see module doc)."""
+
+    def __init__(
+        self,
+        name: str,
+        creator: Callable[[], Client],
+        *,
+        policy: str = "retry",  # retry | consensus
+        dial_timeout_s: float = 10.0,
+        backoff_base_s: float = 0.1,
+        backoff_max_s: float = 2.0,
+        retry_budget: int = 5,
+        on_failure: str = "halt",  # halt | handshake (consensus policy)
+        metrics=None,
+        on_fatal: Optional[Callable[[Exception], None]] = None,
+        resync: Optional[Callable[[Client], None]] = None,
+    ):
+        from ..metrics import ABCIMetrics
+
+        self.name = name
+        self.policy = policy
+        self.on_failure = on_failure
+        self._creator = creator
+        self._dial_timeout_s = dial_timeout_s
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._retry_budget = max(1, retry_budget)
+        self._metrics = metrics if metrics is not None else ABCIMetrics()
+        self._on_fatal = on_fatal
+        self._resync = resync
+        self._lock = threading.RLock()
+        self._client: Optional[Client] = None
+        self._state = STATE_DOWN
+        self._stopping = threading.Event()
+        self._reconnect_thread: Optional[threading.Thread] = None
+        self.reconnects = 0
+        self.last_error: str = ""
+        self._fatal = False
+        # consecutive conn-level call failures; reset only by a call
+        # that SUCCEEDS, so a conn whose dial works but whose requests
+        # always die still reaches "down" instead of flapping
+        self._consecutive_failures = 0
+        # the (conn, method) label sets are static: bind the metric
+        # children once so the per-request hot path (every DeliverTx)
+        # skips the label lookup
+        self._duration = {
+            m: self._metrics.request_duration.with_labels(name, m)
+            for m in METHODS
+        }
+        self._timeouts = {
+            m: self._metrics.request_timeouts.with_labels(name, m)
+            for m in METHODS
+        }
+
+    # -- state machine -------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        self._metrics.conn_state.with_labels(self.name).set(
+            STATE_VALUE[state])
+
+    def status(self) -> dict:
+        """The /debug/abci view of this connection."""
+        return {
+            "state": self._state,
+            "policy": self.policy,
+            "on_failure": self.on_failure if self.policy == "consensus"
+            else "",
+            "reconnects": self.reconnects,
+            "last_error": self.last_error,
+        }
+
+    def set_resync(self, cb: Callable[[Client], None]) -> None:
+        self._resync = cb
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        """Establish the connection, retrying within the boot dial
+        budget — a late-starting app delays boot instead of aborting
+        it (the old GRPCClient channel_ready crash)."""
+        self._client = dial_with_backoff(
+            self._creator,
+            budget_s=self._dial_timeout_s,
+            backoff_base_s=self._backoff_base_s,
+            backoff_max_s=self._backoff_max_s,
+            should_stop=self._stopping.is_set,
+            name=self.name,
+        )
+        self._set_state(STATE_HEALTHY)
+
+    def close(self) -> None:
+        self._stopping.set()
+        with self._lock:
+            client, self._client = self._client, None
+        if client is not None:
+            client.close()
+        t = self._reconnect_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+
+    # -- call path -----------------------------------------------------
+
+    def _invoke(self, method: str, *args):
+        t0 = time.monotonic()
+        try:
+            with self._lock:
+                client = self._client
+            if client is None:
+                raise ABCIConnectionError(
+                    f"{self.name} app connection is {self._state}"
+                    + (f" (last error: {self.last_error})"
+                       if self.last_error else ""))
+            try:
+                res = getattr(client, method)(*args)
+            except ABCITimeoutError as e:
+                self._timeouts[method].inc()
+                raise self._handle_conn_failure(client, method, e)
+            except (ABCIConnectionError, OSError) as e:
+                raise self._handle_conn_failure(client, method, e)
+            self._consecutive_failures = 0
+            return res
+        finally:
+            self._duration[method].observe(time.monotonic() - t0)
+
+    def _handle_conn_failure(self, broken: Client, method: str,
+                             err: Exception) -> Exception:
+        """Returns the exception the in-flight call must raise."""
+        self.last_error = f"{method}: {err}"
+        self._consecutive_failures += 1
+        with self._lock:
+            if self._client is broken:
+                self._client = None
+                try:
+                    broken.close()
+                except Exception:  # noqa: BLE001 - already broken
+                    pass
+            elif self._client is not None:
+                # another thread already swapped in a fresh client
+                return err if isinstance(err, ABCIClientError) \
+                    else ABCIConnectionError(str(err))
+        LOG.warning("ABCI %s conn failed on %s: %s", self.name, method, err)
+        if self._stopping.is_set() or self._fatal:
+            return err
+        if self.policy == "consensus":
+            return self._recover_consensus(err)
+        self._set_state(STATE_DEGRADED if self._consecutive_failures
+                        < self._retry_budget else STATE_DOWN)
+        self._spawn_reconnect_loop()
+        return err
+
+    # -- consensus policy ----------------------------------------------
+
+    def _recover_consensus(self, err: Exception) -> Exception:
+        if self.on_failure != "handshake":
+            return self._halt(err)
+        if isinstance(err, ABCITimeoutError):
+            # a timeout proves nothing about process death: the app may
+            # be slow-but-ALIVE, still holding the first drive's
+            # half-applied working state — re-driving on top of it would
+            # double-apply. Only transport loss (EOF/reset/refused ⇒ the
+            # process and its uncommitted state are gone) is safe to
+            # resync; a wedged consensus app halts.
+            return self._halt(err)
+        try:
+            self._set_state(STATE_DEGRADED)
+            client = dial_with_backoff(
+                self._creator,
+                attempts=self._retry_budget,
+                backoff_base_s=self._backoff_base_s,
+                backoff_max_s=self._backoff_max_s,
+                should_stop=self._stopping.is_set,
+                name=self.name,
+            )
+        except (ABCIConnectionError, OSError) as redial_err:
+            return self._halt(redial_err)
+        try:
+            if self._resync is not None:
+                self._resync(client)
+        except Exception as resync_err:  # noqa: BLE001 - unrecoverable
+            client.close()
+            return self._halt(resync_err)
+        with self._lock:
+            self._client = client
+        self.reconnects += 1
+        self._metrics.reconnects.with_labels(self.name).inc()
+        self._set_state(STATE_HEALTHY)
+        LOG.warning(
+            "ABCI %s conn reconnected and re-synced after: %s; the "
+            "in-flight unit of work must be re-driven", self.name, err)
+        return ABCIAppRestartedError(
+            f"{self.name} app connection was re-established and re-synced "
+            f"after: {err}; re-drive the in-flight work from scratch")
+
+    def _halt(self, err: Exception) -> Exception:
+        self._fatal = True
+        self._set_state(STATE_DOWN)
+        LOG.error("ABCI %s conn unrecoverable (%s); halting", self.name, err)
+        if self._on_fatal is not None:
+            try:
+                self._on_fatal(err)
+            except Exception:  # noqa: BLE001 - halting anyway
+                LOG.exception("on_fatal hook failed")
+        if isinstance(err, ABCIClientError):
+            return err
+        return ABCIConnectionError(str(err))
+
+    # -- retry policy --------------------------------------------------
+
+    def _spawn_reconnect_loop(self) -> None:
+        with self._lock:
+            t = self._reconnect_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(
+                target=self._reconnect_loop,
+                name=f"abci-reconnect-{self.name}", daemon=True)
+            self._reconnect_thread = t
+        t.start()
+
+    def _reconnect_loop(self) -> None:
+        """Background redial with bounded exponential backoff, forever:
+        `retry_budget` consecutive failures demote the conn to "down"
+        (callers fail fast), but a recovered app is always re-adopted.
+        A fresh connection must answer an echo PROBE before adoption —
+        a backend that accepts dials but dies on every request (half-dead
+        process, LB with no backend) keeps backing off toward "down"
+        instead of flapping healthy↔degraded."""
+        failures = 0
+        backoff = self._backoff_base_s
+        while not self._stopping.is_set():
+            client = None
+            try:
+                client = self._creator()
+                client.echo("ping")
+            except (ABCIClientError, OSError) as e:
+                if client is not None:
+                    try:
+                        client.close()
+                    except Exception:  # noqa: BLE001 - probe failed
+                        pass
+                failures += 1
+                self.last_error = f"reconnect: {e}"
+                if failures >= self._retry_budget \
+                        and self._state != STATE_DOWN:
+                    LOG.warning(
+                        "ABCI %s conn down after %d reconnect attempts: %s",
+                        self.name, failures, e)
+                    self._set_state(STATE_DOWN)
+                self._stopping.wait(backoff)
+                backoff = min(backoff * 2, self._backoff_max_s)
+                continue
+            with self._lock:
+                if self._stopping.is_set():
+                    client.close()
+                    return
+                self._client = client
+            self.reconnects += 1
+            self._metrics.reconnects.with_labels(self.name).inc()
+            self._set_state(STATE_HEALTHY)
+            LOG.info("ABCI %s conn reconnected (attempt %d)",
+                     self.name, failures + 1)
+            return
+
+
+def _make_method(name: str):
+    def call(self, *args):
+        return self._invoke(name, *args)
+
+    call.__name__ = name
+    return call
+
+
+for _m in METHODS:
+    setattr(ResilientClient, _m, _make_method(_m))
+del _m

@@ -12,8 +12,9 @@ Serves:
                            (libs/timeline.py marks stitched with the
                            tracer spans tagged height=N)
 - plus any `providers` routes the node mounts: /debug/consensus (the
-  stall watchdog's diagnostic bundle) and /debug/statesync (snapshot
-  inventory, chunk counters, and live restore progress)
+  stall watchdog's diagnostic bundle), /debug/statesync (snapshot
+  inventory, chunk counters, and live restore progress) and /debug/abci
+  (per-connection ResilientClient state: health, reconnects, last error)
 """
 
 from __future__ import annotations

@@ -148,11 +148,24 @@ class BlockExecutor:
 
         from ..libs import tracing
 
+        from ..abci.client import ABCIAppRestartedError
+
         _t0 = _time.monotonic()
         with tracing.span("state.applyBlock", cat="state",
                           height=block.header.height,
                           txs=len(block.data.txs)):
-            return self._apply_block_inner(state, block_id, block, _t0)
+            try:
+                return self._apply_block_inner(state, block_id, block, _t0)
+            except ABCIAppRestartedError as e:
+                # the resilient consensus conn reconnected to a restarted
+                # app and re-synced it to the LAST COMMITTED height (the
+                # in-flight execution died with the old process, nothing
+                # was half-kept) — re-drive the whole block from scratch;
+                # never resume mid-block, so nothing can apply twice
+                self.logger.warning(
+                    "app restarted mid-block at height %d (%s); "
+                    "re-driving the full block", block.header.height, e)
+                return self._apply_block_inner(state, block_id, block, _t0)
 
     def _apply_block_inner(self, state: State, block_id: BlockID,
                            block: Block, _t0: float) -> State:

@@ -22,6 +22,12 @@ NO progress for RESTORE_STUCK_S seconds is flagged restore_stuck and
 degrades network health to "moderate" (a bootstrapping node wedged in
 `fetch` looks perfectly healthy to /status alone — it answers, at
 height 0, forever).
+
+And /debug/abci: the per-connection state of the node's resilient app
+link (proxy/resilient.py). Any conn off "healthy" flags the node
+abci_degraded and drops network health to "moderate" — a node whose
+mempool conn is down keeps committing (and looks fine to /status) while
+silently rejecting every CheckTx.
 """
 
 from __future__ import annotations
@@ -119,6 +125,10 @@ class NodeStatus:
     restore_chunks_total: int = 0
     _restore_progress_key: tuple = ()
     _restore_progress_at: float = 0.0
+    # ABCI app-connection view (from /debug/abci): conn name -> state
+    # ("healthy" | "degraded" | "down") per proxy/resilient.py
+    abci_conns: Dict[str, str] = field(default_factory=dict)
+    abci_reconnects: int = 0
 
     RESTORE_STUCK_S = 30.0
     # phases during which "no progress" means wedged (idle/done/failed
@@ -134,6 +144,13 @@ class NodeStatus:
     @property
     def restoring(self) -> bool:
         return self.restore_phase in self._RESTORE_ACTIVE
+
+    @property
+    def abci_degraded(self) -> bool:
+        """Any app connection not fully healthy — the node may still
+        answer /status and even commit (mempool/query conns fail soft),
+        but it is running on a degraded app link."""
+        return any(s != "healthy" for s in self.abci_conns.values())
 
     @property
     def restore_stuck(self) -> bool:
@@ -163,6 +180,8 @@ class NodeStatus:
         self.restore_phase = ""
         self._restore_progress_key = ()
         self._restore_progress_at = 0.0
+        self.abci_conns = {}
+        self.abci_reconnects = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -290,19 +309,34 @@ class Monitor:
         peers = (data.get("live") or {}).get("peers", [])
         ns.max_peer_lag = max(
             (int(p.get("lag_blocks", 0)) for p in peers), default=0)
+        # the statesync and abci scrapes are independent: a failure of
+        # either (older node, transient timeout) must reset ONLY its own
+        # view — never leave the other's stale flags pinning health()
         try:
             with urllib.request.urlopen(
                     f"http://{daddr}/debug/statesync", timeout=2.0) as r:
                 ss = json.load(r)
+            restore = ss.get("restore") or {}
+            ns.note_restore(
+                str(restore.get("phase", "")),
+                int(restore.get("chunks_applied", 0)),
+                int(restore.get("chunks_total", 0)),
+            )
         except Exception:  # noqa: BLE001 - older nodes lack the route
             ns.note_restore("", 0, 0)
-            return
-        restore = ss.get("restore") or {}
-        ns.note_restore(
-            str(restore.get("phase", "")),
-            int(restore.get("chunks_applied", 0)),
-            int(restore.get("chunks_total", 0)),
-        )
+        try:
+            with urllib.request.urlopen(
+                    f"http://{daddr}/debug/abci", timeout=2.0) as r:
+                ab = json.load(r)
+            conns = ab.get("conns") or {}
+            ns.abci_conns = {
+                name: str(c.get("state", "")) for name, c in conns.items()
+            }
+            ns.abci_reconnects = sum(
+                int(c.get("reconnects", 0)) for c in conns.values())
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.abci_conns = {}
+            ns.abci_reconnects = 0
 
     def _on_block(self, addr: str, ev: dict) -> None:
         ns = self.nodes[addr]
@@ -337,6 +371,9 @@ class Monitor:
                 # more than one block, is not "full" health even though
                 # every /status still answers
                 and not any(n.stalled for n in online)
+                # a node on a degraded/down app connection is not "full"
+                # health even while it keeps answering (and committing)
+                and not any(n.abci_degraded for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -384,6 +421,9 @@ class Monitor:
                                       f"{n.restore_chunks_total}"
                                       if n.restoring else "",
                     "restore_stuck": n.restore_stuck,
+                    "abci_conns": dict(n.abci_conns),
+                    "abci_degraded": n.abci_degraded,
+                    "abci_reconnects": n.abci_reconnects,
                 }
                 for n in self.nodes.values()
             ],
@@ -424,6 +464,11 @@ def main(argv=None) -> int:
                              f" stalls={n['stalls_total']}")
                     if n["stalled"]:
                         line += " [STALLED]"
+                    if n["abci_degraded"]:
+                        bad = ",".join(
+                            f"{k}={v}" for k, v in n["abci_conns"].items()
+                            if v != "healthy")
+                        line += f" [ABCI DEGRADED {bad}]"
                     if n["restore_phase"]:
                         line += (f" restore={n['restore_phase']}"
                                  f" {n['restore_chunks']}")

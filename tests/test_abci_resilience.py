@@ -1,0 +1,908 @@
+"""ABCI connection resilience (ISSUE 5): request deadlines, the
+ResilientClient supervisor and its per-connection policies, the chaos
+fault-injection proxy, the mempool/WAL fail-soft satellites, and the
+kill-the-app-under-a-committing-node e2e.
+
+The three policy proofs from the acceptance criteria:
+- a wedged app trips ABCITimeoutError within request_timeout_s
+  (TestRequestDeadlines)
+- a killed-then-restarted app is re-adopted by the consensus conn via
+  handshake re-sync with no double-applied block
+  (test_killed_app_is_readopted_via_handshake_resync, slow)
+- a down mempool conn degrades — CheckTx rejected, node keeps
+  committing — without halting consensus
+  (test_mempool_conn_down_node_keeps_committing)
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu import state as sm
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.chaos import ChaosClient, ChaosRule
+from tendermint_tpu.abci.client import (
+    ABCIAppRestartedError,
+    ABCIClientError,
+    ABCIConnectionError,
+    ABCITimeoutError,
+    LocalClient,
+    SocketClient,
+)
+from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.proxy import remote_client_creator
+from tendermint_tpu.proxy.resilient import (
+    STATE_DOWN,
+    STATE_HEALTHY,
+    ResilientClient,
+)
+from tendermint_tpu.types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class WedgeableKVStore(KVStoreApplication):
+    """A kvstore whose check_tx/commit can be wedged (block until
+    released) — the failure mode request deadlines exist for."""
+
+    def __init__(self):
+        super().__init__()
+        self.wedge = threading.Event()
+        self.release = threading.Event()
+
+    def _maybe_hang(self):
+        if self.wedge.is_set():
+            self.release.wait(30)
+
+    def check_tx(self, tx):
+        self._maybe_hang()
+        return super().check_tx(tx)
+
+    def commit(self):
+        self._maybe_hang()
+        return super().commit()
+
+
+def _serve(app):
+    srv = ABCIServer("tcp://127.0.0.1:0", app)
+    srv.start()
+    return srv, f"tcp://127.0.0.1:{srv.local_port()}"
+
+
+# --- tentpole 1: request deadlines -----------------------------------
+
+
+class TestRequestDeadlines:
+    def test_wedged_app_trips_timeout_within_deadline(self):
+        app = WedgeableKVStore()
+        srv, addr = _serve(app)
+        try:
+            c = SocketClient(addr, request_timeout=0.5)
+            assert c.check_tx(b"a=1").code == 0  # healthy baseline
+            app.wedge.set()
+            t0 = time.monotonic()
+            with pytest.raises(ABCITimeoutError):
+                c.check_tx(b"b=2")
+            elapsed = time.monotonic() - t0
+            assert 0.3 <= elapsed < 3.0, elapsed
+            # a timed-out socket is desynchronized: poisoned until redial
+            with pytest.raises(ABCIConnectionError):
+                c.echo("x")
+        finally:
+            app.release.set()
+            srv.stop()
+
+    def test_no_request_timeout_is_legacy_blocking(self):
+        app = WedgeableKVStore()
+        srv, addr = _serve(app)
+        try:
+            c = SocketClient(addr)  # request_timeout=0: no deadline
+            assert c._sock.gettimeout() is None
+            assert c.echo("hi") == "hi"
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_socket_dial_refused_is_connection_error(self):
+        with pytest.raises(ABCIConnectionError):
+            SocketClient(f"tcp://127.0.0.1:{_free_port()}", timeout=0.5)
+
+    def test_grpc_wedged_app_trips_timeout(self):
+        pytest.importorskip("grpc")
+        from tendermint_tpu.abci.grpc_app import (
+            GRPCApplicationServer,
+            GRPCClient,
+        )
+
+        app = WedgeableKVStore()
+        srv = GRPCApplicationServer("127.0.0.1:0", app)
+        srv.start()
+        c = None
+        try:
+            c = GRPCClient(srv.listen_addr, request_timeout=0.5)
+            assert c.check_tx(b"a=1").code == 0
+            app.wedge.set()
+            t0 = time.monotonic()
+            with pytest.raises(ABCITimeoutError):
+                c.check_tx(b"b=2")
+            assert time.monotonic() - t0 < 3.0
+        finally:
+            app.release.set()
+            if c is not None:
+                c.close()
+            srv.stop()
+
+    def test_grpc_dial_unavailable_is_connection_error(self):
+        pytest.importorskip("grpc")
+        from tendermint_tpu.abci.grpc_app import GRPCClient
+
+        t0 = time.monotonic()
+        with pytest.raises(ABCIConnectionError):
+            GRPCClient(f"127.0.0.1:{_free_port()}", timeout=0.5)
+        assert time.monotonic() - t0 < 5.0
+
+
+# --- tentpole 2: the ResilientClient supervisor ----------------------
+
+
+class _FakeClient:
+    """Scriptable in-memory client: echo works until `fail_with` is
+    armed, which fires exactly once."""
+
+    def __init__(self):
+        self.fail_with = None
+        self.closed = False
+
+    def echo(self, msg):
+        if self.fail_with is not None:
+            err, self.fail_with = self.fail_with, None
+            raise err
+        return msg
+
+    def close(self):
+        self.closed = True
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return pred()
+
+
+class TestResilientClient:
+    def test_retry_policy_fails_soft_then_reconnects(self):
+        made = []
+
+        def creator():
+            c = _FakeClient()
+            made.append(c)
+            return c
+
+        rc = ResilientClient("mempool", creator, policy="retry",
+                             backoff_base_s=0.005, backoff_max_s=0.01,
+                             retry_budget=3)
+        rc.start()
+        assert rc.state == STATE_HEALTHY
+        made[0].fail_with = ABCIConnectionError("boom")
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("in-flight")  # fails soft: the caller sees it
+        assert made[0].closed
+        assert _wait_for(lambda: rc.state == STATE_HEALTHY)
+        assert rc.echo("after") == "after"
+        assert rc.reconnects == 1
+        rc.close()
+
+    def test_retry_policy_reaches_down_then_readopts(self):
+        recovered = threading.Event()
+        made = []
+
+        def creator():
+            if made and not recovered.is_set():
+                raise ABCIConnectionError("connection refused")
+            c = _FakeClient()
+            made.append(c)
+            return c
+
+        rc = ResilientClient("query", creator, policy="retry",
+                             backoff_base_s=0.002, backoff_max_s=0.005,
+                             retry_budget=3)
+        rc.start()
+        made[0].fail_with = ABCIConnectionError("died")
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("x")
+        assert _wait_for(lambda: rc.state == STATE_DOWN)
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("fails fast while down")
+        recovered.set()
+        assert _wait_for(lambda: rc.state == STATE_HEALTHY)
+        assert rc.echo("back") == "back"
+        rc.close()
+
+    def test_consensus_handshake_policy_resyncs_then_raises(self):
+        made, resynced = [], []
+
+        def creator():
+            c = _FakeClient()
+            made.append(c)
+            return c
+
+        rc = ResilientClient("consensus", creator, policy="consensus",
+                             on_failure="handshake",
+                             backoff_base_s=0.002, backoff_max_s=0.005,
+                             retry_budget=5,
+                             resync=lambda client: resynced.append(client))
+        rc.start()
+        made[0].fail_with = ABCIConnectionError("app died")
+        with pytest.raises(ABCIAppRestartedError):
+            rc.echo("in-flight")
+        # the resync callback ran against the RAW reconnected client
+        assert resynced == [made[1]]
+        assert rc.state == STATE_HEALTHY
+        assert rc.reconnects == 1
+        assert rc.echo("next block") == "next block"
+        rc.close()
+
+    def test_consensus_halt_policy_invokes_on_fatal(self):
+        fatals = []
+        made = []
+
+        def creator():
+            c = _FakeClient()
+            made.append(c)
+            return c
+
+        rc = ResilientClient("consensus", creator, policy="consensus",
+                             on_failure="halt", on_fatal=fatals.append)
+        rc.start()
+        made[0].fail_with = ABCIConnectionError("gone")
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("x")
+        assert len(fatals) == 1
+        assert rc.state == STATE_DOWN
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("still fatal")
+        assert len(made) == 1  # halt never redialed
+        rc.close()
+
+    def test_consensus_handshake_budget_exhausted_halts(self):
+        fatals = []
+        first = _FakeClient()
+        n = {"calls": 0}
+
+        def creator():
+            n["calls"] += 1
+            if n["calls"] == 1:
+                return first
+            raise ABCIConnectionError("still dead")
+
+        rc = ResilientClient("consensus", creator, policy="consensus",
+                             on_failure="handshake", retry_budget=3,
+                             backoff_base_s=0.001, backoff_max_s=0.002,
+                             on_fatal=fatals.append)
+        rc.start()
+        first.fail_with = ABCIConnectionError("gone")
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("x")
+        assert len(fatals) == 1
+        assert n["calls"] == 1 + 3  # boot dial + retry_budget attempts
+        rc.close()
+
+    def test_app_exception_frame_is_not_a_conn_failure(self):
+        made = []
+
+        def creator():
+            c = _FakeClient()
+            made.append(c)
+            return c
+
+        rc = ResilientClient("mempool", creator, policy="retry")
+        rc.start()
+        made[0].fail_with = ABCIClientError("app exception: ouch")
+        with pytest.raises(ABCIClientError):
+            rc.echo("x")
+        assert rc.state == STATE_HEALTHY
+        assert len(made) == 1  # no redial: the conn is fine
+        assert rc.echo("y") == "y"
+        rc.close()
+
+    def test_consensus_timeout_halts_even_under_handshake_policy(self):
+        """A timeout proves nothing about app-process death: the app may
+        be slow-but-alive with half-applied working state, so re-driving
+        the block could double-apply. A consensus-conn timeout must halt
+        regardless of on_failure."""
+        from tendermint_tpu.metrics import prometheus_metrics
+
+        m = prometheus_metrics("t")
+        fatals = []
+        inner = _FakeClient()
+        rc = ResilientClient("consensus", lambda: inner,
+                             policy="consensus", on_failure="handshake",
+                             retry_budget=2, backoff_base_s=0.001,
+                             metrics=m.abci, on_fatal=fatals.append)
+        rc.start()
+        inner.fail_with = ABCITimeoutError("deadline")
+        with pytest.raises(ABCITimeoutError):
+            rc.echo("x")
+        assert len(fatals) == 1  # halted, never resynced/re-driven
+        assert rc.state == STATE_DOWN
+        body = m.registry.render()
+        lines = [l for l in body.splitlines()
+                 if l.startswith("t_abci_request_timeouts_total{")]
+        assert lines and 'method="echo"' in lines[0]
+        assert float(lines[0].split()[-1]) == 1.0
+        rc.close()
+
+    def test_retry_reconnect_probes_before_adoption(self):
+        """A backend that accepts dials but dies on every request must
+        not flap healthy↔degraded: the reconnect loop probes echo before
+        adopting, so the conn backs off toward down instead."""
+        half_dead = threading.Event()
+        half_dead.set()
+        made = []
+
+        def creator():
+            c = _FakeClient()
+            if half_dead.is_set():
+                c.fail_with = ABCIConnectionError("EOF on first request")
+            made.append(c)
+            return c
+
+        rc = ResilientClient("mempool", creator, policy="retry",
+                             backoff_base_s=0.002, backoff_max_s=0.005,
+                             retry_budget=3)
+        # boot succeeds: the dial itself works and start() doesn't probe
+        rc.start()
+        with pytest.raises(ABCIConnectionError):
+            rc.echo("x")  # trips the armed failure
+        # every redial's probe eats the armed failure -> down, no flap
+        assert _wait_for(lambda: rc.state == STATE_DOWN)
+        assert rc.reconnects == 0
+        half_dead.clear()
+        assert _wait_for(lambda: rc.state == STATE_HEALTHY)
+        assert rc.echo("back") == "back"
+        rc.close()
+
+    def test_boot_dial_retries_late_starting_app(self):
+        """A late-starting app delays boot instead of aborting it — the
+        shared dialer keeps retrying within the dial budget (the old
+        GRPCClient channel_ready crash, satellite 1)."""
+        up = threading.Event()
+        attempts = {"n": 0}
+
+        def creator():
+            attempts["n"] += 1
+            if not up.is_set():
+                raise ABCIConnectionError("connection refused")
+            return _FakeClient()
+
+        threading.Timer(0.15, up.set).start()
+        rc = ResilientClient("consensus", creator, policy="consensus",
+                             dial_timeout_s=5.0, backoff_base_s=0.01,
+                             backoff_max_s=0.05)
+        rc.start()  # must NOT raise
+        assert rc.state == STATE_HEALTHY
+        assert attempts["n"] > 1
+        rc.close()
+
+
+# --- tentpole 3: the chaos proxy -------------------------------------
+
+
+class TestChaosClient:
+    def _run_sequence(self, client):
+        out = [client.echo("hello")]
+        for tx in (b"a=1", b"b=2"):
+            r = client.check_tx(tx)
+            out.append((r.code, r.data, r.log))
+            r = client.deliver_tx(tx)
+            out.append((r.code, r.data))
+        out.append(client.commit().data)
+        info = client.info(abci.RequestInfo(version="x"))
+        out.append((info.last_block_height, info.last_block_app_hash))
+        return out
+
+    def test_empty_rules_pass_through_byte_identical(self):
+        direct = self._run_sequence(LocalClient(KVStoreApplication()))
+        chaotic = self._run_sequence(
+            ChaosClient(LocalClient(KVStoreApplication()), rules=(),
+                        seed=123))
+        assert direct == chaotic
+
+    def test_every_fault_kind_fires(self):
+        cases = {
+            "timeout": ABCITimeoutError,
+            "disconnect": ABCIConnectionError,
+            "exception": ABCIClientError,
+            "garbage": ABCIConnectionError,
+        }
+        for kind, exc_type in cases.items():
+            c = ChaosClient(
+                LocalClient(KVStoreApplication()),
+                rules=[ChaosRule(kind, methods=("echo",), max_fires=1)],
+                seed=1)
+            with pytest.raises(ABCIClientError) as ei:
+                c.echo("hi")
+            assert type(ei.value) is exc_type, kind
+            assert c.injected[kind] == 1
+            # rule exhausted (max_fires=1): pass-through again
+            assert c.echo("again") == "again"
+        # delay passes through, late
+        c = ChaosClient(
+            LocalClient(KVStoreApplication()),
+            rules=[ChaosRule("delay", methods=("echo",), delay_s=0.05,
+                             max_fires=1)],
+            seed=1)
+        t0 = time.monotonic()
+        assert c.echo("hi") == "hi"
+        assert time.monotonic() - t0 >= 0.05
+        assert c.injected["delay"] == 1
+
+    def test_rules_are_per_method(self):
+        c = ChaosClient(
+            LocalClient(KVStoreApplication()),
+            rules=[ChaosRule("exception", methods=("deliver_tx",))],
+            seed=1)
+        assert c.echo("fine") == "fine"
+        assert c.check_tx(b"a=1").code == 0
+        with pytest.raises(ABCIClientError):
+            c.deliver_tx(b"a=1")
+
+    def test_seeded_determinism(self):
+        def run(seed):
+            c = ChaosClient(
+                LocalClient(KVStoreApplication()),
+                rules=[ChaosRule("exception", probability=0.5)],
+                seed=seed)
+            outcomes = []
+            for i in range(64):
+                try:
+                    c.echo(str(i))
+                    outcomes.append(True)
+                except ABCIClientError:
+                    outcomes.append(False)
+            return outcomes
+
+        a = run(42)
+        assert a == run(42)
+        assert a != run(7)
+        assert any(a) and not all(a)  # both sides of the coin showed up
+
+    def test_unknown_fault_rejected(self):
+        with pytest.raises(ValueError):
+            ChaosRule("explode")
+
+
+# --- satellite: mempool fail-soft ------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.n = 0.0
+
+    def inc(self, amount=1.0):
+        self.n += amount
+
+
+class TestMempoolFailSoft:
+    def _mempool(self, client):
+        from tendermint_tpu.mempool import Mempool
+        from tendermint_tpu.metrics import MempoolMetrics
+
+        m = MempoolMetrics(recheck_failures=_Recorder())
+        return Mempool(cfg.MempoolConfig(), client, metrics=m), m
+
+    def test_recheck_conn_failure_keeps_txs(self):
+        chaos = ChaosClient(LocalClient(KVStoreApplication()))
+        mp, m = self._mempool(chaos)
+        for i in range(3):
+            assert mp.check_tx(b"k%d=v" % i).code == 0
+        assert mp.size() == 3
+        chaos.rules.append(ChaosRule("disconnect"))
+        mp.lock()
+        try:
+            mp.update(1, [b"k0=v"])  # removes k0, rechecks the rest
+        finally:
+            mp.unlock()
+        # recheck aborted on the conn failure but KEPT the pending txs
+        assert mp.size() == 2
+        assert m.recheck_failures.n == 1
+
+    def test_flush_app_conn_fails_soft(self):
+        chaos = ChaosClient(LocalClient(KVStoreApplication()),
+                            rules=[ChaosRule("disconnect")])
+        mp, m = self._mempool(chaos)
+        mp.flush_app_conn()  # must NOT raise: commit-path call
+        assert m.recheck_failures.n == 1
+
+    def test_check_tx_conn_failure_evicts_cache(self):
+        chaos = ChaosClient(
+            LocalClient(KVStoreApplication()),
+            rules=[ChaosRule("disconnect", methods=("check_tx",),
+                             max_fires=1)])
+        mp, _ = self._mempool(chaos)
+        with pytest.raises(ABCIConnectionError):
+            mp.check_tx(b"x=1")
+        # the tx was evicted from the dedup cache: resubmission works
+        assert mp.check_tx(b"x=1").code == 0
+        assert mp.size() == 1
+
+
+# --- satellite: WAL corruption visibility ----------------------------
+
+
+class TestWALCorruption:
+    def _write_wal(self, tmp_path, counter=None):
+        from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+        path = str(tmp_path / "cs.wal" / "wal")
+        w = WAL(path, corrupted_counter=counter)
+        w.start()
+        for h in range(1, 6):
+            w.write_sync(EndHeightMessage(h))
+        w.stop()
+        return path
+
+    def test_corrupt_record_counted_and_warned_once(self, tmp_path,
+                                                    caplog):
+        from tendermint_tpu.consensus.wal import WAL
+
+        path = self._write_wal(tmp_path)
+        with open(path, "r+b") as f:
+            f.seek(-3, os.SEEK_END)  # flip a payload byte mid-record
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        ctr = _Recorder()
+        w = WAL(path, corrupted_counter=ctr)
+        with caplog.at_level("WARNING", logger="consensus.wal"):
+            msgs = list(w.iter_messages())
+            assert 0 < len(msgs) < 6  # replay stops at the bad record
+            assert ctr.n == 1
+            list(w.iter_messages())  # second pass: counted again...
+            assert ctr.n == 2
+        warnings = [r for r in caplog.records
+                    if "WAL corruption at byte offset" in r.message]
+        assert len(warnings) == 1  # ...but warned once per WAL
+
+    def test_truncated_crash_tail_is_not_corruption(self, tmp_path):
+        from tendermint_tpu.consensus.wal import WAL
+
+        path = self._write_wal(tmp_path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size - 5)  # mid-record crash tail
+        ctr = _Recorder()
+        w = WAL(path, corrupted_counter=ctr)
+        msgs = list(w.iter_messages())
+        assert len(msgs) == 5  # all complete records
+        assert ctr.n == 0
+
+
+# --- the block-level no-double-apply contract ------------------------
+
+
+class _RestartOnceConn:
+    """Consensus conn that raises ABCIAppRestartedError from the first
+    begin_block — what ResilientClient raises after a reconnect+resync."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.tripped = False
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+    def begin_block(self, req):
+        if not self.tripped:
+            self.tripped = True
+            raise ABCIAppRestartedError("app restarted; re-drive")
+        return self.inner.begin_block(req)
+
+
+def test_block_executor_redrives_block_after_app_restart():
+    import test_state as ts
+
+    db = MemDB()
+    doc, keys = ts.make_genesis(1)
+    state = sm.load_state_from_db_or_genesis(db, doc)
+    conn = _RestartOnceConn(LocalClient(KVStoreApplication()))
+    executor = sm.BlockExecutor(db, conn)
+    new_state, block, _ = ts.apply_one(state, executor, keys,
+                                       txs=[b"x=1"])
+    assert conn.tripped
+    assert new_state.last_block_height == 1
+    # the app saw the block exactly once (no double apply)
+    info = conn.info(abci.RequestInfo(version="t"))
+    assert info.last_block_height == 1
+
+
+# --- node-level policy proofs ----------------------------------------
+
+
+def _node_config(tmp_path, name):
+    c = cfg.test_config()
+    c.set_root(str(tmp_path / name))
+    c.base.proxy_app = "kvstore"
+    c.base.moniker = name
+    c.rpc.laddr = ""
+    c.p2p.laddr = "tcp://127.0.0.1:0"
+    c.p2p.pex = False
+    c.consensus.wal_path = "data/cs.wal/wal"
+    return c
+
+
+def _init_files(c):
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    cfg.ensure_root(c.root_dir)
+    pv = load_or_gen_file_pv(c.base.priv_validator_path())
+    doc = GenesisDoc(
+        chain_id="resilience-chain",
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)],
+    )
+    doc.save(c.base.genesis_path())
+    return pv, doc
+
+
+def _wait_blocks(sub, target_height, timeout):
+    deadline = time.time() + timeout
+    height = 0
+    while height < target_height and time.time() < deadline:
+        msg = sub.get(timeout=1.0)
+        if msg is not None:
+            height = msg.data["block"].header.height
+    return height
+
+
+def test_mempool_conn_down_node_keeps_committing(tmp_path):
+    """Acceptance: a down mempool conn degrades (CheckTx rejected, node
+    keeps committing) without halting consensus."""
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.p2p import NodeKey
+
+    c = _node_config(tmp_path, "n0")
+    c.abci.retry_backoff_base_s = 0.01
+    c.abci.retry_backoff_max_s = 0.05
+    c.abci.retry_budget = 2
+    pv, doc = _init_files(c)
+    node_key = NodeKey.load_or_gen(c.base.node_key_path())
+
+    app = KVStoreApplication()
+    lock = threading.Lock()
+    chaos_handle = []
+    dead = threading.Event()
+    calls = {"n": 0}
+
+    def creator():
+        i = calls["n"]
+        calls["n"] += 1
+        if i == 1:  # the mempool conn (created second by AppConns)
+            chaos = ChaosClient(LocalClient(app, lock))
+            chaos_handle.append(chaos)
+            return chaos
+        if i >= 3 and dead.is_set():  # mempool redials: app gone for good
+            raise ABCIConnectionError("mempool app port gone")
+        return LocalClient(app, lock)
+
+    node = Node(c, pv, node_key, creator, doc)
+    sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 64)
+    node.start()
+    try:
+        h = _wait_blocks(sub, 2, timeout=30)
+        assert h >= 2
+        assert node.mempool.check_tx(b"pre=ok").code == 0
+
+        dead.set()
+        chaos_handle[0].rules.append(ChaosRule("disconnect"))
+        with pytest.raises(ABCIClientError):
+            node.mempool.check_tx(b"during=down")
+        # supervisor exhausts its budget against the dead "port"
+        assert _wait_for(
+            lambda: node.proxy_app.status()["conns"]["mempool"]["state"]
+            == STATE_DOWN, timeout=10)
+        with pytest.raises(ABCIClientError):
+            node.mempool.check_tx(b"still=down")  # rejected, fail-fast
+
+        # ...and consensus never noticed: the chain keeps advancing
+        h2 = _wait_blocks(sub, h + 2, timeout=30)
+        assert h2 >= h + 2, "consensus halted on a down mempool conn"
+        st = node.proxy_app.status()
+        assert st["conns"]["consensus"]["state"] == STATE_HEALTHY
+        assert st["conns"]["mempool"]["state"] == STATE_DOWN
+    finally:
+        node.stop()
+
+
+_APP_SERVER_SNIPPET = (
+    "import sys\n"
+    "from tendermint_tpu.abci.cli import main\n"
+    "sys.exit(main(['--address', sys.argv[1], 'kvstore']))\n"
+)
+
+
+def _start_app_subprocess(port):
+    env = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu",
+               JAX_PLATFORMS="cpu", TM_TPU_WARMUP="0")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _APP_SERVER_SNIPPET,
+         f"tcp://127.0.0.1:{port}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"app subprocess exited rc={proc.returncode}: "
+                f"{proc.stdout.read().decode(errors='replace')[-2000:]}")
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=0.2)
+            s.close()
+            return proc
+        except OSError:
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("app subprocess never bound its port")
+
+
+def _retry_abci(fn, timeout=15.0):
+    """Drive a fail-soft (mempool/query) conn until its background
+    redial lands."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn()
+        except ABCIClientError:
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.1)
+
+
+@pytest.mark.slow  # real app subprocess kill/restart under a live node
+def test_killed_app_is_readopted_via_handshake_resync(tmp_path):
+    """Acceptance: a killed-then-restarted app is re-adopted by the
+    consensus conn via handshake re-sync with no double-applied block.
+    The restarted kvstore is EMPTY (height 0), so the re-sync exercises
+    the full InitChain + app-only replay path and the final app-hash
+    cross-check before the in-flight block is re-driven."""
+    from tendermint_tpu.node import Node
+    from tendermint_tpu.p2p import NodeKey
+
+    port = _free_port()
+    app1 = _start_app_subprocess(port)
+    app2 = None
+
+    c = _node_config(tmp_path, "n0")
+    c.base.proxy_app = f"tcp://127.0.0.1:{port}"
+    c.abci.request_timeout_s = 5.0
+    c.abci.on_failure = "handshake"
+    c.abci.retry_budget = 200  # cover the app-restart window
+    c.abci.retry_backoff_base_s = 0.05
+    c.abci.retry_backoff_max_s = 0.25
+    pv, doc = _init_files(c)
+    node_key = NodeKey.load_or_gen(c.base.node_key_path())
+    creator = remote_client_creator(
+        c.base.proxy_app,
+        request_timeout=c.abci.request_timeout_s,
+        dial_timeout=c.abci.dial_timeout_s)
+
+    node = Node(c, pv, node_key, creator, doc)
+    sub = node.event_bus.subscribe("t", query_for_event(EVENT_NEW_BLOCK), 64)
+    node.start()
+    try:
+        assert node.mempool.check_tx(b"alive=before").code == 0
+        h = _wait_blocks(sub, 3, timeout=60)
+        assert h >= 3
+
+        app1.kill()
+        app1.wait(timeout=10)
+        app2 = _start_app_subprocess(port)
+
+        # the chain must pick back up and keep committing
+        h2 = _wait_blocks(sub, h + 3, timeout=90)
+        assert h2 >= h + 3, "chain did not advance after app restart"
+
+        st = node.proxy_app.status()
+        assert st["conns"]["consensus"]["state"] == STATE_HEALTHY
+        assert st["conns"]["consensus"]["reconnects"] >= 1
+
+        # no double apply: the re-synced app tracks the chain exactly —
+        # heights agree and the pre-kill tx is present with its value
+        info = _retry_abci(lambda: node.proxy_app.query.info(
+            abci.RequestInfo(version="t")))
+        assert info.last_block_height >= h
+        res = _retry_abci(lambda: node.proxy_app.query.query(
+            abci.RequestQuery(data=b"alive")))
+        assert res.value == b"before"
+    finally:
+        node.stop()
+        for p in (app1, app2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=5)
+
+
+# --- monitor satellite -----------------------------------------------
+
+
+def test_monitor_flags_abci_degraded_node():
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tendermint_tpu.tools.monitor import (
+        HEALTH_FULL,
+        HEALTH_MODERATE,
+        Monitor,
+    )
+
+    payloads = {
+        "/debug/consensus": {"dwell_s": 0.1, "threshold_s": 30.0,
+                             "stalls_total": 0, "stalls": [],
+                             "live": {"peers": []}},
+        "/debug/statesync": {},
+        "/debug/abci": {"conns": {
+            "consensus": {"state": "healthy", "reconnects": 1},
+            "mempool": {"state": "down", "reconnects": 4},
+            "query": {"state": "healthy", "reconnects": 0},
+        }},
+    }
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(payloads.get(self.path, {})).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    daddr = "%s:%d" % srv.server_address[:2]
+    try:
+        mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc-addr"]
+        ns.mark_online()
+        ns.height = 5
+        mon._poll_debug(ns, daddr)
+        assert ns.abci_conns["mempool"] == "down"
+        assert ns.abci_degraded
+        assert ns.abci_reconnects == 5
+        # node answers /status and commits — still only moderate health
+        assert mon.health() == HEALTH_MODERATE
+        snap = mon.snapshot()
+        assert snap["nodes"][0]["abci_degraded"] is True
+        assert snap["nodes"][0]["abci_conns"]["mempool"] == "down"
+
+        # conn recovers -> full again
+        payloads["/debug/abci"]["conns"]["mempool"]["state"] = "healthy"
+        mon._poll_debug(ns, daddr)
+        assert not ns.abci_degraded
+        assert mon.health() == HEALTH_FULL
+    finally:
+        srv.shutdown()
+        srv.server_close()

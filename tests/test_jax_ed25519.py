@@ -122,6 +122,8 @@ def test_jax_verify_multidevice(batch):
     assert got == want
 
 
+@pytest.mark.slow  # pallas interpret mode: ~60s on CPU-only hosts (same
+# class as the other slow-marked pallas tests in this file)
 def test_pallas_straus_matches_xla():
     """The fused pallas Straus kernel (interpret mode on CPU) must produce
     bit-identical limbs to the XLA curve.straus_mul_sub path."""
@@ -347,6 +349,10 @@ def test_jax_backend_registered():
     assert "jax" in backends()
 
 
+@pytest.mark.slow  # ~90s fresh XLA compile for a 5-sig batch shape; the
+# BatchVerifier interface itself is tier-1-covered on the cpu backend
+# (test_sig_cache / test_crypto_async) and the jax kernel by
+# test_jax_verify_batch
 def test_batch_verifier_interface(batch):
     from tendermint_tpu.crypto.batch import new_batch_verifier
 
@@ -358,6 +364,8 @@ def test_batch_verifier_interface(batch):
     assert bv.verify_all() == all(want)
 
 
+@pytest.mark.slow  # ~160s on CPU-only hosts: compiles BOTH the rlc and
+# per-item kernels to pin one documented edge-case divergence
 def test_rlc_is_cofactored_torsion_divergence_pinned():
     """verify_batch_rlc uses the COFACTORED group equation (z = 8u).
     This test pins the one documented divergence from the per-item

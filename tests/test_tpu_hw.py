@@ -102,6 +102,9 @@ print("BIT-IDENTITY-OK", int(mask_x[:n].sum()), n)
 """
 
 
+@pytest.mark.slow  # TPU-targeted bit-identity check: on CPU-only hosts
+# it degrades to ~60s of pallas-interpret + XLA compile (the class PR-1
+# slow-marked in test_jax_ed25519)
 def test_pallas_vs_xla_bit_identity_on_tpu():
     """The fused pallas kernel and the XLA path must produce identical
     verify masks on REAL TPU hardware — this is the tier that would

@@ -255,6 +255,9 @@ class TestCalibratedCutoff:
         assert calls == [10]
         self._reset()
 
+    @pytest.mark.slow  # runs the real verify-kernel warmup: ~120s of
+    # XLA compile on CPU-only hosts (same class as the slow-marked
+    # test_node warmup test)
     def test_warmup_calibrates_on_this_backend(self, monkeypatch):
         """warmup(calibrate=True) measures REAL dispatch + serial costs on
         the attached backend (CPU here) and installs a sane cutoff."""
