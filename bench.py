@@ -47,10 +47,13 @@ COMMIT4_MODE = "commit4" in sys.argv[1:]  # BASELINE.json config 1
 CACHE_MODE = "cache" in sys.argv[1:]  # duplicate-heavy sig-cache mode
 STATESYNC_MODE = "statesync" in sys.argv[1:]  # restore vs replay (PR 4)
 CHAOS_MODE = "chaos" in sys.argv[1:]  # ABCI reconnect recovery (PR 5)
+LOAD_MODE = "load" in sys.argv[1:]  # sustained-TPS mempool localnet (PR 6)
+PREVERIFY_MODE = "preverify" in sys.argv[1:]  # batched vs serial CheckTx
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
-                      "statesync", "chaos", "--pipeline")]
+                      "statesync", "chaos", "load", "preverify",
+                      "--pipeline")]
 try:
     METRIC_N = int(_args[0]) if _args else 10000
 except ValueError:
@@ -82,6 +85,11 @@ SS_NVAL = _env_int("TM_TPU_BENCH_SS_NVAL", 100)
 SS_METRIC = f"statesync_restore_vs_replay_{SS_NBLOCKS}x{SS_NVAL}val_wall_ms"
 CHAOS_ROUNDS = _env_int("TM_TPU_BENCH_CHAOS_ROUNDS", 10)
 CHAOS_METRIC = f"abci_reconnect_recovery_{CHAOS_ROUNDS}rounds_ms"
+LOAD_TPS = _env_int("TM_TPU_BENCH_LOAD_TPS", 200)
+LOAD_SECS = _env_int("TM_TPU_BENCH_LOAD_SECS", 5)
+LOAD_METRIC = f"mempool_load_{LOAD_TPS}tps_{LOAD_SECS}s_p99_commit_ms"
+PREVERIFY_N = _env_int("TM_TPU_BENCH_PREVERIFY_N", 2000)
+PREVERIFY_METRIC = f"mempool_preverify_{PREVERIFY_N}tx_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -681,6 +689,207 @@ def commit4_main():
     }))
 
 
+class _NullApp:
+    """Zero-cost app stand-in: isolates the mempool's own ingest cost
+    (signature verification, locks, batching) from app logic."""
+
+    def check_tx(self, tx):
+        from tendermint_tpu.abci import types as abci_types
+
+        return abci_types.ResponseCheckTx(code=0, gas_wanted=1)
+
+    def flush(self):
+        pass
+
+
+def preverify_main():
+    """`bench.py preverify` — batched CheckTx signature pre-verification
+    (the ingest queue draining into ONE crypto/batch call riding the
+    verified-signature cache) vs the serial per-tx verify path, same
+    txs, same app. The cache is warmed first — the batched path's win
+    is exactly the PR-2 vote trick applied to tx ingest: a warm cache
+    turns the whole signature batch into sha256 lookups while the
+    serial path re-verifies every tx. cpu backend forced: this mode
+    must not pay (or hang on) a jax init."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto import keys
+    from tendermint_tpu.crypto.sigcache import SigCache
+    from tendermint_tpu.mempool import Mempool, make_signed_tx
+
+    crypto_batch.set_default_backend("cpu")
+    crypto_batch.set_sig_cache(SigCache(4 * PREVERIFY_N))
+    sks = [keys.PrivKeyEd25519.generate() for _ in range(32)]
+    txs = [make_signed_tx(sks[i % len(sks)], b"load-%06d" % i,
+                          priority=i % 4)
+           for i in range(PREVERIFY_N)]
+
+    def serial_run():
+        # the serial baseline is the REFERENCE semantics: one full
+        # Ed25519 verify per tx, no cache (the serial mempool path
+        # itself rides the sig cache when installed — uninstall it for
+        # the baseline so the measured contrast is architectural)
+        cache = crypto_batch.get_sig_cache()
+        crypto_batch.set_sig_cache(None)
+        try:
+            mp = Mempool(cfg.MempoolConfig(size=PREVERIFY_N + 1), _NullApp())
+            for tx in txs:
+                assert mp.check_tx(tx).code == 0
+            return mp
+        finally:
+            crypto_batch.set_sig_cache(cache)
+
+    def batched_run():
+        mp = Mempool(
+            cfg.MempoolConfig(size=PREVERIFY_N + 1, preverify_batch=True,
+                              preverify_batch_max=256,
+                              ingest_queue_size=2 * PREVERIFY_N),
+            _NullApp())
+        futs = [mp.check_tx_nowait(tx) for tx in txs]
+        for f in futs:
+            assert f.result(timeout=60).code == 0
+        mp.stop()
+        return mp
+
+    batched_run()  # warm: fills the verified-signature cache
+    serial_ms = _best_of(serial_run, 3)
+    batched_ms = _best_of(batched_run, 3)
+    crypto_batch.shutdown_dispatchers()
+    crypto_batch.set_sig_cache(None)
+    print(json.dumps({
+        "metric": PREVERIFY_METRIC,
+        "value": round(batched_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(serial_ms / batched_ms, 2),
+        "serial_ms": round(serial_ms, 3),
+        "note": ("batched ingest (one verify_async per drain, warm sig "
+                 "cache) vs serial per-tx Ed25519 verify; cpu backend"),
+    }))
+    return 0
+
+
+def load_main():
+    """`bench.py load` — sustained-load harness: drive an in-process
+    single-validator localnet at a target TPS through the batched
+    ingest path and report accepted TPS plus p50/p99 commit latency
+    (submit -> the NewBlock event carrying the tx). Pure host path."""
+    import hashlib
+    import threading
+
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.consensus import ConsensusState
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto import keys
+    from tendermint_tpu.libs.db import MemDB
+    from tendermint_tpu.mempool import Mempool, make_signed_tx
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK, EventBus, query_for_event)
+    from tendermint_tpu.types.validator_set import random_validator_set
+
+    crypto_batch.set_default_backend("cpu")
+    vs, vkeys = random_validator_set(1, 10)
+    doc = GenesisDoc(
+        chain_id="bench-load",
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(v.pub_key, v.voting_power)
+                    for v in vs.validators],
+    )
+    db = MemDB()
+    state = sm.load_state_from_db_or_genesis(db, doc)
+    conns = AppConns(local_client_creator(KVStoreApplication()))
+    conns.start()
+    mp = Mempool(
+        cfg.MempoolConfig(size=50000, lanes=2, preverify_batch=True,
+                          ingest_queue_size=50000, recheck=False),
+        conns.mempool)
+    bus = EventBus()
+    bus.start()
+    block_exec = sm.BlockExecutor(db, conns.consensus, mempool=mp,
+                                  event_bus=bus)
+    ccfg = cfg.test_config().consensus
+    cs = ConsensusState(
+        ccfg, state, block_exec, BlockStore(MemDB()),
+        mempool=mp, event_bus=bus, priv_validator=FilePV(vkeys[0], None),
+    )
+    sub = bus.subscribe("bench-load", query_for_event(EVENT_NEW_BLOCK), 4096)
+    cs.start()
+
+    sk = keys.PrivKeyEd25519.generate()
+    submit_at = {}
+    latencies_ms = []
+    committed = set()
+
+    def _drain(timeout):
+        msg = sub.get(timeout=timeout)
+        if msg is None:
+            return
+        now = time.perf_counter()
+        for tx in msg.data["block"].data.txs:
+            k = hashlib.sha256(tx).digest()
+            t0 = submit_at.get(k)
+            if t0 is not None and k not in committed:
+                committed.add(k)
+                latencies_ms.append((now - t0) * 1000)
+
+    futs = []
+    t_start = time.perf_counter()
+    n_target = LOAD_TPS * LOAD_SECS
+    for i in range(n_target):
+        tx = make_signed_tx(sk, b"bench-load-%08d" % i, priority=i % 2)
+        k = hashlib.sha256(tx).digest()
+        submit_at[k] = time.perf_counter()
+        futs.append(mp.check_tx_nowait(tx))
+        # pace to the target, absorbing drain time into the schedule
+        next_t = t_start + (i + 1) / LOAD_TPS
+        while time.perf_counter() < next_t:
+            _drain(timeout=max(0.0, next_t - time.perf_counter()))
+    accepted = 0
+    for f in futs:
+        try:
+            if f.result(timeout=30).code == 0:
+                accepted += 1
+        except Exception:  # noqa: BLE001 - full pool counts as rejected
+            pass
+    # grace: let the tail commit
+    deadline = time.time() + max(10.0, 2 * LOAD_SECS)
+    while len(committed) < accepted and time.time() < deadline:
+        _drain(timeout=0.25)
+    wall_s = time.perf_counter() - t_start
+
+    cs.stop()
+    bus.stop()
+    mp.stop()
+    conns.stop()
+    crypto_batch.shutdown_dispatchers()
+
+    lat = sorted(latencies_ms)
+
+    def _pct(p):
+        return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else -1.0
+
+    accepted_tps = accepted / max(wall_s, 1e-9)
+    print(json.dumps({
+        "metric": LOAD_METRIC,
+        "value": round(_pct(0.99), 3),
+        "unit": "ms",
+        "vs_baseline": round(accepted_tps / LOAD_TPS, 2),
+        "target_tps": LOAD_TPS,
+        "accepted_tps": round(accepted_tps, 1),
+        "committed": len(committed),
+        "p50_ms": round(_pct(0.50), 3),
+        "p99_ms": round(_pct(0.99), 3),
+        "note": ("single-validator in-process localnet, batched ingest, "
+                 "2 lanes; vs_baseline = accepted/target TPS"),
+    }))
+    return 0
+
+
 def chaos_main():
     """`bench.py chaos` — ABCI reconnect recovery latency: a real
     kvstore socket app, a ResilientClient(retry) supervising the
@@ -767,6 +976,10 @@ def main():
         return commit4_main()
     if CHAOS_MODE:
         return chaos_main()
+    if LOAD_MODE:
+        return load_main()
+    if PREVERIFY_MODE:
+        return preverify_main()
     degraded = None
     if os.environ.get("TM_TPU_BENCH_FORCE_CPU"):
         degraded = "cpu8-forced"  # BASELINE config 2: by-design CPU mode

@@ -228,6 +228,17 @@ REQUIRED_FAMILIES = (
     "abci_conn_state",
     "mempool_recheck_failures_total",
     "wal_corrupted_records_total",
+    # PR-6 high-throughput mempool (lane/ingest families legitimately
+    # record no samples until txs flow; declaration presence is the
+    # contract, as with the other families above)
+    "mempool_size",
+    "mempool_recheck_times",
+    "mempool_lane_depth",
+    "mempool_checktx_batch_size",
+    "mempool_ingest_queue_wait_seconds",
+    "mempool_preverify_cache_hits_total",
+    "mempool_preverify_rejected_total",
+    "mempool_recheck_skipped_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -90,13 +90,40 @@ class P2PConfig:
 
 @dataclass
 class MempoolConfig:
-    """reference config/config.go:508-560"""
+    """reference config/config.go:508-560 (+ the throughput knobs, ours:
+    lanes/preverify/recheck_mode — every default reproduces the
+    reference's single-lane, synchronous, full-recheck behavior)"""
 
     recheck: bool = True
     broadcast: bool = True
     wal_path: str = ""  # empty = no mempool WAL
     size: int = 5000
     cache_size: int = 10000
+    # priority/fee lanes: the pool splits into `lanes` independent FIFO
+    # shards (per-lane locks + gossip cursors). Reap order is ALWAYS
+    # (priority desc, arrival asc) regardless of lane count — identical
+    # to the reference FIFO while every tx has the default priority 0
+    # (plain txs always do; only signed envelopes carry priorities).
+    # 1 = the reference's single list.
+    lanes: int = 1
+    # recognize the signed-tx envelope (mempool/preverify.py MAGIC):
+    # enveloped txs are signature-checked by the node (serially, or in
+    # batches with preverify_batch) and carry priority/sender. Off, the
+    # magic is just opaque app bytes — the escape hatch for an app
+    # whose own tx format could collide with the 5-byte prefix.
+    envelopes: bool = True
+    # batched CheckTx signature pre-verification: an ingest queue drains
+    # waiting txs into one crypto/batch verify_async call (riding the
+    # sig cache + dispatch threads) before the per-tx ABCI CheckTx.
+    # False = today's synchronous per-tx path.
+    preverify_batch: bool = False
+    preverify_batch_max: int = 256  # max txs drained per verify batch
+    ingest_queue_size: int = 10000  # submit() fails ErrMempoolIsFull past this
+    # post-commit recheck scope: "full" re-runs CheckTx on every pending
+    # tx (reference Update :526); "incremental" rechecks only txs whose
+    # sender was touched by the committed set (unsigned txs, which carry
+    # no sender, are always rechecked)
+    recheck_mode: str = "full"
 
 
 @dataclass

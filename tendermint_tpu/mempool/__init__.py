@@ -6,3 +6,8 @@ from .mempool import (  # noqa: F401
     Mempool,
     TxCache,
 )
+from .preverify import (  # noqa: F401
+    CODE_BAD_SIGNATURE,
+    make_signed_tx,
+    parse as parse_signed_tx,
+)

@@ -1,25 +1,59 @@
 """Mempool — app-validated pending transactions.
 
 Reference parity: mempool/mempool.go. Txs pass CheckTx against the app's
-mempool connection (:299), live in an ordered list traversed lock-light
-by the gossip reactor (CList in the reference; here a list + condition
-variable with monotonically-growing indices), are reaped for proposals
-(:466 ReapMaxBytesMaxGas), and are rechecked after every commit (:526
-Update). A sha256 cache dedupes (:60).
+mempool connection (:299), are reaped for proposals (:466
+ReapMaxBytesMaxGas), and are rechecked after every commit (:526 Update).
+A sha256 cache dedupes (:60).
+
+Throughput layers on top of the reference shape (all off by default —
+for the plain opaque txs every existing app emits, `MempoolConfig()`
+reproduces the single-lane, synchronous, full-recheck reference
+behavior exactly; txs that opt into the NEW signed envelope format
+additionally get node-side signature checks and (priority desc,
+admission asc) reap ordering at any lane count — see
+PARITY_DEVIATIONS.md item 11 and the `envelopes` knob):
+
+- **Priority lanes** (config.lanes > 1): the pool splits into N
+  independent FIFO shards, one per priority band, each with its own
+  lock so gossip and status reads never contend with a long
+  update/recheck holding the global mutex. Reap merges lanes by
+  (priority desc, admission seq asc) — byte-identical to a single-lane
+  pool over the same txs (tests/test_mempool_throughput.py proves it by
+  property), and with all-default priorities it degenerates to the
+  reference's FIFO.
+- **Batched CheckTx pre-verification** (config.preverify_batch): an
+  ingest queue (preverify.IngestQueue) drains waiting txs into ONE
+  crypto/batch verify_async call — riding the PR-2 verified-signature
+  cache and dispatch threads — before the per-tx ABCI CheckTx, so the
+  app only ever sees signature-valid txs and the Ed25519 cost is paid
+  once per batch. Enveloped txs (preverify.MAGIC) are sig-checked on
+  the serial path too, one at a time, so acceptance is identical in
+  both modes.
+- **Incremental recheck** (config.recheck_mode = "incremental"):
+  after a commit only txs whose sender was touched by the committed
+  set — plus unsigned txs, which carry no sender, and any tx the
+  operator's recheck_filter flags — re-run CheckTx; the rest skip the
+  app round trip entirely (counted in mempool_recheck_skipped_total).
+
+Gossip cursors are admission-sequence based (every admitted tx gets a
+monotonic seq): a commit compacting the list can never make a peer's
+cursor skip surviving txs (the old index-based cursor could).
 """
 
 from __future__ import annotations
 
+import bisect
 import hashlib
 import logging
 import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 from ..abci import types as abci
 from ..config import MempoolConfig
+from . import preverify
 
 LOG = logging.getLogger("mempool")
 
@@ -46,11 +80,57 @@ def _tx_key(tx: bytes) -> bytes:
 
 @dataclass
 class MempoolTx:
-    """reference mempoolTx :550-560"""
+    """reference mempoolTx :550-560 (+ priority/sender from the signed
+    envelope and the admission seq backing gossip cursors)"""
 
     tx: bytes
     gas_wanted: int
     height: int  # height at which tx was validated
+    priority: int = 0
+    sender: Optional[bytes] = None  # envelope pubkey; None = unsigned
+    seq: int = 0  # global admission order (monotonic)
+
+
+class _Lane:
+    """One priority shard: a FIFO of MempoolTx (seq ascending) guarded
+    by its own lock. Mutations additionally happen under the mempool's
+    global mutex (lock order: global -> lane); readers — gossip scans,
+    status — take only the lane lock."""
+
+    __slots__ = ("idx", "lock", "txs", "seqs", "bytes")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.lock = threading.Lock()
+        self.txs: List[MempoolTx] = []
+        self.seqs: List[int] = []  # parallel to txs, for cursor bisect
+        self.bytes = 0  # running sum(len(tx)): O(1) pressure reads
+
+    def append(self, mtx: MempoolTx) -> None:
+        with self.lock:
+            self.txs.append(mtx)
+            self.seqs.append(mtx.seq)
+            self.bytes += len(mtx.tx)
+
+    def replace(self, kept: List[MempoolTx]) -> None:
+        with self.lock:
+            self.txs = kept
+            self.seqs = [m.seq for m in kept]
+            self.bytes = sum(len(m.tx) for m in kept)
+
+    def snapshot(self) -> List[MempoolTx]:
+        with self.lock:
+            return list(self.txs)
+
+    def next_after(self, seq: int) -> Optional[MempoolTx]:
+        """First tx with admission seq strictly greater than `seq`."""
+        with self.lock:
+            pos = bisect.bisect_right(self.seqs, seq)
+            return self.txs[pos] if pos < len(self.txs) else None
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self.txs)
 
 
 class TxCache:
@@ -84,7 +164,9 @@ class TxCache:
 
 class Mempool:
     """The reference's Mempool struct (:63-117). Locking model: `lock`
-    serializes Update/Reap against CheckTx (reference :34-60 doc)."""
+    serializes Update/Reap against CheckTx admission (reference :34-60
+    doc); per-lane locks additionally guard each shard so reads
+    (gossip, status) proceed while the global mutex is held."""
 
     def __init__(
         self,
@@ -96,19 +178,37 @@ class Mempool:
         from ..metrics import MempoolMetrics
 
         self.config = config
+        mode = getattr(config, "recheck_mode", "full")
+        if mode not in ("full", "incremental"):
+            # a typo'd mode silently degrading to full recheck would be
+            # invisible (just a flat recheck_skipped counter) — refuse it
+            raise ValueError(
+                f"[mempool] recheck_mode must be 'full' or 'incremental', "
+                f"got {mode!r}")
         self.proxy_app = proxy_app
         self.height = height
         self.metrics = metrics if metrics is not None else MempoolMetrics()
         self._lock = threading.RLock()  # the proxy/update mutex
-        self._txs: List[MempoolTx] = []
-        self._txs_map: Dict[bytes, MempoolTx] = {}
+        self._nlanes = max(1, int(getattr(config, "lanes", 1)))
+        self._lanes = [_Lane(i) for i in range(self._nlanes)]
+        self._seq = 0  # admission counter (monotonic, under _lock)
         self.cache = TxCache(config.cache_size)
         self.pre_check: Optional[Callable[[bytes], None]] = None
         self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
+        # incremental recheck's "app-flagged" hook: txs for which this
+        # returns True are rechecked even when their sender is untouched
+        self.recheck_filter: Optional[Callable[[bytes], bool]] = None
         self._txs_available_cbs: List[Callable[[], None]] = []
         self._cond = threading.Condition(self._lock)
         self._wal = None
         self._last_app_warn = 0.0
+        self._ingest: Optional[preverify.IngestQueue] = None
+        if getattr(config, "preverify_batch", False):
+            self._ingest = preverify.IngestQueue(
+                self,
+                batch_max=getattr(config, "preverify_batch_max", 256),
+                queue_size=getattr(config, "ingest_queue_size", 10000),
+            )
 
     def _warn_app_failure(self, what: str, err: Exception) -> None:
         """Count + rate-limited warn: a failing app used to be silently
@@ -137,15 +237,33 @@ class Mempool:
                 self._wal.close()
                 self._wal = None
 
+    def stop(self) -> None:
+        """Drain + join the ingest worker (if any) and close the WAL."""
+        if self._ingest is not None:
+            self._ingest.stop()
+        self.close_wal()
+
     # --- basic accessors ----------------------------------------------------
 
     def size(self) -> int:
-        with self._lock:
-            return len(self._txs)
+        return sum(len(lane) for lane in self._lanes)
 
     def tx_bytes(self) -> int:
-        with self._lock:
-            return sum(len(t.tx) for t in self._txs)
+        total = 0
+        for lane in self._lanes:
+            with lane.lock:
+                total += lane.bytes
+        return total
+
+    def lane_count(self) -> int:
+        return self._nlanes
+
+    def lane_of(self, priority: int) -> int:
+        """Priority band -> lane index (clamped)."""
+        return min(max(priority, 0), self._nlanes - 1)
+
+    def ingest_queue_depth(self) -> int:
+        return self._ingest.qsize() if self._ingest is not None else 0
 
     def lock(self) -> None:
         self._lock.acquire()
@@ -166,20 +284,59 @@ class Mempool:
     def flush(self) -> None:
         """Remove everything (reference Flush :450)."""
         with self._lock:
-            self._txs.clear()
-            self._txs_map.clear()
+            for lane in self._lanes:
+                lane.replace([])
             self.cache.reset()
+            self._set_lane_gauges()
+
+    def _merged(self) -> List[MempoolTx]:
+        """Every pending tx in reap order: priority desc, admission asc.
+        With all-equal priorities this IS admission (reference) order."""
+        out: List[MempoolTx] = []
+        for lane in self._lanes:
+            out.extend(lane.snapshot())
+        out.sort(key=lambda m: (-m.priority, m.seq))
+        return out
 
     def txs_snapshot(self) -> List[bytes]:
-        with self._lock:
-            return [t.tx for t in self._txs]
+        return [m.tx for m in self._merged()]
+
+    def status(self) -> dict:
+        """The /debug/mempool bundle: pool pressure at a glance —
+        load tooling watches this without reaping."""
+        lanes = []
+        for lane in self._lanes:
+            with lane.lock:
+                lanes.append({
+                    "lane": lane.idx,
+                    "depth": len(lane.txs),
+                    "bytes": lane.bytes,
+                })
+        return {
+            "size": sum(l["depth"] for l in lanes),
+            "max_size": self.config.size,
+            "tx_bytes": sum(l["bytes"] for l in lanes),
+            "lanes": lanes,
+            "preverify_batch": self._ingest is not None,
+            "ingest": {
+                "queued": self.ingest_queue_depth(),
+                "capacity": (self._ingest.capacity
+                             if self._ingest is not None else 0),
+            },
+            "recheck_mode": getattr(self.config, "recheck_mode", "full"),
+        }
+
+    def _set_lane_gauges(self) -> None:
+        for lane in self._lanes:
+            self.metrics.lane_depth.with_labels(str(lane.idx)).set(len(lane))
+        self.metrics.size.set(self.size())
 
     # --- txs-available notification (reference :119-161) --------------------
 
     def notify_txs_available(self, cb: Callable[[], None]) -> None:
         """One-shot callback when the pool becomes non-empty."""
         with self._lock:
-            if self._txs:
+            if self.size():
                 cb()
             else:
                 self._txs_available_cbs.append(cb)
@@ -196,10 +353,72 @@ class Mempool:
 
     def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
         """Validate tx against the app and admit to the pool (reference
-        CheckTx :299-345 + resCbNormal :357-397)."""
+        CheckTx :299-345 + resCbNormal :357-397). With preverify_batch
+        on, the call funnels through the batching ingest queue (the
+        result is identical — this just lets concurrent submitters share
+        one signature batch)."""
+        if self._ingest is not None:
+            return self._ingest.submit(tx).result()
+        return self._check_tx_serial(tx)
+
+    def check_tx_nowait(self, tx: bytes) -> Optional[preverify.TxFuture]:
+        """Fire-and-forget submission into the batching ingest queue.
+        Returns None when batching is off — the caller runs check_tx()
+        inline (today's behavior) instead."""
+        if self._ingest is None:
+            return None
+        return self._ingest.submit(tx)
+
+    def parse_envelope(self, tx: bytes) -> Optional[preverify.SignedTx]:
+        """The envelope view of tx — None for plain txs, and for EVERY
+        tx when [mempool] envelopes is off (the escape hatch for apps
+        whose opaque tx bytes could collide with the magic prefix)."""
+        if not getattr(self.config, "envelopes", True):
+            return None
+        return preverify.parse(tx)
+
+    def _check_tx_serial(self, tx: bytes) -> abci.ResponseCheckTx:
+        """The synchronous per-tx path: envelope signatures verify one
+        at a time, right here (reference-shaped serial cost)."""
+        parsed = self.parse_envelope(tx)
+        if parsed is not None and not self._verify_envelope(parsed):
+            self.metrics.preverify_rejected.inc()
+            return preverify.reject_response()
+        return self._admit_preverified(tx, parsed)
+
+    def _verify_envelope(self, parsed: preverify.SignedTx) -> bool:
+        """Serial envelope verification riding the process-wide
+        verified-signature cache when one is installed: a replayed or
+        gossip-duplicated signed tx costs a sha256 lookup, not another
+        full Ed25519 verify — the same cheap-replay hardening the
+        batched path gets from BatchVerifier's cache pass. Both
+        verdicts are cached, so bad-sig replays are cheap too."""
+        from ..crypto import batch as crypto_batch
+
+        cache = crypto_batch.get_sig_cache()
+        if cache is None:
+            return parsed.verify()
+        k = cache.key(parsed.msg, parsed.sig, parsed.pubkey)
+        v = cache.get(k)
+        if v is not None:
+            self.metrics.preverify_cache_hits.inc()
+            return v
+        v = parsed.verify()
+        cache.put(k, v)
+        return v
+
+    def _admit_preverified(
+        self, tx: bytes, parsed: Optional[preverify.SignedTx]
+    ) -> abci.ResponseCheckTx:
+        """Admission after signature pre-verification (or for plain
+        txs): size/dedup gates, the per-tx ABCI CheckTx, lane insert."""
         with self._lock:
-            if len(self._txs) >= self.config.size:
-                raise ErrMempoolIsFull(f"mempool is full: {len(self._txs)} txs")
+            # lanes mutate only under this lock, so the count stays
+            # exact through the admission below (computed once — the
+            # sweep takes every lane lock)
+            size = self.size()
+            if size >= self.config.size:
+                raise ErrMempoolIsFull(f"mempool is full: {size} txs")
             if self.pre_check is not None:
                 try:
                     self.pre_check(tx)
@@ -226,11 +445,22 @@ class Mempool:
                     res = abci.ResponseCheckTx(code=1, log=f"postCheck: {e}")
 
             if res.code == abci.CODE_TYPE_OK:
-                mtx = MempoolTx(tx=tx, gas_wanted=res.gas_wanted, height=self.height)
-                self._txs.append(mtx)
-                self._txs_map[_tx_key(tx)] = mtx
-                LOG.debug("added good tx %s (pool=%d)", _tx_key(tx).hex()[:12], len(self._txs))
-                self.metrics.size.set(len(self._txs))
+                priority = parsed.priority if parsed is not None else 0
+                self._seq += 1
+                mtx = MempoolTx(
+                    tx=tx, gas_wanted=res.gas_wanted, height=self.height,
+                    priority=priority,
+                    sender=parsed.pubkey if parsed is not None else None,
+                    seq=self._seq,
+                )
+                lane = self._lanes[self.lane_of(priority)]
+                lane.append(mtx)
+                if LOG.isEnabledFor(logging.DEBUG):
+                    LOG.debug("added good tx %s (lane=%d pool=%d)",
+                              _tx_key(tx).hex()[:12], lane.idx, size + 1)
+                self.metrics.lane_depth.with_labels(str(lane.idx)).set(
+                    len(lane))
+                self.metrics.size.set(size + 1)
                 self.metrics.tx_size_bytes.observe(len(tx))
                 self._fire_txs_available()
                 self._cond.notify_all()
@@ -246,12 +476,13 @@ class Mempool:
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         """Txs for a proposal under byte+gas limits (reference
-        ReapMaxBytesMaxGas :466-505)."""
+        ReapMaxBytesMaxGas :466-505), walked in merged lane order
+        (priority desc, admission asc)."""
         with self._lock:
             total_bytes = 0
             total_gas = 0
             out: List[bytes] = []
-            for mtx in self._txs:
+            for mtx in self._merged():
                 n = len(mtx.tx)
                 if max_bytes > -1 and total_bytes + n > max_bytes:
                     break
@@ -264,9 +495,10 @@ class Mempool:
 
     def reap_max_txs(self, n: int) -> List[bytes]:
         with self._lock:
+            merged = self._merged()
             if n < 0:
-                return [t.tx for t in self._txs]
-            return [t.tx for t in self._txs[:n]]
+                return [m.tx for m in merged]
+            return [m.tx for m in merged[:n]]
 
     # --- Update (post-commit) ----------------------------------------------
 
@@ -290,50 +522,118 @@ class Mempool:
         # commit txs stay in the cache so they can't re-enter
         for tx in txs:
             self.cache.push(tx)
-        kept = [m for m in self._txs if _tx_key(m.tx) not in committed]
-        self._txs = kept
-        self._txs_map = {_tx_key(m.tx): m for m in kept}
 
-        if kept and self.config.recheck:
-            LOG.debug("rechecking %d txs at height %d", len(kept), height)
-            self.metrics.recheck_times.inc(len(kept))
-            self._recheck_txs()
-        self.metrics.size.set(len(self._txs))
-        if self._txs:
+        # incremental recheck: only senders the committed set touched can
+        # have had their pending txs invalidated (nonce bumps, balance
+        # spends); everyone else skips the app round trip
+        incremental = (self.config.recheck
+                       and getattr(self.config, "recheck_mode", "full")
+                       == "incremental")
+        touched = set()
+        if incremental:
+            for tx in txs:
+                p = self.parse_envelope(tx)
+                if p is not None:
+                    touched.add(p.pubkey)
+
+        for lane in self._lanes:
+            kept = [m for m in lane.snapshot()
+                    if _tx_key(m.tx) not in committed]
+            lane.replace(kept)
+            if kept and self.config.recheck:
+                self._recheck_lane(lane, touched if incremental else None)
+        self._set_lane_gauges()
+        if self.size():
             self._fire_txs_available()
 
-    def _recheck_txs(self) -> None:
-        """Re-run CheckTx on everything still pending (reference
-        recheckTxs :569-585 + resCbRecheck :399-442). Runs inside the
+    def _should_recheck(self, mtx: MempoolTx, touched: Optional[set]) -> bool:
+        if touched is None:  # full mode
+            return True
+        if mtx.sender is None:  # unsigned: no sender to attribute
+            return True
+        if mtx.sender in touched:
+            return True
+        flt = self.recheck_filter
+        if flt is not None:
+            try:
+                return bool(flt(mtx.tx))
+            except Exception:  # noqa: BLE001 - a bad hook must not drop txs
+                LOG.exception("recheck_filter failed; rechecking tx")
+                return True
+        return False
+
+    def _recheck_lane(self, lane: _Lane, touched: Optional[set]) -> None:
+        """Re-run CheckTx on one lane's survivors (reference recheckTxs
+        :569-585 + resCbRecheck :399-442) — all of them in full mode,
+        only invalidated ones in incremental mode. Runs inside the
         commit path: a transport-level failure aborts the recheck and
         KEEPS the remaining txs (they are rechecked after the next
         commit) instead of propagating into — and halting — consensus."""
+        txs = lane.snapshot()
         still: List[MempoolTx] = []
-        for i, mtx in enumerate(self._txs):
+        rechecked = skipped = 0
+        for i, mtx in enumerate(txs):
+            if not self._should_recheck(mtx, touched):
+                skipped += 1
+                still.append(mtx)
+                continue
             try:
                 res = self.proxy_app.check_tx(mtx.tx)
             except Exception as e:  # noqa: BLE001 - fail soft, keep txs
                 self._warn_app_failure("recheck", e)
-                still.extend(self._txs[i:])
+                still.extend(txs[i:])
                 break
+            rechecked += 1
             if res.code == abci.CODE_TYPE_OK:
                 still.append(mtx)
             else:
                 self.cache.remove(mtx.tx)
-        self._txs = still
-        self._txs_map = {_tx_key(m.tx): m for m in still}
+        lane.replace(still)
+        if rechecked:
+            self.metrics.recheck_times.inc(rechecked)
+        if skipped:
+            self.metrics.recheck_skipped.inc(skipped)
 
     # --- gossip support -----------------------------------------------------
 
-    def wait_for_tx_after(self, idx: int, timeout: float = 0.2) -> Optional[int]:
-        """Block until a tx exists at list position idx (the reactor's
-        CList-wait analogue). Returns idx if available."""
-        with self._cond:
-            if idx < len(self._txs):
-                return idx
-            self._cond.wait(timeout)
-            return idx if idx < len(self._txs) else None
+    def next_for_cursors(
+        self, cursors: List[int], timeout: float = 0.2,
+        fair_lane: Optional[int] = None,
+    ) -> Optional[Tuple[int, int, bytes]]:
+        """The reactor's per-peer wait: the next tx some lane holds past
+        that lane's cursor (admission seq), scanning high-priority lanes
+        first so a full low-priority lane can't starve high-priority
+        propagation. The reactor periodically passes a rotating
+        `fair_lane` — that lane is scanned FIRST that round, so under
+        sustained high-lane traffic every lane (including the middle
+        ones) still gets a bounded share of the peer's bandwidth.
+        Returns (lane, seq, tx) or None after `timeout`.
 
-    def tx_at(self, idx: int) -> Optional[bytes]:
-        with self._lock:
-            return self._txs[idx].tx if idx < len(self._txs) else None
+        Seq-based cursors survive compaction: a commit removing txs
+        below the cursor shifts list positions but never seqs, so a
+        surviving tx can't be skipped (the old index cursor could)."""
+        hit = self._scan_cursors(cursors, fair_lane)
+        if hit is not None:
+            return hit
+        with self._cond:
+            # re-scan under the lock: an admission (and its notify) that
+            # slipped in after the lock-free scan must not be slept past
+            hit = self._scan_cursors(cursors, fair_lane)
+            if hit is not None:
+                return hit
+            self._cond.wait(timeout)
+        return self._scan_cursors(cursors, fair_lane)
+
+    def _scan_cursors(
+        self, cursors: List[int], fair_lane: Optional[int] = None
+    ) -> Optional[Tuple[int, int, bytes]]:
+        order = list(range(self._nlanes - 1, -1, -1))
+        if fair_lane is not None:
+            fl = fair_lane % self._nlanes
+            order.remove(fl)
+            order.insert(0, fl)
+        for li in order:
+            mtx = self._lanes[li].next_after(cursors[li])
+            if mtx is not None:
+                return li, mtx.seq, mtx.tx
+        return None

@@ -1,9 +1,13 @@
 """Mempool reactor — tx gossip on channel 0x30 (reference mempool/reactor.go).
 
-One broadcastTxRoutine per peer walks the mempool's tx list from the
-front, sending each tx and blocking (with a timeout-poll) at the tail
-until new txs arrive; txs aren't sent to peers whose reported height
-shows they'd reject them (reactor.go:134-185).
+One broadcastTxRoutine per peer walks the mempool's lanes, sending each
+tx and blocking (with a timeout-poll) at the tail until new txs arrive
+(reactor.go:134-185). Cursors are per-lane ADMISSION SEQUENCES, not list
+indices: a commit compacting a lane shifts positions but never seqs, so
+a surviving tx can't be skipped while the peer's cursor points past it
+(the old `idx = min(idx, size())` snap-back could drop txs that shifted
+under the cursor). Lanes are scanned highest-priority first, so a full
+low-priority lane can't starve high-priority propagation.
 """
 
 from __future__ import annotations
@@ -11,7 +15,6 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict
 
 from ..p2p.base_reactor import ChannelDescriptor, Reactor
 from ..types import serde
@@ -20,6 +23,11 @@ LOG = logging.getLogger("mempool.reactor")
 
 MEMPOOL_CHANNEL = 0x30
 PEER_CATCHUP_SLEEP = 0.1
+# every Nth send per peer scans a ROTATING fair lane first, bounding
+# starvation of every lane (middle ones included) under sustained
+# higher-priority traffic: with L lanes, each lane is guaranteed at
+# least 1/(N*L) of the peer's gossip bandwidth
+FAIRNESS_INTERVAL = 16
 
 
 class MempoolReactor(Reactor):
@@ -51,30 +59,51 @@ class MempoolReactor(Reactor):
         t.start()
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
-        """reactor.go:119-132: CheckTx every gossiped tx."""
+        """reactor.go:119-132: CheckTx every gossiped tx. With batched
+        pre-verification on, gossiped txs funnel into the ingest queue
+        (sharing a signature batch with RPC submissions) instead of
+        paying a synchronous per-tx verify on the receive thread."""
         obj = serde.unpack(msg_bytes)
         if not (isinstance(obj, (list, tuple)) and obj and obj[0] == "tx"):
             raise ValueError("bad mempool message")
         tx = bytes(obj[1])
         try:
-            self.mempool.check_tx(tx)
+            fut = self.mempool.check_tx_nowait(tx)
+            if fut is None:
+                self.mempool.check_tx(tx)
+            else:
+                # fire-and-forget, but not silently: admission errors
+                # (dup, full pool/queue) surface at debug like the
+                # serial path's rejections do
+                fut.add_done_callback(self._log_gossip_result)
         except Exception as e:
             LOG.debug("gossiped tx rejected: %s", e)
 
+    @staticmethod
+    def _log_gossip_result(fut) -> None:
+        exc = fut.exception()
+        if exc is not None:
+            LOG.debug("gossiped tx rejected: %s", exc)
+
     def _broadcast_tx_routine(self, peer) -> None:
-        """reactor.go:134-185: walk the tx list; idx is our cursor into
-        the mempool's append-only running order."""
-        idx = 0
+        """reactor.go:134-185: walk the lanes; cursors[lane] is the last
+        admission seq sent to this peer from that lane."""
+        cursors = [0] * self.mempool.lane_count()
+        sends = 0
+        fair = 0
         while peer.is_running() and not self._stop.is_set():
-            if self.mempool.wait_for_tx_after(idx, timeout=0.2) is None:
-                # nothing at our cursor yet; if the list compacted under
-                # us (commit removed txs), snap the cursor back
-                idx = min(idx, self.mempool.size())
+            fair_lane = None
+            if sends % FAIRNESS_INTERVAL == FAIRNESS_INTERVAL - 1:
+                fair_lane = fair
+            hit = self.mempool.next_for_cursors(
+                cursors, timeout=0.2, fair_lane=fair_lane)
+            if hit is None:
                 continue
-            tx = self.mempool.tx_at(idx)
-            if tx is None:
-                continue
+            lane, seq, tx = hit
             if peer.send(MEMPOOL_CHANNEL, serde.pack(["tx", tx])):
-                idx += 1
+                cursors[lane] = seq
+                sends += 1
+                if fair_lane is not None:
+                    fair = (fair + 1) % self.mempool.lane_count()
             else:
                 time.sleep(PEER_CATCHUP_SLEEP)

@@ -166,7 +166,9 @@ class ABCIMetrics:
 @dataclass
 class MempoolMetrics:
     """mempool/metrics.go:12-25 (+ recheck_failures, ours: recheck/flush
-    app errors that previously vanished silently)"""
+    app errors that previously vanished silently; + the throughput-path
+    families: lane depths, CheckTx ingest batching, signature
+    pre-verification, and incremental-recheck skip accounting)"""
 
     size: object = NOP
     tx_size_bytes: object = NOP
@@ -176,6 +178,24 @@ class MempoolMetrics:
     # at the TRANSPORT level — a failing/app-down signal, distinct from
     # failed_txs (txs the app rejected by code)
     recheck_failures: object = NOP
+    # pending txs per priority lane, labeled (lane)
+    lane_depth: object = NOP
+    # txs drained per ingest round (the batched-preverify batch size)
+    checktx_batch_size: object = NOP
+    # submit -> drain wait inside the ingest queue
+    ingest_queue_wait: object = NOP
+    # serial-path envelope verifications served from the verified-sig
+    # cache (gossip duplicates, replays: a sha256 instead of a full
+    # Ed25519 verify). Batched-ingest hits are counted by the crypto
+    # layer: crypto_sig_cache_hits_total.
+    preverify_cache_hits: object = NOP
+    # enveloped txs rejected for a bad signature BEFORE the app's
+    # CheckTx ever ran (distinct from failed_txs: app verdicts)
+    preverify_rejected: object = NOP
+    # incremental recheck: pending txs that skipped the post-commit app
+    # round trip because the committed set couldn't have invalidated
+    # them (recheck_times counts the ones actually re-run)
+    recheck_skipped: object = NOP
 
 
 @dataclass
@@ -314,6 +334,30 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_mempool_recheck_failures_total",
             "Recheck/flush app calls that failed at the transport "
             "level (app down or erroring)."),
+        lane_depth=r.gauge(
+            f"{ns}_mempool_lane_depth",
+            "Pending transactions per priority lane.", ("lane",)),
+        checktx_batch_size=r.histogram(
+            f"{ns}_mempool_checktx_batch_size",
+            "Transactions drained per batched-CheckTx ingest round.",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+        ingest_queue_wait=r.histogram(
+            f"{ns}_mempool_ingest_queue_wait_seconds",
+            "Wait between tx submission and ingest-batch drain (s).",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)),
+        preverify_cache_hits=r.counter(
+            f"{ns}_mempool_preverify_cache_hits_total",
+            "Serial-path tx signature checks served from the verified-"
+            "signature cache (batched-ingest hits land in "
+            "crypto_sig_cache_hits_total)."),
+        preverify_rejected=r.counter(
+            f"{ns}_mempool_preverify_rejected_total",
+            "Transactions rejected for a bad signature before the "
+            "app's CheckTx ran."),
+        recheck_skipped=r.counter(
+            f"{ns}_mempool_recheck_skipped_total",
+            "Pending transactions that skipped the post-commit recheck "
+            "(incremental mode: sender untouched by the committed set)."),
     )
     state = StateMetrics(
         block_processing_time=r.histogram(

@@ -606,6 +606,7 @@ class Node:
                 "/debug/consensus": lambda q: self.watchdog.status(),
                 "/debug/statesync": lambda q: self._statesync_status(),
                 "/debug/abci": lambda q: self.proxy_app.status(),
+                "/debug/mempool": lambda q: self.mempool.status(),
             },
         )
         self._prof_server.start()
@@ -651,6 +652,10 @@ class Node:
 
             tracing.get_tracer().disable()
         self.sw.stop()
+        # drain the mempool ingest worker BEFORE the crypto dispatchers:
+        # its queued batches verify_async, and a drain after dispatcher
+        # shutdown would respawn a dispatcher thread post-stop
+        self.mempool.stop()
         # join the async verify dispatch threads AFTER the reactors are
         # down (queued batches drain first; futures always complete). A
         # concurrently running node respawns its dispatcher lazily.
@@ -660,7 +665,6 @@ class Node:
         self.trust_store.save()
         self.indexer_service.stop()
         self.event_bus.stop()
-        self.mempool.close_wal()
         self.proxy_app.stop()
         # remote signer (SocketPV) holds a conn + listener; hang up so
         # the signer process sees EOF and the laddr can be re-bound

@@ -432,7 +432,14 @@ def unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
 
 
 def num_unconfirmed_txs(env: RPCEnvironment, params: dict) -> dict:
-    return {"n_txs": str(env.mempool.size()), "txs": None}
+    """Pool pressure without reaping: count AND resident bytes, so load
+    tooling can watch saturation (reference ResultUnconfirmedTxs carries
+    total_bytes too)."""
+    return {
+        "n_txs": str(env.mempool.size()),
+        "total_bytes": str(env.mempool.tx_bytes()),
+        "txs": None,
+    }
 
 
 # --- tx routes (rpc/core/mempool.go, tx.go) ---------------------------
@@ -458,9 +465,14 @@ def _async_executor():
 
 
 def broadcast_tx_async(env: RPCEnvironment, params: dict) -> dict:
-    """CheckTx in the background; return immediately (mempool.go:26)."""
+    """CheckTx in the background; return immediately (mempool.go:26).
+    With batched pre-verification on, the tx goes straight into the
+    mempool's ingest queue (sharing a signature batch with concurrent
+    submissions) instead of through the worker pool."""
     tx = _tx_param(params)
-    _async_executor().submit(_checked_check_tx, env, tx)
+    if env.mempool.check_tx_nowait(tx) is None:
+        # batching off: today's small worker pool runs CheckTx inline
+        _async_executor().submit(_checked_check_tx, env, tx)
     return {"code": 0, "data": "", "log": "",
             "hash": enc.hexu(compute_tx_hash(tx))}
 

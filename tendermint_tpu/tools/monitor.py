@@ -129,8 +129,21 @@ class NodeStatus:
     # ("healthy" | "degraded" | "down") per proxy/resilient.py
     abci_conns: Dict[str, str] = field(default_factory=dict)
     abci_reconnects: int = 0
+    # mempool pressure view (from /debug/mempool): pool depth vs its
+    # cap, per-lane depths, and the batched-preverify ingest queue —
+    # a node drowning in tx load keeps answering /status while every
+    # new submission bounces
+    mempool_size: int = 0
+    mempool_max: int = 0
+    mempool_bytes: int = 0
+    mempool_lanes: List[dict] = field(default_factory=list)
+    ingest_queued: int = 0
+    ingest_capacity: int = 0
 
     RESTORE_STUCK_S = 30.0
+    # ingest queue occupancy past this fraction of capacity counts as
+    # backed up (saturated) even before the pool itself fills
+    INGEST_BACKUP_FRACTION = 0.8
     # phases during which "no progress" means wedged (idle/done/failed
     # are terminal — done hands off to fast sync, failed falls back)
     _RESTORE_ACTIVE = ("discover", "verify", "fetch", "apply", "finalize")
@@ -151,6 +164,16 @@ class NodeStatus:
         answer /status and even commit (mempool/query conns fail soft),
         but it is running on a degraded app link."""
         return any(s != "healthy" for s in self.abci_conns.values())
+
+    @property
+    def mempool_saturated(self) -> bool:
+        """Pool at capacity, or the ingest queue backed up past the
+        threshold — either way new txs are bouncing (or about to)."""
+        if self.mempool_max > 0 and self.mempool_size >= self.mempool_max:
+            return True
+        return (self.ingest_capacity > 0
+                and self.ingest_queued
+                >= self.INGEST_BACKUP_FRACTION * self.ingest_capacity)
 
     @property
     def restore_stuck(self) -> bool:
@@ -182,6 +205,12 @@ class NodeStatus:
         self._restore_progress_at = 0.0
         self.abci_conns = {}
         self.abci_reconnects = 0
+        self.mempool_size = 0
+        self.mempool_max = 0
+        self.mempool_bytes = 0
+        self.mempool_lanes = []
+        self.ingest_queued = 0
+        self.ingest_capacity = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -337,6 +366,24 @@ class Monitor:
         except Exception:  # noqa: BLE001 - older nodes lack the route
             ns.abci_conns = {}
             ns.abci_reconnects = 0
+        try:
+            with urllib.request.urlopen(
+                    f"http://{daddr}/debug/mempool", timeout=2.0) as r:
+                mp = json.load(r)
+            ns.mempool_size = int(mp.get("size", 0))
+            ns.mempool_max = int(mp.get("max_size", 0))
+            ns.mempool_bytes = int(mp.get("tx_bytes", 0))
+            ns.mempool_lanes = list(mp.get("lanes", []))
+            ingest = mp.get("ingest") or {}
+            ns.ingest_queued = int(ingest.get("queued", 0))
+            ns.ingest_capacity = int(ingest.get("capacity", 0))
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.mempool_size = 0
+            ns.mempool_max = 0
+            ns.mempool_bytes = 0
+            ns.mempool_lanes = []
+            ns.ingest_queued = 0
+            ns.ingest_capacity = 0
 
     def _on_block(self, addr: str, ev: dict) -> None:
         ns = self.nodes[addr]
@@ -374,6 +421,9 @@ class Monitor:
                 # a node on a degraded/down app connection is not "full"
                 # health even while it keeps answering (and committing)
                 and not any(n.abci_degraded for n in online)
+                # a full pool / backed-up ingest queue bounces new txs
+                # while the node looks perfectly alive to /status
+                and not any(n.mempool_saturated for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -424,6 +474,13 @@ class Monitor:
                     "abci_conns": dict(n.abci_conns),
                     "abci_degraded": n.abci_degraded,
                     "abci_reconnects": n.abci_reconnects,
+                    "mempool_size": n.mempool_size,
+                    "mempool_max": n.mempool_max,
+                    "mempool_bytes": n.mempool_bytes,
+                    "mempool_lanes": list(n.mempool_lanes),
+                    "ingest_queued": n.ingest_queued,
+                    "ingest_capacity": n.ingest_capacity,
+                    "mempool_saturated": n.mempool_saturated,
                 }
                 for n in self.nodes.values()
             ],
@@ -474,6 +531,11 @@ def main(argv=None) -> int:
                                  f" {n['restore_chunks']}")
                     if n["restore_stuck"]:
                         line += " [RESTORE STUCK]"
+                    if n["mempool_max"]:
+                        line += (f" pool={n['mempool_size']}"
+                                 f"/{n['mempool_max']}")
+                    if n["mempool_saturated"]:
+                        line += " [MEMPOOL SATURATED]"
                 print(line)
             for a in snap["stall_alerts"]:
                 print(f"  ALERT {a['addr']}: stall h={a.get('round_state', {}).get('height')} "

@@ -1,0 +1,762 @@
+"""High-throughput mempool: priority lanes, batched CheckTx
+pre-verification, incremental recheck, seq-based gossip cursors.
+
+Key contracts proven here:
+- lane-sharded reap is byte-identical to single-lane reap (property,
+  priority ties and byte/gas cutoffs included), and with all-default
+  priorities it reproduces the reference FIFO;
+- batched-preverify acceptance == serial-CheckTx acceptance for valid,
+  invalid-sig, duplicate, and unsigned txs;
+- a commit compacting the tx list mid-gossip can no longer make a
+  peer's cursor skip surviving txs;
+- incremental recheck touches only invalidated senders (plus unsigned
+  txs) and fails soft on transport errors.
+"""
+
+import os
+import random
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.mempool import (
+    CODE_BAD_SIGNATURE,
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    Mempool,
+    make_signed_tx,
+    parse_signed_tx,
+)
+from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+from tendermint_tpu.types import serde
+
+
+class StubApp:
+    """Mempool-conn stand-in: everything OK, gas derived from the tx so
+    gas cutoffs are exercisable without a real app."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.calls = []
+        self.delay_s = delay_s
+        self.fail_transport = False
+        self.reject = set()  # txs to refuse by app code
+        self._lock = threading.Lock()
+
+    def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
+        if self.fail_transport:
+            raise ConnectionError("app down")
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.calls.append(bytes(tx))
+        if bytes(tx) in self.reject:
+            return abci.ResponseCheckTx(code=9, log="app says no")
+        return abci.ResponseCheckTx(
+            code=abci.CODE_TYPE_OK, gas_wanted=(len(tx) * 13) % 5 + 1)
+
+    def flush(self):
+        pass
+
+
+def make_pool(lanes=1, app=None, **kw) -> Mempool:
+    return Mempool(cfg.MempoolConfig(lanes=lanes, **kw),
+                   app if app is not None else StubApp())
+
+
+KEYS = [PrivKeyEd25519.generate() for _ in range(4)]
+
+
+# --- signed envelope ---------------------------------------------------
+
+
+def test_envelope_roundtrip_and_tamper():
+    tx = make_signed_tx(KEYS[0], b"hello=world", priority=7)
+    p = parse_signed_tx(tx)
+    assert p is not None
+    assert p.priority == 7
+    assert p.payload == b"hello=world"
+    assert p.pubkey == KEYS[0].pub_key().bytes()
+    assert p.verify()
+    # any tampering — priority byte, payload, or sig — invalidates
+    for i in (5, len(tx) - 1, 40):
+        bad = tx[:i] + bytes([tx[i] ^ 1]) + tx[i + 1:]
+        pb = parse_signed_tx(bad)
+        assert pb is not None and not pb.verify()
+    # plain txs are not envelopes
+    assert parse_signed_tx(b"k=v") is None
+    assert parse_signed_tx(b"") is None
+
+
+# --- lane-sharded reap ≡ single-lane reap (property) ------------------
+
+
+def _random_txs(rng, n):
+    txs = []
+    for i in range(n):
+        payload = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 40)))
+        if rng.random() < 0.7:
+            txs.append(make_signed_tx(
+                KEYS[i % len(KEYS)], payload + b"|%d" % i,
+                priority=rng.randrange(8)))
+        else:
+            txs.append(b"plain|%d|" % i + payload)
+    return txs
+
+
+def test_lane_reap_matches_single_lane_property():
+    rng = random.Random(0xBEEF)
+    for round_i in range(3):
+        txs = _random_txs(rng, 60)
+        pools = [make_pool(lanes=1), make_pool(lanes=4), make_pool(lanes=8)]
+        for mp in pools:
+            for tx in txs:
+                assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        cutoffs = [(-1, -1), (0, -1), (-1, 0), (200, -1), (-1, 37),
+                   (500, 60), (37, 11)]
+        for _ in range(5):
+            cutoffs.append((rng.randrange(1, 1200), rng.randrange(1, 150)))
+        for max_bytes, max_gas in cutoffs:
+            want = pools[0].reap_max_bytes_max_gas(max_bytes, max_gas)
+            for mp in pools[1:]:
+                got = mp.reap_max_bytes_max_gas(max_bytes, max_gas)
+                assert got == want, (
+                    f"lane reap diverged at cutoff ({max_bytes},{max_gas}) "
+                    f"round {round_i}")
+        assert pools[0].txs_snapshot() == pools[1].txs_snapshot()
+        for n in (-1, 0, 5, 1000):
+            assert pools[0].reap_max_txs(n) == pools[1].reap_max_txs(n)
+
+
+def test_default_priority_reap_is_fifo():
+    """All-equal priorities (every existing config): reap order is
+    admission order — the reference's exact semantics."""
+    mp = make_pool(lanes=4)
+    txs = [b"tx-%04d" % i for i in range(10)]
+    for tx in txs:
+        mp.check_tx(tx)
+    assert mp.reap_max_bytes_max_gas(-1, -1) == txs
+    assert mp.txs_snapshot() == txs
+
+
+def test_priority_orders_reap_and_update_removes_across_lanes():
+    mp = make_pool(lanes=4)
+    lo = make_signed_tx(KEYS[0], b"lo", priority=0)
+    hi = make_signed_tx(KEYS[1], b"hi", priority=200)
+    mid = make_signed_tx(KEYS[2], b"mid", priority=2)
+    for tx in (lo, hi, mid):
+        mp.check_tx(tx)
+    assert mp.reap_max_bytes_max_gas(-1, -1) == [hi, mid, lo]
+    mp.lock()
+    try:
+        mp.update(1, [hi, lo])
+    finally:
+        mp.unlock()
+    assert mp.txs_snapshot() == [mid]
+    with pytest.raises(ErrTxInCache):
+        mp.check_tx(hi)  # committed txs can't re-enter
+
+
+# --- batched preverify ≡ serial CheckTx -------------------------------
+
+
+def _equivalence_submissions():
+    valid = make_signed_tx(KEYS[0], b"good=1", priority=1)
+    tampered = bytearray(make_signed_tx(KEYS[1], b"evil=1", priority=1))
+    tampered[-1] ^= 1  # payload flip: signature no longer matches
+    return [valid, bytes(tampered), b"plain=1", valid, b"plain=1"]
+
+
+def _submit_all(mp, txs):
+    """(kind, code) per submission — exceptions become kinds."""
+    out = []
+    for tx in txs:
+        try:
+            out.append(("res", mp.check_tx(tx).code))
+        except ErrTxInCache:
+            out.append(("in_cache", None))
+        except ErrMempoolIsFull:
+            out.append(("full", None))
+    return out
+
+
+def test_batched_preverify_equals_serial_acceptance():
+    txs = _equivalence_submissions()
+    serial = make_pool()
+    batched = make_pool(preverify_batch=True, preverify_batch_max=64)
+    try:
+        got_serial = _submit_all(serial, txs)
+        got_batched = _submit_all(batched, txs)
+        assert got_serial == got_batched
+        assert got_serial[0] == ("res", abci.CODE_TYPE_OK)
+        assert got_serial[1] == ("res", CODE_BAD_SIGNATURE)
+        assert got_serial[2] == ("res", abci.CODE_TYPE_OK)
+        assert got_serial[3] == ("in_cache", None)  # duplicate signed
+        assert got_serial[4] == ("in_cache", None)  # duplicate plain
+        assert serial.txs_snapshot() == batched.txs_snapshot()
+        # a sig-rejected tx never entered the cache: it can be retried
+        # (and rejected again) rather than bouncing off the dedupe
+        assert _submit_all(serial, [txs[1]]) == [("res", CODE_BAD_SIGNATURE)]
+        assert _submit_all(batched, [txs[1]]) == [("res", CODE_BAD_SIGNATURE)]
+        # the app never saw the bad-signature tx on either path
+        for mp in (serial, batched):
+            assert bytes(txs[1]) not in mp.proxy_app.calls
+    finally:
+        batched.stop()
+
+
+def test_serial_duplicate_rides_sig_cache(monkeypatch):
+    """Replayed/gossip-duplicated signed txs on the SERIAL path must
+    cost a cache lookup, not another full Ed25519 verify — both
+    verdicts (valid and bad-sig) are cached."""
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto.sigcache import SigCache
+    from tendermint_tpu.mempool import preverify as pv
+
+    verifies = []
+    orig = pv.SignedTx.verify
+    monkeypatch.setattr(
+        pv.SignedTx, "verify",
+        lambda self: (verifies.append(1), orig(self))[1])
+    crypto_batch.set_sig_cache(SigCache(64))
+    try:
+        mp = make_pool()
+        tx = make_signed_tx(KEYS[0], b"dup-cache")
+        assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+        with pytest.raises(ErrTxInCache):
+            mp.check_tx(tx)
+        assert len(verifies) == 1, "duplicate must not re-verify"
+        bad = bytearray(make_signed_tx(KEYS[1], b"bad-cache"))
+        bad[-1] ^= 1
+        assert mp.check_tx(bytes(bad)).code == CODE_BAD_SIGNATURE
+        assert mp.check_tx(bytes(bad)).code == CODE_BAD_SIGNATURE
+        assert len(verifies) == 2, "bad-sig replay must not re-verify"
+    finally:
+        crypto_batch.set_sig_cache(None)
+
+
+def test_batched_preverify_batches_concurrent_submitters():
+    """Concurrent submitters share verify batches; everything lands."""
+    app = StubApp()
+    mp = make_pool(lanes=2, app=app, preverify_batch=True,
+                   preverify_batch_max=32)
+    txs = [make_signed_tx(KEYS[i % 4], b"conc-%03d" % i, priority=i % 2)
+           for i in range(24)]
+    try:
+        futs = [mp.check_tx_nowait(tx) for tx in txs]
+        codes = [f.result(timeout=30).code for f in futs]
+        assert codes == [abci.CODE_TYPE_OK] * len(txs)
+        assert mp.size() == len(txs)
+        assert sorted(mp.txs_snapshot()) == sorted(txs)
+    finally:
+        mp.stop()
+
+
+def test_ingest_queue_full_and_stop_drains():
+    gate = threading.Event()
+
+    class SlowApp(StubApp):
+        def check_tx(self, tx):
+            gate.wait(10)
+            return super().check_tx(tx)
+
+    mp = make_pool(app=SlowApp(), preverify_batch=True,
+                   preverify_batch_max=1, ingest_queue_size=3)
+    try:
+        first = mp.check_tx_nowait(b"first")
+        deadline = time.time() + 5
+        while mp.ingest_queue_depth() > 0 and time.time() < deadline:
+            time.sleep(0.005)  # worker picked up `first`, queue empty
+        queued = [mp.check_tx_nowait(b"q-%d" % i) for i in range(3)]
+        overflow = mp.check_tx_nowait(b"overflow")
+        with pytest.raises(ErrMempoolIsFull, match="ingest queue"):
+            overflow.result(timeout=1)
+        gate.set()
+        # stop() drains what was queued: every future resolves
+        mp.stop()
+        assert first.result(timeout=1).code == abci.CODE_TYPE_OK
+        for f in queued:
+            assert f.result(timeout=1).code == abci.CODE_TYPE_OK
+        # post-shutdown submissions fail fast instead of hanging
+        with pytest.raises(ErrMempoolIsFull, match="shut down"):
+            mp.check_tx_nowait(b"late").result(timeout=1)
+    finally:
+        gate.set()
+        mp.stop()
+
+
+# --- gossip cursors ----------------------------------------------------
+
+
+class FakePeer:
+    def __init__(self, quota=None):
+        self.id = "ff" * 20
+        self.sent = []
+        self.quota = quota  # None = unlimited
+        self._lock = threading.Lock()
+
+    def is_running(self):
+        return True
+
+    def send(self, ch_id, msg_bytes):
+        assert ch_id == MEMPOOL_CHANNEL
+        with self._lock:
+            if self.quota is not None and self.quota <= 0:
+                return False
+            if self.quota is not None:
+                self.quota -= 1
+            self.sent.append(bytes(serde.unpack(msg_bytes)[1]))
+            return True
+
+    def resume(self):
+        with self._lock:
+            self.quota = None
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def test_gossip_cursor_survives_mid_commit_compaction():
+    """Regression for the index-cursor snap-back: commit 6 of 10 txs
+    after the peer got 4 — every SURVIVING tx must still arrive."""
+    mp = make_pool()
+    txs = [b"tx-%04d" % i for i in range(10)]
+    for tx in txs:
+        mp.check_tx(tx)
+    peer = FakePeer(quota=4)
+    reactor = MempoolReactor(cfg.MempoolConfig(), mp)
+    reactor.add_peer(peer)
+    try:
+        assert _wait(lambda: len(peer.sent) == 4)
+        assert peer.sent == txs[:4]
+        mp.lock()
+        try:
+            mp.update(1, txs[:6])  # compacts the list below the cursor
+        finally:
+            mp.unlock()
+        peer.resume()
+        assert _wait(lambda: set(txs[6:]) <= set(peer.sent)), (
+            f"survivors skipped after compaction; got {peer.sent}")
+    finally:
+        reactor.stop()
+
+
+def test_gossip_scans_high_priority_lane_first():
+    mp = make_pool(lanes=4)
+    lows = [make_signed_tx(KEYS[0], b"low-%d" % i, priority=0)
+            for i in range(5)]
+    for tx in lows:
+        mp.check_tx(tx)
+    hi = make_signed_tx(KEYS[1], b"hi", priority=3)
+    mp.check_tx(hi)
+    peer = FakePeer()
+    reactor = MempoolReactor(cfg.MempoolConfig(), mp)
+    reactor.add_peer(peer)
+    try:
+        assert _wait(lambda: len(peer.sent) == 6)
+        assert peer.sent[0] == hi, "high-priority lane must gossip first"
+        assert peer.sent[1:] == lows
+    finally:
+        reactor.stop()
+
+
+def test_gossip_fairness_bounds_every_lane_starvation():
+    """Sustained high-priority traffic must not starve ANY lower lane —
+    middle lanes included: every FAIRNESS_INTERVAL-th send scans a
+    rotating fair lane first, so each of L lanes is guaranteed at
+    least 1/(FAIRNESS_INTERVAL*L) of the peer's bandwidth."""
+    from tendermint_tpu.mempool.reactor import FAIRNESS_INTERVAL
+
+    mp = make_pool(lanes=3)
+    lo = make_signed_tx(KEYS[0], b"lo-starved", priority=0)
+    mid = make_signed_tx(KEYS[2], b"mid-starved", priority=1)
+    mp.check_tx(lo)
+    mp.check_tx(mid)
+    his = [make_signed_tx(KEYS[1], b"hi-%02d" % i, priority=2)
+           for i in range(4 * FAIRNESS_INTERVAL)]
+    for tx in his:
+        mp.check_tx(tx)
+    peer = FakePeer()
+    reactor = MempoolReactor(cfg.MempoolConfig(), mp)
+    reactor.add_peer(peer)
+    try:
+        assert _wait(lambda: len(peer.sent) == len(his) + 2)
+        bound = 3 * FAIRNESS_INTERVAL  # one full fair-lane rotation
+        assert peer.sent.index(lo) <= bound, (
+            f"low lane starved: lo at {peer.sent.index(lo)}")
+        assert peer.sent.index(mid) <= bound, (
+            f"middle lane starved: mid at {peer.sent.index(mid)}")
+    finally:
+        reactor.stop()
+
+
+def test_recheck_mode_typo_is_refused():
+    with pytest.raises(ValueError, match="recheck_mode"):
+        make_pool(recheck_mode="Incremental")
+
+
+def test_envelopes_off_treats_magic_as_opaque_bytes():
+    """[mempool] envelopes=false: the escape hatch for apps whose tx
+    bytes could collide with the magic — everything goes straight to
+    the app, un-sig-checked, priority 0, full recheck semantics."""
+    app = StubApp()
+    serial = make_pool(app=app, envelopes=False)
+    bad = bytearray(make_signed_tx(KEYS[0], b"collide"))
+    bad[-1] ^= 1  # invalid as an envelope — but envelopes are off
+    assert serial.check_tx(bytes(bad)).code == abci.CODE_TYPE_OK
+    assert bytes(bad) in app.calls, "app must see the raw tx"
+    assert serial.txs_snapshot() == [bytes(bad)]
+    # batched path honors the knob identically
+    batched = make_pool(app=StubApp(), envelopes=False,
+                        preverify_batch=True)
+    try:
+        assert batched.check_tx(bytes(bad)).code == abci.CODE_TYPE_OK
+        assert batched.txs_snapshot() == [bytes(bad)]
+    finally:
+        batched.stop()
+
+
+def test_gossip_receive_funnels_into_ingest_queue():
+    mp = make_pool(preverify_batch=True)
+    reactor = MempoolReactor(cfg.MempoolConfig(), mp)
+    try:
+        reactor.receive(MEMPOOL_CHANNEL, FakePeer(),
+                        serde.pack(["tx", b"gossip=1"]))
+        assert _wait(lambda: mp.size() == 1)
+        # bad-signature gossip is dropped without reaching the app
+        bad = bytearray(make_signed_tx(KEYS[0], b"x"))
+        bad[-1] ^= 1
+        reactor.receive(MEMPOOL_CHANNEL, FakePeer(),
+                        serde.pack(["tx", bytes(bad)]))
+        time.sleep(0.1)
+        assert mp.size() == 1
+        assert bytes(bad) not in mp.proxy_app.calls
+    finally:
+        mp.stop()
+        reactor.stop()
+
+
+# --- incremental recheck ----------------------------------------------
+
+
+def test_incremental_recheck_touched_senders_only():
+    app = StubApp()
+    mp = make_pool(app=app, recheck_mode="incremental")
+    a1 = make_signed_tx(KEYS[0], b"a1")
+    a2 = make_signed_tx(KEYS[0], b"a2")
+    b1 = make_signed_tx(KEYS[1], b"b1")
+    u1 = b"unsigned=1"
+    for tx in (a1, a2, b1, u1):
+        assert mp.check_tx(tx).code == abci.CODE_TYPE_OK
+    committed = make_signed_tx(KEYS[0], b"committed")  # sender A touched
+    app.calls.clear()
+    mp.lock()
+    try:
+        mp.update(1, [committed])
+    finally:
+        mp.unlock()
+    # sender-A txs and the unsigned tx recheck; sender B skips
+    assert sorted(app.calls) == sorted([a1, a2, u1])
+    assert mp.size() == 4
+
+    # app-flagged hook: operator marks b1 as invalidated
+    mp.recheck_filter = lambda tx: tx == b1
+    app.calls.clear()
+    mp.lock()
+    try:
+        mp.update(2, [b"other-plain-commit"])
+    finally:
+        mp.unlock()
+    # plain committed tx touches no sender: only unsigned + flagged run
+    assert sorted(app.calls) == sorted([b1, u1])
+
+
+def test_incremental_recheck_removes_now_invalid_txs():
+    app = StubApp()
+    mp = make_pool(app=app, recheck_mode="incremental")
+    a1 = make_signed_tx(KEYS[0], b"spend-1")
+    b1 = make_signed_tx(KEYS[1], b"keep-1")
+    for tx in (a1, b1):
+        mp.check_tx(tx)
+    app.reject.add(a1)  # new state: sender A's pending tx is now invalid
+    mp.lock()
+    try:
+        mp.update(1, [make_signed_tx(KEYS[0], b"conflict")])
+    finally:
+        mp.unlock()
+    assert mp.txs_snapshot() == [b1]
+    # evicted from the dedupe cache: a fixed-up resubmission works
+    app.reject.discard(a1)
+    assert mp.check_tx(a1).code == abci.CODE_TYPE_OK
+
+
+def test_full_recheck_default_rechecks_everything():
+    app = StubApp()
+    mp = make_pool(app=app)  # recheck_mode="full" default
+    txs = [make_signed_tx(KEYS[0], b"f-%d" % i) for i in range(3)]
+    txs.append(b"plain-f")
+    for tx in txs:
+        mp.check_tx(tx)
+    app.calls.clear()
+    mp.lock()
+    try:
+        mp.update(1, [b"unrelated"])
+    finally:
+        mp.unlock()
+    assert sorted(app.calls) == sorted(txs)
+
+
+def test_recheck_transport_failure_keeps_txs():
+    app = StubApp()
+    mp = make_pool(app=app, recheck_mode="incremental")
+    txs = [b"keep-%d" % i for i in range(4)]
+    for tx in txs:
+        mp.check_tx(tx)
+    app.fail_transport = True
+    mp.lock()
+    try:
+        mp.update(1, [])
+    finally:
+        mp.unlock()
+    assert mp.txs_snapshot() == txs, "txs must survive an app outage"
+    app.fail_transport = False
+
+
+# --- concurrency -------------------------------------------------------
+
+
+@pytest.mark.parametrize("batched", [False, True])
+def test_checktx_hammer_during_update(batched):
+    app = StubApp(delay_s=0.0002)
+    mp = make_pool(lanes=4, app=app, size=100000,
+                   preverify_batch=batched, ingest_queue_size=100000)
+    n_threads, per_thread = 6, 30
+    errors = []
+    admitted = [[] for _ in range(n_threads)]
+
+    def submitter(ti):
+        try:
+            for i in range(per_thread):
+                tx = b"t%d-%04d" % (ti, i)
+                if mp.check_tx(tx).code == abci.CODE_TYPE_OK:
+                    admitted[ti].append(tx)
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=submitter, args=(ti,))
+               for ti in range(n_threads)]
+    for t in threads:
+        t.start()
+    committed = set()
+    try:
+        for round_i in range(10):
+            time.sleep(0.005)
+            mp.lock()
+            try:
+                snap = mp.txs_snapshot()
+                victims = snap[: len(snap) // 3]
+                committed.update(victims)
+                mp.update(round_i + 1, victims)
+            finally:
+                mp.unlock()
+    finally:
+        for t in threads:
+            t.join(30)
+    assert not errors, errors
+    assert not any(t.is_alive() for t in threads)
+    final = mp.txs_snapshot()
+    all_admitted = {tx for lane in admitted for tx in lane}
+    assert len(all_admitted) == n_threads * per_thread
+    assert set(final) <= all_admitted
+    assert not (set(final) & committed), "committed txs must not survive"
+    assert len(final) == len(set(final)), "no duplicates"
+    assert mp.size() == len(final)
+    mp.stop()
+
+
+# --- pool-pressure surfaces -------------------------------------------
+
+
+def test_status_and_lane_depth_surfaces():
+    mp = make_pool(lanes=2, preverify_batch=True)
+    try:
+        for i in range(3):
+            assert mp.check_tx(
+                make_signed_tx(KEYS[0], b"s-%d" % i, priority=i % 2)
+            ).code == abci.CODE_TYPE_OK
+        st = mp.status()
+        assert st["size"] == 3
+        assert st["max_size"] == mp.config.size
+        assert st["tx_bytes"] == mp.tx_bytes() > 0
+        assert [l["lane"] for l in st["lanes"]] == [0, 1]
+        assert sum(l["depth"] for l in st["lanes"]) == 3
+        assert st["preverify_batch"] is True
+        assert st["ingest"]["capacity"] > 0
+    finally:
+        mp.stop()
+
+
+def test_num_unconfirmed_txs_reports_total_bytes():
+    from types import SimpleNamespace
+
+    from tendermint_tpu.rpc import core as rpc_core
+
+    mp = make_pool()
+    mp.check_tx(b"abc=def")
+    mp.check_tx(b"gh=i")
+    env = SimpleNamespace(mempool=mp)
+    out = rpc_core.num_unconfirmed_txs(env, {})
+    assert out["n_txs"] == "2"
+    assert out["total_bytes"] == str(len(b"abc=def") + len(b"gh=i"))
+
+
+def test_live_metrics_record_lanes_and_recheck_split():
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("tendermint")
+    app = StubApp()
+    mp = Mempool(
+        cfg.MempoolConfig(lanes=2, recheck_mode="incremental",
+                          preverify_batch=True),
+        app, metrics=m.mempool)
+    try:
+        for i in range(4):
+            mp.check_tx(make_signed_tx(KEYS[0], b"m-%d" % i, priority=i % 2))
+        mp.check_tx(b"plain-m")
+        bad = bytearray(make_signed_tx(KEYS[1], b"bad"))
+        bad[-1] ^= 1
+        assert mp.check_tx(bytes(bad)).code == CODE_BAD_SIGNATURE
+        mp.lock()
+        try:
+            mp.update(1, [make_signed_tx(KEYS[1], b"commit")])
+        finally:
+            mp.unlock()
+        body = m.registry.render()
+        assert 'tendermint_mempool_lane_depth{lane="0"}' in body
+        assert 'tendermint_mempool_lane_depth{lane="1"}' in body
+        assert "tendermint_mempool_preverify_rejected_total 1" in body
+        # incremental: plain tx rechecked, untouched-sender txs skipped
+        assert "tendermint_mempool_recheck_skipped_total 4" in body
+        assert "tendermint_mempool_checktx_batch_size_count" in body
+        assert "tendermint_mempool_ingest_queue_wait_seconds_count" in body
+    finally:
+        mp.stop()
+
+
+@pytest.mark.slow
+def test_bench_load_emits_standard_schema():
+    """`bench.py load` e2e (slow-marked: in-process localnet commits
+    real blocks for LOAD_SECS): one standard-schema BENCH line with
+    target TPS in, accepted TPS + p50/p99 commit latency out."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TM_TPU_BENCH_LOAD_TPS="50", TM_TPU_BENCH_LOAD_SECS="2")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "load"], cwd=root, env=env,
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "mempool_load_50tps_2s_p99_commit_ms"
+    for k in ("value", "unit", "vs_baseline", "target_tps",
+              "accepted_tps", "p50_ms", "p99_ms"):
+        assert k in rec, f"missing BENCH field {k}"
+    assert rec["unit"] == "ms"
+    assert rec["accepted_tps"] > 0
+    assert rec["p99_ms"] >= rec["p50_ms"] > 0
+
+
+@pytest.mark.slow
+def test_bench_preverify_beats_serial():
+    """`bench.py preverify` e2e (slow-marked: three serial per-tx
+    Ed25519 sweeps): batched ingest with a warm sig cache must beat
+    the serial per-tx verify path on cpu (speedup > 1)."""
+    import json
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TM_TPU_BENCH_PREVERIFY_N="500")
+    out = subprocess.run(
+        [sys.executable, "bench.py", "preverify"], cwd=root, env=env,
+        capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "mempool_preverify_500tx_wall_ms"
+    assert rec["unit"] == "ms" and rec["value"] > 0
+    assert rec["vs_baseline"] > 1, (
+        f"batched preverify must beat serial: {rec}")
+
+
+def _stub_debug_server(payload: dict):
+    """Tiny HTTP server answering every /debug route with `payload`."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host, port = srv.server_address[:2]
+    return srv, f"{host}:{port}"
+
+
+def test_monitor_flags_saturated_mempool():
+    from tendermint_tpu.tools.monitor import HEALTH_MODERATE, Monitor
+
+    payload = {
+        "dwell_s": 0.1, "threshold_s": 30.0, "stalls_total": 0,
+        "stalls": [], "live": {"peers": []},
+        # the same stub answers every /debug route; mempool keys below
+        "size": 5000, "max_size": 5000, "tx_bytes": 123456,
+        "lanes": [{"lane": 0, "depth": 5000, "bytes": 123456}],
+        "ingest": {"queued": 0, "capacity": 10000},
+    }
+    srv, daddr = _stub_debug_server(payload)
+    try:
+        mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc-addr"]
+        ns.mark_online()
+        mon._poll_debug(ns, daddr)
+        assert ns.mempool_size == 5000
+        assert ns.mempool_saturated
+        assert mon.health() == HEALTH_MODERATE
+        snap = mon.snapshot()
+        assert snap["nodes"][0]["mempool_saturated"] is True
+        assert snap["nodes"][0]["mempool_size"] == 5000
+
+        # ingest backlog alone (pool not full) also degrades health
+        ns.mempool_size, ns.mempool_max = 10, 5000
+        ns.ingest_queued, ns.ingest_capacity = 9000, 10000
+        assert ns.mempool_saturated
+        assert mon.health() == HEALTH_MODERATE
+        ns.ingest_queued = 10
+        assert not ns.mempool_saturated
+    finally:
+        srv.shutdown()
+        srv.server_close()
