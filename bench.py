@@ -49,11 +49,12 @@ STATESYNC_MODE = "statesync" in sys.argv[1:]  # restore vs replay (PR 4)
 CHAOS_MODE = "chaos" in sys.argv[1:]  # ABCI reconnect recovery (PR 5)
 LOAD_MODE = "load" in sys.argv[1:]  # sustained-TPS mempool localnet (PR 6)
 PREVERIFY_MODE = "preverify" in sys.argv[1:]  # batched vs serial CheckTx
+AGGVERIFY_MODE = "aggverify" in sys.argv[1:]  # BLS aggregate cert (PR 7)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
-                      "--pipeline")]
+                      "aggverify", "--pipeline")]
 try:
     METRIC_N = int(_args[0]) if _args else 10000
 except ValueError:
@@ -90,6 +91,8 @@ LOAD_SECS = _env_int("TM_TPU_BENCH_LOAD_SECS", 5)
 LOAD_METRIC = f"mempool_load_{LOAD_TPS}tps_{LOAD_SECS}s_p99_commit_ms"
 PREVERIFY_N = _env_int("TM_TPU_BENCH_PREVERIFY_N", 2000)
 PREVERIFY_METRIC = f"mempool_preverify_{PREVERIFY_N}tx_wall_ms"
+AGG_NVAL = _env_int("TM_TPU_BENCH_AGG_NVAL", 10000)
+AGG_METRIC = f"aggverify_{AGG_NVAL}val_commit_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -890,6 +893,106 @@ def load_main():
     return 0
 
 
+def aggverify_main():
+    """`bench.py aggverify` — the aggregate-signature fast lane: ONE
+    BLS commit certificate (signer bitmap + 96-byte aggregate) verified
+    with one pubkey aggregation + one 2-pairing product check, against
+    the Ed25519 batch path (verify_commit over N per-vote signatures)
+    at the same committee size. cpu backend forced (pure host path —
+    this mode must not pay, or hang on, a jax init); the BLS pubkey
+    MSM runs the registry default (python unless TM_TPU_BLS_MSM=jax).
+
+    Fixture note: the BLS committee uses consecutive secret scalars so
+    the 10k pubkeys come from incremental generator additions, and the
+    aggregate signature is [sum sk_i] H(m) — mathematically identical
+    to aggregating per-validator signatures, without 10k G2 scalar
+    multiplications of fixture setup."""
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.crypto import bls
+    from tendermint_tpu.crypto.bls import curve as bc
+    from tendermint_tpu.crypto.bls.fields import R_ORDER
+    from tendermint_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from tendermint_tpu.libs.bit_array import BitArray
+    from tendermint_tpu.types import BlockID
+    from tendermint_tpu.types.basic import PartSetHeader
+    from tendermint_tpu.types.block import AggregateCommit
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+    crypto_batch.set_default_backend("cpu")
+    crypto_batch.set_sig_cache(None)  # the certificate never hits the
+    # sig cache anyway; the ed25519 baseline must not either
+    chain = "bench-aggverify"
+    nval = AGG_NVAL
+    bid = BlockID(b"\x07" * 20, PartSetHeader(1, b"\x0c" * 20))
+
+    # --- BLS committee: pk_i = [s0 + i] G1, built incrementally -------
+    s0 = 7_777_777
+    pt = bc.g1_mul(bc.G1_GEN, s0)
+    jac_points = []
+    for _ in range(nval):
+        jac_points.append(pt)
+        pt = bc.g1_add(pt, bc.G1_GEN)
+    # batch-normalize via one shared inversion chain (affine pubkeys)
+    from tendermint_tpu.crypto.bls.fields import P as _P, fp_inv
+
+    zs = [p[2] for p in jac_points]
+    prefix, acc = [], 1
+    for z in zs:
+        prefix.append(acc)
+        acc = acc * z % _P
+    inv = fp_inv(acc)
+    pubs = [None] * nval
+    for i in range(nval - 1, -1, -1):
+        zi = inv * prefix[i] % _P
+        inv = inv * zs[i] % _P
+        zi2 = zi * zi % _P
+        X, Y, _ = jac_points[i]
+        pubs[i] = bls.PubKeyBLS12381(
+            bc.g1_compress((X * zi2 % _P, Y * zi2 * zi % _P, 1)))
+    vals_bls = ValidatorSet([Validator.new(pk, 10) for pk in pubs])
+
+    signers = BitArray(nval)
+    for i in range(nval):
+        signers.set_index(i, True)
+    cert = AggregateCommit(block_id=bid, agg_height=1, agg_round=0,
+                           signers=signers, agg_sig=b"\x00" * 96)
+    sum_sk = sum(s0 + i for i in range(nval)) % R_ORDER
+    hm = hash_to_g2(cert.sign_bytes(chain), bls.DST_SIG)
+    cert.agg_sig = bc.g2_compress(bc.g2_mul(hm, sum_sk))
+
+    def bls_run():
+        vals_bls.verify_commit(chain, bid, 1, cert)
+
+    # --- Ed25519 baseline: the existing batch path, same size ---------
+    vs_ed, sorted_sks = _build_valset(nval, b"agg-ed")
+    commit_ed = _build_commit(chain, vs_ed, sorted_sks, 1, bid)
+
+    def ed_run():
+        vs_ed.verify_commit(chain, bid, 1, commit_ed)
+
+    bls_run()  # warm (point parse caches)
+    bls_ms = _best_of(bls_run, 3)
+    ed_ms = _best_of(ed_run, 2)
+
+    cert_bytes = cert.size_bytes()
+    print(json.dumps({
+        "metric": AGG_METRIC,
+        "value": round(bls_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(ed_ms / bls_ms, 2),
+        "ed25519_batch_ms": round(ed_ms, 3),
+        "cert_bytes": cert_bytes,
+        "signature_bytes_ed25519": 64 * nval,
+        "msm_backend": __import__(
+            "tendermint_tpu.crypto.bls.msm", fromlist=["msm"]
+        ).default_msm_backend(),
+        "note": ("one fast_aggregate_verify (bitmap MSM + 2-pairing "
+                 "check) vs verify_commit over %d per-vote Ed25519 "
+                 "signatures; cpu backend forced" % nval),
+    }))
+    return 0
+
+
 def chaos_main():
     """`bench.py chaos` — ABCI reconnect recovery latency: a real
     kvstore socket app, a ResilientClient(retry) supervising the
@@ -980,6 +1083,9 @@ def main():
         return load_main()
     if PREVERIFY_MODE:
         return preverify_main()
+    if AGGVERIFY_MODE:
+        # pure host path like commit4/preverify: no TPU probe
+        return aggverify_main()
     degraded = None
     if os.environ.get("TM_TPU_BENCH_FORCE_CPU"):
         degraded = "cpu8-forced"  # BASELINE config 2: by-design CPU mode
@@ -1157,6 +1263,8 @@ if __name__ == "__main__":
             metric = CACHE_METRIC
         elif COMMIT4_MODE:
             metric = COMMIT4_METRIC
+        elif AGGVERIFY_MODE:
+            metric = AGG_METRIC
         else:
             mode = "_rlc" if RLC_MODE else ""
             metric = f"verify_commit_{METRIC_N}_sigs{mode}_wall_ms"

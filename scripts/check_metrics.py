@@ -239,6 +239,12 @@ REQUIRED_FAMILIES = (
     "mempool_preverify_cache_hits_total",
     "mempool_preverify_rejected_total",
     "mempool_recheck_skipped_total",
+    # PR-7 BLS aggregate fast lane (declaration presence: Ed25519 chains
+    # legitimately never record aggregate samples)
+    "crypto_agg_verify_seconds",
+    "crypto_agg_signers",
+    "consensus_agg_gossip_merges_total",
+    "agg_commit_size_bytes",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

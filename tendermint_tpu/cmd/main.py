@@ -35,11 +35,13 @@ def cmd_init(args) -> int:
     from tendermint_tpu import config as cfg
     from tendermint_tpu.p2p import NodeKey
     from tendermint_tpu.privval import load_or_gen_file_pv
-    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types import GenesisDoc
+    from tendermint_tpu.types.genesis import genesis_validator_for
 
     c = _load_config(args.home)
     cfg.ensure_root(c.root_dir)
-    pv = load_or_gen_file_pv(c.base.priv_validator_path())
+    pv = load_or_gen_file_pv(c.base.priv_validator_path(),
+                             key_type=c.crypto.key_type)
     NodeKey.load_or_gen(c.base.node_key_path())
     gen_path = c.base.genesis_path()
     if os.path.exists(gen_path):
@@ -48,7 +50,7 @@ def cmd_init(args) -> int:
         doc = GenesisDoc(
             chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
             genesis_time=time.time_ns(),
-            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+            validators=[genesis_validator_for(pv.priv_key, 10)],
         )
         doc.save(gen_path)
         print(f"Generated genesis file {gen_path}")
@@ -136,23 +138,27 @@ def cmd_testnet(args) -> int:
     from tendermint_tpu import config as cfg
     from tendermint_tpu.p2p import NodeKey
     from tendermint_tpu.privval import load_or_gen_file_pv
-    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types import GenesisDoc
+    from tendermint_tpu.types.genesis import genesis_validator_for
 
     n = args.v
     out = args.o
     starting_port = args.starting_port
+    key_type = getattr(args, "key_type", None) or "ed25519"
     roots, node_keys, pvs = [], [], []
     for i in range(n):
         root = os.path.join(out, f"{args.node_dir_prefix}{i}")
         c = cfg.default_config().set_root(root)
+        c.crypto.key_type = key_type
         cfg.ensure_root(root)
         node_keys.append(NodeKey.load_or_gen(c.base.node_key_path()))
-        pvs.append(load_or_gen_file_pv(c.base.priv_validator_path()))
+        pvs.append(load_or_gen_file_pv(c.base.priv_validator_path(),
+                                       key_type=key_type))
         roots.append((root, c))
     doc = GenesisDoc(
         chain_id=args.chain_id or f"chain-{os.urandom(3).hex()}",
         genesis_time=time.time_ns(),
-        validators=[GenesisValidator(pv.get_pub_key(), 1) for pv in pvs],
+        validators=[genesis_validator_for(pv.priv_key, 1) for pv in pvs],
     )
     # peer layout (reference commands/testnet.go:121-184): one host with
     # per-node port offsets (default), one IP per node
@@ -205,10 +211,11 @@ def cmd_testnet(args) -> int:
 
 def cmd_gen_validator(args) -> int:
     """commands/gen_validator.go: print a fresh priv validator JSON."""
-    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.crypto.keys import generate_priv_key
     from tendermint_tpu.privval import FilePV
 
-    pv = FilePV(PrivKeyEd25519.generate(), None)
+    key_type = getattr(args, "key_type", None) or "ed25519"
+    pv = FilePV(generate_priv_key(key_type), None)
     print(pv.to_json())
     return 0
 
@@ -355,11 +362,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="one IP per node from here (docker subnets)")
     sp.add_argument("--hostname-prefix", default="",
                     help="one hostname per node: PREFIX0.. (k8s pods)")
+    sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                    choices=("ed25519", "bls12381"),
+                    help="validator key type (bls12381 = aggregate "
+                         "commit certificates)")
     sp.set_defaults(fn=cmd_testnet)
 
-    sub.add_parser("gen_validator",
-                   help="generate a priv validator").set_defaults(
-        fn=cmd_gen_validator)
+    sp = sub.add_parser("gen_validator",
+                        help="generate a priv validator")
+    sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                    choices=("ed25519", "bls12381"))
+    sp.set_defaults(fn=cmd_gen_validator)
     sub.add_parser("show_node_id",
                    help="print the node p2p id").set_defaults(
         fn=cmd_show_node_id)

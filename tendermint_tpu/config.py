@@ -201,10 +201,18 @@ class CryptoConfig:
     consensus receive loop overlapping a vote run's WAL write with its
     device dispatch; BatchVerifier.verify() itself stays synchronous
     either way. sig_cache_size bounds the verified-signature LRU
-    (crypto/sigcache.py) in entries; 0 disables the cache."""
+    (crypto/sigcache.py) in entries; 0 disables the cache.
+
+    key_type selects the validator key algorithm when a NEW private
+    validator is generated ("ed25519" | "bls12381"); an existing
+    priv_validator.json keeps its key. bls12381 opts the chain into the
+    aggregate-signature fast lane (O(1) commit certificates) — every
+    genesis validator must use it, with proofs of possession in the
+    genesis doc (MIGRATION.md)."""
 
     async_dispatch: bool = True
     sig_cache_size: int = 65536
+    key_type: str = "ed25519"
 
 
 @dataclass

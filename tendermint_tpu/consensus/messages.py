@@ -94,6 +94,18 @@ class VoteSetBitsMessage:
     votes: BitArray
 
 
+@dataclass
+class AggregateCommitMessage:
+    """Handel-lite precommit aggregation (no reference equivalent; BLS
+    fast lane only): a running (signer bitmap, aggregate signature)
+    certificate for (height, round, block_id). Peers merge disjoint
+    certificates and re-gossip, so a node assembles 2/3+ from O(log n)
+    messages instead of one VoteMessage per validator. `commit` is a
+    types.block.AggregateCommit."""
+
+    commit: object
+
+
 def _ba_obj(ba: Optional[BitArray]):
     return None if ba is None else [ba.bits, ba.to_bytes()]
 
@@ -125,6 +137,8 @@ def message_to_obj(m) -> list:
     if isinstance(m, VoteSetBitsMessage):
         return ["vote_set_bits", m.height, m.round, m.type,
                 serde.block_id_obj(m.block_id), _ba_obj(m.votes)]
+    if isinstance(m, AggregateCommitMessage):
+        return ["agg_commit", serde.commit_obj(m.commit)]
     raise TypeError(f"unknown consensus message {type(m)}")
 
 
@@ -148,4 +162,6 @@ def message_from_obj(o: list):
         return VoteSetMaj23Message(o[1], o[2], o[3], serde.block_id_from(o[4]))
     if kind == "vote_set_bits":
         return VoteSetBitsMessage(o[1], o[2], o[3], serde.block_id_from(o[4]), _ba_from(o[5]))
+    if kind == "agg_commit":
+        return AggregateCommitMessage(serde.commit_from(o[1]))
     raise ValueError(f"unknown consensus message kind {kind!r}")

@@ -35,6 +35,7 @@ from ..types.event_bus import (
 )
 from .cstypes import STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PREVOTE_WAIT
 from .messages import (
+    AggregateCommitMessage,
     BlockPartMessage,
     CommitStepMessage,
     HasVoteMessage,
@@ -209,6 +210,36 @@ class PeerState:
             self._set_has_vote_locked(
                 vote.height, vote.round, vote.type, vote.validator_index
             )
+
+    def apply_agg_commit(self, cert) -> None:
+        """Mark every signer bit of an aggregate precommit certificate
+        as known to the peer (sent to it, or received from it) — the
+        BLS lane's bulk set_has_vote."""
+        with self._lock:
+            ba = self._get_vote_bit_array_locked(
+                cert.agg_height, cert.agg_round, VOTE_TYPE_PRECOMMIT)
+            if ba is None:
+                return
+            for i in range(cert.signers.size()):
+                if cert.signers.get_index(i):
+                    ba.set_index(i, True)
+
+    def agg_cert_has_news(self, cert) -> bool:
+        """Does the certificate cover any signer the peer isn't known to
+        have? (Gossip guard: merged certificates re-send only while they
+        still grow the peer's view.)"""
+        with self._lock:
+            ba = self._get_vote_bit_array_locked(
+                cert.agg_height, cert.agg_round, VOTE_TYPE_PRECOMMIT)
+            if ba is None:
+                # no tracking slot for that (height, round) — stay quiet
+                # rather than re-sending every gossip tick; the per-vote
+                # path covers mismatched-round peers
+                return False
+            for i in range(cert.signers.size()):
+                if cert.signers.get_index(i) and not ba.get_index(i):
+                    return True
+            return False
 
     def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
         """reactor.go:975-994."""
@@ -436,6 +467,16 @@ class ConsensusReactor(Reactor):
                 ps.ensure_vote_bit_arrays(rs.height - 1, n)
                 ps.set_has_vote(msg.vote)
                 self.cs.add_peer_message(msg, peer.id)
+            elif isinstance(msg, AggregateCommitMessage):
+                # Handel-lite lane: everything the cert covers, the peer
+                # knows; the consensus loop verifies + merges it
+                if msg.commit is not None:
+                    rs = self.cs.get_round_state()
+                    n = len(rs.validators) if rs.validators else 0
+                    ps.ensure_vote_bit_arrays(rs.height, n)
+                    ps.ensure_vote_bit_arrays(rs.height - 1, n)
+                    ps.apply_agg_commit(msg.commit)
+                    self.cs.add_peer_message(msg, peer.id)
         elif ch_id == VOTE_SET_BITS_CHANNEL:
             if self.fast_sync:
                 return
@@ -635,6 +676,10 @@ class ConsensusReactor(Reactor):
                 return False
             return peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote=vote)))
 
+        # BLS fast lane: one merged certificate beats N VoteMessages
+        if self._gossip_agg_cert_once(peer, ps, rs, prs):
+            return True
+
         # same height: current-round votes, POL prevotes, last commit
         if rs.height == prs.height and rs.votes is not None:
             # last commit to help the peer finish the previous height
@@ -662,12 +707,61 @@ class ConsensusReactor(Reactor):
         # further behind: stored commit for their height
         block_store = getattr(self.cs, "block_store", None)
         if prs.height != 0 and rs.height >= prs.height + 2 and block_store is not None:
+            from ..types.block import AggregateCommit
+
             commit = block_store.load_block_commit(prs.height)
-            if commit is not None:
+            if isinstance(commit, AggregateCommit):
+                # BLS catch-up: the stored certificate IS the commit —
+                # one message instead of one per validator
+                ps.ensure_catchup_commit_round(prs.height, commit.round(),
+                                               commit.size())
+                if ps.agg_cert_has_news(commit) and peer.send(
+                    VOTE_CHANNEL,
+                    encode_msg(AggregateCommitMessage(commit)),
+                ):
+                    ps.apply_agg_commit(commit)
+                    return True
+            elif commit is not None:
                 ps.ensure_catchup_commit_round(prs.height, commit.round(), len(commit.precommits))
                 vote = ps.pick_vote_to_send(_CommitVoteSetView(commit))
                 if send(vote):
                     return True
+        return False
+
+    def _gossip_agg_cert_once(self, peer, ps: PeerState, rs, prs) -> bool:
+        """Handel-lite aggregation-aware precommit gossip (BLS valsets
+        only; Ed25519 chains never reach this). Send our current merged
+        (bitmap, aggregate) pair whenever it covers signers the peer
+        lacks: the peer merges it with its own running aggregate and
+        re-gossips, so quorum assembly takes O(log n) messages instead
+        of one per validator."""
+        if rs.validators is None or not rs.validators.is_bls():
+            return False
+        try:
+            # same height: the peer's current round precommits
+            if (prs.height == rs.height and rs.votes is not None
+                    and 0 <= prs.round <= rs.round):
+                pc = rs.votes.precommits(prs.round)
+                cert = pc.aggregate_certificate() if pc is not None else None
+                if cert is not None and cert.num_signers() > 1:
+                    ps.ensure_vote_bit_arrays(rs.height, cert.size())
+                    if ps.agg_cert_has_news(cert) and peer.send(
+                        VOTE_CHANNEL, encode_msg(AggregateCommitMessage(cert))
+                    ):
+                        ps.apply_agg_commit(cert)
+                        return True
+            # peer one height behind: our last commit as one certificate
+            if prs.height + 1 == rs.height and rs.last_commit is not None:
+                cert = rs.last_commit.aggregate_certificate()
+                if cert is not None:
+                    ps.ensure_vote_bit_arrays(prs.height, cert.size())
+                    if ps.agg_cert_has_news(cert) and peer.send(
+                        VOTE_CHANNEL, encode_msg(AggregateCommitMessage(cert))
+                    ):
+                        ps.apply_agg_commit(cert)
+                        return True
+        except Exception:
+            LOG.exception("aggregate cert gossip error for %s", peer.id[:8])
         return False
 
     def _query_maj23_routine(self, peer, ps: PeerState) -> None:

@@ -65,6 +65,7 @@ from .cstypes import (
     RoundStepType,
 )
 from .messages import (
+    AggregateCommitMessage,
     BlockPartMessage,
     ProposalMessage,
     VoteMessage,
@@ -145,6 +146,9 @@ class ConsensusState:
         self.on_vote_added: Optional[Callable] = None
 
         self.n_height_committed = 0  # metrics
+        # BLS aggregate lane diagnostics (stall_snapshot / monitor)
+        self.n_agg_merges = 0
+        self.last_agg_cert_bytes = 0
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
@@ -268,6 +272,18 @@ class ConsensusState:
             VOTE_TYPE_PRECOMMIT,
             state.last_validators,
         )
+        from ..types.block import AggregateCommit
+
+        if isinstance(seen, AggregateCommit):
+            # BLS lane: ONE certificate verification (a pairing) instead
+            # of re-verifying N stored precommits
+            if not last_precommits.absorb_certificate(seen):
+                raise RuntimeError(
+                    "stored aggregate seen-commit failed verification")
+            if not last_precommits.has_two_thirds_majority():
+                raise RuntimeError("reconstructed LastCommit lacks +2/3")
+            self.rs.last_commit = last_precommits
+            return
         votes = [v for v in seen.precommits if v is not None]
         # bulk path: ONE batched (TPU) verification for the whole commit.
         # add_votes applies per-item — a corrupt signature in the stored
@@ -472,7 +488,7 @@ class ConsensusState:
                 val_set is not None
                 and 0 <= vote.validator_index < len(val_set)
                 and vote.signature is not None
-                and len(vote.signature) == 64
+                and len(vote.signature) in (64, 96)  # ed25519 | bls12381
             ):
                 addr, val = val_set.get_by_index(vote.validator_index)
                 if addr == vote.validator_address:
@@ -500,8 +516,38 @@ class ConsensusState:
             self._add_proposal_block_part(msg, peer_id)
         elif isinstance(msg, VoteMessage):
             self._try_add_vote(msg.vote, peer_id)
+        elif isinstance(msg, AggregateCommitMessage):
+            self._add_aggregate_certificate(msg.commit, peer_id)
         else:
             LOG.warning("unknown message type %s", type(msg))
+
+    def _add_aggregate_certificate(self, cert, peer_id: str) -> None:
+        """Handel-lite lane: merge a gossiped precommit certificate into
+        the matching VoteSet (current height) or LastCommit (previous
+        height). Verification and composability live in
+        VoteSet.absorb_certificate; a merged certificate drives the
+        same step transitions a 2/3-crossing precommit would."""
+        rs = self.rs
+        if cert is None:
+            return
+        if cert.agg_height == rs.height and rs.votes is not None:
+            vs = rs.votes.precommits(cert.agg_round)
+            if vs is None:
+                return
+            if vs.absorb_certificate(cert):
+                self.metrics.agg_gossip_merges.inc()
+                self.n_agg_merges += 1
+                LOG.debug("absorbed aggregate certificate %s from %s",
+                          cert, peer_id[:8] if peer_id else "self")
+                self._on_precommit_progress(cert.agg_round)
+        elif (cert.agg_height + 1 == rs.height
+              and rs.last_commit is not None
+              and cert.agg_round == rs.last_commit.round):
+            if rs.last_commit.absorb_certificate(cert):
+                self.metrics.agg_gossip_merges.inc()
+                self.n_agg_merges += 1
+                if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                    self._enter_new_round(rs.height, 0)
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """reference handleTimeout :677-711"""
@@ -693,8 +739,16 @@ class ConsensusState:
             txs = []
         evidence = self.evpool.pending_evidence() if self.evpool is not None else []
         proposer = self.priv_validator.get_address()
+        from ..types.block import AggregateCommit
+
         if rs.height == 1:
             t = self.state.last_block_time  # genesis time (reference state.go:146)
+        elif isinstance(commit, AggregateCommit):
+            # BLS lane: no per-vote timestamps to take a median of — the
+            # proposer's clock sets block time, clamped strictly past the
+            # previous block (validators enforce monotonicity only)
+            t = max(now_ns(),
+                    self.state.last_block_time + self.config.blocktime_iota)
         else:
             t = sm_state.median_time(commit, self.state.last_validators)
         block = self.state.make_block(rs.height, txs, commit if rs.height > 1 else None, evidence, proposer, time_ns=t)
@@ -919,6 +973,15 @@ class ConsensusState:
             fail.fail_point("FinalizeCommit.BeforeSave")  # :1251
             if self.block_store.height() < block.header.height:
                 seen_commit = rs.votes.precommits(rs.commit_round).make_commit()
+                from ..types.block import AggregateCommit
+
+                if isinstance(seen_commit, AggregateCommit):
+                    self.last_agg_cert_bytes = seen_commit.size_bytes()
+                    from ..crypto import batch as crypto_batch
+
+                    cm = crypto_batch.get_metrics()
+                    if cm is not None:
+                        cm.agg_commit_size_bytes.set(self.last_agg_cert_bytes)
                 self.block_store.save_block(block, block_parts, seen_commit)  # :1254-1259
             fail.fail_point("FinalizeCommit.AfterSave")  # :1265
 
@@ -954,8 +1017,13 @@ class ConsensusState:
             m.validators.set(len(self.rs.validators))
             m.validators_power.set(self.rs.validators.total_voting_power())
         if block.last_commit is not None:
-            m.missing_validators.set(
-                sum(1 for v in block.last_commit.precommits if v is None))
+            from ..types.block import AggregateCommit
+
+            if isinstance(block.last_commit, AggregateCommit):
+                m.missing_validators.set(block.last_commit.num_absent())
+            else:
+                m.missing_validators.set(
+                    sum(1 for v in block.last_commit.precommits if v is None))
         m.byzantine_validators.set(len(block.evidence.evidence))
         m.num_txs.set(len(block.data.txs))
         m.total_txs.add(len(block.data.txs))
@@ -1138,23 +1206,29 @@ class ConsensusState:
 
     def _on_precommit_added(self, vote: Vote) -> None:
         """reference addVote precommit branch :1603-1632"""
+        self._on_precommit_progress(vote.round)
+
+    def _on_precommit_progress(self, round_: int) -> None:
+        """Shared precommit-quorum transitions: driven by a single added
+        vote OR a merged aggregate certificate (the Handel-lite lane) —
+        both can cross 2/3 for the round."""
         rs = self.rs
-        precommits = rs.votes.precommits(vote.round)
+        precommits = rs.votes.precommits(round_)
         block_id = precommits.two_thirds_majority()
         if block_id is not None:
             self.timeline.mark(rs.height, "precommit_23", peer_id="",
-                               round_=vote.round)
-            self._enter_new_round(rs.height, vote.round)
-            self._enter_precommit(rs.height, vote.round)
+                               round_=round_)
+            self._enter_new_round(rs.height, round_)
+            self._enter_precommit(rs.height, round_)
             if block_id.hash:
-                self._enter_commit(rs.height, vote.round)
+                self._enter_commit(rs.height, round_)
                 if self.config.skip_timeout_commit and precommits.has_all():
                     self._enter_new_round(rs.height, 0)
             else:
-                self._enter_precommit_wait(rs.height, vote.round)
-        elif rs.round <= vote.round and precommits.has_two_thirds_any():
-            self._enter_new_round(rs.height, vote.round)
-            self._enter_precommit_wait(rs.height, vote.round)
+                self._enter_precommit_wait(rs.height, round_)
+        elif rs.round <= round_ and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, round_)
+            self._enter_precommit_wait(rs.height, round_)
 
     # --- vote signing -------------------------------------------------------
 
@@ -1180,9 +1254,17 @@ class ConsensusState:
     def _vote_time(self) -> int:
         """Vote time must exceed the voted block's time by iota, so the
         next block's median commit time is strictly increasing (reference
-        voteTime :1658-1673)."""
-        now = now_ns()
+        voteTime :1658-1673).
+
+        BLS fast lane: votes carry timestamp 0 — aggregation requires
+        every precommit for (height, round, block_id) to sign IDENTICAL
+        bytes, and the timestamp is the only per-validator field. Block
+        time then comes from the proposer's clock under a strict
+        monotonicity rule (PARITY_DEVIATIONS.md)."""
         rs = self.rs
+        if rs.validators is not None and rs.validators.is_bls():
+            return 0
+        now = now_ns()
         min_t = now
         if rs.locked_block is not None:
             min_t = rs.locked_block.header.time + self.config.blocktime_iota
@@ -1249,6 +1331,15 @@ class ConsensusState:
             "missing_validators": [],
             "peers": [],
             "inflight_verify_batches": crypto_batch.inflight_count(),
+            # BLS aggregate fast lane: whether this chain runs it, how
+            # many gossiped certificates merged, and the last persisted
+            # certificate's wire size (monitor surfaces these)
+            "agg": {
+                "enabled": bool(rs.validators is not None
+                                and rs.validators.is_bls()),
+                "gossip_merges": self.n_agg_merges,
+                "last_cert_bytes": self.last_agg_cert_bytes,
+            },
         }
         try:
             if rs.votes is not None and rs.validators is not None:

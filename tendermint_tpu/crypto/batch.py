@@ -387,7 +387,12 @@ class BatchVerifier:
 
 
 class CPUBatchVerifier(BatchVerifier):
-    """Serial per-signature verification — the reference semantics."""
+    """Serial per-signature verification — the reference semantics.
+
+    Key type is dispatched on pubkey length: 32 bytes = Ed25519,
+    48 bytes = BLS12-381 (the aggregate fast lane's INDIVIDUAL votes —
+    live gossip still delivers one precommit at a time; the O(1)
+    certificate path is ValidatorSet.verify_commit_aggregate)."""
 
     BACKEND = "cpu"
 
@@ -397,7 +402,12 @@ class CPUBatchVerifier(BatchVerifier):
         out = []
         for msg, sig, pk in self._items:
             try:
-                out.append(PubKeyEd25519(pk).verify_bytes(msg, sig))
+                if len(pk) == 48:
+                    from .bls import PubKeyBLS12381
+
+                    out.append(PubKeyBLS12381(pk).verify_bytes(msg, sig))
+                else:
+                    out.append(PubKeyEd25519(pk).verify_bytes(msg, sig))
             except ValueError:
                 out.append(False)
         return out
@@ -427,6 +437,13 @@ class AdaptiveBatchVerifier(BatchVerifier):
         # under its leaf backend label — a template here would double
         # count every batch. Adaptive only adds the routing decision.
         n = len(self._items)
+        if any(len(pk) != 32 for _, _, pk in self._items):
+            # non-Ed25519 triples (BLS fast lane): the jax kernel is
+            # Ed25519-specific — route straight to the CPU dispatcher
+            inner = CPUBatchVerifier()
+            for msg, sig, pk in self._items:
+                inner.add(msg, sig, pk)
+            return inner.verify()
         cache = _sig_cache
         if cache is not None and n:
             # route on the CACHE-MISS count (stats-neutral peek): the

@@ -1,0 +1,334 @@
+"""BLS12-381 min-pubkey signatures (48-byte G1 pubkeys, 96-byte G2
+signatures) — the aggregate-signature fast lane.
+
+Scheme layout follows draft-irtf-cfrg-bls-signature (min-pubkey-size,
+proof-of-possession scheme): sign(sk, m) = [sk] H(m) with H = hash-to-G2
+(hash_to_curve.py; RFC 9380 structure, SvdW map — see the deviation note
+there), verify via the 2-pairing product check, aggregation = one G2
+point addition per signature, and fast_aggregate_verify (same-message
+aggregate: exactly the commit-certificate shape) = ONE pubkey MSM + ONE
+2-pairing check regardless of committee size. Rogue-key attacks are
+blocked by proof-of-possession registration: aggregate verification is
+only sound over keys whose PoP was checked, so the registry refuses
+unproven keys and ValidatorSet construction enforces registration for
+BLS validator sets.
+
+Point parsing is cached process-wide (decompression + subgroup check
+are the per-object costs; gossip re-delivery then costs a dict hit).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+from .. import tmhash
+from ..keys import PrivKey, PubKey
+from . import msm
+from .curve import (
+    G1_GEN,
+    G2Point,
+    g1_compress,
+    g1_decompress,
+    g1_in_subgroup,
+    g1_neg,
+    g1_mul,
+    g1_to_affine,
+    g2_add,
+    g2_compress,
+    g2_decompress,
+    g2_in_subgroup,
+    g2_mul,
+)
+from .fields import R_ORDER
+from .hash_to_curve import hash_to_g2
+from .pairing import pairing_product_is_one
+
+BLS_PUBKEY_SIZE = 48
+BLS_PRIVKEY_SIZE = 32
+BLS_SIGNATURE_SIZE = 96
+
+# ciphersuite DSTs (names kept from the Eth2 / draft-irtf ciphersuite;
+# the curve map deviation is documented in hash_to_curve.py)
+DST_SIG = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+_NEG_G1_GEN = g1_neg(G1_GEN)
+
+
+class _PointCache:
+    """Tiny thread-safe LRU: compressed bytes -> (point, in_subgroup)."""
+
+    def __init__(self, maxsize: int = 16384):
+        self._d: OrderedDict = OrderedDict()
+        self._max = maxsize
+        self._lock = threading.Lock()
+
+    def get(self, key: bytes):
+        with self._lock:
+            v = self._d.get(key)
+            if v is not None:
+                self._d.move_to_end(key)
+            return v
+
+    def put(self, key: bytes, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self._max:
+                self._d.popitem(last=False)
+
+
+_g1_cache = _PointCache()
+_g2_cache = _PointCache()
+
+
+def _parse_pubkey_point(data: bytes):
+    """48 compressed bytes -> affine (x, y) in the G1 subgroup, or None
+    for invalid/infinity/out-of-subgroup encodings."""
+    hit = _g1_cache.get(data)
+    if hit is not None:
+        return hit[0] if hit[1] else None
+    try:
+        pt = g1_decompress(data)
+    except ValueError:
+        _g1_cache.put(data, (None, False))
+        return None
+    if pt is None or not g1_in_subgroup(pt):
+        _g1_cache.put(data, (None, False))
+        return None
+    aff = g1_to_affine(pt)
+    _g1_cache.put(data, (aff, True))
+    return aff
+
+
+def _parse_signature_point(data: bytes) -> Optional[G2Point]:
+    hit = _g2_cache.get(data)
+    if hit is not None:
+        return hit[0] if hit[1] else None
+    try:
+        pt = g2_decompress(data)
+    except ValueError:
+        _g2_cache.put(data, (None, False))
+        return None
+    if pt is None or not g2_in_subgroup(pt):
+        _g2_cache.put(data, (None, False))
+        return None
+    _g2_cache.put(data, (pt, True))
+    return pt
+
+
+# --- proof-of-possession registry -------------------------------------
+# fast_aggregate_verify is only rogue-key-safe over keys that proved
+# possession. Registration verifies the PoP once; the valset layer
+# refuses BLS keys that never registered.
+
+_pop_registry: set = set()
+_pop_lock = threading.Lock()
+
+
+def pop_prove(priv: "PrivKeyBLS12381") -> bytes:
+    """PoP = sign the pubkey bytes under the POP DST."""
+    pk = priv.pub_key().data
+    sk = int.from_bytes(priv.data, "big") % R_ORDER
+    return g2_compress(g2_mul(hash_to_g2(pk, DST_POP), sk))
+
+
+def pop_verify(pubkey: bytes, proof: bytes) -> bool:
+    pk_pt = _parse_pubkey_point(pubkey)
+    sig_pt = _parse_signature_point(proof)
+    if pk_pt is None or sig_pt is None:
+        return False
+    hm = hash_to_g2(pubkey, DST_POP)
+    return pairing_product_is_one(
+        [((pk_pt[0], pk_pt[1], 1), hm), (_NEG_G1_GEN, sig_pt)]
+    )
+
+
+def register_proof_of_possession(pubkey: bytes, proof: bytes) -> bool:
+    """Verify + record a key's PoP; aggregate paths only trust
+    registered keys. Returns False (and records nothing) on a bad
+    proof."""
+    with _pop_lock:
+        if pubkey in _pop_registry:
+            return True
+    if not pop_verify(pubkey, proof):
+        return False
+    with _pop_lock:
+        _pop_registry.add(pubkey)
+    return True
+
+
+def pop_registered(pubkey: bytes) -> bool:
+    with _pop_lock:
+        return pubkey in _pop_registry
+
+
+def _register_pop_unchecked(pubkey: bytes) -> None:
+    """Key generated locally from its secret — possession is intrinsic
+    (used by PrivKeyBLS12381.pub_key so self-generated keys can always
+    participate)."""
+    with _pop_lock:
+        _pop_registry.add(pubkey)
+
+
+# --- key types (crypto.keys interface) --------------------------------
+
+
+@dataclass(frozen=True)
+class PubKeyBLS12381(PubKey):
+    data: bytes  # 48 compressed G1 bytes
+
+    def __post_init__(self):
+        if len(self.data) != BLS_PUBKEY_SIZE:
+            raise ValueError(f"bls12381 pubkey must be {BLS_PUBKEY_SIZE} bytes")
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self.data)
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def verify_bytes(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != BLS_SIGNATURE_SIZE:
+            return False
+        pk_pt = _parse_pubkey_point(self.data)
+        sig_pt = _parse_signature_point(sig)
+        if pk_pt is None or sig_pt is None:
+            return False
+        hm = hash_to_g2(msg, DST_SIG)
+        return pairing_product_is_one(
+            [((pk_pt[0], pk_pt[1], 1), hm), (_NEG_G1_GEN, sig_pt)]
+        )
+
+    def __eq__(self, other):
+        return PubKey.__eq__(self, other)
+
+    def __hash__(self):
+        return PubKey.__hash__(self)
+
+
+@dataclass(frozen=True)
+class PrivKeyBLS12381(PrivKey):
+    data: bytes  # 32-byte big-endian scalar in [1, r)
+
+    def __post_init__(self):
+        if len(self.data) != BLS_PRIVKEY_SIZE:
+            raise ValueError(f"bls12381 privkey must be {BLS_PRIVKEY_SIZE} bytes")
+        if int.from_bytes(self.data, "big") % R_ORDER == 0:
+            raise ValueError("bls12381 privkey scalar is zero mod r")
+
+    @staticmethod
+    def generate() -> "PrivKeyBLS12381":
+        import secrets
+
+        while True:
+            sk = secrets.randbits(380) % R_ORDER
+            if sk:
+                return PrivKeyBLS12381(sk.to_bytes(32, "big"))
+
+    @staticmethod
+    def gen_from_secret(secret: bytes) -> "PrivKeyBLS12381":
+        """Deterministic key from a secret (test fixtures; mirrors
+        PrivKeyEd25519.gen_from_secret)."""
+        seed = hashlib.sha512(b"bls12381-keygen" + secret).digest()
+        sk = int.from_bytes(seed, "big") % R_ORDER
+        if sk == 0:  # pragma: no cover - probability 2^-255
+            sk = 1
+        return PrivKeyBLS12381(sk.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self.data
+
+    def sign(self, msg: bytes) -> bytes:
+        sk = int.from_bytes(self.data, "big") % R_ORDER
+        return g2_compress(g2_mul(hash_to_g2(msg, DST_SIG), sk))
+
+    def pub_key(self) -> PubKeyBLS12381:
+        sk = int.from_bytes(self.data, "big") % R_ORDER
+        pk = g1_compress(g1_mul(G1_GEN, sk))
+        _register_pop_unchecked(pk)
+        return PubKeyBLS12381(pk)
+
+    def pop_prove(self) -> bytes:
+        return pop_prove(self)
+
+    def __eq__(self, other):
+        return PrivKey.__eq__(self, other)
+
+    def __hash__(self):
+        return PrivKey.__hash__(self)
+
+
+# --- aggregation -------------------------------------------------------
+
+
+def aggregate_signatures(sigs: Sequence[bytes]) -> bytes:
+    """Sum the G2 signature points; raises on malformed input (callers
+    aggregate only signatures they individually accepted)."""
+    if not sigs:
+        raise ValueError("cannot aggregate zero signatures")
+    acc: G2Point = None
+    for s in sigs:
+        pt = _parse_signature_point(s)
+        if pt is None:
+            raise ValueError("cannot aggregate invalid signature")
+        acc = g2_add(acc, pt)
+    return g2_compress(acc)
+
+
+def aggregate_pubkeys(pubkeys: Sequence[bytes], backend: Optional[str] = None):
+    """Bitmap-selected pubkey aggregation: the MSM kernel input. Returns
+    a Jacobian G1 point or None; invalid keys raise."""
+    pts = []
+    for pk in pubkeys:
+        aff = _parse_pubkey_point(pk)
+        if aff is None:
+            raise ValueError("cannot aggregate invalid pubkey")
+        pts.append(aff)
+    return msm.aggregate_points(pts, backend=backend)
+
+
+def fast_aggregate_verify(
+    pubkeys: Sequence[bytes], msg: bytes, signature: bytes,
+    backend: Optional[str] = None, require_pop: bool = True,
+) -> bool:
+    """Same-message aggregate verification: one pubkey MSM + one
+    2-pairing product check — O(1) pairings for any committee size.
+
+    require_pop (default) refuses the check unless every key registered
+    a proof of possession: fast aggregate verification without PoP is
+    exactly the rogue-key attack surface."""
+    if not pubkeys:
+        return False
+    if len(signature) != BLS_SIGNATURE_SIZE:
+        return False
+    if require_pop and not all(pop_registered(pk) for pk in pubkeys):
+        return False
+    sig_pt = _parse_signature_point(signature)
+    if sig_pt is None:
+        return False
+    t0 = time.perf_counter()
+    try:
+        agg_pk = aggregate_pubkeys(pubkeys, backend=backend)
+    except ValueError:
+        return False
+    if agg_pk is None:  # keys summed to infinity (attack-shaped input)
+        return False
+    hm = hash_to_g2(msg, DST_SIG)
+    ok = pairing_product_is_one([(agg_pk, hm), (_NEG_G1_GEN, sig_pt)])
+    _record_agg_metrics(time.perf_counter() - t0, len(pubkeys))
+    return ok
+
+
+def _record_agg_metrics(dt: float, signers: int) -> None:
+    from .. import batch
+
+    m = batch.get_metrics()
+    if m is not None:
+        m.agg_verify_seconds.observe(dt)
+        m.agg_signers.observe(signers)

@@ -1,0 +1,401 @@
+"""BLS12-381 group arithmetic: G1 over Fp, G2 over Fp2 (twist), and the
+ZCash-style compressed point encodings (48-byte G1, 96-byte G2).
+
+Points are Jacobian triples (X, Y, Z); None is the point at infinity.
+G1 coordinates are ints, G2 coordinates are fields.Fp2 tuples. The
+formulas are the standard a=0 Jacobian ones; every deserialization
+verifies the curve equation, and subgroup membership is checked with an
+explicit [r]P == O multiply (cached by callers — the scheme layer
+parses each key/signature once).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .fields import (
+    F2_ONE,
+    F2_ZERO,
+    P,
+    R_ORDER,
+    X_PARAM,
+    XI,
+    f2_add,
+    f2_batch_inv,
+    f2_conj,
+    f2_inv,
+    f2_mul,
+    f2_mul_fp,
+    f2_neg,
+    f2_pow,
+    f2_sqr,
+    f2_sqrt,
+    f2_sub,
+    fp_inv,
+    fp_sqrt,
+)
+
+B_G1 = 4  # E1: y^2 = x^3 + 4
+B_G2 = (4, 4)  # E2' (the twist): y^2 = x^3 + 4(1 + u)
+
+# generators (standard constants; tests assert on-curve + order r)
+G1_GEN_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_GEN_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+G2_GEN_X = (
+    0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8,
+    0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E,
+)
+G2_GEN_Y = (
+    0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801,
+    0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE,
+)
+
+G1Point = Optional[Tuple[int, int, int]]
+G2Point = Optional[Tuple]
+
+G1_GEN: G1Point = (G1_GEN_X, G1_GEN_Y, 1)
+G2_GEN: G2Point = (G2_GEN_X, G2_GEN_Y, F2_ONE)
+
+# --- G1 (Jacobian over Fp) --------------------------------------------
+
+
+def g1_dbl(p: G1Point) -> G1Point:
+    if p is None:
+        return None
+    X, Y, Z = p
+    if Y == 0:
+        return None
+    A = X * X % P
+    Bv = Y * Y % P
+    C = Bv * Bv % P
+    D = 2 * ((X + Bv) * (X + Bv) - A - C) % P
+    E = 3 * A % P
+    F = E * E % P
+    X3 = (F - 2 * D) % P
+    Y3 = (E * (D - X3) - 8 * C) % P
+    Z3 = 2 * Y * Z % P
+    return (X3, Y3, Z3)
+
+
+def g1_add(p: G1Point, q: G1Point) -> G1Point:
+    if p is None:
+        return q
+    if q is None:
+        return p
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    if U1 == U2:
+        if S1 != S2:
+            return None
+        return g1_dbl(p)
+    H = (U2 - U1) % P
+    I = 4 * H * H % P
+    J = H * I % P
+    rr = 2 * (S2 - S1) % P
+    V = U1 * I % P
+    X3 = (rr * rr - J - 2 * V) % P
+    Y3 = (rr * (V - X3) - 2 * S1 * J) % P
+    Z3 = ((Z1 + Z2) * (Z1 + Z2) - Z1Z1 - Z2Z2) * H % P
+    return (X3, Y3, Z3)
+
+
+def g1_neg(p: G1Point) -> G1Point:
+    if p is None:
+        return None
+    return (p[0], (-p[1]) % P, p[2])
+
+
+def g1_mul(p: G1Point, k: int) -> G1Point:
+    k %= R_ORDER
+    out: G1Point = None
+    add = p
+    while k:
+        if k & 1:
+            out = g1_add(out, add)
+        add = g1_dbl(add)
+        k >>= 1
+    return out
+
+
+def g1_to_affine(p: G1Point) -> Optional[Tuple[int, int]]:
+    if p is None:
+        return None
+    X, Y, Z = p
+    zi = fp_inv(Z)
+    zi2 = zi * zi % P
+    return X * zi2 % P, Y * zi2 * zi % P
+
+
+def g1_eq(p: G1Point, q: G1Point) -> bool:
+    if p is None or q is None:
+        return p is q or (p is None and q is None)
+    # cross-multiplied Jacobian equality
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    return (
+        X1 * Z2Z2 % P == X2 * Z1Z1 % P
+        and Y1 * Z2 * Z2Z2 % P == Y2 * Z1 * Z1Z1 % P
+    )
+
+
+def g1_on_curve(p: G1Point) -> bool:
+    if p is None:
+        return True
+    x, y = g1_to_affine(p)
+    return (y * y - x * x * x - B_G1) % P == 0
+
+
+def g1_in_subgroup(p: G1Point) -> bool:
+    return g1_on_curve(p) and g1_mul(p, R_ORDER) is None
+
+
+def g1_sum(points: List[G1Point]) -> G1Point:
+    """Plain sequential Jacobian accumulation — the host-side reference
+    the JAX MSM kernel (msm.py) is property-tested against."""
+    acc: G1Point = None
+    for p in points:
+        acc = g1_add(acc, p)
+    return acc
+
+
+# --- G2 (Jacobian over Fp2, on the twist) -----------------------------
+
+
+def g2_dbl(p: G2Point) -> G2Point:
+    if p is None:
+        return None
+    X, Y, Z = p
+    if Y == F2_ZERO:
+        return None
+    A = f2_sqr(X)
+    Bv = f2_sqr(Y)
+    C = f2_sqr(Bv)
+    t = f2_sqr(f2_add(X, Bv))
+    D = f2_sub(t, f2_add(A, C))
+    D = f2_add(D, D)
+    E = f2_add(f2_add(A, A), A)
+    F = f2_sqr(E)
+    X3 = f2_sub(F, f2_add(D, D))
+    Y3 = f2_sub(f2_mul(E, f2_sub(D, X3)), _f2_x8(C))
+    Z3 = f2_mul(f2_add(Y, Y), Z)
+    return (X3, Y3, Z3)
+
+
+def _f2_x8(a):
+    return a[0] * 8 % P, a[1] * 8 % P
+
+
+def g2_add(p: G2Point, q: G2Point) -> G2Point:
+    if p is None:
+        return q
+    if q is None:
+        return p
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = f2_sqr(Z1)
+    Z2Z2 = f2_sqr(Z2)
+    U1 = f2_mul(X1, Z2Z2)
+    U2 = f2_mul(X2, Z1Z1)
+    S1 = f2_mul(f2_mul(Y1, Z2), Z2Z2)
+    S2 = f2_mul(f2_mul(Y2, Z1), Z1Z1)
+    if U1 == U2:
+        if S1 != S2:
+            return None
+        return g2_dbl(p)
+    H = f2_sub(U2, U1)
+    I = f2_sqr(f2_add(H, H))
+    J = f2_mul(H, I)
+    rr = f2_sub(S2, S1)
+    rr = f2_add(rr, rr)
+    V = f2_mul(U1, I)
+    X3 = f2_sub(f2_sub(f2_sqr(rr), J), f2_add(V, V))
+    S1J = f2_mul(S1, J)
+    Y3 = f2_sub(f2_mul(rr, f2_sub(V, X3)), f2_add(S1J, S1J))
+    Z3 = f2_mul(f2_sub(f2_sub(f2_sqr(f2_add(Z1, Z2)), Z1Z1), Z2Z2), H)
+    return (X3, Y3, Z3)
+
+
+def g2_neg(p: G2Point) -> G2Point:
+    if p is None:
+        return None
+    return (p[0], f2_neg(p[1]), p[2])
+
+
+def g2_mul(p: G2Point, k: int) -> G2Point:
+    if k < 0:
+        return g2_neg(g2_mul(p, -k))
+    out: G2Point = None
+    add = p
+    while k:
+        if k & 1:
+            out = g2_add(out, add)
+        add = g2_dbl(add)
+        k >>= 1
+    return out
+
+
+def g2_to_affine(p: G2Point) -> Optional[Tuple]:
+    if p is None:
+        return None
+    X, Y, Z = p
+    zi = f2_inv(Z)
+    zi2 = f2_sqr(zi)
+    return f2_mul(X, zi2), f2_mul(Y, f2_mul(zi2, zi))
+
+
+def g2_batch_to_affine(points: List[G2Point]) -> List[Optional[Tuple]]:
+    """Normalize many Jacobian points with ONE field inversion."""
+    zs = [p[2] for p in points if p is not None]
+    invs = iter(f2_batch_inv(zs))
+    out = []
+    for p in points:
+        if p is None:
+            out.append(None)
+            continue
+        zi = next(invs)
+        zi2 = f2_sqr(zi)
+        out.append((f2_mul(p[0], zi2), f2_mul(p[1], f2_mul(zi2, zi))))
+    return out
+
+
+def g2_eq(p: G2Point, q: G2Point) -> bool:
+    if p is None or q is None:
+        return p is None and q is None
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = f2_sqr(Z1)
+    Z2Z2 = f2_sqr(Z2)
+    return f2_mul(X1, Z2Z2) == f2_mul(X2, Z1Z1) and f2_mul(
+        f2_mul(Y1, Z2), Z2Z2
+    ) == f2_mul(f2_mul(Y2, Z1), Z1Z1)
+
+
+def g2_on_curve(p: G2Point) -> bool:
+    if p is None:
+        return True
+    x, y = g2_to_affine(p)
+    return f2_sqr(y) == f2_add(f2_mul(f2_sqr(x), x), B_G2)
+
+
+def g2_in_subgroup(p: G2Point) -> bool:
+    return g2_on_curve(p) and g2_mul(p, R_ORDER) is None
+
+
+# --- psi (untwist-Frobenius-twist endomorphism on the twist) -----------
+# psi(x, y) = (cx * conj(x), cy * conj(y)) with cx = XI^((1-p)/3) and
+# cy = XI^((1-p)/2) — derived from untwist x/w^2, y/w^3 with w^6 = XI.
+
+_PSI_CX = f2_inv(f2_pow(XI, (P - 1) // 3))
+_PSI_CY = f2_inv(f2_pow(XI, (P - 1) // 2))
+
+
+def g2_psi(p: G2Point) -> G2Point:
+    if p is None:
+        return None
+    x, y = g2_to_affine(p)
+    return (f2_mul(_PSI_CX, f2_conj(x)), f2_mul(_PSI_CY, f2_conj(y)), F2_ONE)
+
+
+def g2_clear_cofactor(p: G2Point) -> G2Point:
+    """Budroni–Pintore efficient cofactor clearing for BLS12 G2:
+    [h_eff]P = [x^2 - x - 1]P + [x - 1]psi(P) + psi^2([2]P).
+    Output is in the r-torsion (property-tested: [r]out == O)."""
+    if p is None:
+        return None
+    xP = g2_mul(p, X_PARAM)  # [x]P
+    x2P = g2_mul(xP, X_PARAM)  # [x^2]P
+    out = g2_add(x2P, g2_neg(xP))  # [x^2 - x]P
+    out = g2_add(out, g2_neg(p))  # [x^2 - x - 1]P
+    psiP = g2_psi(p)
+    t = g2_add(g2_mul(psiP, X_PARAM), g2_neg(psiP))  # [x - 1]psi(P)
+    out = g2_add(out, t)
+    out = g2_add(out, g2_psi(g2_psi(g2_dbl(p))))
+    return out
+
+
+# --- compressed serialization (ZCash flags) ---------------------------
+# byte 0 high bits: 0x80 compressed (always set), 0x40 infinity,
+# 0x20 sign (y is the lexicographically larger of {y, -y}).
+
+
+def _fp_is_larger(y: int) -> bool:
+    return y > (P - 1) // 2
+
+
+def _f2_is_larger(y: Tuple[int, int]) -> bool:
+    """Fp2 ordering used by the flag bit: compare as y1 * p + y0."""
+    if y[1] != 0:
+        return _fp_is_larger(y[1])
+    return _fp_is_larger(y[0])
+
+
+def g1_compress(p: G1Point) -> bytes:
+    if p is None:
+        return bytes([0xC0]) + b"\x00" * 47
+    x, y = g1_to_affine(p)
+    flags = 0x80 | (0x20 if _fp_is_larger(y) else 0)
+    raw = bytearray(x.to_bytes(48, "big"))
+    raw[0] |= flags
+    return bytes(raw)
+
+
+def g1_decompress(data: bytes) -> G1Point:
+    if len(data) != 48:
+        raise ValueError("G1 point must be 48 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise ValueError("uncompressed G1 encoding not supported")
+    if flags & 0x40:
+        if any(data[1:]) or flags & 0x3F:
+            raise ValueError("malformed G1 infinity encoding")
+        return None
+    x = int.from_bytes(bytes([flags & 0x1F]) + data[1:], "big")
+    if x >= P:
+        raise ValueError("G1 x coordinate out of range")
+    y = fp_sqrt((x * x * x + B_G1) % P)
+    if y is None:
+        raise ValueError("G1 x is not on the curve")
+    if _fp_is_larger(y) != bool(flags & 0x20):
+        y = (-y) % P
+    return (x, y, 1)
+
+
+def g2_compress(p: G2Point) -> bytes:
+    if p is None:
+        return bytes([0xC0]) + b"\x00" * 95
+    x, y = g2_to_affine(p)
+    flags = 0x80 | (0x20 if _f2_is_larger(y) else 0)
+    raw = bytearray(x[1].to_bytes(48, "big") + x[0].to_bytes(48, "big"))
+    raw[0] |= flags
+    return bytes(raw)
+
+
+def g2_decompress(data: bytes) -> G2Point:
+    if len(data) != 96:
+        raise ValueError("G2 point must be 96 bytes")
+    flags = data[0]
+    if not flags & 0x80:
+        raise ValueError("uncompressed G2 encoding not supported")
+    if flags & 0x40:
+        if any(data[1:]) or flags & 0x3F:
+            raise ValueError("malformed G2 infinity encoding")
+        return None
+    x1 = int.from_bytes(bytes([flags & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P or x1 >= P:
+        raise ValueError("G2 x coordinate out of range")
+    x = (x0, x1)
+    y = f2_sqrt(f2_add(f2_mul(f2_sqr(x), x), B_G2))
+    if y is None:
+        raise ValueError("G2 x is not on the curve")
+    if _f2_is_larger(y) != bool(flags & 0x20):
+        y = f2_neg(y)
+    return (x, y, F2_ONE)

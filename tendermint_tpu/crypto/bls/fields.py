@@ -1,0 +1,342 @@
+"""BLS12-381 field tower: Fp, Fp2, Fp12 (house pure-Python style).
+
+Layout mirrors the other pure-Python crypto fallbacks (RFC-pinned,
+zero-dependency): Fp elements are plain ints mod P; Fp2 elements are
+(c0, c1) tuples meaning c0 + c1*u with u^2 = -1; Fp12 elements are
+6-tuples of Fp2 coefficients over w with w^6 = XI = 1 + u (the sextic
+non-residue). The "sextic over quadratic" representation keeps
+Frobenius maps coefficient-wise: (sum c_i w^i)^(p^k) needs only an Fp2
+conjugation (k odd) and a precomputed twist constant per coefficient —
+all constants are DERIVED at import from P and XI, never transcribed.
+
+Every derived constant that has a checkable algebraic property is
+asserted in tests/test_bls.py (tower consistency, Frobenius == repeated
+multiplication, inverse round-trips).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# --- curve family constants (verified in tests against the defining
+# relations r = x^4 - x^2 + 1 and p = (x-1)^2 r / 3 + x) ----------------
+
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+R_ORDER = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+X_PARAM = -0xD201000000010000  # the BLS12-381 curve parameter (negative)
+
+Fp2 = Tuple[int, int]
+Fp12 = Tuple[Fp2, Fp2, Fp2, Fp2, Fp2, Fp2]
+
+F2_ZERO: Fp2 = (0, 0)
+F2_ONE: Fp2 = (1, 0)
+XI: Fp2 = (1, 1)  # the sextic non-residue 1 + u; w^6 = XI
+
+# --- Fp ----------------------------------------------------------------
+
+
+def fp_inv(a: int) -> int:
+    return pow(a, P - 2, P)
+
+
+def fp_sqrt(a: int) -> Optional[int]:
+    """sqrt mod P (P = 3 mod 4), or None if a is a non-residue."""
+    s = pow(a, (P + 1) // 4, P)
+    return s if s * s % P == a % P else None
+
+
+def fp_is_square(a: int) -> bool:
+    return a % P == 0 or pow(a, (P - 1) // 2, P) == 1
+
+
+# --- Fp2 ---------------------------------------------------------------
+
+
+def f2_add(a: Fp2, b: Fp2) -> Fp2:
+    return (a[0] + b[0]) % P, (a[1] + b[1]) % P
+
+
+def f2_sub(a: Fp2, b: Fp2) -> Fp2:
+    return (a[0] - b[0]) % P, (a[1] - b[1]) % P
+
+
+def f2_neg(a: Fp2) -> Fp2:
+    return (-a[0]) % P, (-a[1]) % P
+
+
+def f2_mul(a: Fp2, b: Fp2) -> Fp2:
+    # Karatsuba: 3 big multiplications
+    t0 = a[0] * b[0]
+    t1 = a[1] * b[1]
+    return (t0 - t1) % P, ((a[0] + a[1]) * (b[0] + b[1]) - t0 - t1) % P
+
+
+def f2_sqr(a: Fp2) -> Fp2:
+    # (c0+c1 u)^2 = (c0+c1)(c0-c1) + 2 c0 c1 u
+    t = a[0] * a[1]
+    return (a[0] + a[1]) * (a[0] - a[1]) % P, (t + t) % P
+
+
+def f2_mul_fp(a: Fp2, s: int) -> Fp2:
+    return a[0] * s % P, a[1] * s % P
+
+
+def f2_mul_xi(a: Fp2) -> Fp2:
+    # (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u
+    return (a[0] - a[1]) % P, (a[0] + a[1]) % P
+
+
+def f2_conj(a: Fp2) -> Fp2:
+    """Frobenius a^p on Fp2 = conjugation."""
+    return a[0], (-a[1]) % P
+
+
+def f2_inv(a: Fp2) -> Fp2:
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    ni = fp_inv(norm)
+    return a[0] * ni % P, (-a[1]) * ni % P
+
+
+def f2_pow(a: Fp2, e: int) -> Fp2:
+    out = F2_ONE
+    base = a
+    while e:
+        if e & 1:
+            out = f2_mul(out, base)
+        base = f2_sqr(base)
+        e >>= 1
+    return out
+
+
+def f2_is_square(a: Fp2) -> bool:
+    """a is a square in Fp2 iff its norm is a square in Fp."""
+    norm = (a[0] * a[0] + a[1] * a[1]) % P
+    return fp_is_square(norm)
+
+
+def f2_sqrt(a: Fp2) -> Optional[Fp2]:
+    """Square root in Fp2 via the complex method (P = 3 mod 4); returns
+    None for non-squares. Output is verified by squaring before return,
+    so a wrong branch can never leak an invalid root."""
+    if a == F2_ZERO:
+        return F2_ZERO
+    a0, a1 = a[0] % P, a[1] % P
+    if a1 == 0:
+        s = fp_sqrt(a0)
+        if s is not None:
+            return (s, 0)
+        s = fp_sqrt((-a0) % P)  # (s u)^2 = -s^2 = a0
+        return (0, s) if s is not None else None
+    alpha = (a0 * a0 + a1 * a1) % P
+    n = fp_sqrt(alpha)
+    if n is None:
+        return None
+    inv2 = (P + 1) // 2
+    delta = (a0 + n) * inv2 % P
+    s = fp_sqrt(delta)
+    if s is None:
+        delta = (a0 - n) * inv2 % P
+        s = fp_sqrt(delta)
+        if s is None:
+            return None
+    c0 = s
+    c1 = a1 * fp_inv(2 * s % P) % P
+    cand = (c0, c1)
+    return cand if f2_sqr(cand) == (a0, a1) else None
+
+
+def f2_sgn0(a: Fp2) -> int:
+    """RFC 9380 sgn0 for m=2: parity of c0, falling back to c1's parity
+    when c0 == 0."""
+    s0 = a[0] % 2
+    if a[0] % P != 0:
+        return s0
+    return a[1] % 2
+
+
+def f2_batch_inv(xs):
+    """Montgomery batch inversion: one fp_inv for the whole list. All
+    inputs must be nonzero."""
+    n = len(xs)
+    if n == 0:
+        return []
+    prefix = [None] * n
+    acc = F2_ONE
+    for i, x in enumerate(xs):
+        prefix[i] = acc
+        acc = f2_mul(acc, x)
+    inv = f2_inv(acc)
+    out = [None] * n
+    for i in range(n - 1, -1, -1):
+        out[i] = f2_mul(inv, prefix[i])
+        inv = f2_mul(inv, xs[i])
+    return out
+
+
+# --- Fp12 as Fp2[w] / (w^6 - XI) --------------------------------------
+
+F12_ONE: Fp12 = (F2_ONE, F2_ZERO, F2_ZERO, F2_ZERO, F2_ZERO, F2_ZERO)
+
+
+def f12_mul(a: Fp12, b: Fp12) -> Fp12:
+    out = [F2_ZERO] * 6
+    for i in range(6):
+        ai = a[i]
+        if ai == F2_ZERO:
+            continue
+        for j in range(6):
+            bj = b[j]
+            if bj == F2_ZERO:
+                continue
+            t = f2_mul(ai, bj)
+            k = i + j
+            if k >= 6:
+                k -= 6
+                t = f2_mul_xi(t)
+            out[k] = f2_add(out[k], t)
+    return tuple(out)
+
+
+def f12_sqr(a: Fp12) -> Fp12:
+    out = [F2_ZERO] * 6
+    for i in range(6):
+        ai = a[i]
+        if ai == F2_ZERO:
+            continue
+        t = f2_sqr(ai)
+        k = 2 * i
+        if k >= 6:
+            k -= 6
+            t = f2_mul_xi(t)
+        out[k] = f2_add(out[k], t)
+        for j in range(i + 1, 6):
+            aj = a[j]
+            if aj == F2_ZERO:
+                continue
+            t = f2_mul(ai, aj)
+            t = f2_add(t, t)
+            k = i + j
+            if k >= 6:
+                k -= 6
+                t = f2_mul_xi(t)
+            out[k] = f2_add(out[k], t)
+    return tuple(out)
+
+
+def f12_mul_sparse(a: Fp12, c0: Fp2, c3: Fp2, c5: Fp2) -> Fp12:
+    """Multiply by the sparse line element c0 + c3 w^3 + c5 w^5 (the
+    shape every Miller-loop line evaluation produces)."""
+    out = [F2_ZERO] * 6
+    for j, cj in ((0, c0), (3, c3), (5, c5)):
+        if cj == F2_ZERO:
+            continue
+        for i in range(6):
+            ai = a[i]
+            if ai == F2_ZERO:
+                continue
+            t = f2_mul(ai, cj)
+            k = i + j
+            if k >= 6:
+                k -= 6
+                t = f2_mul_xi(t)
+            out[k] = f2_add(out[k], t)
+    return tuple(out)
+
+
+def _poly_xgcd_inverse(a: Fp12) -> Fp12:
+    """Invert a as a polynomial in Fp2[x] modulo x^6 - XI (extended
+    Euclid). Only used once per final exponentiation — correctness over
+    speed."""
+    mod = [f2_neg(XI), F2_ZERO, F2_ZERO, F2_ZERO, F2_ZERO, F2_ZERO, F2_ONE]
+
+    def deg(p):
+        for i in range(len(p) - 1, -1, -1):
+            if p[i] != F2_ZERO:
+                return i
+        return -1
+
+    def trim(p):
+        d = deg(p)
+        return list(p[: d + 1]) if d >= 0 else []
+
+    r0, r1 = trim(mod), trim(list(a))
+    s0, s1 = [], [F2_ONE]
+    while r1:
+        d0, d1 = deg(r0), deg(r1)
+        if d0 < d1:
+            r0, r1, s0, s1 = r1, r0, s1, s0
+            continue
+        lead = f2_mul(r0[d0], f2_inv(r1[d1]))
+        shift = d0 - d1
+        nr = list(r0)
+        for i, c in enumerate(r1):
+            nr[i + shift] = f2_sub(nr[i + shift], f2_mul(lead, c))
+        ns = list(s0) + [F2_ZERO] * max(0, d1 + shift + 1 - len(s0))
+        for i, c in enumerate(s1):
+            if i + shift < len(ns):
+                ns[i + shift] = f2_sub(ns[i + shift], f2_mul(lead, c))
+            else:
+                ns.append(f2_neg(f2_mul(lead, c)))
+        r0, s0 = trim(nr), ns
+        if deg(r0) < deg(r1):
+            r0, r1, s0, s1 = r1, r0, s1, s0
+    # r0 is the gcd (a nonzero constant for invertible a)
+    if deg(r0) != 0:
+        raise ZeroDivisionError("Fp12 element is not invertible")
+    c = f2_inv(r0[0])
+    out = [f2_mul(c, s) for s in s0[:6]]
+    out += [F2_ZERO] * (6 - len(out))
+    return tuple(out)
+
+
+def f12_inv(a: Fp12) -> Fp12:
+    return _poly_xgcd_inverse(a)
+
+
+def f12_pow(a: Fp12, e: int) -> Fp12:
+    if e < 0:
+        raise ValueError("negative exponent")
+    out = F12_ONE
+    base = a
+    while e:
+        if e & 1:
+            out = f12_mul(out, base)
+        base = f12_sqr(base)
+        e >>= 1
+    return out
+
+
+# --- Frobenius maps ----------------------------------------------------
+# (sum c_i w^i)^(p^k) = sum c_i^(p^k) * GAMMA_k[i] * w^i with
+# GAMMA_k[i] = XI^(i * (p^k - 1) / 6); c^(p^k) is an Fp2 conjugation for
+# odd k and the identity for even k. All tables derived at import.
+
+
+def _gamma(k: int):
+    e = (P**k - 1) // 6
+    return tuple(f2_pow(XI, (i * e) % (P * P - 1)) for i in range(6))
+
+
+_G1 = _gamma(1)
+_G2 = _gamma(2)
+_G3 = _gamma(3)
+_G6 = _gamma(6)
+
+
+def f12_frob1(a: Fp12) -> Fp12:
+    return tuple(f2_mul(f2_conj(a[i]), _G1[i]) for i in range(6))
+
+
+def f12_frob2(a: Fp12) -> Fp12:
+    return tuple(f2_mul(a[i], _G2[i]) for i in range(6))
+
+
+def f12_frob3(a: Fp12) -> Fp12:
+    return tuple(f2_mul(f2_conj(a[i]), _G3[i]) for i in range(6))
+
+
+def f12_conj6(a: Fp12) -> Fp12:
+    """a^(p^6). For elements of the cyclotomic subgroup (every
+    post-easy-part value) this is the multiplicative INVERSE, which is
+    what makes negative-x exponentiation cheap."""
+    return tuple(f2_mul(a[i], _G6[i]) for i in range(6))

@@ -706,6 +706,15 @@ class JAXBatchVerifier(BatchVerifier):
     def _verify(self):
         if not self._items:
             return []
+        if any(len(p) != 32 for _, _, p in self._items):
+            # non-Ed25519 triples (e.g. 48-byte BLS pubkeys): this
+            # kernel is Ed25519-specific — serial host dispatch instead
+            from ..batch import CPUBatchVerifier
+
+            inner = CPUBatchVerifier()
+            for m, s, p in self._items:
+                inner.add(m, s, p)
+            return inner._verify()
         msgs = [m for m, _, _ in self._items]
         sigs = [s for _, s, _ in self._items]
         pks = [p for _, _, p in self._items]

@@ -163,9 +163,37 @@ class PrivKeyEd25519(PrivKey):
 TYPE_ED25519 = 0x01
 TYPE_SECP256K1 = 0x02
 TYPE_MULTISIG = 0x03
+TYPE_BLS12381 = 0x04
+
+# key-type names accepted by genesis / priv_validator / [crypto] config
+KEY_TYPE_ED25519 = "ed25519"
+KEY_TYPE_BLS12381 = "bls12381"
+
+
+def generate_priv_key(key_type: str = KEY_TYPE_ED25519) -> PrivKey:
+    """Key-type registry entry point for config/CLI plumbing."""
+    if key_type == KEY_TYPE_ED25519:
+        return PrivKeyEd25519.generate()
+    if key_type == KEY_TYPE_BLS12381:
+        from .bls import PrivKeyBLS12381
+
+        return PrivKeyBLS12381.generate()
+    raise ValueError(
+        f"unknown key type {key_type!r}; have "
+        f"{KEY_TYPE_ED25519!r}, {KEY_TYPE_BLS12381!r}")
+
+
+def key_type_of(pk) -> str:
+    """Canonical key-type name of a PubKey or PrivKey instance."""
+    from .bls import PrivKeyBLS12381, PubKeyBLS12381
+
+    if isinstance(pk, (PubKeyBLS12381, PrivKeyBLS12381)):
+        return KEY_TYPE_BLS12381
+    return KEY_TYPE_ED25519
 
 
 def pubkey_to_bytes(pk: PubKey) -> bytes:
+    from .bls import PubKeyBLS12381
     from .multisig import PubKeyMultisigThreshold
     from .secp256k1 import PubKeySecp256k1
 
@@ -175,6 +203,8 @@ def pubkey_to_bytes(pk: PubKey) -> bytes:
         return bytes([TYPE_SECP256K1]) + pk.data
     if isinstance(pk, PubKeyMultisigThreshold):
         return bytes([TYPE_MULTISIG]) + pk.bytes()
+    if isinstance(pk, PubKeyBLS12381):
+        return bytes([TYPE_BLS12381]) + pk.data
     raise TypeError(f"unknown pubkey type {type(pk)}")
 
 
@@ -191,16 +221,23 @@ def pubkey_from_bytes(data: bytes) -> PubKey:
         from .multisig import PubKeyMultisigThreshold
 
         return PubKeyMultisigThreshold.from_bytes(data[1:])
+    if data[0] == TYPE_BLS12381:
+        from .bls import PubKeyBLS12381
+
+        return PubKeyBLS12381(data[1:])
     raise ValueError(f"unknown pubkey type tag {data[0]:#x}")
 
 
 def privkey_to_bytes(sk: PrivKey) -> bytes:
+    from .bls import PrivKeyBLS12381
     from .secp256k1 import PrivKeySecp256k1
 
     if isinstance(sk, PrivKeyEd25519):
         return bytes([TYPE_ED25519]) + sk.data
     if isinstance(sk, PrivKeySecp256k1):
         return bytes([TYPE_SECP256K1]) + sk.data
+    if isinstance(sk, PrivKeyBLS12381):
+        return bytes([TYPE_BLS12381]) + sk.data
     raise TypeError(f"unknown privkey type {type(sk)}")
 
 
@@ -213,4 +250,8 @@ def privkey_from_bytes(data: bytes) -> PrivKey:
         from .secp256k1 import PrivKeySecp256k1
 
         return PrivKeySecp256k1(data[1:])
+    if data[0] == TYPE_BLS12381:
+        from .bls import PrivKeyBLS12381
+
+        return PrivKeyBLS12381(data[1:])
     raise ValueError(f"unknown privkey type tag {data[0]:#x}")

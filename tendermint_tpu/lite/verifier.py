@@ -40,7 +40,8 @@ class ErrTooMuchChange(ErrLiteVerification):
 def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
                             signed_header: SignedHeader,
                             trust_fraction_num: int = 2,
-                            trust_fraction_den: int = 3) -> None:
+                            trust_fraction_den: int = 3,
+                            commit_vals: ValidatorSet = None) -> None:
     """types/validator_set.go VerifyFutureCommit-style check: >2/3 of
     OUR trusted set must have signed the new header (used while
     stepping across valset changes, validator_set.go:409-434; the
@@ -49,8 +50,42 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
     signers like the reference's seen-map."""
     from ..crypto import batch
     from ..types.basic import VOTE_TYPE_PRECOMMIT
+    from ..types.block import AggregateCommit
 
     commit = signed_header.commit
+    if isinstance(commit, AggregateCommit):
+        # BLS fast lane: the certificate's bitmap indexes the COMMIT's
+        # own valset (hash-checked against the header by validate_full),
+        # so the caller must supply it; signature validity is ONE
+        # fast_aggregate_verify, then the trusted-power tally walks the
+        # bitmap-selected addresses that are also in OUR set.
+        if commit_vals is None:
+            raise ErrLiteVerification(
+                "aggregate commit requires the commit's validator set")
+        try:
+            commit_vals.verify_commit_aggregate(
+                chain_id, commit.block_id, signed_header.height, commit)
+        except ErrInvalidCommit as e:
+            raise ErrLiteVerification(str(e))
+        tallied = 0
+        for idx, val in enumerate(commit_vals.validators):
+            if not commit.signers.get_index(idx):
+                continue
+            _, ours = vals.get_by_address(val.address)
+            # the PUBKEY must match our trusted entry, not just the
+            # address: addresses arrive verbatim on the wire, so a
+            # malicious source could pair its own keys (which signed the
+            # aggregate) with OUR validators' addresses and inherit
+            # their power. The aggregate was verified over commit_vals'
+            # pubkeys — power only counts where that pubkey IS the
+            # trusted one.
+            if ours is not None and ours.pub_key == val.pub_key:
+                tallied += ours.voting_power
+        total = vals.total_voting_power()
+        if tallied * trust_fraction_den <= total * trust_fraction_num:
+            raise ErrTooMuchChange(
+                f"too little trusted power signed: {tallied}/{total}")
+        return
     bv = batch.new_batch_verifier()
     entries = []
     seen = set()
@@ -224,7 +259,8 @@ class DynamicVerifier:
                 # claimed valset signed it
                 _verify_commit_trusting(
                     trusted_fc.next_validators or trusted_fc.validators,
-                    self.chain_id, source_fc.signed_header)
+                    self.chain_id, source_fc.signed_header,
+                    commit_vals=source_fc.validators)
                 _validate_full(source_fc, self.chain_id)
                 BaseVerifier(
                     self.chain_id, source_fc.height, source_fc.validators,

@@ -53,6 +53,9 @@ class ConsensusMetrics:
     # failure) by consensus/wal.py iter_messages — an operator signal
     # that the disk is eating records, not a code path that can recover
     wal_corrupted: object = NOP
+    # Handel-lite lane: gossiped aggregate precommit certificates that
+    # verified and advanced our running aggregate (merged)
+    agg_gossip_merges: object = NOP
 
 
 @dataclass
@@ -81,6 +84,13 @@ class CryptoMetrics:
     # wall time a caller overlapped with an in-flight async batch
     # (submit -> first result() call, capped at batch completion)
     pipeline_overlap_seconds: object = NOP
+    # BLS aggregate fast lane (crypto/bls): wall time of one
+    # fast_aggregate_verify (MSM + pairing check) and signers per call
+    agg_verify_seconds: object = NOP
+    agg_signers: object = NOP
+    # wire size of the last aggregate commit certificate seen/produced
+    # (constant bitmap+96B vs 64B x N — the fast lane's bandwidth story)
+    agg_commit_size_bytes: object = NOP
 
 
 @dataclass
@@ -270,6 +280,10 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_wal_corrupted_records_total",
             "WAL records dropped due to corruption (bad CRC/length/"
             "decode)."),
+        agg_gossip_merges=r.counter(
+            f"{ns}_consensus_agg_gossip_merges_total",
+            "Gossiped aggregate precommit certificates merged into the "
+            "running aggregate (BLS fast lane)."),
     )
     p2p = P2PMetrics(
         peers=r.gauge(f"{ns}_p2p_peers", "Number of connected peers."),
@@ -406,6 +420,19 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Wall time callers overlapped with an in-flight async batch.",
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05,
                      0.1, 0.25, 1)),
+        agg_verify_seconds=r.histogram(
+            f"{ns}_crypto_agg_verify_seconds",
+            "Wall time of one BLS fast_aggregate_verify (bitmap MSM + "
+            "pairing check).",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5)),
+        agg_signers=r.histogram(
+            f"{ns}_crypto_agg_signers",
+            "Signers covered by one BLS aggregate verification.",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384)),
+        agg_commit_size_bytes=r.gauge(
+            f"{ns}_agg_commit_size_bytes",
+            "Wire size of the latest aggregate commit certificate "
+            "(signer bitmap + one 96-byte signature)."),
     )
     statesync = StateSyncMetrics(
         snapshots=r.gauge(

@@ -691,7 +691,8 @@ def default_new_node(config: cfg.Config) -> Node:
         LOG.info("waiting for remote signer on %s", pv.listen_addr)
         pv.accept()
     else:
-        pv = load_or_gen_file_pv(config.base.priv_validator_path())
+        pv = load_or_gen_file_pv(config.base.priv_validator_path(),
+                                 key_type=config.crypto.key_type)
     genesis_doc = GenesisDoc.load(config.base.genesis_path())
     creator = default_client_creator(
         config.base.proxy_app, config.base.abci,

@@ -15,7 +15,14 @@ import os
 import threading
 from typing import Optional
 
-from ..crypto import PrivKeyEd25519, pubkey_from_bytes, pubkey_to_bytes
+from ..crypto import (
+    PrivKey,
+    PrivKeyEd25519,
+    privkey_from_bytes,
+    pubkey_from_bytes,
+    pubkey_to_bytes,
+)
+from ..crypto.keys import KEY_TYPE_ED25519, generate_priv_key
 from ..types.basic import (
     VOTE_TYPE_PRECOMMIT,
     VOTE_TYPE_PREVOTE,
@@ -47,7 +54,7 @@ class FilePV:
     """Implements the PrivValidator interface (types/priv_validator.go):
     get_pub_key / sign_vote / sign_proposal."""
 
-    def __init__(self, priv_key: PrivKeyEd25519, file_path: Optional[str] = None):
+    def __init__(self, priv_key: PrivKey, file_path: Optional[str] = None):
         self.priv_key = priv_key
         self.file_path = file_path
         self.last_height = 0
@@ -160,11 +167,20 @@ class FilePV:
     # --- persistence --------------------------------------------------------
 
     def to_json(self) -> str:
+        # Ed25519 keys keep the legacy raw-64-byte spelling (existing
+        # priv_validator.json files stay loadable byte-for-byte); other
+        # key types (bls12381) persist type-tagged
+        if isinstance(self.priv_key, PrivKeyEd25519):
+            raw = self.priv_key.bytes().hex()
+        else:
+            from ..crypto import privkey_to_bytes
+
+            raw = privkey_to_bytes(self.priv_key).hex()
         return json.dumps(
             {
                 "address": self.get_address().hex(),
                 "pub_key": pubkey_to_bytes(self.get_pub_key()).hex(),
-                "priv_key": self.priv_key.bytes().hex(),
+                "priv_key": raw,
                 "last_height": self.last_height,
                 "last_round": self.last_round,
                 "last_step": self.last_step,
@@ -188,7 +204,11 @@ class FilePV:
     def load(cls, file_path: str) -> "FilePV":
         with open(file_path) as f:
             o = json.load(f)
-        pv = cls(PrivKeyEd25519(bytes.fromhex(o["priv_key"])), file_path)
+        raw = bytes.fromhex(o["priv_key"])
+        # legacy files hold the raw 64-byte Ed25519 key; anything else
+        # is type-tagged (crypto.keys registry)
+        key = PrivKeyEd25519(raw) if len(raw) == 64 else privkey_from_bytes(raw)
+        pv = cls(key, file_path)
         pv.last_height = o.get("last_height", 0)
         pv.last_round = o.get("last_round", 0)
         pv.last_step = o.get("last_step", 0)
@@ -197,8 +217,9 @@ class FilePV:
         return pv
 
     @classmethod
-    def generate(cls, file_path: Optional[str] = None) -> "FilePV":
-        pv = cls(PrivKeyEd25519.generate(), file_path)
+    def generate(cls, file_path: Optional[str] = None,
+                 key_type: str = KEY_TYPE_ED25519) -> "FilePV":
+        pv = cls(generate_priv_key(key_type), file_path)
         pv.save()
         return pv
 
@@ -216,11 +237,14 @@ class FilePV:
         return f"FilePV{{{self.get_address().hex()[:12]} LH:{self.last_height} LR:{self.last_round} LS:{self.last_step}}}"
 
 
-def load_or_gen_file_pv(file_path: str) -> FilePV:
-    """Reference privval/priv_validator.go:108 LoadOrGenFilePV."""
+def load_or_gen_file_pv(file_path: str,
+                        key_type: str = KEY_TYPE_ED25519) -> FilePV:
+    """Reference privval/priv_validator.go:108 LoadOrGenFilePV.
+    key_type ([crypto] config) applies only when generating — an
+    existing file keeps whatever key it holds."""
     if os.path.exists(file_path):
         return FilePV.load(file_path)
-    return FilePV.generate(file_path)
+    return FilePV.generate(file_path, key_type=key_type)
 
 
 def _vote_only_differs_by_timestamp(chain_id: str, last_sign_bytes: bytes, vote: Vote):

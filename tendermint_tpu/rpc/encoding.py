@@ -68,6 +68,17 @@ def vote_json(v) -> Optional[dict]:
 def commit_json(c) -> Optional[dict]:
     if c is None:
         return None
+    from ..types.block import AggregateCommit
+
+    if isinstance(c, AggregateCommit):
+        return {
+            "block_id": block_id_json(c.block_id),
+            "height": str(c.agg_height),
+            "round": str(c.agg_round),
+            "signers": b64(c.signers.to_bytes()),
+            "signers_bits": c.signers.size(),
+            "aggregate_signature": b64(c.agg_sig),
+        }
     return {
         "block_id": block_id_json(c.block_id),
         "precommits": [vote_json(v) for v in c.precommits],
@@ -153,11 +164,23 @@ def vote_from_json(o) -> Optional["Vote"]:
     )
 
 
-def commit_from_json(o) -> Optional["Commit"]:
+def commit_from_json(o):
     from ..types.block import Commit
 
     if o is None:
         return None
+    if "aggregate_signature" in o:
+        from ..libs.bit_array import BitArray
+        from ..types.block import AggregateCommit
+
+        return AggregateCommit(
+            block_id=block_id_from_json(o["block_id"]),
+            agg_height=int(o["height"]),
+            agg_round=int(o["round"]),
+            signers=BitArray.from_bytes_size(unb64(o["signers"]),
+                                             int(o["signers_bits"])),
+            agg_sig=unb64(o["aggregate_signature"]),
+        )
     return Commit(
         block_id=block_id_from_json(o["block_id"]),
         precommits=[vote_from_json(v) for v in o["precommits"]],
@@ -168,8 +191,14 @@ def validator_from_json(o) -> "Validator":
     from ..crypto.keys import PubKeyEd25519
     from ..types.validator_set import Validator
 
-    v = Validator.new(PubKeyEd25519(unb64(o["pub_key"]["value"])),
-                      int(o["voting_power"]))
+    raw = unb64(o["pub_key"]["value"])
+    if len(raw) == 48:
+        from ..crypto.bls import PubKeyBLS12381
+
+        pub = PubKeyBLS12381(raw)
+    else:
+        pub = PubKeyEd25519(raw)
+    v = Validator.new(pub, int(o["voting_power"]))
     v.proposer_priority = int(o.get("proposer_priority", 0))
     return v
 

@@ -309,14 +309,21 @@ def make_last_commit_info(last_validators, block: Block) -> abci.LastCommitInfo:
     """(address, power, signed) per last validator (execution.go:277-300).
     Shared with handshake replay so replayed BeginBlocks carry the same
     vote info as original execution."""
+    from ..types.block import AggregateCommit
+
     votes = []
     if block.header.height > 1 and block.last_commit is not None and last_validators is not None:
-        for i, v in enumerate(last_validators.validators):
-            signed = (
-                i < len(block.last_commit.precommits)
-                and block.last_commit.precommits[i] is not None
-            )
-            votes.append((v.address, v.voting_power, signed))
+        if isinstance(block.last_commit, AggregateCommit):
+            signers = block.last_commit.signers
+            for i, v in enumerate(last_validators.validators):
+                votes.append((v.address, v.voting_power, signers.get_index(i)))
+        else:
+            for i, v in enumerate(last_validators.validators):
+                signed = (
+                    i < len(block.last_commit.precommits)
+                    and block.last_commit.precommits[i] is not None
+                )
+                votes.append((v.address, v.voting_power, signed))
     return abci.LastCommitInfo(round=block.last_commit.round() if block.last_commit else 0, votes=votes)
 
 

@@ -46,8 +46,13 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
         raise ErrInvalidBlock("wrong consensus_hash")
 
     # last commit (reference validation.go:100-116)
+    from ..types.block import AggregateCommit
+
+    is_agg = isinstance(block.last_commit, AggregateCommit)
     if h.height == 1:
-        if block.last_commit is not None and block.last_commit.precommits:
+        if block.last_commit is not None and (
+            is_agg or block.last_commit.precommits
+        ):
             raise ErrInvalidBlock("block at height 1 can't have LastCommit precommits")
         # block time at height 1 IS the genesis time (validation.go:126-133)
         if h.time != state.last_block_time:
@@ -55,14 +60,22 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
                 f"block time {h.time} != genesis time {state.last_block_time}"
             )
     else:
-        if block.last_commit is None or len(block.last_commit.precommits) != len(
+        if is_agg:
+            # BLS fast lane: the certificate replaces the precommit list.
+            # Size/height checks + the single-pairing verification all
+            # live in verify_commit_aggregate (via the same dispatch).
+            if state.last_validators.is_bls() is False:
+                raise ErrInvalidBlock(
+                    "aggregate LastCommit on a non-BLS validator set")
+        elif block.last_commit is None or len(block.last_commit.precommits) != len(
             state.last_validators
         ):
             got = 0 if block.last_commit is None else len(block.last_commit.precommits)
             raise ErrInvalidBlock(
                 f"wrong LastCommit size {got}, expected {len(state.last_validators)}"
             )
-        # ★ batched signature verification (TPU path)
+        # ★ batched signature verification (TPU path); AggregateCommit
+        # dispatches to the one-pairing certificate check
         state.last_validators.verify_commit(
             state.chain_id, state.last_block_id, h.height - 1, block.last_commit
         )
@@ -72,11 +85,16 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
             raise ErrInvalidBlock(
                 f"block time {h.time} not greater than last block time {state.last_block_time}"
             )
-        expected = median_time(block.last_commit, state.last_validators)
-        if h.time != expected:
-            raise ErrInvalidBlock(
-                f"invalid block time {h.time}, expected (median) {expected}"
-            )
+        if not is_agg:
+            expected = median_time(block.last_commit, state.last_validators)
+            if h.time != expected:
+                raise ErrInvalidBlock(
+                    f"invalid block time {h.time}, expected (median) {expected}"
+                )
+        # aggregate certificates carry no per-vote timestamps (identical
+        # sign-bytes are what make aggregation possible), so BFT median
+        # time degrades to the strict-monotonicity check above — the
+        # proposer's clock sets block time (PARITY_DEVIATIONS.md)
 
     # proposer must be in the current validator set (validation.go:131-138)
     if not state.validators.has_address(h.proposer_address):

@@ -129,6 +129,12 @@ class NodeStatus:
     # ("healthy" | "degraded" | "down") per proxy/resilient.py
     abci_conns: Dict[str, str] = field(default_factory=dict)
     abci_reconnects: int = 0
+    # BLS aggregate fast lane view (from /debug/consensus "agg"):
+    # whether the chain runs aggregate certificates, merged-cert count,
+    # and the last persisted certificate's wire size
+    agg_enabled: bool = False
+    agg_gossip_merges: int = 0
+    agg_cert_bytes: int = 0
     # mempool pressure view (from /debug/mempool): pool depth vs its
     # cap, per-lane depths, and the batched-preverify ingest queue —
     # a node drowning in tx load keeps answering /status while every
@@ -205,6 +211,9 @@ class NodeStatus:
         self._restore_progress_at = 0.0
         self.abci_conns = {}
         self.abci_reconnects = 0
+        self.agg_enabled = False
+        self.agg_gossip_merges = 0
+        self.agg_cert_bytes = 0
         self.mempool_size = 0
         self.mempool_max = 0
         self.mempool_bytes = 0
@@ -338,6 +347,10 @@ class Monitor:
         peers = (data.get("live") or {}).get("peers", [])
         ns.max_peer_lag = max(
             (int(p.get("lag_blocks", 0)) for p in peers), default=0)
+        agg = (data.get("live") or {}).get("agg") or {}
+        ns.agg_enabled = bool(agg.get("enabled", False))
+        ns.agg_gossip_merges = int(agg.get("gossip_merges", 0))
+        ns.agg_cert_bytes = int(agg.get("last_cert_bytes", 0))
         # the statesync and abci scrapes are independent: a failure of
         # either (older node, transient timeout) must reset ONLY its own
         # view — never leave the other's stale flags pinning health()
@@ -474,6 +487,9 @@ class Monitor:
                     "abci_conns": dict(n.abci_conns),
                     "abci_degraded": n.abci_degraded,
                     "abci_reconnects": n.abci_reconnects,
+                    "agg_enabled": n.agg_enabled,
+                    "agg_gossip_merges": n.agg_gossip_merges,
+                    "agg_cert_bytes": n.agg_cert_bytes,
                     "mempool_size": n.mempool_size,
                     "mempool_max": n.mempool_max,
                     "mempool_bytes": n.mempool_bytes,

@@ -132,6 +132,97 @@ class Commit:
 
 
 @dataclass
+class AggregateCommit:
+    """O(1) commit certificate for BLS12-381-keyed validator sets: the
+    signer bitmap plus ONE 96-byte aggregate signature (no reference
+    equivalent; the aggregate-signature fast lane's wire/store form).
+
+    Every signer's precommit for (height, round, block_id) covers
+    identical sign-bytes — BLS-lane votes carry timestamp 0 (see
+    MIGRATION.md) — so the certificate verifies with one
+    fast_aggregate_verify over the bitmap-selected pubkeys, replacing
+    N per-vote signature checks AND N×64 wire bytes with
+    ceil(N/8) + 96. Duck-types the Commit query surface (height/round/
+    size/bit_array/validate_basic/hash) used by stores, gossip, and
+    verification; it deliberately has NO .precommits — every consumer
+    branches explicitly so the plain per-vote path stays byte-for-byte
+    untouched."""
+
+    block_id: BlockID
+    agg_height: int
+    agg_round: int
+    signers: "object"  # libs.bit_array.BitArray
+    agg_sig: bytes  # 96-byte compressed G2 aggregate
+
+    def height(self) -> int:
+        return self.agg_height
+
+    def round(self) -> int:
+        return self.agg_round
+
+    def size(self) -> int:
+        return self.signers.size()
+
+    def is_commit(self) -> bool:
+        return self.signers.num_true() > 0
+
+    def bit_array(self):
+        return self.signers.copy()
+
+    def num_signers(self) -> int:
+        return self.signers.num_true()
+
+    def num_absent(self) -> int:
+        return self.signers.size() - self.signers.num_true()
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        """The single message every signer covered (precommit canonical
+        sign-bytes with timestamp 0)."""
+        from .basic import canonical_vote_sign_bytes
+
+        return canonical_vote_sign_bytes(
+            chain_id, VOTE_TYPE_PRECOMMIT, self.agg_height, self.agg_round,
+            self.block_id, 0,
+        )
+
+    def validate_basic(self) -> None:
+        if self.block_id.is_zero():
+            raise ValueError("aggregate commit has zero block id")
+        if self.signers.size() == 0 or self.signers.num_true() == 0:
+            raise ValueError("aggregate commit has no signers")
+        if len(self.agg_sig) != 96:
+            raise ValueError("aggregate commit signature must be 96 bytes")
+        if self.agg_height <= 0:
+            raise ValueError("aggregate commit height must be positive")
+        if self.agg_round < 0:
+            raise ValueError("aggregate commit round must be non-negative")
+
+    def encode(self) -> bytes:
+        return (
+            codec.t_message(1, self.block_id.encode())
+            + codec.t_fixed64(2, self.agg_height)
+            + codec.t_fixed64(3, self.agg_round)
+            + codec.t_uvarint(4, self.signers.size())
+            + codec.t_bytes(5, self.signers.to_bytes())
+            + codec.t_bytes(6, self.agg_sig)
+        )
+
+    def size_bytes(self) -> int:
+        """Certificate wire size — the constant-vs-64×N story the
+        agg_commit_size_bytes gauge reports."""
+        return len(self.encode())
+
+    def hash(self) -> bytes:
+        return tmhash.sum(self.encode())
+
+    def __str__(self):
+        return (
+            f"AggregateCommit{{{self.agg_height}/{self.agg_round} "
+            f"{self.num_signers()}/{self.size()} {self.block_id}}}"
+        )
+
+
+@dataclass
 class EvidenceData:
     evidence: list = dc_field(default_factory=list)
 

@@ -68,6 +68,21 @@ class GenesisValidator:
     pub_key: PubKey
     power: int
     name: str = ""
+    # BLS12-381 keys must prove possession (rogue-key defense for the
+    # aggregate fast lane): 96-byte PoP signature over the pubkey bytes,
+    # verified + registered by validate_and_complete. Empty for Ed25519.
+    pop: bytes = b""
+
+
+def genesis_validator_for(priv_key, power: int, name: str = "") -> "GenesisValidator":
+    """Build a GenesisValidator from a private key, attaching the proof
+    of possession BLS keys require (no-op for other key types)."""
+    gv = GenesisValidator(priv_key.pub_key(), power, name)
+    from ..crypto import bls
+
+    if isinstance(priv_key, bls.PrivKeyBLS12381):
+        gv.pop = bls.pop_prove(priv_key)
+    return gv
 
 
 @dataclass
@@ -88,6 +103,38 @@ class GenesisDoc:
         for i, v in enumerate(self.validators):
             if v.power == 0:
                 raise ValueError(f"genesis validator {i} has zero voting power")
+        self._validate_key_types()
+
+    def _validate_key_types(self) -> None:
+        """The aggregate fast lane is all-or-nothing per chain: a valset
+        mixing BLS and non-BLS keys cannot form one certificate, so
+        mixed genesis docs are rejected outright (MIGRATION.md). BLS
+        validators must additionally carry a verifying proof of
+        possession, which is registered process-wide here."""
+        if not self.validators:
+            return
+        from ..crypto import bls
+
+        kinds = {isinstance(v.pub_key, bls.PubKeyBLS12381)
+                 for v in self.validators}
+        if kinds == {True, False}:
+            raise ValueError(
+                "genesis validator set mixes bls12381 and non-BLS key "
+                "types; the aggregate-signature lane is per-chain — use "
+                "one key type for every validator (see MIGRATION.md "
+                "[crypto] key_type)")
+        if kinds == {True}:
+            for i, v in enumerate(self.validators):
+                if not v.pop:
+                    raise ValueError(
+                        f"genesis validator {i} has a bls12381 key but no "
+                        "proof of possession (pop); aggregate verification "
+                        "would be rogue-key-attackable without it")
+                if not bls.register_proof_of_possession(v.pub_key.bytes(),
+                                                        v.pop):
+                    raise ValueError(
+                        f"genesis validator {i} proof of possession does "
+                        "not verify")
 
     def validator_set_validators(self) -> List[Validator]:
         return [Validator.new(v.pub_key, v.power) for v in self.validators]
@@ -109,6 +156,7 @@ class GenesisDoc:
                         "pub_key": pubkey_to_bytes(v.pub_key).hex(),
                         "power": v.power,
                         "name": v.name,
+                        **({"pop": v.pop.hex()} if v.pop else {}),
                     }
                     for v in self.validators
                 ],
@@ -137,6 +185,7 @@ class GenesisDoc:
                     pub_key=pubkey_from_bytes(bytes.fromhex(v["pub_key"])),
                     power=v["power"],
                     name=v.get("name", ""),
+                    pop=bytes.fromhex(v.get("pop", "")),
                 )
                 for v in o.get("validators", [])
             ],

@@ -101,15 +101,30 @@ def proposal_from(o) -> Proposal:
     )
 
 
-def commit_obj(c: Optional[Commit]):
+def commit_obj(c):
     if c is None:
         return None
+    from .block import AggregateCommit
+
+    if isinstance(c, AggregateCommit):
+        # tagged form: a plain Commit's first element is a block-id obj
+        # (a list), so the string tag is unambiguous on decode
+        return ["AGG", block_id_obj(c.block_id), c.agg_height, c.agg_round,
+                c.signers.size(), c.signers.to_bytes(), c.agg_sig]
     return [block_id_obj(c.block_id), [vote_obj(v) for v in c.precommits]]
 
 
-def commit_from(o) -> Optional[Commit]:
+def commit_from(o):
     if o is None:
         return None
+    if isinstance(o[0], str) and o[0] == "AGG":
+        from ..libs.bit_array import BitArray
+        from .block import AggregateCommit
+
+        return AggregateCommit(
+            block_id=block_id_from(o[1]), agg_height=o[2], agg_round=o[3],
+            signers=BitArray.from_bytes_size(o[5], o[4]), agg_sig=o[6],
+        )
     return Commit(block_id=block_id_from(o[0]), precommits=[vote_from(v) for v in o[1]])
 
 

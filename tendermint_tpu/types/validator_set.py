@@ -220,6 +220,26 @@ class ValidatorSet:
 
         return merkle.hash_from_byte_slices([v.hash_bytes() for v in self.validators])
 
+    def is_bls(self) -> bool:
+        """True when every validator key is BLS12-381 — the aggregate
+        fast lane's opt-in switch (mixed sets are rejected at genesis).
+        Cached: hot paths (gossip ticks, vote signing, VoteSet
+        construction) query this per call, and at mega-committee sizes
+        an O(N) isinstance scan per query is real interpreter time.
+        getattr-with-default keeps instances built via __new__ (copy,
+        serde) safe; update_with_changes invalidates."""
+        cached = getattr(self, "_is_bls_cache", None)
+        if cached is not None:
+            return cached
+        if not self.validators:
+            return False  # not cached: an empty set may still be grown
+        from ..crypto.bls import PubKeyBLS12381
+
+        result = all(isinstance(v.pub_key, PubKeyBLS12381)
+                     for v in self.validators)
+        self._is_bls_cache = result
+        return result
+
     # --- commit verification (north-star call site #1) ---------------------
 
     def verify_commit(self, chain_id: str, block_id: BlockID, height: int, commit) -> None:
@@ -227,11 +247,66 @@ class ValidatorSet:
         ErrInvalidCommit subclasses on failure.
 
         Reference types/validator_set.go:330-378, except the per-signature
-        loop becomes one BatchVerifier call (TPU-batched).
+        loop becomes one BatchVerifier call (TPU-batched). An
+        AggregateCommit certificate (BLS fast lane) instead routes to
+        verify_commit_aggregate: ONE pairing check regardless of
+        committee size.
         """
+        from .block import AggregateCommit
+
+        if isinstance(commit, AggregateCommit):
+            self.verify_commit_aggregate(chain_id, block_id, height, commit)
+            return
         bv, entries = self._prepare_commit_verify(chain_id, block_id, height, commit)
         mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
         self._finish_commit_verify(mask, psum_tally, entries, block_id)
+
+    def verify_commit_aggregate(self, chain_id: str, block_id: BlockID,
+                                height: int, commit) -> None:
+        """Verify an AggregateCommit: structural checks, the voting-power
+        tally over the signer bitmap, then ONE fast_aggregate_verify
+        (bitmap->aggregate-pubkey MSM + a 2-pairing product check)
+        instead of N signature checks.
+
+        PoP note: rogue-key safety for the aggregate check rests on
+        proof-of-possession at key REGISTRATION time (genesis validation
+        / the app's validator updates); a valset reaching this method is
+        hash-chained from that trust root, so the per-call registry
+        check is skipped (require_pop=False)."""
+        from ..crypto import batch as crypto_batch
+        from ..crypto import bls
+
+        if commit.signers.size() != len(self.validators):
+            raise ErrInvalidCommit(
+                f"invalid aggregate commit: {commit.signers.size()} signer "
+                f"bits for {len(self.validators)} validators")
+        if height != commit.height():
+            raise ErrInvalidCommit(
+                f"invalid aggregate commit height {commit.height()} != {height}")
+        if commit.block_id != block_id:
+            raise ErrInvalidCommit(
+                f"invalid aggregate commit block id {commit.block_id} != {block_id}")
+        pubkeys = []
+        tallied = 0
+        for idx in range(len(self.validators)):
+            if commit.signers.get_index(idx):
+                val = self.validators[idx]
+                pubkeys.append(val.pub_key.bytes())
+                tallied += val.voting_power
+        # cheap power gate FIRST: an under-powered certificate must not
+        # cost a pairing
+        if 3 * tallied <= 2 * self.total_voting_power():
+            raise ErrNotEnoughVotingPower(
+                f"invalid aggregate commit: tallied {tallied} <= 2/3 of "
+                f"{self.total_voting_power()}")
+        msg = commit.sign_bytes(chain_id)
+        if not bls.fast_aggregate_verify(pubkeys, msg, commit.agg_sig,
+                                         require_pop=False):
+            raise ErrInvalidCommitSignatures(
+                f"invalid aggregate signature over {len(pubkeys)} signers")
+        m = crypto_batch.get_metrics()
+        if m is not None:
+            m.agg_commit_size_bytes.set(commit.size_bytes())
 
     def begin_verify_commit(
         self, chain_id: str, block_id: BlockID, height: int, commit
@@ -244,7 +319,19 @@ class ValidatorSet:
         on-device while block k applies on the host. When async dispatch
         is disabled the whole verification runs synchronously here and
         .result() just replays the outcome. (The multi-device psum tally
-        path is sync-only; the host tally is authoritative either way.)"""
+        path is sync-only; the host tally is authoritative either way.)
+
+        AggregateCommit certificates verify synchronously (one pairing —
+        there is no batch to overlap); the pending handle just replays
+        the outcome."""
+        from .block import AggregateCommit
+
+        if isinstance(commit, AggregateCommit):
+            try:
+                self.verify_commit_aggregate(chain_id, block_id, height, commit)
+            except ErrInvalidCommit as e:
+                return PendingCommitVerify(exc=e)
+            return PendingCommitVerify()
         bv, entries = self._prepare_commit_verify(chain_id, block_id, height, commit)
         if entries and batch.async_enabled():
             fut = bv.verify_async()
@@ -381,6 +468,7 @@ class ValidatorSet:
                 by_addr[c.address] = nv
         self.validators = sorted(by_addr.values(), key=lambda v: v.address)
         self._total = None
+        self._is_bls_cache = None
         if self.proposer is not None and self.proposer.address not in by_addr:
             self.proposer = None
         self.total_voting_power()
@@ -396,6 +484,21 @@ def random_validator_set(n: int, power: int = 10):
     from ..crypto import PrivKeyEd25519
 
     keys = [PrivKeyEd25519.generate() for _ in range(n)]
+    vals = [Validator.new(k.pub_key(), power) for k in keys]
+    vs = ValidatorSet(vals)
+    keys_sorted = sorted(keys, key=lambda k: k.pub_key().address())
+    return vs, keys_sorted
+
+
+def random_bls_validator_set(n: int, power: int = 10, seed: bytes = b"bls"):
+    """BLS-keyed fixture for the aggregate fast lane: deterministic keys
+    (pairing-grade keygen is ~10ms/key, so fixtures stay cheap and
+    cacheable). Returns (ValidatorSet, [PrivKeyBLS12381] sorted to
+    match)."""
+    from ..crypto.bls import PrivKeyBLS12381
+
+    keys = [PrivKeyBLS12381.gen_from_secret(seed + b"-%d" % i)
+            for i in range(n)]
     vals = [Validator.new(k.pub_key(), power) for k in keys]
     vs = ValidatorSet(vals)
     keys_sorted = sorted(keys, key=lambda k: k.pub_key().address())

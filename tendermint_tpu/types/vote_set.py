@@ -40,6 +40,21 @@ class VoteSet:
         self._votes_by_block: Dict[bytes, "_BlockVotes"] = {}
         # peer id -> block key they claim has 2/3 (reference peerMaj23s)
         self._peer_maj23s: Dict[str, bytes] = {}
+        # BLS aggregate lane (precommit sets over BLS valsets only):
+        # block key -> running (signer bits, aggregate G2 point, power),
+        # grown incrementally from individual votes and absorbed gossip
+        # certificates so make_commit / gossip compose in O(1)
+        from .basic import VOTE_TYPE_PRECOMMIT as _PC
+
+        self._agg_enabled = type_ == _PC and n > 0 and val_set.is_bls()
+        self._agg: Dict[bytes, "_AggState"] = {}
+        # failed-certificate memo: a certificate that failed its pairing
+        # check is remembered (bounded) so a replaying/flooding peer
+        # costs a sha256 per repeat instead of ~90ms of pairing — the
+        # cert-lane analogue of the verified-signature cache. Unique
+        # garbage still costs a pairing each; the p2p layer's per-peer
+        # recv flowrate caps bound that rate.
+        self._agg_rejects: set = set()
 
     def size(self) -> int:
         return len(self.val_set)
@@ -126,7 +141,7 @@ class VoteSet:
         addr, _ = self.val_set.get_by_index(idx)
         if addr != vote.validator_address:
             raise ErrVoteInvalid("validator address does not match index")
-        if len(vote.signature) != 64:
+        if len(vote.signature) not in (64, 96):  # ed25519 | bls12381
             raise ErrVoteInvalid("malformed signature")
 
     def _conflict_check(self, vote: Vote):
@@ -143,19 +158,158 @@ class VoteSet:
     def _add_verified(self, vote: Vote, power: int) -> None:
         idx = vote.validator_index
         self.votes[idx] = vote
-        self.votes_bit_array.set_index(idx, True)
-        self.sum += power
+        # a certificate may already have claimed this bit (aggregate
+        # lane); the global power sum counts each validator once
+        if not self.votes_bit_array.get_index(idx):
+            self.votes_bit_array.set_index(idx, True)
+            self.sum += power
         key = vote.block_id.key()
         bv = self._votes_by_block.get(key)
         if bv is None:
             bv = _BlockVotes(len(self.val_set))
             self._votes_by_block[key] = bv
         bv.add(idx, power)
+        if self._agg_enabled:
+            self._agg_fold_vote(vote, power)
         if (
             self.maj23 is None
             and 3 * bv.sum > 2 * self.val_set.total_voting_power()
         ):
             self.maj23 = vote.block_id
+
+    # --- BLS aggregate lane -------------------------------------------------
+
+    def _agg_state(self, key: bytes, block_id: BlockID) -> "_AggState":
+        st = self._agg.get(key)
+        if st is None:
+            st = _AggState(block_id)
+            self._agg[key] = st
+        return st
+
+    def _agg_fold_vote(self, vote: Vote, power: int) -> None:
+        """Fold one verified BLS precommit into its block's running
+        aggregate (decompression is cached process-wide in crypto.bls)."""
+        from ..crypto import bls
+        from ..crypto.bls.curve import g2_add
+
+        st = self._agg_state(vote.block_id.key(), vote.block_id)
+        idx = vote.validator_index
+        if idx in st.bits:
+            return
+        pt = bls._parse_signature_point(vote.signature)
+        if pt is None:  # verified upstream; defensive
+            return
+        st.point = g2_add(st.point, pt)
+        st.bits.add(idx)
+        st.power += power
+
+    def absorb_certificate(self, cert) -> bool:
+        """Absorb a gossiped (bitmap, aggregate-signature) precommit
+        certificate (Handel-lite lane). The certificate's aggregate
+        signature is verified over exactly its bitmap (ANY subset — no
+        quorum requirement), then merged into the running aggregate when
+        composable (disjoint, or a superset that replaces it); newly
+        covered validators join the power tallies. Returns True when
+        the certificate advanced our aggregate, False otherwise (bad
+        certificates and non-composable overlaps are just ignored —
+        per-vote gossip still makes progress)."""
+        from ..crypto import bls
+        from ..crypto.bls.curve import g2_add
+        from .block import AggregateCommit
+
+        if not self._agg_enabled or not isinstance(cert, AggregateCommit):
+            return False
+        with self._lock:
+            n = len(self.val_set)
+            if (cert.agg_height != self.height or cert.agg_round != self.round
+                    or cert.signers.size() != n):
+                return False
+            bits = {i for i in range(n) if cert.signers.get_index(i)}
+            if not bits:
+                return False
+            st = self._agg.get(cert.block_id.key())
+            have = st.bits if st is not None else set()
+            if bits <= have:
+                return False  # nothing new
+            if have and not (bits.isdisjoint(have) or bits >= have):
+                return False  # non-composable overlap; keep what we have
+            # verify the aggregate over exactly the claimed bitmap
+            # (known-bad certificates short-circuit on the memo)
+            import hashlib as _hashlib
+
+            reject_key = _hashlib.sha256(
+                cert.block_id.key() + cert.signers.to_bytes() + cert.agg_sig
+            ).digest()
+            if reject_key in self._agg_rejects:
+                return False
+            pubkeys = [self.val_set.validators[i].pub_key.bytes()
+                       for i in sorted(bits)]
+            msg = cert.sign_bytes(self.chain_id)
+            if not bls.fast_aggregate_verify(pubkeys, msg, cert.agg_sig,
+                                             require_pop=False):
+                if len(self._agg_rejects) >= 512:
+                    self._agg_rejects.clear()
+                self._agg_rejects.add(reject_key)
+                return False
+            pt = bls._parse_signature_point(cert.agg_sig)
+            power_of = {}
+            for i in bits:
+                _, val = self.val_set.get_by_index(i)
+                power_of[i] = val.voting_power
+            st = self._agg_state(cert.block_id.key(), cert.block_id)
+            if bits >= st.bits:
+                st.bits = set(bits)
+                st.point = pt
+                st.power = sum(power_of[i] for i in bits)
+            else:  # disjoint merge
+                st.point = g2_add(st.point, pt)
+                st.bits |= bits
+                st.power += sum(power_of[i] for i in bits)
+            # tally newly covered validators (each counted once globally)
+            bv = self._votes_by_block.get(cert.block_id.key())
+            if bv is None:
+                bv = _BlockVotes(n)
+                self._votes_by_block[cert.block_id.key()] = bv
+            for i in bits:
+                if not self.votes_bit_array.get_index(i):
+                    self.votes_bit_array.set_index(i, True)
+                    self.sum += power_of[i]
+                bv.add(i, power_of[i])
+            if (self.maj23 is None
+                    and 3 * bv.sum > 2 * self.val_set.total_voting_power()):
+                self.maj23 = cert.block_id
+            return True
+
+    def aggregate_certificate(self, block_id: Optional[BlockID] = None):
+        """Current best AggregateCommit for block_id (default: the maj23
+        block, else the highest-power block) — what the reactor gossips.
+        Returns None when the lane is off or nothing is aggregated."""
+        from .block import AggregateCommit
+
+        with self._lock:
+            if not self._agg_enabled or not self._agg:
+                return None
+            if block_id is None:
+                key = None
+                if self.maj23 is not None:
+                    key = self.maj23.key()
+                if key is None or key not in self._agg:
+                    key = max(self._agg, key=lambda k: self._agg[k].power)
+                st = self._agg[key]
+            else:
+                st = self._agg.get(block_id.key())
+                if st is None:
+                    return None
+            signers = BitArray(len(self.val_set))
+            for i in st.bits:
+                signers.set_index(i, True)
+            from ..crypto.bls.curve import g2_compress
+
+            return AggregateCommit(
+                block_id=st.block_id, agg_height=self.height,
+                agg_round=self.round, signers=signers,
+                agg_sig=g2_compress(st.point),
+            )
 
     # --- queries -----------------------------------------------------------
 
@@ -209,6 +363,18 @@ class VoteSet:
                 raise ValueError("cannot make commit from non-precommit VoteSet")
             if self.maj23 is None:
                 raise ValueError("cannot make commit: no 2/3 majority")
+            if self._agg_enabled:
+                # BLS fast lane: the running aggregate for the decided
+                # block IS the commit — bitmap + one 96-byte signature.
+                # Its power covers at least the tallied quorum (every
+                # tallied bit was folded when counted).
+                cert = self.aggregate_certificate(self.maj23)
+                if cert is None or 3 * self._agg[self.maj23.key()].power <= \
+                        2 * self.val_set.total_voting_power():
+                    raise ValueError(
+                        "cannot make aggregate commit: composed "
+                        "certificate below 2/3")
+                return cert
             precommits = [
                 v.copy() if v is not None and v.block_id == self.maj23 else None
                 for v in self.votes
@@ -233,3 +399,16 @@ class _BlockVotes:
         if not self.bit_array.get_index(idx):
             self.bit_array.set_index(idx, True)
             self.sum += power
+
+
+class _AggState:
+    """Running (signer bits, aggregate G2 point, power) for one block —
+    the incremental composition behind make_commit and cert gossip."""
+
+    __slots__ = ("block_id", "bits", "point", "power")
+
+    def __init__(self, block_id: BlockID):
+        self.block_id = block_id
+        self.bits: set = set()
+        self.point = None  # curve.G2Point (Jacobian); None = identity
+        self.power = 0
