@@ -220,9 +220,9 @@ class PeerState:
                 cert.agg_height, cert.agg_round, VOTE_TYPE_PRECOMMIT)
             if ba is None:
                 return
-            for i in range(cert.signers.size()):
-                if cert.signers.get_index(i):
-                    ba.set_index(i, True)
+            # bulk OR: at mega-committee sizes a per-bit set_index loop
+            # is size() lock round-trips per gossip send
+            ba.or_update(cert.signers)
 
     def agg_cert_has_news(self, cert) -> bool:
         """Does the certificate cover any signer the peer isn't known to
@@ -236,10 +236,9 @@ class PeerState:
                 # rather than re-sending every gossip tick; the per-vote
                 # path covers mismatched-round peers
                 return False
-            for i in range(cert.signers.size()):
-                if cert.signers.get_index(i) and not ba.get_index(i):
-                    return True
-            return False
+            # any signer bit the peer lacks? — one bulk numpy op, not
+            # 2×size() per-bit lock acquisitions per gossip tick
+            return not cert.signers.sub(ba).is_empty()
 
     def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
         """reactor.go:975-994."""

@@ -534,7 +534,7 @@ class ConsensusState:
             vs = rs.votes.precommits(cert.agg_round)
             if vs is None:
                 return
-            if vs.absorb_certificate(cert):
+            if vs.absorb_certificate(cert, peer_id=peer_id):
                 self.metrics.agg_gossip_merges.inc()
                 self.n_agg_merges += 1
                 LOG.debug("absorbed aggregate certificate %s from %s",
@@ -543,7 +543,7 @@ class ConsensusState:
         elif (cert.agg_height + 1 == rs.height
               and rs.last_commit is not None
               and cert.agg_round == rs.last_commit.round):
-            if rs.last_commit.absorb_certificate(cert):
+            if rs.last_commit.absorb_certificate(cert, peer_id=peer_id):
                 self.metrics.agg_gossip_merges.inc()
                 self.n_agg_merges += 1
                 if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -862,7 +862,14 @@ class ConsensusState:
             # polka for our proposal block: lock it (reference :1089-1103)
             if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
                 try:
-                    self.block_exec.validate_block(self.state, rs.proposal_block)
+                    # decided=True: +2/3 already prevoted this block, so
+                    # SUBJECTIVE proposal-time checks (the aggregate-lane
+                    # clock-drift bound) must not be re-asserted — a
+                    # clock-lagging validator that re-judged timeliness
+                    # here would abstain from a polka'd block and lose
+                    # its precommit every affected round
+                    self.block_exec.validate_block(self.state, rs.proposal_block,
+                                                   decided=True)
                 except Exception as e:
                     raise RuntimeError(f"enter_precommit: +2/3 prevoted an invalid block: {e}")
                 rs.locked_round = round_
@@ -961,7 +968,9 @@ class ConsensusState:
             if block is None or block.hash() != block_id.hash:
                 raise RuntimeError("cannot finalize: no proposal block / hash mismatch")
 
-            self.block_exec.validate_block(self.state, block)  # :1243
+            # 2/3 already precommitted this block — it is decided, so
+            # proposal-time-only checks (agg clock drift) don't apply
+            self.block_exec.validate_block(self.state, block, decided=True)  # :1243
 
             LOG.info(
                 "finalizing commit of block h=%d hash=%s txs=%d",

@@ -168,6 +168,30 @@ def pop_registered(pubkey: bytes) -> bool:
         return pubkey in _pop_registry
 
 
+_pop_verify_cache = _PointCache(4096)
+
+
+def pop_verify_cached(pubkey: bytes, proof: bytes) -> bool:
+    """pop_verify behind a bounded LRU memo, for proofs arriving on the
+    wire (lite / statesync valsets). Unlike register_proof_of_possession
+    this adds NOTHING to the process-wide registry: an untrusted source
+    streaming valsets of fresh keys with valid PoPs must not grow
+    process memory without bound, and each (key, proof) pair costs at
+    most one pairing before the memo answers replays."""
+    # length-gate BEFORE caching: the key embeds the wire-supplied
+    # proof, so an oversized proof would occupy oversized memo entries
+    # (4096 × attacker-chosen bytes); real encodings have fixed sizes
+    if len(pubkey) != BLS_PUBKEY_SIZE or len(proof) != BLS_SIGNATURE_SIZE:
+        return False
+    key = pubkey + proof
+    hit = _pop_verify_cache.get(key)
+    if hit is not None:
+        return hit
+    ok = pop_verify(pubkey, proof)
+    _pop_verify_cache.put(key, ok)
+    return ok
+
+
 def _register_pop_unchecked(pubkey: bytes) -> None:
     """Key generated locally from its secret — possession is intrinsic
     (used by PrivKeyBLS12381.pub_key so self-generated keys can always

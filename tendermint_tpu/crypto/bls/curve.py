@@ -112,7 +112,12 @@ def g1_neg(p: G1Point) -> G1Point:
 
 
 def g1_mul(p: G1Point, k: int) -> G1Point:
-    k %= R_ORDER
+    # NO reduction mod R_ORDER here (mirror g2_mul): g1_in_subgroup's
+    # [r]P == O test relies on multiplying by the FULL group order — a
+    # reduced scalar would turn it into [0]P and vacuously accept every
+    # on-curve point, disabling pubkey subgroup validation
+    if k < 0:
+        return g1_neg(g1_mul(p, -k))
     out: G1Point = None
     add = p
     while k:

@@ -78,6 +78,20 @@ class BitArray:
             ba._elems[:n] &= ~other._elems[:n]
             return ba
 
+    def or_update(self, other: "BitArray") -> None:
+        """In-place OR of other's bits into self — the bulk form of a
+        set_index loop (one numpy op instead of size() lock round-trips;
+        the aggregate-certificate gossip path marks whole bitmaps)."""
+        with self._lock:
+            n = min(self.bits, other.bits)
+            self._elems[:n] |= other._elems[:n]
+
+    def true_indices(self) -> list:
+        """Indices of all set bits — one locked numpy op instead of a
+        size() get_index scan (certificate bitmap unpacking)."""
+        with self._lock:
+            return np.flatnonzero(self._elems).tolist()
+
     def is_empty(self) -> bool:
         with self._lock:
             return not self._elems.any()

@@ -62,29 +62,86 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
         if commit_vals is None:
             raise ErrLiteVerification(
                 "aggregate commit requires the commit's validator set")
-        try:
-            commit_vals.verify_commit_aggregate(
-                chain_id, commit.block_id, signed_header.height, commit)
-        except ErrInvalidCommit as e:
-            raise ErrLiteVerification(str(e))
+        # Structural gate: no address may appear twice. A legitimate
+        # valset can't contain duplicates (ValidatorSet.__init__ rejects
+        # them) but wire decoders build sets via __new__, and a repeated
+        # trusted entry would count that validator's power once PER COPY
+        # in the tally below — one low-power trusted signer could clone
+        # itself past 2/3 (its aggregate signature is just k·sig, a
+        # public scalar multiple anyone can compute).
+        addrs = [v.address for v in commit_vals.validators]
+        if len(set(addrs)) != len(addrs):
+            raise ErrLiteVerification(
+                "aggregate commit valset contains duplicate addresses")
+        # Rogue-key gate BEFORE paying the pairing: commit_vals arrives
+        # on the wire from an untrusted source, and fast aggregate
+        # verification over attacker-chosen keys is forgeable — a rogue
+        # key PK_R = PK_A - sum(other selected keys) collapses the
+        # aggregate pubkey to one the attacker controls. Every
+        # bitmap-selected key must therefore have PROVEN possession of
+        # its secret: either its pubkey IS our trusted entry for that
+        # address (possession vouched by the trust root — genesis and
+        # on-chain admission require PoPs), or a verifying proof of
+        # possession travels with the wire valset (Validator.pop —
+        # checked via the bounded memo, NOT registered process-wide: an
+        # untrusted source must not grow the PoP registry).
+        # Merely dropping unproven bits would be wrong the other way:
+        # their signatures are folded into agg_sig, so a sub-aggregate
+        # check rejects every honest valset-change certificate.
+        from ..crypto import bls
+
+        signer_idxs = [i for i in commit.signers.true_indices()
+                       if i < len(commit_vals.validators)]
+        # Trusted-power PRE-tally, crypto-free, before any pairing is
+        # paid: only signers whose PUBKEY equals our trusted entry can
+        # ever contribute trusted power (addresses arrive verbatim on
+        # the wire, so a malicious source could pair its own keys —
+        # which signed the aggregate — with OUR validators' addresses
+        # and inherit their power; the aggregate is verified over
+        # commit_vals' pubkeys, so power only counts where that pubkey
+        # IS the trusted one). If the bitmap can't reach the trust
+        # fraction even counting every matching bit, the PoP gate and
+        # the aggregate check below — each a ~pairing per unproven
+        # signer — would be pure attacker-farmable CPU: a source
+        # streaming valsets of fresh keys (valid PoPs cost it nothing)
+        # must fail HERE, for free. Raising ErrTooMuchChange before
+        # signature validation sends garbage input down the bisection
+        # walk instead of failing it immediately, but each bisection
+        # step re-runs only this same crypto-free tally — O(log h)
+        # cheap fetches versus O(n) pairings per header.
+        # one O(N) index instead of get_by_address per signer — at the
+        # committee sizes this lane targets, per-signer linear scans
+        # would make the "free" path quadratic
+        trusted_by_addr = {v.address: v for v in vals.validators}
         tallied = 0
-        for idx, val in enumerate(commit_vals.validators):
-            if not commit.signers.get_index(idx):
-                continue
-            _, ours = vals.get_by_address(val.address)
-            # the PUBKEY must match our trusted entry, not just the
-            # address: addresses arrive verbatim on the wire, so a
-            # malicious source could pair its own keys (which signed the
-            # aggregate) with OUR validators' addresses and inherit
-            # their power. The aggregate was verified over commit_vals'
-            # pubkeys — power only counts where that pubkey IS the
-            # trusted one.
+        for idx in signer_idxs:
+            val = commit_vals.validators[idx]
+            ours = trusted_by_addr.get(val.address)
             if ours is not None and ours.pub_key == val.pub_key:
                 tallied += ours.voting_power
         total = vals.total_voting_power()
         if tallied * trust_fraction_den <= total * trust_fraction_num:
             raise ErrTooMuchChange(
                 f"too little trusted power signed: {tallied}/{total}")
+        for idx in signer_idxs:
+            val = commit_vals.validators[idx]
+            pk = val.pub_key.bytes()
+            ours = trusted_by_addr.get(val.address)
+            if ours is not None and ours.pub_key == val.pub_key:
+                continue
+            if bls.pop_registered(pk):
+                continue
+            if val.pop and bls.pop_verify_cached(pk, val.pop):
+                continue
+            raise ErrLiteVerification(
+                f"aggregate signer {val.address.hex()[:12]} is outside "
+                "the trusted set and has no verifying proof of "
+                "possession (rogue-key defense)")
+        try:
+            commit_vals.verify_commit_aggregate(
+                chain_id, commit.block_id, signed_header.height, commit)
+        except ErrInvalidCommit as e:
+            raise ErrLiteVerification(str(e))
         return
     bv = batch.new_batch_verifier()
     entries = []

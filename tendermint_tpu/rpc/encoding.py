@@ -100,12 +100,21 @@ def block_meta_json(m) -> dict:
 
 
 def validator_json(v) -> dict:
-    return {
+    # type tag matches the [crypto] key_type registry names; our own
+    # decoder sniffs key length, but external consumers trust the tag
+    key_type = "bls12381" if len(v.pub_key.bytes()) == 48 else "ed25519"
+    o = {
         "address": hexu(v.address),
-        "pub_key": {"type": "ed25519", "value": b64(v.pub_key.bytes())},
+        "pub_key": {"type": key_type, "value": b64(v.pub_key.bytes())},
         "voting_power": str(v.voting_power),
         "proposer_priority": str(v.proposer_priority),
     }
+    # BLS proof of possession rides along (optional key, Ed25519 wire
+    # shape unchanged): lite clients rebuilding valsets from RPC need
+    # it to prove possession of signers outside their trusted set
+    if v.pop:
+        o["pop"] = b64(v.pop)
+    return o
 
 
 # --- decoders (inverse views, used by the lite client and RPC-driven
@@ -198,7 +207,8 @@ def validator_from_json(o) -> "Validator":
         pub = PubKeyBLS12381(raw)
     else:
         pub = PubKeyEd25519(raw)
-    v = Validator.new(pub, int(o["voting_power"]))
+    v = Validator.new(pub, int(o["voting_power"]),
+                      pop=unb64(o["pop"]) if o.get("pop") else b"")
     v.proposer_priority = int(o.get("proposer_priority", 0))
     return v
 

@@ -138,8 +138,9 @@ class BlockExecutor:
     def set_event_bus(self, event_bus) -> None:
         self.event_bus = event_bus
 
-    def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block, self.evidence_pool)
+    def validate_block(self, state: State, block: Block,
+                       decided: bool = False) -> None:
+        validate_block(state, block, self.evidence_pool, decided=decided)
 
     def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
         """Validate → exec against app → update state → commit app →
@@ -171,7 +172,10 @@ class BlockExecutor:
                            block: Block, _t0: float) -> State:
         import time as _time
 
-        self.validate_block(state, block)
+        # apply-time blocks are DECIDED (commit apply, replay, fast
+        # sync) — proposal-only checks like the aggregate-lane clock
+        # drift bound must not reject them
+        self.validate_block(state, block, decided=True)
 
         abci_responses = self.exec_block_on_proxy_app(state, block)
 

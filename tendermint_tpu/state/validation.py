@@ -16,7 +16,23 @@ class ErrInvalidBlock(Exception):
     pass
 
 
-def validate_block(state: State, block: Block, evidence_pool=None) -> None:
+# Aggregate-lane block-time bound: BLS certificates carry no per-vote
+# timestamps, so block time is proposer-chosen (validated for strict
+# monotonicity). Without an upper bound a malicious proposer could set
+# a time arbitrarily far in the future and — monotonicity — drag every
+# later block past it, corrupting evidence expiry and lite-client
+# trusting windows chain-wide. Mirror proposer-based-timestamp designs:
+# reject h.time beyond our local clock plus an allowed drift. Like PBTS
+# timely checks, this applies ONLY to undecided proposals (prevote
+# time, decided=False): an honest 2/3 then never commits such a block,
+# and a node whose own clock lags must still accept blocks the network
+# already decided (replay, fast sync, finalize-commit apply all pass
+# decided=True) or it would crash-loop on a committed block.
+AGG_MAX_CLOCK_DRIFT_NS = 10_000_000_000  # 10s
+
+
+def validate_block(state: State, block: Block, evidence_pool=None,
+                   decided: bool = False) -> None:
     """Raises ErrInvalidBlock (or ErrInvalidCommit subclasses) on failure."""
     h = block.header
     # header matches state (reference validation.go:25-98; chain/height
@@ -91,10 +107,20 @@ def validate_block(state: State, block: Block, evidence_pool=None) -> None:
                 raise ErrInvalidBlock(
                     f"invalid block time {h.time}, expected (median) {expected}"
                 )
-        # aggregate certificates carry no per-vote timestamps (identical
-        # sign-bytes are what make aggregation possible), so BFT median
-        # time degrades to the strict-monotonicity check above — the
-        # proposer's clock sets block time (PARITY_DEVIATIONS.md)
+        elif not decided:
+            # aggregate certificates carry no per-vote timestamps
+            # (identical sign-bytes are what make aggregation possible),
+            # so BFT median time degrades to the proposer's clock under
+            # strict monotonicity (above) PLUS a local-clock upper bound
+            # — proposal-time only, see AGG_MAX_CLOCK_DRIFT_NS above
+            # (PARITY_DEVIATIONS.md item 13)
+            from ..types.basic import now_ns
+
+            if h.time > now_ns() + AGG_MAX_CLOCK_DRIFT_NS:
+                raise ErrInvalidBlock(
+                    f"aggregate-lane block time {h.time} is further than "
+                    f"{AGG_MAX_CLOCK_DRIFT_NS}ns past the local clock"
+                )
 
     # proposer must be in the current validator set (validation.go:131-138)
     if not state.validators.has_address(h.proposer_address):

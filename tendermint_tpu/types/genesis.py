@@ -137,7 +137,11 @@ class GenesisDoc:
                         "not verify")
 
     def validator_set_validators(self) -> List[Validator]:
-        return [Validator.new(v.pub_key, v.power) for v in self.validators]
+        # the PoP rides along so valsets served to lite clients /
+        # statesync peers carry their possession proofs (the lite
+        # aggregate path requires them for keys outside its trusted set)
+        return [Validator.new(v.pub_key, v.power, pop=v.pop)
+                for v in self.validators]
 
     def to_json(self) -> str:
         return json.dumps(

@@ -219,7 +219,10 @@ def decode_commit(data: bytes) -> Commit:
 
 
 def validator_obj(v: Validator):
-    return [v.address, pubkey_to_bytes(v.pub_key), v.voting_power, v.proposer_priority]
+    # element 4 (proof of possession) is optional on the wire: older
+    # peers / previously persisted valsets serialized 4-element lists
+    return [v.address, pubkey_to_bytes(v.pub_key), v.voting_power,
+            v.proposer_priority, v.pop]
 
 
 def validator_from(o) -> Validator:
@@ -228,6 +231,7 @@ def validator_from(o) -> Validator:
         pub_key=pubkey_from_bytes(o[1]),
         voting_power=o[2],
         proposer_priority=o[3],
+        pop=bytes(o[4]) if len(o) > 4 and o[4] else b"",
     )
 
 
@@ -239,6 +243,14 @@ def valset_obj(vs: ValidatorSet):
 def valset_from(o) -> ValidatorSet:
     vs = ValidatorSet.__new__(ValidatorSet)
     vs.validators = [validator_from(v) for v in o[0]]
+    # __new__ skips __init__'s sort/rotation on purpose (persisted sets
+    # carry their exact order + priorities) but its duplicate-address
+    # check must still hold: statesync feeds wire bytes through here,
+    # and a repeated entry would double-count that validator's power in
+    # every tally downstream (lite aggregate trusting path included)
+    addrs = [v.address for v in vs.validators]
+    if len(set(addrs)) != len(addrs):
+        raise ValueError("duplicate validator address")
     vs._total = None
     vs.proposer = None
     for v in vs.validators:

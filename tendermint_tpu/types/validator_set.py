@@ -65,13 +65,22 @@ class Validator:
     pub_key: PubKey
     voting_power: int
     proposer_priority: int = 0
+    # BLS12-381 proof of possession (96-byte signature over the pubkey
+    # bytes under the POP DST; empty for Ed25519). Travels with the
+    # validator on the wire so lite clients / statesync — which never
+    # see the genesis doc — can prove possession of keys outside their
+    # trusted set before an aggregate check (rogue-key defense).
+    # Deliberately EXCLUDED from encode()/hash_bytes(): the valset hash
+    # must stay identical whether or not the PoP rides along.
+    pop: bytes = b""
 
     @classmethod
-    def new(cls, pub_key: PubKey, power: int) -> "Validator":
-        return cls(pub_key.address(), pub_key, power, 0)
+    def new(cls, pub_key: PubKey, power: int, pop: bytes = b"") -> "Validator":
+        return cls(pub_key.address(), pub_key, power, 0, pop)
 
     def copy(self) -> "Validator":
-        return Validator(self.address, self.pub_key, self.voting_power, self.proposer_priority)
+        return Validator(self.address, self.pub_key, self.voting_power,
+                         self.proposer_priority, self.pop)
 
     def compare_proposer_priority(self, other: "Validator") -> "Validator":
         """Higher priority wins; ties break by lower address (reference
@@ -497,9 +506,12 @@ def random_bls_validator_set(n: int, power: int = 10, seed: bytes = b"bls"):
     match)."""
     from ..crypto.bls import PrivKeyBLS12381
 
+    from ..crypto import bls
+
     keys = [PrivKeyBLS12381.gen_from_secret(seed + b"-%d" % i)
             for i in range(n)]
-    vals = [Validator.new(k.pub_key(), power) for k in keys]
+    vals = [Validator.new(k.pub_key(), power, pop=bls.pop_prove(k))
+            for k in keys]
     vs = ValidatorSet(vals)
     keys_sorted = sorted(keys, key=lambda k: k.pub_key().address())
     return vs, keys_sorted

@@ -21,6 +21,16 @@ class ErrVoteInvalid(Exception):
     pass
 
 
+# Aggregate-certificate DoS bounds (Handel-lite lane): a certificate
+# claiming fewer signers than this rides the per-vote path instead of
+# paying a pairing; a peer whose certificates fail verification this
+# many times in one VoteSet (height, round) is ignored thereafter; the
+# failed-certificate memo holds this many digests, FIFO-evicted.
+_AGG_MIN_CERT_SIGNERS = 2
+_AGG_CERT_FAIL_BUDGET = 8
+_AGG_REJECT_MEMO_MAX = 512
+
+
 class VoteSet:
     def __init__(self, chain_id: str, height: int, round_: int, type_: int, val_set: ValidatorSet):
         if height == 0:
@@ -49,12 +59,14 @@ class VoteSet:
         self._agg_enabled = type_ == _PC and n > 0 and val_set.is_bls()
         self._agg: Dict[bytes, "_AggState"] = {}
         # failed-certificate memo: a certificate that failed its pairing
-        # check is remembered (bounded) so a replaying/flooding peer
-        # costs a sha256 per repeat instead of ~90ms of pairing — the
-        # cert-lane analogue of the verified-signature cache. Unique
-        # garbage still costs a pairing each; the p2p layer's per-peer
-        # recv flowrate caps bound that rate.
-        self._agg_rejects: set = set()
+        # check is remembered (FIFO-bounded) so a replaying peer costs a
+        # sha256 per repeat instead of ~90ms of pairing — the cert-lane
+        # analogue of the verified-signature cache. Unique garbage is
+        # bounded separately: each peer gets _AGG_CERT_FAIL_BUDGET
+        # failed verifications per (height, round), then its
+        # certificates are ignored (per-vote gossip still progresses).
+        self._agg_rejects: Dict[bytes, bool] = {}
+        self._agg_cert_fails: Dict[str, int] = {}
 
     def size(self) -> int:
         return len(self.val_set)
@@ -143,6 +155,16 @@ class VoteSet:
             raise ErrVoteInvalid("validator address does not match index")
         if len(vote.signature) not in (64, 96):  # ed25519 | bls12381
             raise ErrVoteInvalid("malformed signature")
+        if self._agg_enabled and vote.timestamp != 0:
+            # BLS-lane precommits MUST sign timestamp 0: aggregation
+            # composes votes into one certificate whose sign-bytes
+            # assume it. A vote with any other timestamp verifies
+            # individually (it signs its own bytes) but would poison
+            # the running aggregate — make_commit would emit a
+            # certificate that fails verification chain-wide
+            raise ErrVoteInvalid(
+                f"BLS-lane precommit carries timestamp {vote.timestamp} "
+                "!= 0 (aggregate sign-bytes invariant)")
 
     def _conflict_check(self, vote: Vote):
         """Returns None (new), "dup" (same again), or the existing
@@ -192,6 +214,11 @@ class VoteSet:
         from ..crypto import bls
         from ..crypto.bls.curve import g2_add
 
+        if vote.timestamp != 0:
+            # enforced by _precheck; defensive — a non-zero timestamp
+            # vote signs different bytes and must never fold into the
+            # timestamp-0 aggregate
+            return
         st = self._agg_state(vote.block_id.key(), vote.block_id)
         idx = vote.validator_index
         if idx in st.bits:
@@ -203,16 +230,42 @@ class VoteSet:
         st.bits.add(idx)
         st.power += power
 
-    def absorb_certificate(self, cert) -> bool:
+    def _agg_cert_composable(self, key: bytes, bits: set) -> bool:
+        """Would merging `bits` advance the running aggregate for this
+        block? (lock held by caller)"""
+        st = self._agg.get(key)
+        have = st.bits if st is not None else set()
+        if bits <= have:
+            return False  # nothing new
+        if have and not (bits.isdisjoint(have) or bits >= have):
+            return False  # non-composable overlap; keep what we have
+        return True
+
+    def absorb_certificate(self, cert, peer_id: str = "") -> bool:
         """Absorb a gossiped (bitmap, aggregate-signature) precommit
         certificate (Handel-lite lane). The certificate's aggregate
-        signature is verified over exactly its bitmap (ANY subset — no
-        quorum requirement), then merged into the running aggregate when
-        composable (disjoint, or a superset that replaces it); newly
-        covered validators join the power tallies. Returns True when
-        the certificate advanced our aggregate, False otherwise (bad
-        certificates and non-composable overlaps are just ignored —
-        per-vote gossip still makes progress)."""
+        signature is verified over exactly its bitmap, then merged into
+        the running aggregate when composable (disjoint, or a superset
+        that replaces it); newly covered validators join the power
+        tallies. Returns True when the certificate advanced our
+        aggregate, False otherwise (bad certificates and non-composable
+        overlaps are just ignored — per-vote gossip still makes
+        progress).
+
+        DoS posture: the pairing (~hundreds of ms pure-Python) runs
+        OUTSIDE the VoteSet lock so certificate verification never
+        stalls vote processing; composability is re-checked after
+        reacquiring. A certificate only pays a pairing when it claims
+        at least _AGG_MIN_CERT_SIGNERS signers (singletons ride the
+        per-vote path) and would advance our aggregate, and each peer
+        gets _AGG_CERT_FAIL_BUDGET failed verifications per VoteSet
+        before its certificates are dropped unexamined. Both admission
+        gates apply to gossip input only: local call sites (stored
+        seen-commit reconstruction, self-composed certificates) pass an
+        empty peer_id and skip them — a whale chain's legitimate
+        1-signer certificate must still reconstruct on restart."""
+        import hashlib as _hashlib
+
         from ..crypto import bls
         from ..crypto.bls.curve import g2_add
         from .block import AggregateCommit
@@ -224,19 +277,23 @@ class VoteSet:
             if (cert.agg_height != self.height or cert.agg_round != self.round
                     or cert.signers.size() != n):
                 return False
-            bits = {i for i in range(n) if cert.signers.get_index(i)}
+            bits = set(cert.signers.true_indices())
             if not bits:
                 return False
-            st = self._agg.get(cert.block_id.key())
-            have = st.bits if st is not None else set()
-            if bits <= have:
-                return False  # nothing new
-            if have and not (bits.isdisjoint(have) or bits >= have):
-                return False  # non-composable overlap; keep what we have
-            # verify the aggregate over exactly the claimed bitmap
-            # (known-bad certificates short-circuit on the memo)
-            import hashlib as _hashlib
-
+            # DoS admission gates apply to REMOTE input only (non-empty
+            # peer_id, i.e. the gossip lane). Local call sites — the
+            # stored seen-commit on restart, self-composed certificates
+            # — must not be bounced: a whale chain can legitimately
+            # persist a 1-signer certificate, and rejecting it at
+            # reconstruction would crash-loop the node.
+            if peer_id:
+                if len(bits) < min(_AGG_MIN_CERT_SIGNERS, n):
+                    return False
+                if self._agg_cert_fails.get(peer_id, 0) >= \
+                        _AGG_CERT_FAIL_BUDGET:
+                    return False
+            if not self._agg_cert_composable(cert.block_id.key(), bits):
+                return False
             reject_key = _hashlib.sha256(
                 cert.block_id.key() + cert.signers.to_bytes() + cert.agg_sig
             ).digest()
@@ -245,13 +302,27 @@ class VoteSet:
             pubkeys = [self.val_set.validators[i].pub_key.bytes()
                        for i in sorted(bits)]
             msg = cert.sign_bytes(self.chain_id)
-            if not bls.fast_aggregate_verify(pubkeys, msg, cert.agg_sig,
-                                             require_pop=False):
-                if len(self._agg_rejects) >= 512:
-                    self._agg_rejects.clear()
-                self._agg_rejects.add(reject_key)
+        # pairing outside the lock — votes keep flowing while we verify
+        ok = bls.fast_aggregate_verify(pubkeys, msg, cert.agg_sig,
+                                       require_pop=False)
+        with self._lock:
+            if not ok:
+                if len(self._agg_rejects) >= _AGG_REJECT_MEMO_MAX:
+                    # FIFO eviction (insertion-ordered dict), not a
+                    # wholesale clear a flooder could exploit to force
+                    # re-verification of replayed garbage
+                    self._agg_rejects.pop(next(iter(self._agg_rejects)))
+                self._agg_rejects[reject_key] = True
+                if peer_id:  # gossip lane only — local calls aren't peers
+                    self._agg_cert_fails[peer_id] = \
+                        self._agg_cert_fails.get(peer_id, 0) + 1
+                return False
+            # the set may have advanced while the pairing ran
+            if not self._agg_cert_composable(cert.block_id.key(), bits):
                 return False
             pt = bls._parse_signature_point(cert.agg_sig)
+            if pt is None:
+                return False
             power_of = {}
             for i in bits:
                 _, val = self.val_set.get_by_index(i)

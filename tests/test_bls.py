@@ -692,3 +692,424 @@ def test_bls_localnet_4node_commit():
             except Exception:
                 pass
         crypto_batch.set_sig_cache(prev_cache)
+
+
+def test_lite_trusting_rejects_rogue_key_valset():
+    """Regression (review finding): the lite/statesync trusting path
+    verifies an AggregateCommit over a WIRE-SUPPLIED valset. An
+    attacker who appends a rogue key PK_R = PK_A - sum(trusted PKs) to
+    the trusted pubkeys collapses the aggregate pubkey to PK_A (whose
+    secret they hold), so one attacker signature passes
+    fast_aggregate_verify while the pubkey-equality tally counts full
+    trusted power. Possession must be proven for every selected key."""
+    from tendermint_tpu.libs.bit_array import BitArray
+    from tendermint_tpu.lite.types import SignedHeader
+    from tendermint_tpu.lite.verifier import (
+        ErrLiteVerification,
+        _verify_commit_trusting,
+    )
+    from tendermint_tpu.types.block import AggregateCommit, Header
+    from tendermint_tpu.types.validator_set import ValidatorSet, Validator
+
+    chain = "bls-lane"
+    trusted, sks, bid, _ = _bls_commit_fixture(chain=chain)
+    attacker = bls.PrivKeyBLS12381.gen_from_secret(b"rogue-master")
+    # PK_R = PK_A - sum(trusted pubkeys): a valid subgroup point whose
+    # secret NOBODY knows
+    acc = None
+    for v in trusted.validators:
+        acc = bc.g1_add(acc, bc.g1_decompress(v.pub_key.bytes()))
+    pk_a_pt = bc.g1_decompress(attacker.pub_key().data)
+    pk_r = bc.g1_compress(bc.g1_add(pk_a_pt, bc.g1_neg(acc)))
+    rogue_val = Validator.new(bls.PubKeyBLS12381(pk_r), 1)
+
+    commit_vals = ValidatorSet.__new__(ValidatorSet)
+    commit_vals.validators = [v.copy() for v in trusted.validators] + [rogue_val]
+    commit_vals._total = None
+    commit_vals.proposer = None
+
+    n = len(commit_vals.validators)
+    signers = BitArray(n)
+    for i in range(n):
+        signers.set_index(i, True)
+    forged = AggregateCommit(block_id=bid, agg_height=5, agg_round=0,
+                             signers=signers, agg_sig=b"")
+    msg = forged.sign_bytes(chain)
+    forged.agg_sig = attacker.sign(msg)
+
+    # the forgery is cryptographically valid without a possession gate:
+    # ONE attacker signature verifies over all five claimed signers
+    pks = [v.pub_key.bytes() for v in commit_vals.validators]
+    assert bls.fast_aggregate_verify(pks, msg, forged.agg_sig,
+                                     require_pop=False)
+    assert not bls.pop_registered(pk_r)
+
+    sh = SignedHeader(header=Header(chain_id=chain, height=5), commit=forged)
+    with pytest.raises(ErrLiteVerification, match="possession"):
+        _verify_commit_trusting(trusted, chain, sh, commit_vals=commit_vals)
+
+
+def test_lite_trusting_rejects_duplicate_signer_valset():
+    """Regression (review finding): with no duplicate-address gate, ONE
+    low-power trusted validator could serve a commit_vals repeating its
+    own entry k times — every copy passes the PoP gate via the
+    pubkey-equality bypass, agg_sig = k·sig is a public scalar multiple
+    of its single real signature, and the tally counts its trusted
+    power k times, forging >2/3 trusted power for an arbitrary header.
+    serde.valset_from (the statesync decode path) must also refuse the
+    duplicated set."""
+    from tendermint_tpu.libs.bit_array import BitArray
+    from tendermint_tpu.lite.types import SignedHeader
+    from tendermint_tpu.lite.verifier import (
+        ErrLiteVerification,
+        _verify_commit_trusting,
+    )
+    from tendermint_tpu.types import serde
+    from tendermint_tpu.types.block import AggregateCommit, Header
+    from tendermint_tpu.types.validator_set import ValidatorSet
+
+    chain = "bls-lane"
+    trusted, sks, bid, _ = _bls_commit_fixture(chain=chain)
+    # the malicious trusted validator (power 10 of 40) clones its entry
+    # 3x: 30 tallied > 2/3 * 40 without the gate
+    evil_idx = 0
+    evil = trusted.validators[evil_idx]
+    evil_sk = sks[evil_idx]
+    commit_vals = ValidatorSet.__new__(ValidatorSet)
+    commit_vals.validators = [evil.copy() for _ in range(3)]
+    commit_vals._total = None
+    commit_vals.proposer = None
+
+    signers = BitArray(3)
+    for i in range(3):
+        signers.set_index(i, True)
+    forged = AggregateCommit(block_id=bid, agg_height=5, agg_round=0,
+                             signers=signers, agg_sig=b"")
+    one_sig = evil_sk.sign(forged.sign_bytes(chain))
+    # k·sig needs no secret: anyone can scalar-multiply a public G2 point
+    forged.agg_sig = bc.g2_compress(bc.g2_mul(bc.g2_decompress(one_sig), 3))
+
+    # the forgery is cryptographically valid over the duplicated keys
+    pks = [v.pub_key.bytes() for v in commit_vals.validators]
+    assert bls.fast_aggregate_verify(pks, forged.sign_bytes(chain),
+                                     forged.agg_sig, require_pop=False)
+
+    sh = SignedHeader(header=Header(chain_id=chain, height=5), commit=forged)
+    with pytest.raises(ErrLiteVerification, match="duplicate"):
+        _verify_commit_trusting(trusted, chain, sh, commit_vals=commit_vals)
+
+    # and the statesync wire decoder refuses to build such a set at all
+    with pytest.raises(ValueError, match="duplicate"):
+        serde.valset_from(serde.valset_obj(commit_vals))
+
+
+def test_lite_trusting_valset_change_requires_wire_pop(monkeypatch):
+    """A validator joining the set proves possession to lite clients
+    via the PoP riding on the wire valset (Validator.pop): with an
+    empty local registry (a lite client never parses genesis), a
+    valset-change certificate is accepted only when the new signer's
+    PoP travels along and verifies."""
+    from tendermint_tpu.types.basic import VOTE_TYPE_PRECOMMIT, Vote
+    from tendermint_tpu.lite.types import SignedHeader
+    from tendermint_tpu.lite.verifier import (
+        ErrLiteVerification,
+        _verify_commit_trusting,
+    )
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.basic import BlockID, PartSetHeader
+    from tendermint_tpu.types.validator_set import (
+        Validator,
+        ValidatorSet,
+        random_bls_validator_set,
+    )
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain = "bls-lane"
+    trusted, old_sks = random_bls_validator_set(4, seed=b"old-set")
+    joiner = bls.PrivKeyBLS12381.gen_from_secret(b"joiner")
+    new_vs = ValidatorSet(
+        [v.copy() for v in trusted.validators]
+        + [Validator.new(joiner.pub_key(), 10, pop=bls.pop_prove(joiner))]
+    )
+    key_by_addr = {k.pub_key().address(): k for k in old_sks + [joiner]}
+    bid = BlockID(b"\x0d" * 20, PartSetHeader(1, b"\x0e" * 20))
+    votes = VoteSet(chain, 5, 0, VOTE_TYPE_PRECOMMIT, new_vs)
+    for i in range(len(new_vs)):
+        addr, _ = new_vs.get_by_index(i)
+        v = Vote(addr, i, 5, 0, 0, VOTE_TYPE_PRECOMMIT, bid)
+        v.signature = key_by_addr[addr].sign(v.sign_bytes(chain))
+        votes.add_vote(v)
+    cert = votes.make_commit()
+    sh = SignedHeader(header=Header(chain_id=chain, height=5), commit=cert)
+
+    # pop stripped + empty registry -> the joining signer is unproven
+    monkeypatch.setattr(bls, "_pop_registry", set())
+    stripped = new_vs.copy()
+    for v in stripped.validators:
+        v.pop = b""
+    with pytest.raises(ErrLiteVerification, match="possession"):
+        _verify_commit_trusting(trusted, chain, sh, commit_vals=stripped)
+
+    # wire pop + (still) empty registry -> accepted
+    monkeypatch.setattr(bls, "_pop_registry", set())
+    _verify_commit_trusting(trusted, chain, sh, commit_vals=new_vs)
+
+    # oversized wire proofs are length-gated before touching the memo
+    # (the LRU key embeds the proof bytes; review round 3)
+    t0 = time.monotonic()
+    assert not bls.pop_verify_cached(joiner.pub_key().data, b"\x07" * 10**6)
+    assert time.monotonic() - t0 < 0.05  # no pairing was paid
+
+
+def test_bls_nonzero_timestamp_precommit_rejected():
+    """Regression (review finding): a BLS precommit with timestamp != 0
+    verifies over its OWN sign-bytes, but folding it into the running
+    aggregate would poison the composed certificate (whose sign-bytes
+    assume timestamp 0) — one faulty validator could halt the chain.
+    Such votes are rejected outright and the aggregate stays clean."""
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+    )
+    from tendermint_tpu.types.validator_set import random_bls_validator_set
+    from tendermint_tpu.types.vote_set import ErrVoteInvalid, VoteSet
+
+    chain = "bls-lane"
+    vs, sks = random_bls_validator_set(4, seed=b"ts-lane")
+    bid = BlockID(b"\x0f" * 20, PartSetHeader(1, b"\x10" * 20))
+    votes = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    for i in range(3):
+        addr, _ = vs.get_by_index(i)
+        v = Vote(addr, i, 1, 0, 0, VOTE_TYPE_PRECOMMIT, bid)
+        v.signature = sks[i].sign(v.sign_bytes(chain))
+        votes.add_vote(v)
+    # byzantine validator 3: valid signature over NON-ZERO timestamp
+    addr, _ = vs.get_by_index(3)
+    bad = Vote(addr, 3, 1, 0, 123456789, VOTE_TYPE_PRECOMMIT, bid)
+    bad.signature = sks[3].sign(bad.sign_bytes(chain))
+    with pytest.raises(ErrVoteInvalid, match="timestamp"):
+        votes.add_vote(bad)
+    with pytest.raises(ErrVoteInvalid, match="timestamp"):
+        votes.add_votes([bad])
+    # the quorum and the composed certificate are unaffected
+    assert votes.has_two_thirds_majority()
+    commit = votes.make_commit()
+    assert commit.num_signers() == 3
+    vs.verify_commit(chain, bid, 1, commit)
+
+
+def test_agg_block_time_bounded_by_local_clock():
+    """Regression (review finding): in the BLS lane block time is
+    proposer-chosen; without an upper bound a malicious proposer sets
+    it arbitrarily far in the future and monotonicity drags the whole
+    chain past it. validate_block bounds it to now + allowed drift."""
+    from tendermint_tpu.state import ErrInvalidBlock, validate_block
+    from tendermint_tpu.state.state import State
+    from tendermint_tpu.state.validation import AGG_MAX_CLOCK_DRIFT_NS
+    from tendermint_tpu.types.basic import now_ns
+
+    chain = "bls-lane"
+    vs, sks, bid, votes = _bls_commit_fixture(chain=chain)
+    commit = votes.make_commit()
+    state = State(
+        chain_id=chain,
+        last_block_height=1,
+        last_block_id=bid,
+        last_block_time=now_ns() - 10**9,
+        validators=vs,
+        next_validators=vs,
+        last_validators=vs,
+    )
+    proposer = vs.get_proposer().address
+
+    sane = state.make_block(2, [], commit, [], proposer, time_ns=now_ns())
+    validate_block(state, sane)
+
+    future = state.make_block(2, [], commit, [], proposer,
+                              time_ns=now_ns() + 100 * AGG_MAX_CLOCK_DRIFT_NS)
+    with pytest.raises(ErrInvalidBlock, match="local clock"):
+        validate_block(state, future)
+
+    # DECIDED blocks skip the drift bound (review round 3): the check
+    # is PBTS-style proposal-time-only — a node whose own clock lags
+    # must still apply/replay a block the network already committed,
+    # or restart/catch-up would crash-loop on it
+    validate_block(state, future, decided=True)
+
+
+def test_absorb_certificate_peer_failure_budget(monkeypatch):
+    """Regression (review finding): each unique invalid certificate
+    costs a full pairing, so a peer streaming unique garbage could
+    stall the round. After _AGG_CERT_FAIL_BUDGET failed verifications a
+    peer's certificates are dropped before the pairing; exact replays
+    short-circuit on the reject memo; other peers are unaffected."""
+    from tendermint_tpu.libs.bit_array import BitArray
+    from tendermint_tpu.types.basic import VOTE_TYPE_PRECOMMIT
+    from tendermint_tpu.types.block import AggregateCommit
+    from tendermint_tpu.types import vote_set as vote_set_mod
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain = "bls-lane"
+    vs, sks, bid, votes = _bls_commit_fixture(chain=chain)
+    good = votes.make_commit()
+
+    calls = []
+    monkeypatch.setattr(bls, "fast_aggregate_verify",
+                        lambda *a, **k: (calls.append(1), False)[1])
+    fresh = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    budget = vote_set_mod._AGG_CERT_FAIL_BUDGET
+    signers = BitArray(4)
+    signers.set_index(0, True)
+    signers.set_index(1, True)
+    for i in range(budget + 4):
+        bad = AggregateCommit(bid, 1, 0, signers.copy(),
+                              bytes([i]) + b"\x01" * 95)  # unique garbage
+        assert not fresh.absorb_certificate(bad, peer_id="evil")
+    assert len(calls) == budget  # later certs never reached a pairing
+    # exact replay of a seen-bad certificate: memo, no new verify even
+    # for a peer with remaining budget
+    replay = AggregateCommit(bid, 1, 0, signers.copy(),
+                             bytes([0]) + b"\x01" * 95)
+    assert not fresh.absorb_certificate(replay, peer_id="other")
+    assert len(calls) == budget
+
+    # a good certificate from a different peer still merges
+    monkeypatch.undo()
+    assert fresh.absorb_certificate(good, peer_id="good")
+    assert fresh.has_two_thirds_majority()
+
+
+def test_absorb_certificate_singleton_rides_vote_path():
+    """A 1-signer 'certificate' is just a vote: it must not buy a
+    pairing through the certificate lane."""
+    from tendermint_tpu.types.basic import VOTE_TYPE_PRECOMMIT
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain = "bls-lane"
+    vs, sks, bid, _ = _bls_commit_fixture(chain=chain)
+    solo_set = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    from tendermint_tpu.types.basic import Vote
+
+    addr, _ = vs.get_by_index(0)
+    v = Vote(addr, 0, 1, 0, 0, VOTE_TYPE_PRECOMMIT, bid)
+    v.signature = sks[0].sign(v.sign_bytes(chain))
+    solo_set.add_vote(v)
+    solo = solo_set.aggregate_certificate()
+    assert solo is not None and solo.num_signers() == 1
+    fresh = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    assert not fresh.absorb_certificate(solo, peer_id="peer")
+    assert fresh.sum == 0
+
+
+def test_validator_pop_serde_roundtrip():
+    """Validator.pop travels on the wire (element 4, optional) but is
+    EXCLUDED from hashing: the valset hash is identical with and
+    without it, and 4-element lists from older peers still decode."""
+    from tendermint_tpu.types import serde
+    from tendermint_tpu.types.validator_set import (
+        ValidatorSet,
+        random_bls_validator_set,
+    )
+
+    vs, _ = random_bls_validator_set(3, seed=b"serde-pop")
+    assert all(v.pop for v in vs.validators)
+    rt = serde.valset_from(serde.valset_obj(vs))
+    assert [v.pop for v in rt.validators] == [v.pop for v in vs.validators]
+    stripped = vs.copy()
+    for v in stripped.validators:
+        v.pop = b""
+    assert stripped.hash() == vs.hash()
+    # 4-element (pre-pop) wire form still decodes
+    old = serde.valset_obj(vs)
+    old[0] = [item[:4] for item in old[0]]
+    legacy = serde.valset_from(old)
+    assert all(v.pop == b"" for v in legacy.validators)
+    assert legacy.hash() == vs.hash()
+
+
+def test_g1_subgroup_check_rejects_cofactor_point():
+    """Regression (review finding): g1_mul used to reduce the scalar
+    mod r, which turned g1_in_subgroup's [r]P == O test into [0]P == O
+    — vacuously true for EVERY on-curve point, disabling G1 pubkey
+    subgroup validation. Pin an on-curve, out-of-subgroup point (x=4)
+    as rejected by both the curve check and the pubkey parser."""
+    from tendermint_tpu.crypto.bls.fields import P
+
+    x = 4
+    y2 = (x**3 + bc.B_G1) % P
+    y = pow(y2, (P + 1) // 4, P)
+    assert y * y % P == y2  # on the curve...
+    pt = (x, y, 1)
+    assert bc.g1_on_curve(pt)
+    assert not bc.g1_in_subgroup(pt)  # ...but not in the r-subgroup
+    assert bls._parse_pubkey_point(bc.g1_compress(pt)) is None
+    # real keys and the generator still pass
+    assert bc.g1_in_subgroup(bc.G1_GEN)
+    pk = bls.PrivKeyBLS12381.gen_from_secret(b"sub").pub_key()
+    assert bc.g1_in_subgroup(bc.g1_decompress(pk.data))
+
+
+def test_rpc_validator_json_carries_pop():
+    """Regression (review finding): lite clients rebuild valsets from
+    RPC JSON — if validator_json dropped the PoP, every honest BLS
+    valset change would be rejected by the lite rogue-key gate."""
+    from tendermint_tpu.rpc.encoding import validator_from_json, validator_json
+    from tendermint_tpu.types.validator_set import random_bls_validator_set
+
+    vs, _ = random_bls_validator_set(2, seed=b"rpc-pop")
+    for v in vs.validators:
+        assert v.pop
+        rt = validator_from_json(validator_json(v))
+        assert rt.pop == v.pop and rt.pub_key == v.pub_key
+    # Ed25519 validators keep the exact legacy JSON shape (no pop key)
+    from tendermint_tpu.types.validator_set import random_validator_set
+
+    evs, _ = random_validator_set(1)
+    o = validator_json(evs.validators[0])
+    assert "pop" not in o
+    assert validator_from_json(o).pop == b""
+
+
+def test_single_signer_stored_certificate_reconstructs():
+    """Regression (review finding): the gossip DoS gates (min signers,
+    peer budget) must not apply to LOCAL call sites — a whale chain
+    legitimately persists a 1-signer certificate, and restart
+    reconstruction absorbs it with an empty peer_id."""
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+    )
+    from tendermint_tpu.types.validator_set import (
+        Validator,
+        ValidatorSet,
+        random_bls_validator_set,
+    )
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    chain = "bls-lane"
+    base, sks = random_bls_validator_set(2, seed=b"whale")
+    whale, minnow = base.validators
+    vs = ValidatorSet([Validator(whale.address, whale.pub_key, 10, 0, whale.pop),
+                       Validator(minnow.address, minnow.pub_key, 1, 0, minnow.pop)])
+    bid = BlockID(b"\x11" * 20, PartSetHeader(1, b"\x12" * 20))
+    votes = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    widx, _ = vs.get_by_address(whale.address)
+    wkey = next(k for k in sks if k.pub_key().address() == whale.address)
+    v = Vote(whale.address, widx, 1, 0, 0, VOTE_TYPE_PRECOMMIT, bid)
+    v.signature = wkey.sign(v.sign_bytes(chain))
+    votes.add_vote(v)
+    assert votes.has_two_thirds_majority()  # 30 > 22
+    cert = votes.make_commit()
+    assert cert.num_signers() == 1
+
+    # restart reconstruction (local, empty peer_id): must absorb
+    fresh = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    assert fresh.absorb_certificate(cert)
+    assert fresh.has_two_thirds_majority()
+    # the same certificate from the GOSSIP lane stays gated
+    gossiped = VoteSet(chain, 1, 0, VOTE_TYPE_PRECOMMIT, vs)
+    assert not gossiped.absorb_certificate(cert, peer_id="peer")
