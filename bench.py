@@ -21,6 +21,14 @@ Async/cache modes (PR 2):
   `bench.py cache` — duplicate-heavy deliveries through the verified-
         signature cache; reports hit rate and wall vs the uncached run
 
+Compile-once modes (PR 8):
+  `bench.py warmstart` — kernel READINESS, cold process (XLA compile +
+        AOT artifact write) vs a second process on the same machine
+        (AOT load); vs_baseline = cold/warm readiness
+  `bench.py mega` — the default verify-commit benchmark at the
+        100k-signature mega-committee point (10k validators x many
+        heights in flight); `bench.py 100000` spelled as a mode
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
 vs_baseline > 1 means faster than the serial baseline.
@@ -50,15 +58,17 @@ CHAOS_MODE = "chaos" in sys.argv[1:]  # ABCI reconnect recovery (PR 5)
 LOAD_MODE = "load" in sys.argv[1:]  # sustained-TPS mempool localnet (PR 6)
 PREVERIFY_MODE = "preverify" in sys.argv[1:]  # batched vs serial CheckTx
 AGGVERIFY_MODE = "aggverify" in sys.argv[1:]  # BLS aggregate cert (PR 7)
+WARMSTART_MODE = "warmstart" in sys.argv[1:]  # compile-once readiness (PR 8)
+MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
-                      "aggverify", "--pipeline")]
+                      "aggverify", "warmstart", "mega", "--pipeline")]
 try:
-    METRIC_N = int(_args[0]) if _args else 10000
+    METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
-    METRIC_N = 10000
+    METRIC_N = 100000 if MEGA_MODE else 10000
 
 def _env_int(name: str, default: int) -> int:
     try:
@@ -93,6 +103,8 @@ PREVERIFY_N = _env_int("TM_TPU_BENCH_PREVERIFY_N", 2000)
 PREVERIFY_METRIC = f"mempool_preverify_{PREVERIFY_N}tx_wall_ms"
 AGG_NVAL = _env_int("TM_TPU_BENCH_AGG_NVAL", 10000)
 AGG_METRIC = f"aggverify_{AGG_NVAL}val_commit_wall_ms"
+WARM_N = _env_int("TM_TPU_BENCH_WARM_N", 10000)
+WARM_METRIC = f"warmstart_ready_{WARM_N}sigs_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1072,6 +1084,84 @@ def chaos_main():
     return 0
 
 
+# Child process for `bench.py warmstart`: measure KERNEL READINESS —
+# the wall time from "I want the n-sig commit kernel" to "a compiled
+# executable is dispatchable" — in a fresh process against a given
+# compile-cache dir. Run twice against the same dir, the first child is
+# the cold compile (writes the AOT artifact), the second the warm load.
+_WARMSTART_CHILD = r'''
+import json, os, sys, time
+n, cache_dir = int(sys.argv[1]), sys.argv[2]
+os.environ["TM_TPU_COMPILE_CACHE"] = cache_dir
+t_boot = time.perf_counter()
+import numpy as np
+import jax
+from tendermint_tpu.crypto import kernel_cache
+from tendermint_tpu.crypto.jaxed25519 import verify as V
+# dims of an n-sig commit batch (vote-sized ~110B messages) without
+# paying n real signatures — zeros pack to the same padded shape
+msgs = [b"x" * 110] * n
+sig = np.zeros((n, 64), dtype=np.uint8)
+pk = np.zeros((n, 32), dtype=np.uint8)
+_, nb, mrows, bpad = V.pack_buffer(msgs, sig, pk, 1)
+fn = V._jitted_packed(nb, mrows, bpad, 1, donate=V._donate_default())
+t0 = time.perf_counter()
+if hasattr(fn, "prepare"):
+    fn.prepare(jax.ShapeDtypeStruct((V.ROWS_AUX + mrows, bpad),
+                                    jax.numpy.int32))
+else:  # cache layer disabled/unavailable: readiness = first dispatch
+    np.asarray(fn(np.zeros((V.ROWS_AUX + mrows, bpad), dtype=np.int32)))
+ready_s = time.perf_counter() - t0
+print(json.dumps({"ready_s": ready_s, "boot_s": t0 - t_boot,
+                  "stats": kernel_cache.stats()}))
+'''
+
+
+def warmstart_main(degraded):
+    """Compile-once story end to end: a cold process pays the XLA
+    compile for the WARM_N-sig commit kernel and writes the AOT
+    artifact; a second process on the same machine loads it in
+    milliseconds. vs_baseline = cold readiness / warm readiness."""
+    import shutil
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="tmtpu-warmstart-")
+    env = dict(os.environ)
+    env.pop("TM_TPU_COMPILE_CACHE", None)
+    if degraded:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    def run_child(tag):
+        t0 = time.perf_counter()
+        p = subprocess.run(
+            [sys.executable, "-c", _WARMSTART_CHILD, str(WARM_N), cache_dir],
+            capture_output=True, text=True, env=env, timeout=1800)
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"warmstart {tag} child failed: {p.stderr[-300:]}")
+        res = json.loads(p.stdout.strip().splitlines()[-1])
+        return res, time.perf_counter() - t0
+
+    try:
+        cold, cold_wall = run_child("cold")
+        warm, warm_wall = run_child("warm")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    out = {
+        "metric": WARM_METRIC,
+        "value": round(warm["ready_s"] * 1000, 1),
+        "unit": "ms",
+        "vs_baseline": round(cold["ready_s"] / max(warm["ready_s"], 1e-9), 2),
+        "cold_ready_ms": round(cold["ready_s"] * 1000, 1),
+        "cold_wall_ms": round(cold_wall * 1000, 1),
+        "warm_wall_ms": round(warm_wall * 1000, 1),
+        # the warm child must have LOADED the artifact, not recompiled
+        "warm_cache_hit": bool(warm["stats"].get("hits", 0) >= 1
+                               and warm["stats"].get("compiles", 0) == 0),
+    }
+    _emit(out, degraded)
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -1103,6 +1193,8 @@ def main():
             # fall back to however many devices the platform has
             pass
 
+    if WARMSTART_MODE:
+        return warmstart_main(degraded)
     if VOTES_MODE:
         return votes_main(degraded)
     if FASTSYNC_MODE:
@@ -1265,6 +1357,8 @@ if __name__ == "__main__":
             metric = COMMIT4_METRIC
         elif AGGVERIFY_MODE:
             metric = AGG_METRIC
+        elif WARMSTART_MODE:
+            metric = WARM_METRIC
         else:
             mode = "_rlc" if RLC_MODE else ""
             metric = f"verify_commit_{METRIC_N}_sigs{mode}_wall_ms"

@@ -245,6 +245,13 @@ REQUIRED_FAMILIES = (
     "crypto_agg_signers",
     "consensus_agg_gossip_merges_total",
     "agg_commit_size_bytes",
+    # PR-8 compile-once kernels (declaration presence: a cpu-backend
+    # node never compiles and a fully warm node never misses; the
+    # coalescer records nothing with the window at its default 0)
+    "crypto_compile_seconds",
+    "crypto_compile_cache_hits_total",
+    "crypto_compile_cache_misses_total",
+    "crypto_coalesced_calls_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

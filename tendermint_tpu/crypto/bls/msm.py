@@ -281,8 +281,7 @@ def _build_jax():
         z3 = jnp.where(a_inf[None, :], bz, jnp.where(b_inf[None, :], az, z3))
         return x3, y3, z3
 
-    @jax.jit
-    def _level(xs, ys, zs, shift):
+    def _level_impl(xs, ys, zs, shift):
         """One tree level: lane i (i % (2*shift) == 0) absorbs lane
         i+shift; other lanes are zeroed to infinity."""
         n = xs.shape[1]
@@ -296,6 +295,16 @@ def _build_jax():
         y3 = jnp.where(keep[None, :], y3, jnp.zeros_like(y3))
         z3 = jnp.where(keep[None, :], z3, jnp.zeros_like(z3))
         return x3, y3, z3
+
+    # compile-once: the level step costs ~minutes of XLA compile (the
+    # Jacobian add formula is a huge graph), which is why the backend
+    # is opt-in — the AOT store turns that into once per MACHINE.
+    # `shift` is a runtime scalar, so ONE executable per batch width
+    # serves every tree level.
+    from .. import kernel_cache
+
+    _level = kernel_cache.aot_wrap("bls_msm_level", (),
+                                   jax.jit(_level_impl))
 
     def jax_sum(points: List[AffinePoint]) -> G1Point:
         live = [p for p in points if p is not None]

@@ -18,6 +18,7 @@ all-gather of masks.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from functools import lru_cache, partial
 
@@ -26,17 +27,22 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import batch as batch_mod
+from .. import kernel_cache
 from ..batch import BatchVerifier
 from . import curve, pack, pallas_kernels, scalar, sha512
 
-# persistent compilation cache: the kernel is expensive to compile (~20-40s
-# on TPU) and identical across processes
-_cache_dir = os.environ.get("TM_TPU_JAX_CACHE", os.path.expanduser("~/.cache/tm_tpu_jax"))
-try:  # pragma: no cover
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+# compile-once layer (crypto/kernel_cache): persistent XLA compilation
+# cache + AOT-serialized executables, so kernels compile once per
+# machine instead of per process. Honors TM_TPU_COMPILE_CACHE (and the
+# legacy TM_TPU_JAX_CACHE spelling) until node config takes over.
+kernel_cache.ensure_configured()
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001 - backend init failed
+        return "cpu"
 
 
 @lru_cache(maxsize=1)
@@ -198,17 +204,38 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs)
 
 
+def _donate_default() -> bool:
+    """Whether verify_batch donates the packed h2d buffer to the kernel
+    (steady-state verification then reuses device memory instead of
+    allocating per batch). Default: on for accelerators, off for the
+    CPU backend (XLA CPU can rarely alias the buffer and warns instead).
+    TM_TPU_DONATE=0/1 forces either way. Donated kernels are a separate
+    compile key: introspection/profiling callers that re-dispatch on a
+    resident device array keep the undonated variant (donate=False, the
+    _jitted_packed default)."""
+    env = os.environ.get("TM_TPU_DONATE")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        return jax.devices()[0].platform != "cpu"
+    except Exception:  # noqa: BLE001 - no backend: nothing to donate to
+        return False
+
+
 def _jitted_packed(nb: int, mrows: int, bpad: int, ndev: int,
-                   force_pallas=None):
+                   force_pallas=None, donate: bool = False):
     # resolve env/backend flags BEFORE the cache so flipping
     # TM_TPU_FORCE_PALLAS between calls can't return a stale kernel path
     use_pallas, interp = _pallas_flags(force_pallas)
-    return _jitted_packed_impl(nb, mrows, bpad, ndev, use_pallas, interp)
+    return _jitted_packed_impl(nb, mrows, bpad, ndev, use_pallas, interp,
+                               donate)
 
 
 @lru_cache(maxsize=32)
 def _jitted_packed_impl(nb: int, mrows: int, bpad: int, ndev: int,
-                        use_pallas: bool, interp: bool):
+                        use_pallas: bool, interp: bool,
+                        donate: bool = False):
+    donate_kw = {"donate_argnums": (0,)} if donate else {}
     if ndev > 1:
         from jax.sharding import Mesh, PartitionSpec as P
 
@@ -219,11 +246,20 @@ def _jitted_packed_impl(nb: int, mrows: int, bpad: int, ndev: int,
         mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
         body = partial(_verify_packed_core, nb=nb, mrows=mrows,
                        use_pallas=use_pallas, pallas_interpret=interp)
-        return jax.jit(_shard_map(body, mesh,
-                                  in_specs=(P(None, "dp"),),
-                                  out_specs=P("dp")))
-    return jax.jit(partial(_verify_packed_core, nb=nb, mrows=mrows,
-                           use_pallas=use_pallas, pallas_interpret=interp))
+        fn = jax.jit(_shard_map(body, mesh,
+                                in_specs=(P(None, "dp"),),
+                                out_specs=P("dp")), **donate_kw)
+    else:
+        fn = jax.jit(partial(_verify_packed_core, nb=nb, mrows=mrows,
+                             use_pallas=use_pallas,
+                             pallas_interpret=interp), **donate_kw)
+    if interp:
+        # pallas interpret mode is a CPU-mesh dryrun path; its artifacts
+        # are worthless cross-process and its lowering is the slow part
+        return fn
+    return kernel_cache.aot_wrap(
+        "ed25519_packed",
+        (nb, mrows, bpad, ndev, use_pallas, donate), fn)
 
 
 @lru_cache(maxsize=1)
@@ -241,13 +277,33 @@ def _pack_le_rows(arr: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(packed.T).view(np.int32)
 
 
+# per-thread packed-buffer rings for the chunked dispatch: one ring per
+# (chunks, shape), so concurrent verify_batch callers (dispatch threads
+# + direct callers) never share host memory. Reuse is ACROSS calls only
+# — within a call every chunk packs its own slot, because device_put is
+# async and the host array must stay unmodified until the copy lands.
+_host_bufs = threading.local()
+
+
+def _host_buf_ring(chunks: int, shape) -> list:
+    key = (chunks, shape)
+    pool = getattr(_host_bufs, "pool", None)
+    if pool is None or pool[0] != key:
+        pool = (key, [np.zeros(shape, dtype=np.int32)
+                      for _ in range(chunks)])
+        _host_bufs.pool = pool
+    return pool[1]
+
+
 def pack_buffer(msgs, sig_arr: np.ndarray, pk_arr: np.ndarray, ndev: int = 1,
-                dims=None):
+                dims=None, out: np.ndarray | None = None):
     """Build the single packed h2d buffer (see _verify_packed_core layout).
     Returns (buf (ROWS_AUX+mrows, bpad) int32, nb, mrows, bpad). The ONLY
     place the layout is produced — bench/profiling code reuses it.
     `dims=(nb, mrows, bpad)` forces the padded shape (chunked dispatch:
-    every chunk must share ONE jit key regardless of its own maxima)."""
+    every chunk must share ONE jit key regardless of its own maxima).
+    `out` reuses a caller-held buffer of exactly that shape instead of
+    allocating (the chunked path ping-pongs two buffers)."""
     n = len(msgs)
     lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n)
     maxlen = int(lens.max()) if n else 0
@@ -267,7 +323,11 @@ def pack_buffer(msgs, sig_arr: np.ndarray, pk_arr: np.ndarray, ndev: int = 1,
     msg_mat = np.zeros((n, mrows * 4), dtype=np.uint8)
     pack.fill_msg_bytes(msg_mat, [bytes(m) for m in msgs], lens)
 
-    buf = np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)
+    if out is not None and out.shape == (ROWS_AUX + mrows, bpad):
+        buf = out
+        buf.fill(0)
+    else:
+        buf = np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)
     buf[0, :n] = lens
     buf[1:17, :n] = _pack_le_rows(sig_arr)
     buf[17:25, :n] = _pack_le_rows(pk_arr)
@@ -313,8 +373,19 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
     equal chunks dispatched back-to-back: chunk i+1's host->device
     transfer overlaps chunk i's kernel, hiding min(transfer, compute)
     per extra chunk on direct-attached TPU. All chunks share one jit
-    key (same padded shape). Only batches >= 2048 split — below that
-    the extra dispatch overhead outweighs the overlap."""
+    key (same padded shape), and chunking composes with multi-device
+    meshes (each chunk's bpad stays a multiple of ndev, so every chunk
+    shards cleanly). Only batches >= 2048 split — below that the extra
+    dispatch overhead outweighs the overlap. On accelerators the host
+    side packs into a per-thread RING of `chunks` buffers — distinct
+    per chunk within one call (device_put is async and PJRT only
+    requires the host buffer stay unmodified until the copy completes,
+    so a buffer is never repacked under an in-flight transfer) and
+    reused across back-to-back calls (this function returns only after
+    every mask materializes, which bounds every transfer) — and the
+    device buffer is DONATED to the kernel, so steady-state
+    verification reuses both host and device memory instead of
+    allocating per batch."""
     n = len(msgs)
     if n == 0:
         return []
@@ -327,7 +398,7 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
     except ValueError:
         # a malformed env var must never take down verification
         chunks, chunk_min = 1, 2048
-    if chunks < 2 or n < chunk_min or ndev > 1:
+    if chunks < 2 or n < chunk_min:
         chunks = 1
 
     # one jit key for every chunk, derived from GLOBAL maxima: a chunk
@@ -341,7 +412,14 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
     if ndev > 1:
         bpad = max(bpad, ndev)
         bpad = (bpad + ndev - 1) // ndev * ndev
-    fn = _jitted_packed(nb, mrows, bpad, ndev)
+    fn = _jitted_packed(nb, mrows, bpad, ndev, donate=_donate_default())
+
+    # host-buffer reuse only where device_put copies out of the host
+    # array (accelerators); the CPU backend can alias numpy memory, and
+    # an aliased buffer must never be repacked under an in-flight kernel
+    reuse_host = chunks > 1 and _platform() != "cpu"
+    bufs = (_host_buf_ring(chunks, (ROWS_AUX + mrows, bpad))
+            if reuse_host else None)
 
     # transfer-vs-compute attribution for the CryptoMetrics split gauges
     # (PROFILE.md round 4 measured this with one-off scripts; now it is
@@ -352,11 +430,12 @@ def verify_batch(msgs, sigs, pks, devices: int | None = None):
     t_transfer = 0.0
     t0 = time.perf_counter()
     masks = []
-    for lo in range(0, n, per):
+    for idx, lo in enumerate(range(0, n, per)):
         hi = min(lo + per, n)
         buf, _, _, _ = pack_buffer(
             msgs[lo:hi], sig_arr[lo:hi], pk_arr[lo:hi], ndev,
-            dims=(nb, mrows, bpad))
+            dims=(nb, mrows, bpad),
+            out=bufs[idx] if reuse_host else None)
         # device_put + dispatch are async: the NEXT chunk's pack and
         # h2d transfer overlap this chunk's kernel (with chunks=1 this
         # is the plain single-dispatch pipeline)
@@ -407,7 +486,9 @@ def _rlc_core(words, nblocks, a_y, a_sign, r_y, r_sign, s_limbs, z_limbs,
 
 @lru_cache(maxsize=16)
 def _jitted_rlc(nb: int, bpad: int, group: int):
-    return jax.jit(partial(_rlc_core, group=group))
+    return kernel_cache.aot_wrap(
+        "ed25519_rlc", (nb, bpad, group),
+        jax.jit(partial(_rlc_core, group=group)))
 
 
 def verify_batch_rlc(msgs, sigs, pks, group: int = 64,
@@ -562,7 +643,11 @@ def _sharded_commit_fn_impl(ndev: int, use_pallas: bool, interp: bool):
     mesh = Mesh(np.asarray(jax.devices()[:ndev]), ("dp",))
     # interp is True only when use_pallas is, and make_sharded_commit_step
     # re-derives it identically from the boolean
-    return make_sharded_commit_step(mesh, force_pallas=use_pallas)
+    step = make_sharded_commit_step(mesh, force_pallas=use_pallas)
+    if interp:
+        return step  # CPU-mesh dryrun: artifacts are worthless cross-run
+    return kernel_cache.aot_wrap(
+        "ed25519_commit_step", (ndev, use_pallas), step)
 
 
 def sharded_commit_verify(msgs, sigs, pks, powers, for_block,
@@ -634,12 +719,13 @@ def warmup(buckets=(8, 16, 64), nb: int = 2, mrows: int = 32,
 
     ndev = devices if devices is not None else len(jax.devices())
     small_fn, small_shape = None, None
+    donate = _donate_default()  # warm the variant the live path runs
     for b in buckets:
         bpad = _bucket(b)
         if ndev > 1:
             bpad = max(bpad, ndev)
             bpad = (bpad + ndev - 1) // ndev * ndev
-        fn = _jitted_packed(nb, mrows, bpad, ndev)
+        fn = _jitted_packed(nb, mrows, bpad, ndev, donate=donate)
         fn(jnp.asarray(np.zeros((ROWS_AUX + mrows, bpad), dtype=np.int32)))
         if small_fn is None or bpad < small_shape[1]:
             small_fn, small_shape = fn, (ROWS_AUX + mrows, bpad)
@@ -672,10 +758,14 @@ def _calibrate_batch_min(fn, shape) -> int | None:
     from ..keys import PrivKeyEd25519
 
     try:
-        d = jax.device_put(np.zeros(shape, dtype=np.int32))
         ts = []
         for _ in range(3):
+            # put INSIDE the timed region (and fresh per rep): the live
+            # path pays the transfer every batch, and a donated kernel
+            # consumes its input buffer — re-dispatching a resident
+            # array is exactly what donation forbids
             t0 = time.perf_counter()
+            d = jax.device_put(np.zeros(shape, dtype=np.int32))
             np.asarray(fn(d))
             ts.append(time.perf_counter() - t0)
         dispatch_ms = sorted(ts)[1] * 1e3

@@ -1,0 +1,432 @@
+"""Compile-once kernel layer: persistent XLA cache + AOT artifact store.
+
+XLA compilation is the dominant tax on the verify hot path: the packed
+Ed25519 kernel costs multi-second compiles per (shape, device) key, the
+BLS jax-MSM kernel minutes — and every PROCESS used to pay it again.
+This module makes kernels compile once per MACHINE:
+
+1. The persistent XLA compilation cache (``jax_compilation_cache_dir``)
+   is enabled under a configurable directory (``[crypto]``
+   ``compile_cache_dir``, default ``~/.cache/tendermint-tpu/xla``), so
+   XLA itself reuses compiled modules across processes.
+2. An AOT artifact store layers on top: known kernels are
+   ``.lower().compile()``d once, serialized with
+   ``jax.experimental.serialize_executable``, and written (atomically)
+   under ``<cache_dir>/aot/``. A later process deserializes the native
+   executable in milliseconds — no tracing, no XLA compile at all.
+
+Artifacts are keyed by (jax version, backend platform, device kind,
+device count, kernel name, static key, argument avals); a corrupted,
+truncated, or version-mismatched artifact is IGNORED (fresh compile +
+miss counter), never a crash. Writes go through a same-directory
+tempfile + ``os.replace`` so concurrent processes racing one entry
+cannot corrupt it — last writer wins, both end up with a valid file.
+
+Trust model: artifacts deserialize via pickle, the same local-user
+trust boundary as XLA's own persistent cache directory — do not point
+``compile_cache_dir`` at an untrusted location.
+
+Everything here is best-effort: any failure in the cache layer falls
+back to the plain jit path. The module never imports jax at import
+time (mirroring crypto/batch's deferred-registration idiom).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import tempfile
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+LOG = logging.getLogger("crypto.kernel_cache")
+
+DEFAULT_CACHE_DIR = "~/.cache/tendermint-tpu/xla"
+
+# artifact header: magic + one json metadata line, then the pickled
+# serialize_executable payload
+_MAGIC = b"TMTPU-AOT1 "
+
+_lock = threading.RLock()
+_dir: Optional[str] = None  # resolved cache dir; None = not yet configured
+_disabled = False  # explicit opt-out (compile_cache_dir = "")
+_stats = {"hits": 0, "misses": 0, "compiles": 0, "load_errors": 0}
+# in-progress compiles: unique token -> (kernel, perf_counter() start);
+# tokens (not kernel names) so two shapes of one kernel compiling
+# concurrently both stay visible until each finishes
+_compiling: dict = {}
+_compile_seq = 0
+# weakrefs to every live aot_wrap in-memory cache (clear_memory's only
+# purpose); weak so an aot_wrap dropped by its caller (e.g. lru_cache
+# eviction of a kernel shape) actually frees its loaded executables
+_wrapper_caches: list = []
+
+
+class _WrapperCache(dict):
+    """A dict that supports weak references (plain dicts don't)."""
+
+    __slots__ = ("__weakref__",)
+
+
+def _metrics():
+    """The process-wide CryptoMetrics sink, if one is installed
+    (crypto/batch.set_metrics). Imported lazily: batch imports the jax
+    verify module which imports us — a top-level import would cycle."""
+    from . import batch as _batch
+
+    return _batch.get_metrics()
+
+
+def configure(cache_dir: Optional[str]) -> Optional[str]:
+    """Set the compile-cache root: enables jax's persistent compilation
+    cache there and roots the AOT artifact store at ``<dir>/aot``.
+    ``""`` (or None) disables both layers. Returns the resolved dir.
+
+    Safe to call before OR after jax backend init, and repeatedly (a
+    node reconfiguring to the same dir is a no-op)."""
+    global _dir, _disabled
+    with _lock:
+        if not cache_dir:
+            if _dir is not None:
+                try:  # pragma: no cover - depends on jax build
+                    import jax
+
+                    jax.config.update("jax_compilation_cache_dir", None)
+                except Exception as e:  # noqa: BLE001 - best-effort
+                    LOG.debug("persistent XLA cache not disabled: %s", e)
+            _disabled = True
+            _dir = None
+            return None
+        resolved = os.path.abspath(os.path.expanduser(cache_dir))
+        _disabled = False
+        if resolved == _dir:
+            return _dir
+        _dir = resolved
+        try:
+            os.makedirs(os.path.join(resolved, "aot"), exist_ok=True)
+        except OSError as e:
+            LOG.warning("compile cache dir %s unusable, caching disabled: %s",
+                        resolved, e)
+            _dir, _disabled = None, True
+            return None
+        _prune_stale(resolved)
+        try:  # pragma: no cover - depends on jax build
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", resolved)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.5)
+        except Exception as e:  # noqa: BLE001 - cache is best-effort
+            LOG.debug("persistent XLA cache not enabled: %s", e)
+        return _dir
+
+
+_TMP_MAX_AGE_S = 24 * 3600.0  # crashed writers' tempfiles age out
+
+
+def _prune_stale(root: str) -> None:
+    """Best-effort GC of the aot/ store, run once per configure():
+    artifacts written by a DIFFERENT jax version are permanently
+    unreachable (the version is part of the key hash in the filename)
+    and multi-MB each, so without this they accumulate forever across
+    upgrades; unparseable artifacts can never load either. Live
+    same-version artifacts are never touched."""
+    try:
+        import jax
+
+        version = jax.__version__
+    except Exception:  # noqa: BLE001 - no jax, nothing to compare to
+        return
+    aot = os.path.join(root, "aot")
+    try:
+        names = os.listdir(aot)
+    except OSError:
+        return
+    now = time.time()
+    for name in names:
+        path = os.path.join(aot, name)
+        try:
+            if name.startswith(".tmp-aot-"):
+                if now - os.path.getmtime(path) > _TMP_MAX_AGE_S:
+                    os.unlink(path)
+                continue
+            if not name.endswith(".aot"):
+                continue
+            with open(path, "rb") as f:
+                head = f.read(65536)  # meta line sits right after magic
+            keep = False
+            if head.startswith(_MAGIC):
+                nl = head.find(b"\n", len(_MAGIC))
+                if nl != -1:
+                    try:
+                        meta = json.loads(head[len(_MAGIC):nl].decode())
+                        keep = json.loads(meta["key"])[0] == version
+                    except Exception:  # noqa: BLE001 - junk never loads
+                        keep = False
+            if not keep:
+                os.unlink(path)
+        except OSError:
+            continue  # racing process: it won the unlink, fine
+
+
+def unconfigure() -> None:
+    """Return to the never-configured state (test fixtures): unlike
+    configure(""), which pins the layer DISABLED, the next
+    ensure_configured() re-reads the environment/default."""
+    global _dir, _disabled
+    with _lock:
+        _dir = None
+        _disabled = False
+
+
+def ensure_configured() -> Optional[str]:
+    """Configure with the environment/default dir unless a configure()
+    call already happened. TM_TPU_COMPILE_CACHE wins, then the legacy
+    TM_TPU_JAX_CACHE spelling, then DEFAULT_CACHE_DIR; an empty
+    TM_TPU_COMPILE_CACHE disables caching."""
+    with _lock:
+        if _dir is not None or _disabled:
+            return _dir
+    env = os.environ.get("TM_TPU_COMPILE_CACHE")
+    if env is None:
+        env = os.environ.get("TM_TPU_JAX_CACHE") or DEFAULT_CACHE_DIR
+    return configure(env)
+
+
+def cache_dir() -> Optional[str]:
+    return _dir
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def status() -> dict:
+    """Bundle for /debug/crypto: store state, counters, and any compile
+    currently in progress (a node stuck compiling at boot shows up here
+    as {"kernel": elapsed_seconds})."""
+    now = time.perf_counter()
+    with _lock:
+        compiling: dict = {}
+        for kernel, t in _compiling.values():
+            elapsed = round(now - t, 1)
+            # several shapes of one kernel: report the longest-running
+            compiling[kernel] = max(elapsed, compiling.get(kernel, 0.0))
+        return {
+            "dir": _dir,
+            "enabled": _dir is not None,
+            **_stats,
+            "compiling": compiling,
+        }
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def clear_memory() -> None:
+    """Drop every aot_wrap in-memory compiled-kernel reference, so the
+    next call re-loads from disk — a fresh process, simulated in-process
+    (warm-path tests use this to assert load-without-recompile)."""
+    with _lock:
+        live = []
+        for ref in _wrapper_caches:
+            c = ref()
+            if c is not None:
+                c.clear()
+                live.append(ref)
+        _wrapper_caches[:] = live  # prune dead wrappers while here
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _lock:
+        _stats[key] += n
+
+
+def _aval_part(a) -> tuple:
+    """Stable key component for one argument: (shape, dtype) for
+    anything array-like, a type tag for python scalars."""
+    import numpy as np
+
+    if hasattr(a, "shape") and hasattr(a, "dtype"):
+        return ("arr", tuple(int(s) for s in a.shape), str(a.dtype))
+    if isinstance(a, bool):
+        return ("pybool",)
+    if isinstance(a, int):
+        return ("pyint",)
+    if isinstance(a, float):
+        return ("pyfloat",)
+    return ("other", str(np.asarray(a).shape), str(np.asarray(a).dtype))
+
+
+def _full_key(kernel: str, static_key: tuple, args) -> str:
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+        platform, kind, ndev = dev.platform, dev.device_kind, len(jax.devices())
+    except Exception:  # noqa: BLE001 - no backend: key still stable
+        platform, kind, ndev = "none", "none", 0
+    return json.dumps([jax.__version__, platform, kind, ndev, kernel,
+                       list(static_key), [list(_aval_part(a)) for a in args]],
+                      sort_keys=True)
+
+
+def _artifact_path(kernel: str, key: str) -> Optional[str]:
+    if _dir is None:
+        return None
+    h = hashlib.sha256(key.encode()).hexdigest()[:24]
+    return os.path.join(_dir, "aot", f"{kernel}-{h}.aot")
+
+
+def _try_load(kernel: str, key: str, path: str):
+    """Deserialize a stored executable; None on ANY mismatch/corruption
+    (counted, logged at debug — the fresh-compile path takes over)."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None  # plain miss: not on disk yet
+    try:
+        if not blob.startswith(_MAGIC):
+            raise ValueError("bad magic")
+        rest = blob[len(_MAGIC):]
+        nl = rest.index(b"\n")
+        meta = json.loads(rest[:nl].decode())
+        if meta.get("key") != key:
+            raise ValueError("key mismatch (different jax/backend/shape)")
+        payload = pickle.loads(rest[nl + 1:])
+        from jax.experimental import serialize_executable as _se
+
+        compiled = _se.deserialize_and_load(*payload)
+        return compiled
+    except Exception as e:  # noqa: BLE001 - corrupt/foreign artifact
+        _bump("load_errors")
+        LOG.debug("ignoring unusable AOT artifact %s: %s", path, e)
+        return None
+
+
+def _try_store(kernel: str, key: str, path: str, compiled) -> None:
+    """Serialize + atomic write-rename; failures only cost the cache."""
+    try:
+        from jax.experimental import serialize_executable as _se
+
+        payload = pickle.dumps(_se.serialize(compiled))
+        meta = json.dumps({"key": key, "kernel": kernel}).encode()
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp-aot-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(_MAGIC + meta + b"\n" + payload)
+            os.replace(tmp, path)  # atomic: racing writers both stay valid
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except Exception as e:  # noqa: BLE001 - store is best-effort
+        LOG.debug("could not persist AOT artifact for %s: %s", kernel, e)
+
+
+def _timed_compile(kernel: str, jitted, args):
+    """lower().compile() with the compile-seconds metric and the
+    in-progress marker /debug/crypto surfaces."""
+    global _compile_seq
+    t0 = time.perf_counter()
+    with _lock:
+        _compile_seq += 1
+        token = _compile_seq
+        _compiling[token] = (kernel, t0)
+    try:
+        compiled = jitted.lower(*args).compile()
+    finally:
+        with _lock:
+            _compiling.pop(token, None)
+    dt = time.perf_counter() - t0
+    _bump("compiles")
+    m = _metrics()
+    if m is not None:
+        m.compile_seconds.with_labels(kernel).observe(dt)
+    LOG.info("compiled kernel %s in %.1fs", kernel, dt)
+    return compiled
+
+
+def load_or_compile(kernel: str, static_key: tuple, jitted, args):
+    """One kernel instance: AOT-load from disk if a matching artifact
+    exists, else lower+compile from `args` (concrete arrays or
+    jax.ShapeDtypeStruct) and write the artifact back. Any cache-layer
+    failure degrades to the fresh-compile result."""
+    ensure_configured()
+    m = _metrics()
+    try:
+        key = _full_key(kernel, static_key, args)
+        path = _artifact_path(kernel, key)
+    except Exception as e:  # noqa: BLE001 - never block verification
+        LOG.debug("AOT key derivation failed for %s: %s", kernel, e)
+        key = path = None
+    if path is not None:
+        compiled = _try_load(kernel, key, path)
+        if compiled is not None:
+            _bump("hits")
+            if m is not None:
+                m.compile_cache_hits.inc()
+            return compiled
+        _bump("misses")
+        if m is not None:
+            m.compile_cache_misses.inc()
+    try:
+        compiled = _timed_compile(kernel, jitted, args)
+    except Exception as e:  # noqa: BLE001 - AOT lowering unsupported
+        # e.g. an arg form .lower() can't take: the plain jit function
+        # is always a correct (lazily compiling) stand-in
+        LOG.debug("AOT compile path unavailable for %s (%s); "
+                  "falling back to plain jit", kernel, e)
+        return jitted
+    if path is not None:
+        _try_store(kernel, key, path, compiled)
+    return compiled
+
+
+def aot_wrap(kernel: str, static_key: tuple, jitted) -> Callable:
+    """Wrap a jitted function with the compile-once layer: the first
+    call for each argument-shape signature loads the stored executable
+    (or compiles and stores it); later calls dispatch the executable
+    directly. Drop-in for the jit callable at every existing call site.
+    """
+    cache = _WrapperCache()
+    lock = threading.Lock()
+    with _lock:
+        _wrapper_caches.append(weakref.ref(cache))
+
+    def call(*args):
+        k = tuple(_aval_part(a) for a in args)
+        fn = cache.get(k)
+        if fn is None:
+            with lock:
+                fn = cache.get(k)
+                if fn is None:
+                    fn = load_or_compile(kernel, static_key, jitted, args)
+                    cache[k] = fn
+        return fn(*args)
+
+    def prepare(*args) -> None:
+        """Force the load-or-compile for this signature without
+        executing (args may be jax.ShapeDtypeStruct placeholders) —
+        bench warmstart measures exactly this readiness step."""
+        k = tuple(_aval_part(a) for a in args)
+        with lock:
+            if k not in cache:
+                cache[k] = load_or_compile(kernel, static_key, jitted, args)
+
+    call.prepare = prepare
+    call.kernel_name = kernel
+    return call

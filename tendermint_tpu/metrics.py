@@ -91,6 +91,15 @@ class CryptoMetrics:
     # wire size of the last aggregate commit certificate seen/produced
     # (constant bitmap+96B vs 64B x N — the fast lane's bandwidth story)
     agg_commit_size_bytes: object = NOP
+    # compile-once layer (crypto/kernel_cache.py): wall time of each
+    # XLA lower+compile (labeled by kernel — a node stuck compiling at
+    # boot shows up here), and AOT artifact store hit/miss counters
+    compile_seconds: object = NOP
+    compile_cache_hits: object = NOP
+    compile_cache_misses: object = NOP
+    # cross-height verify scheduler (crypto/batch.py): verify_async
+    # calls that were merged into another caller's dispatch
+    coalesced_calls: object = NOP
 
 
 @dataclass
@@ -433,6 +442,23 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_agg_commit_size_bytes",
             "Wire size of the latest aggregate commit certificate "
             "(signer bitmap + one 96-byte signature)."),
+        compile_seconds=r.histogram(
+            f"{ns}_crypto_compile_seconds",
+            "Wall time of one XLA kernel lower+compile, by kernel.",
+            ("kernel",),
+            buckets=(0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)),
+        compile_cache_hits=r.counter(
+            f"{ns}_crypto_compile_cache_hits_total",
+            "Kernel executables loaded from the AOT artifact store "
+            "(no XLA compile paid)."),
+        compile_cache_misses=r.counter(
+            f"{ns}_crypto_compile_cache_misses_total",
+            "Kernel signatures that missed the AOT artifact store and "
+            "paid a fresh XLA compile."),
+        coalesced_calls=r.counter(
+            f"{ns}_crypto_coalesced_calls_total",
+            "verify_async calls merged into another caller's dispatch "
+            "by the cross-height coalescing scheduler."),
     )
     statesync = StateSyncMetrics(
         snapshots=r.gauge(

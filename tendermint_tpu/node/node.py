@@ -138,8 +138,20 @@ class Node:
         crypto_batch.configure(
             async_dispatch=config.crypto.async_dispatch,
             sig_cache_size=config.crypto.sig_cache_size,
+            coalesce_window_ms=config.crypto.coalesce_window_ms,
+            coalesce_max_batch=config.crypto.coalesce_max_batch,
         )
         self._installed_sig_cache = crypto_batch.get_sig_cache()
+        # compile-once kernel layer: root the persistent XLA cache + AOT
+        # executable store under [crypto] compile_cache_dir (env
+        # TM_TPU_COMPILE_CACHE — or the legacy TM_TPU_JAX_CACHE
+        # spelling — wins for this process; "" disables). Safe before
+        # jax backend init, so boot-time warmup loads warm.
+        from ..crypto import kernel_cache
+
+        if ("TM_TPU_COMPILE_CACHE" not in os.environ
+                and "TM_TPU_JAX_CACHE" not in os.environ):
+            kernel_cache.configure(config.crypto.compile_cache_dir)
         self._enabled_tracing = False
         if config.instrumentation.tracing:
             tracer = tracing.get_tracer()
@@ -607,9 +619,23 @@ class Node:
                 "/debug/statesync": lambda q: self._statesync_status(),
                 "/debug/abci": lambda q: self.proxy_app.status(),
                 "/debug/mempool": lambda q: self.mempool.status(),
+                "/debug/crypto": lambda q: self._crypto_status(),
             },
         )
         self._prof_server.start()
+
+    def _crypto_status(self) -> dict:
+        """The /debug/crypto bundle: compile-once layer state (cache
+        dir, AOT hit/miss counters, any compile in progress — a node
+        wedged compiling at boot shows up here), plus the coalescing
+        scheduler config and live async-batch count."""
+        from ..crypto import batch as crypto_batch
+        from ..crypto import kernel_cache
+
+        out = kernel_cache.status()
+        out["coalesce"] = crypto_batch.coalesce_status()
+        out["inflight_batches"] = crypto_batch.inflight_count()
+        return out
 
     def _statesync_status(self) -> dict:
         """The /debug/statesync bundle: serve-side snapshot inventory +

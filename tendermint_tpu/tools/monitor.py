@@ -135,6 +135,13 @@ class NodeStatus:
     agg_enabled: bool = False
     agg_gossip_merges: int = 0
     agg_cert_bytes: int = 0
+    # compile-once kernel layer view (from /debug/crypto): AOT artifact
+    # store hit/miss counters and any XLA compile currently in progress
+    # (kernel name -> elapsed seconds) — a node wedged compiling at boot
+    # answers /status at height 0 and would otherwise just look slow
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
+    compiling: Dict[str, float] = field(default_factory=dict)
     # mempool pressure view (from /debug/mempool): pool depth vs its
     # cap, per-lane depths, and the batched-preverify ingest queue —
     # a node drowning in tx load keeps answering /status while every
@@ -214,6 +221,9 @@ class NodeStatus:
         self.agg_enabled = False
         self.agg_gossip_merges = 0
         self.agg_cert_bytes = 0
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
+        self.compiling = {}
         self.mempool_size = 0
         self.mempool_max = 0
         self.mempool_bytes = 0
@@ -381,6 +391,18 @@ class Monitor:
             ns.abci_reconnects = 0
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/crypto", timeout=2.0) as r:
+                cr = json.load(r)
+            ns.compile_cache_hits = int(cr.get("hits", 0))
+            ns.compile_cache_misses = int(cr.get("misses", 0))
+            ns.compiling = {str(k): float(v) for k, v in
+                            (cr.get("compiling") or {}).items()}
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.compile_cache_hits = 0
+            ns.compile_cache_misses = 0
+            ns.compiling = {}
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/mempool", timeout=2.0) as r:
                 mp = json.load(r)
             ns.mempool_size = int(mp.get("size", 0))
@@ -490,6 +512,9 @@ class Monitor:
                     "agg_enabled": n.agg_enabled,
                     "agg_gossip_merges": n.agg_gossip_merges,
                     "agg_cert_bytes": n.agg_cert_bytes,
+                    "compile_cache_hits": n.compile_cache_hits,
+                    "compile_cache_misses": n.compile_cache_misses,
+                    "compiling": dict(n.compiling),
                     "mempool_size": n.mempool_size,
                     "mempool_max": n.mempool_max,
                     "mempool_bytes": n.mempool_bytes,
@@ -542,6 +567,10 @@ def main(argv=None) -> int:
                             f"{k}={v}" for k, v in n["abci_conns"].items()
                             if v != "healthy")
                         line += f" [ABCI DEGRADED {bad}]"
+                    if n["compiling"]:
+                        busy = ",".join(f"{k}={v:.0f}s" for k, v
+                                        in n["compiling"].items())
+                        line += f" [COMPILING {busy}]"
                     if n["restore_phase"]:
                         line += (f" restore={n['restore_phase']}"
                                  f" {n['restore_chunks']}")

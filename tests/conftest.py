@@ -26,6 +26,22 @@ try:
 except AttributeError:
     pass  # XLA_FLAGS above already provides the 8 virtual devices
 
+# Compile-once for the test session too: the XLA-compile burners (verify
+# warmup calibration, the jax_ed25519 suites, jax-MSM equivalence) pay
+# the multi-second/minute compiles once per MACHINE instead of per run —
+# the AOT artifacts + XLA cache persist under ~/.cache by default.
+# Opt out with TM_TPU_TEST_COMPILE_CACHE=0 (or point it elsewhere);
+# an explicit TM_TPU_COMPILE_CACHE always wins (kernel_cache reads it).
+_test_cache = os.environ.get("TM_TPU_TEST_COMPILE_CACHE")
+if "TM_TPU_COMPILE_CACHE" not in os.environ:
+    if _test_cache == "0":
+        # genuinely cold: "" disables BOTH cache layers (otherwise
+        # kernel_cache would fall back to the production default dir)
+        os.environ["TM_TPU_COMPILE_CACHE"] = ""
+    else:
+        os.environ["TM_TPU_COMPILE_CACHE"] = (_test_cache or
+            os.path.expanduser("~/.cache/tendermint-tpu/xla-tests"))
+
 import pytest
 
 
@@ -41,11 +57,13 @@ def _crypto_async_hygiene():
 
     from tendermint_tpu.crypto import batch as crypto_batch
 
+    crypto_batch.set_coalesce(window_ms=0)
     crypto_batch.shutdown_dispatchers()
     crypto_batch.set_sig_cache(None)
     crypto_batch.set_async_enabled(True)
     leaked = [
         t for t in threading.enumerate()
-        if t.name.startswith("crypto-dispatch") and t.is_alive()
+        if (t.name.startswith("crypto-dispatch")
+            or t.name.startswith("crypto-coalesce")) and t.is_alive()
     ]
     assert not leaked, f"leaked crypto dispatch threads: {leaked}"

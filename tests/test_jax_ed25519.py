@@ -122,6 +122,27 @@ def test_jax_verify_multidevice(batch):
     assert got == want
 
 
+def test_chunked_composes_with_multidevice(batch, monkeypatch):
+    """PR 8: chunking is no longer forced off on multi-device meshes —
+    every chunk's bpad stays a multiple of ndev so each shards cleanly,
+    and the masks match the single-dispatch mesh path exactly. Same
+    padded dims as test_jax_verify_multidevice, so no extra compile."""
+    import jax
+
+    from tendermint_tpu.crypto.jaxed25519.verify import verify_batch
+
+    ndev = len(jax.devices())
+    msgs = [m for m, _, _, _ in batch]
+    sigs = [s for _, s, _, _ in batch]
+    pks = [p for _, _, p, _ in batch]
+    want = verify_batch(msgs, sigs, pks, devices=ndev)
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNKS", "2")
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNK_MIN", "4")
+    got = verify_batch(msgs, sigs, pks, devices=ndev)
+    assert got == want
+    assert got == [e for _, _, _, e in batch]
+
+
 @pytest.mark.slow  # pallas interpret mode: ~60s on CPU-only hosts (same
 # class as the other slow-marked pallas tests in this file)
 def test_pallas_straus_matches_xla():
@@ -473,3 +494,35 @@ def test_chunked_verify_matches_single_dispatch(monkeypatch):
     got = V.verify_batch(msgs, sigs, pks, devices=1)
     assert got == want
     assert sum(want) == 20  # invalid: i in {1,7,13,19} (13 also malformed)
+
+
+@pytest.mark.slow  # fresh XLA compile: donate=True is its own kernel key
+def test_donated_dispatch_matches_undonated(monkeypatch):
+    """PR 8 donated-buffer dispatch: with TM_TPU_DONATE=1 the packed
+    h2d buffer is donated to the kernel (steady-state device-memory
+    reuse); verdicts must be identical to the undonated path, across
+    repeat dispatches of the same shape (a donated buffer must never be
+    reused by the host after dispatch)."""
+    from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+    items = []
+    for i in range(12):
+        sk, pk = _keypair()
+        m = secrets.token_bytes(80)
+        s = sk.sign(m)
+        if i % 4 == 2:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        items.append((m, s, pk))
+    msgs = [m for m, _, _ in items]
+    sigs = [s for _, s, _ in items]
+    pks = [p for _, _, p in items]
+
+    monkeypatch.setenv("TM_TPU_DONATE", "0")
+    want = V.verify_batch(msgs, sigs, pks, devices=1)
+    monkeypatch.setenv("TM_TPU_DONATE", "1")
+    for _ in range(3):  # steady state: repeated donated dispatches
+        assert V.verify_batch(msgs, sigs, pks, devices=1) == want
+    # chunked + donated: ping-pong host buffers over a donated kernel
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNKS", "2")
+    monkeypatch.setenv("TM_TPU_VERIFY_CHUNK_MIN", "4")
+    assert V.verify_batch(msgs, sigs, pks, devices=1) == want

@@ -1,0 +1,554 @@
+"""Compile-once kernel layer (crypto/kernel_cache.py) and the
+cross-height coalescing verify scheduler (crypto/batch.py).
+
+The kernel-cache tests drive the AOT artifact store with TINY jitted
+kernels (millisecond compiles) so integrity properties — corrupted
+artifacts fall back, foreign keys are ignored, racing writers never
+corrupt an entry, cached ≡ fresh results — run in tier-1 time. The
+real verify kernels route through exactly the same aot_wrap layer
+(tests/test_jax_ed25519.py exercises them end to end, warm via the
+conftest session cache).
+
+Coalescer tests run on the cpu backend: no jax, no compile cost.
+"""
+
+import os
+import threading
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto import kernel_cache
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    """Point the module-global store at a fresh dir for one test, then
+    restore whatever the session (conftest env) had configured."""
+    prev = kernel_cache.cache_dir()
+    d = str(tmp_path / "kc")
+    kernel_cache.configure(d)
+    kernel_cache.reset_stats()
+    yield d
+    if prev:
+        kernel_cache.configure(prev)
+    else:
+        # back to UNCONFIGURED, not disabled: a later test's
+        # ensure_configured() must still pick up the session cache env
+        kernel_cache.unconfigure()
+        kernel_cache.ensure_configured()
+    kernel_cache.reset_stats()
+
+
+_KERNEL_SEQ = [0]
+
+
+def _tiny_kernel(c: int = 3):
+    """A fresh aot_wrap'ed trivial kernel (unique name per call so tests
+    never share artifacts)."""
+    _KERNEL_SEQ[0] += 1
+    name = f"test_tiny_{_KERNEL_SEQ[0]}"
+    return kernel_cache.aot_wrap(name, (c,), jax.jit(lambda x: x * c + 1))
+
+
+def _artifacts(d):
+    aot = os.path.join(d, "aot")
+    return sorted(os.path.join(aot, f) for f in os.listdir(aot)
+                  if f.endswith(".aot"))
+
+
+class TestAOTStore:
+    def test_cold_compile_then_warm_load(self, cache_dir):
+        """First call compiles + persists; dropping the in-memory
+        executable reloads from disk WITHOUT recompiling, and the
+        loaded executable computes the same result (cached ≡ fresh)."""
+        fn = _tiny_kernel()
+        x = np.arange(8, dtype=np.int32)
+        fresh = np.asarray(fn(x))
+        s = kernel_cache.stats()
+        assert s["compiles"] == 1 and s["misses"] == 1 and s["hits"] == 0
+        assert len(_artifacts(cache_dir)) == 1
+
+        kernel_cache.clear_memory()  # simulate a fresh process
+        warm = np.asarray(fn(x))
+        s = kernel_cache.stats()
+        assert s["compiles"] == 1, "warm load must not recompile"
+        assert s["hits"] == 1
+        np.testing.assert_array_equal(fresh, warm)
+
+    def test_stale_version_artifacts_pruned_at_configure(self, cache_dir,
+                                                         tmp_path):
+        """configure() GCs aot/ entries a different jax version wrote
+        (their filename hash embeds the version, so they are
+        permanently unreachable) and day-old crashed-writer tempfiles —
+        live same-version artifacts survive untouched."""
+        import json as _json
+
+        fn = _tiny_kernel()
+        x = np.arange(4, dtype=np.int32)
+        want = np.asarray(fn(x))
+        live = os.path.basename(_artifacts(cache_dir)[0])
+
+        aot = os.path.join(cache_dir, "aot")
+        meta = _json.dumps(
+            {"key": _json.dumps(["0.0.0-foreign"]), "kernel": "x"}).encode()
+        with open(os.path.join(aot, "x-deadbeef.aot"), "wb") as f:
+            f.write(kernel_cache._MAGIC + meta + b"\npayload")
+        with open(os.path.join(aot, "y-cafebabe.aot"), "wb") as f:
+            f.write(b"not an artifact at all")
+        stale_tmp = os.path.join(aot, ".tmp-aot-crashed")
+        open(stale_tmp, "wb").close()
+        os.utime(stale_tmp, (1, 1))
+
+        # prune runs on dir CHANGE: bounce configure through another dir
+        kernel_cache.configure(str(tmp_path / "elsewhere"))
+        kernel_cache.configure(cache_dir)
+        names = os.listdir(aot)
+        assert live in names, "live same-version artifact must survive"
+        assert "x-deadbeef.aot" not in names
+        assert "y-cafebabe.aot" not in names
+        assert ".tmp-aot-crashed" not in names
+
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        np.testing.assert_array_equal(want, np.asarray(fn(x)))
+        assert kernel_cache.stats()["compiles"] == 0  # still warm
+
+    def test_distinct_shapes_distinct_artifacts(self, cache_dir):
+        fn = _tiny_kernel()
+        fn(np.arange(8, dtype=np.int32))
+        fn(np.arange(16, dtype=np.int32))
+        assert kernel_cache.stats()["compiles"] == 2
+        assert len(_artifacts(cache_dir)) == 2
+        # both signatures warm-load independently
+        kernel_cache.clear_memory()
+        fn(np.arange(16, dtype=np.int32))
+        fn(np.arange(8, dtype=np.int32))
+        s = kernel_cache.stats()
+        assert s["compiles"] == 2 and s["hits"] == 2
+
+    def test_truncated_artifact_falls_back_to_fresh_compile(self, cache_dir):
+        fn = _tiny_kernel()
+        x = np.arange(8, dtype=np.int32)
+        want = np.asarray(fn(x))
+        path = _artifacts(cache_dir)[0]
+        with open(path, "r+b") as f:
+            f.truncate(10)
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        got = np.asarray(fn(x))  # no crash, no wrong verdicts
+        np.testing.assert_array_equal(want, got)
+        s = kernel_cache.stats()
+        assert s["load_errors"] == 1 and s["misses"] == 1
+        assert s["compiles"] == 1  # fresh compile replaced the artifact
+        # ...and the rewritten artifact is valid again
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        np.testing.assert_array_equal(want, np.asarray(fn(x)))
+        assert kernel_cache.stats()["hits"] == 1
+
+    def test_garbage_payload_falls_back(self, cache_dir):
+        fn = _tiny_kernel()
+        x = np.arange(8, dtype=np.int32)
+        want = np.asarray(fn(x))
+        path = _artifacts(cache_dir)[0]
+        with open(path, "rb") as f:
+            blob = f.read()
+        head, _, _ = blob.partition(b"\n")  # keep magic+meta, trash payload
+        with open(path, "wb") as f:
+            f.write(head + b"\n" + os.urandom(256))
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        np.testing.assert_array_equal(want, np.asarray(fn(x)))
+        s = kernel_cache.stats()
+        assert s["load_errors"] == 1 and s["compiles"] == 1
+
+    def test_foreign_key_ignored(self, cache_dir):
+        """An artifact whose embedded key names a different jax version
+        / backend string is ignored (fresh compile), never trusted."""
+        import json
+
+        fn = _tiny_kernel()
+        x = np.arange(8, dtype=np.int32)
+        want = np.asarray(fn(x))
+        path = _artifacts(cache_dir)[0]
+        with open(path, "rb") as f:
+            blob = f.read()
+        magic = blob[:len(b"TMTPU-AOT1 ")]
+        rest = blob[len(magic):]
+        meta_raw, _, payload = rest.partition(b"\n")
+        meta = json.loads(meta_raw.decode())
+        key = json.loads(meta["key"])
+        key[0] = "0.0.0-other-jax"  # jax version field of the key
+        meta["key"] = json.dumps(key, sort_keys=True)
+        with open(path, "wb") as f:
+            f.write(magic + json.dumps(meta).encode() + b"\n" + payload)
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        np.testing.assert_array_equal(want, np.asarray(fn(x)))
+        s = kernel_cache.stats()
+        assert s["load_errors"] == 1 and s["hits"] == 0
+        assert s["compiles"] == 1
+
+    def test_concurrent_writers_never_corrupt(self, cache_dir):
+        """Threads racing load-or-compile on the SAME entry (the
+        process-race analogue; os.replace atomicity is identical):
+        every caller gets correct results and the surviving artifact
+        file is loadable."""
+        fn = _tiny_kernel()
+        x = np.arange(8, dtype=np.int32)
+        want = list(range(1, 25, 3))
+        results, errs = [], []
+
+        def worker():
+            try:
+                results.append(np.asarray(fn(x)).tolist())
+            except Exception as e:  # noqa: BLE001 - fail the test below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        assert not errs
+        assert all(r == want for r in results)
+        # the entry on disk is valid: a "fresh process" warm-loads it
+        kernel_cache.clear_memory()
+        kernel_cache.reset_stats()
+        assert np.asarray(fn(x)).tolist() == want
+        s = kernel_cache.stats()
+        assert s["hits"] == 1 and s["load_errors"] == 0
+
+    def test_stale_tempfile_is_harmless(self, cache_dir):
+        aot = os.path.join(cache_dir, "aot")
+        with open(os.path.join(aot, ".tmp-aot-crashed"), "wb") as f:
+            f.write(b"a writer died here")
+        fn = _tiny_kernel()
+        assert np.asarray(fn(np.arange(4, dtype=np.int32))).tolist() \
+            == [1, 4, 7, 10]
+
+    def test_disabled_cache_still_verifies(self, tmp_path):
+        prev = kernel_cache.cache_dir()
+        try:
+            kernel_cache.configure("")  # explicit opt-out
+            kernel_cache.reset_stats()
+            fn = _tiny_kernel()
+            assert np.asarray(fn(np.arange(4, dtype=np.int32))).tolist() \
+                == [1, 4, 7, 10]
+            s = kernel_cache.stats()
+            assert s["hits"] == 0 and s["misses"] == 0  # store bypassed
+        finally:
+            kernel_cache.configure(prev)
+            kernel_cache.reset_stats()
+
+    def test_prepare_readies_without_executing(self, cache_dir):
+        """prepare() (bench warmstart's readiness probe) compiles from a
+        ShapeDtypeStruct; the later concrete call reuses the executable
+        with no second compile."""
+        fn = _tiny_kernel()
+        fn.prepare(jax.ShapeDtypeStruct((8,), jnp.int32))
+        assert kernel_cache.stats()["compiles"] == 1
+        out = np.asarray(fn(np.arange(8, dtype=np.int32)))
+        assert kernel_cache.stats()["compiles"] == 1
+        assert out.tolist() == list(range(1, 25, 3))
+
+    def test_donated_equals_undonated(self, cache_dir):
+        """donate_argnums is a compile-key dimension, not a semantics
+        one: the donated executable computes identical results."""
+        base = lambda x: (x * 7 + 5) % 11  # noqa: E731
+        plain = kernel_cache.aot_wrap("t_undonated", (), jax.jit(base))
+        donated = kernel_cache.aot_wrap(
+            "t_donated", (), jax.jit(base, donate_argnums=(0,)))
+        x = np.arange(32, dtype=np.int32)
+        want = np.asarray(plain(x))
+        got = np.asarray(donated(np.arange(32, dtype=np.int32)))
+        np.testing.assert_array_equal(want, got)
+
+    def test_status_bundle_shape(self, cache_dir):
+        fn = _tiny_kernel()
+        fn(np.arange(4, dtype=np.int32))
+        st = kernel_cache.status()
+        assert st["enabled"] and st["dir"] == cache_dir
+        assert st["compiles"] == 1 and st["compiling"] == {}
+
+
+def _triple(i=0, valid=True):
+    sk = PrivKeyEd25519.gen_from_secret(b"coal-%d" % i)
+    msg = b"cmsg-%d" % i
+    sig = sk.sign(msg)
+    if not valid:
+        sig = bytes([sig[0] ^ 1]) + sig[1:]
+    return (msg, sig, sk.pub_key().bytes())
+
+
+@pytest.fixture
+def coalesce_window():
+    crypto_batch.set_coalesce(window_ms=25, max_batch=8192)
+    yield
+    crypto_batch.set_coalesce(window_ms=0, max_batch=8192)
+    crypto_batch.shutdown_dispatchers()
+
+
+class TestCoalescer:
+    def test_coalesced_equals_sequential(self, coalesce_window):
+        """Property: merged dispatch returns exactly the per-caller
+        masks sequential dispatch would — mixed validity, mixed sizes,
+        add order preserved."""
+        batches = [
+            [_triple(10 * k + j, valid=((j + k) % 3 != 0))
+             for j in range(k + 1)]
+            for k in range(6)
+        ]
+        wants = [crypto_batch.batch_verify(b, backend="cpu")
+                 for b in batches]
+        futs = []
+        for b in batches:
+            bv = crypto_batch.CPUBatchVerifier()
+            for t in b:
+                bv.add(*t)
+            futs.append(bv.verify_async())
+        got = [f.result(timeout=30) for f in futs]
+        assert got == wants
+
+    def test_callers_actually_merged(self, coalesce_window):
+        """Submissions inside one window produce ONE backend dispatch
+        (observed via a counting subclass), not one per caller."""
+        calls = []
+
+        class Counting(crypto_batch.CPUBatchVerifier):
+            def _verify(self):
+                calls.append(len(self._items))
+                return super()._verify()
+
+        futs = []
+        for k in range(4):
+            bv = Counting()
+            for t in [_triple(100 + 10 * k + j) for j in range(3)]:
+                bv.add(*t)
+            futs.append(bv.verify_async())
+        for f in futs:
+            assert f.result(timeout=30) == [True] * 3
+        assert sum(calls) == 12
+        assert len(calls) < 4, f"expected merged dispatches, got {calls}"
+
+    def test_distinct_instance_keys_do_not_merge(self, coalesce_window):
+        """A merged batch runs entirely on the FIRST caller's verifier
+        instance, so verifiers carrying different per-instance dispatch
+        policy (_coalesce_key — e.g. AdaptiveBatchVerifier's
+        factory/threshold) must never share a dispatch."""
+        calls = []
+
+        class Keyed(crypto_batch.CPUBatchVerifier):
+            def __init__(self, key):
+                super().__init__()
+                self._key = key
+
+            def _coalesce_key(self):
+                return (self._key,)
+
+            def _verify(self):
+                calls.append((self._key, len(self._items)))
+                return super()._verify()
+
+        futs = []
+        for k in range(4):
+            bv = Keyed(k % 2)
+            for t in [_triple(400 + 10 * k + j) for j in range(2)]:
+                bv.add(*t)
+            futs.append(bv.verify_async())
+        for f in futs:
+            assert f.result(timeout=30) == [True, True]
+        # every dispatch carries exactly one policy key, and each key's
+        # four items were verified under ITS instances — a cross-key
+        # merge would count one key's items under the other's policy
+        for key in (0, 1):
+            assert sum(n for k, n in calls if k == key) == 4, calls
+
+    def test_exception_fans_out_and_thread_survives(self, coalesce_window):
+        class Exploding(crypto_batch.CPUBatchVerifier):
+            def _verify(self):
+                raise RuntimeError("backend boom")
+
+        futs = []
+        for k in range(3):
+            bv = Exploding()
+            bv.add(*_triple(200 + k))
+            futs.append(bv.verify_async())
+        for f in futs:
+            with pytest.raises(RuntimeError, match="backend boom"):
+                f.result(timeout=30)
+        # the scheduler thread survives and serves later batches
+        bv = crypto_batch.CPUBatchVerifier()
+        bv.add(*_triple(250))
+        assert bv.verify_async().result(timeout=30) == [True]
+        assert crypto_batch.inflight_count() == 0
+
+    def test_max_batch_splits_oversize_groups(self):
+        crypto_batch.set_coalesce(window_ms=25, max_batch=4)
+        try:
+            futs = []
+            for k in range(3):
+                bv = crypto_batch.CPUBatchVerifier()
+                for t in [_triple(300 + 10 * k + j) for j in range(3)]:
+                    bv.add(*t)
+                futs.append(bv.verify_async())
+            assert all(f.result(timeout=30) == [True] * 3 for f in futs)
+        finally:
+            crypto_batch.set_coalesce(window_ms=0, max_batch=8192)
+            crypto_batch.shutdown_dispatchers()
+
+    def test_window_off_means_no_scheduler(self):
+        crypto_batch.set_coalesce(window_ms=0)
+        bv = crypto_batch.CPUBatchVerifier()
+        bv.add(*_triple(400))
+        assert bv.verify_async().result(timeout=30) == [True]
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("crypto-coalesce")]
+
+    def test_empty_verifier_skips_coalescer(self, coalesce_window):
+        bv = crypto_batch.CPUBatchVerifier()
+        assert bv.verify_async().result(timeout=30) == []
+
+    def test_shutdown_resolves_pending(self):
+        """stop() drains: futures submitted right before shutdown still
+        resolve (the invariant the dispatcher path already guarantees)."""
+        crypto_batch.set_coalesce(window_ms=500, max_batch=8192)
+        try:
+            bv = crypto_batch.CPUBatchVerifier()
+            bv.add(*_triple(500))
+            fut = bv.verify_async()  # parked in the 500ms window
+            crypto_batch.shutdown_dispatchers()
+            assert fut.result(timeout=10) == [True]
+        finally:
+            crypto_batch.set_coalesce(window_ms=0)
+
+    def test_coalesced_calls_metric(self, coalesce_window):
+        from tendermint_tpu.metrics import prometheus_metrics
+
+        ms = prometheus_metrics("tm")
+        crypto_batch.set_metrics(ms.crypto)
+        try:
+            futs = []
+            for k in range(3):
+                bv = crypto_batch.CPUBatchVerifier()
+                bv.add(*_triple(600 + k))
+                futs.append(bv.verify_async())
+            for f in futs:
+                f.result(timeout=30)
+            body = ms.registry.render()
+            assert "tm_crypto_coalesced_calls_total" in body
+        finally:
+            crypto_batch.set_metrics(None)
+
+    def test_config_plumbs_coalesce_knobs(self):
+        crypto_batch.configure(coalesce_window_ms=7.5,
+                               coalesce_max_batch=123)
+        try:
+            st = crypto_batch.coalesce_status()
+            assert st["window_ms"] == 7.5 and st["max_batch"] == 123
+        finally:
+            crypto_batch.set_coalesce(window_ms=0, max_batch=8192)
+
+
+class TestHostBufRing:
+    def test_ring_distinct_within_reused_across(self):
+        """The chunked dispatch's host ring: every chunk of one call
+        gets its OWN buffer (no repack under an in-flight async
+        transfer), and back-to-back calls with the same (chunks, shape)
+        reuse the same memory; a shape change swaps the pool."""
+        from tendermint_tpu.crypto.jaxed25519 import verify as V
+
+        a = V._host_buf_ring(3, (57, 64))
+        assert len(a) == 3
+        assert len({id(b) for b in a}) == 3  # distinct per chunk
+        assert all(b.shape == (57, 64) and b.dtype == np.int32 for b in a)
+        b = V._host_buf_ring(3, (57, 64))
+        assert [id(x) for x in a] == [id(x) for x in b]  # cross-call reuse
+        c = V._host_buf_ring(2, (57, 128))
+        assert len(c) == 2 and c[0].shape == (57, 128)
+
+    def test_wrapper_cache_weakly_held(self, cache_dir):
+        """An aot_wrap dropped by its caller (lru_cache eviction) must
+        free its executables — the registry holds them weakly."""
+        import gc
+
+        fn = _tiny_kernel()
+        fn(np.arange(4, dtype=np.int32))
+        live_before = sum(1 for r in kernel_cache._wrapper_caches
+                          if r() is not None)
+        del fn
+        gc.collect()
+        kernel_cache.clear_memory()  # also prunes dead refs
+        live_after = sum(1 for r in kernel_cache._wrapper_caches
+                         if r() is not None)
+        assert live_after < live_before
+
+
+class TestObservability:
+    def test_node_crypto_status_bundle(self, cache_dir):
+        """The /debug/crypto provider bundle: kernel-cache state +
+        coalescer config + inflight count, JSON-serializable."""
+        import json
+
+        from tendermint_tpu.node.node import Node
+
+        out = Node._crypto_status(None)  # uses only module state
+        json.dumps(out)
+        assert out["dir"] == cache_dir and out["enabled"]
+        assert "compiling" in out and "coalesce" in out
+        assert out["inflight_batches"] == 0
+
+    def test_monitor_surfaces_compiling_node(self):
+        """A node stuck compiling at boot is visible in the monitor
+        snapshot (compiling kernel -> elapsed seconds) and the view
+        resets when the debug endpoint goes away."""
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from tendermint_tpu.tools.monitor import Monitor
+
+        payload = {
+            "dwell_s": 0.1, "threshold_s": 30.0, "stalls_total": 0,
+            "stalls": [], "live": {"peers": []},
+            # the same stub answers every /debug route; crypto keys:
+            "hits": 3, "misses": 1,
+            "compiling": {"ed25519_packed": 42.5},
+        }
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = _json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        daddr = "%s:%d" % srv.server_address[:2]
+        try:
+            mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+            ns = mon.nodes["rpc-addr"]
+            ns.mark_online()
+            mon._poll_debug(ns, daddr)
+            assert ns.compiling == {"ed25519_packed": 42.5}
+            assert ns.compile_cache_hits == 3
+            snap = mon.snapshot()
+            assert snap["nodes"][0]["compiling"] == {"ed25519_packed": 42.5}
+            assert snap["nodes"][0]["compile_cache_misses"] == 1
+            ns.clear_debug_view()
+            assert ns.compiling == {} and ns.compile_cache_hits == 0
+        finally:
+            srv.shutdown()
+            srv.server_close()
