@@ -58,6 +58,7 @@ CHAOS_MODE = "chaos" in sys.argv[1:]  # ABCI reconnect recovery (PR 5)
 LOAD_MODE = "load" in sys.argv[1:]  # sustained-TPS mempool localnet (PR 6)
 PREVERIFY_MODE = "preverify" in sys.argv[1:]  # batched vs serial CheckTx
 AGGVERIFY_MODE = "aggverify" in sys.argv[1:]  # BLS aggregate cert (PR 7)
+RPCLOAD_MODE = "rpcload" in sys.argv[1:]  # RPC fan-out serving (PR 9)
 WARMSTART_MODE = "warmstart" in sys.argv[1:]  # compile-once readiness (PR 8)
 MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
@@ -105,6 +106,10 @@ AGG_NVAL = _env_int("TM_TPU_BENCH_AGG_NVAL", 10000)
 AGG_METRIC = f"aggverify_{AGG_NVAL}val_commit_wall_ms"
 WARM_N = _env_int("TM_TPU_BENCH_WARM_N", 10000)
 WARM_METRIC = f"warmstart_ready_{WARM_N}sigs_wall_ms"
+RPC_SUBS = _env_int("TM_TPU_BENCH_RPC_SUBS", 100)
+RPC_QUERIES = _env_int("TM_TPU_BENCH_RPC_QUERIES", 2000)
+RPC_THREADS = _env_int("TM_TPU_BENCH_RPC_THREADS", 4)
+RPCLOAD_METRIC = f"rpc_serving_{RPC_SUBS}subs_hot_status_p50_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -905,6 +910,210 @@ def load_main():
     return 0
 
 
+def rpcload_main():
+    """`bench.py rpcload` — RPC serving at fan-out scale: a single-
+    validator in-process node answers a concurrent mixed read load
+    (status/block/validators) through the serving layer twice — once
+    with the height/generation byte cache on, once bypassed — and then
+    fans NewBlock events out to RPC_SUBS live websocket subscribers,
+    reporting the render-once funnel (renders vs frames delivered).
+    Pure host path; the JSON line is the hot-status p50 with
+    vs_baseline = uncached_p50 / cached_p50."""
+    import tempfile
+    import threading
+
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.rpc import core as rpc_core
+    from tendermint_tpu.rpc.client import WSClient
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK, query_for_event)
+
+    with tempfile.TemporaryDirectory(prefix="bench_rpcload_") as root:
+        c = cfg.test_config()
+        c.set_root(root)
+        c.base.proxy_app = "kvstore"
+        c.base.moniker = "bench-rpcload"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        c.rpc.cache_bytes = 32 << 20
+        c.rpc.ws_send_queue = 512
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        # a slow-ish cadence leaves clear gaps between blocks, so the
+        # fan-out phase can align its counting window to the block
+        # schedule and compare renders vs deliveries exactly
+        c.consensus.create_empty_blocks_interval = 0.6
+        cfg.ensure_root(root)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        pv = load_or_gen_file_pv(c.base.priv_validator_path())
+        GenesisDoc(
+            chain_id="bench-rpcload",
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        ).save(c.base.genesis_path())
+        node = default_new_node(c)
+        sub = node.event_bus.subscribe(
+            "bench-rpcload", query_for_event(EVENT_NEW_BLOCK), 64)
+        node.start()
+        try:
+            deadline = time.time() + 60
+            while node.block_store.height() < 2 and time.time() < deadline:
+                sub.get(timeout=0.5)
+            if node.block_store.height() < 2:
+                raise RuntimeError("node never committed 2 blocks")
+            srv = node._rpc_server
+
+            queries = [("status", {}), ("block", {"height": 1}),
+                       ("validators", {})]
+
+            def run_load():
+                """RPC_QUERIES mixed calls across RPC_THREADS threads
+                through the serving layer; returns {method: [ms...]}."""
+                lats = {m: [] for m, _ in queries}
+                lock = threading.Lock()
+                per_thread = RPC_QUERIES // RPC_THREADS
+
+                def worker():
+                    local = {m: [] for m, _ in queries}
+                    for i in range(per_thread):
+                        m, p = queries[i % len(queries)]
+                        t0 = time.perf_counter()
+                        srv.call_bytes(m, p)
+                        local[m].append(
+                            (time.perf_counter() - t0) * 1000)
+                    with lock:
+                        for m in local:
+                            lats[m].extend(local[m])
+
+                ts = [threading.Thread(target=worker)
+                      for _ in range(RPC_THREADS)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return lats
+
+            def _pct(samples, p):
+                s = sorted(samples)
+                return s[min(len(s) - 1, int(p * len(s)))] if s else -1.0
+
+            # warm the cache, then the cached run; then bypass the
+            # cache entirely for the baseline (same handlers, full
+            # render + encode per call — today's serving path)
+            for m, p in queries:
+                srv.call_bytes(m, p)
+            cached = run_load()
+            saved_cache, srv.cache = srv.cache, None
+            try:
+                uncached = run_load()
+            finally:
+                srv.cache = saved_cache
+
+            # fan-out: RPC_SUBS real websocket subscribers, NewBlock
+            clients = []
+            for _ in range(RPC_SUBS):
+                w = WSClient(node.rpc_listen_addr)
+                w.connect(timeout=10.0)
+                w.subscribe("tm.event = 'NewBlock'")
+                clients.append(w)
+
+            delivered = {}  # height -> frames read
+
+            def drain_all(record=True) -> int:
+                got = 0
+                for w in clients:
+                    while True:
+                        ev = w.next_event(timeout=0)
+                        if ev is None:
+                            break
+                        got += 1
+                        if record:
+                            try:
+                                h = (ev["data"]["value"]["block"]
+                                     ["header"]["height"])
+                            except (KeyError, TypeError):
+                                continue
+                            delivered[h] = delivered.get(h, 0) + 1
+                return got
+
+            def settle():
+                """Align to the block schedule: wait for the next
+                NewBlock on the node bus (render + delivery start at
+                that instant), give its frames a beat to reach every
+                client reader, and drain them — the next block is then
+                a comfortable fraction of the 0.6s interval away, so a
+                snapshot taken now sits in quiet air with nothing in
+                flight between renderer, queues, and clients."""
+                while sub.get(timeout=0.0) is not None:
+                    pass  # clear bus backlog
+                if sub.get(timeout=10.0) is None:
+                    raise RuntimeError("chain stopped producing blocks")
+                time.sleep(0.2)
+                drain_all()
+
+            # discard the connect-phase boundary (clients subscribed
+            # at different instants), then count a clean window
+            settle()
+            delivered.clear()
+            renders0 = rpc_core.events_rendered_count()
+            t0 = time.perf_counter()
+            window_s = 3.0
+            end = time.perf_counter() + window_s
+            while time.perf_counter() < end:
+                drain_all()
+                time.sleep(0.02)
+            settle()
+            renders = rpc_core.events_rendered_count() - renders0
+            frames = sum(delivered.values())
+            for w in clients:
+                w.close()
+            fanout_s = time.perf_counter() - t0
+
+            cached_p50 = _pct(cached["status"], 0.50)
+            uncached_p50 = _pct(uncached["status"], 0.50)
+            print(json.dumps({
+                "metric": RPCLOAD_METRIC,
+                "value": round(cached_p50, 4),
+                "unit": "ms",
+                "vs_baseline": round(uncached_p50 / max(cached_p50, 1e-9),
+                                     2),
+                "status_p50_ms": round(cached_p50, 4),
+                "status_p99_ms": round(_pct(cached["status"], 0.99), 4),
+                "status_uncached_p50_ms": round(uncached_p50, 4),
+                "status_uncached_p99_ms": round(
+                    _pct(uncached["status"], 0.99), 4),
+                "block_p50_ms": round(_pct(cached["block"], 0.50), 4),
+                "block_uncached_p50_ms": round(
+                    _pct(uncached["block"], 0.50), 4),
+                "validators_p50_ms": round(
+                    _pct(cached["validators"], 0.50), 4),
+                "validators_uncached_p50_ms": round(
+                    _pct(uncached["validators"], 0.50), 4),
+                "cache_hit_rate": srv.cache.stats()["hit_rate"],
+                "subscribers": RPC_SUBS,
+                "fanout_events": len(delivered),
+                "fanout_renders": renders,
+                "fanout_frames_delivered": frames,
+                "renders_per_event": round(
+                    renders / max(len(delivered), 1), 2),
+                "fanout_window_s": round(fanout_s, 2),
+                "note": ("in-process node; mixed status/block/validators"
+                         f" x{RPC_QUERIES} over {RPC_THREADS} threads, "
+                         "cached (pre-encoded bytes) vs uncached "
+                         "(handler+encode); render-once websocket "
+                         "fan-out — renders advance per event, frames "
+                         "per (event x subscriber)"),
+            }))
+        finally:
+            node.stop()
+    return 0
+
+
 def aggverify_main():
     """`bench.py aggverify` — the aggregate-signature fast lane: ONE
     BLS commit certificate (signer bitmap + 96-byte aggregate) verified
@@ -1176,6 +1385,9 @@ def main():
     if AGGVERIFY_MODE:
         # pure host path like commit4/preverify: no TPU probe
         return aggverify_main()
+    if RPCLOAD_MODE:
+        # pure host serving path: no TPU probe
+        return rpcload_main()
     degraded = None
     if os.environ.get("TM_TPU_BENCH_FORCE_CPU"):
         degraded = "cpu8-forced"  # BASELINE config 2: by-design CPU mode

@@ -252,6 +252,15 @@ REQUIRED_FAMILIES = (
     "crypto_compile_cache_hits_total",
     "crypto_compile_cache_misses_total",
     "crypto_coalesced_calls_total",
+    # PR-9 RPC fan-out serving (declaration presence: a node with
+    # caching off or no websocket subscribers legitimately records no
+    # samples; rpc_ws_dropped_total only fires under slow clients)
+    "rpc_cache_hits_total",
+    "rpc_cache_misses_total",
+    "rpc_cache_bytes",
+    "rpc_ws_subscribers",
+    "rpc_ws_dropped_total",
+    "rpc_events_rendered_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

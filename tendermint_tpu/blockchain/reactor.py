@@ -40,6 +40,10 @@ _KNOWN_MSG_KINDS = frozenset((
 
 TRY_SYNC_INTERVAL = 0.01  # reactor.go:31 trySyncIntervalMS
 STATUS_UPDATE_INTERVAL = 10.0  # reactor.go:34
+# replica tail mode never hands off to consensus, so peer status polls
+# are its only way to learn new heights — poll much faster than the
+# catch-up default or the replica trails the chain by whole seconds
+TAIL_STATUS_UPDATE_INTERVAL = 0.5
 SWITCH_TO_CONSENSUS_INTERVAL = 1.0  # reactor.go:37
 SYNC_BATCH = 10  # blocks applied per didProcess burst
 
@@ -66,13 +70,20 @@ class _SpeculativeVerify:
 
 
 class BlockchainReactor(Reactor):
-    def __init__(self, state, block_exec, block_store, fast_sync: bool, consensus_reactor=None):
+    def __init__(self, state, block_exec, block_store, fast_sync: bool,
+                 consensus_reactor=None, tail_forever: bool = False):
+        """`tail_forever` is replica mode ([base] mode = replica): the
+        sync loop never stops and never hands off to consensus — the
+        node permanently tails committed blocks (verify → apply →
+        publish events) and serves reads. resume_fast_sync after a
+        state-sync bootstrap re-enters the same endless loop."""
         super().__init__("BlockchainReactor")
         self.initial_state = state
         self.state = state
         self.block_exec = block_exec
         self.store = block_store
         self.fast_sync = fast_sync
+        self.tail_forever = tail_forever
         self.consensus_reactor = consensus_reactor  # for switch_to_consensus
         self._stop = threading.Event()
         self._pool_thread: Optional[threading.Thread] = None
@@ -200,14 +211,35 @@ class BlockchainReactor(Reactor):
 
     # -- the sync loop -------------------------------------------------
 
+    @property
+    def catching_up(self) -> bool:
+        """/status sync_info.catching_up: a tailing replica that is at
+        (or within the one-block verify lag of) its best peer height is
+        serving live data, not catching up."""
+        if not self.fast_sync:
+            return False
+        if not self.tail_forever:
+            return True
+        max_peer = self.pool.max_peer_height()
+        if max_peer <= 0:
+            # no peer height known (fresh boot, partition): claiming
+            # "caught up" here would route read traffic to a replica
+            # serving arbitrarily stale data — stay conservative
+            return True
+        # the tail verifies block h with h+1's commit, so a healthy
+        # replica legitimately sits one block behind the tip it knows
+        return self.store.height() < max_peer - 1
+
     def _pool_routine(self) -> None:
         """reactor.go:216-359."""
         last_status = 0.0
         last_switch_check = 0.0
+        status_interval = (TAIL_STATUS_UPDATE_INTERVAL if self.tail_forever
+                           else STATUS_UPDATE_INTERVAL)
         self._broadcast_status_request()
         while not self._stop.is_set() and self.pool.is_running():
             now = time.monotonic()
-            if now - last_status >= STATUS_UPDATE_INTERVAL:
+            if now - last_status >= status_interval:
                 last_status = now
                 self._broadcast_status_request()
             if now - last_switch_check >= SWITCH_TO_CONSENSUS_INTERVAL:
@@ -218,11 +250,17 @@ class BlockchainReactor(Reactor):
                 time.sleep(TRY_SYNC_INTERVAL)
 
     def _maybe_switch_to_consensus(self) -> bool:
-        """reactor.go:258-280."""
+        """reactor.go:258-280. Replicas (tail_forever) never switch:
+        the pool keeps running and the loop keeps tailing new blocks."""
+        if self.tail_forever:
+            return False
         height, num_pending, total = self.pool.get_status()
         if self.pool.is_caught_up():
             LOG.info("caught up at height %d; switching to consensus", height - 1)
             self.pool.stop()
+            # the node is no longer syncing: /status catching_up must
+            # flip here, not stay pinned at the boot-time value
+            self.fast_sync = False
             if self.consensus_reactor is not None:
                 self.consensus_reactor.switch_to_consensus(self.state, self.blocks_synced)
             return True

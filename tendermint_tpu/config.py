@@ -18,6 +18,12 @@ class BaseConfig:
     root_dir: str = ""
     chain_id: str = ""
     moniker: str = "anonymous"
+    # "full" (default: the reference node — consensus + serving) or
+    # "replica": a non-validating read node that bootstraps via state
+    # sync, permanently tails blocks through the fast-sync reactor
+    # (never starts consensus), and serves the full RPC/subscription
+    # surface — read traffic scales horizontally by adding replicas
+    mode: str = "full"
     fast_sync: bool = True
     db_backend: str = "filedb"  # memdb | filedb | native
     db_dir: str = "data"
@@ -49,13 +55,33 @@ class BaseConfig:
 
 @dataclass
 class RPCConfig:
-    """reference config/config.go:262-347"""
+    """reference config/config.go:262-347 (+ the fan-out-scale serving
+    knobs, ours: response caching, websocket backpressure, and the
+    broadcast_tx_commit wait).
+
+    cache_bytes: byte budget for the height/generation response cache
+    (rpc/cache.py) serving pre-encoded JSON for hot read endpoints
+    (block/commit/block_results/validators/blockchain at a fixed
+    height; status and latest-height variants per block generation).
+    0 (default) disables caching — every request runs its handler.
+    ws_send_queue: bounded per-websocket-client event queue drained by
+    a writer thread; a slow client backs up only its own queue.
+    ws_slow_policy: what happens when that queue is full — "drop"
+    sheds the event with a counter (rpc_ws_dropped_total), keeping the
+    connection; "disconnect" hangs up so the client's reconnect logic
+    resubscribes from live state.
+    timeout_broadcast_tx_commit: seconds broadcast_tx_commit waits for
+    the DeliverTx event (the reference hard-codes 10s)."""
 
     laddr: str = "tcp://0.0.0.0:26657"
     grpc_laddr: str = ""
     grpc_max_open_connections: int = 900
     unsafe: bool = False
     max_open_connections: int = 900
+    cache_bytes: int = 0
+    ws_send_queue: int = 256
+    ws_slow_policy: str = "drop"  # drop | disconnect
+    timeout_broadcast_tx_commit: float = 10.0
 
 
 @dataclass

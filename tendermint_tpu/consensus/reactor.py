@@ -329,6 +329,48 @@ class PeerState:
             return vote
 
 
+class ReplicaConsensusAbsorber(Reactor):
+    """Owns the four consensus channels on a read replica ([base]
+    mode = replica) WITHOUT any consensus machinery behind them.
+
+    Peers running real consensus gossip votes/steps to every connected
+    peer; a node that advertised no owner for those channels would
+    disconnect each validator on the first inbound frame (the switch
+    treats an unowned channel as a protocol error). The absorber keeps
+    the wire protocol intact and drops the traffic — validators' gossip
+    routines see a peer that never advances past height 0 and mostly
+    sleep (reactor.go's prs.height == 0 guards). The replica itself
+    never sends a consensus message."""
+
+    def __init__(self):
+        super().__init__("ReplicaConsensusAbsorber")
+        self.absorbed = 0  # frames dropped; /debug visibility only
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=1,
+                              send_queue_capacity=2),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=1,
+                              send_queue_capacity=2,
+                              recv_message_capacity=1048576),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=1,
+                              send_queue_capacity=2,
+                              recv_message_capacity=100 * 1024),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2,
+                              recv_message_capacity=1024),
+        ]
+
+    def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
+        self.absorbed += 1
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
 class ConsensusReactor(Reactor):
     """reactor.go:37."""
 

@@ -178,13 +178,21 @@ class Subscription:
         self._cond = threading.Condition()
         self._cancelled = False
         self.capacity = capacity
+        # messages shed because the buffer was full — consumers that
+        # care about loss (the RPC fan-out layer applies its own
+        # slow-client policy downstream) can watch this instead of the
+        # drop being silent
+        self.dropped = 0
 
     def publish(self, msg: Message) -> bool:
         with self._cond:
             if self._cancelled:
                 return False
             if len(self._buf) >= self.capacity:
-                return False  # slow subscriber: drop (reference: err/unsubscribe)
+                # slow subscriber: drop (reference: err/unsubscribe),
+                # but never silently — the counter is the trace
+                self.dropped += 1
+                return False
             self._buf.append(msg)
             self._cond.notify_all()
             return True

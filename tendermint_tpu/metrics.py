@@ -218,6 +218,28 @@ class MempoolMetrics:
 
 
 @dataclass
+class RPCMetrics:
+    """Fan-out serving telemetry (rpc/cache.py + rpc/server.py; no
+    reference equivalent — the reference re-marshals every response and
+    renders every event per subscriber)."""
+
+    # height/generation response cache: requests served from cached
+    # pre-encoded bytes vs. run through a handler + encoder, and the
+    # bytes currently resident against [rpc] cache_bytes
+    cache_hits: object = NOP
+    cache_misses: object = NOP
+    cache_bytes: object = NOP
+    # live websocket subscriptions across all clients
+    ws_subscribers: object = NOP
+    # event frames shed (or connections cut) by the slow-client policy,
+    # labeled policy=drop|disconnect
+    ws_dropped: object = NOP
+    # events rendered to wire bytes — with render-once fan-out this
+    # advances once per event, not once per (event x subscriber)
+    events_rendered: object = NOP
+
+
+@dataclass
 class StateMetrics:
     """state/metrics.go:10-22"""
 
@@ -233,6 +255,7 @@ class NodeMetrics:
     state: StateMetrics = field(default_factory=StateMetrics)
     crypto: CryptoMetrics = field(default_factory=CryptoMetrics)
     statesync: StateSyncMetrics = field(default_factory=StateSyncMetrics)
+    rpc: RPCMetrics = field(default_factory=RPCMetrics)
     registry: Optional[Registry] = None
 
 
@@ -486,6 +509,29 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             ("phase",),
             buckets=(0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 300)),
     )
+    rpc = RPCMetrics(
+        cache_hits=r.counter(
+            f"{ns}_rpc_cache_hits_total",
+            "RPC requests served from the pre-encoded response cache."),
+        cache_misses=r.counter(
+            f"{ns}_rpc_cache_misses_total",
+            "Cache-eligible RPC requests that ran the handler and "
+            "encoder."),
+        cache_bytes=r.gauge(
+            f"{ns}_rpc_cache_bytes",
+            "Bytes resident in the RPC response cache."),
+        ws_subscribers=r.gauge(
+            f"{ns}_rpc_ws_subscribers",
+            "Live websocket event subscriptions across all clients."),
+        ws_dropped=r.counter(
+            f"{ns}_rpc_ws_dropped_total",
+            "Event frames shed (drop) or connections cut (disconnect) "
+            "by the slow-websocket-client policy.", ("policy",)),
+        events_rendered=r.counter(
+            f"{ns}_rpc_events_rendered_total",
+            "Events rendered to wire bytes (once per event under "
+            "render-once fan-out, regardless of subscriber count)."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
-                       registry=r)
+                       rpc=rpc, registry=r)

@@ -86,6 +86,37 @@ def _split_addr(laddr: str) -> str:
     return laddr.split("://", 1)[-1]
 
 
+class _TelemetryTicker:
+    """Replica-mode stand-in for the StallWatchdog's tick: runs the
+    node's per-peer gauge refresh on a fixed cadence (there is no
+    consensus machine to watch, but flow rates and peer lag still
+    matter to operators of a read fleet)."""
+
+    def __init__(self, fn, interval: float = 2.0):
+        self._fn = fn
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="replica-telemetry", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._fn()
+            except Exception:  # noqa: BLE001 - telemetry must not die
+                LOG.exception("replica telemetry tick failed")
+
+
 class Node:
     """A full Tendermint node (reference node/node.go:118-150 struct)."""
 
@@ -101,6 +132,14 @@ class Node:
         self.genesis_doc = genesis_doc
         self.priv_validator = priv_validator
         self.node_key = node_key
+        # [base] mode: "full" runs consensus; "replica" is a read node
+        # that tails blocks through the fast-sync reactor forever and
+        # never instantiates a ConsensusState
+        self.mode = config.base.mode or "full"
+        if self.mode not in ("full", "replica"):
+            raise ValueError(
+                f"[base] mode must be 'full' or 'replica', got "
+                f"{self.mode!r}")
 
         root = config.root_dir
         db_dir = config.base.db_path()
@@ -128,8 +167,14 @@ class Node:
         from ..crypto import batch as crypto_batch
         from ..libs import tracing
 
+        from ..rpc import core as rpc_core
+
         if config.instrumentation.prometheus:
             crypto_batch.set_metrics(self.metrics.crypto)
+            # the websocket event renderer is process-global the same
+            # way the crypto sink is (render-once fan-out memoizes on
+            # the Message, not per server)
+            rpc_core.set_metrics(self.metrics.rpc)
         # [crypto] section: async dispatch flag + verified-signature
         # cache, process-wide like the metrics sink (every BatchVerifier
         # call site picks them up). The cache object is remembered so
@@ -186,9 +231,12 @@ class Node:
         state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
 
         # fast-sync only makes sense with peers to sync from; a sole
-        # validator skips it (reference node/node.go:240-246)
+        # validator skips it (reference node/node.go:240-246). A replica
+        # ALWAYS fast-syncs — tailing blocks is its whole job
         fast_sync = config.base.fast_sync
-        if len(state.validators) == 1 and priv_validator is not None:
+        if self.mode == "replica":
+            fast_sync = True
+        elif len(state.validators) == 1 and priv_validator is not None:
             addr = priv_validator.get_address()
             if state.validators.has_address(addr):
                 fast_sync = False
@@ -232,43 +280,63 @@ class Node:
         )
 
         # --- consensus (node/node.go:309-326) ------------------------
-        wal = None
-        if config.consensus.wal_path:
-            wal_path = config.consensus.wal_file(root)
-            os.makedirs(os.path.dirname(wal_path), exist_ok=True)
-            wal = WAL(wal_path,
-                      corrupted_counter=self.metrics.consensus.wal_corrupted)
-        self.consensus_state = ConsensusState(
-            config.consensus,
-            state,
-            self.block_exec,
-            self.block_store,
-            mempool=self.mempool,
-            evpool=self.evidence_pool,
-            event_bus=self.event_bus,
-            priv_validator=priv_validator,
-            wal=wal,
-            metrics=self.metrics.consensus,
-        )
-        # per-height lifecycle timelines (libs/timeline.py): the recorder
-        # lives on the ConsensusState (per-node, not process-global);
-        # marks are a dict write per consensus event, so this defaults on
-        if config.instrumentation.timeline_heights > 0:
-            self.consensus_state.timeline.enable(
-                config.instrumentation.timeline_heights)
-        # while state sync runs, consensus must stay parked (fast_sync
-        # mode) and the blockchain pool must NOT start at height 1 —
-        # resume_fast_sync re-arms it at the restored height
-        self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, fast_sync=fast_sync or state_sync
-        )
-        self.blockchain_reactor = BlockchainReactor(
-            state,
-            self.block_exec,
-            self.block_store,
-            fast_sync and not state_sync,
-            consensus_reactor=self.consensus_reactor,
-        )
+        # replica mode builds NO consensus machinery at all: the
+        # blockchain reactor tails blocks forever and a channel
+        # absorber keeps the p2p protocol intact for validator peers
+        self._consensus_absorber = None
+        if self.mode == "full":
+            wal = None
+            if config.consensus.wal_path:
+                wal_path = config.consensus.wal_file(root)
+                os.makedirs(os.path.dirname(wal_path), exist_ok=True)
+                wal = WAL(wal_path,
+                          corrupted_counter=self.metrics.consensus.wal_corrupted)
+            self.consensus_state = ConsensusState(
+                config.consensus,
+                state,
+                self.block_exec,
+                self.block_store,
+                mempool=self.mempool,
+                evpool=self.evidence_pool,
+                event_bus=self.event_bus,
+                priv_validator=priv_validator,
+                wal=wal,
+                metrics=self.metrics.consensus,
+            )
+            # per-height lifecycle timelines (libs/timeline.py): the
+            # recorder lives on the ConsensusState (per-node, not
+            # process-global); marks are a dict write per consensus
+            # event, so this defaults on
+            if config.instrumentation.timeline_heights > 0:
+                self.consensus_state.timeline.enable(
+                    config.instrumentation.timeline_heights)
+            # while state sync runs, consensus must stay parked
+            # (fast_sync mode) and the blockchain pool must NOT start at
+            # height 1 — resume_fast_sync re-arms it at the restored
+            # height
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus_state, fast_sync=fast_sync or state_sync
+            )
+            self.blockchain_reactor = BlockchainReactor(
+                state,
+                self.block_exec,
+                self.block_store,
+                fast_sync and not state_sync,
+                consensus_reactor=self.consensus_reactor,
+            )
+        else:
+            from ..consensus.reactor import ReplicaConsensusAbsorber
+
+            self.consensus_state = None
+            self.consensus_reactor = None
+            self._consensus_absorber = ReplicaConsensusAbsorber()
+            self.blockchain_reactor = BlockchainReactor(
+                state,
+                self.block_exec,
+                self.block_store,
+                fast_sync and not state_sync,
+                tail_forever=True,
+            )
 
         # --- tx indexer (node/node.go:329-349) -----------------------
         if config.tx_index.indexer == "kv":
@@ -352,7 +420,10 @@ class Node:
         )
         self.sw.add_reactor("MEMPOOL", self.mempool_reactor)
         self.sw.add_reactor("BLOCKCHAIN", self.blockchain_reactor)
-        self.sw.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.sw.add_reactor(
+            "CONSENSUS",
+            self.consensus_reactor if self.consensus_reactor is not None
+            else self._consensus_absorber)
         self.sw.add_reactor("EVIDENCE", self.evidence_reactor)
 
         # --- state sync (statesync/; upstream v0.34 leapfrog) --------
@@ -407,14 +478,22 @@ class Node:
         # bundle at /debug/consensus, and carries the per-peer network
         # telemetry refresh (flow rates, queue depth, height lag) on its
         # tick so peer gauges update even between scrapes
-        from ..consensus.state import StallWatchdog
+        self.watchdog = None
+        self._telemetry_ticker = None
+        if self.consensus_state is not None:
+            from ..consensus.state import StallWatchdog
 
-        self.watchdog = StallWatchdog(
-            self.consensus_state,
-            threshold_s=config.instrumentation.stall_threshold_s,
-            switch=self.sw,
-        )
-        self.watchdog.on_tick.append(self._refresh_peer_telemetry)
+            self.watchdog = StallWatchdog(
+                self.consensus_state,
+                threshold_s=config.instrumentation.stall_threshold_s,
+                switch=self.sw,
+            )
+            self.watchdog.on_tick.append(self._refresh_peer_telemetry)
+        else:
+            # replicas have no watchdog (nothing to stall) but the
+            # per-peer network gauges still need a cadence
+            self._telemetry_ticker = _TelemetryTicker(
+                self._refresh_peer_telemetry)
 
         self._rpc_server = None
         self._grpc_server = None
@@ -458,7 +537,10 @@ class Node:
         ]
         if peers:
             self.sw.dial_peers_async(peers, persistent=True)
-        self.watchdog.start()
+        if self.watchdog is not None:
+            self.watchdog.start()
+        if self._telemetry_ticker is not None:
+            self._telemetry_ticker.start()
 
         # snapshot production: push the [statesync] producer knobs to
         # the app over ABCI SetOption (works for in-proc and remote
@@ -522,7 +604,9 @@ class Node:
         MConnection flowrate monitors (send/recv EWMA), pending send
         queue depth, and consensus height lag from PeerState."""
         m = self.metrics.p2p
-        our_height = self.consensus_state.rs.height
+        our_height = (self.consensus_state.rs.height
+                      if self.consensus_state is not None
+                      else self.block_store.height())
         for p in self.sw.peers.list():
             if not p.is_running():
                 # racing removal: writing now would re-create series the
@@ -546,6 +630,7 @@ class Node:
                         max(0, our_height - peer_h))
 
     def _start_rpc(self) -> None:
+        from ..rpc.cache import RPCCache
         from ..rpc.core import RPCEnvironment
         from ..rpc.server import RPCServer
 
@@ -556,6 +641,11 @@ class Node:
         self._rpc_server = RPCServer(
             env, host, int(port), unsafe=self.config.rpc.unsafe,
             max_open_connections=self.config.rpc.max_open_connections,
+            cache=RPCCache(self.config.rpc.cache_bytes,
+                           metrics=self.metrics.rpc),
+            ws_send_queue=self.config.rpc.ws_send_queue,
+            ws_slow_policy=self.config.rpc.ws_slow_policy,
+            metrics=self.metrics.rpc,
         )
         self._rpc_server.start()
         if self.config.rpc.grpc_laddr:
@@ -613,16 +703,41 @@ class Node:
         host, _, port = addr.rpartition(":")
         self._prof_server = ProfServer(
             host or "127.0.0.1", int(port),
-            timeline=self.consensus_state.timeline,
+            timeline=(self.consensus_state.timeline
+                      if self.consensus_state is not None else None),
             providers={
-                "/debug/consensus": lambda q: self.watchdog.status(),
+                "/debug/consensus": lambda q: self._consensus_status(),
                 "/debug/statesync": lambda q: self._statesync_status(),
                 "/debug/abci": lambda q: self.proxy_app.status(),
                 "/debug/mempool": lambda q: self.mempool.status(),
                 "/debug/crypto": lambda q: self._crypto_status(),
+                "/debug/rpc": lambda q: self._rpc_status(),
             },
         )
         self._prof_server.start()
+
+    def _consensus_status(self) -> dict:
+        """/debug/consensus: the watchdog bundle on a full node; a
+        minimal never-stalled shape on a replica so monitors scraping a
+        mixed fleet keep one code path."""
+        if self.watchdog is not None:
+            return self.watchdog.status()
+        return {
+            "mode": "replica",
+            "height": self.block_store.height(),
+            "dwell_s": 0.0, "threshold_s": 0.0,
+            "stalls_total": 0, "stalls": [],
+            "live": {"peers": [], "absorbed_consensus_msgs":
+                     (self._consensus_absorber.absorbed
+                      if self._consensus_absorber is not None else 0)},
+        }
+
+    def _rpc_status(self) -> dict:
+        """/debug/rpc: response-cache pressure + websocket fan-out
+        state (queue occupancy, drops, render-once counter)."""
+        if self._rpc_server is None:
+            return {"enabled": False}
+        return self._rpc_server.debug_status()
 
     def _crypto_status(self) -> dict:
         """The /debug/crypto bundle: compile-once layer state (cache
@@ -656,7 +771,10 @@ class Node:
         self._running = False
         if self.state_syncer is not None:
             self.state_syncer.stop()
-        self.watchdog.stop()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self._telemetry_ticker is not None:
+            self._telemetry_ticker.stop()
         for srv in (self._rpc_server, self._grpc_server, self._prof_server,
                     self._metrics_server):
             if srv is not None:
@@ -670,6 +788,10 @@ class Node:
         if self.config.instrumentation.prometheus:
             if crypto_batch.get_metrics() is self.metrics.crypto:
                 crypto_batch.set_metrics(None)
+            from ..rpc import core as rpc_core
+
+            if rpc_core.get_metrics() is self.metrics.rpc:
+                rpc_core.set_metrics(None)
         if (self._installed_sig_cache is not None
                 and crypto_batch.get_sig_cache() is self._installed_sig_cache):
             crypto_batch.set_sig_cache(None)

@@ -1,0 +1,173 @@
+"""Height-keyed RPC response cache — serialized JSON bytes for the hot
+read endpoints (no reference equivalent; the reference re-marshals
+every response).
+
+Two entry classes share one LRU with a byte budget ([rpc] cache_bytes):
+
+- **immutable** entries, keyed ``(method, height-ish key)``: a block,
+  commit, block-results or validator set at a height at-or-below the
+  store tip never changes once written, so its rendered JSON bytes are
+  valid forever (eviction is purely a memory decision).
+- **generational** entries, for tip-dependent responses (``/status``,
+  latest-height variants, tip commits): stamped with the cache
+  generation at fill time. A single EventBus ``NewBlock`` subscription
+  bumps the generation, so a stale tip response is never served past
+  one generation — without enumerating or locking per-method state on
+  the commit path.
+
+Values are the serialized JSON bytes of the RPC *result* (not the
+response envelope): a hit is spliced into the JSON-RPC frame by byte
+concatenation (rpc/server.py), skipping both the handler and the
+re-encode entirely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+# bookkeeping bytes charged per entry on top of the payload, so a flood
+# of tiny entries can't blow the budget through dict/key overhead
+ENTRY_OVERHEAD = 256
+
+# wall-clock ceiling on generational entries: generations only advance
+# on LOCAL NewBlock, so a node whose block flow stalls would otherwise
+# serve its last healthy-looking /status forever — after this many
+# seconds a generational entry expires even with no bump, and the live
+# handler (whose catching_up/height now tell the truth) runs again.
+# Immutable entries are unaffected (a stored block did not change).
+GEN_TTL_S = 10.0
+
+
+class RPCCache:
+    """LRU over rendered result bytes with a hard byte budget.
+
+    Thread-safe; every operation is a dict hit under one lock. A
+    ``max_bytes`` of 0 disables the cache (every get misses, puts are
+    dropped) — the configured default, preserving current behavior.
+    """
+
+    def __init__(self, max_bytes: int = 0, metrics=None,
+                 gen_ttl_s: float = GEN_TTL_S):
+        self.max_bytes = max(0, int(max_bytes))
+        self.metrics = metrics  # RPCMetrics or None
+        self.gen_ttl_s = gen_ttl_s
+        self._lock = threading.Lock()
+        # (method, key) -> (raw bytes, generation or None for
+        # immutable, monotonic fill time)
+        self._lru: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.generation = 0
+        # counters (also mirrored into metrics when wired)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_bytes > 0
+
+    # -- read/write ----------------------------------------------------
+
+    def get(self, method: str, key: tuple) -> Optional[bytes]:
+        """Serve cached result bytes, or None. A generational entry
+        stamped with an older generation — or older than gen_ttl_s of
+        wall clock, covering a node whose block flow (and therefore
+        generation counter) has stalled — is dropped and misses."""
+        if not self.enabled:
+            return None
+        k = (method, key)
+        with self._lock:
+            ent = self._lru.get(k)
+            if ent is not None:
+                raw, gen, stamp = ent
+                if gen is None or (
+                        gen == self.generation
+                        and time.monotonic() - stamp <= self.gen_ttl_s):
+                    self._lru.move_to_end(k)
+                    self.hits += 1
+                    if self.metrics is not None:
+                        self.metrics.cache_hits.inc()
+                    return raw
+                # stale generation/TTL: drop eagerly, free the budget
+                del self._lru[k]
+                self._bytes -= len(raw) + ENTRY_OVERHEAD
+                if self.metrics is not None:
+                    self.metrics.cache_bytes.set(self._bytes)
+            self.misses += 1
+        if self.metrics is not None:
+            self.metrics.cache_misses.inc()
+        return None
+
+    def put(self, method: str, key: tuple, raw: bytes,
+            generational: bool = False,
+            generation: Optional[int] = None) -> None:
+        """Store result bytes. Generational callers should pass the
+        generation they observed BEFORE computing the result: if a
+        block landed while the handler ran, the entry is then already
+        stale and dies on first lookup, instead of serving pre-bump
+        data for the whole next generation."""
+        if not self.enabled:
+            return
+        cost = len(raw) + ENTRY_OVERHEAD
+        if cost > self.max_bytes:
+            return  # larger than the whole budget: never cacheable
+        k = (method, key)
+        with self._lock:
+            old = self._lru.pop(k, None)
+            if old is not None:
+                self._bytes -= len(old[0]) + ENTRY_OVERHEAD
+            gen = None
+            if generational:
+                gen = self.generation if generation is None else generation
+            self._lru[k] = (raw, gen, time.monotonic())
+            self._bytes += cost
+            while self._bytes > self.max_bytes and self._lru:
+                _, (oraw, _, _) = self._lru.popitem(last=False)
+                self._bytes -= len(oraw) + ENTRY_OVERHEAD
+                self.evictions += 1
+            if self.metrics is not None:
+                self.metrics.cache_bytes.set(self._bytes)
+
+    # -- invalidation --------------------------------------------------
+
+    def on_new_block(self) -> None:
+        """The EventBus NewBlock hook: one integer bump expires every
+        generational entry at once. Immutable entries survive — blocks
+        already on disk did not change."""
+        with self._lock:
+            self.generation += 1
+            self.invalidations += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._bytes = 0
+            if self.metrics is not None:
+                self.metrics.cache_bytes.set(0)
+
+    # -- introspection -------------------------------------------------
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = len(self._lru)
+            b = self._bytes
+        total = self.hits + self.misses
+        return {
+            "enabled": self.enabled,
+            "max_bytes": self.max_bytes,
+            "bytes": b,
+            "entries": n,
+            "generation": self.generation,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "evictions": self.evictions,
+        }

@@ -181,6 +181,16 @@ class WSClient:
     def close(self) -> None:
         self._closed.set()
         if self._sock is not None:
+            # shutdown BEFORE close: close() alone does not wake a
+            # read loop blocked in recv (Linux keeps the in-flight
+            # syscall blocked on the open file description), so no FIN
+            # would reach the server and its connection state — pumps,
+            # subscription counts — would linger until the next event
+            # happened to flow
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
@@ -338,6 +348,13 @@ class ReconnectingWSClient(WSClient):
             if time.time() - self._last_rx > self.pong_timeout:
                 sock = self._sock
                 if sock is not None:
+                    # shutdown first: close() alone cannot wake the
+                    # read loop out of a blocked recv (see close()),
+                    # and waking it is this kill's entire purpose
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
                     try:
                         sock.close()
                     except OSError:

@@ -28,7 +28,12 @@ SUBSCRIBE_TIMEOUT = 10.0  # reference rpc/core/events.go subscribeTimeout
 
 
 class RPCEnvironment:
-    """All node internals the handlers need (rpc/core/pipe.go)."""
+    """All node internals the handlers need (rpc/core/pipe.go).
+
+    ``consensus_state`` is None on a read replica ([base] mode =
+    replica): the node tails blocks through the fast-sync reactor and
+    never runs consensus, so the latest State lives on the blockchain
+    reactor instead."""
 
     def __init__(self, node):
         self.node = node
@@ -37,7 +42,7 @@ class RPCEnvironment:
         self.state_db = node.state_db
         self.mempool = node.mempool
         self.evidence_pool = node.evidence_pool
-        self.consensus_state = node.consensus_state
+        self.consensus_state = getattr(node, "consensus_state", None)
         self.p2p_switch = node.sw
         self.event_bus = node.event_bus
         self.tx_indexer = node.tx_indexer
@@ -48,7 +53,9 @@ class RPCEnvironment:
         )
 
     def latest_state(self):
-        return self.consensus_state.state
+        if self.consensus_state is not None:
+            return self.consensus_state.state
+        return self.node.blockchain_reactor.state
 
 
 # --- helpers ----------------------------------------------------------
@@ -112,6 +119,59 @@ def _load_height(env: RPCEnvironment, params: dict) -> int:
     return h
 
 
+# --- response-cache planning (rpc/cache.py) ---------------------------
+#
+# Which calls may serve pre-rendered bytes, and under what key. A plan
+# is (key, generational): immutable entries (height <= tip) live until
+# evicted; generational entries expire when the EventBus NewBlock hook
+# bumps the cache generation. None = not cacheable (including any
+# malformed params — the handler still runs to produce the right error).
+
+CACHEABLE_METHODS = frozenset((
+    "status", "genesis", "block", "block_results", "commit",
+    "validators", "blockchain",
+))
+
+
+def cache_plan(env: RPCEnvironment, method: str, params: dict):
+    if method not in CACHEABLE_METHODS:
+        return None
+    try:
+        if method == "status":
+            return ((), True)
+        if method == "genesis":
+            return ((), False)
+        store_h = env.block_store.height()
+        if method in ("block", "block_results", "commit"):
+            h = _int(params, "height", None)
+            if h is None or h == 0:
+                # latest-height variant: tip-dependent, expire per block
+                return (("latest",), True)
+            if not 1 <= h <= store_h:
+                return None
+            if method == "commit" and h == store_h:
+                # the tip's commit is the mutable seen-commit until the
+                # next block makes it canonical (rpc/core/blocks.go)
+                return ((h,), True)
+            return ((h,), False)
+        if method == "validators":
+            h = _int(params, "height", None)
+            if h is None or h == 0:
+                return (("latest",), True)  # next-height set, from State
+            return ((h,), False) if h >= 1 else None
+        if method == "blockchain":
+            # the response embeds last_height = the MOVING tip, so no
+            # blockchain range is ever immutable — every variant is
+            # generational (and negative/omitted maxHeight resolves to
+            # the tip anyway)
+            min_p = _int(params, "minHeight", None)
+            max_p = _int(params, "maxHeight", None)
+            return ((min_p, max_p), True)
+    except RPCError:
+        return None
+    return None
+
+
 # --- info routes (rpc/core/routes.go:14-27) ---------------------------
 
 
@@ -135,7 +195,12 @@ def status(env: RPCEnvironment, params: dict) -> dict:
         addr = env.pub_key.address()
         if state.validators.has_address(addr):
             voting_power = state.validators.get_by_address(addr)[1].voting_power
-    catching_up = getattr(env.node.blockchain_reactor, "fast_sync", False)
+    bcr = env.node.blockchain_reactor
+    # replicas fast-sync forever; "catching up" means actually behind
+    # the best peer height, not merely running the tail loop
+    catching_up = getattr(bcr, "catching_up", None)
+    if catching_up is None:
+        catching_up = getattr(bcr, "fast_sync", False)
     return {
         "node_info": {
             "id": node_info.id,
@@ -329,8 +394,16 @@ def validators(env: RPCEnvironment, params: dict) -> dict:
     }
 
 
+def _require_consensus(env: RPCEnvironment):
+    if env.consensus_state is None:
+        raise RPCError(
+            ERR_SERVER, "consensus is not running on this node "
+            "([base] mode = replica serves reads only)")
+    return env.consensus_state
+
+
 def dump_consensus_state(env: RPCEnvironment, params: dict) -> dict:
-    rs = env.consensus_state.rs
+    rs = _require_consensus(env).rs
     peers = []
     for p in env.p2p_switch.peers.list():
         ps = p.get("consensus_peer_state")
@@ -352,7 +425,7 @@ def dump_consensus_state(env: RPCEnvironment, params: dict) -> dict:
 
 
 def consensus_state(env: RPCEnvironment, params: dict) -> dict:
-    return {"round_state": _round_state_json(env.consensus_state.rs,
+    return {"round_state": _round_state_json(_require_consensus(env).rs,
                                              full=False)}
 
 
@@ -501,11 +574,16 @@ def broadcast_tx_sync(env: RPCEnvironment, params: dict) -> dict:
 
 def broadcast_tx_commit(env: RPCEnvironment, params: dict) -> dict:
     """Subscribe to the tx's DeliverTx event, CheckTx, wait for commit
-    (reference rpc/core/mempool.go:168-230)."""
+    (reference rpc/core/mempool.go:168-230). The wait is bounded by
+    [rpc] timeout_broadcast_tx_commit (default: the reference's 10s);
+    a CheckTx rejection tears the subscription down immediately — it
+    must never linger for the commit timeout."""
     tx = _tx_param(params)
     txh = compute_tx_hash(tx)
     q = Query(f"{TX_HASH_KEY} = '{txh.hex().upper()}'")
     subscriber = f"rpc-btc-{txh.hex()[:16]}-{time.monotonic_ns()}"
+    timeout = getattr(env.config.rpc, "timeout_broadcast_tx_commit",
+                      SUBSCRIBE_TIMEOUT) or SUBSCRIBE_TIMEOUT
     sub = env.event_bus.subscribe(subscriber, q, 4)
     try:
         try:
@@ -513,13 +591,17 @@ def broadcast_tx_commit(env: RPCEnvironment, params: dict) -> dict:
         except Exception as e:
             raise RPCError(ERR_SERVER, str(e))
         if check_res.code != abci.CODE_TYPE_OK:
+            # early-return path: drop the subscription NOW (the finally
+            # below also runs, but being explicit keeps the invariant
+            # obvious — a rejected tx never holds event-bus state)
+            env.event_bus.unsubscribe_all(subscriber)
             return {
                 "check_tx": enc.tx_response_json(check_res),
                 "deliver_tx": enc.tx_response_json(abci.ResponseDeliverTx()),
                 "hash": enc.hexu(txh),
                 "height": "0",
             }
-        msg = sub.get(timeout=SUBSCRIBE_TIMEOUT)
+        msg = sub.get(timeout=timeout)
         if msg is None:
             raise RPCError(ERR_SERVER, "timed out waiting for tx to be "
                            "included in a block")
@@ -647,6 +729,63 @@ def dial_peers(env: RPCEnvironment, params: dict) -> dict:
 
 
 # --- event rendering for websocket subscribers ------------------------
+
+# render-once fan-out: the heavy part of an event notification (the
+# amino-JSON data union + tags) is identical for every subscriber, so
+# it is rendered to wire bytes ONCE per Message and memoized on the
+# message object; per-subscriber work shrinks to splicing the (tiny)
+# query string into the frame. _render_lock serializes the first
+# render so N pumps racing one fresh event still cost one render.
+_render_lock = threading.Lock()
+_events_rendered = 0  # process-wide funnel counter (tests/bench assert)
+_rpc_metrics = None  # RPCMetrics sink, wired by the node like crypto's
+
+
+def events_rendered_count() -> int:
+    return _events_rendered
+
+
+def set_metrics(m) -> None:
+    """Install (or clear, with None) the process-wide RPCMetrics sink
+    the event renderer reports to."""
+    global _rpc_metrics
+    _rpc_metrics = m
+
+
+def get_metrics():
+    return _rpc_metrics
+
+
+def render_event_payload(msg) -> bytes:
+    """`"data":<...>,"tags":<...>` as JSON bytes (no surrounding
+    braces), rendered once per EventBus Message and cached on it."""
+    cached = getattr(msg, "_rpc_wire_payload", None)
+    if cached is not None:
+        return cached
+    with _render_lock:
+        cached = getattr(msg, "_rpc_wire_payload", None)
+        if cached is None:
+            global _events_rendered
+            _events_rendered += 1
+            if _rpc_metrics is not None:
+                _rpc_metrics.events_rendered.inc()
+            from . import jsonrpc as _jsonrpc
+
+            body = _jsonrpc.dumps(
+                {"data": _event_data_json(msg), "tags": msg.tags})
+            cached = body[1:-1]  # strip the object braces for splicing
+            msg._rpc_wire_payload = cached
+    return cached
+
+
+def render_event_frame(msg, query_str: str) -> bytes:
+    """The full JSON-RPC notification frame for one subscriber: only
+    the query string is per-subscriber; data+tags come pre-rendered."""
+    from . import jsonrpc as _jsonrpc
+
+    return (b'{"jsonrpc":"2.0","id":"#event","result":{"query":'
+            + _jsonrpc.dumps(query_str) + b","
+            + render_event_payload(msg) + b"}}")
 
 
 def _event_data_json(msg) -> dict:

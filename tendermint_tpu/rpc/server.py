@@ -10,11 +10,25 @@
 The websocket side is hand-rolled (accept-key handshake + masked
 client frames) so one threaded server owns both transports, matching
 the reference's single listener.
+
+Fan-out-scale serving (ours; no reference equivalent):
+
+- hot read responses are served as pre-encoded JSON bytes out of the
+  height/generation cache (rpc/cache.py) — a cached hit skips the
+  handler AND the re-encode, splicing the stored result bytes into the
+  response frame by concatenation;
+- every websocket event is rendered to wire bytes once (rpc/core.py
+  render_event_frame) and fanned out through a bounded per-client send
+  queue drained by a writer thread, so one slow client backs up only
+  its own queue. The slow-client policy is explicit ([rpc]
+  ws_slow_policy): "drop" sheds that client's events with a counter,
+  "disconnect" hangs up so the client's reconnect logic takes over.
 """
 
 from __future__ import annotations
 
 import base64
+import collections
 import hashlib
 import logging
 import socket
@@ -27,7 +41,8 @@ from urllib.parse import parse_qsl, urlparse
 
 from ..libs.events import Query
 from . import jsonrpc
-from .core import ROUTES, UNSAFE_ROUTES, RPCEnvironment
+from .cache import RPCCache
+from .core import ROUTES, UNSAFE_ROUTES, RPCEnvironment, cache_plan
 from .jsonrpc import RPCError
 
 LOG = logging.getLogger("rpc.server")
@@ -35,18 +50,40 @@ LOG = logging.getLogger("rpc.server")
 WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
 # cap on POST bodies: the RPC port is public, and Content-Length is
-# attacker-controlled (same spirit as the remote-signer MAX_FRAME)
+# attacker-controlled (same spirit as the remote-signer MAX_FRAME).
+# Websocket frames share the cap — the 64-bit extended length field is
+# equally attacker-controlled and was previously unbounded.
 MAX_BODY_BYTES = 1 << 20
+
+WS_SLOW_POLICIES = ("drop", "disconnect")
+
+
+def _result_frame(id_, result_raw: bytes) -> bytes:
+    """Splice pre-encoded result bytes into a JSON-RPC response frame
+    without re-encoding the result."""
+    return (b'{"jsonrpc":"2.0","id":' + jsonrpc.dumps(id_)
+            + b',"result":' + result_raw + b"}")
 
 
 class RPCServer:
     def __init__(self, env: RPCEnvironment, host: str, port: int,
-                 unsafe: bool = False, max_open_connections: int = 0):
+                 unsafe: bool = False, max_open_connections: int = 0,
+                 cache: Optional[RPCCache] = None,
+                 ws_send_queue: int = 256, ws_slow_policy: str = "drop",
+                 metrics=None):
         self.env = env
         self.unsafe = unsafe
         self.routes = dict(ROUTES)
         if unsafe:
             self.routes.update(UNSAFE_ROUTES)
+        self.cache = cache
+        if ws_slow_policy not in WS_SLOW_POLICIES:
+            raise ValueError(
+                f"[rpc] ws_slow_policy must be one of {WS_SLOW_POLICIES}, "
+                f"got {ws_slow_policy!r}")
+        self.ws_send_queue = max(1, int(ws_send_queue))
+        self.ws_slow_policy = ws_slow_policy
+        self.metrics = metrics  # RPCMetrics or None
         handler = _make_handler(self)
 
         outer = self
@@ -96,6 +133,15 @@ class RPCServer:
         # their auto-reconnect would never fire
         self._ws_conns: set = set()
         self._ws_lock = threading.Lock()
+        # fan-out accounting (rpc_ws_subscribers / rpc_ws_dropped_total)
+        self._subs_count = 0
+        self._dropped: Dict[str, int] = {p: 0 for p in WS_SLOW_POLICIES}
+        self._events_enqueued = 0
+        self._stats_lock = threading.Lock()
+        # cache invalidation: one NewBlock subscription per server
+        self._inval_sub = None
+        self._inval_thread: Optional[threading.Thread] = None
+        self._inval_stop = threading.Event()
 
     @property
     def listen_addr(self) -> str:
@@ -107,15 +153,54 @@ class RPCServer:
             target=self._httpd.serve_forever, name="rpc-http", daemon=True
         )
         self._thread.start()
+        if self.cache is not None and self.cache.enabled:
+            self._start_invalidation()
         LOG.info("RPC server listening on %s", self.listen_addr)
 
     def stop(self) -> None:
+        self._inval_stop.set()
+        if self._inval_sub is not None:
+            try:
+                self.env.event_bus.unsubscribe_all(self._inval_subscriber)
+            except Exception:  # noqa: BLE001 - bus may already be down
+                pass
+            self._inval_sub = None
         self._httpd.shutdown()
         self._httpd.server_close()
         with self._ws_lock:
             conns = list(self._ws_conns)
         for c in conns:
             c.close()
+
+    # -- cache invalidation (one EventBus NewBlock subscription) -------
+
+    def _start_invalidation(self) -> None:
+        from ..types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+        self._inval_subscriber = f"rpc-cache-{id(self):x}"
+        self._inval_sub = self.env.event_bus.subscribe(
+            self._inval_subscriber, query_for_event(EVENT_NEW_BLOCK), 16)
+        self._inval_stop.clear()
+        # bind the cache OBJECT, not the attribute: tests/bench swap
+        # self.cache to None to measure the uncached path while blocks
+        # keep landing, and the object must keep seeing every bump or
+        # its generational entries would survive the bypass window
+        cache = self.cache
+
+        def _drain():
+            while not self._inval_stop.is_set():
+                sub = self._inval_sub
+                if sub is None or sub.cancelled:
+                    return
+                msg = sub.get(timeout=0.5)
+                if msg is not None:
+                    cache.on_new_block()
+
+        self._inval_thread = threading.Thread(
+            target=_drain, name="rpc-cache-inval", daemon=True)
+        self._inval_thread.start()
+
+    # -- open-connection cap -------------------------------------------
 
     def _open_conns_add(self) -> bool:
         with self._open_lock:
@@ -136,6 +221,55 @@ class RPCServer:
         with self._ws_lock:
             self._ws_conns.discard(conn)
 
+    # -- fan-out accounting --------------------------------------------
+
+    def _note_subs(self, delta: int) -> None:
+        with self._stats_lock:
+            self._subs_count = max(0, self._subs_count + delta)
+            n = self._subs_count
+        if self.metrics is not None:
+            self.metrics.ws_subscribers.set(n)
+
+    def _note_dropped(self, policy: str) -> None:
+        with self._stats_lock:
+            self._dropped[policy] = self._dropped.get(policy, 0) + 1
+        if self.metrics is not None:
+            self.metrics.ws_dropped.with_labels(policy).inc()
+
+    def _note_enqueued(self) -> None:
+        with self._stats_lock:
+            self._events_enqueued += 1
+
+    def debug_status(self) -> dict:
+        """The /debug/rpc bundle: cache pressure + websocket fan-out
+        state — queue occupancy against capacity is the backpressure
+        signal tooling watches (tools/monitor.py)."""
+        from .core import events_rendered_count
+
+        with self._ws_lock:
+            conns = list(self._ws_conns)
+        depths = [c.queue_depth() for c in conns]
+        hwms = [c._q_hwm for c in conns]
+        with self._stats_lock:
+            out_ws = {
+                "conns": len(conns),
+                "subscribers": self._subs_count,
+                "send_queue_capacity": self.ws_send_queue,
+                "max_queue_depth": max(depths, default=0),
+                # high-water mark since connect: catches a queue that
+                # backed up and drained between scrapes
+                "max_queue_hwm": max(hwms, default=0),
+                "slow_policy": self.ws_slow_policy,
+                "events_enqueued": self._events_enqueued,
+                "events_dropped": dict(self._dropped),
+            }
+        out_ws["events_rendered"] = events_rendered_count()
+        return {
+            "ws": out_ws,
+            "cache": (self.cache.stats() if self.cache is not None
+                      else {"enabled": False}),
+        }
+
     # -- dispatch ------------------------------------------------------
 
     def call(self, method: str, params: dict) -> dict:
@@ -144,6 +278,27 @@ class RPCServer:
             raise RPCError(jsonrpc.ERR_METHOD_NOT_FOUND,
                            f"method {method!r} not found")
         return fn(self.env, params)
+
+    def call_bytes(self, method: str, params: dict) -> bytes:
+        """One RPC call, returning the RESULT as serialized JSON bytes.
+        Cache-eligible calls ([rpc] cache_bytes > 0) are served from —
+        and fill — the response cache; a hit never runs the handler or
+        the JSON encoder. Raises exactly like call()."""
+        cache = self.cache
+        if cache is None or not cache.enabled:
+            return jsonrpc.dumps(self.call(method, params))
+        plan = cache_plan(self.env, method, params)
+        if plan is None:
+            return jsonrpc.dumps(self.call(method, params))
+        key, generational = plan
+        raw = cache.get(method, key)
+        if raw is not None:
+            return raw
+        gen0 = cache.generation  # observed BEFORE the handler runs
+        raw = jsonrpc.dumps(self.call(method, params))
+        cache.put(method, key, raw, generational=generational,
+                  generation=gen0)
+        return raw
 
 
 def _make_handler(server: RPCServer):
@@ -155,13 +310,15 @@ def _make_handler(server: RPCServer):
 
         # ---- plain HTTP ---------------------------------------------
 
-        def _send_json(self, obj: dict, status: int = 200) -> None:
-            body = jsonrpc.dumps(obj)
+        def _send_body(self, body: bytes, status: int = 200) -> None:
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_json(self, obj, status: int = 200) -> None:
+            self._send_body(jsonrpc.dumps(obj), status=status)
 
         def do_POST(self):
             try:
@@ -183,24 +340,27 @@ def _make_handler(server: RPCServer):
                 return self._send_json(
                     jsonrpc.error_response(None, e.code, e.message))
             if isinstance(req, list):  # batch
-                return self._send_json(
-                    [self._handle_one(r) for r in req])
-            self._send_json(self._handle_one(req))
+                return self._send_body(
+                    b"[" + b",".join(self._handle_one(r) for r in req)
+                    + b"]")
+            self._send_body(self._handle_one(req))
 
-        def _handle_one(self, req) -> dict:
+        def _handle_one(self, req) -> bytes:
             if not isinstance(req, dict) or "method" not in req:
-                return jsonrpc.error_response(
-                    None, jsonrpc.ERR_INVALID_REQUEST, "invalid request")
+                return jsonrpc.dumps(jsonrpc.error_response(
+                    None, jsonrpc.ERR_INVALID_REQUEST, "invalid request"))
             id_ = req.get("id")
             try:
-                result = server.call(req["method"], req.get("params") or {})
-                return jsonrpc.ok_response(id_, result)
+                raw = server.call_bytes(req["method"],
+                                        req.get("params") or {})
+                return _result_frame(id_, raw)
             except RPCError as e:
-                return jsonrpc.error_response(id_, e.code, e.message, e.data)
+                return jsonrpc.dumps(
+                    jsonrpc.error_response(id_, e.code, e.message, e.data))
             except Exception as e:  # noqa: BLE001 - handler crash → 32603
                 LOG.exception("rpc %s failed", req.get("method"))
-                return jsonrpc.error_response(
-                    id_, jsonrpc.ERR_INTERNAL, str(e))
+                return jsonrpc.dumps(jsonrpc.error_response(
+                    id_, jsonrpc.ERR_INTERNAL, str(e)))
 
         def do_GET(self):
             parsed = urlparse(self.path)
@@ -231,8 +391,8 @@ def _make_handler(server: RPCServer):
                 for k, v in params.items()
             }
             try:
-                result = server.call(path, params)
-                self._send_json(jsonrpc.ok_response("", result))
+                raw = server.call_bytes(path, params)
+                self._send_body(_result_frame("", raw))
             except RPCError as e:
                 self._send_json(
                     jsonrpc.error_response("", e.code, e.message, e.data))
@@ -270,7 +430,13 @@ def _make_handler(server: RPCServer):
 
 class WSConn:
     """One websocket client: JSON-RPC dispatch + event subscriptions
-    (reference wsConnection + wsSubscribe in rpc/core/events.go)."""
+    (reference wsConnection + wsSubscribe in rpc/core/events.go).
+
+    Event notifications go through a bounded send queue drained by a
+    dedicated writer thread — a client that stops reading backs up its
+    own queue only, and the configured slow policy (drop/disconnect)
+    applies there. Direct RPC responses and pongs bypass the queue (a
+    slow client stalls only its own request thread)."""
 
     def __init__(self, sock: socket.socket, server: RPCServer):
         self.sock = sock
@@ -281,6 +447,14 @@ class WSConn:
         self._subs: Dict[str, object] = {}  # query str -> Subscription
         self._pumps = []
         self._closed = threading.Event()
+        # bounded event send queue + its writer
+        self._q: collections.deque = collections.deque()
+        self._q_cap = server.ws_send_queue
+        self._q_cond = threading.Condition()
+        self._q_hwm = 0
+        self.events_sent = 0
+        self.events_dropped = 0
+        self._writer: Optional[threading.Thread] = None
 
     # -- frame IO ------------------------------------------------------
 
@@ -295,7 +469,10 @@ class WSConn:
 
     def recv_frame(self) -> Optional[bytes]:
         """Returns a full text/binary message, None on close frame.
-        Fragmented messages are reassembled; ping answered inline."""
+        Fragmented messages are reassembled; ping answered inline.
+        Frames (and reassembled messages) over MAX_BODY_BYTES tear the
+        connection down — the extended length field is wire input and
+        must never size an allocation unchecked."""
         message = b""
         while True:
             hdr = self._recv_exact(2)
@@ -307,6 +484,9 @@ class WSConn:
                 ln = struct.unpack(">H", self._recv_exact(2))[0]
             elif ln == 127:
                 ln = struct.unpack(">Q", self._recv_exact(8))[0]
+            if ln + len(message) > MAX_BODY_BYTES:
+                raise ConnectionError(
+                    f"ws frame exceeds {MAX_BODY_BYTES} bytes")
             mask = self._recv_exact(4) if masked else b""
             payload = self._recv_exact(ln)
             if masked:
@@ -336,14 +516,70 @@ class WSConn:
             self.sock.sendall(header + payload)
 
     def send_json(self, obj: dict) -> None:
+        self.send_bytes(jsonrpc.dumps(obj))
+
+    def send_bytes(self, payload: bytes) -> None:
         try:
-            self.send_frame(jsonrpc.dumps(obj))
+            self.send_frame(payload)
         except OSError:
             self._closed.set()
+
+    # -- event send queue ----------------------------------------------
+
+    def queue_depth(self) -> int:
+        with self._q_cond:
+            return len(self._q)
+
+    def enqueue_event(self, frame: bytes) -> bool:
+        """Queue one pre-rendered event frame for the writer. Applies
+        the slow-client policy when the queue is full; returns False if
+        the frame was shed (or the connection is closing)."""
+        if self._closed.is_set():
+            return False
+        disconnect = False
+        with self._q_cond:
+            if len(self._q) >= self._q_cap:
+                self.events_dropped += 1
+                policy = self.server.ws_slow_policy
+                self.server._note_dropped(policy)
+                disconnect = policy == "disconnect"
+            else:
+                self._q.append(frame)
+                self._q_hwm = max(self._q_hwm, len(self._q))
+                self._q_cond.notify()
+                self.server._note_enqueued()
+                return True
+        if disconnect:
+            LOG.info("ws client too slow (queue %d full); disconnecting",
+                     self._q_cap)
+            self.close()
+        return False
+
+    def _writer_loop(self) -> None:
+        while True:
+            with self._q_cond:
+                while not self._q and not self._closed.is_set():
+                    self._q_cond.wait(timeout=0.5)
+                if self._closed.is_set() and not self._q:
+                    return
+                frame = self._q.popleft()
+            try:
+                self.send_frame(frame)
+                self.events_sent += 1
+            except OSError:
+                self._closed.set()
+                with self._q_cond:
+                    self._q.clear()
+                    self._q_cond.notify_all()
+                return
 
     # -- serve loop ----------------------------------------------------
 
     def serve(self) -> None:
+        self._writer = threading.Thread(
+            target=self._writer_loop, daemon=True,
+            name=f"ws-writer-{id(self):x}")
+        self._writer.start()
         try:
             while not self._closed.is_set():
                 msg = self.recv_frame()
@@ -360,16 +596,23 @@ class WSConn:
             pass
         finally:
             self._closed.set()
+            with self._q_cond:
+                self._q_cond.notify_all()
             self.env.event_bus.unsubscribe_all(self._subscriber)
+            self.server._note_subs(-len(self._subs))
+            self._subs.clear()
             try:
                 self.sock.close()
             except OSError:
                 pass
 
     def close(self) -> None:
-        """Tear the connection down from outside (server stop): a FIN
-        reaches the client so its read loop exits promptly."""
+        """Tear the connection down from outside (server stop, slow-
+        client disconnect): a FIN reaches the client so its read loop
+        exits promptly."""
         self._closed.set()
+        with self._q_cond:
+            self._q_cond.notify_all()
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -388,16 +631,19 @@ class WSConn:
         params = req.get("params") or {}
         try:
             if method == "subscribe":
-                result = self._subscribe(params)
+                self.send_json(jsonrpc.ok_response(
+                    id_, self._subscribe(params)))
             elif method == "unsubscribe":
-                result = self._unsubscribe(params)
+                self.send_json(jsonrpc.ok_response(
+                    id_, self._unsubscribe(params)))
             elif method == "unsubscribe_all":
                 self.env.event_bus.unsubscribe_all(self._subscriber)
+                self.server._note_subs(-len(self._subs))
                 self._subs.clear()
-                result = {}
+                self.send_json(jsonrpc.ok_response(id_, {}))
             else:
-                result = self.server.call(method, params)
-            self.send_json(jsonrpc.ok_response(id_, result))
+                raw = self.server.call_bytes(method, params)
+                self.send_bytes(_result_frame(id_, raw))
         except RPCError as e:
             self.send_json(jsonrpc.error_response(id_, e.code, e.message))
         except Exception as e:  # noqa: BLE001
@@ -415,6 +661,7 @@ class WSConn:
             raise RPCError(jsonrpc.ERR_SERVER, "already subscribed")
         sub = self.env.event_bus.subscribe(self._subscriber, Query(qs), 128)
         self._subs[qs] = sub
+        self.server._note_subs(1)
         t = threading.Thread(
             target=self._pump, args=(qs, sub), daemon=True,
             name=f"ws-sub-{len(self._subs)}",
@@ -428,24 +675,19 @@ class WSConn:
         if not qs or qs not in self._subs:
             raise RPCError(jsonrpc.ERR_SERVER, "subscription not found")
         self.env.event_bus.unsubscribe(self._subscriber, Query(qs))
-        self._subs.pop(qs, None)
+        if self._subs.pop(qs, None) is not None:
+            self.server._note_subs(-1)
         return {}
 
     def _pump(self, qs: str, sub) -> None:
-        """Stream matching events to the client as JSON-RPC
-        notifications with id '#event' (reference events.go:73-90)."""
-        from .core import _event_data_json
+        """Move matching events from the bus subscription into this
+        client's send queue. The frame is rendered ONCE per event
+        process-wide (render_event_frame memoizes data+tags on the
+        Message); this pump only splices the query string."""
+        from .core import render_event_frame
 
         while not self._closed.is_set() and not sub.cancelled:
             msg = sub.get(timeout=0.5)
             if msg is None:
                 continue
-            self.send_json({
-                "jsonrpc": "2.0",
-                "id": "#event",
-                "result": {
-                    "query": qs,
-                    "data": _event_data_json(msg),
-                    "tags": msg.tags,
-                },
-            })
+            self.enqueue_event(render_event_frame(msg, qs))

@@ -152,11 +152,34 @@ class NodeStatus:
     mempool_lanes: List[dict] = field(default_factory=list)
     ingest_queued: int = 0
     ingest_capacity: int = 0
+    # RPC fan-out view (from /debug/rpc): websocket send-queue pressure
+    # and response-cache behavior — a node whose event queues are backed
+    # up is shedding (or about to shed) subscriber traffic, and a cache
+    # evicting faster than it hits is just burning memory
+    ws_subscribers: int = 0
+    ws_queue_capacity: int = 0
+    ws_max_queue_depth: int = 0
+    ws_dropped_total: int = 0
+    rpc_cache_enabled: bool = False
+    rpc_cache_hit_rate: float = 0.0
+    rpc_cache_bytes: int = 0
+    rpc_cache_evictions: int = 0
+    cache_thrash: bool = False
+    # (evictions, hits, misses) from the previous poll — thrash is an
+    # INTERVAL judgment; () means no baseline yet (first poll never
+    # flags, and lifetime counters never mask current behavior)
+    _cache_prev: tuple = ()
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
     # backed up (saturated) even before the pool itself fills
     INGEST_BACKUP_FRACTION = 0.8
+    # a websocket send queue past this fraction of capacity means the
+    # slow-client policy is about to fire
+    WS_BACKUP_FRACTION = 0.8
+    # cache evictions advancing while the hit rate sits below this is
+    # thrash: the working set doesn't fit [rpc] cache_bytes
+    CACHE_THRASH_HIT_RATE = 0.5
     # phases during which "no progress" means wedged (idle/done/failed
     # are terminal — done hands off to fast sync, failed falls back)
     _RESTORE_ACTIVE = ("discover", "verify", "fetch", "apply", "finalize")
@@ -187,6 +210,43 @@ class NodeStatus:
         return (self.ingest_capacity > 0
                 and self.ingest_queued
                 >= self.INGEST_BACKUP_FRACTION * self.ingest_capacity)
+
+    @property
+    def ws_backed_up(self) -> bool:
+        """Some subscriber's send queue is at (or near) capacity — the
+        slow-client policy is firing or about to."""
+        return (self.ws_queue_capacity > 0
+                and self.ws_max_queue_depth
+                >= self.WS_BACKUP_FRACTION * self.ws_queue_capacity)
+
+    def note_rpc(self, ws: dict, cache: dict) -> None:
+        self.ws_subscribers = int(ws.get("subscribers", 0))
+        self.ws_queue_capacity = int(ws.get("send_queue_capacity", 0))
+        self.ws_max_queue_depth = int(ws.get("max_queue_depth", 0))
+        self.ws_dropped_total = sum(
+            int(v) for v in (ws.get("events_dropped") or {}).values())
+        self.rpc_cache_enabled = bool(cache.get("enabled", False))
+        self.rpc_cache_hit_rate = float(cache.get("hit_rate", 0.0))
+        self.rpc_cache_bytes = int(cache.get("bytes", 0))
+        evictions = int(cache.get("evictions", 0))
+        hits = int(cache.get("hits", 0))
+        misses = int(cache.get("misses", 0))
+        # thrash = evicting during THIS poll interval while mostly
+        # missing during it — lifetime counters would both mis-fire on
+        # a monitor (re)start against a node with old history and mask
+        # a cache that only recently started thrashing
+        if self._cache_prev:
+            pe, ph, pm = self._cache_prev
+            d_req = (hits - ph) + (misses - pm)
+            d_hit_rate = (hits - ph) / d_req if d_req > 0 else 1.0
+            self.cache_thrash = (
+                self.rpc_cache_enabled
+                and evictions > pe
+                and d_hit_rate < self.CACHE_THRASH_HIT_RATE)
+        else:
+            self.cache_thrash = False  # first poll: no baseline
+        self._cache_prev = (evictions, hits, misses)
+        self.rpc_cache_evictions = evictions
 
     @property
     def restore_stuck(self) -> bool:
@@ -230,6 +290,16 @@ class NodeStatus:
         self.mempool_lanes = []
         self.ingest_queued = 0
         self.ingest_capacity = 0
+        self.ws_subscribers = 0
+        self.ws_queue_capacity = 0
+        self.ws_max_queue_depth = 0
+        self.ws_dropped_total = 0
+        self.rpc_cache_enabled = False
+        self.rpc_cache_hit_rate = 0.0
+        self.rpc_cache_bytes = 0
+        self.rpc_cache_evictions = 0
+        self.cache_thrash = False
+        self._cache_prev = ()
 
     def mark_online(self) -> None:
         now = time.time()
@@ -419,6 +489,21 @@ class Monitor:
             ns.mempool_lanes = []
             ns.ingest_queued = 0
             ns.ingest_capacity = 0
+        try:
+            with urllib.request.urlopen(
+                    f"http://{daddr}/debug/rpc", timeout=2.0) as r:
+                rp = json.load(r)
+            ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.ws_subscribers = 0
+            ns.ws_queue_capacity = 0
+            ns.ws_max_queue_depth = 0
+            ns.ws_dropped_total = 0
+            ns.rpc_cache_enabled = False
+            ns.rpc_cache_hit_rate = 0.0
+            ns.rpc_cache_bytes = 0
+            ns.cache_thrash = False
+            ns._cache_prev = ()
 
     def _on_block(self, addr: str, ev: dict) -> None:
         ns = self.nodes[addr]
@@ -459,6 +544,11 @@ class Monitor:
                 # a full pool / backed-up ingest queue bounces new txs
                 # while the node looks perfectly alive to /status
                 and not any(n.mempool_saturated for n in online)
+                # backed-up websocket queues mean subscribers are about
+                # to lose events; a thrashing response cache means the
+                # read path is silently back to full-price serving
+                and not any(n.ws_backed_up for n in online)
+                and not any(n.cache_thrash for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -522,6 +612,14 @@ class Monitor:
                     "ingest_queued": n.ingest_queued,
                     "ingest_capacity": n.ingest_capacity,
                     "mempool_saturated": n.mempool_saturated,
+                    "ws_subscribers": n.ws_subscribers,
+                    "ws_max_queue_depth": n.ws_max_queue_depth,
+                    "ws_queue_capacity": n.ws_queue_capacity,
+                    "ws_dropped_total": n.ws_dropped_total,
+                    "ws_backed_up": n.ws_backed_up,
+                    "rpc_cache_hit_rate": n.rpc_cache_hit_rate,
+                    "rpc_cache_bytes": n.rpc_cache_bytes,
+                    "cache_thrash": n.cache_thrash,
                 }
                 for n in self.nodes.values()
             ],
@@ -581,6 +679,14 @@ def main(argv=None) -> int:
                                  f"/{n['mempool_max']}")
                     if n["mempool_saturated"]:
                         line += " [MEMPOOL SATURATED]"
+                    if n["ws_subscribers"]:
+                        line += (f" subs={n['ws_subscribers']}"
+                                 f" wsq={n['ws_max_queue_depth']}"
+                                 f"/{n['ws_queue_capacity']}")
+                    if n["ws_backed_up"]:
+                        line += " [WS BACKPRESSURE]"
+                    if n["cache_thrash"]:
+                        line += " [CACHE THRASH]"
                 print(line)
             for a in snap["stall_alerts"]:
                 print(f"  ALERT {a['addr']}: stall h={a.get('round_state', {}).get('height')} "

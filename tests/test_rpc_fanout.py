@@ -1,0 +1,849 @@
+"""RPC serving at fan-out scale (PR 9): height-keyed response caching,
+render-once event fan-out with per-client backpressure, and read-replica
+nodes.
+
+Fast tests run against one shared kvstore node (like test_rpc) plus
+unit-level fixtures for the backpressure machinery; the replica
+statesync e2e and the bench rpcload e2e are slow-marked.
+"""
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import types
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu import config as cfg
+from tendermint_tpu.libs.events import Message
+from tendermint_tpu.node import default_new_node
+from tendermint_tpu.rpc import core as rpc_core
+from tendermint_tpu.rpc.cache import ENTRY_OVERHEAD, RPCCache
+from tendermint_tpu.rpc.client import HTTPClient, WSClient
+from tendermint_tpu.rpc.jsonrpc import RPCError
+from tendermint_tpu.rpc.server import MAX_BODY_BYTES, WSConn
+from tendermint_tpu.types.event_bus import (
+    EVENT_NEW_BLOCK,
+    EventBus,
+    query_for_event,
+)
+
+from test_node import init_files, make_config
+
+
+# --- RPCCache unit ----------------------------------------------------
+
+
+def test_cache_lru_byte_budget_and_eviction():
+    c = RPCCache(max_bytes=4 * (100 + ENTRY_OVERHEAD))
+    raw = b"x" * 100
+    for h in range(4):
+        c.put("block", (h,), raw)
+    assert c.get("block", (0,)) == raw  # 0 is now most-recent
+    c.put("block", (4,), raw)  # evicts LRU entry (1,)
+    assert c.evictions == 1
+    assert c.get("block", (1,)) is None
+    assert c.get("block", (0,)) == raw
+    assert c.resident_bytes() <= c.max_bytes
+    # an entry bigger than the whole budget is refused outright
+    c.put("block", (9,), b"y" * (c.max_bytes + 1))
+    assert c.get("block", (9,)) is None
+
+
+def test_cache_generation_invalidation():
+    c = RPCCache(max_bytes=1 << 16)
+    c.put("status", (), b'{"h":"1"}', generational=True)
+    c.put("block", (1,), b'{"b":1}', generational=False)
+    assert c.get("status", ()) == b'{"h":"1"}'
+    c.on_new_block()
+    # generational entry expired; immutable entry survives
+    assert c.get("status", ()) is None
+    assert c.get("block", (1,)) == b'{"b":1}'
+    # refill at the new generation serves again
+    c.put("status", (), b'{"h":"2"}', generational=True)
+    assert c.get("status", ()) == b'{"h":"2"}'
+
+
+def test_cache_put_with_pre_handler_generation_is_already_stale():
+    """Race guard: a generational fill stamped with the generation
+    observed BEFORE the handler ran dies immediately if a block landed
+    mid-handler — pre-bump data never survives into the new
+    generation."""
+    c = RPCCache(max_bytes=1 << 16)
+    gen0 = c.generation
+    c.on_new_block()  # block lands while the handler is running
+    c.put("status", (), b'{"stale":1}', generational=True,
+          generation=gen0)
+    assert c.get("status", ()) is None
+    # same-generation fills serve normally
+    c.put("status", (), b'{"fresh":1}', generational=True,
+          generation=c.generation)
+    assert c.get("status", ()) == b'{"fresh":1}'
+
+
+def test_cache_generational_ttl_covers_stalled_generation():
+    """A node whose block flow stalls stops bumping the generation; the
+    wall-clock TTL makes sure a healthy-looking /status can't be served
+    from before the stall forever. Immutable entries never expire."""
+    c = RPCCache(max_bytes=1 << 16, gen_ttl_s=0.05)
+    c.put("status", (), b'{"h":"1"}', generational=True)
+    c.put("block", (1,), b'{"b":1}', generational=False)
+    assert c.get("status", ()) == b'{"h":"1"}'
+    time.sleep(0.08)
+    assert c.get("status", ()) is None  # TTL expired, no bump needed
+    assert c.get("block", (1,)) == b'{"b":1}'  # immutable unaffected
+
+
+def test_cache_disabled_is_noop():
+    c = RPCCache(max_bytes=0)
+    assert not c.enabled
+    c.put("block", (1,), b"data")
+    assert c.get("block", (1,)) is None
+    assert c.stats()["enabled"] is False
+
+
+def test_cache_plan_keys():
+    env = types.SimpleNamespace(
+        block_store=types.SimpleNamespace(height=lambda: 10))
+    plan = rpc_core.cache_plan
+    assert plan(env, "status", {}) == ((), True)
+    assert plan(env, "genesis", {}) == ((), False)
+    assert plan(env, "block", {"height": 3}) == ((3,), False)
+    assert plan(env, "block", {}) == (("latest",), True)
+    assert plan(env, "block", {"height": 11}) is None  # past tip
+    assert plan(env, "block", {"height": "bogus"}) is None
+    # the tip commit is the mutable seen-commit: generational
+    assert plan(env, "commit", {"height": 10}) == ((10,), True)
+    assert plan(env, "commit", {"height": 9}) == ((9,), False)
+    assert plan(env, "validators", {"height": 5}) == ((5,), False)
+    assert plan(env, "validators", {}) == (("latest",), True)
+    # blockchain embeds last_height (the moving tip) in EVERY response,
+    # so even a fixed explicit range must be generational — and a
+    # negative maxHeight resolves to the tip in the handler
+    assert plan(env, "blockchain", {"minHeight": 1, "maxHeight": 5}) \
+        == ((1, 5), True)
+    assert plan(env, "blockchain", {"maxHeight": -1})[1] is True
+    assert plan(env, "blockchain", {})[1] is True
+    # non-cacheable routes never plan
+    for m in ("net_info", "tx", "tx_search", "abci_query",
+              "broadcast_tx_sync", "unconfirmed_txs",
+              "dump_consensus_state"):
+        assert plan(env, m, {}) is None
+
+
+# --- backpressure machinery (unit) ------------------------------------
+
+
+class _FakeServer:
+    """Just enough of RPCServer for a WSConn: config knobs + counters."""
+
+    def __init__(self, queue=4, policy="drop"):
+        self.env = types.SimpleNamespace(event_bus=EventBus())
+        self.ws_send_queue = queue
+        self.ws_slow_policy = policy
+        self.metrics = None
+        self.dropped = {}
+        self.enqueued = 0
+        self.subs = 0
+
+    def _note_dropped(self, policy):
+        self.dropped[policy] = self.dropped.get(policy, 0) + 1
+
+    def _note_enqueued(self):
+        self.enqueued += 1
+
+    def _note_subs(self, delta):
+        self.subs += delta
+
+
+class _MemSock:
+    """Collects sent bytes; optionally blocks sendall until released."""
+
+    def __init__(self, blocked=False):
+        self.sent = []
+        self._release = threading.Event()
+        if not blocked:
+            self._release.set()
+        self.closed = False
+
+    def sendall(self, b):
+        if not self._release.wait(timeout=10):
+            raise OSError("blocked sock timeout")
+        self.sent.append(b)
+
+    def release(self):
+        self._release.set()
+
+    def recv(self, n):
+        time.sleep(10)
+        return b""
+
+    def shutdown(self, how):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def test_slow_subscriber_drop_policy_counts():
+    srv = _FakeServer(queue=3, policy="drop")
+    conn = WSConn(_MemSock(), srv)
+    # no writer running: the queue fills deterministically
+    for i in range(8):
+        conn.enqueue_event(b"frame-%d" % i)
+    assert conn.queue_depth() == 3
+    assert conn.events_dropped == 5
+    assert srv.dropped == {"drop": 5}
+    assert not conn._closed.is_set()  # drop keeps the connection
+
+
+def test_slow_subscriber_disconnect_policy_closes():
+    srv = _FakeServer(queue=2, policy="disconnect")
+    sock = _MemSock()
+    conn = WSConn(sock, srv)
+    assert conn.enqueue_event(b"a") and conn.enqueue_event(b"b")
+    assert conn.enqueue_event(b"c") is False
+    assert conn._closed.is_set()
+    assert sock.closed
+    assert srv.dropped == {"disconnect": 1}
+    # a closed connection sheds everything silently
+    assert conn.enqueue_event(b"d") is False
+
+
+def test_writer_drains_queue_and_fast_subscriber_unaffected():
+    srv = _FakeServer(queue=4, policy="drop")
+    srv_fast = _FakeServer(queue=64, policy="drop")
+    slow_sock = _MemSock(blocked=True)
+    slow = WSConn(slow_sock, srv)
+    fast_sock = _MemSock()
+    fast = WSConn(fast_sock, srv_fast)
+    for conn in (slow, fast):
+        conn._writer = threading.Thread(
+            target=conn._writer_loop, daemon=True)
+        conn._writer.start()
+    frames = [b"ev-%d" % i for i in range(12)]
+    for f in frames:
+        slow.enqueue_event(f)
+        fast.enqueue_event(f)
+    deadline = time.time() + 5
+    while fast.events_sent < len(frames) and time.time() < deadline:
+        time.sleep(0.01)
+    # the fast client saw every event, in order, while the slow one
+    # wedged on its first send and dropped the overflow
+    assert fast_sock.sent == [bytes([0x81, len(x)]) + x for x in frames]
+    assert fast.events_dropped == 0
+    assert slow.events_dropped > 0
+    slow_sock.release()
+    for conn in (slow, fast):
+        conn._closed.set()
+        with conn._q_cond:
+            conn._q_cond.notify_all()
+
+
+def test_render_once_for_n_concurrent_subscribers():
+    msg = Message(data={"height": 7, "raw": b"abc"},
+                  tags={"tm.event": "NewBlock"})
+    before = rpc_core.events_rendered_count()
+    frames = []
+    lock = threading.Lock()
+
+    def render(q):
+        f = rpc_core.render_event_frame(msg, q)
+        with lock:
+            frames.append((q, f))
+
+    threads = [threading.Thread(target=render, args=(f"q{i % 3}",))
+               for i in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # 32 subscribers, ONE render — only the query splice is per-client
+    assert rpc_core.events_rendered_count() - before == 1
+    payloads = {f.split(b',"data":', 1)[1] for _, f in frames}
+    assert len(payloads) == 1
+    for q, f in frames:
+        obj = json.loads(f)
+        assert obj["id"] == "#event"
+        assert obj["result"]["query"] == q
+        assert obj["result"]["data"]["value"]["height"] == 7
+
+
+def test_ws_slow_policy_validated():
+    from tendermint_tpu.rpc.server import RPCServer
+
+    with pytest.raises(ValueError, match="ws_slow_policy"):
+        RPCServer(types.SimpleNamespace(), "127.0.0.1", 0,
+                  ws_slow_policy="panic")
+
+
+def test_catching_up_clears_on_switch_to_consensus():
+    """/status catching_up must flip false when fast sync hands off —
+    not stay pinned at the boot-time fast_sync value for the node's
+    whole life."""
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.libs.db import MemDB
+
+    r = BlockchainReactor(None, None, BlockStore(MemDB()), True)
+    assert r.catching_up is True
+
+    class _CaughtUpPool:
+        def is_caught_up(self):
+            return True
+
+        def get_status(self):
+            return (5, 0, 0)
+
+        def max_peer_height(self):
+            return 4
+
+        def stop(self):
+            pass
+
+    r.pool = _CaughtUpPool()
+    assert r._maybe_switch_to_consensus() is True
+    assert r.fast_sync is False
+    assert r.catching_up is False
+
+    # a tailing replica with NO peer height yet (fresh boot, partition)
+    # must claim catching_up — not present itself as a live read node
+    r2 = BlockchainReactor(None, None, BlockStore(MemDB()), True,
+                           tail_forever=True)
+    assert r2.pool.max_peer_height() == 0
+    assert r2.catching_up is True
+
+
+def test_subscription_buffer_counts_drops():
+    from tendermint_tpu.libs.events import PubSub, Query
+
+    ps = PubSub()
+    sub = ps.subscribe("s", Query("k = 'v'"), capacity=2)
+    for _ in range(5):
+        ps.publish("data", {"k": "v"})
+    assert sub.dropped == 3
+
+
+# --- one shared live node ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fanout_node(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fanout")
+    c = make_config(tmp, "n0")
+    c.rpc.laddr = "tcp://127.0.0.1:0"
+    c.rpc.cache_bytes = 4 << 20
+    c.rpc.ws_send_queue = 64
+    c.base.proxy_app = "kvstore"
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    sub = node.event_bus.subscribe(
+        "warm", query_for_event(EVENT_NEW_BLOCK), 8)
+    deadline, h = time.time() + 30, 0
+    while h < 2 and time.time() < deadline:
+        m = sub.get(timeout=1.0)
+        if m is not None:
+            h = m.data["block"].header.height
+    node.event_bus.unsubscribe_all("warm")
+    assert h >= 2
+    client = HTTPClient(node.rpc_listen_addr)
+    yield node, client
+    node.stop()
+
+
+def test_cached_vs_fresh_byte_identical(fanout_node):
+    """Property: for every cacheable immutable call, the bytes served
+    from the cache are EXACTLY the bytes the handler+encoder produce."""
+    node, _ = fanout_node
+    srv = node._rpc_server
+    calls = [
+        ("block", {"height": 1}), ("block", {"height": 2}),
+        ("block_results", {"height": 1}),
+        ("commit", {"height": 1}),
+        ("validators", {"height": 1}),
+        ("blockchain", {"minHeight": 1, "maxHeight": 2}),
+        ("genesis", {}),
+    ]
+    for method, params in calls:
+        # the chain keeps committing: generational responses (e.g.
+        # blockchain's last_height) legitimately change across a
+        # generation bump, so compare within one stable generation
+        for _ in range(10):
+            gen0 = srv.cache.generation
+            fill = srv.call_bytes(method, params)  # miss or hit: fills
+            hit = srv.call_bytes(method, params)   # hit (same gen)
+            saved, srv.cache = srv.cache, None
+            try:
+                fresh = srv.call_bytes(method, params)
+            finally:
+                srv.cache = saved
+            if srv.cache.generation == gen0:
+                break
+        else:
+            pytest.fail("no stable generation window in 10 tries")
+        assert fill == hit == fresh, f"{method} {params} diverged"
+        # and the result is real JSON a client can parse
+        json.loads(fresh)
+
+
+def test_cache_hits_recorded_and_http_served(fanout_node):
+    node, client = fanout_node
+    srv = node._rpc_server
+    h0 = srv.cache.hits
+    b1 = client.block(1)
+    b2 = client.block(1)
+    assert b1 == b2
+    assert srv.cache.hits > h0
+    st = srv.cache.stats()
+    assert st["enabled"] and st["bytes"] > 0 and st["entries"] > 0
+
+
+def test_stale_status_never_served_past_one_generation(fanout_node):
+    """The tentpole invalidation contract: once a NewBlock lands, the
+    next /status reflects (at least) that height promptly — the cached
+    generation died with the block event."""
+    node, client = fanout_node
+    sub = node.event_bus.subscribe(
+        "stale-check", query_for_event(EVENT_NEW_BLOCK), 8)
+    try:
+        client.status()  # prime the generational entry
+        msg = sub.get(timeout=10)
+        assert msg is not None
+        h = msg.data["block"].header.height
+        deadline = time.time() + 3.0
+        latest = -1
+        while time.time() < deadline:
+            latest = int(client.status()["sync_info"]
+                         ["latest_block_height"])
+            if latest >= h:
+                break
+            time.sleep(0.02)
+        assert latest >= h, (
+            f"status stuck at {latest} after NewBlock {h}")
+    finally:
+        node.event_bus.unsubscribe_all("stale-check")
+
+
+def test_ws_subscribe_event_has_render_once_shape(fanout_node):
+    node, _ = fanout_node
+    ws = WSClient(node.rpc_listen_addr)
+    ws.connect()
+    try:
+        ws.subscribe("tm.event = 'NewBlock'")
+        ev = ws.next_event(timeout=15)
+        assert ev is not None
+        assert ev["query"] == "tm.event = 'NewBlock'"
+        assert ev["data"]["type"] == "NewBlock"
+        int(ev["data"]["value"]["block"]["header"]["height"])
+        # the debug bundle exposes the funnel counters
+        st = node._rpc_server.debug_status()
+        assert st["ws"]["events_rendered"] >= 1
+        assert st["ws"]["send_queue_capacity"] == 64
+        assert st["ws"]["max_queue_hwm"] >= 0
+        json.dumps(st)  # JSON-able for /debug/rpc
+    finally:
+        ws.close()
+
+
+def test_ws_subscriber_gauge_tracks_lifecycle(fanout_node):
+    node, _ = fanout_node
+    srv = node._rpc_server
+    base = srv._subs_count
+    ws = WSClient(node.rpc_listen_addr)
+    ws.connect()
+    try:
+        ws.subscribe("tm.event = 'NewBlock'")
+        assert srv._subs_count == base + 1
+        ws.unsubscribe("tm.event = 'NewBlock'")
+        assert srv._subs_count == base
+        ws.subscribe("tm.event = 'Tx'")
+        assert srv._subs_count == base + 1
+    finally:
+        ws.close()
+    deadline = time.time() + 5
+    while srv._subs_count != base and time.time() < deadline:
+        time.sleep(0.05)
+    assert srv._subs_count == base  # conn teardown released its subs
+
+
+def test_ws_frame_size_capped(fanout_node):
+    """Satellite: the 64-bit extended length is attacker-controlled —
+    a frame claiming more than MAX_BODY_BYTES must kill the conn, not
+    size an allocation."""
+    node, _ = fanout_node
+    import base64 as b64
+    import hashlib as hl
+
+    host, _, port = node.rpc_listen_addr.rpartition(":")
+    s = socket.create_connection((host, int(port)), timeout=5)
+    try:
+        key = b64.b64encode(os.urandom(16)).decode()
+        s.sendall((
+            f"GET /websocket HTTP/1.1\r\nHost: {host}:{port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = s.recv(4096)
+            assert chunk, "handshake failed"
+            buf += chunk
+        assert b"101" in buf.split(b"\r\n", 1)[0]
+        # masked frame claiming a 1 TiB payload
+        hdr = bytes([0x81, 0x80 | 127]) + struct.pack(">Q", 1 << 40)
+        s.sendall(hdr + os.urandom(4))
+        s.settimeout(5)
+        # server hangs up without reading a terabyte
+        end = time.time() + 5
+        closed = False
+        while time.time() < end:
+            try:
+                if s.recv(4096) == b"":
+                    closed = True
+                    break
+            except socket.timeout:
+                break
+            except OSError:  # RST is as closed as FIN
+                closed = True
+                break
+        assert closed, "server kept the oversize-frame connection open"
+    finally:
+        s.close()
+    # the server is still healthy for well-behaved clients
+    ws = WSClient(node.rpc_listen_addr)
+    ws.connect()
+    ws.close()
+
+
+def test_broadcast_tx_commit_rejection_leaves_no_subscription(
+        fanout_node):
+    """Satellite: a CheckTx rejection must tear the event subscription
+    down immediately — not hold it for the commit timeout."""
+    from tendermint_tpu.mempool import make_signed_tx
+    from tendermint_tpu.crypto import keys
+
+    node, client = fanout_node
+    sk = keys.PrivKeyEd25519.generate()
+    tx = bytearray(make_signed_tx(sk, b"btc-reject-payload"))
+    tx[10] ^= 0xFF  # corrupt the signature: preverify rejects pre-app
+    before = node.event_bus.num_subscriptions()
+    t0 = time.time()
+    res = client.broadcast_tx_commit(bytes(tx))
+    elapsed = time.time() - t0
+    assert int(res["check_tx"]["code"]) != 0
+    assert res["height"] == "0"
+    assert elapsed < 5.0, "rejection waited on the commit timeout"
+    assert node.event_bus.num_subscriptions() == before
+
+
+def test_broadcast_tx_commit_timeout_configurable(fanout_node,
+                                                  monkeypatch):
+    node, client = fanout_node
+    monkeypatch.setattr(
+        node.config.rpc, "timeout_broadcast_tx_commit", 0.001)
+    t0 = time.time()
+    # valid tx: CheckTx passes, but 1ms never covers a commit — the
+    # knob (not the hard-coded 10s) bounds the wait
+    with pytest.raises(RPCError, match="timed out"):
+        client.broadcast_tx_commit(b"btc-timeout-knob=1")
+    assert time.time() - t0 < 5.0
+
+
+# --- monitor /debug/rpc -----------------------------------------------
+
+
+def _stub_debug_server(payload: dict):
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    host, port = srv.server_address[:2]
+    return srv, f"{host}:{port}"
+
+
+def test_monitor_flags_ws_backpressure_and_cache_thrash():
+    from tendermint_tpu.tools.monitor import (
+        HEALTH_FULL,
+        HEALTH_MODERATE,
+        Monitor,
+    )
+
+    healthy = {
+        "dwell_s": 0.1, "threshold_s": 30.0, "stalls_total": 0,
+        "stalls": [], "live": {"peers": []},
+        "ws": {"subscribers": 5, "send_queue_capacity": 100,
+               "max_queue_depth": 2, "events_dropped": {}},
+        "cache": {"enabled": True, "hit_rate": 0.9, "bytes": 1000,
+                  "evictions": 0},
+    }
+    srv, daddr = _stub_debug_server(healthy)
+    try:
+        mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc-addr"]
+        ns.mark_online()
+        mon._poll_debug(ns, daddr)
+        assert ns.ws_subscribers == 5 and not ns.ws_backed_up
+        assert not ns.cache_thrash
+        assert mon.health() == HEALTH_FULL
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # ws queue >= 80% of capacity -> moderate
+    backed = dict(healthy)
+    backed["ws"] = {"subscribers": 5, "send_queue_capacity": 100,
+                    "max_queue_depth": 85,
+                    "events_dropped": {"drop": 12}}
+    srv, daddr = _stub_debug_server(backed)
+    try:
+        mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc-addr"]
+        ns.mark_online()
+        mon._poll_debug(ns, daddr)
+        assert ns.ws_backed_up and ns.ws_dropped_total == 12
+        assert mon.health() == HEALTH_MODERATE
+        snap = mon.snapshot()
+        assert snap["nodes"][0]["ws_backed_up"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    # evicting while mostly missing ACROSS A POLL INTERVAL -> thrash ->
+    # moderate; the first poll only establishes the baseline (a monitor
+    # restarting against a node with old history must not mis-fire on
+    # lifetime counters)
+    thrash = dict(healthy)
+    thrash["cache"] = {"enabled": True, "hit_rate": 0.1, "bytes": 1000,
+                       "evictions": 500, "hits": 10, "misses": 90}
+    srv, daddr = _stub_debug_server(thrash)
+    try:
+        mon = Monitor(["rpc-addr"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc-addr"]
+        ns.mark_online()
+        mon._poll_debug(ns, daddr)
+        assert not ns.cache_thrash  # baseline poll never flags
+        # interval: 400 more evictions, 400 requests, 1 hit
+        thrash["cache"] = {"enabled": True, "hit_rate": 0.1,
+                           "bytes": 1000, "evictions": 900,
+                           "hits": 11, "misses": 489}
+        mon._poll_debug(ns, daddr)
+        assert ns.cache_thrash
+        assert mon.health() == HEALTH_MODERATE
+        # a healthy interval (hits, no evictions) clears the flag
+        thrash["cache"] = {"enabled": True, "hit_rate": 0.5,
+                           "bytes": 1000, "evictions": 900,
+                           "hits": 200, "misses": 490}
+        mon._poll_debug(ns, daddr)
+        assert not ns.cache_thrash
+        # endpoint loss clears the view instead of pinning moderate
+        ns.cache_thrash = True
+        ns.clear_debug_view()
+        assert not ns.cache_thrash and not ns.ws_backed_up
+        assert mon.health() == HEALTH_FULL
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# --- config plumbing --------------------------------------------------
+
+
+def test_config_toml_roundtrip_serving_knobs():
+    c = cfg.Config()
+    c.base.mode = "replica"
+    c.rpc.cache_bytes = 123456
+    c.rpc.ws_send_queue = 42
+    c.rpc.ws_slow_policy = "disconnect"
+    c.rpc.timeout_broadcast_tx_commit = 3.5
+    out = cfg.Config.from_toml(c.to_toml())
+    assert out.base.mode == "replica"
+    assert out.rpc.cache_bytes == 123456
+    assert out.rpc.ws_send_queue == 42
+    assert out.rpc.ws_slow_policy == "disconnect"
+    assert out.rpc.timeout_broadcast_tx_commit == 3.5
+    # defaults preserve current behavior: cache off, full mode
+    d = cfg.Config()
+    assert d.rpc.cache_bytes == 0
+    assert d.base.mode == "full"
+    assert d.rpc.ws_slow_policy == "drop"
+    assert d.rpc.timeout_broadcast_tx_commit == 10.0
+
+
+def test_bad_mode_refused():
+    tmp = None
+    with pytest.raises(ValueError, match="mode"):
+        from tendermint_tpu.node.node import Node
+
+        c = cfg.test_config()
+        c.base.mode = "reed-replica"
+        Node(c, None, None, None, None)
+
+
+# --- replica e2e (slow) -----------------------------------------------
+
+
+@pytest.mark.slow  # two-node statesync bootstrap + tail: ~40s wall
+def test_replica_statesync_join_tail_and_serve(tmp_path):
+    """The acceptance e2e: a replica joins via state sync, permanently
+    tails blocks through the fast-sync reactor, and serves block/
+    validators/status plus live subscriptions — without EVER
+    instantiating a ConsensusState."""
+    from tendermint_tpu.consensus import ConsensusState
+    from tendermint_tpu.types import GenesisDoc
+
+    def _cfg(name):
+        c = make_config(tmp_path, name)
+        c.consensus.create_empty_blocks_interval = 0.25
+        c.statesync.chunk_size = 64
+        c.statesync.discovery_time_s = 1.0
+        c.statesync.restore_timeout_s = 45.0
+        return c
+
+    ca = _cfg("producer")
+    ca.statesync.snapshot_interval = 2
+    init_files(ca)
+    genesis = GenesisDoc.load(ca.base.genesis_path())
+    a = default_new_node(ca)
+    a.start()
+    b = None
+    instantiated = []
+    orig_init = ConsensusState.__init__
+
+    def _counting_init(self, *args, **kw):
+        instantiated.append(self)
+        return orig_init(self, *args, **kw)
+
+    try:
+        for i in range(40):
+            a.mempool.check_tx(b"seed-%d=%s" % (i, b"v" * 40))
+        deadline = time.time() + 60
+        while a.block_store.height() < 7 and time.time() < deadline:
+            time.sleep(0.2)
+        assert a.block_store.height() >= 7
+
+        cb = _cfg("replica")
+        cb.base.mode = "replica"
+        cb.statesync.enable = True
+        cb.rpc.laddr = "tcp://127.0.0.1:0"
+        cb.rpc.cache_bytes = 1 << 20
+        cb.p2p.persistent_peers = \
+            f"{a.node_key.id}@{a.transport.listen_addr}"
+        init_files(cb, genesis_doc=genesis)
+
+        ConsensusState.__init__ = _counting_init
+        try:
+            b = default_new_node(cb)
+            assert b.consensus_state is None
+            assert b.consensus_reactor is None
+            assert b.state_syncer is not None, "fresh replica statesyncs"
+            sub_b = b.event_bus.subscribe(
+                "tail", query_for_event(EVENT_NEW_BLOCK), 256)
+            b.start()
+
+            # statesync completed: store seeded past genesis
+            deadline = time.time() + 60
+            while time.time() < deadline and b.block_store.base() <= 1:
+                time.sleep(0.2)
+            assert b.block_store.base() > 1, (
+                f"restore never finished: {b.state_syncer.status()}")
+
+            # tails NEW blocks while the validator keeps committing
+            heights = []
+            deadline = time.time() + 60
+            while len(heights) < 3 and time.time() < deadline:
+                m = sub_b.get(timeout=0.25)
+                if m is not None:
+                    heights.append(m.data["block"].header.height)
+            assert len(heights) >= 3, f"replica saw only {heights}"
+            assert heights == sorted(heights)
+        finally:
+            ConsensusState.__init__ = orig_init
+        assert instantiated == [], (
+            "replica instantiated a ConsensusState")
+
+        # serves the read surface
+        client = HTTPClient(b.rpc_listen_addr)
+        st = client.status()
+        assert int(st["sync_info"]["latest_block_height"]) >= heights[0]
+        blk = client.block(heights[0])
+        assert blk["block"]["header"]["height"] == str(heights[0])
+        assert a.block_store.load_block(heights[0]).hash() == \
+            b.block_store.load_block(heights[0]).hash()
+        vals = client.validators()
+        assert len(vals["validators"]) == 1
+        # cache serves the second identical read
+        h0 = b._rpc_server.cache.hits
+        assert client.block(heights[0]) == blk
+        assert b._rpc_server.cache.hits > h0
+
+        # live subscriptions work on the replica
+        ws = WSClient(b.rpc_listen_addr)
+        ws.connect()
+        try:
+            ws.subscribe("tm.event = 'NewBlock'")
+            ev = ws.next_event(timeout=30)
+            assert ev is not None, "no live event from replica"
+            assert int(ev["data"]["value"]["block"]["header"]
+                       ["height"]) > heights[0]
+        finally:
+            ws.close()
+
+        # consensus introspection refuses politely
+        with pytest.raises(Exception, match="replica"):
+            client.consensus_state()
+        # /debug/consensus equivalent reports replica shape
+        json.dumps(b._consensus_status())
+        assert b._consensus_status()["mode"] == "replica"
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+@pytest.mark.slow  # boots a node + 100 websocket clients: ~30s wall
+def test_bench_rpcload_schema_and_acceptance(tmp_path):
+    """`bench.py rpcload` emits the standard BENCH line; the hot cached
+    endpoint is >=5x the uncached p50 and fan-out to 100 subscribers
+    performs exactly 1 render per event (counter-asserted)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TM_TPU_BENCH_RPC_SUBS="100")
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "..", "bench.py"), "rpcload"],
+        capture_output=True, text=True, timeout=300, env=env)
+    line = [l for l in out.stdout.splitlines()
+            if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"].startswith("rpc_serving_100subs")
+    assert rec["unit"] == "ms"
+    assert rec["value"] > 0
+    # acceptance: >=5x p50 on the hot cached endpoint vs uncached
+    assert rec["vs_baseline"] >= 5.0, rec
+    # acceptance: exactly 1 render per event at 100 subscribers
+    assert rec["subscribers"] == 100
+    assert rec["fanout_events"] >= 1
+    assert rec["fanout_renders"] == rec["fanout_events"], rec
+    assert rec["fanout_frames_delivered"] == \
+        rec["fanout_events"] * 100, rec
+    assert rec["renders_per_event"] == 1.0
