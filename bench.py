@@ -61,11 +61,13 @@ AGGVERIFY_MODE = "aggverify" in sys.argv[1:]  # BLS aggregate cert (PR 7)
 RPCLOAD_MODE = "rpcload" in sys.argv[1:]  # RPC fan-out serving (PR 9)
 WARMSTART_MODE = "warmstart" in sys.argv[1:]  # compile-once readiness (PR 8)
 MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
+CHAOSNET_MODE = "chaosnet" in sys.argv[1:]  # partition-heal recovery (PR 10)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
-                      "aggverify", "warmstart", "mega", "--pipeline")]
+                      "aggverify", "warmstart", "mega", "chaosnet",
+                      "--pipeline")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -110,6 +112,10 @@ RPC_SUBS = _env_int("TM_TPU_BENCH_RPC_SUBS", 100)
 RPC_QUERIES = _env_int("TM_TPU_BENCH_RPC_QUERIES", 2000)
 RPC_THREADS = _env_int("TM_TPU_BENCH_RPC_THREADS", 4)
 RPCLOAD_METRIC = f"rpc_serving_{RPC_SUBS}subs_hot_status_p50_ms"
+CHAOSNET_NVAL = _env_int("TM_TPU_BENCH_CHAOSNET_NVAL", 4)
+CHAOSNET_SEED = _env_int("TM_TPU_BENCH_CHAOSNET_SEED", 1)
+CHAOSNET_METRIC = (
+    f"chaosnet_partition_heal_{CHAOSNET_NVAL}node_recovery_ms")
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1371,6 +1377,41 @@ def warmstart_main(degraded):
     _emit(out, degraded)
 
 
+def chaosnet_main():
+    """`bench.py chaosnet` — network-partition recovery latency: the
+    partition_heal scenario (tools/scenarios.py) on an in-process
+    localnet, reporting wall ms from fault removal to the first NEW
+    height committed and agreed by every node. Pure host path: no TPU.
+    The scenario's oracle gates the number: a run that fails to
+    converge, violates safety, or misclassifies its stall emits
+    value -1 instead of a fake latency."""
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("partition_heal", seed=CHAOSNET_SEED,
+                        n=CHAOSNET_NVAL)
+    ok = bool(res.get("ok"))
+    recovery_ms = (round(res["recovery_s"] * 1000, 1)
+                   if ok and res.get("recovery_s") is not None else -1)
+    print(json.dumps({
+        "metric": CHAOSNET_METRIC,
+        "value": recovery_ms,
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "seed": CHAOSNET_SEED,
+        "converged": res.get("converged"),
+        "safety_ok": res.get("safety_ok"),
+        "classified_ok": res.get("classified_ok"),
+        "stall_reasons": sorted(set(res.get("stall_reasons", []))),
+        "note": ("wall from partition heal to first new agreed height; "
+                 "fault timeline replayable from seed "
+                 f"{CHAOSNET_SEED} (netchaos FaultPlan)"),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -1378,6 +1419,9 @@ def main():
         return commit4_main()
     if CHAOS_MODE:
         return chaos_main()
+    if CHAOSNET_MODE:
+        # in-process localnet: pure host path, no TPU probe
+        return chaosnet_main()
     if LOAD_MODE:
         return load_main()
     if PREVERIFY_MODE:

@@ -261,6 +261,15 @@ REQUIRED_FAMILIES = (
     "rpc_ws_subscribers",
     "rpc_ws_dropped_total",
     "rpc_events_rendered_total",
+    # PR-10 chaos engine + churn workload (declaration presence: a node
+    # with no installed fault plan injects nothing, a stable valset
+    # records no churn, and reconnect attempts need a dropped
+    # persistent peer)
+    "chaos_injected_total",
+    "chaos_active_rules",
+    "churn_validator_updates_total",
+    "churn_valset_changes_total",
+    "p2p_reconnect_attempts_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -56,11 +56,16 @@ def _proof_from(o):
 
 
 def _valupdates_obj(vs):
-    return [[v.pub_key, v.power] for v in vs]
+    # pop rides as an optional third element so pre-churn peers'
+    # two-element encodings stay decodable
+    return [[v.pub_key, v.power, v.pop] if v.pop
+            else [v.pub_key, v.power] for v in vs]
 
 
 def _valupdates_from(o):
-    return [abci.ValidatorUpdate(pub_key=v[0], power=v[1]) for v in o]
+    return [abci.ValidatorUpdate(pub_key=v[0], power=v[1],
+                                 pop=v[2] if len(v) > 2 else b"")
+            for v in o]
 
 
 def _header_obj(h):

@@ -292,3 +292,137 @@ class PersistentKVStoreApplication(KVStoreApplication):
         for k, v in self.db.iterator(b"valset:", b"valset;"):
             out.append(abci.ValidatorUpdate(pub_key=k[len(b"valset:") :], power=struct.unpack(">q", v)[0]))
         return out
+
+
+class ChurnKVStoreApplication(PersistentKVStoreApplication):
+    """Validator-churn workload driver: every `epoch_blocks` heights,
+    EndBlock emits a large validator-update batch — removing a
+    `rotation_fraction` of the phantom validators it manages, refilling
+    the pool with fresh deterministic keys, and repowering survivors —
+    on top of whatever `val:` txs produced. This is the first-class
+    rotation workload the chaos scenario suite drives: big
+    update_with_changes batches, verify-path cache invalidation,
+    vote-set handling of validators that vanish mid-height.
+
+    Phantoms never vote (no node holds their keys), so the driver
+    enforces a liveness bound: the phantom pool's total power stays
+    strictly below half the real validators' power, keeping the live
+    set above the +2/3 quorum no matter how the epochs land.
+
+    Everything is a pure function of (seed, height, db state): keys
+    come from gen_from_secret over (seed, height, slot) and the epoch
+    RNG is seeded per (seed, epoch), so crash-replayed EndBlocks emit
+    byte-identical batches and two runs with one seed rotate
+    identically."""
+
+    PHANTOM_PREFIX = b"churnpk:"
+
+    def __init__(self, db: DB, epoch_blocks: int = 4,
+                 rotation_fraction: float = 0.5, phantom_pool: int = 8,
+                 seed: int = 0):
+        super().__init__(db)
+        if epoch_blocks < 1:
+            raise ValueError("epoch_blocks must be >= 1")
+        if not 0.0 <= rotation_fraction <= 1.0:
+            raise ValueError("rotation_fraction must be in [0, 1]")
+        self.epoch_blocks = epoch_blocks
+        self.rotation_fraction = rotation_fraction
+        self.phantom_pool = phantom_pool
+        self.seed = seed
+        self.epochs_run = 0  # process-local telemetry, not consensus state
+
+    # -- phantom bookkeeping (db-backed: replay-deterministic) ---------
+
+    def _phantoms(self):
+        """[(type-tagged pubkey bytes, power)] sorted by pubkey."""
+        out = []
+        for k, v in self.db.iterator(self.PHANTOM_PREFIX, b"churnpk;"):
+            out.append((k[len(self.PHANTOM_PREFIX):],
+                        struct.unpack(">q", v)[0]))
+        return out
+
+    def _real_power(self) -> int:
+        phantom_keys = {pk for pk, _ in self._phantoms()}
+        total = 0
+        for v in self.validators():
+            if v.pub_key not in phantom_keys:
+                total += v.power
+        return total
+
+    def _phantom_key(self, height: int, slot: int) -> bytes:
+        from ...crypto import pubkey_to_bytes
+        from ...crypto.keys import PrivKeyEd25519
+
+        sk = PrivKeyEd25519.gen_from_secret(
+            b"churn:%d:%d:%d" % (self.seed, height, slot))
+        return pubkey_to_bytes(sk.pub_key())
+
+    def _apply_phantom(self, update: abci.ValidatorUpdate) -> None:
+        self._set_validator(update)
+        key = self.PHANTOM_PREFIX + update.pub_key
+        if update.power == 0:
+            self.db.delete(key)
+        else:
+            self.db.set(key, struct.pack(">q", update.power))
+
+    def _epoch_batch(self, height: int):
+        """The deterministic rotation batch for one epoch boundary."""
+        import random as _random
+
+        epoch = height // self.epoch_blocks
+        rng = _random.Random((self.seed << 20) ^ epoch)
+        phantoms = self._phantoms()
+        updates = []
+
+        # 1) rotate out a fraction of the current pool
+        n_remove = int(len(phantoms) * self.rotation_fraction)
+        removed = {pk for pk, _ in rng.sample(phantoms, n_remove)}
+        updates.extend(abci.ValidatorUpdate(pub_key=pk, power=0)
+                       for pk, _ in phantoms if pk in removed)
+
+        # liveness bound for steps 2+3: phantom power after this batch
+        # stays < real_power / 2, so the REAL validators always hold
+        # > 2/3 of the total no matter how the epochs land
+        budget = max(0, (self._real_power() - 1) // 2)
+
+        # 2) repower a rotation of the survivors (power toggles 1<->2;
+        # a toggle UP that would breach the bound is skipped, the RNG
+        # draw is consumed either way so the stream stays aligned)
+        survivors = []  # (pubkey, power AFTER this batch)
+        power_after = sum(p for pk, p in phantoms if pk not in removed)
+        for pk, p in phantoms:
+            if pk in removed:
+                continue
+            newp = p
+            if rng.random() < 0.5:
+                cand = 2 if p == 1 else 1
+                if power_after + (cand - p) <= budget or cand < p:
+                    newp = cand
+            if newp != p:
+                updates.append(abci.ValidatorUpdate(pub_key=pk, power=newp))
+                power_after += newp - p
+            survivors.append((pk, newp))
+
+        # 3) refill the pool with fresh keys, same bound
+        slot = 0
+        for _ in range(max(0, self.phantom_pool - len(survivors))):
+            if power_after + 1 > budget:
+                break  # pool would endanger quorum; skip the add
+            updates.append(abci.ValidatorUpdate(
+                pub_key=self._phantom_key(height, slot), power=1))
+            power_after += 1
+            slot += 1
+        return updates
+
+    def end_block(self, req):
+        res = super().end_block(req)
+        if req.height % self.epoch_blocks != 0:
+            return res
+        batch = self._epoch_batch(req.height)
+        for u in batch:
+            self._apply_phantom(u)
+        self.epochs_run += 1
+        # tx-driven updates ride first; the epoch batch never touches
+        # real validators, so the two cannot conflict on a key
+        res.validator_updates = list(res.validator_updates) + batch
+        return res

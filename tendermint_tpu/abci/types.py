@@ -75,6 +75,13 @@ class ResponseQuery:
 class ValidatorUpdate:
     pub_key: bytes  # type-tagged pubkey bytes (crypto.pubkey_to_bytes)
     power: int
+    # BLS12-381 proof of possession for a key JOINING a BLS valset
+    # (96-byte signature over the pubkey bytes under the POP DST; empty
+    # for Ed25519 keys and for updates to already-registered keys).
+    # Without a verified PoP the aggregate fast lane would be open to
+    # rogue-key attacks from any key the app rotates in — update_state
+    # refuses such updates (state/execution.py).
+    pop: bytes = b""
 
 
 @dataclass

@@ -107,11 +107,15 @@ class P2PConfig:
     allow_duplicate_ip: bool = True
     handshake_timeout: float = 20.0
     dial_timeout: float = 3.0
-    # fuzz testing (reference config/config.go:485-530)
+    # fuzz testing (reference config/config.go:485-530): with test_fuzz
+    # on, every peer connection is wrapped in a FuzzedConnection
+    # (p2p/fuzz.py) built from these knobs. test_fuzz_seed != 0 makes
+    # each connection's op sequence deterministic (per-instance RNG).
     test_fuzz: bool = False
     test_fuzz_mode: str = "drop"  # drop | delay
     test_fuzz_prob_drop_rw: float = 0.2
     test_fuzz_delay_ms: int = 250
+    test_fuzz_seed: int = 0
 
 
 @dataclass
@@ -290,6 +294,25 @@ class StateSyncConfig:
 
 
 @dataclass
+class ChaosConfig:
+    """[chaos] — the deterministic network-fault engine (p2p/netchaos.py;
+    ours, no reference equivalent — the reference's only fault tool is
+    the per-connection fuzz wrapper).
+
+    enable: install a process-wide NetChaosController at node boot;
+    every peer link's outbound path then runs the plan's rules.
+    seed: the fault plan's RNG seed — same seed, same fault timeline.
+    plan: path to a FaultPlan JSON file (FaultPlan.to_json shape:
+    {"seed": N, "phases": [[at_s, until_s, rule], ...]}); empty = an
+    empty plan (the engine idles until one is installed in-process,
+    which is how the scenario runner drives it)."""
+
+    enable: bool = False
+    seed: int = 0
+    plan: str = ""
+
+
+@dataclass
 class TxIndexConfig:
     """reference config/config.go:723-760"""
 
@@ -331,6 +354,7 @@ class Config:
     abci: ABCIConfig = field(default_factory=ABCIConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -374,6 +398,7 @@ class Config:
             abci_section,
             emit("crypto", self.crypto),
             emit("statesync", self.statesync),
+            emit("chaos", self.chaos),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
         ]
@@ -395,6 +420,7 @@ class Config:
             "consensus": cfg.consensus,
             "crypto": cfg.crypto,
             "statesync": cfg.statesync,
+            "chaos": cfg.chaos,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,
         }

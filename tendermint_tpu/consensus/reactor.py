@@ -96,6 +96,11 @@ class PeerState:
         self.peer = peer
         self._lock = threading.RLock()
         self.prs = PeerRoundState()
+        # gossip-mark self-healing bookkeeping: when the peer's HEIGHT
+        # last advanced, and when we last expired our sent-marks for it
+        # (see expire_gossip_marks_if_stalled)
+        self.last_height_advance = time.monotonic()
+        self._marks_expired_at = time.monotonic()
 
     # -- queries -------------------------------------------------------
 
@@ -129,6 +134,8 @@ class PeerState:
             # every precommit the peer already has
             ps_precommits = prs.precommits
 
+            if ps_height != msg.height:
+                self.last_height_advance = time.monotonic()
             prs.height = msg.height
             prs.round = msg.round
             prs.step = msg.step
@@ -253,6 +260,47 @@ class PeerState:
                 prs.catchup_commit = prs.precommits
             else:
                 prs.catchup_commit = BitArray(num_validators)
+
+    def expire_gossip_marks_if_stalled(self, stall_s: float,
+                                       our_height: int = None) -> bool:
+        """Self-healing under silent message loss (netchaos drops, lossy
+        links, asymmetric partitions): gossip marks votes/parts as
+        known-to-the-peer ON SEND, but a dropped send means the peer
+        never got them — and with the TCP connection surviving the
+        fault, nothing ever clears the poisoned marks, so after the
+        fault both sides sit forever believing there is nothing left to
+        send (the reference never hits this because TCP either delivers
+        or kills the conn, which resets PeerState wholesale).
+
+        When the peer's HEIGHT has not advanced for `stall_s`, wipe the
+        knowledge marks so the gossip routines re-offer everything the
+        peer might have missed; duplicates are cheap (dup-check + sig
+        cache) and the wipe re-arms at most once per stall_s. A peer
+        AHEAD of us is excluded via our_height: nothing we hold can
+        unstick it, so wiping would only generate duplicate traffic."""
+        with self._lock:
+            now = time.monotonic()
+            if our_height is not None and self.prs.height > our_height:
+                return False
+            if (now - self.last_height_advance < stall_s
+                    or now - self._marks_expired_at < stall_s):
+                return False
+            self._marks_expired_at = now
+            prs = self.prs
+            prs.proposal = False
+            if prs.proposal_block_parts is not None:
+                prs.proposal_block_parts = BitArray(
+                    prs.proposal_block_parts.bits)
+            prs.proposal_pol = None
+            prs.prevotes = None
+            prs.precommits = None
+            prs.last_commit = None
+            # reset the catchup round too: ensure_catchup_commit_round
+            # early-returns on a matching round and would otherwise
+            # leave catchup_commit None forever
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+            return True
 
     def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
         """reactor.go:996-1018."""
@@ -383,6 +431,17 @@ class ConsensusReactor(Reactor):
         self._stop = threading.Event()
         self._bcast_thread: Optional[threading.Thread] = None
         self._subs = []
+        # gossip-mark expiry horizon (expire_gossip_marks_if_stalled):
+        # roughly one full round at this chain's timeouts — long enough
+        # that normal progress never expires, short enough that a
+        # silent-loss stall re-offers within a few rounds
+        try:
+            conf = consensus_state.config
+            self._gossip_resend_s = max(
+                2.0,
+                2 * (conf.propose(1) + conf.prevote(1) + conf.precommit(1)))
+        except Exception:  # noqa: BLE001 - absent config in bare tests
+            self._gossip_resend_s = 10.0
 
     def get_channels(self):
         """reactor.go:125-157."""
@@ -412,6 +471,40 @@ class ConsensusReactor(Reactor):
             target=self._broadcast_routine, name="cons-bcast", daemon=True
         )
         self._bcast_thread.start()
+        self._step_refresh_thread = threading.Thread(
+            target=self._step_refresh_routine, name="cons-step-refresh",
+            daemon=True)
+        self._step_refresh_thread.start()
+
+    def _step_refresh_routine(self) -> None:
+        """Periodically re-announce our round step to every peer.
+
+        Step transitions broadcast NewRoundStep once; under silent
+        message loss (netchaos drops, asymmetric partitions) that one
+        copy can vanish, and several steps (PREVOTE before 2/3-any,
+        PRECOMMIT_WAIT) have NO timeout — a wedged node then emits
+        nothing, every peer's view of its (height, round) goes stale,
+        and vote gossip keeps aiming at the wrong round forever. A
+        ~tiny periodic refresh (one <100B message per peer) re-anchors
+        peer views so the mark-expiry resend actually lands.
+
+        It re-sends the LAST step broadcast's bytes rather than
+        re-reading RoundState: a fresh shallow copy taken from this
+        thread can tear mid-transition, and a torn (height, round,
+        step) that jumps FORWARD would poison every peer's view (the
+        receive guard only rejects regressions). Stale-but-consistent
+        bytes are harmless — receivers ignore anything <= their view."""
+        interval = max(0.5, self._gossip_resend_s / 2.0)
+        while not self._stop.wait(interval):
+            if self.fast_sync:
+                continue
+            step_bytes = getattr(self, "_last_step_bcast", None)
+            if step_bytes is None:
+                continue
+            try:
+                self._broadcast(STATE_CHANNEL, step_bytes)
+            except Exception:  # noqa: BLE001 - refresh must outlive bugs
+                LOG.exception("round-step refresh failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -574,7 +667,12 @@ class ConsensusReactor(Reactor):
             msg = sub_step.get(timeout=0.05)
             if msg is not None:
                 rs = msg.data
-                self._broadcast(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+                step_bytes = encode_msg(_new_round_step_msg(rs))
+                # cache for the periodic refresh: these bytes were built
+                # from a receive-thread-published snapshot, so re-sending
+                # them later can never leak a torn (height, round, step)
+                self._last_step_bcast = step_bytes
+                self._broadcast(STATE_CHANNEL, step_bytes)
                 cs_msg = _commit_step_msg(rs)
                 if cs_msg is not None:
                     # reference makeRoundStepMessages (reactor.go:404-412):
@@ -704,6 +802,11 @@ class ConsensusReactor(Reactor):
             try:
                 if self._gossip_votes_once(peer, ps):
                     continue
+                # nothing to send: if the peer's height has been stuck
+                # for a full round span, our sent-marks may be lies
+                # (silently dropped sends) — expire and re-offer
+                ps.expire_gossip_marks_if_stalled(
+                    self._gossip_resend_s, our_height=self.cs.rs.height)
             except Exception:
                 LOG.exception("gossip votes error for %s", peer.id[:8])
             time.sleep(PEER_GOSSIP_SLEEP)

@@ -119,8 +119,12 @@ class ConsensusState:
         # several in-process nodes (tests, sim harnesses)
         self.timeline = timeline_mod.Timeline()
         # wall clock of the last (height, round) change — the stall
-        # watchdog's dwell anchor; written only by the receive thread
+        # watchdog's dwell anchor; written only by the receive thread.
+        # _height_entered anchors the HEIGHT-level dwell: a partition
+        # churns rounds fast enough that no single round ever crosses
+        # the threshold while the height stays stuck for the whole fault
         self._round_entered = time.time()
+        self._height_entered = time.time()
 
         self.rs = RoundState()
         self.state = None  # set by update_to_state
@@ -251,6 +255,7 @@ class ConsensusState:
         rs.triggered_timeout_precommit = False
 
         self._round_entered = time.time()
+        self._height_entered = time.time()
         self.timeline.mark(height, "new_height")
         self.state = state
         self._new_step()
@@ -1076,7 +1081,17 @@ class ConsensusState:
             return False
         if rs.proposal_block_parts is None:
             return False
-        added = rs.proposal_block_parts.add_part(msg.part)
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except ValueError as e:
+            # a part whose proof fails against OUR current parts header
+            # is usually not malice: gossip for the previous round's
+            # proposal racing our round change lands here (the sender's
+            # view of our round was a beat stale). Reject the part,
+            # keep the peer and the receive loop.
+            LOG.debug("rejecting block part h=%d r=%d from %s: %s",
+                      msg.height, msg.round, peer_id[:8] or "self", e)
+            return False
         if not added:
             return False
         if rs.proposal_block_parts.is_complete():
@@ -1312,6 +1327,13 @@ class ConsensusState:
         (height, round) — the watchdog's primary signal."""
         return max(0.0, time.time() - self._round_entered)
 
+    def height_dwell_seconds(self) -> float:
+        """Wall seconds since the machine entered the current HEIGHT —
+        the partition/churn signal: round churn (propose timeout →
+        nil prevotes → next round) keeps every per-round dwell short
+        while the height itself goes nowhere."""
+        return max(0.0, time.time() - self._height_entered)
+
     def stall_snapshot(self, switch=None, reason: str = "",
                        dwell_s: float = 0.0) -> dict:
         """Structured diagnostic bundle for the current round: RoundState
@@ -1337,6 +1359,8 @@ class ConsensusState:
                 "valid_round": rs.valid_round,
             },
             "votes": {},
+            "n_validators": (len(rs.validators)
+                             if rs.validators is not None else 0),
             "missing_validators": [],
             "peers": [],
             "inflight_verify_batches": crypto_batch.inflight_count(),
@@ -1423,13 +1447,43 @@ def _bits_str(ba) -> str:
     return "".join("1" if ba.get_index(i) else "0" for i in range(ba.bits))
 
 
+# a peer that delivered no packet for this long is silent: either gone,
+# or the far side of a partition whose writes never reach us. Live
+# consensus peers gossip steps/votes many times a second, so anything
+# healthy sits far under it; a freshly (re)dialed connection counts as
+# silent until its first packet lands — a redial straight into a
+# partition (the handshake rides the raw socket, only post-upgrade
+# traffic hits the fault rules) must not look reachable. Partition
+# classification scales this with the watchdog threshold (a stalled
+# production round legitimately goes seconds between messages); this
+# default serves the /debug payload's per-peer view.
+PEER_SILENT_AFTER_S = 3.0
+
+
+def _peer_is_silent(peer, after_s: float = PEER_SILENT_AFTER_S) -> bool:
+    try:
+        last = peer.mconn.last_recv_time
+    except Exception:  # noqa: BLE001 - diagnostics never raise
+        return True
+    return last == 0.0 or time.monotonic() - last >= after_s
+
+
+def _reachable_peer_count(switch,
+                          after_s: float = PEER_SILENT_AFTER_S) -> int:
+    """Peers we are actually HEARING from — the quorum-reachability
+    input for partition classification."""
+    return sum(1 for p in switch.peers.list()
+               if not _peer_is_silent(p, after_s))
+
+
 def _peer_states_json(switch, our_height: int) -> List[dict]:
     """Per-peer consensus PeerState summaries (heights, steps, vote bit
     arrays, lag vs our height) for /debug/consensus and the monitor."""
     peers = []
     for p in switch.peers.list():
         ps = p.get("consensus_peer_state")
-        entry = {"peer_id": p.id, "moniker": p.node_info.moniker}
+        entry = {"peer_id": p.id, "moniker": p.node_info.moniker,
+                 "silent": _peer_is_silent(p)}
         if ps is not None:
             prs = ps.get_round_state()
             entry.update({
@@ -1445,20 +1499,56 @@ def _peer_states_json(switch, our_height: int) -> List[dict]:
     return peers
 
 
-def classify_stall(rs: RoundState) -> str:
+def classify_stall(rs: RoundState, switch=None, state=None,
+                   silent_after_s: float = PEER_SILENT_AFTER_S) -> str:
     """Map the stuck round's state to a coarse diagnosis, used as the
-    consensus_stalls_total{reason} label (bounded cardinality)."""
+    consensus_stalls_total{reason} label (bounded cardinality).
+
+    With network/chain context (the watchdog passes both), two sharper
+    diagnoses outrank the generic missing-quorum labels:
+
+    - partition_suspected: quorum is missing AND the peers we can still
+      reach cannot possibly carry +2/3 even if every one of them were a
+      distinct validator — count-based quorum-reachability, the netchaos
+      partition signature.
+    - valset_rotation: quorum is missing right after a validator-set
+      change took effect (churn epoch) — votes may be aimed at (or
+      coming from) a set the sender no longer agrees on.
+    """
     if rs.step in (STEP_NEW_HEIGHT, STEP_NEW_ROUND):
         return "slow_round_start"
-    if rs.step == STEP_PROPOSE:
-        return "no_proposal" if rs.proposal is None else "incomplete_proposal"
-    if rs.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
-        return "no_prevote_quorum"
-    if rs.step in (STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT):
-        return "no_precommit_quorum"
-    if rs.step == STEP_COMMIT:
-        return "commit_not_finalized"
-    return "unknown"
+    if rs.step == STEP_PROPOSE and rs.proposal is None:
+        base = "no_proposal"
+    elif rs.step == STEP_PROPOSE:
+        base = "incomplete_proposal"
+    elif rs.step in (STEP_PREVOTE, STEP_PREVOTE_WAIT):
+        base = "no_prevote_quorum"
+    elif rs.step in (STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT):
+        base = "no_precommit_quorum"
+    elif rs.step == STEP_COMMIT:
+        base = "commit_not_finalized"
+    else:
+        return "unknown"
+    quorum_missing = base in ("no_proposal", "no_prevote_quorum",
+                              "no_precommit_quorum")
+    if quorum_missing and rs.validators is not None:
+        n_vals = len(rs.validators)
+        # rotation FIRST: while a validator-set change is still taking
+        # effect, missing quorum most likely reflects the churn itself,
+        # and the count-based partition heuristic below is unreliable
+        # (phantom/offline validators make every peer-count look like a
+        # minority). rs.height > 1 guard: genesis state reports
+        # last_height_validators_changed == 1, which is bootstrap.
+        if (state is not None and rs.height > 1
+                and state.last_height_validators_changed >= rs.height):
+            return "valset_rotation"
+        if switch is not None and n_vals > 1:
+            # responsive peers + ourselves: even if every one were a
+            # distinct validator, could they carry +2/3?
+            reachable = _reachable_peer_count(switch, silent_after_s) + 1
+            if 3 * reachable <= 2 * n_vals:
+                return "partition_suspected"
+    return base
 
 
 class StallWatchdog:
@@ -1480,10 +1570,17 @@ class StallWatchdog:
 
     def __init__(self, cs: ConsensusState, threshold_s: float = 30.0,
                  switch=None, interval: Optional[float] = None,
-                 max_bundles: int = 8):
+                 max_bundles: int = 8,
+                 height_threshold_s: Optional[float] = None):
         self.cs = cs
         self.switch = switch
         self.threshold_s = threshold_s
+        # height-level stall detection: a partition/churn fault churns
+        # ROUNDS (each under threshold_s) while the HEIGHT goes nowhere;
+        # default = 3x the round threshold, 0 disables
+        if height_threshold_s is None:
+            height_threshold_s = 3.0 * threshold_s if threshold_s > 0 else 0.0
+        self.height_threshold_s = height_threshold_s
         if interval is None:
             interval = min(1.0, threshold_s / 4.0) if threshold_s > 0 else 1.0
         self.interval = max(0.05, interval)
@@ -1492,6 +1589,7 @@ class StallWatchdog:
             maxlen=max_bundles)
         self._stalls_total = 0
         self._flagged: Optional[tuple] = None
+        self._flagged_height: Optional[int] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -1525,22 +1623,48 @@ class StallWatchdog:
                 fn()
             except Exception:  # noqa: BLE001
                 LOG.exception("watchdog on_tick hook failed")
-        if self.threshold_s <= 0 or dwell < self.threshold_s:
-            return
         rs = self.cs.rs
-        key = (rs.height, rs.round)
-        if self._flagged == key:
-            return
-        self._flagged = key
-        reason = classify_stall(rs)
+        if self.threshold_s > 0 and dwell >= self.threshold_s:
+            # one bundle per (height, round) — unless the DIAGNOSIS
+            # shifts while the round stays stuck (e.g. a quorum stall
+            # sharpening into partition_suspected once the cut-off
+            # peers have been silent long enough): a changed reason
+            # records again, a constant one never spams
+            reason = self._classify(rs)
+            key = (rs.height, rs.round, reason)
+            if self._flagged != key:
+                self._flagged = key
+                self._trip(rs, dwell, "round", reason)
+                return
+        # height-level detection: rounds may churn under the per-round
+        # threshold while the height dwells (partition signature)
+        h_dwell = self.cs.height_dwell_seconds()
+        if self.height_threshold_s > 0 and h_dwell >= self.height_threshold_s:
+            reason = self._classify(rs)
+            if self._flagged_height != (rs.height, reason):
+                self._flagged_height = (rs.height, reason)
+                self._trip(rs, h_dwell, "height", reason)
+
+    def _classify(self, rs: RoundState) -> str:
+        # silence cutoff tracks the threshold: a prod deployment's
+        # stalled rounds legitimately go seconds between messages, a
+        # fast-timeout test net goes milliseconds
+        cutoff = max(1.0, min(PEER_SILENT_AFTER_S, self.threshold_s)) \
+            if self.threshold_s > 0 else PEER_SILENT_AFTER_S
+        return classify_stall(rs, switch=self.switch, state=self.cs.state,
+                              silent_after_s=cutoff)
+
+    def _trip(self, rs: RoundState, dwell: float, scope: str,
+              reason: str) -> None:
         self.cs.metrics.stalls.with_labels(reason).inc()
         self._stalls_total += 1
         bundle = self.cs.stall_snapshot(
             switch=self.switch, reason=reason, dwell_s=dwell)
+        bundle["scope"] = scope  # which dwell crossed: round | height
         self._bundles.append(bundle)
         LOG.warning(
-            "consensus stall: h=%d r=%d dwelt %.1fs (> %.1fs) reason=%s",
-            rs.height, rs.round, dwell, self.threshold_s, reason)
+            "consensus stall (%s): h=%d r=%d dwelt %.1fs reason=%s",
+            scope, rs.height, rs.round, dwell, reason)
 
     # -- export (/debug/consensus) -------------------------------------
 
@@ -1561,7 +1685,9 @@ class StallWatchdog:
             "round": rs.round,
             "step": RoundStepType.name(rs.step),
             "dwell_s": round(dwell, 3),
+            "height_dwell_s": round(self.cs.height_dwell_seconds(), 3),
             "threshold_s": self.threshold_s,
+            "height_threshold_s": self.height_threshold_s,
             "stalls_total": self._stalls_total,
             "stalls": list(self._bundles),
             "live": self.cs.stall_snapshot(

@@ -118,6 +118,14 @@ class P2PMetrics:
     peer_recv_rate: object = NOP  # (peer_id)
     peer_pending_send: object = NOP  # (peer_id) msgs queued across chans
     peer_lag_blocks: object = NOP  # (peer_id) our height - peer height
+    # reconnect storm hygiene (switch._schedule_reconnect): dial attempts
+    # at a dropped persistent peer, pruned on removal like the rest
+    reconnect_attempts: object = NOP  # (peer_id)
+    # network-fault engine (p2p/netchaos.py): faults actually injected,
+    # by kind (drop|delay|throttle|disconnect), and the rules currently
+    # active in the installed fault plan (0 when no controller/phase)
+    chaos_injected: object = NOP  # (kind)
+    chaos_active_rules: object = NOP
 
 
 # the P2PMetrics families carrying a peer_id label; prune_peer_series
@@ -130,6 +138,7 @@ _P2P_PEER_LABELED = (
     "peer_recv_rate",
     "peer_pending_send",
     "peer_lag_blocks",
+    "reconnect_attempts",
 )
 
 
@@ -241,9 +250,15 @@ class RPCMetrics:
 
 @dataclass
 class StateMetrics:
-    """state/metrics.go:10-22"""
+    """state/metrics.go:10-22 (+ the churn families, ours: EndBlock
+    validator-update batches applied by update_state — the first-class
+    validator-rotation workload's primary counters)"""
 
     block_processing_time: object = NOP
+    # individual validator updates applied (adds + removes + repowers)
+    validator_updates: object = NOP
+    # blocks whose EndBlock carried at least one validator update
+    valset_changes: object = NOP
 
 
 @dataclass
@@ -346,6 +361,18 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_p2p_peer_lag_blocks",
             "Blocks the peer's consensus height trails ours.",
             ("peer_id",)),
+        reconnect_attempts=r.counter(
+            f"{ns}_p2p_reconnect_attempts_total",
+            "Dial attempts at a dropped persistent peer (reconnect "
+            "loops; pruned with the peer's other series on removal).",
+            ("peer_id",)),
+        chaos_injected=r.counter(
+            f"{ns}_chaos_injected_total",
+            "Network faults injected by the netchaos engine, by kind.",
+            ("kind",)),
+        chaos_active_rules=r.gauge(
+            f"{ns}_chaos_active_rules",
+            "Link rules currently active in the installed fault plan."),
     )
     abci_m = ABCIMetrics(
         request_duration=r.histogram(
@@ -410,6 +437,14 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_state_block_processing_time",
             "Time spent processing a block (s).",
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5)),
+        validator_updates=r.counter(
+            f"{ns}_churn_validator_updates_total",
+            "Individual validator updates (add/remove/repower) applied "
+            "from EndBlock responses."),
+        valset_changes=r.counter(
+            f"{ns}_churn_valset_changes_total",
+            "Blocks whose EndBlock carried at least one validator "
+            "update."),
     )
     crypto = CryptoMetrics(
         batch_verify_seconds=r.histogram(

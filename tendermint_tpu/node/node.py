@@ -399,8 +399,43 @@ class Node:
             conn_filters.append(_abci_addr_filter)
             peer_filters.append(_abci_id_filter)
 
+        # legacy single-connection fuzz mode ([p2p] test_fuzz*): every
+        # peer socket is wrapped in a FuzzedConnection built from TOML —
+        # previously the config keys existed but nothing consumed them
+        fuzz_wrap = None
+        if config.p2p.test_fuzz:
+            from ..p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+            fuzz_cfg = FuzzConnConfig(
+                mode=config.p2p.test_fuzz_mode,
+                max_delay=config.p2p.test_fuzz_delay_ms / 1000.0,
+                prob_drop_rw=config.p2p.test_fuzz_prob_drop_rw,
+                seed=config.p2p.test_fuzz_seed,
+            )
+            fuzz_wrap = lambda conn: FuzzedConnection(conn, fuzz_cfg)  # noqa: E731
+
+        # network-fault engine ([chaos]): install the process-wide
+        # controller BEFORE the switch exists so every peer link it
+        # creates runs through the plan's rules
+        self._chaos_installed = False
+        if config.chaos.enable:
+            from ..p2p import netchaos
+
+            if config.chaos.plan:
+                with open(os.path.join(root, config.chaos.plan)
+                          if not os.path.isabs(config.chaos.plan)
+                          else config.chaos.plan) as f:
+                    plan = netchaos.FaultPlan.from_json(f.read())
+                plan.seed = config.chaos.seed or plan.seed
+            else:
+                plan = netchaos.FaultPlan(seed=config.chaos.seed)
+            netchaos.install(netchaos.NetChaosController(
+                plan, metrics=self.metrics.p2p))
+            self._chaos_installed = True
+
         self.transport = MultiplexTransport(
-            node_info, node_key, conn_filters=conn_filters)
+            node_info, node_key, conn_filters=conn_filters,
+            fuzz_wrap=fuzz_wrap)
         # peer trust scoring (p2p/trust.py; reference p2p/trust/store.go):
         # persisted per-peer metrics the switch consults on admission and
         # persistent-peer reconnects
@@ -800,6 +835,13 @@ class Node:
 
             tracing.get_tracer().disable()
         self.sw.stop()
+        if self._chaos_installed:
+            # only the installer tears the process-wide controller down
+            # (scenario runs install their own outside any node)
+            from ..p2p import netchaos
+
+            netchaos.uninstall()
+            self._chaos_installed = False
         # drain the mempool ingest worker BEFORE the crypto dispatchers:
         # its queued batches verify_async, and a drain after dispatcher
         # shutdown would respawn a dispatcher thread post-stop

@@ -114,6 +114,13 @@ class MConnection:
         self.on_error = on_error
         self.send_monitor = Monitor()
         self.recv_monitor = Monitor()
+        # wall clock of the last fully received packet (any kind);
+        # 0.0 until the first one lands. The peer-reachability probe
+        # (consensus stall classification, monitor [PARTITIONED?] tag)
+        # reads this instead of the flowrate EWMA — the EWMA takes ~10s
+        # to decay below any threshold after a link goes dark, silence
+        # since the last packet is visible immediately.
+        self.last_recv_time = 0.0
         self._send_signal = threading.Event()
         self._pong_pending = threading.Event()
         self._pong_received = threading.Event()
@@ -247,6 +254,7 @@ class MConnection:
                 if length > max_packet:
                     raise ConnectionError(f"packet too large: {length}")
                 body = self.conn.read_exact(length)
+                self.last_recv_time = time.monotonic()
                 self.recv_monitor.update(len(body))
                 self.recv_monitor.limit(len(body), self.config.recv_rate)
                 pkt = msgpack.unpackb(body, raw=False)

@@ -1,8 +1,17 @@
 """FuzzedConnection — network fault injection (reference p2p/fuzz.go:14-104).
 
 Wraps a socket; in async mode randomly delays or drops writes, in sync
-mode sleeps inline.  Activated via FuzzConnConfig (config/config.go:485)
-for network-level fuzz testing (SURVEY §4 tier 4).
+mode sleeps inline.  Activated via FuzzConnConfig — reachable from TOML
+through the `[p2p] test_fuzz*` keys (config.py) — for network-level
+fuzz testing (SURVEY §4 tier 4).
+
+Determinism: every instance draws from its OWN `random.Random`. With a
+nonzero `seed` the op sequence a connection sees is reproducible
+bit-for-bit, and concurrent connections (or unrelated tests) can never
+perturb each other's streams — the process-global `random` module this
+layer used to draw from made runs irreproducible by construction. The
+richer per-link engine lives in p2p/netchaos.py; this stays the
+reference-parity single-connection mode.
 """
 
 from __future__ import annotations
@@ -21,6 +30,9 @@ class FuzzConnConfig:
     prob_drop_rw: float = 0.2
     prob_drop_conn: float = 0.0
     prob_sleep: float = 0.0
+    # 0 = seed from OS entropy (legacy behavior, still per-instance);
+    # nonzero = fully deterministic op sequence for this config
+    seed: int = 0
 
 
 class FuzzedConnection:
@@ -29,23 +41,29 @@ class FuzzedConnection:
     def __init__(self, conn: socket.socket, config: FuzzConnConfig = None):
         self._conn = conn
         self.config = config or FuzzConnConfig()
+        self._rng = random.Random(self.config.seed or None)
         self._lock = threading.Lock()
 
     def _fuzz(self) -> bool:
         """True = drop this operation."""
         cfg = self.config
         if cfg.mode == "drop":
-            r = random.random()
+            with self._lock:
+                r = self._rng.random()
             if r < cfg.prob_drop_rw:
                 return True
             if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
                 self._conn.close()
                 return True
             if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
-                time.sleep(random.random() * cfg.max_delay)
+                time.sleep(self._sleep_s())
         elif cfg.mode == "delay":
-            time.sleep(random.random() * cfg.max_delay)
+            time.sleep(self._sleep_s())
         return False
+
+    def _sleep_s(self) -> float:
+        with self._lock:
+            return self._rng.random() * self.config.max_delay
 
     def sendall(self, data: bytes) -> None:
         if self._fuzz():
@@ -55,7 +73,7 @@ class FuzzedConnection:
     def recv(self, n: int) -> bytes:
         if self._fuzz():
             # a dropped read manifests as a stall, not data loss
-            time.sleep(random.random() * self.config.max_delay)
+            time.sleep(self._sleep_s())
         return self._conn.recv(n)
 
     def settimeout(self, t) -> None:

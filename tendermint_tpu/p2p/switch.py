@@ -28,6 +28,11 @@ RECONNECT_INTERVAL = 5.0  # switch.go:23 reconnectInterval
 RECONNECT_BACK_OFF_ATTEMPTS = 10  # switch.go:26
 RECONNECT_BACK_OFF_BASE = 3.0  # switch.go:27
 DIAL_RANDOMIZER_INTERVAL = 3.0  # switch.go:17 randomization of dial start
+# storm hygiene: minimum wall-clock gap between two dial attempts at the
+# SAME peer, across every reconnect loop iteration — a churn storm that
+# drops many peers at once must not collapse into synchronized redial
+# bursts (each loop additionally full-jitters its sleeps to ±50%)
+RECONNECT_MIN_GAP = 1.0
 
 # minimum trust score (0-100, trust/metric.go TrustValue x100) a peer
 # needs to be admitted or reconnected when a TrustMetricStore is wired
@@ -64,6 +69,8 @@ class Switch:
         self.peers = PeerSet()
         self.dialing: Dict[str, bool] = {}
         self.reconnecting: Dict[str, bool] = {}
+        # reconnect storm hygiene: last dial-attempt wall clock per peer
+        self._last_reconnect_attempt: Dict[str, float] = {}
         self.persistent_addrs: Dict[str, str] = {}  # id -> addr
         self.max_inbound = max_inbound
         self.max_outbound = max_outbound
@@ -184,6 +191,12 @@ class Switch:
     def _add_peer_conn(
         self, sc, their_info: NodeInfo, remote: str, outbound: bool, persistent: bool = False
     ) -> Optional[Peer]:
+        # network-fault engine hook: while a NetChaosController is
+        # installed, every peer link's OUTBOUND path runs through its
+        # per-(src, dst) rules (p2p/netchaos.py); identity otherwise
+        from . import netchaos
+
+        sc = netchaos.wrap_conn(sc, self.node_info().id, their_info.id)
         for f in self.peer_filters:
             try:
                 f(their_info)
@@ -227,6 +240,10 @@ class Switch:
                 sc.close()
                 return None
         peer.start()
+        with self._lock:
+            # reconnect bookkeeping is per-ATTEMPT state; a established
+            # peer clears it so the map can't grow with historic peers
+            self._last_reconnect_attempt.pop(their_info.id, None)
         self.metrics.peers.set(self.peers.size())
         if self.trust is not None:
             self.trust.get_metric(peer.id).good_events(1)
@@ -342,20 +359,34 @@ class Switch:
         def try_once() -> bool:
             if not self._running.is_set() or (peer_id and self.peers.has(peer_id)):
                 return True
+            # per-peer rate limit: a churn storm can race multiple
+            # reconnect loops (drop -> redial -> drop) at one peer;
+            # space the dials so the storm can't amplify itself
+            with self._lock:
+                last = self._last_reconnect_attempt.get(key, 0.0)
+                now = time.monotonic()
+                wait = RECONNECT_MIN_GAP - (now - last)
+            if wait > 0:
+                time.sleep(wait)
+            with self._lock:
+                self._last_reconnect_attempt[key] = time.monotonic()
+            self.metrics.reconnect_attempts.with_labels(key).inc()
             # persistent=True keeps persistent_addrs populated so the
             # re-established peer reconnects again on its next drop
             return self.dial_peer(addr, expect_id=peer_id, persistent=True) is not None
 
         def loop():
             try:
-                # phase 1: linear retries (switch.go:334-350)
+                # phase 1: linear retries (switch.go:334-350), with FULL
+                # ±50% jitter so peers dropped together don't redial
+                # together (the synchronized-burst storm signature)
                 for _ in range(RECONNECT_ATTEMPTS):
-                    time.sleep(RECONNECT_INTERVAL * (1 + 0.3 * random.random()))
+                    time.sleep(RECONNECT_INTERVAL * (0.5 + random.random()))
                     if try_once():
                         return
                 # phase 2: exponential backoff (switch.go:352-367)
                 for i in range(1, RECONNECT_BACK_OFF_ATTEMPTS + 1):
-                    time.sleep((RECONNECT_BACK_OFF_BASE**i) * (1 + 0.3 * random.random()))
+                    time.sleep((RECONNECT_BACK_OFF_BASE**i) * (0.5 + random.random()))
                     if try_once():
                         return
             finally:

@@ -90,6 +90,23 @@ def default_client_creator(address: str, transport: str = "socket",
         _, _, path = address.partition(":")
         db = FileDB(path) if path else MemDB()
         return local_client_creator(PersistentKVStoreApplication(db))
+    if address == "churn_kvstore" or address.startswith("churn_kvstore:"):
+        # validator-churn workload driver: per-epoch rotation batches
+        # from EndBlock. "churn_kvstore:epoch=2,frac=0.5,pool=8,seed=7"
+        # tunes it; omitted keys keep the app's defaults.
+        from ..abci.example.kvstore import ChurnKVStoreApplication
+        from ..libs.db import MemDB
+
+        _, _, spec = address.partition(":")
+        kw = {}
+        names = {"epoch": "epoch_blocks", "frac": "rotation_fraction",
+                 "pool": "phantom_pool", "seed": "seed"}
+        for part in filter(None, spec.split(",")):
+            k, _, v = part.partition("=")
+            if k not in names:
+                raise ValueError(f"unknown churn_kvstore param {k!r}")
+            kw[names[k]] = float(v) if k == "frac" else int(v)
+        return local_client_creator(ChurnKVStoreApplication(MemDB(), **kw))
     if address == "counter":
         from ..abci.example.counter import CounterApplication
 

@@ -52,7 +52,8 @@ class ABCIResponses:
                 [[r.code, r.data, r.log, r.gas_wanted, r.gas_used,
                   _tags_obj(r.tags)] for r in self.deliver_tx],
                 [
-                    [[u.pub_key, u.power] for u in self.end_block.validator_updates],
+                    [[u.pub_key, u.power, u.pop]
+                     for u in self.end_block.validator_updates],
                     _params_obj(self.end_block.consensus_param_updates),
                     _tags_obj(self.end_block.tags),
                 ]
@@ -75,7 +76,10 @@ class ABCIResponses:
         eb = None
         if o[1] is not None:
             eb = abci.ResponseEndBlock(
-                validator_updates=[abci.ValidatorUpdate(u[0], u[1]) for u in o[1][0]],
+                validator_updates=[
+                    abci.ValidatorUpdate(u[0], u[1],
+                                         pop=u[2] if len(u) > 2 else b"")
+                    for u in o[1][0]],
                 consensus_param_updates=_params_from(o[1][1]),
                 tags=_tags_from(o[1][2]) if len(o[1]) > 2 else [],
             )
@@ -186,6 +190,8 @@ class BlockExecutor:
         val_updates = _abci_validator_updates(abci_responses)
         if val_updates:
             self.logger.info("updates to validators: %d", len(val_updates))
+            self.metrics.validator_updates.inc(len(val_updates))
+            self.metrics.valset_changes.inc()
 
         state = update_state(state, block_id, block.header, abci_responses)
 
@@ -341,6 +347,45 @@ def _abci_validator_updates(abci_responses: ABCIResponses) -> List[abci.Validato
     return list(abci_responses.end_block.validator_updates)
 
 
+def _check_rotation_pop(val_set, changes: List[Validator]) -> None:
+    """Rotation-time rogue-key defense for the BLS aggregate lane.
+
+    Genesis validates every BLS key's proof of possession
+    (types/genesis.py); EndBlock rotation is the OTHER door into the
+    valset, and fast_aggregate_verify is only sound over keys that
+    proved possession. The accept/reject decision depends ONLY on
+    consensus state — a key already in the current valset is trusted
+    (its membership is hash-chained back to a PoP-checked join), a NEW
+    key must carry a valid PoP in its ValidatorUpdate — never on the
+    process-local registry, which a freshly restarted or statesynced
+    node holds in a different state than its long-lived peers (keys it
+    never saw registered); consulting it would let nodes diverge on
+    the same update. Verified keys are (re)registered as a side effect
+    so the aggregate lane's registry stays warm. Ed25519 sets (and
+    removals, power 0) are untouched."""
+    if not val_set.is_bls():
+        return
+    from ..crypto import bls
+    from ..crypto.bls import PubKeyBLS12381
+
+    member_keys = {v.pub_key.data for v in val_set.validators
+                   if isinstance(v.pub_key, PubKeyBLS12381)}
+    for v in changes:
+        if v.voting_power == 0 or not isinstance(v.pub_key, PubKeyBLS12381):
+            continue
+        pk = v.pub_key.data
+        if pk in member_keys:
+            # repower of a sitting validator: possession was proved when
+            # the key joined; re-register for the registry's benefit
+            bls._register_pop_unchecked(pk)
+            continue
+        if not v.pop or not bls.register_proof_of_possession(pk, v.pop):
+            raise ValueError(
+                "validator update rotates BLS key "
+                f"{v.address.hex()[:12]} into an aggregate-lane valset "
+                "without a valid proof of possession")
+
+
 def update_state(
     state: State, block_id: BlockID, header, abci_responses: ABCIResponses
 ) -> State:
@@ -352,8 +397,10 @@ def update_state(
     val_updates = _abci_validator_updates(abci_responses)
     if val_updates:
         changes = [
-            Validator.new(pubkey_from_bytes(u.pub_key), u.power) for u in val_updates
+            Validator.new(pubkey_from_bytes(u.pub_key), u.power, pop=u.pop)
+            for u in val_updates
         ]
+        _check_rotation_pop(n_val_set, changes)
         n_val_set.update_with_changes(changes)
         # changes take effect at height+2 (execution.go:419)
         last_height_vals_changed = header.height + VALSET_CHANGE_DELAY

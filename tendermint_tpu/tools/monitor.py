@@ -117,6 +117,13 @@ class NodeStatus:
     stalls_total: int = 0
     stall_alerts: List[dict] = field(default_factory=list)
     max_peer_lag: int = 0
+    # quorum-reachability view (from /debug/consensus live): responsive
+    # peers (heard from recently) / silent peers (connected but dark) vs
+    # the validator-set size — the inputs of the [PARTITIONED?]
+    # judgment (-1 = no debug view yet)
+    n_peers: int = -1
+    n_peers_silent: int = 0
+    n_validators: int = 0
     # state-sync restore view (from /debug/statesync): the live phase,
     # chunk progress, and when that progress last ADVANCED — a restore
     # that stops advancing is a wedged bootstrap, not a healthy node
@@ -189,6 +196,25 @@ class NodeStatus:
         """The node's current round has dwelt past its own threshold."""
         return (self.stall_threshold_s > 0
                 and self.round_dwell_s >= self.stall_threshold_s)
+
+    @property
+    def partition_suspect(self) -> bool:
+        """Responsive-peer count below quorum-reachability WHILE round
+        dwell climbs AND at least one connected peer has gone silent:
+        even if every responsive peer were a distinct validator, self +
+        peers could not carry +2/3 — the node is (likely) on the
+        minority side of a partition. Dwell counts as climbing from half
+        the stall threshold, so the tag fires before the watchdog trips.
+        The silent-peer requirement keeps the tag off chains whose
+        validator set is simply larger than their peer mesh (phantom /
+        offline validators under a churn workload never were peers —
+        a partition, by contrast, silences peers the node HAD)."""
+        if self.n_peers < 0 or self.n_validators <= 1:
+            return False
+        if self.stall_threshold_s <= 0 or self.n_peers_silent <= 0:
+            return False
+        climbing = self.round_dwell_s >= self.stall_threshold_s / 2.0
+        return climbing and 3 * (self.n_peers + 1) <= 2 * self.n_validators
 
     @property
     def restoring(self) -> bool:
@@ -273,6 +299,9 @@ class NodeStatus:
         self.stall_threshold_s = 0.0
         self.stall_alerts = []
         self.max_peer_lag = 0
+        self.n_peers = -1
+        self.n_peers_silent = 0
+        self.n_validators = 0
         self.restore_phase = ""
         self._restore_progress_key = ()
         self._restore_progress_at = 0.0
@@ -424,9 +453,15 @@ class Monitor:
         ns.stall_threshold_s = float(data.get("threshold_s", 0.0))
         ns.stalls_total = int(data.get("stalls_total", 0))
         ns.stall_alerts = list(data.get("stalls", []))[-3:]
-        peers = (data.get("live") or {}).get("peers", [])
+        live = data.get("live") or {}
+        peers = live.get("peers", [])
         ns.max_peer_lag = max(
             (int(p.get("lag_blocks", 0)) for p in peers), default=0)
+        # count only peers the node is actually hearing from ("silent"
+        # rides each peer entry; absent on older nodes -> count all)
+        ns.n_peers = sum(1 for p in peers if not p.get("silent", False))
+        ns.n_peers_silent = len(peers) - ns.n_peers
+        ns.n_validators = int(live.get("n_validators", 0))
         agg = (data.get("live") or {}).get("agg") or {}
         ns.agg_enabled = bool(agg.get("enabled", False))
         ns.agg_gossip_merges = int(agg.get("gossip_merges", 0))
@@ -538,6 +573,9 @@ class Monitor:
                 # more than one block, is not "full" health even though
                 # every /status still answers
                 and not any(n.stalled for n in online)
+                # a node that can't reach a quorum's worth of peers
+                # while its round dwell climbs is likely partitioned
+                and not any(n.partition_suspect for n in online)
                 # a node on a degraded/down app connection is not "full"
                 # health even while it keeps answering (and committing)
                 and not any(n.abci_degraded for n in online)
@@ -591,6 +629,10 @@ class Monitor:
                     "stalled": n.stalled,
                     "stalls_total": n.stalls_total,
                     "max_peer_lag": n.max_peer_lag,
+                    "n_peers": n.n_peers,
+                    "n_peers_silent": n.n_peers_silent,
+                    "n_validators": n.n_validators,
+                    "partition_suspect": n.partition_suspect,
                     "restore_phase": n.restore_phase,
                     "restore_chunks": f"{n.restore_chunks_applied}/"
                                       f"{n.restore_chunks_total}"
@@ -660,6 +702,9 @@ def main(argv=None) -> int:
                              f" stalls={n['stalls_total']}")
                     if n["stalled"]:
                         line += " [STALLED]"
+                    if n["partition_suspect"]:
+                        line += (f" [PARTITIONED? peers={n['n_peers']}"
+                                 f"/{n['n_validators']}vals]")
                     if n["abci_degraded"]:
                         bad = ",".join(
                             f"{k}={v}" for k, v in n["abci_conns"].items()

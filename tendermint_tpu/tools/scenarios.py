@@ -1,0 +1,618 @@
+"""Named adversarial scenario suite over the netchaos fault engine.
+
+Each scenario is a replayable experiment: build an in-process localnet
+(real TCP, encrypted MConnections, one full consensus stack + stall
+watchdog per validator), arm a seeded FaultPlan on the process-wide
+NetChaosController, and judge the outcome with the observability stack
+as the oracle:
+
+  converged    every node reaches a common post-fault height with
+               identical block hashes (and NEVER double-commits: all
+               stored blocks at every shared height must agree)
+  classified   the stall watchdog tripped during the fault with a
+               reason in the scenario's expected set (the same payload
+               /debug/consensus serves)
+  recovery_s   wall seconds from fault removal to the first NEW height
+               committed and agreed by every node
+
+Catalog (run one with `python -m tendermint_tpu.tools.scenarios NAME
+[--seed N]`, or all of them with `all`):
+
+  partition_heal           full split into two halves, then heal
+  asym_partition           one-way drop: a minority's outbound vanishes
+  delay_jitter             100ms±100ms on every link; must keep committing
+  churn_storm              rotation epochs + forced-disconnect storms
+  rotation_epoch           clean network, aggressive validator rotation
+  statesync_join_under_churn  fresh node statesyncs in mid-rotation
+
+The fault timeline is a pure function of the seed (see p2p/netchaos.py);
+`bench.py chaosnet` reports partition_heal's recovery latency as a
+standard BENCH line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from .. import config as cfg
+from ..libs.db import MemDB
+from ..p2p import netchaos
+
+
+def _load_factor() -> float:
+    try:
+        return max(1.0, float(os.environ.get("TM_TPU_TEST_LOAD_FACTOR", "1")))
+    except ValueError:
+        return 1.0
+
+
+# warm/converge budgets scale with TM_TPU_TEST_LOAD_FACTOR: a loaded CI
+# box gets slack, a laptop stays fast (same knob the deflaked multi-node
+# tier-1 tests use). Generous defaults: in-process localnets on a
+# CPU-throttled container churn several rounds per height even with no
+# fault armed (the pre-existing timing behavior the tier-1 memory notes
+# document), and a scenario must judge the FAULT, not the box.
+WARM_TIMEOUT = 90.0 * _load_factor()
+CONVERGE_TIMEOUT = 120.0 * _load_factor()
+
+
+class ScenarioNode:
+    """One in-process validator stack: consensus state + reactors +
+    switch + stall watchdog (the tests' NetNode shape, promoted into
+    the package so scenarios and bench share it)."""
+
+    def __init__(self, idx: int, doc, key, chain_id: str,
+                 app_factory: Optional[Callable] = None,
+                 watch_threshold_s: float = 1.0,
+                 height_threshold_s: float = 3.0):
+        from .. import state as sm
+        from ..blockchain.reactor import BlockchainReactor
+        from ..blockchain.store import BlockStore
+        from ..consensus import ConsensusState
+        from ..consensus.reactor import ConsensusReactor
+        from ..consensus.state import StallWatchdog
+        from ..crypto.keys import PrivKeyEd25519
+        from ..evidence import EvidencePool, EvidenceStore
+        from ..evidence.reactor import EvidenceReactor
+        from ..mempool import Mempool
+        from ..mempool.reactor import MempoolReactor
+        from ..p2p import (
+            MultiplexTransport,
+            NodeInfo,
+            NodeKey,
+            ProtocolVersion,
+            Switch,
+        )
+        from ..privval import FilePV
+        from ..proxy import AppConns, local_client_creator
+        from ..abci.example.kvstore import KVStoreApplication
+        from ..types.event_bus import EventBus
+
+        db = MemDB()
+        self.state = sm.load_state_from_db_or_genesis(db, doc)
+        self.app = (app_factory() if app_factory is not None
+                    else KVStoreApplication())
+        self.conns = AppConns(local_client_creator(self.app))
+        self.conns.start()
+        # the full node runs the ABCI handshake which InitChains the
+        # app with the genesis valset; this harness must do the same or
+        # a churn app sees zero "real power" and its liveness bound
+        # blocks every phantom add
+        from ..abci import types as abci_types
+        from ..crypto import pubkey_to_bytes
+
+        if self.state.last_block_height == 0:
+            self.conns.consensus.init_chain(abci_types.RequestInitChain(
+                validators=[abci_types.ValidatorUpdate(
+                    pub_key=pubkey_to_bytes(v.pub_key), power=v.power)
+                    for v in doc.validators]))
+        self.mempool = Mempool(cfg.MempoolConfig(), self.conns.mempool)
+        self.bus = EventBus()
+        self.bus.start()
+        block_exec = sm.BlockExecutor(
+            db, self.conns.consensus, mempool=self.mempool,
+            event_bus=self.bus)
+        self.bstore = BlockStore(MemDB())
+        self.evpool = EvidencePool(EvidenceStore(MemDB()), self.state)
+        self.ev_reactor = EvidenceReactor(self.evpool)
+        block_exec.evidence_pool = self.evpool
+        conf = cfg.test_config().consensus
+        self.cs = ConsensusState(
+            conf, self.state, block_exec, self.bstore,
+            mempool=self.mempool, evpool=self.evpool, event_bus=self.bus,
+            priv_validator=FilePV(key, None) if key is not None else None,
+        )
+        self.cons_reactor = ConsensusReactor(self.cs, fast_sync=False)
+        self.mp_reactor = MempoolReactor(cfg.MempoolConfig(), self.mempool)
+        self.bc_reactor = BlockchainReactor(
+            self.state, block_exec, self.bstore, False,
+            consensus_reactor=self.cons_reactor)
+
+        nk = NodeKey(PrivKeyEd25519.generate())
+        ni = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            id=nk.id,
+            listen_addr="",
+            network=chain_id,
+            version="dev",
+            channels=bytes([0x20, 0x21, 0x22, 0x23, 0x30, 0x38, 0x40]),
+            moniker=f"scenario-node{idx}",
+        )
+        tr = MultiplexTransport(ni, nk)
+        tr.listen("127.0.0.1:0")
+        ni.listen_addr = tr.listen_addr
+        self.switch = Switch(tr)
+        self.switch.add_reactor("CONSENSUS", self.cons_reactor)
+        self.switch.add_reactor("MEMPOOL", self.mp_reactor)
+        self.switch.add_reactor("BLOCKCHAIN", self.bc_reactor)
+        self.switch.add_reactor("EVIDENCE", self.ev_reactor)
+        # deep bundle window: a scenario reads the reasons at the END,
+        # and post-heal round churn must not evict the fault-time ones
+        self.watchdog = StallWatchdog(
+            self.cs, threshold_s=watch_threshold_s, switch=self.switch,
+            interval=0.2, height_threshold_s=height_threshold_s,
+            max_bundles=128)
+
+    @property
+    def id(self) -> str:
+        return self.switch.node_info().id
+
+    @property
+    def height(self) -> int:
+        return self.cs.rs.height
+
+    def start(self) -> None:
+        self.switch.start()
+        self.watchdog.start()
+
+    def stop(self) -> None:
+        self.watchdog.stop()
+        self.switch.stop()
+        self.bus.stop()
+
+    def stall_reasons(self) -> List[str]:
+        return [b.get("reason", "") for b in self.watchdog.stall_bundles()]
+
+
+class ChaosNet:
+    """N-validator in-process localnet with the netchaos controller
+    installed (idle) before any link exists, so every peer connection
+    is wrapped from birth; arm(plan) starts a scenario's fault clock."""
+
+    def __init__(self, n: int, seed: int,
+                 app_factory: Optional[Callable] = None,
+                 chain_id: str = "chaosnet", power: int = 10):
+        from ..types import GenesisDoc, GenesisValidator
+        from ..types.event_bus import EVENT_NEW_BLOCK, query_for_event
+        from ..types.validator_set import random_validator_set
+
+        self.seed = seed
+        self.controller = netchaos.install(
+            netchaos.NetChaosController(netchaos.FaultPlan(seed=seed)))
+        vs, keys = random_validator_set(n, power)
+        doc = GenesisDoc(
+            chain_id=chain_id,
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(v.pub_key, v.voting_power)
+                        for v in vs.validators],
+        )
+        self.nodes = [ScenarioNode(i, doc, keys[i], chain_id,
+                                   app_factory=app_factory)
+                      for i in range(n)]
+        self.subs = [
+            node.bus.subscribe(f"sc{i}", query_for_event(EVENT_NEW_BLOCK), 256)
+            for i, node in enumerate(self.nodes)
+        ]
+        for node in self.nodes:
+            node.start()
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                a.switch.dial_peer(b.switch.transport.listen_addr,
+                                   expect_id=b.id, persistent=True)
+
+    # -- id/group helpers ----------------------------------------------
+
+    def ids(self, *indices: int) -> frozenset:
+        if not indices:
+            return frozenset(n.id for n in self.nodes)
+        return frozenset(self.nodes[i].id for i in indices)
+
+    # -- plan control --------------------------------------------------
+
+    def arm(self, plan: netchaos.FaultPlan) -> None:
+        self.controller.set_plan(plan)
+
+    # -- oracle helpers ------------------------------------------------
+
+    def heights(self) -> List[int]:
+        return [n.height for n in self.nodes]
+
+    def wait_min_height(self, h: int, timeout: float) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if min(self.heights()) >= h:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def redial_missing(self) -> None:
+        """Re-establish any link a fault (disconnect storm) severed."""
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1:]:
+                if not (a.switch.peers.has(b.id)
+                        or b.switch.peers.has(a.id)):
+                    a.switch.dial_peer(b.switch.transport.listen_addr,
+                                       expect_id=b.id, persistent=True)
+
+    def wait_converged(self, past_height: int,
+                       timeout: float) -> Optional[float]:
+        """Wall seconds until every node has COMMITTED a common height
+        > past_height and all agree on its block hash; None on timeout.
+        (A node at consensus height H has committed H-1.)"""
+        t0 = time.time()
+        target = past_height + 1
+        deadline = t0 + timeout
+        while time.time() < deadline:
+            if min(self.heights()) > target:
+                blocks = [n.bstore.load_block(target) for n in self.nodes]
+                if all(b is not None for b in blocks) and len(
+                        {b.hash() for b in blocks}) == 1:
+                    return time.time() - t0
+                return None  # committed but disagree: safety violation
+            time.sleep(0.1)
+        return None
+
+    def safety_ok(self) -> bool:
+        """No double-commit anywhere: every height all nodes share must
+        carry ONE block hash."""
+        upto = min(n.height for n in self.nodes) - 1
+        for h in range(1, upto + 1):
+            hashes = {n.bstore.load_block(h).hash() for n in self.nodes
+                      if n.bstore.load_block(h) is not None}
+            if len(hashes) > 1:
+                return False
+        return True
+
+    def stall_reasons(self) -> List[str]:
+        out: List[str] = []
+        for n in self.nodes:
+            out.extend(n.stall_reasons())
+        return out
+
+    def stop(self) -> None:
+        netchaos.uninstall()
+        for n in self.nodes:
+            n.stop()
+
+
+# --- the catalog ------------------------------------------------------
+
+SCENARIOS: Dict[str, Callable] = {}
+
+
+def _scenario(fn):
+    SCENARIOS[fn.__name__] = fn
+    return fn
+
+
+def _result(name: str, seed: int, net: Optional[ChaosNet],
+            converged: bool, recovery_s: Optional[float],
+            expect_reasons, extra: Optional[dict] = None) -> dict:
+    reasons = net.stall_reasons() if net is not None else []
+    out = {
+        "scenario": name,
+        "seed": seed,
+        "converged": bool(converged),
+        "recovery_s": round(recovery_s, 3) if recovery_s is not None else None,
+        "safety_ok": net.safety_ok() if net is not None else True,
+        "heights": net.heights() if net is not None else [],
+        "stall_reasons": reasons,
+        "classified_ok": (not expect_reasons
+                          or any(r in expect_reasons for r in reasons)),
+        "injected": dict(net.controller.injected) if net is not None else {},
+        "plan": net.controller.plan.to_json() if net is not None else "",
+    }
+    if extra:
+        out.update(extra)
+    out["ok"] = bool(out["converged"] and out["safety_ok"]
+                     and out["classified_ok"])
+    return out
+
+
+@_scenario
+def partition_heal(seed: int = 1, n: int = 4, fault_s: float = 8.0) -> dict:
+    """Full partition into two halves: both sides lose quorum, the
+    watchdog must classify the stall as a partition (the initial
+    disconnect burst severs the cross links, so quorum-reachability by
+    peer count fails), and after the plan expires + redial the chain
+    converges with zero safety violations."""
+    net = ChaosNet(n, seed)
+    try:
+        if not net.wait_min_height(2, WARM_TIMEOUT):
+            return _result("partition_heal", seed, net, False, None, ())
+        half_a, half_b = net.ids(*range(n // 2)), net.ids(*range(n // 2, n))
+        plan = netchaos.FaultPlan(seed=seed)
+        # burst: close every cross-partition conn (drives peer counts
+        # below quorum reachability -> partition_suspected)
+        plan.add(0.0, fault_s, netchaos.disconnect_storm(
+            1.0, srcs=half_a, dsts=half_b))
+        # and keep the halves dark for the whole window even if a
+        # reconnect slips through
+        plan.add(0.0, fault_s, netchaos.partition(half_a, half_b))
+        h_before = max(net.heights())
+        net.arm(plan)
+        time.sleep(fault_s + 0.5)
+        net.redial_missing()
+        h_heal = max(net.heights())
+        recovery = net.wait_converged(h_heal, CONVERGE_TIMEOUT)
+        return _result(
+            "partition_heal", seed, net, recovery is not None, recovery,
+            ("partition_suspected",),
+            {"height_at_fault": h_before, "height_at_heal": h_heal})
+    finally:
+        net.stop()
+
+
+@_scenario
+def asym_partition(seed: int = 2, n: int = 4, fault_s: float = 8.0) -> dict:
+    """Asymmetric partition: a 2-node minority's OUTBOUND traffic is
+    dropped while its inbound flows. The majority (20/40 power) loses
+    quorum without losing a single TCP connection — the watchdog sees
+    missing votes, not missing peers."""
+    net = ChaosNet(n, seed)
+    try:
+        if not net.wait_min_height(2, WARM_TIMEOUT):
+            return _result("asym_partition", seed, net, False, None, ())
+        muted = net.ids(0, 1)
+        plan = netchaos.FaultPlan(seed=seed)
+        plan.add(0.0, fault_s, netchaos.one_way_drop(muted, net.ids()))
+        net.arm(plan)
+        time.sleep(fault_s + 0.5)
+        h_heal = max(net.heights())
+        recovery = net.wait_converged(h_heal, CONVERGE_TIMEOUT)
+        return _result(
+            "asym_partition", seed, net, recovery is not None, recovery,
+            ("no_prevote_quorum", "no_precommit_quorum", "no_proposal",
+             "partition_suspected"),
+            {"height_at_heal": h_heal})
+    finally:
+        net.stop()
+
+
+@_scenario
+def delay_jitter(seed: int = 3, n: int = 3, fault_s: float = 10.0) -> dict:
+    """Injected per-packet latency (15ms ± 25ms) on every link — the
+    delay applies per MConnection frame on the sender's serialized
+    write path, so the effective link slowdown is much larger than the
+    raw numbers read. The chain must KEEP COMMITTING through it (no
+    stall required), converge afterward, and never violate safety."""
+    net = ChaosNet(n, seed)
+    try:
+        if not net.wait_min_height(2, WARM_TIMEOUT):
+            return _result("delay_jitter", seed, net, False, None, ())
+        plan = netchaos.FaultPlan(seed=seed)
+        plan.add(0.0, fault_s, netchaos.delay(0.015, jitter_s=0.025))
+        h_before = min(net.heights())
+        net.arm(plan)
+        time.sleep(fault_s + 0.5)
+        progressed = min(net.heights()) > h_before
+        h_heal = max(net.heights())
+        recovery = net.wait_converged(h_heal, CONVERGE_TIMEOUT)
+        return _result(
+            "delay_jitter", seed, net,
+            recovery is not None and progressed, recovery, (),
+            {"progressed_under_delay": progressed})
+    finally:
+        net.stop()
+
+
+def _churn_factory(seed: int, epoch_blocks: int = 2, pool: int = 6):
+    from ..abci.example.kvstore import ChurnKVStoreApplication
+
+    return lambda: ChurnKVStoreApplication(
+        MemDB(), epoch_blocks=epoch_blocks, rotation_fraction=0.5,
+        phantom_pool=pool, seed=seed)
+
+
+@_scenario
+def churn_storm(seed: int = 4, n: int = 4, fault_s: float = 6.0) -> dict:
+    """Rotation epochs PLUS forced-disconnect storms: every epoch
+    rewrites the valset while peers drop and redial. Persistent-peer
+    reconnection (rate-limited, jittered) must re-knit the mesh and
+    the chain must converge on one history."""
+    # real validators get dominant power: phantoms (power 1-2) must
+    # never make the quorum margin so thin that one late real vote
+    # fails a round — the workload is ROTATION pressure, not a
+    # quorum-knife-edge liveness test
+    net = ChaosNet(n, seed, app_factory=_churn_factory(seed), power=100)
+    try:
+        if not net.wait_min_height(2, WARM_TIMEOUT):
+            return _result("churn_storm", seed, net, False, None, ())
+        plan = netchaos.FaultPlan(seed=seed)
+        plan.add(0.0, fault_s, netchaos.disconnect_storm(0.02))
+        net.arm(plan)
+        time.sleep(fault_s + 0.5)
+        net.redial_missing()
+        h_heal = max(net.heights())
+        recovery = net.wait_converged(h_heal, CONVERGE_TIMEOUT)
+        epochs = max(getattr(n_.app, "epochs_run", 0) for n_ in net.nodes)
+        return _result(
+            "churn_storm", seed, net,
+            recovery is not None and epochs > 0, recovery, (),
+            {"epochs_run": epochs,
+             "disconnects": net.controller.injected["disconnect"]})
+    finally:
+        net.stop()
+
+
+@_scenario
+def rotation_epoch(seed: int = 5, n: int = 4, epochs: int = 3) -> dict:
+    """Clean network, aggressive validator rotation: every epoch's
+    EndBlock batch rewrites the phantom pool. All nodes must apply the
+    SAME rotations (valset hash equality at a common height) and the
+    verify-path caches must never accept a stale entry — enforced
+    structurally (tests/test_rotation_caches.py) and end-to-end here
+    by the chain simply staying correct across epochs."""
+    net = ChaosNet(n, seed, app_factory=_churn_factory(seed), power=100)
+    try:
+        target = 2 * epochs + 2
+        if not net.wait_min_height(target, WARM_TIMEOUT + 30):
+            return _result("rotation_epoch", seed, net, False, None, ())
+        h = min(net.heights()) - 1
+        recovery = net.wait_converged(h, CONVERGE_TIMEOUT)
+        valsets = {n_.cs.state.validators.hash() for n_ in net.nodes}
+        rotated = all(len(n_.cs.state.validators) > n for n_ in net.nodes)
+        agree = len(valsets) == 1
+        epochs_run = max(getattr(n_.app, "epochs_run", 0)
+                         for n_ in net.nodes)
+        return _result(
+            "rotation_epoch", seed, net,
+            recovery is not None and rotated and agree and epochs_run >= epochs,
+            recovery, (),
+            {"epochs_run": epochs_run, "valsets_agree": agree,
+             "valset_size": len(net.nodes[0].cs.state.validators)})
+    finally:
+        net.stop()
+
+
+@_scenario
+def statesync_join_under_churn(seed: int = 6, tmp_root: str = "") -> dict:
+    """A fresh node statesyncs DURING rotation epochs: the snapshot it
+    restores and the light-verification hops it walks both land inside
+    a churning valset window. Full nodes (the statesync pipeline lives
+    in node.py); the producer runs the churn app with snapshots on."""
+    import tempfile
+
+    from ..node import default_new_node
+
+    own_tmp = None
+    if not tmp_root:
+        own_tmp = tempfile.TemporaryDirectory(prefix="chaos_ssync_")
+        tmp_root = own_tmp.name
+
+    def make_config(name, statesync_enable=False, persistent_peers=""):
+        c = cfg.test_config()
+        c.set_root(os.path.join(tmp_root, name))
+        c.base.proxy_app = f"churn_kvstore:epoch=2,pool=4,seed={seed}"
+        c.base.moniker = name
+        c.rpc.laddr = ""
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.p2p.pex = False
+        c.p2p.persistent_peers = persistent_peers
+        c.consensus.wal_path = "data/cs.wal/wal"
+        c.consensus.create_empty_blocks_interval = 0.25
+        c.statesync.snapshot_interval = 0 if statesync_enable else 2
+        c.statesync.chunk_size = 64
+        c.statesync.enable = statesync_enable
+        c.statesync.discovery_time_s = 1.0
+        c.statesync.restore_timeout_s = 45.0
+        return c
+
+    def init_files(c, genesis_doc=None):
+        from ..p2p import NodeKey
+        from ..privval import load_or_gen_file_pv
+        from ..types import GenesisDoc, GenesisValidator
+
+        cfg.ensure_root(c.root_dir)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        pv = load_or_gen_file_pv(c.base.priv_validator_path())
+        if genesis_doc is None:
+            genesis_doc = GenesisDoc(
+                chain_id="chaos-ssync",
+                genesis_time=time.time_ns() - 10**9,
+                validators=[GenesisValidator(pv.get_pub_key(), 10)],
+            )
+        genesis_doc.save(c.base.genesis_path())
+        return genesis_doc
+
+    ca = make_config("producer")
+    genesis = init_files(ca)
+    a = default_new_node(ca)
+    a.start()
+    b = None
+    try:
+        # let snapshots AND rotation epochs accumulate
+        deadline = time.time() + WARM_TIMEOUT
+        while time.time() < deadline and a.block_store.height() < 7:
+            time.sleep(0.2)
+        if a.block_store.height() < 7:
+            return {"scenario": "statesync_join_under_churn", "seed": seed,
+                    "converged": False, "ok": False,
+                    "note": "producer never reached snapshot height"}
+        cb = make_config(
+            "joiner", statesync_enable=True,
+            persistent_peers=f"{a.node_key.id}@{a.transport.listen_addr}")
+        init_files(cb, genesis_doc=genesis)
+        b = default_new_node(cb)
+        b.start()
+        # restore completes mid-churn: block store seeded past genesis
+        deadline = time.time() + CONVERGE_TIMEOUT
+        while time.time() < deadline and b.block_store.base() <= 1:
+            time.sleep(0.2)
+        restored = b.block_store.base() > 1
+        # and the joiner tails the churning chain live
+        caught_up = False
+        deadline = time.time() + CONVERGE_TIMEOUT
+        while time.time() < deadline:
+            ha, hb = a.block_store.height(), b.block_store.height()
+            if restored and hb >= ha > 0:
+                blk_a = a.block_store.load_block(ha)
+                blk_b = b.block_store.load_block(ha)
+                if blk_a is not None and blk_b is not None \
+                        and blk_a.hash() == blk_b.hash():
+                    caught_up = True
+                    break
+            time.sleep(0.2)
+        return {
+            "scenario": "statesync_join_under_churn",
+            "seed": seed,
+            "converged": bool(restored and caught_up),
+            "restored_base": b.block_store.base(),
+            "producer_height": a.block_store.height(),
+            "joiner_height": b.block_store.height(),
+            "safety_ok": True,
+            "classified_ok": True,
+            "ok": bool(restored and caught_up),
+        }
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+# --- entry points -----------------------------------------------------
+
+
+def run(name: str, seed: Optional[int] = None, **kw) -> dict:
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})")
+    if seed is not None:
+        kw["seed"] = seed
+    return SCENARIOS[name](**kw)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="scenarios", description="chaos/churn scenario runner")
+    p.add_argument("name", help="scenario name, or 'all'")
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+    names = sorted(SCENARIOS) if args.name == "all" else [args.name]
+    rc = 0
+    for name in names:
+        res = run(name, seed=args.seed)
+        print(json.dumps(res, default=str))
+        if not res.get("ok"):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,10 +18,16 @@ def test_default_round_trip_all_sections(tmp_path):
     c.p2p.laddr = "tcp://0.0.0.0:36656"
     c.p2p.persistent_peers = "id1@h1:1,id2@h2:2"
     c.p2p.seed_mode = True
+    c.p2p.test_fuzz = True
+    c.p2p.test_fuzz_mode = "delay"
+    c.p2p.test_fuzz_seed = 1234
     c.mempool.size = 777
     c.mempool.recheck = False
     c.consensus.timeout_propose = 1.25
     c.consensus.create_empty_blocks = False
+    c.chaos.enable = True
+    c.chaos.seed = 42
+    c.chaos.plan = "config/faultplan.json"
     c.tx_index.indexer = "kv"
     c.instrumentation.prometheus = True
 
@@ -37,10 +43,16 @@ def test_default_round_trip_all_sections(tmp_path):
     assert c2.rpc.max_open_connections == 123
     assert c2.p2p.persistent_peers == "id1@h1:1,id2@h2:2"
     assert c2.p2p.seed_mode is True
+    assert c2.p2p.test_fuzz is True
+    assert c2.p2p.test_fuzz_mode == "delay"
+    assert c2.p2p.test_fuzz_seed == 1234
     assert c2.mempool.size == 777
     assert c2.mempool.recheck is False
     assert c2.consensus.timeout_propose == 1.25
     assert c2.consensus.create_empty_blocks is False
+    assert c2.chaos.enable is True
+    assert c2.chaos.seed == 42
+    assert c2.chaos.plan == "config/faultplan.json"
     assert c2.tx_index.indexer == "kv"
     assert c2.instrumentation.prometheus is True
 

@@ -173,6 +173,108 @@ class TestMConnectionFuzz:
 
 
 # ---------------------------------------------------------------------------
+# FuzzedConnection determinism (per-instance seeded RNG)
+# ---------------------------------------------------------------------------
+
+
+class _SinkConn:
+    """Socket stand-in recording sendall payloads."""
+
+    def __init__(self):
+        self.sent = []
+
+    def sendall(self, data):
+        self.sent.append(bytes(data))
+
+    def recv(self, n):
+        return b"\x00" * n
+
+    def settimeout(self, t):
+        pass
+
+    def close(self):
+        pass
+
+    def shutdown(self, how):
+        pass
+
+
+class TestFuzzedConnectionDeterminism:
+    def _pattern(self, seed, n=200):
+        from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+        sink = _SinkConn()
+        fc = FuzzedConnection(sink, FuzzConnConfig(
+            mode="drop", prob_drop_rw=0.5, seed=seed))
+        for i in range(n):
+            fc.sendall(b"pkt-%d" % i)
+        return sink.sent
+
+    def test_same_seed_same_drop_pattern(self):
+        a, b = self._pattern(77), self._pattern(77)
+        assert a == b
+        assert 0 < len(a) < 200  # actually dropping, not all/none
+
+    def test_different_seed_differs(self):
+        assert self._pattern(77) != self._pattern(78)
+
+    def test_concurrent_instances_do_not_perturb_each_other(self):
+        """The old implementation drew from the global `random` module:
+        a second connection's draws changed the first's op sequence.
+        Per-instance RNGs make each stream self-contained."""
+        from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+        want = self._pattern(99)
+        sink = _SinkConn()
+        fc = FuzzedConnection(sink, FuzzConnConfig(
+            mode="drop", prob_drop_rw=0.5, seed=99))
+        noise = FuzzedConnection(_SinkConn(), FuzzConnConfig(
+            mode="drop", prob_drop_rw=0.5, seed=1))
+        for i in range(200):
+            noise.sendall(b"noise")  # interleaved foreign draws
+            fc.sendall(b"pkt-%d" % i)
+        assert sink.sent == want
+
+    def test_seed_zero_keeps_legacy_entropy(self):
+        """seed=0 (the default) still fuzzes — just unseeded."""
+        from tendermint_tpu.p2p.fuzz import FuzzConnConfig, FuzzedConnection
+
+        sink = _SinkConn()
+        fc = FuzzedConnection(sink, FuzzConnConfig(
+            mode="drop", prob_drop_rw=0.5, seed=0))
+        for i in range(300):
+            fc.sendall(b"x")
+        assert 0 < len(sink.sent) < 300
+
+    def test_node_wires_fuzz_wrap_from_config(self, tmp_path):
+        """[p2p] test_fuzz reaches the REAL transport: previously the
+        TOML keys existed but nothing consumed them. Built through
+        Node.__init__ (not started), so a regression in the wiring —
+        dropped fuzz_wrap argument, mis-mapped key — fails here."""
+        from test_node import init_files, make_config
+
+        from tendermint_tpu.node import default_new_node
+        from tendermint_tpu.p2p.fuzz import FuzzedConnection
+
+        c = make_config(tmp_path, "fz")
+        c.p2p.test_fuzz = True
+        c.p2p.test_fuzz_mode = "delay"
+        c.p2p.test_fuzz_delay_ms = 250
+        c.p2p.test_fuzz_seed = 5
+        init_files(c)
+        node = default_new_node(c)
+        try:
+            assert node.transport.fuzz_wrap is not None
+            wrapped = node.transport.fuzz_wrap(_SinkConn())
+            assert isinstance(wrapped, FuzzedConnection)
+            assert wrapped.config.mode == "delay"
+            assert wrapped.config.seed == 5
+            assert wrapped.config.max_delay == 0.25
+        finally:
+            node.stop()
+
+
+# ---------------------------------------------------------------------------
 # SecretConnection: handshake + sealed-frame layer
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,353 @@
+"""Network chaos engine unit tests (p2p/netchaos.py): fault-plan data
+model and replayability, per-link decision determinism, the ChaosConn
+write-path semantics, process-wide installation, and the switch hook.
+
+The multi-node scenario suite built on this engine lives in
+tests/test_scenarios.py (slow tier); everything here is fast and
+socket-free except one tiny two-switch integration check.
+"""
+
+import os
+import struct
+import threading
+import time
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.metrics import prometheus_metrics
+from tendermint_tpu.p2p import netchaos
+from tendermint_tpu.p2p.netchaos import (
+    ChaosConn,
+    Decision,
+    FaultPlan,
+    LinkRule,
+    NetChaosController,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_controller():
+    yield
+    netchaos.uninstall()
+
+
+# --- data model -------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_json_roundtrip_is_textual_identity(self):
+        plan = FaultPlan(seed=7)
+        plan.add(0, 5, netchaos.partition({"a"}, {"b", "c"}))
+        plan.add(2, 9, netchaos.delay(0.1, jitter_s=0.05, srcs={"a"}))
+        plan.add(1, 3, netchaos.throttle(1024))
+        plan.add(0.5, 4, netchaos.disconnect_storm(0.2, dsts={"b"}))
+        text = plan.to_json()
+        again = FaultPlan.from_json(text)
+        assert again.to_json() == text
+        assert again.seed == 7
+        assert len(again.phases) == 4
+
+    def test_phase_windows(self):
+        plan = FaultPlan().add(1, 2, netchaos.delay(0.1))
+        assert plan.active(0.5) == []
+        assert len(plan.active(1.0)) == 1
+        assert len(plan.active(1.999)) == 1
+        assert plan.active(2.0) == []
+        assert plan.end_s() == 2.0
+        with pytest.raises(ValueError):
+            plan.add(3, 3, netchaos.delay(0.1))  # empty window
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            LinkRule("nonsense")
+        with pytest.raises(ValueError):
+            LinkRule("drop", prob=1.5)
+
+
+class TestLinkRuleMatching:
+    def test_symmetric_partition_matches_both_directions(self):
+        r = netchaos.partition({"a"}, {"b"})
+        assert r.matches("a", "b")
+        assert r.matches("b", "a")
+        assert not r.matches("a", "c")
+        assert not r.matches("c", "b")
+
+    def test_one_way_drop_matches_one_direction(self):
+        r = netchaos.one_way_drop({"a"}, {"b"})
+        assert r.matches("a", "b")
+        assert not r.matches("b", "a")
+
+    def test_none_is_wildcard(self):
+        r = LinkRule("delay", delay_s=0.1)
+        assert r.matches("x", "y")
+        r2 = LinkRule("delay", src={"x"}, delay_s=0.1, symmetric=False)
+        assert r2.matches("x", "anyone")
+        assert not r2.matches("anyone", "x")
+
+
+# --- determinism ------------------------------------------------------
+
+
+class TestDeterminism:
+    def _stream(self, ctrl, src, dst, n=64):
+        return [ctrl.outbound(src, dst, 100).drop for _ in range(n)]
+
+    def test_same_seed_same_decision_stream(self):
+        plan = FaultPlan(seed=42).add(0, 600, LinkRule("drop", prob=0.5))
+        a = NetChaosController(plan)
+        b = NetChaosController(plan)
+        a.start()
+        b.start()
+        sa = self._stream(a, "x", "y")
+        assert sa == self._stream(b, "x", "y")
+        assert any(sa) and not all(sa)  # actually probabilistic
+
+    def test_different_seed_differs(self):
+        mk = lambda s: NetChaosController(  # noqa: E731
+            FaultPlan(seed=s).add(0, 600, LinkRule("drop", prob=0.5)))
+        assert self._stream(mk(1), "x", "y") != self._stream(mk(2), "x", "y")
+
+    def test_other_links_do_not_perturb_a_links_stream(self):
+        plan = FaultPlan(seed=9).add(0, 600, LinkRule("drop", prob=0.5))
+        clean = NetChaosController(plan)
+        noisy = NetChaosController(plan)
+        want = self._stream(clean, "x", "y")
+        got = []
+        for i in range(64):
+            noisy.outbound("p", "q", 1)  # concurrent link traffic
+            noisy.outbound("q", "p", 1)
+            got.append(noisy.outbound("x", "y", 100).drop)
+        assert got == want
+
+    def test_set_plan_resets_rng_streams(self):
+        plan = FaultPlan(seed=5).add(0, 600, LinkRule("drop", prob=0.5))
+        c = NetChaosController(plan)
+        c.start()
+        first = self._stream(c, "x", "y")
+        c.set_plan(FaultPlan(seed=5).add(0, 600, LinkRule("drop", prob=0.5)))
+        assert self._stream(c, "x", "y") == first
+
+
+# --- decision semantics ----------------------------------------------
+
+
+class _FakeConn:
+    def __init__(self):
+        self.written = []
+        self.closed = False
+
+    def write(self, data):
+        self.written.append(bytes(data))
+
+    def read_exact(self, n):
+        return b"\x00" * n
+
+    def close(self):
+        self.closed = True
+
+
+class TestChaosConn:
+    def _link(self, rule, seed=1):
+        plan = FaultPlan(seed=seed).add(0, 600, rule)
+        ctrl = NetChaosController(plan)
+        ctrl.start()
+        raw = _FakeConn()
+        return raw, ChaosConn(raw, ctrl, "src", "dst"), ctrl
+
+    def test_drop_swallows_whole_writes(self):
+        raw, conn, ctrl = self._link(netchaos.partition({"src"}, {"dst"}))
+        conn.write(b"frame-1")
+        conn.write(b"frame-2")
+        assert raw.written == []
+        assert ctrl.injected["drop"] == 2
+
+    def test_unmatched_traffic_flows(self):
+        raw, conn, ctrl = self._link(netchaos.partition({"a"}, {"b"}))
+        conn.write(b"hello")
+        assert raw.written == [b"hello"]
+        assert ctrl.injected["drop"] == 0
+
+    def test_disconnect_closes_and_raises(self):
+        raw, conn, ctrl = self._link(netchaos.disconnect_storm(1.0))
+        with pytest.raises(ConnectionError):
+            conn.write(b"boom")
+        assert raw.closed
+        assert ctrl.injected["disconnect"] == 1
+
+    def test_delay_is_bounded_and_counted(self):
+        raw, conn, ctrl = self._link(netchaos.delay(0.01, jitter_s=0.01))
+        t0 = time.perf_counter()
+        conn.write(b"slow")
+        took = time.perf_counter() - t0
+        assert raw.written == [b"slow"]
+        assert 0.005 < took < 1.0
+        assert ctrl.injected["delay"] == 1
+        # a mis-built plan cannot wedge the send routine for minutes
+        d = Decision(delay_s=netchaos.MAX_INJECT_DELAY_S)
+        assert d.delay_s <= netchaos.MAX_INJECT_DELAY_S
+
+    def test_throttle_delivers_all_bytes(self):
+        raw, conn, ctrl = self._link(netchaos.throttle(64 * 1024))
+        payload = os.urandom(8192)
+        conn.write(payload)
+        assert b"".join(raw.written) == payload
+        assert ctrl.injected["throttle"] == 1
+
+    def test_read_side_passes_through(self):
+        raw, conn, _ = self._link(netchaos.partition({"src"}, {"dst"}))
+        assert conn.read_exact(4) == b"\x00" * 4  # inbound untouched
+
+    def test_metrics_mirror(self):
+        m = prometheus_metrics()
+        plan = FaultPlan(seed=3).add(0, 600, netchaos.partition(None, None))
+        ctrl = NetChaosController(plan, metrics=m.p2p)
+        ctrl.start()
+        ctrl.outbound("a", "b", 10)
+        rendered = m.registry.render()
+        assert 'tendermint_chaos_injected_total{kind="drop"} 1' in rendered
+        assert "tendermint_chaos_active_rules 1" in rendered
+        assert ctrl.injected["drop"] == 1
+
+
+# --- installation + switch hook ---------------------------------------
+
+
+class TestInstallation:
+    def test_wrap_conn_identity_without_controller(self):
+        raw = _FakeConn()
+        assert netchaos.wrap_conn(raw, "a", "b") is raw
+
+    def test_install_wrap_uninstall(self):
+        ctrl = netchaos.install(NetChaosController(FaultPlan(seed=1)))
+        assert netchaos.get_controller() is ctrl
+        raw = _FakeConn()
+        wrapped = netchaos.wrap_conn(raw, "a", "b")
+        assert isinstance(wrapped, ChaosConn)
+        netchaos.uninstall()
+        assert netchaos.get_controller() is None
+        assert netchaos.wrap_conn(raw, "a", "b") is raw
+
+
+def _mk_switch(network="chaos-net"):
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.p2p import (
+        MultiplexTransport,
+        NodeInfo,
+        NodeKey,
+        ProtocolVersion,
+        Switch,
+    )
+    from tendermint_tpu.p2p.base_reactor import ChannelDescriptor, Reactor
+
+    class Echo(Reactor):
+        def __init__(self):
+            super().__init__("ECHO")
+            self.got = []
+            self.ev = threading.Event()
+
+        def get_channels(self):
+            return [ChannelDescriptor(id=0x77, priority=1)]
+
+        def receive(self, ch_id, peer, msg_bytes):
+            self.got.append(msg_bytes)
+            self.ev.set()
+
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+        def init_peer(self, peer):
+            pass
+
+        def add_peer(self, peer):
+            pass
+
+        def remove_peer(self, peer, reason):
+            pass
+
+    nk = NodeKey(PrivKeyEd25519.generate())
+    ni = NodeInfo(
+        protocol_version=ProtocolVersion(), id=nk.id, listen_addr="",
+        network=network, version="dev", channels=bytes([0x77]),
+        moniker="chaos-test")
+    tr = MultiplexTransport(ni, nk)
+    tr.listen("127.0.0.1:0")
+    ni.listen_addr = tr.listen_addr
+    sw = Switch(tr)
+    echo = Echo()
+    sw.add_reactor("ECHO", echo)
+    sw.start()
+    return sw, echo
+
+
+class TestSwitchIntegration:
+    def test_partition_blocks_then_heals_over_real_sockets(self):
+        """Two real switches: with a partition rule armed between their
+        ids, a broadcast never arrives; set an empty plan (heal) and
+        the SAME connection delivers again — framing survives drops."""
+        ctrl = netchaos.install(NetChaosController(FaultPlan(seed=11)))
+        a = b = None
+        try:
+            a, echo_a = _mk_switch()
+            b, echo_b = _mk_switch()
+            peer = a.dial_peer(b.transport.listen_addr)
+            assert peer is not None
+            deadline = time.time() + 5
+            while time.time() < deadline and b.peers.size() == 0:
+                time.sleep(0.02)
+            assert b.peers.size() == 1
+
+            ctrl.set_plan(FaultPlan(seed=11).add(
+                0, 600, netchaos.partition({a.node_info().id},
+                                           {b.node_info().id})))
+            a.broadcast(0x77, b"during-partition")
+            assert not echo_b.ev.wait(0.6)
+            assert echo_b.got == []
+            assert ctrl.injected["drop"] >= 1
+
+            ctrl.set_plan(FaultPlan(seed=11))  # heal
+            echo_b.ev.clear()
+            a.broadcast(0x77, b"after-heal")
+            assert echo_b.ev.wait(5.0), "healed link never delivered"
+            assert echo_b.got[-1] == b"after-heal"
+        finally:
+            for sw in (a, b):
+                if sw is not None:
+                    sw.stop()
+
+
+class TestReconnectHygiene:
+    def test_reconnect_attempts_metric_and_rate_limit(self, monkeypatch):
+        """A dropped persistent peer's redials are counted per peer and
+        spaced by the min-gap even with fast retry intervals."""
+        from tendermint_tpu.p2p import switch as switch_mod
+
+        monkeypatch.setattr(switch_mod, "RECONNECT_INTERVAL", 0.01)
+        monkeypatch.setattr(switch_mod, "RECONNECT_MIN_GAP", 0.15)
+        m = prometheus_metrics()
+        a, _ = _mk_switch()
+        a.metrics = m.p2p
+        b, _ = _mk_switch()
+        try:
+            peer = a.dial_peer(b.transport.listen_addr,
+                               expect_id=b.node_info().id, persistent=True)
+            assert peer is not None
+            b_id = b.node_info().id
+            b.stop()  # kill the far side: reconnect loop starts
+            a.stop_peer_for_error(peer, RuntimeError("injected drop"))
+            time.sleep(0.8)
+            rendered = m.registry.render()
+            assert "p2p_reconnect_attempts_total" in rendered
+            # rate limit: ~0.8s / 0.15s min gap -> at most ~6 attempts
+            line = [ln for ln in rendered.splitlines()
+                    if ln.startswith("tendermint_p2p_reconnect_attempts_total{")
+                    and b_id in ln]
+            assert line, rendered
+            count = float(line[0].rsplit(" ", 1)[1])
+            assert 1 <= count <= 7, line
+        finally:
+            a.stop()

@@ -41,6 +41,16 @@ from tendermint_tpu.types.validator_set import random_validator_set
 CHAIN_ID = "reactor-net"
 
 
+def _load_factor() -> float:
+    """Deadline scale for the multi-node tests that flake only under
+    full-gate CPU contention (pass standalone): TM_TPU_TEST_LOAD_FACTOR
+    buys slack on a loaded box without slowing standalone runs."""
+    try:
+        return max(1.0, float(os.environ.get("TM_TPU_TEST_LOAD_FACTOR", "1")))
+    except ValueError:
+        return 1.0
+
+
 class NetNode:
     def __init__(self, idx, doc, key, fast_sync=False, app_factory=None):
         db = MemDB()
@@ -140,9 +150,13 @@ def collect_blocks(sub, want, timeout):
 
 class TestConsensusNet:
     def test_four_validators_commit_blocks(self):
+        # known full-gate load flake (memory: "invalid part proof"
+        # family, passes standalone) — scale the deadline on loaded
+        # boxes via TM_TPU_TEST_LOAD_FACTOR
         nodes, subs = make_net(4)
         try:
-            per_node = [collect_blocks(s, 2, timeout=60.0) for s in subs]
+            per_node = [collect_blocks(s, 2, timeout=60.0 * _load_factor())
+                        for s in subs]
             for i, blocks in enumerate(per_node):
                 assert len(blocks) >= 2, f"node {i} committed only {len(blocks)} blocks"
             # all nodes agree on block 1's hash

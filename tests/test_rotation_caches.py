@@ -1,0 +1,255 @@
+"""Rotation-safe cache + vanishing-validator tests.
+
+A churning validator set must never let a verify-path cache serve a
+stale answer: the verified-signature LRU is keyed by the full
+(msg, sig, pubkey) triple, ValidatorSet.is_bls() re-derives after every
+update_with_changes, and the BLS aggregate lane's proof-of-possession
+registry gates EndBlock rotation the same way genesis gates the initial
+set. Plus the regression the churn scenarios lean on: a vote from a
+validator that was JUST rotated out is rejected cleanly — no crash, no
+peer damage, no tally poisoning.
+"""
+
+import os
+import random
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.crypto import pubkey_to_bytes
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.crypto.sigcache import SigCache
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    Vote,
+)
+from tendermint_tpu.types.validator_set import (
+    Validator,
+    ValidatorSet,
+    random_validator_set,
+)
+from tendermint_tpu.types.vote_set import ErrVoteInvalid, VoteSet
+
+CHAIN = "rotation-chain"
+
+
+# --- verified-signature LRU under re-keying ---------------------------
+
+
+class TestSigCacheRotationSafety:
+    def test_rekeyed_validator_cannot_hit_old_verdict(self):
+        """Property: across random rotations and re-keys, a cached
+        verdict can only ever be returned for the EXACT triple that
+        produced it — a validator re-keyed at the same address gets a
+        different pubkey, hence a different cache key, hence a miss."""
+        rng = random.Random(0x50)
+        cache = SigCache(1024)
+        keys = [PrivKeyEd25519.gen_from_secret(b"rot-%d" % i)
+                for i in range(8)]
+        for trial in range(200):
+            sk = rng.choice(keys)
+            msg = b"height-%d" % rng.randrange(32)
+            sig = sk.sign(msg)
+            pk = sk.pub_key().data
+            k = SigCache.key(msg, sig, pk)
+            cached = cache.get(k)
+            fresh = sk.pub_key().verify_bytes(msg, sig)
+            if cached is not None:
+                assert cached == fresh  # never a stale/wrong verdict
+            cache.put(k, fresh)
+            # "re-key": same msg+sig under a DIFFERENT pubkey must form
+            # a different key entirely
+            other = rng.choice([x for x in keys if x is not sk])
+            k2 = SigCache.key(msg, sig, other.pub_key().data)
+            assert k2 != k
+            v2 = cache.get(k2)
+            if v2 is not None:
+                # only legitimate if that exact triple was stored before
+                assert v2 == other.pub_key().verify_bytes(msg, sig)
+
+    def test_key_injective_on_suffix_boundary(self):
+        """sig+pk form a fixed 96-byte suffix: shifting bytes between
+        msg and sig must change the key."""
+        sk = PrivKeyEd25519.gen_from_secret(b"x")
+        msg, sig, pk = b"abc", sk.sign(b"abc"), sk.pub_key().data
+        assert SigCache.key(msg, sig, pk) != SigCache.key(
+            msg + sig[:1], sig[1:] + b"\x00", pk)
+
+
+# --- is_bls() cache invalidation --------------------------------------
+
+
+class TestIsBlsCacheInvalidation:
+    def test_update_with_changes_invalidates(self):
+        vs, _ = random_validator_set(3)
+        assert vs.is_bls() is False
+        # rotating an ed25519 validator in keeps it False and must not
+        # resurrect a stale cached True later
+        nk = PrivKeyEd25519.gen_from_secret(b"new")
+        vs.update_with_changes([Validator.new(nk.pub_key(), 5)])
+        assert len(vs) == 4
+        assert vs.is_bls() is False
+        assert getattr(vs, "_is_bls_cache") is False
+
+    @pytest.mark.slow  # pairing-grade keygen: ~seconds of pure python
+    def test_bls_set_loses_flag_when_ed25519_rotates_in(self):
+        from tendermint_tpu.types.validator_set import (
+            random_bls_validator_set,
+        )
+
+        vs, _ = random_bls_validator_set(2, seed=b"rotbls")
+        assert vs.is_bls() is True
+        ed = PrivKeyEd25519.gen_from_secret(b"intruder")
+        vs.update_with_changes([Validator.new(ed.pub_key(), 1)])
+        assert vs.is_bls() is False  # stale True would re-enable agg lane
+
+    def test_copy_preserves_correct_answer(self):
+        vs, _ = random_validator_set(2)
+        vs.is_bls()  # populate the cache
+        assert vs.copy().is_bls() is False
+
+
+# --- EndBlock rotation PoP gate (aggregate-lane rogue-key defense) ----
+
+
+class TestRotationPopGate:
+    def test_ed25519_sets_are_untouched(self):
+        from tendermint_tpu.state.execution import _check_rotation_pop
+
+        vs, _ = random_validator_set(3)
+        nk = PrivKeyEd25519.gen_from_secret(b"any")
+        _check_rotation_pop(vs, [Validator.new(nk.pub_key(), 5)])  # no raise
+
+    @pytest.mark.slow  # BLS keygen + PoP pairing: seconds of pure python
+    def test_bls_join_requires_pop(self):
+        from tendermint_tpu.crypto import bls
+        from tendermint_tpu.crypto.bls import PrivKeyBLS12381
+        from tendermint_tpu.state.execution import _check_rotation_pop
+        from tendermint_tpu.types.validator_set import (
+            random_bls_validator_set,
+        )
+
+        vs, _ = random_bls_validator_set(2, seed=b"popgate")
+        joiner = PrivKeyBLS12381.gen_from_secret(b"popgate-joiner-raw")
+        pub = joiner.pub_key()  # NOTE: pub_key() self-registers its PoP
+        pk_bytes = pub.data
+        v = Validator(pub.address(), pub, 3)
+        v_ok = Validator(pub.address(), pub, 3, pop=bls.pop_prove(joiner))
+
+        def scrub():
+            # model a node that never saw this key before (the
+            # registry is process-wide; building the key above
+            # registered it as locally-possessed)
+            with bls._pop_lock:
+                bls._pop_registry.discard(pk_bytes)
+
+        scrub()
+        with pytest.raises(ValueError, match="proof of possession"):
+            _check_rotation_pop(vs, [v])
+        # removals never need a PoP
+        _check_rotation_pop(
+            vs, [Validator(vs.validators[0].address,
+                           vs.validators[0].pub_key, 0)])
+        # a valid PoP riding the update registers and passes
+        scrub()
+        _check_rotation_pop(vs, [v_ok])
+        assert bls.pop_registered(pk_bytes)
+
+
+# --- ValidatorUpdate.pop wire plumbing --------------------------------
+
+
+class TestValidatorUpdatePopSerde:
+    def test_abci_responses_roundtrip_with_pop(self):
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.state.execution import ABCIResponses
+
+        eb = abci.ResponseEndBlock(validator_updates=[
+            abci.ValidatorUpdate(pub_key=b"\x01" * 33, power=5,
+                                 pop=b"\x02" * 96),
+            abci.ValidatorUpdate(pub_key=b"\x03" * 33, power=0),
+        ])
+        res = ABCIResponses([abci.ResponseDeliverTx(code=0)], eb)
+        again = ABCIResponses.from_bytes(res.to_bytes())
+        ups = again.end_block.validator_updates
+        assert ups[0].pop == b"\x02" * 96
+        assert ups[1].pop == b""
+
+    def test_abci_codec_roundtrip_with_pop(self):
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.abci.codec import (
+            _valupdates_from,
+            _valupdates_obj,
+        )
+
+        ups = [abci.ValidatorUpdate(pub_key=b"\x01" * 33, power=5,
+                                    pop=b"\x09" * 96),
+               abci.ValidatorUpdate(pub_key=b"\x02" * 33, power=7)]
+        assert _valupdates_from(_valupdates_obj(ups)) == ups
+        # pre-churn two-element encodings still decode
+        assert _valupdates_from([[b"\x01", 3]]) == [
+            abci.ValidatorUpdate(pub_key=b"\x01", power=3)]
+
+
+# --- votes from rotated-out validators --------------------------------
+
+
+def _signed_vote(sk, vals: ValidatorSet, height: int) -> Vote:
+    idx, val = vals.get_by_address(sk.pub_key().address())
+    vote = Vote(
+        validator_address=sk.pub_key().address(),
+        validator_index=idx,
+        height=height,
+        round=0,
+        timestamp=1_700_000_000_000_000_000,
+        type=VOTE_TYPE_PRECOMMIT,
+        block_id=BlockID(hash=b"\xaa" * 20),
+    )
+    vote.signature = sk.sign(vote.sign_bytes(CHAIN))
+    return vote
+
+
+class TestVoteFromRotatedOutValidator:
+    def _rotated(self):
+        vs, keys = random_validator_set(4, 10)
+        gone = keys[-1]
+        rotated = vs.copy()
+        rotated.update_with_changes(
+            [Validator(gone.pub_key().address(), gone.pub_key(), 0)])
+        assert len(rotated) == 3
+        return vs, rotated, keys, gone
+
+    def test_vote_set_rejects_cleanly(self):
+        """The rotated-out validator's vote — validly signed against
+        the OLD set — must raise ErrVoteInvalid against the new set's
+        VoteSet (index/address mismatch), never crash or tally."""
+        old_vs, rotated, keys, gone = self._rotated()
+        vote = _signed_vote(gone, old_vs, height=5)
+        new_set = VoteSet(CHAIN, 5, 0, VOTE_TYPE_PRECOMMIT, rotated)
+        with pytest.raises(ErrVoteInvalid):
+            new_set.add_vote(vote)
+        assert new_set.sum == 0
+        assert new_set.bit_array().num_true() == 0
+        # bulk path too (the TPU-batched ingestion)
+        with pytest.raises(ErrVoteInvalid):
+            new_set.add_votes([vote])
+        # the set still works for surviving validators afterward
+        good = _signed_vote(keys[0], rotated, height=5)
+        assert new_set.add_vote(good)
+
+    def test_out_of_range_index_never_crashes_peer_state(self):
+        """Gossip bookkeeping with a stale (pre-rotation) validator
+        index must be a bounded no-op — BitArray bounds-checks — so a
+        straggler HasVote can't take down an honest peer."""
+        from tendermint_tpu.consensus.messages import HasVoteMessage
+        from tendermint_tpu.consensus.reactor import PeerState
+
+        ps = PeerState(peer=None)
+        ps.prs.height = 5
+        ps.prs.round = 0
+        ps.ensure_vote_bit_arrays(5, 3)  # sized for the NEW set
+        ps.apply_has_vote(HasVoteMessage(
+            height=5, round=0, type=VOTE_TYPE_PRECOMMIT, index=3))
+        assert ps.prs.precommits.num_true() == 0  # ignored, no crash

@@ -1,0 +1,214 @@
+"""Chaos/churn scenario suite (tools/scenarios.py) + churn-driver unit
+tests.
+
+The six named scenarios each boot a real in-process localnet and are
+slow-marked (tens of seconds each, and multi-node nets are exactly the
+load-flake class tier-1 must not carry); the churn-driver tests are
+fast and tier-1. `bench.py chaosnet` runs partition_heal with the same
+oracle and reports recovery latency.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.kvstore import ChurnKVStoreApplication
+from tendermint_tpu.libs.db import MemDB
+
+
+# --- churn driver (fast, tier-1) --------------------------------------
+
+
+def _drive(app, heights, txs=()):
+    """Run begin/deliver/end/commit for each height; returns the
+    end_block validator-update batches per height."""
+    batches = []
+    for h in heights:
+        app.begin_block(abci.RequestBeginBlock())
+        for tx in txs:
+            app.deliver_tx(tx)
+        res = app.end_block(abci.RequestEndBlock(height=h))
+        app.commit()
+        batches.append(list(res.validator_updates))
+    return batches
+
+
+def _seed_real_validators(app, n=4, power=10):
+    from tendermint_tpu.crypto import pubkey_to_bytes
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+    vals = []
+    for i in range(n):
+        pk = PrivKeyEd25519.gen_from_secret(b"real-%d" % i).pub_key()
+        vals.append(abci.ValidatorUpdate(
+            pub_key=pubkey_to_bytes(pk), power=power))
+    app.init_chain(abci.RequestInitChain(validators=vals))
+    return vals
+
+
+class TestChurnDriver:
+    def test_epoch_batches_are_deterministic_from_seed(self):
+        runs = []
+        for _ in range(2):
+            app = ChurnKVStoreApplication(MemDB(), epoch_blocks=2,
+                                          rotation_fraction=0.5,
+                                          phantom_pool=6, seed=99)
+            _seed_real_validators(app)
+            runs.append(_drive(app, range(1, 11)))
+        assert runs[0] == runs[1], "same seed must rotate identically"
+        # and a different seed rotates differently
+        app = ChurnKVStoreApplication(MemDB(), epoch_blocks=2,
+                                      rotation_fraction=0.5,
+                                      phantom_pool=6, seed=100)
+        _seed_real_validators(app)
+        assert _drive(app, range(1, 11)) != runs[0]
+
+    def test_epochs_only_on_boundaries_and_batches_are_large(self):
+        app = ChurnKVStoreApplication(MemDB(), epoch_blocks=3,
+                                      phantom_pool=8, seed=1)
+        _seed_real_validators(app)
+        batches = _drive(app, range(1, 10))
+        for i, batch in enumerate(batches, start=1):
+            if i % 3 == 0:
+                assert batch, f"epoch boundary {i} emitted nothing"
+            else:
+                assert batch == [], f"non-boundary {i} emitted updates"
+        assert app.epochs_run == 3
+        # first boundary fills the pool in one large batch
+        assert len(batches[2]) == 8
+
+    def test_liveness_bound_holds_across_epochs(self):
+        """Real validators keep > 2/3 of total power no matter how many
+        epochs run — phantoms can never threaten quorum."""
+        app = ChurnKVStoreApplication(MemDB(), epoch_blocks=1,
+                                      rotation_fraction=0.5,
+                                      phantom_pool=32, seed=7)
+        _seed_real_validators(app, n=4, power=10)
+        _drive(app, range(1, 16))
+        phantom = sum(p for _, p in app._phantoms())
+        real = app._real_power()
+        assert real == 40
+        assert 3 * real > 2 * (real + phantom), (real, phantom)
+
+    def test_rotation_actually_rotates(self):
+        app = ChurnKVStoreApplication(MemDB(), epoch_blocks=1,
+                                      rotation_fraction=0.5,
+                                      phantom_pool=6, seed=3)
+        _seed_real_validators(app)
+        _drive(app, [1])
+        first = {pk for pk, _ in app._phantoms()}
+        _drive(app, [2, 3])
+        later = {pk for pk, _ in app._phantoms()}
+        assert first != later
+        assert first & later, "rotation should keep some survivors"
+
+    def test_tx_driven_updates_still_ride_along(self):
+        from tendermint_tpu.crypto import pubkey_to_bytes
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+        app = ChurnKVStoreApplication(MemDB(), epoch_blocks=2, seed=5)
+        _seed_real_validators(app)
+        newk = PrivKeyEd25519.gen_from_secret(b"txval").pub_key()
+        tx = b"val:" + pubkey_to_bytes(newk).hex().encode() + b"!9"
+        app.begin_block(abci.RequestBeginBlock())
+        app.deliver_tx(tx)
+        res = app.end_block(abci.RequestEndBlock(height=2))
+        pks = [u.pub_key for u in res.validator_updates]
+        assert pubkey_to_bytes(newk) in pks  # tx update present
+        assert len(res.validator_updates) > 1  # epoch batch rode along
+
+    def test_proxy_creator_spec_parsing(self):
+        from tendermint_tpu.proxy import default_client_creator
+
+        creator = default_client_creator(
+            "churn_kvstore:epoch=3,frac=0.25,pool=5,seed=11")
+        # local client creator returns a client wrapping the app
+        client = creator()
+        target = getattr(client, "app", None) or getattr(
+            client, "_app", None)
+        assert target is not None
+        assert target.epoch_blocks == 3
+        assert target.rotation_fraction == 0.25
+        assert target.phantom_pool == 5
+        assert target.seed == 11
+        with pytest.raises(ValueError):
+            default_client_creator("churn_kvstore:bogus=1")
+
+
+# --- the named scenarios (slow: real multi-node localnets) ------------
+
+
+def _run(name, **kw):
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run(name, **kw)
+    assert res["ok"], res
+    return res
+
+
+@pytest.mark.slow
+def test_scenario_partition_heal():
+    res = _run("partition_heal")
+    assert "partition_suspected" in res["stall_reasons"]
+    assert res["recovery_s"] > 0
+    assert res["injected"]["disconnect"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_asym_partition():
+    res = _run("asym_partition")
+    assert res["recovery_s"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_delay_jitter():
+    res = _run("delay_jitter")
+    assert res["progressed_under_delay"]
+    assert res["injected"]["delay"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_churn_storm():
+    res = _run("churn_storm")
+    assert res["epochs_run"] > 0
+    assert res["disconnects"] > 0
+
+
+@pytest.mark.slow
+def test_scenario_rotation_epoch():
+    res = _run("rotation_epoch")
+    assert res["valsets_agree"]
+    assert res["valset_size"] > 4
+
+
+@pytest.mark.slow
+def test_scenario_statesync_join_under_churn(tmp_path):
+    res = _run("statesync_join_under_churn", tmp_root=str(tmp_path))
+    assert res["restored_base"] > 1
+
+
+@pytest.mark.slow
+def test_scenario_fault_timeline_replays_from_seed():
+    """Same seed => byte-identical fault plan AND the same injected
+    drop pattern on a fixed synthetic packet schedule (the netchaos
+    determinism contract at scenario level)."""
+    from tendermint_tpu.p2p import netchaos
+
+    def timeline(seed):
+        plan = netchaos.FaultPlan(seed=seed)
+        plan.add(0, 5, netchaos.LinkRule("drop", prob=0.4))
+        plan.add(1, 6, netchaos.delay(0.01, jitter_s=0.05))
+        ctrl = netchaos.NetChaosController(plan, time_fn=lambda: 0.0)
+        ctrl.start()
+        ctrl._time = lambda: 2.0  # inside both phases
+        return plan.to_json(), [
+            (d.drop, round(d.delay_s, 9))
+            for d in (ctrl.outbound("a", "b", 100) for _ in range(200))
+        ]
+
+    assert timeline(1234) == timeline(1234)
+    assert timeline(1234) != timeline(4321)
