@@ -1,0 +1,839 @@
+#!/usr/bin/env python
+"""check_concurrency — static lock-discipline analyzer for the threaded
+stack.
+
+Parses every Python file under tendermint_tpu/ (no imports, pure AST)
+and enforces the concurrency discipline rules (CD-1..CD-7, README
+"Correctness tooling") the runtime half (libs/lockdep.py) checks in
+live executions:
+
+  CC-GUARD   a field written under a class's lock in some methods is
+             read/written bare (or under a different lock) in others
+  CC-ORDER   lock-order cycles in the acquisition graph built from
+             nested `with` scopes and cross-class calls made while a
+             lock is held (plus nested re-entry of a non-reentrant
+             Lock, which deadlocks unconditionally)
+  CC-BLOCK   blocking calls — sleeps, joins, waits, socket/HTTP I/O,
+             subprocess, pairing/XLA dispatch — made while holding a
+             lock (the exact shape of the PR-7 absorb_certificate bug)
+  CC-THREAD  threading.Thread creations with no termination path: not
+             joined anywhere, and the owning class has no
+             stop()/shutdown()/close() that joins or signals
+  CC-TORN    the PR-10 tearing idiom: data derived from a
+             get_round_state() shallow copy flowing into a wire send
+             (send/try_send/broadcast) without checking the snapshot's
+             `snapshot_consistent` stamp
+
+Findings are suppressed ONLY via scripts/concurrency_allowlist.json;
+every entry must carry a non-empty justification string. Keys are
+line-number-free so they survive drift. Wired into the test suite as a
+tier-1 gate (tests/test_check_concurrency.py, mirroring check_metrics)
+and runnable standalone:
+
+    python scripts/check_concurrency.py [--json] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+# attribute names that read as locks even without a visible
+# threading.Lock() assignment (duck-typed / injected locks)
+_LOCKISH_RE = re.compile(r"(^|_)(lock|rlock|wlock|mtx|mu)$|_lock$|^mtx$")
+
+# methods named *_locked are the repo's caller-holds-the-lock
+# convention: their bodies are analyzed as if every class lock is held
+_ASSUME_HELD_SUFFIX = "_locked"
+
+# stop-path method names for CC-THREAD (on_stop: the BaseService hook)
+_STOP_NAMES = ("stop", "shutdown", "close", "stop_all", "join", "on_stop")
+
+# wire-send call names for CC-TORN
+_SEND_NAMES = {"send", "try_send", "broadcast", "_broadcast"}
+
+# queue-ish receiver names for blocking get/put
+_QUEUEISH_RE = re.compile(r"(queue|_q$|^q$)", re.IGNORECASE)
+_THREADISH_RE = re.compile(r"(thread|^t\d?$|proc|worker)", re.IGNORECASE)
+
+# method calls that mutate a container field in place — these count as
+# WRITES for guard inference (self._cache[k] = v never rebinds _cache)
+_MUTATOR_METHODS = {
+    "append", "add", "pop", "popleft", "popitem", "update", "setdefault",
+    "extend", "remove", "discard", "clear", "insert", "appendleft",
+    "set_index", "or_update",
+}
+
+
+def _last_attr(expr) -> Optional[str]:
+    """Trailing identifier of a Name/Attribute chain."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _dotted(expr) -> Optional[str]:
+    """Render a Name/Attribute chain like self.mempool._lock; None for
+    anything more complex (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_threading_lock_call(call: ast.Call) -> Optional[str]:
+    """'Lock'/'RLock' if the call is threading.Lock()/RLock() or
+    lockdep.leaf_lock() (the lockdep-exempt leaf constructor — still a
+    plain Lock for discipline purposes), else None."""
+    fn = call.func
+    name = _last_attr(fn)
+    if name == "leaf_lock":
+        return "Lock"
+    if name in ("Lock", "RLock"):
+        if isinstance(fn, ast.Attribute):
+            base = _dotted(fn.value)
+            if base not in (None, "threading", "_threading"):
+                return None
+        return name
+    return None
+
+
+def _is_thread_create(call: ast.Call) -> bool:
+    fn = call.func
+    if _last_attr(fn) != "Thread":
+        return False
+    if isinstance(fn, ast.Attribute):
+        return _dotted(fn.value) in ("threading", None)
+    return True
+
+
+BLOCKING_PATTERNS: Tuple[Tuple[str, object], ...] = ()
+
+
+def _classify_blocking(call: ast.Call) -> Optional[str]:
+    """A short label when `call` matches the blocking-call allowlist
+    (things that may stall the holder for unbounded/IO-scale time)."""
+    fn = call.func
+    attr = _last_attr(fn)
+    if attr is None:
+        return None
+    recv = fn.value if isinstance(fn, ast.Attribute) else None
+    recv_name = _last_attr(recv) if recv is not None else None
+
+    if attr == "sleep" and recv_name in ("time", "_time"):
+        return "time.sleep"
+    if attr == "wait":
+        return ".wait()"
+    if attr == "join":
+        # str.join is ubiquitous: require a threadish receiver
+        if recv_name and _THREADISH_RE.search(recv_name):
+            return ".join()"
+        return None
+    if attr == "result" and recv is not None:
+        return "future.result()"
+    if attr in ("recv", "recvfrom", "accept", "sendall",
+                "create_connection"):
+        return f"socket .{attr}()"
+    if attr == "connect" and recv_name and "sock" in recv_name.lower():
+        return "socket .connect()"
+    if attr in ("run", "check_output", "check_call", "call", "Popen") \
+            and recv_name == "subprocess":
+        return f"subprocess.{attr}"
+    if attr == "urlopen":
+        return "urlopen"
+    if attr == "block_until_ready":
+        return "jax block_until_ready"
+    if attr in ("fast_aggregate_verify", "aggregate_verify", "pairing",
+                "multi_pairing", "pairing_check"):
+        return f"BLS {attr}"
+    if attr in ("batch_verify", "verify_commit"):
+        return f"batched verify {attr}"
+    if attr in ("get", "put") and recv_name \
+            and _QUEUEISH_RE.search(recv_name):
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+        return f"queue .{attr}()"
+    return None
+
+
+class MethodFacts:
+    def __init__(self, name: str):
+        self.name = name
+        # (field, is_write, frozenset(held lock names), lineno)
+        self.accesses: List[Tuple[str, bool, frozenset, int]] = []
+        # (outer lock, inner lock, lineno) for directly nested withs
+        self.nested: List[Tuple[str, str, int]] = []
+        # lock names this method acquires directly (any depth)
+        self.acquires: Set[str] = set()
+        # (held frozenset, receiver kind 'self'|'other', method, lineno)
+        self.calls_under_lock: List[Tuple[frozenset, str, str, int]] = []
+        # (held frozenset, blocking label, lineno)
+        self.blocking: List[Tuple[frozenset, str, int]] = []
+        # (lineno, stored name 'self.X'|'X'|None)
+        self.thread_creates: List[Tuple[int, Optional[str]]] = []
+        self.joins: Set[str] = set()          # names .join() was called on
+        self.signals = False                   # .set() / flag = False seen
+        self.grs_vars: Set[str] = set()        # names bound to get_round_state()
+        self.torn_sends: List[Tuple[str, int]] = []
+        self.mentions_gate = False             # snapshot_consistent referenced
+
+
+class ClassFacts:
+    def __init__(self, name: str, path: str, lineno: int):
+        self.name = name
+        self.path = path
+        self.lineno = lineno
+        self.lock_fields: Dict[str, str] = {}  # attr -> Lock|RLock
+        self.methods: Dict[str, MethodFacts] = {}
+        self.bases: List[str] = []
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Statement walker for one function body, tracking the stack of
+    held locks through `with` scopes."""
+
+    def __init__(self, facts: MethodFacts, cls: Optional[ClassFacts],
+                 assume_held: frozenset):
+        self.f = facts
+        self.cls = cls
+        self.held: List[str] = list(assume_held)
+        self.assumed = frozenset(assume_held)
+
+    # -- lock recognition ---------------------------------------------
+
+    def _lock_name(self, expr) -> Optional[str]:
+        """Canonical held-lock name for a with-context expr, or None if
+        it isn't a lock. self.X locks use the bare field name; other
+        paths keep their dotted spelling."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            attr = d.split(".", 1)[1]
+            if "." not in attr:
+                if self.cls is not None and attr in self.cls.lock_fields:
+                    return attr
+                if _LOCKISH_RE.search(attr):
+                    return attr
+                return None
+            # deeper path (self.obj._lock): lockish tail only
+            tail = attr.rsplit(".", 1)[-1]
+            return d if _LOCKISH_RE.search(tail) else None
+        tail = d.rsplit(".", 1)[-1]
+        return d if _LOCKISH_RE.search(tail) else None
+
+    # -- visitors ------------------------------------------------------
+
+    def visit_With(self, node: ast.With):
+        acquired = []
+        for item in node.items:
+            ln = self._lock_name(item.context_expr)
+            if ln is not None:
+                self.f.acquires.add(ln)
+                for h in self.held:
+                    self.f.nested.append((h, ln, node.lineno))
+                acquired.append(ln)
+                self.held.append(ln)
+            # the context expr itself may contain calls/accesses
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.f.accesses.append(
+                (node.attr, is_write, frozenset(self.held), node.lineno))
+        if node.attr == "snapshot_consistent":
+            self.f.mentions_gate = True
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # self.X[k] = v / del self.X[k]: a WRITE to the container field
+        if isinstance(node.ctx, (ast.Store, ast.Del)) \
+                and isinstance(node.value, ast.Attribute) \
+                and isinstance(node.value.value, ast.Name) \
+                and node.value.value.id == "self":
+            self.f.accesses.append(
+                (node.value.attr, True, frozenset(self.held), node.lineno))
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        if node.id == "snapshot_consistent":
+            self.f.mentions_gate = True
+
+    def visit_Constant(self, node: ast.Constant):
+        if node.value == "snapshot_consistent":
+            self.f.mentions_gate = True
+
+    def visit_Assign(self, node: ast.Assign):
+        # x = <...>.get_round_state(), plus transitive taint: anything
+        # computed FROM a snapshot variable (the PR-10 bug built the
+        # wire bytes first, then broadcast the local)
+        tainted = (isinstance(node.value, ast.Call)
+                   and _last_attr(node.value.func) == "get_round_state")
+        if not tainted and self.f.grs_vars:
+            tainted = any(isinstance(sub, ast.Name)
+                          and sub.id in self.f.grs_vars
+                          for sub in ast.walk(node.value))
+        if tainted:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.f.grs_vars.add(tgt.id)
+        # thread creation storage + stop-flag signals
+        if isinstance(node.value, ast.Call) \
+                and _is_thread_create(node.value):
+            stored = None
+            for tgt in node.targets:
+                d = _dotted(tgt)
+                if d is not None:
+                    stored = d
+            self.f.thread_creates.append((node.lineno, stored))
+            node.value._cc_recorded = True
+        elif isinstance(node.value, ast.Constant) \
+                and node.value.value is False:
+            for tgt in node.targets:
+                if _dotted(tgt) and _dotted(tgt).startswith("self."):
+                    self.f.signals = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        attr = _last_attr(node.func)
+        recv = node.func.value if isinstance(node.func, ast.Attribute) \
+            else None
+
+        if _is_thread_create(node) and not getattr(node, "_cc_recorded",
+                                                   False):
+            # bare Thread(...) not caught via visit_Assign (passed
+            # straight to .start(), appended to a list, ...)
+            self.f.thread_creates.append((node.lineno, None))
+
+        if attr == "join" and recv is not None:
+            d = _dotted(recv)
+            if d is not None:
+                self.f.joins.add(d)
+        if attr in ("set", "clear") and recv is not None:
+            # Event.set() / Event.clear(): both idioms signal loop exit
+            self.f.signals = True
+        if attr in ("stop", "shutdown", "close") and recv is not None:
+            self.f.signals = True
+
+        # in-place container mutation through a method: a WRITE to the
+        # receiver field for guard inference
+        if attr in _MUTATOR_METHODS and isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            self.f.accesses.append(
+                (recv.attr, True, frozenset(self.held), node.lineno))
+
+        held = frozenset(self.held)
+        if held:
+            label = _classify_blocking(node)
+            if label is not None:
+                self.f.blocking.append((held, label, node.lineno))
+            if attr is not None and recv is not None:
+                kind = "self" if (isinstance(recv, ast.Name)
+                                  and recv.id == "self") else "other"
+                self.f.calls_under_lock.append(
+                    (held, kind, attr, node.lineno))
+
+        # torn-snapshot flow: a send-family call whose args reference a
+        # get_round_state() binding
+        if attr in _SEND_NAMES and self.f.grs_vars:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) \
+                            and sub.id in self.f.grs_vars:
+                        self.f.torn_sends.append((sub.id, node.lineno))
+                        break
+        self.generic_visit(node)
+
+    # nested function/lambda bodies execute in an unknown lock context:
+    # walk them with an empty held stack but the same fact sink, so
+    # their accesses/sends still attribute to the enclosing method
+    def visit_FunctionDef(self, node):
+        inner = _FuncWalker(self.f, self.cls, frozenset())
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        inner = _FuncWalker(self.f, self.cls, frozenset())
+        inner.visit(node.body)
+
+
+def _collect_lock_fields(cls_node: ast.ClassDef) -> Dict[str, str]:
+    fields: Dict[str, str] = {}
+    for sub in ast.walk(cls_node):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            kind = _is_threading_lock_call(sub.value)
+            if kind is None:
+                continue
+            for tgt in sub.targets:
+                d = _dotted(tgt)
+                if d is not None and d.startswith("self.") \
+                        and d.count(".") == 1:
+                    fields[d.split(".", 1)[1]] = kind
+    return fields
+
+
+def analyze_file(path: str, relpath: str) -> Tuple[List[ClassFacts],
+                                                   List[MethodFacts]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    classes: List[ClassFacts] = []
+    mod_funcs: List[MethodFacts] = []
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cf = ClassFacts(node.name, relpath, node.lineno)
+            cf.bases = [b for b in
+                        (_last_attr(x) for x in node.bases) if b]
+            cf.lock_fields = _collect_lock_fields(node)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    mf = MethodFacts(sub.name)
+                    assume = frozenset(cf.lock_fields) \
+                        if sub.name.endswith(_ASSUME_HELD_SUFFIX) \
+                        else frozenset()
+                    w = _FuncWalker(mf, cf, assume)
+                    for stmt in sub.body:
+                        w.visit(stmt)
+                    cf.methods[sub.name] = mf
+            classes.append(cf)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mf = MethodFacts(node.name)
+            w = _FuncWalker(mf, None, frozenset())
+            for stmt in node.body:
+                w.visit(stmt)
+            mod_funcs.append(mf)
+    return classes, mod_funcs
+
+
+# --- checks -----------------------------------------------------------
+
+
+class Finding:
+    def __init__(self, rule: str, key: str, path: str, line: int,
+                 message: str):
+        self.rule = rule
+        self.key = key
+        self.path = path
+        self.line = line
+        self.message = message
+        self.suppressed_by: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "key": self.key, "path": self.path,
+                "line": self.line, "message": self.message,
+                "suppressed": self.suppressed_by is not None}
+
+
+def check_guarded_fields(cls: ClassFacts) -> List[Finding]:
+    if not cls.lock_fields:
+        return []
+    out: List[Finding] = []
+    # guard inference: writes under a self lock, outside construction
+    guards: Dict[str, Set[str]] = {}
+    for mname, mf in cls.methods.items():
+        if mname in ("__init__", "__post_init__"):
+            continue
+        for field, is_write, held, _ in mf.accesses:
+            if not is_write or field in cls.lock_fields:
+                continue
+            own = {h for h in held if h in cls.lock_fields}
+            if own:
+                guards.setdefault(field, set()).update(own)
+    for field, locks in sorted(guards.items()):
+        bad: List[str] = []
+        for mname, mf in cls.methods.items():
+            if mname in ("__init__", "__post_init__"):
+                continue
+            for f2, is_write, held, line in mf.accesses:
+                if f2 != field:
+                    continue
+                if not (set(held) & locks):
+                    kind = "write" if is_write else "read"
+                    bad.append(f"{mname}:{line}({kind})")
+        if bad:
+            lockdesc = "/".join(f"self.{l}" for l in sorted(locks))
+            out.append(Finding(
+                "CC-GUARD",
+                f"CC-GUARD:{cls.path}:{cls.name}.{field}",
+                cls.path, cls.lineno,
+                f"{cls.name}.{field} is written under {lockdesc} but "
+                f"accessed bare in: {', '.join(sorted(set(bad))[:6])}"
+                + (" …" if len(set(bad)) > 6 else "")))
+    return out
+
+
+def _lock_node(cls: ClassFacts, lock: str) -> str:
+    return f"{cls.name}.{lock}"
+
+
+def build_lock_graph(all_classes: List[ClassFacts]) -> Dict[str, dict]:
+    """Edges {(a, b): witness} from nested withs + one-hop cross-class
+    calls made while holding a lock."""
+    # method name -> [(class, direct locks it acquires)]
+    method_index: Dict[str, List[Tuple[ClassFacts, Set[str]]]] = {}
+    for cls in all_classes:
+        for mname, mf in cls.methods.items():
+            own = {l for l in mf.acquires if l in cls.lock_fields}
+            if own:
+                method_index.setdefault(mname, []).append((cls, own))
+
+    edges: Dict[Tuple[str, str], dict] = {}
+
+    def add_edge(a: str, b: str, path: str, line: int, why: str):
+        if a == b:
+            return
+        edges.setdefault((a, b), {"path": path, "line": line, "why": why})
+
+    for cls in all_classes:
+        for mname, mf in cls.methods.items():
+            for outer, inner, line in mf.nested:
+                if outer in cls.lock_fields and inner in cls.lock_fields:
+                    add_edge(_lock_node(cls, outer), _lock_node(cls, inner),
+                             cls.path, line, f"nested with in {mname}")
+            for held, kind, callee, line in mf.calls_under_lock:
+                own_held = [h for h in held if h in cls.lock_fields]
+                if not own_held:
+                    continue
+                if kind == "self":
+                    targets = [(cls, {l for l in
+                                      cls.methods.get(callee,
+                                                      MethodFacts(callee))
+                                      .acquires if l in cls.lock_fields})] \
+                        if callee in cls.methods else []
+                else:
+                    cands = method_index.get(callee, [])
+                    # only unambiguous one-class resolutions
+                    targets = cands if len(cands) == 1 else []
+                for tcls, tlocks in targets:
+                    for tl in tlocks:
+                        for h in own_held:
+                            add_edge(
+                                _lock_node(cls, h), _lock_node(tcls, tl),
+                                cls.path, line,
+                                f"{cls.name}.{mname} holds self.{h} and "
+                                f"calls {tcls.name}.{callee}")
+    return edges
+
+
+def check_lock_order(all_classes: List[ClassFacts]) -> List[Finding]:
+    out: List[Finding] = []
+    # unconditional deadlock: nested re-entry of a plain Lock
+    for cls in all_classes:
+        for mname, mf in cls.methods.items():
+            for outer, inner, line in mf.nested:
+                if outer == inner and cls.lock_fields.get(outer) == "Lock":
+                    out.append(Finding(
+                        "CC-ORDER",
+                        f"CC-ORDER:{cls.path}:{cls.name}.{mname}:"
+                        f"reentry.{outer}",
+                        cls.path, line,
+                        f"{cls.name}.{mname} re-enters non-reentrant "
+                        f"Lock self.{outer} (guaranteed deadlock)"))
+    edges = build_lock_graph(all_classes)
+    # cycle detection over the directed edge set
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) > 1:
+                    cyc = tuple(sorted(path))
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        w = edges.get((path[-1], start)) or {}
+                        out.append(Finding(
+                            "CC-ORDER",
+                            "CC-ORDER:cycle:" + "|".join(cyc),
+                            w.get("path", "?"), w.get("line", 0),
+                            "lock-order cycle: "
+                            + " -> ".join(path + [start])
+                            + " (" + "; ".join(
+                                (edges.get((path[i], path[i + 1]),
+                                           edges.get((path[-1], start), {}))
+                                 .get("why", "?"))
+                                for i in range(len(path) - 1)) + ")"))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    for n in list(adj):
+        dfs(n)
+    return out
+
+
+def check_blocking(all_classes: List[ClassFacts],
+                   mod_funcs_by_file) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in all_classes:
+        for mname, mf in cls.methods.items():
+            for held, label, line in mf.blocking:
+                own = sorted(h for h in held if h in cls.lock_fields) \
+                    or sorted(held)
+                out.append(Finding(
+                    "CC-BLOCK",
+                    f"CC-BLOCK:{cls.path}:{cls.name}.{mname}:{label}",
+                    cls.path, line,
+                    f"{cls.name}.{mname} calls {label} while holding "
+                    + "/".join(f"self.{h}" if "." not in h else h
+                               for h in own)))
+    for relpath, funcs in mod_funcs_by_file.items():
+        for mf in funcs:
+            for held, label, line in mf.blocking:
+                out.append(Finding(
+                    "CC-BLOCK",
+                    f"CC-BLOCK:{relpath}:{mf.name}:{label}",
+                    relpath, line,
+                    f"{mf.name} calls {label} while holding "
+                    + "/".join(sorted(held))))
+    return out
+
+
+def check_threads(all_classes: List[ClassFacts],
+                  mod_funcs_by_file) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in all_classes:
+        creates = [(m, line, stored)
+                   for m, mf in cls.methods.items()
+                   for line, stored in mf.thread_creates]
+        if not creates:
+            continue
+        joins: Set[str] = set()
+        stop_ok = False
+        for mname, mf in cls.methods.items():
+            joins |= mf.joins
+            if any(mname == s or mname.startswith(s + "_")
+                   for s in _STOP_NAMES):
+                if mf.joins or mf.signals:
+                    stop_ok = True
+        # dedup anonymous+stored records for the same line
+        seen_lines: Set[int] = set()
+        for mname, line, stored in creates:
+            if line in seen_lines:
+                continue
+            seen_lines.add(line)
+            if stored is not None and stored in joins:
+                continue
+            local_join = stored is not None \
+                and stored in cls.methods[mname].joins
+            if local_join or stop_ok:
+                continue
+            out.append(Finding(
+                "CC-THREAD",
+                f"CC-THREAD:{cls.path}:{cls.name}.{mname}",
+                cls.path, line,
+                f"{cls.name}.{mname} creates a Thread"
+                + (f" (stored as {stored})" if stored else "")
+                + " but the class has no stop()/shutdown()/close() "
+                  "path that joins or signals it"))
+    for relpath, funcs in mod_funcs_by_file.items():
+        for mf in funcs:
+            seen_lines = set()
+            for line, stored in mf.thread_creates:
+                if line in seen_lines:
+                    continue
+                seen_lines.add(line)
+                if stored is not None and stored in mf.joins:
+                    continue
+                out.append(Finding(
+                    "CC-THREAD",
+                    f"CC-THREAD:{relpath}:{mf.name}",
+                    relpath, line,
+                    f"module function {mf.name} creates a Thread it "
+                    f"never joins"))
+    return out
+
+
+def check_torn(all_classes: List[ClassFacts],
+               mod_funcs_by_file) -> List[Finding]:
+    out: List[Finding] = []
+
+    def scan(mf: MethodFacts, owner: str, relpath: str):
+        if not mf.torn_sends or mf.mentions_gate:
+            return
+        var, line = mf.torn_sends[0]
+        out.append(Finding(
+            "CC-TORN",
+            f"CC-TORN:{relpath}:{owner}",
+            relpath, line,
+            f"{owner} sends wire data derived from a get_round_state() "
+            f"snapshot ({var}) without checking snapshot_consistent "
+            f"(PR-10 torn-read idiom, rule CD-5)"))
+
+    for cls in all_classes:
+        for mname, mf in cls.methods.items():
+            scan(mf, f"{cls.name}.{mname}", cls.path)
+    for relpath, funcs in mod_funcs_by_file.items():
+        for mf in funcs:
+            scan(mf, mf.name, relpath)
+    return out
+
+
+# --- allowlist + driver ----------------------------------------------
+
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "concurrency_allowlist.json")
+
+
+def load_allowlist(path: str) -> Dict[str, str]:
+    """{key: justification}; raises ValueError on entries with a
+    missing/empty justification — suppression must be explained.
+    An empty/missing path means no suppressions."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    entries = doc.get("entries", [])
+    out: Dict[str, str] = {}
+    for i, e in enumerate(entries):
+        key = e.get("key", "")
+        just = (e.get("justification") or "").strip()
+        if not key:
+            raise ValueError(f"allowlist entry {i} has no key")
+        if not just:
+            raise ValueError(
+                f"allowlist entry {key!r} has no justification — "
+                f"every suppression must say why")
+        out[key] = just
+    return out
+
+
+def collect_files(paths: List[str], root: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append((ap, os.path.relpath(ap, root)))
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        fp = os.path.join(dirpath, fn)
+                        out.append((fp, os.path.relpath(fp, root)))
+    return out
+
+
+def run_check(paths: List[str], root: str,
+              allowlist: Dict[str, str]) -> Tuple[List[Finding], dict]:
+    all_classes: List[ClassFacts] = []
+    mod_funcs_by_file: Dict[str, List[MethodFacts]] = {}
+    files = collect_files(paths, root)
+    errors: List[str] = []
+    for path, rel in files:
+        try:
+            classes, mod_funcs = analyze_file(path, rel)
+        except SyntaxError as e:
+            errors.append(f"{rel}: {e}")
+            continue
+        all_classes.extend(classes)
+        if mod_funcs:
+            mod_funcs_by_file[rel] = mod_funcs
+
+    findings: List[Finding] = []
+    for cls in all_classes:
+        findings.extend(check_guarded_fields(cls))
+    findings.extend(check_lock_order(all_classes))
+    findings.extend(check_blocking(all_classes, mod_funcs_by_file))
+    findings.extend(check_threads(all_classes, mod_funcs_by_file))
+    findings.extend(check_torn(all_classes, mod_funcs_by_file))
+
+    matched: Set[str] = set()
+    for f in findings:
+        if f.key in allowlist:
+            f.suppressed_by = allowlist[f.key]
+            matched.add(f.key)
+    stale = sorted(set(allowlist) - matched)
+    summary = {
+        "files": len(files),
+        "classes": len(all_classes),
+        "findings": len(findings),
+        "suppressed": sum(1 for f in findings if f.suppressed_by),
+        "unsuppressed": sum(1 for f in findings if not f.suppressed_by),
+        "stale_allowlist": stale,
+        "parse_errors": errors,
+    }
+    return findings, summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: tendermint_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings (baseline mode)")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--all", action="store_true",
+                    help="show suppressed findings too")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(root, "tendermint_tpu")]
+    t0 = time.time()
+    try:
+        allowlist = load_allowlist(args.allowlist)
+    except ValueError as e:
+        print(f"check_concurrency: FAIL: {e}", file=sys.stderr)
+        return 2
+    findings, summary = run_check(paths, root, allowlist)
+    elapsed = time.time() - t0
+
+    if args.json:
+        print(json.dumps(
+            {"findings": [f.as_dict() for f in findings],
+             "summary": summary, "elapsed_s": round(elapsed, 3)},
+            indent=1))
+    else:
+        shown = [f for f in findings
+                 if args.all or f.suppressed_by is None]
+        shown.sort(key=lambda f: (f.rule, f.path, f.line))
+        for f in shown:
+            tag = " [allowlisted]" if f.suppressed_by else ""
+            print(f"{f.rule}{tag} {f.path}:{f.line}\n  {f.message}\n"
+                  f"  key: {f.key}")
+        for s in summary["stale_allowlist"]:
+            print(f"WARNING: stale allowlist entry (no matching finding):"
+                  f" {s}")
+        for e in summary["parse_errors"]:
+            print(f"WARNING: parse error: {e}")
+        verdict = ("OK" if summary["unsuppressed"] == 0 else "FAIL")
+        print(f"check_concurrency: {verdict} — {summary['files']} files, "
+              f"{summary['classes']} classes, "
+              f"{summary['findings']} findings "
+              f"({summary['suppressed']} allowlisted, "
+              f"{summary['unsuppressed']} unsuppressed) "
+              f"in {elapsed:.2f}s")
+    return 0 if summary["unsuppressed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

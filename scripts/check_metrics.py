@@ -270,6 +270,11 @@ REQUIRED_FAMILIES = (
     "churn_validator_updates_total",
     "churn_valset_changes_total",
     "p2p_reconnect_attempts_total",
+    # PR-11 runtime lockdep (declaration presence: samples flow only
+    # under [instrumentation] lockdep = true — the chaos-under-lockdep
+    # scenarios are where these families go live)
+    "lockdep_hold_seconds",
+    "lockdep_inversions_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -342,6 +342,13 @@ class InstrumentationConfig:
     # per-height lifecycle timelines (libs/timeline.py) kept for the
     # newest N heights, served at /debug/timeline?height=N; 0 disables
     timeline_heights: int = 64
+    # runtime lock-discipline checker (libs/lockdep.py): wraps every
+    # threading.Lock/RLock created after boot with acquisition-order
+    # tracking (lock-order-inversion detection), per-site hold-time
+    # histograms, and the /debug/lockdep report on prof_laddr. Debug
+    # mode: ~5us per acquire/release pair on a throttled CPU — leave
+    # off in production (see README "Correctness tooling")
+    lockdep: bool = False
 
 
 @dataclass

@@ -18,6 +18,7 @@ serial verify per message.
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 import time
@@ -105,8 +106,12 @@ class PeerState:
     # -- queries -------------------------------------------------------
 
     def get_round_state(self) -> PeerRoundState:
+        # a shallow COPY under the lock (reference GetRoundState,
+        # reactor.go:921-927): gossip threads act on a consistent
+        # (height, round, step) instead of racing the receive thread's
+        # in-place updates field by field
         with self._lock:
-            return self.prs  # callers only read under short races; fine
+            return copy.copy(self.prs)
 
     def get_height(self) -> int:
         with self._lock:
@@ -538,12 +543,23 @@ class ConsensusReactor(Reactor):
     def add_peer(self, peer) -> None:
         ps: PeerState = peer.get("consensus_peer_state")
         self._peer_states[peer.id] = ps
-        # announce our current state so the peer can gossip to us
+        # announce our current state so the peer can gossip to us. This
+        # runs on the peer's accept/dial thread: only a CONSISTENT
+        # stamped snapshot may be turned into wire bytes (CD-5) — a
+        # torn forward-jumping round step poisons the peer's view. On a
+        # torn read, fall back to the last receive-thread-built
+        # broadcast bytes (always safe, may be stale) or stay quiet;
+        # the periodic step refresh re-anchors the peer either way.
         rs = self.cs.get_round_state()
-        peer.send(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
-        cs_msg = _commit_step_msg(rs)
-        if cs_msg is not None:
-            peer.send(STATE_CHANNEL, encode_msg(cs_msg))
+        if getattr(rs, "snapshot_consistent", True):
+            peer.send(STATE_CHANNEL, encode_msg(_new_round_step_msg(rs)))
+            cs_msg = _commit_step_msg(rs)
+            if cs_msg is not None:
+                peer.send(STATE_CHANNEL, encode_msg(cs_msg))
+        else:
+            step_bytes = getattr(self, "_last_step_bcast", None)
+            if step_bytes is not None:
+                peer.send(STATE_CHANNEL, step_bytes)
         threads = []
         for fn, nm in (
             (self._gossip_data_routine, "gossip-data"),
@@ -630,6 +646,10 @@ class ConsensusReactor(Reactor):
     def _handle_vote_set_maj23(self, peer, ps: PeerState, msg: VoteSetMaj23Message) -> None:
         """reactor.go:249-304: record the claim, respond with our bits."""
         rs = self.cs.get_round_state()
+        # wire reply below: never answer from a torn snapshot (CD-5);
+        # the peer's maj23 query repeats every PEER_QUERY_MAJ23_SLEEP
+        if not getattr(rs, "snapshot_consistent", True):
+            return
         if rs.height != msg.height or rs.votes is None:
             return
         rs.votes.set_peer_maj23(msg.round, msg.type, peer.id, msg.block_id)
@@ -714,6 +734,10 @@ class ConsensusReactor(Reactor):
     def _gossip_data_once(self, peer, ps: PeerState) -> bool:
         """One attempt; True if something was sent (skip the sleep)."""
         rs = self.cs.get_round_state()
+        # everything below builds wire messages from rs: skip the tick
+        # on a torn snapshot (CD-5) — the next one is 100ms away
+        if not getattr(rs, "snapshot_consistent", True):
+            return False
         prs = ps.get_round_state()
 
         # send proposal block parts the peer is missing
@@ -813,6 +837,10 @@ class ConsensusReactor(Reactor):
 
     def _gossip_votes_once(self, peer, ps: PeerState) -> bool:
         rs = self.cs.get_round_state()
+        # wire sends built from rs below: torn snapshot -> skip the
+        # tick (CD-5)
+        if not getattr(rs, "snapshot_consistent", True):
+            return False
         prs = ps.get_round_state()
 
         def send(vote) -> bool:
@@ -915,6 +943,10 @@ class ConsensusReactor(Reactor):
             time.sleep(PEER_QUERY_MAJ23_SLEEP)
             try:
                 rs = self.cs.get_round_state()
+                # maj23 claims are wire messages: only from a
+                # consistent snapshot (CD-5); retry in 2s
+                if not getattr(rs, "snapshot_consistent", True):
+                    continue
                 prs = ps.get_round_state()
                 if rs.votes is None:
                     continue

@@ -35,6 +35,7 @@ from typing import Callable, List, Optional
 
 from ..config import ConsensusConfig
 from ..libs import fail, timeline as timeline_mod, tracing
+from ..libs.lockdep import GenStamp, stamped_read
 from ..state import BlockExecutor
 from ..state import state as sm_state
 from ..types.basic import (
@@ -127,6 +128,23 @@ class ConsensusState:
         self._height_entered = time.time()
 
         self.rs = RoundState()
+        # seqlock generation stamp over self.rs: the receive loop (the
+        # single writer) brackets each message/timeout's processing with
+        # write_begin/write_end, so get_round_state() can prove a
+        # shallow copy did not interleave with a transition — the
+        # PR-10 torn-read class (discipline rule CD-5)
+        self._rs_stamp = GenStamp()
+        # writer-published fallback snapshot: one (gen, snapshot)
+        # tuple, swapped atomically (GIL) after every mutation burst,
+        # so readers that lose the stamped-read race get a CONSISTENT,
+        # at-most-one-burst-stale copy instead of a torn one — with
+        # the generation that MATCHES it (a tuple, not two fields: two
+        # loads could pair an old snapshot with a newer gen). Without
+        # the fallback a busy receive loop (single-validator producer
+        # committing back to back) keeps the generation odd most of
+        # the time and every gossip tick would skip — catch-up
+        # starves.
+        self._rs_published = None  # Optional[(gen, RoundState)]
         self.state = None  # set by update_to_state
 
         # message queues (reference :38 msgQueueSize=1000)
@@ -197,10 +215,34 @@ class ConsensusState:
         self._queue.put(("msg", ("", msg)))
 
     def get_round_state(self) -> RoundState:
-        """Snapshot (shallow; the receive loop is the only writer)."""
+        """Stamped snapshot (shallow; the receive loop is the only
+        writer). The returned RoundState carries `snapshot_gen` (the
+        seqlock generation it was taken at) and `snapshot_consistent`
+        (False when no provably-untorn copy could be produced).
+        Consumers that build WIRE messages must check the flag — a torn
+        forward-jumping (height, round, step) poisons every peer's view
+        (PR-10's multi-node stall signature); diagnostic readers may
+        tolerate tears but should report the flag.
+
+        Reads from the receive thread itself are always consistent and
+        skip the retry loop. Readers that lose the stamped-read race
+        against a busy receive loop get the writer-published fallback —
+        consistent by construction, at most one burst stale — so
+        gossip never starves waiting for a quiet window; inconsistent
+        snapshots only escape before the machine has processed its
+        first message."""
         import copy
 
-        return copy.copy(self.rs)
+        snap, gen, consistent = stamped_read(
+            self._rs_stamp, lambda: copy.copy(self.rs), retries=3)
+        if not consistent:
+            pub = self._rs_published
+            if pub is not None:
+                gen, published = pub
+                snap, consistent = copy.copy(published), True
+        snap.snapshot_gen = gen
+        snap.snapshot_consistent = consistent
+        return snap
 
     def is_proposer(self, address: Optional[bytes] = None) -> bool:
         if address is None:
@@ -214,6 +256,10 @@ class ConsensusState:
     def update_to_state(self, state) -> None:
         """Reset the RoundState for state.last_block_height+1 (reference
         updateToState :471-557)."""
+        with self._mutating():
+            self._update_to_state_inner(state)
+
+    def _update_to_state_inner(self, state) -> None:
         rs = self.rs
         if rs.commit_round > -1 and 0 < rs.height != state.last_block_height:
             raise RuntimeError(
@@ -393,7 +439,30 @@ class ConsensusState:
         finally:
             self._stopped.set()
 
+    @contextmanager
+    def _mutating(self):
+        """Seqlock bracket around one receive-loop processing burst: any
+        RoundState mutation inside is invisible to stamped readers
+        until write_end. Re-entrant on the writer thread (the vote
+        path's tail handling nests). The outermost exit publishes a
+        fresh consistent snapshot for readers that lose the race."""
+        import copy
+
+        self._rs_stamp.write_begin()
+        try:
+            yield
+        finally:
+            self._rs_stamp.write_end()
+            if not self._rs_stamp.is_writer():
+                self._rs_published = (self._rs_stamp.gen,
+                                      copy.copy(self.rs))
+
     def _handle_item(self, item) -> None:
+        # the seqlock bracket covers ONLY the state transition, not the
+        # WAL write (an fsync-scale stall inside the bracket would keep
+        # the generation odd for milliseconds and starve every stamped
+        # reader into torn-skip fallbacks — gossip ticks would mostly
+        # no-op under load)
         kind, payload = item
         if kind == "msg":
             peer_id, msg = payload
@@ -401,11 +470,13 @@ class ConsensusState:
                 self.wal.write_sync((peer_id, msg))  # :604-609
             else:
                 self.wal.write((peer_id, msg))
-            self._handle_msg(msg, peer_id)
+            with self._mutating():
+                self._handle_msg(msg, peer_id)
         elif kind == "timeout":
             ti: TimeoutInfo = payload
             self.wal.write(ti)
-            self._handle_timeout(ti)
+            with self._mutating():
+                self._handle_timeout(ti)
 
     def _handle_vote_msgs(self, items, finish=None) -> None:
         """Apply a drained run of VoteMessages: one batched signature
@@ -416,13 +487,19 @@ class ConsensusState:
         WAL write with the device round trip)."""
         if len(items) == 1:
             peer_id, msg = items[0]
-            self._try_add_vote(msg.vote, peer_id)
+            with self._mutating():
+                self._try_add_vote(msg.vote, peer_id)
             return
         if finish is None:
-            finish = self._preverify_votes_begin([m.vote for _, m in items])
+            finish = self._preverify_votes_begin(
+                [m.vote for _, m in items])
+        # wait for the (device) verification OUTSIDE the bracket: the
+        # round trip is milliseconds and mutates nothing — only the
+        # tally/transition loop below needs tear protection
         mask = finish()
-        for (peer_id, msg), ok in zip(items, mask):
-            self._try_add_vote(msg.vote, peer_id, verified=ok)
+        with self._mutating():
+            for (peer_id, msg), ok in zip(items, mask):
+                self._try_add_vote(msg.vote, peer_id, verified=ok)
 
     def _preverify_votes(self, votes) -> List[bool]:
         """Batch-verify vote signatures against the SAME (valset, chain_id)
@@ -1417,7 +1494,8 @@ class ConsensusState:
         self._replay_mode = True
         try:
             for m in msgs:
-                self._replay_one(m)
+                with self._mutating():
+                    self._replay_one(m)
             LOG.info("WAL replay for height %d done: %d messages", height, len(msgs))
         finally:
             self._replay_mode = False

@@ -7,7 +7,9 @@ has-vote tracking. numpy-backed so large validator sets stay cheap.
 from __future__ import annotations
 
 import secrets
-import threading
+import threading  # noqa: F401 - kept for API parity
+
+from . import lockdep
 
 import numpy as np
 
@@ -18,7 +20,11 @@ class BitArray:
             raise ValueError("negative size")
         self.bits = bits
         self._elems = np.zeros(bits, dtype=bool)
-        self._lock = threading.Lock()
+        # leaf lock (lockdep-exempt): no BitArray critical section
+        # acquires another lock, so it can never close an inversion
+        # cycle — and per-bit ops are the hottest lock traffic in a
+        # gossiping net (see libs/lockdep.leaf_lock)
+        self._lock = lockdep.leaf_lock()
 
     @classmethod
     def from_bools(cls, bools) -> "BitArray":
@@ -123,11 +129,21 @@ class BitArray:
         ba._elems[:] = arr[:bits]
         return ba
 
+    def _snapshot_elems(self):
+        with self._lock:
+            return self._elems.copy()
+
     def __eq__(self, other):
         if not isinstance(other, BitArray):
             return NotImplemented
-        return self.bits == other.bits and bool((self._elems == other._elems).all())
+        if self.bits != other.bits:
+            return False
+        # snapshot each side under its own lock (never both at once —
+        # no ordering to get wrong), then compare the copies
+        return bool(
+            (self._snapshot_elems() == other._snapshot_elems()).all())
 
     def __repr__(self):
-        s = "".join("x" if b else "_" for b in self._elems[:64])
+        with self._lock:
+            s = "".join("x" if b else "_" for b in self._elems[:64])
         return f"BA{{{self.bits}:{s}}}"

@@ -1,0 +1,501 @@
+"""Runtime lock-discipline checker (lockdep) + torn-read detection.
+
+Two independent tools for the threaded stack, both debug-grade:
+
+1. **lockdep proper** — `enable()` monkeypatches `threading.Lock` /
+   `threading.RLock` so every lock *created afterwards* is wrapped with
+   per-thread acquisition-order tracking (the Linux-kernel lockdep
+   idea, scaled down): locks are classed by their creation site
+   (``file.py:line``), every observed nesting "held A, acquired B"
+   records an A→B edge, and the first time the reverse edge of an
+   existing one appears the pair is reported as a **lock-order
+   inversion** — a deadlock that merely hasn't fired yet. Per-site
+   hold-time histograms (``lockdep_hold_seconds{site}``) and the
+   inversion counter (``lockdep_inversions_total``) ride the node's
+   metrics registry when wired via ``set_metrics``; `report()` (served
+   as ``/debug/lockdep`` on the prof server) returns the full edge
+   graph, inversion witnesses, and hold statistics.
+
+   Enabled by ``[instrumentation] lockdep = true`` (node config) or by
+   the scenario runner's ``--lockdep`` flag. Overhead is real (one
+   bookkeeping mutex round-trip per acquire/release — see README
+   "Correctness tooling" for measured numbers); leave it off in
+   production.
+
+2. **GenStamp** — a single-writer seqlock generation stamp for the
+   torn-snapshot problem PR 10 debugged the hard way (see
+   consensus/state.py get_round_state): the writer brackets each
+   mutation burst with ``write_begin()/write_end()`` (generation odd =
+   mutating), and readers use `stamped_read` to take a shallow copy
+   they can *prove* didn't interleave with a transition — or learn
+   that it did, instead of silently acting on a torn
+   (height, round, step).
+
+The static half of this gate is scripts/check_concurrency.py; the
+discipline rules both enforce are numbered CD-1..CD-7 in the README.
+"""
+
+from __future__ import annotations
+
+import threading as _threading
+import time
+import traceback
+from typing import Optional
+
+# the real primitives, captured before any monkeypatching — lockdep's
+# own bookkeeping must never run through a wrapped lock
+_RealLock = _threading.Lock
+_RealRLock = _threading.RLock
+
+
+def leaf_lock():
+    """A lock exempt from lockdep wrapping, for PROVEN-leaf lock
+    classes: ones whose critical sections never acquire another lock
+    (BitArray, the metrics registry). A leaf lock can only ever appear
+    on the ACQUIRED side of an ordering edge, so it cannot close a
+    cycle — exempting it loses zero inversion coverage while removing
+    the wrapper cost from the hottest per-bit/per-sample paths (a
+    4-node in-process net does millions of these ops; wrapping them
+    starves consensus on a throttled box). The static analyzer still
+    enforces guard discipline (CC-GUARD) on fields behind leaf locks;
+    leafness itself is what CC-ORDER's edge builder verifies. Use ONLY
+    with a comment arguing leafness at the call site."""
+    return _RealLock()
+
+
+# --- generation-stamped snapshots (seqlock) ---------------------------
+
+
+class GenStamp:
+    """Single-writer seqlock stamp. The writer thread brackets every
+    mutation burst with write_begin()/write_end() (re-entrant: nested
+    brackets on the writer thread collapse into one); the generation is
+    odd exactly while a mutation is in flight. Readers snapshot with
+    `stamped_read`. CPython's GIL makes the int loads/stores atomic;
+    correctness needs only the single-writer discipline."""
+
+    __slots__ = ("gen", "_writer", "_depth")
+
+    def __init__(self):
+        self.gen = 0
+        self._writer = 0
+        self._depth = 0
+
+    def write_begin(self) -> None:
+        me = _threading.get_ident()
+        if self._writer == me:
+            self._depth += 1
+            return
+        self._writer = me
+        self._depth = 1
+        self.gen += 1
+
+    def write_end(self) -> None:
+        if self._writer != _threading.get_ident():
+            return  # unbalanced end from a non-writer: ignore
+        self._depth -= 1
+        if self._depth <= 0:
+            self.gen += 1
+            self._writer = 0
+            self._depth = 0
+
+    def is_writer(self) -> bool:
+        return self._writer == _threading.get_ident()
+
+
+def stamped_read(stamp: GenStamp, copy_fn, retries: int = 6,
+                 backoff_s: float = 0.0002):
+    """Take a snapshot via copy_fn() that provably did not interleave
+    with a writer mutation burst.
+
+    Returns (snapshot, generation, consistent). `consistent` is True
+    when the generation was even and unchanged across the copy (or the
+    caller IS the writer thread, whose own reads can never tear). After
+    `retries` collisions the last copy is returned with consistent =
+    False — the caller must treat it as diagnostic-only and NEVER feed
+    it to the wire (discipline rule CD-5)."""
+    if stamp.is_writer():
+        return copy_fn(), stamp.gen, True
+    for attempt in range(retries):
+        g1 = stamp.gen
+        if g1 & 1:
+            # first collisions: yield the GIL so a short write burst
+            # can finish; only later attempts pay a real sleep
+            time.sleep(0 if attempt < 2 else backoff_s)
+            continue
+        snap = copy_fn()
+        if stamp.gen == g1:
+            return snap, g1, True
+        time.sleep(0 if attempt < 2 else backoff_s)
+    return copy_fn(), stamp.gen, False
+
+
+# --- lockdep state ----------------------------------------------------
+
+
+class _State:
+    def __init__(self):
+        self.mu = _RealLock()  # guards everything below
+        self.enabled = False
+        self.locks_created = 0
+        # (site_a, site_b) -> {"count": n, "thread": name, "stack": [...]}
+        self.edges: dict = {}
+        # frozenset({a, b}) pairs already reported as inverted
+        self.inverted_pairs: set = set()
+        self.inversions: list = []
+        # per-thread hold dicts {site: [count, total_s, max_s]},
+        # registered once per thread and merged at report() time —
+        # hold accounting must NOT serialize every lock release in the
+        # process through one global mutex (that contention alone can
+        # starve a multi-node in-process net on a throttled CPU)
+        self.thread_holds: list = []
+
+
+_state = _State()
+_tls = _threading.local()
+_metrics = None  # LockdepMetrics-shaped sink (hold_seconds, inversions)
+
+
+def set_metrics(m) -> None:
+    """Install the metrics sink (a LockdepMetrics dataclass or None).
+    Process-global like crypto.batch.set_metrics: the families are
+    registered whether or not lockdep is enabled — declaration presence
+    is the check_metrics contract, samples only flow in debug mode.
+
+    The sink's OWN internal locks are de-instrumented (swapped back to
+    real primitives) if they were created under the patch: recording a
+    hold time for the hold-time histogram's own lock would re-enter
+    that very lock mid-release — the one self-deadlock the wrapper
+    cannot talk its way out of."""
+    global _metrics
+    if m is not None:
+        for sink in (getattr(m, "hold_seconds", None),
+                     getattr(m, "inversions", None)):
+            lk = getattr(sink, "_lock", None)
+            if isinstance(lk, _LockdepBase):
+                sink._lock = lk._inner
+    _metrics = m
+
+
+def get_metrics():
+    return _metrics
+
+
+def _held_stack() -> list:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def _thread_holds() -> dict:
+    h = getattr(_tls, "holds", None)
+    if h is None:
+        h = _tls.holds = {}
+        with _state.mu:
+            _state.thread_holds.append(h)
+    return h
+
+
+def _creation_site(depth: int = 2) -> str:
+    """file.py:line of the frame that called threading.Lock() — the
+    lock-class identity, lockdep-style."""
+    f = None
+    try:
+        import sys
+
+        f = sys._getframe(depth)
+        fn = f.f_code.co_filename
+        # keep the path short but unambiguous: last two components
+        parts = fn.replace("\\", "/").rsplit("/", 2)
+        short = "/".join(parts[-2:]) if len(parts) > 1 else fn
+        return f"{short}:{f.f_lineno}"
+    except Exception:  # noqa: BLE001 - site labels are best-effort
+        return "?"
+    finally:
+        del f
+
+
+def _record_acquired(site: str, obj_id: int) -> None:
+    held = _held_stack()
+    for h_site, h_obj in held:
+        if h_obj == obj_id:
+            # re-entrant acquire of the same RLock: no new ordering info
+            held.append((site, obj_id))
+            return
+    new_edges = []
+    for h_site, _ in held:
+        if h_site != site:
+            new_edges.append((h_site, site))
+    held.append((site, obj_id))
+    if not new_edges:
+        return
+    with _state.mu:
+        for edge in new_edges:
+            rec = _state.edges.get(edge)
+            if rec is not None:
+                rec["count"] += 1
+                continue
+            _state.edges[edge] = {
+                "count": 1,
+                "thread": _threading.current_thread().name,
+                "stack": _short_stack(),
+            }
+            rev = (edge[1], edge[0])
+            pair = frozenset(edge)
+            if rev in _state.edges and pair not in _state.inverted_pairs:
+                _state.inverted_pairs.add(pair)
+                _state.inversions.append({
+                    "locks": sorted(pair),
+                    "first": {"order": list(rev),
+                              "thread": _state.edges[rev]["thread"],
+                              "stack": _state.edges[rev]["stack"]},
+                    "second": {"order": list(edge),
+                               "thread": _state.edges[edge]["thread"],
+                               "stack": _state.edges[edge]["stack"]},
+                })
+                m = _metrics
+                if m is not None:
+                    try:
+                        m.inversions.inc()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+
+def _record_released(site: str, obj_id: int, held_s: Optional[float],
+                     all_levels: bool = False) -> None:
+    held = _held_stack()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][1] == obj_id:
+            del held[i]
+            if not all_levels:
+                break
+    if held_s is None:
+        return
+    holds = _thread_holds()  # lock-free: this thread's own dict
+    rec = holds.get(site)
+    if rec is None:
+        holds[site] = [1, held_s, held_s]
+    else:
+        rec[0] += 1
+        rec[1] += held_s
+        if held_s > rec[2]:
+            rec[2] = held_s
+
+
+def _emit_hold(site: str, held_s: float) -> None:
+    """Metrics emission, AFTER the subject lock's inner release and
+    under the re-entrancy guard — the sample lands through locks of its
+    own and must never loop back into bookkeeping."""
+    m = _metrics
+    if m is None or getattr(_tls, "busy", False):
+        return
+    _tls.busy = True
+    try:
+        m.hold_seconds.with_labels(site).observe(held_s)
+    except Exception:  # noqa: BLE001
+        pass
+    finally:
+        _tls.busy = False
+
+
+def _short_stack(limit: int = 6) -> list:
+    frames = traceback.extract_stack(limit=limit + 3)[:-3]
+    return [f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno}:{fr.name}"
+            for fr in frames[-limit:]
+            if "lockdep" not in fr.filename]
+
+
+class _LockdepBase:
+    """Common wrapper over a real Lock/RLock. Bookkeeping is skipped
+    re-entrantly (a metrics observe during release may itself acquire a
+    wrapped lock) and entirely when lockdep has been disabled since the
+    lock was created — the wrapper then degrades to plain delegation."""
+
+    __slots__ = ("_inner", "_site", "_t0", "_depth")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+        self._t0 = 0.0
+        self._depth = 0
+
+    # -- the lock protocol --------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _state.enabled and not getattr(_tls, "busy", False):
+            _tls.busy = True
+            try:
+                if self._depth == 0:
+                    self._t0 = time.perf_counter()
+                self._depth += 1
+                _record_acquired(self._site, id(self))
+            finally:
+                _tls.busy = False
+        elif ok:
+            self._depth += 1
+        return ok
+
+    def release(self):
+        held_s = None
+        if not getattr(_tls, "busy", False):
+            # pop the held-stack entry even when lockdep has been
+            # DISABLED since the acquire: a thread mid-critical-section
+            # at disable() time would otherwise leave a phantom entry
+            # that fabricates edges (and false inversions) after the
+            # next enable(). Stats/metrics only record while enabled.
+            _tls.busy = True
+            try:
+                self._depth -= 1
+                if _state.enabled and self._depth == 0:
+                    held_s = time.perf_counter() - self._t0
+                _record_released(self._site, id(self), held_s)
+            finally:
+                _tls.busy = False
+        else:
+            self._depth -= 1
+        self._inner.release()
+        if held_s is not None:
+            _emit_hold(self._site, held_s)
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<lockdep {self._inner!r} site={self._site}>"
+
+
+class LockdepLock(_LockdepBase):
+    __slots__ = ()
+
+
+class LockdepRLock(_LockdepBase):
+    __slots__ = ()
+
+    # threading.Condition fast paths — delegate to the real RLock but
+    # keep our held-stack/hold-time bookkeeping balanced, or a
+    # cond.wait() would leave a phantom "held" entry behind
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        depth = self._depth
+        if _state.enabled and not getattr(_tls, "busy", False):
+            _tls.busy = True
+            try:
+                held_s = time.perf_counter() - self._t0 if depth else None
+                _record_released(self._site, id(self), held_s,
+                                 all_levels=True)
+            finally:
+                _tls.busy = False
+        self._depth = 0
+        return depth, self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        depth, inner_state = state
+        self._inner._acquire_restore(inner_state)
+        self._t0 = time.perf_counter()
+        self._depth = depth
+        if _state.enabled and not getattr(_tls, "busy", False):
+            _tls.busy = True
+            try:
+                _record_acquired(self._site, id(self))
+            finally:
+                _tls.busy = False
+
+
+def _make_lock():
+    with _state.mu:
+        _state.locks_created += 1
+    return LockdepLock(_RealLock(), _creation_site())
+
+
+def _make_rlock():
+    with _state.mu:
+        _state.locks_created += 1
+    return LockdepRLock(_RealRLock(), _creation_site())
+
+
+# --- enable / disable / report ---------------------------------------
+
+
+def enable(metrics=None) -> bool:
+    """Patch threading.Lock/RLock so locks created from now on are
+    wrapped. Returns True if THIS call enabled it (first-enabler owns
+    the global, tracing-style); False if already on."""
+    with _state.mu:
+        if _state.enabled:
+            return False
+        _state.enabled = True
+    if metrics is not None:
+        set_metrics(metrics)
+    _threading.Lock = _make_lock
+    _threading.RLock = _make_rlock
+    return True
+
+
+def disable() -> None:
+    """Restore the real primitives. Wrapped locks already handed out
+    keep working (plain delegation once enabled is False)."""
+    _threading.Lock = _RealLock
+    _threading.RLock = _RealRLock
+    with _state.mu:
+        _state.enabled = False
+
+
+def is_enabled() -> bool:
+    return _state.enabled
+
+
+def reset() -> None:
+    """Clear accumulated edges/inversions/holds (not the enabled flag)."""
+    with _state.mu:
+        _state.edges.clear()
+        _state.inverted_pairs.clear()
+        _state.inversions.clear()
+        for h in _state.thread_holds:
+            h.clear()  # in place: live threads keep their registered dict
+        _state.thread_holds = [h for h in _state.thread_holds if h]
+        _state.locks_created = 0
+
+
+def inversion_count() -> int:
+    with _state.mu:
+        return len(_state.inversions)
+
+
+def report() -> dict:
+    """The /debug/lockdep bundle: acquisition graph, inversion
+    witnesses, per-site hold stats."""
+    with _state.mu:
+        edges = [{"from": a, "to": b, "count": rec["count"],
+                  "thread": rec["thread"]}
+                 for (a, b), rec in sorted(_state.edges.items())]
+        inversions = [dict(i) for i in _state.inversions]
+        merged: dict = {}
+        for h in _state.thread_holds:
+            for site, (c, t, mx) in list(h.items()):
+                rec = merged.get(site)
+                if rec is None:
+                    merged[site] = [c, t, mx]
+                else:
+                    rec[0] += c
+                    rec[1] += t
+                    if mx > rec[2]:
+                        rec[2] = mx
+        holds = {site: {"count": c, "total_s": round(t, 6),
+                        "max_s": round(mx, 6)}
+                 for site, (c, t, mx) in sorted(merged.items())}
+        return {
+            "enabled": _state.enabled,
+            "locks_created": _state.locks_created,
+            "edges": edges,
+            "inversions": inversions,
+            "holds": holds,
+        }

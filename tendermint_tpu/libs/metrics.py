@@ -12,6 +12,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from . import lockdep
+
 
 def _esc_label(v) -> str:
     """Prometheus label-value escaping: backslash, quote, and newline —
@@ -35,7 +37,10 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        # leaf lock (lockdep-exempt): no metric critical section
+        # acquires another lock, and every instrumented hot path
+        # observes through one — see libs/lockdep.leaf_lock
+        self._lock = lockdep.leaf_lock()
 
     def with_labels(self, *values: str) -> "_Metric":
         if len(values) != len(self.label_names):
@@ -219,7 +224,9 @@ class Histogram(_Metric):
 class Registry:
     def __init__(self):
         self._metrics: List[_Metric] = []
-        self._lock = threading.Lock()
+        # leaf: held only to copy the metric list; child renders and
+        # prunes run after release
+        self._lock = lockdep.leaf_lock()
 
     def register(self, metric: _Metric) -> _Metric:
         with self._lock:

@@ -51,10 +51,16 @@ class BaseService:
             return self._started and not self._stopped
 
     def wait(self, timeout: float | None = None) -> bool:
-        return self._quit.wait(timeout)
+        # fetch under the lifecycle lock: restart() swaps in a fresh
+        # Event, and waiting on the pre-swap object would miss the next
+        # stop() forever (checker finding CC-GUARD:BaseService._quit)
+        with self._lifecycle_lock:
+            quit_ev = self._quit
+        return quit_ev.wait(timeout)
 
     def quit_event(self) -> threading.Event:
-        return self._quit
+        with self._lifecycle_lock:
+            return self._quit
 
     def on_start(self) -> None:  # override
         pass

@@ -87,7 +87,8 @@ class Timeline:
 
     @property
     def capacity(self) -> int:
-        return self._capacity
+        with self._lock:
+            return self._capacity
 
     def enable(self, capacity: Optional[int] = None) -> None:
         with self._lock:
@@ -98,7 +99,8 @@ class Timeline:
             self._enabled = True
 
     def disable(self) -> None:
-        self._enabled = False
+        with self._lock:
+            self._enabled = False
 
     def clear(self) -> None:
         with self._lock:

@@ -118,7 +118,8 @@ class Tracer:
 
     @property
     def capacity(self) -> int:
-        return self._buf.maxlen or 0
+        with self._lock:
+            return self._buf.maxlen or 0
 
     def enable(self, capacity: Optional[int] = None) -> None:
         with self._lock:

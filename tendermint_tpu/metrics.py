@@ -249,6 +249,20 @@ class RPCMetrics:
 
 
 @dataclass
+class LockdepMetrics:
+    """Runtime lock-discipline telemetry (libs/lockdep.py; no reference
+    equivalent). Families are registered unconditionally — declaration
+    presence is the check_metrics contract — but record samples only
+    while [instrumentation] lockdep is on."""
+
+    # wall time a lock was held, by creation site (file.py:line)
+    hold_seconds: object = NOP
+    # distinct lock-order inversions (A->B observed after B->A) —
+    # latent deadlocks; the chaos-under-lockdep oracle requires zero
+    inversions: object = NOP
+
+
+@dataclass
 class StateMetrics:
     """state/metrics.go:10-22 (+ the churn families, ours: EndBlock
     validator-update batches applied by update_state — the first-class
@@ -271,6 +285,7 @@ class NodeMetrics:
     crypto: CryptoMetrics = field(default_factory=CryptoMetrics)
     statesync: StateSyncMetrics = field(default_factory=StateSyncMetrics)
     rpc: RPCMetrics = field(default_factory=RPCMetrics)
+    lockdep: LockdepMetrics = field(default_factory=LockdepMetrics)
     registry: Optional[Registry] = None
 
 
@@ -567,6 +582,20 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Events rendered to wire bytes (once per event under "
             "render-once fan-out, regardless of subscriber count)."),
     )
+    lockdep = LockdepMetrics(
+        hold_seconds=r.histogram(
+            f"{ns}_lockdep_hold_seconds",
+            "Wall time locks were held, by creation site (records only "
+            "under [instrumentation] lockdep).",
+            ("site",),
+            buckets=(0.000001, 0.00001, 0.0001, 0.001, 0.01, 0.1, 1,
+                     10)),
+        inversions=r.counter(
+            f"{ns}_lockdep_inversions_total",
+            "Distinct lock-order inversions observed at runtime "
+            "(latent deadlocks; records only under [instrumentation] "
+            "lockdep)."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
-                       rpc=rpc, registry=r)
+                       rpc=rpc, lockdep=lockdep, registry=r)

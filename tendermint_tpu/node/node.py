@@ -204,6 +204,19 @@ class Node:
             # it already on leaves it alone in stop() too
             self._enabled_tracing = not tracer.enabled
             tracer.enable(config.instrumentation.tracing_buffer_size)
+        # runtime lock-discipline checker ([instrumentation] lockdep):
+        # enabled HERE, before any subsystem constructs its locks, so
+        # the whole threaded stack below gets wrapped primitives. Same
+        # first-enabler-owns contract as the tracer; the metrics sink is
+        # process-global like crypto_batch's (families declared either
+        # way, samples only in debug mode).
+        from ..libs import lockdep
+
+        self._enabled_lockdep = False
+        if config.instrumentation.lockdep:
+            self._enabled_lockdep = lockdep.enable()
+        if config.instrumentation.prometheus:
+            lockdep.set_metrics(self.metrics.lockdep)
 
         # --- storage (node/node.go:162-171) --------------------------
         self.block_store_db = db_provider("blockstore", backend, db_dir)
@@ -747,6 +760,7 @@ class Node:
                 "/debug/mempool": lambda q: self.mempool.status(),
                 "/debug/crypto": lambda q: self._crypto_status(),
                 "/debug/rpc": lambda q: self._rpc_status(),
+                "/debug/lockdep": lambda q: self._lockdep_status(),
             },
         )
         self._prof_server.start()
@@ -786,6 +800,13 @@ class Node:
         out["coalesce"] = crypto_batch.coalesce_status()
         out["inflight_batches"] = crypto_batch.inflight_count()
         return out
+
+    def _lockdep_status(self) -> dict:
+        """/debug/lockdep: the acquisition graph, inversion witnesses,
+        and per-site hold stats (empty shells when the mode is off)."""
+        from ..libs import lockdep
+
+        return lockdep.report()
 
     def _statesync_status(self) -> dict:
         """The /debug/statesync bundle: serve-side snapshot inventory +
@@ -834,6 +855,12 @@ class Node:
             from ..libs import tracing
 
             tracing.get_tracer().disable()
+        from ..libs import lockdep
+
+        if self._enabled_lockdep:
+            lockdep.disable()
+        if lockdep.get_metrics() is self.metrics.lockdep:
+            lockdep.set_metrics(None)
         self.sw.stop()
         if self._chaos_installed:
             # only the installer tears the process-wide controller down

@@ -121,7 +121,7 @@ class AddrBook:
             self._our_ids.add(node_id.lower())
             self._our_addrs.add(addr)
 
-    def is_our_address(self, nid: str, addr: str) -> bool:
+    def _is_our_address_locked(self, nid: str, addr: str) -> bool:
         return nid.lower() in self._our_ids or addr in self._our_addrs
 
     # -- bucket math (addrbook.go:754-791) -----------------------------
@@ -138,14 +138,14 @@ class AddrBook:
             return f"{parts[0]}.{parts[1]}".encode()
         return host.encode() or b"unroutable"
 
-    def _calc_new_bucket(self, addr: str, src_addr: str) -> int:
+    def _calc_new_bucket_locked(self, addr: str, src_addr: str) -> int:
         h1 = int.from_bytes(
             _dsha(self._hash_key + self._group(addr) + self._group(src_addr))[:8],
             "big") % NEW_BUCKETS_PER_GROUP
         h2 = _dsha(self._hash_key + self._group(src_addr) + h1.to_bytes(8, "big"))
         return int.from_bytes(h2[:8], "big") % NEW_BUCKET_COUNT
 
-    def _calc_old_bucket(self, net_addr: str) -> int:
+    def _calc_old_bucket_locked(self, net_addr: str) -> int:
         h1 = int.from_bytes(
             _dsha(self._hash_key + net_addr.encode())[:8],
             "big") % OLD_BUCKETS_PER_GROUP
@@ -171,7 +171,7 @@ class AddrBook:
         if (not nid or ":" not in addr) and self.strict:
             return False
         with self._lock:
-            if self.is_our_address(nid, addr):
+            if self._is_our_address_locked(nid, addr):
                 return False
             key = self._key(nid, addr)
             ka = self._addrs.get(key)
@@ -189,11 +189,11 @@ class AddrBook:
                     id=nid, addr=addr, src=src_id or nid or addr,
                     src_addr=src_addr,
                 )
-            idx = self._calc_new_bucket(addr, src_addr or src_id or addr)
-            self._add_to_new_bucket(ka, idx)
+            idx = self._calc_new_bucket_locked(addr, src_addr or src_id or addr)
+            self._add_to_new_bucket_locked(ka, idx)
             return True
 
-    def _add_to_new_bucket(self, ka: KnownAddress, idx: int) -> None:
+    def _add_to_new_bucket_locked(self, ka: KnownAddress, idx: int) -> None:
         """addrbook.go addToNewBucket:526-556."""
         bucket = self._new[idx]
         akey = self._key(ka.id, ka.addr)
@@ -218,9 +218,9 @@ class AddrBook:
                 break
         if victim is None:
             victim = min(bucket.values(), key=lambda a: a.last_attempt)
-        self._remove_from_bucket(victim, idx)
+        self._remove_from_bucket_locked(victim, idx)
 
-    def _remove_from_bucket(self, ka: KnownAddress, idx: int) -> None:
+    def _remove_from_bucket_locked(self, ka: KnownAddress, idx: int) -> None:
         akey = self._key(ka.id, ka.addr)
         self._new[idx].pop(akey, None)
         if idx in ka.buckets:
@@ -228,7 +228,7 @@ class AddrBook:
         if not ka.buckets and ka.bucket_type == "new":
             self._addrs.pop(akey, None)
 
-    def _remove_from_all_buckets(self, ka: KnownAddress) -> None:
+    def _remove_from_all_buckets_locked(self, ka: KnownAddress) -> None:
         akey = self._key(ka.id, ka.addr)
         for idx in list(ka.buckets):
             if ka.bucket_type == "new":
@@ -243,7 +243,7 @@ class AddrBook:
         with self._lock:
             ka = self._addrs.get(self._key(nid, addr))
             if ka is not None:
-                self._remove_from_all_buckets(ka)
+                self._remove_from_all_buckets_locked(ka)
 
     def mark_attempt(self, addr_str: str) -> None:
         nid, addr = parse_net_address(addr_str)
@@ -269,15 +269,15 @@ class AddrBook:
             ka.last_attempt = time.time()
             if ka.bucket_type == "old":
                 return
-            self._move_to_old(ka)
+            self._move_to_old_locked(ka)
 
-    def _move_to_old(self, ka: KnownAddress) -> None:
+    def _move_to_old_locked(self, ka: KnownAddress) -> None:
         akey = self._key(ka.id, ka.addr)
         for idx in list(ka.buckets):
             self._new[idx].pop(akey, None)
         ka.buckets = []
         ka.bucket_type = "old"
-        idx = self._calc_old_bucket(ka.net_addr)
+        idx = self._calc_old_bucket_locked(ka.net_addr)
         bucket = self._old[idx]
         if len(bucket) >= OLD_BUCKET_SIZE:
             # demote the oldest old entry back to a new bucket
@@ -286,9 +286,9 @@ class AddrBook:
             bucket.pop(dkey, None)
             demoted.buckets = []
             demoted.bucket_type = "new"
-            self._add_to_new_bucket(
+            self._add_to_new_bucket_locked(
                 demoted,
-                self._calc_new_bucket(demoted.addr,
+                self._calc_new_bucket_locked(demoted.addr,
                                       demoted.src_addr or demoted.src),
             )
         bucket[akey] = ka
@@ -406,12 +406,12 @@ class AddrBook:
                 self._addrs[akey] = ka
                 idxs = o.get("buckets") or []
                 if ka.bucket_type == "old":
-                    for idx in idxs[:1] or [self._calc_old_bucket(ka.net_addr)]:
+                    for idx in idxs[:1] or [self._calc_old_bucket_locked(ka.net_addr)]:
                         self._old[idx % OLD_BUCKET_COUNT][akey] = ka
                         ka.buckets = [idx % OLD_BUCKET_COUNT]
                 else:
                     if not idxs:
-                        idxs = [self._calc_new_bucket(ka.addr, ka.src_addr or ka.src)]
+                        idxs = [self._calc_new_bucket_locked(ka.addr, ka.src_addr or ka.src)]
                     for idx in idxs:
                         self._new[idx % NEW_BUCKET_COUNT][akey] = ka
                         if idx % NEW_BUCKET_COUNT not in ka.buckets:

@@ -290,7 +290,9 @@ class Switch:
     def num_peers(self):
         out = sum(1 for p in self.peers.list() if p.outbound)
         inb = self.peers.size() - out
-        return out, inb, len(self.dialing)
+        with self._lock:
+            dialing = len(self.dialing)
+        return out, inb, dialing
 
     # -- peer removal --------------------------------------------------
 

@@ -46,13 +46,13 @@ class TrustMetric:
 
     def good_events(self, n: int = 1, now: Optional[float] = None) -> None:
         with self._lock:
-            self._maybe_roll(now)
+            self._maybe_roll_locked(now)
             self._good += n
             self.paused = False
 
     def bad_events(self, n: int = 1, now: Optional[float] = None) -> None:
         with self._lock:
-            self._maybe_roll(now)
+            self._maybe_roll_locked(now)
             self._bad += n
             self.paused = False
 
@@ -63,17 +63,17 @@ class TrustMetric:
 
     # -- interval roll (metric.go NextTimeInterval) --------------------
 
-    def _current_r(self) -> float:
+    def _current_r_locked(self) -> float:
         total = self._good + self._bad
         return self._good / total if total > 0 else 1.0
 
-    def _maybe_roll(self, now: Optional[float]) -> None:
+    def _maybe_roll_locked(self, now: Optional[float]) -> None:
         now = now if now is not None else time.time()
         if self.paused:
             self._last_roll = now
             return
         while now - self._last_roll >= self.interval:
-            self._history.append(self._current_r())
+            self._history.append(self._current_r_locked())
             if len(self._history) > self.max_intervals:
                 self._history.pop(0)
             # weighted history value: newer intervals weigh more
@@ -91,8 +91,8 @@ class TrustMetric:
 
     def trust_value(self, now: Optional[float] = None) -> float:
         with self._lock:
-            self._maybe_roll(now)
-            r = self._current_r()
+            self._maybe_roll_locked(now)
+            r = self._current_r_locked()
             i = self._history_value
             v = r * PROPORTIONAL_WEIGHT + i * INTEGRAL_WEIGHT
             # derivative penalty only when behavior is degrading
@@ -114,7 +114,8 @@ class TrustMetricStore:
         self._metrics: Dict[str, TrustMetric] = {}
         self._lock = threading.Lock()
         if db is not None:
-            self._load()
+            with self._lock:
+                self._load_locked()
 
     def get_metric(self, peer_id: str) -> TrustMetric:
         with self._lock:
@@ -147,7 +148,7 @@ class TrustMetricStore:
             }
         self.db.set_sync(self._KEY, json.dumps(out).encode())
 
-    def _load(self) -> None:
+    def _load_locked(self) -> None:
         raw = self.db.get(self._KEY)
         if not raw:
             return

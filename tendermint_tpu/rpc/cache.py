@@ -156,18 +156,24 @@ class RPCCache:
             return self._bytes
 
     def stats(self) -> dict:
+        # one consistent snapshot: hits/misses/evictions/generation are
+        # all written under the lock by the serving threads, so reading
+        # them bare here could pair a fresh hit count with a stale total
+        # (checker finding CC-GUARD:rpc/cache.py:RPCCache.*)
         with self._lock:
             n = len(self._lru)
             b = self._bytes
-        total = self.hits + self.misses
+            hits, misses = self.hits, self.misses
+            generation, evictions = self.generation, self.evictions
+        total = hits + misses
         return {
             "enabled": self.enabled,
             "max_bytes": self.max_bytes,
             "bytes": b,
             "entries": n,
-            "generation": self.generation,
-            "hits": self.hits,
-            "misses": self.misses,
-            "hit_rate": round(self.hits / total, 4) if total else 0.0,
-            "evictions": self.evictions,
+            "generation": generation,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+            "evictions": evictions,
         }

@@ -403,7 +403,10 @@ def _require_consensus(env: RPCEnvironment):
 
 
 def dump_consensus_state(env: RPCEnvironment, params: dict) -> dict:
-    rs = _require_consensus(env).rs
+    # stamped snapshot, not a live .rs reference: this runs on an RPC
+    # worker thread. Diagnostics tolerate a torn read, but report the
+    # stamp so an operator (or test) can tell.
+    rs = _require_consensus(env).get_round_state()
     peers = []
     for p in env.p2p_switch.peers.list():
         ps = p.get("consensus_peer_state")
@@ -421,12 +424,15 @@ def dump_consensus_state(env: RPCEnvironment, params: dict) -> dict:
             ),
         })
     return {"round_state": _round_state_json(rs, full=True),
+            "snapshot_gen": getattr(rs, "snapshot_gen", None),
+            "snapshot_consistent": getattr(rs, "snapshot_consistent", True),
             "peers": peers}
 
 
 def consensus_state(env: RPCEnvironment, params: dict) -> dict:
-    return {"round_state": _round_state_json(_require_consensus(env).rs,
-                                             full=False)}
+    rs = _require_consensus(env).get_round_state()
+    return {"round_state": _round_state_json(rs, full=False),
+            "snapshot_consistent": getattr(rs, "snapshot_consistent", True)}
 
 
 def _round_state_json(rs, full: bool) -> dict:

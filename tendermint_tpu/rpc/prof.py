@@ -13,8 +13,11 @@ Serves:
                            tracer spans tagged height=N)
 - plus any `providers` routes the node mounts: /debug/consensus (the
   stall watchdog's diagnostic bundle), /debug/statesync (snapshot
-  inventory, chunk counters, and live restore progress) and /debug/abci
-  (per-connection ResilientClient state: health, reconnects, last error)
+  inventory, chunk counters, and live restore progress), /debug/abci
+  (per-connection ResilientClient state: health, reconnects, last
+  error) and /debug/lockdep (libs/lockdep.py acquisition graph,
+  lock-order-inversion witnesses, and per-site hold stats when
+  [instrumentation] lockdep is on)
 """
 
 from __future__ import annotations

@@ -187,3 +187,8 @@ class IndexerService(BaseService):
 
     def on_stop(self) -> None:
         self.event_bus.unsubscribe_all(self.SUBSCRIBER)
+        # _quit was set by BaseService.stop() before this hook runs;
+        # join so no tx-indexer thread outlives its service
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)

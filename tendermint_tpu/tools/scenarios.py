@@ -589,13 +589,63 @@ def statesync_join_under_churn(seed: int = 6, tmp_root: str = "") -> dict:
 # --- entry points -----------------------------------------------------
 
 
-def run(name: str, seed: Optional[int] = None, **kw) -> dict:
+def run(name: str, seed: Optional[int] = None,
+        lockdep_on: bool = False, **kw) -> dict:
+    """Run one scenario. With lockdep_on the whole run executes under
+    the runtime lock-discipline checker (libs/lockdep.py): every lock
+    the localnet creates is wrapped, and the result gains a "lockdep"
+    section — the acceptance oracle is ZERO lock-order inversions
+    across the chaos run, so any inversion flips ok to False."""
     if name not in SCENARIOS:
         raise ValueError(
             f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})")
     if seed is not None:
         kw["seed"] = seed
-    return SCENARIOS[name](**kw)
+    if not lockdep_on:
+        return SCENARIOS[name](**kw)
+
+    from ..libs import lockdep
+
+    # wrapped locks tax every remaining non-leaf acquire (~5µs/op, see
+    # README): give the localnet proportionally more wall clock — the
+    # budgets exist for the box, and lockdep slows the box uniformly.
+    # The ORACLE (zero inversions, converged, safety_ok) is unchanged.
+    global WARM_TIMEOUT, CONVERGE_TIMEOUT
+    try:
+        factor = max(1.0, float(
+            os.environ.get("TM_TPU_LOCKDEP_BUDGET_FACTOR", "3")))
+    except ValueError:
+        factor = 3.0
+    saved = (WARM_TIMEOUT, CONVERGE_TIMEOUT)
+    WARM_TIMEOUT, CONVERGE_TIMEOUT = (saved[0] * factor,
+                                      saved[1] * factor)
+    owned = lockdep.enable()
+    if owned:
+        # enable() does not clear state a prior enable/disable cycle
+        # left behind — start this scenario's ledger from zero
+        lockdep.reset()
+    # not-owned (lockdep already on for the process): judge only the
+    # inversions THIS scenario adds, not foreign history
+    inv_before = lockdep.inversion_count()
+    try:
+        res = SCENARIOS[name](**kw)
+    finally:
+        WARM_TIMEOUT, CONVERGE_TIMEOUT = saved
+        rep = lockdep.report()
+        if owned:
+            lockdep.disable()
+            lockdep.reset()
+    new_inversions = rep["inversions"][inv_before:]
+    res["lockdep"] = {
+        "locks_created": rep["locks_created"],
+        "edges": len(rep["edges"]),
+        "hold_sites": len(rep["holds"]),
+        "inversions": len(new_inversions),
+        "inversion_detail": new_inversions,
+    }
+    if new_inversions:
+        res["ok"] = False
+    return res
 
 
 def main(argv=None) -> int:
@@ -603,11 +653,14 @@ def main(argv=None) -> int:
         prog="scenarios", description="chaos/churn scenario runner")
     p.add_argument("name", help="scenario name, or 'all'")
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--lockdep", action="store_true",
+                   help="run under the runtime lock-discipline checker;"
+                        " any lock-order inversion fails the scenario")
     args = p.parse_args(argv)
     names = sorted(SCENARIOS) if args.name == "all" else [args.name]
     rc = 0
     for name in names:
-        res = run(name, seed=args.seed)
+        res = run(name, seed=args.seed, lockdep_on=args.lockdep)
         print(json.dumps(res, default=str))
         if not res.get("ok"):
             rc = 1

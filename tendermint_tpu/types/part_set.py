@@ -95,4 +95,5 @@ class PartSet:
     def assemble(self) -> bytes:
         if not self.is_complete():
             raise ValueError("part set incomplete")
-        return b"".join(p.bytes for p in self._parts)
+        with self._lock:
+            return b"".join(p.bytes for p in self._parts)

@@ -84,14 +84,14 @@ class VoteSet:
         with self._lock:
             self._precheck(vote)
             _, val = self.val_set.get_by_index(vote.validator_index)
-            conflict = self._conflict_check(vote)
+            conflict = self._conflict_check_locked(vote)
             if conflict == "dup":
                 return False
             if not verified and not vote.verify(self.chain_id, val.pub_key):
                 raise ErrVoteInvalid(f"invalid signature on {vote}")
             if conflict is not None:
                 raise ErrVoteConflictingVotes(conflict, vote)
-            self._add_verified(vote, val.voting_power)
+            self._add_verified_locked(vote, val.voting_power)
             return True
 
     def add_votes(self, votes: List[Vote]) -> List[bool]:
@@ -122,7 +122,7 @@ class VoteSet:
                         first_invalid = vote
                     added.append(False)
                     continue
-                conflict = self._conflict_check(vote)
+                conflict = self._conflict_check_locked(vote)
                 if conflict == "dup":
                     added.append(False)
                     continue
@@ -131,7 +131,7 @@ class VoteSet:
                         first_conflict = (conflict, vote)
                     added.append(False)
                     continue
-                self._add_verified(vote, val.voting_power)
+                self._add_verified_locked(vote, val.voting_power)
                 added.append(True)
             if first_conflict is not None:
                 raise ErrVoteConflictingVotes(first_conflict[0], first_conflict[1])
@@ -166,7 +166,7 @@ class VoteSet:
                 f"BLS-lane precommit carries timestamp {vote.timestamp} "
                 "!= 0 (aggregate sign-bytes invariant)")
 
-    def _conflict_check(self, vote: Vote):
+    def _conflict_check_locked(self, vote: Vote):
         """Returns None (new), "dup" (same again), or the existing
         conflicting Vote."""
         existing = self.votes[vote.validator_index]
@@ -177,7 +177,7 @@ class VoteSet:
             return "dup"
         return existing
 
-    def _add_verified(self, vote: Vote, power: int) -> None:
+    def _add_verified_locked(self, vote: Vote, power: int) -> None:
         idx = vote.validator_index
         self.votes[idx] = vote
         # a certificate may already have claimed this bit (aggregate
@@ -453,10 +453,12 @@ class VoteSet:
             return Commit(block_id=self.maj23, precommits=precommits)
 
     def __str__(self):
-        return (
-            f"VoteSet{{h:{self.height}/{self.round}/{self.type} "
-            f"{self.votes_bit_array.num_true()}/{len(self.val_set)} sum:{self.sum} maj23:{self.maj23}}}"
-        )
+        with self._lock:
+            return (
+                f"VoteSet{{h:{self.height}/{self.round}/{self.type} "
+                f"{self.votes_bit_array.num_true()}/{len(self.val_set)} "
+                f"sum:{self.sum} maj23:{self.maj23}}}"
+            )
 
 
 class _BlockVotes:

@@ -44,26 +44,82 @@ if "TM_TPU_COMPILE_CACHE" not in os.environ:
 
 import pytest
 
+# Named thread families a test must not leak (PR-11 generalization of
+# the crypto-dispatch check): each prefix is a worker family with an
+# owning stop()/shutdown path, so anything still alive after teardown
+# means a lifecycle bug — exactly what check_concurrency's CC-THREAD
+# rule enforces statically. Families whose teardown is asynchronous get
+# a short grace join before the assert so shutdown races don't flake.
+_THREAD_FAMILIES = (
+    "crypto-dispatch",    # per-backend verify dispatchers
+    "crypto-coalesce",    # cross-height coalescing scheduler
+    "mempool-ingest",     # batched CheckTx ingest worker
+    "ws-writer",          # per-client websocket writer (PR-9 fan-out)
+    "rpc-cache-inval",    # RPC response-cache invalidation drainer
+    "cs-watchdog",        # consensus stall watchdog ticker
+    "replica-telemetry",  # replica-mode telemetry ticker
+    "lockdep",            # lockdep reporter/debug threads (PR-11)
+    "tx-indexer",         # indexer service drainer (joined on stop)
+)
 
-@pytest.fixture(autouse=True)
-def _crypto_async_hygiene():
-    """Async-dispatch hygiene after every test: the per-backend
-    crypto-dispatch threads must join cleanly (shutdown drains queued
-    futures first — a hung or leaked thread fails the test), and the
-    process-wide sig cache / async flag are reset so tests stay
-    isolated."""
-    yield
+# Daemons allowed to outlive a test: process-wide singletons that are
+# deliberately not per-test (none today — add entries HERE with a
+# reason, not by widening the family list).
+_KNOWN_DAEMON_ALLOWLIST: frozenset = frozenset()
+
+
+def _leaked_family_threads():
     import threading
 
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive()
+        and t.name not in _KNOWN_DAEMON_ALLOWLIST
+        and any(t.name.startswith(p) for p in _THREAD_FAMILIES)
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _thread_hygiene():
+    """Thread + process-global hygiene after every test: no NEW thread
+    from ANY named worker family may outlive the test that created it
+    (grace-joined first so in-flight shutdowns can finish), the crypto
+    dispatch/cache globals are reset, and lockdep never stays patched
+    into threading. Delta-based on purpose: module-scoped node
+    fixtures (test_rpc_fanout's fanout_node and friends) legitimately
+    keep their worker families alive across the module — those threads
+    are in the baseline, so only threads the TEST spawned and lost can
+    fail it."""
+    # strong refs to the Thread OBJECTS, not idents: CPython reuses
+    # idents after a thread exits, which could mask a leaked thread
+    # that recycled a baseline ident; holding the objects pins their
+    # identity for the test's duration
+    baseline = set(_leaked_family_threads())
+    yield
+    import time
+
     from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.libs import lockdep
 
     crypto_batch.set_coalesce(window_ms=0)
     crypto_batch.shutdown_dispatchers()
     crypto_batch.set_sig_cache(None)
     crypto_batch.set_async_enabled(True)
-    leaked = [
-        t for t in threading.enumerate()
-        if (t.name.startswith("crypto-dispatch")
-            or t.name.startswith("crypto-coalesce")) and t.is_alive()
-    ]
-    assert not leaked, f"leaked crypto dispatch threads: {leaked}"
+    # a test that enabled lockdep and failed before disable() would
+    # leave threading.Lock patched for every later test
+    if lockdep.is_enabled():
+        lockdep.disable()
+        lockdep.reset()
+
+    def new_leaks():
+        return [t for t in _leaked_family_threads()
+                if t not in baseline]
+
+    leaked = new_leaks()
+    deadline = time.monotonic() + 2.0
+    while leaked and time.monotonic() < deadline:
+        for t in leaked:
+            t.join(timeout=0.2)
+        leaked = new_leaks()
+    assert not leaked, (
+        f"leaked worker threads (family list in conftest): {leaked}")

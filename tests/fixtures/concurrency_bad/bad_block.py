@@ -1,0 +1,33 @@
+"""Seeded CC-BLOCK violations: sleeping, waiting on a queue, and
+running a pairing-shaped verification while holding a lock (the PR-7
+absorb_certificate bug shape). Parsed only, never imported."""
+
+import queue
+import threading
+import time
+
+
+class SleepyCache:
+    def __init__(self, backend):
+        self._lock = threading.Lock()
+        self.backend = backend
+        self.data = {}
+        self.queue = queue.Queue(maxsize=4)
+
+    def refresh(self, key):
+        with self._lock:
+            time.sleep(0.5)  # blocking while holding the lock
+            self.data[key] = self.backend.fetch(key)
+
+    def drain_one(self):
+        with self._lock:
+            item = self.queue.get(timeout=1.0)  # queue wait under lock
+            self.data[item.key] = item
+
+    def absorb(self, cert):
+        with self._lock:
+            # ~90ms pairing under the tally lock: every reader stalls
+            if not cert.fast_aggregate_verify(self.data):
+                return False
+            self.data[cert.key] = cert
+            return True

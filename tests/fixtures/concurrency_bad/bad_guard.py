@@ -1,0 +1,25 @@
+"""Seeded CC-GUARD violation: _counter and _items are written under
+self._lock in add() but accessed bare in total()/drain(). Never
+imported — parsed by check_concurrency tests only."""
+
+import threading
+
+
+class LeakyCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._items = []
+
+    def add(self, n):
+        with self._lock:
+            self._counter += n
+            self._items.append(n)
+
+    def total(self):
+        return self._counter  # bare read of a guarded field
+
+    def drain(self):
+        out = list(self._items)  # bare read
+        self._items = []         # bare write
+        return out

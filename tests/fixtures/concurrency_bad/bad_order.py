@@ -1,0 +1,50 @@
+"""Seeded CC-ORDER violations: (1) a two-class lock-order cycle —
+Ledger.transfer holds self._lock then calls Auditor.observe (which
+takes ITS lock), while Auditor.reconcile holds its lock and calls
+Ledger.balance (which takes Ledger's) — and (2) nested re-entry of a
+non-reentrant Lock. Parsed only, never imported."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self, auditor):
+        self._lock = threading.Lock()
+        self.auditor = auditor
+        self.entries = {}
+
+    def transfer(self, a, b, amount):
+        with self._lock:
+            self.entries[a] = self.entries.get(a, 0) - amount
+            self.entries[b] = self.entries.get(b, 0) + amount
+            self.auditor.observe(a, b, amount)  # Ledger -> Auditor
+
+    def balance(self, a):
+        with self._lock:
+            return self.entries.get(a, 0)
+
+
+class Auditor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ledger = None
+        self.seen = []
+
+    def observe(self, a, b, amount):
+        with self._lock:
+            self.seen.append((a, b, amount))
+
+    def reconcile(self, a):
+        with self._lock:
+            return self.ledger.balance(a)  # Auditor -> Ledger
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump_twice(self):
+        with self._lock:
+            with self._lock:  # plain Lock re-entry: guaranteed deadlock
+                self.n += 2

@@ -1,0 +1,22 @@
+"""Seeded CC-THREAD violations: a class that spawns a worker thread
+with no stop()/shutdown()/close() path at all, and a module function
+that fires a thread and forgets it. Parsed only, never imported."""
+
+import threading
+import time
+
+
+class Orphanage:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            time.sleep(1)
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return True

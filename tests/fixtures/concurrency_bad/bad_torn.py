@@ -1,0 +1,25 @@
+"""Seeded CC-TORN violation: the PR-10 tearing idiom — a periodic
+thread re-reads RoundState via get_round_state() and broadcasts bytes
+built from the (possibly torn) copy, with no snapshot_consistent
+check. Parsed only, never imported."""
+
+
+def encode(obj):
+    return bytes(obj)
+
+
+class StepAnnouncer:
+    STATE_CHANNEL = 0x20
+
+    def __init__(self, cs, switch):
+        self.cs = cs
+        self.switch = switch
+
+    def announce_once(self):
+        rs = self.cs.get_round_state()
+        msg = {"height": rs.height, "round": rs.round, "step": rs.step}
+        self.switch.broadcast(self.STATE_CHANNEL, encode(msg))
+
+    def greet_peer(self, peer):
+        rs = self.cs.get_round_state()
+        peer.send(self.STATE_CHANNEL, encode((rs.height, rs.step)))

@@ -1,0 +1,77 @@
+"""Clean corpus: the same shapes as the bad corpus written under the
+discipline rules — every guarded access under the lock, caller-holds
+helpers named *_locked, one lock order, no blocking under locks, a
+joined worker thread, and a gated torn-snapshot send. The checker must
+report NOTHING here. Parsed only, never imported."""
+
+import queue
+import threading
+import time
+
+
+class DisciplinedCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._items = []
+
+    def add(self, n):
+        with self._lock:
+            self._counter += n
+            self._items.append(n)
+            self._note_locked(n)
+
+    def _note_locked(self, n):
+        self._items.append(-n)
+
+    def total(self):
+        with self._lock:
+            return self._counter
+
+    def drain(self):
+        with self._lock:
+            out = list(self._items)
+            self._items = []
+            return out
+
+
+class DisciplinedWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.inbox = queue.Queue(maxsize=4)
+        self.data = {}
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                item = self.inbox.get(timeout=0.2)  # no lock held here
+            except queue.Empty:
+                continue
+            with self._lock:
+                self.data[item[0]] = item[1]
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+class GatedAnnouncer:
+    STATE_CHANNEL = 0x20
+
+    def __init__(self, cs, switch):
+        self.cs = cs
+        self.switch = switch
+
+    def announce_once(self):
+        rs = self.cs.get_round_state()
+        if not getattr(rs, "snapshot_consistent", True):
+            return  # torn snapshot: never feed it to the wire (CD-5)
+        self.switch.broadcast(self.STATE_CHANNEL,
+                              bytes((rs.height, rs.round, rs.step)))
+
+
+def sleep_outside_locks():
+    time.sleep(0.01)

@@ -1,0 +1,201 @@
+"""scripts/check_concurrency.py as a tier-1 guard (the static half of
+the PR-11 concurrency gate, wired like check_metrics): the analyzer
+must hold the tree at zero unsuppressed findings, flag every seeded
+violation in the bad corpus, stay silent on the disciplined corpus,
+and keep its allowlist honest (justifications required, stale entries
+surfaced).
+
+The fixes this gate locked in (each erased a real finding key):
+  CC-GUARD:...:RPCCache.{hits,misses,generation,evictions} — stats()
+    now snapshots the counters under the lock
+  CC-GUARD:...:BaseService._quit — wait()/quit_event() fetch the event
+    under the lifecycle lock (restart() swaps it)
+  CC-GUARD:...:BitArray._elems — __eq__/__repr__ compare/print locked
+    snapshots
+  CC-GUARD:...:VoteSet.* — caller-holds helpers renamed *_locked,
+    __str__ locks
+  CC-GUARD:...:{AddrBook,TrustMetric,TrustMetricStore}.* — caller-holds
+    helpers renamed *_locked
+  CC-GUARD:...:Switch.dialing / Timeline._capacity / Tracer._buf /
+    PartSet._parts — diagnostic readers take the lock
+  CC-THREAD:...:IndexerService.on_start — on_stop joins the tx-indexer
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_concurrency as cc
+
+BAD = os.path.join(REPO, "tests", "fixtures", "concurrency_bad")
+CLEAN = os.path.join(REPO, "tests", "fixtures", "concurrency_clean")
+
+
+def _run(paths, allowlist=None):
+    return cc.run_check(paths, REPO, allowlist or {})
+
+
+def test_tree_is_clean_under_allowlist():
+    """The gate: zero unsuppressed findings on tendermint_tpu/, every
+    suppression justified, nothing stale, and the scan stays far under
+    the ~10s budget the tier-1 slack allows."""
+    allow = cc.load_allowlist(cc.DEFAULT_ALLOWLIST)
+    assert allow, "allowlist should exist and be non-empty"
+    import time
+
+    t0 = time.time()
+    findings, summary = _run([os.path.join(REPO, "tendermint_tpu")], allow)
+    elapsed = time.time() - t0
+    unsup = [f.key for f in findings if f.suppressed_by is None]
+    assert unsup == [], f"unsuppressed findings: {unsup}"
+    assert summary["stale_allowlist"] == [], (
+        "allowlist entries with no matching finding — remove them: "
+        f"{summary['stale_allowlist']}")
+    assert summary["parse_errors"] == []
+    assert elapsed < 10.0, f"checker took {elapsed:.1f}s (budget ~10s)"
+
+
+def test_fixed_finding_keys_stay_fixed():
+    """The true positives this PR fixed must not resurface: their keys
+    must be absent from a fresh scan (they are fixed in code, NOT
+    allowlisted)."""
+    findings, _ = _run([os.path.join(REPO, "tendermint_tpu")])
+    keys = {f.key for f in findings}
+    for fixed in (
+        "CC-GUARD:tendermint_tpu/rpc/cache.py:RPCCache.hits",
+        "CC-GUARD:tendermint_tpu/rpc/cache.py:RPCCache.misses",
+        "CC-GUARD:tendermint_tpu/rpc/cache.py:RPCCache.generation",
+        "CC-GUARD:tendermint_tpu/rpc/cache.py:RPCCache.evictions",
+        "CC-GUARD:tendermint_tpu/libs/service.py:BaseService._quit",
+        "CC-GUARD:tendermint_tpu/libs/bit_array.py:BitArray._elems",
+        "CC-GUARD:tendermint_tpu/types/vote_set.py:VoteSet.sum",
+        "CC-GUARD:tendermint_tpu/types/vote_set.py:VoteSet.maj23",
+        "CC-GUARD:tendermint_tpu/types/part_set.py:PartSet._parts",
+        "CC-GUARD:tendermint_tpu/p2p/switch.py:Switch.dialing",
+        "CC-GUARD:tendermint_tpu/p2p/pex.py:AddrBook._addrs",
+        "CC-GUARD:tendermint_tpu/p2p/trust.py:TrustMetric._good",
+        "CC-GUARD:tendermint_tpu/libs/timeline.py:Timeline._capacity",
+        "CC-GUARD:tendermint_tpu/libs/tracing.py:Tracer._buf",
+        "CC-THREAD:tendermint_tpu/state/txindex.py:IndexerService"
+        ".on_start",
+    ):
+        assert fixed not in keys, f"fixed finding resurfaced: {fixed}"
+
+
+def test_bad_corpus_flags_every_rule():
+    findings, summary = _run([BAD])
+    assert summary["parse_errors"] == []
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.key)
+    assert set(by_rule) == {"CC-GUARD", "CC-ORDER", "CC-BLOCK",
+                            "CC-THREAD", "CC-TORN"}, by_rule
+    # the specific seeded shapes, by key
+    keys = {f.key for f in findings}
+    assert ("CC-GUARD:tests/fixtures/concurrency_bad/bad_guard.py:"
+            "LeakyCounter._counter") in keys
+    assert "CC-ORDER:cycle:Auditor._lock|Ledger._lock" in keys
+    assert ("CC-ORDER:tests/fixtures/concurrency_bad/bad_order.py:"
+            "SelfDeadlock.bump_twice:reentry._lock") in keys
+    assert ("CC-THREAD:tests/fixtures/concurrency_bad/bad_thread.py:"
+            "Orphanage.__init__") in keys
+    assert ("CC-THREAD:tests/fixtures/concurrency_bad/bad_thread.py:"
+            "fire_and_forget") in keys
+    assert ("CC-BLOCK:tests/fixtures/concurrency_bad/bad_block.py:"
+            "SleepyCache.refresh:time.sleep") in keys
+    assert ("CC-BLOCK:tests/fixtures/concurrency_bad/bad_block.py:"
+            "SleepyCache.absorb:BLS fast_aggregate_verify") in keys
+    # both torn shapes: direct send and taint through a local
+    assert ("CC-TORN:tests/fixtures/concurrency_bad/bad_torn.py:"
+            "StepAnnouncer.greet_peer") in keys
+    assert ("CC-TORN:tests/fixtures/concurrency_bad/bad_torn.py:"
+            "StepAnnouncer.announce_once") in keys
+
+
+def test_clean_corpus_is_silent():
+    findings, summary = _run([CLEAN])
+    assert summary["parse_errors"] == []
+    assert findings == [], [f.key for f in findings]
+
+
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "allow.json"
+    p.write_text(json.dumps(
+        {"entries": [{"key": "CC-GUARD:x:Y.z", "justification": ""}]}))
+    with pytest.raises(ValueError, match="no justification"):
+        cc.load_allowlist(str(p))
+    p.write_text(json.dumps({"entries": [{"justification": "why"}]}))
+    with pytest.raises(ValueError, match="no key"):
+        cc.load_allowlist(str(p))
+
+
+def test_stale_allowlist_entries_are_reported():
+    findings, summary = _run(
+        [CLEAN], {"CC-GUARD:nonexistent:Thing.field": "stale reason"})
+    assert summary["stale_allowlist"] == [
+        "CC-GUARD:nonexistent:Thing.field"]
+
+
+def test_json_baseline_mode():
+    """--json mirrors check_metrics' CI wiring: machine-readable
+    findings + summary, exit 1 while unsuppressed findings exist."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_concurrency.py"),
+         "--json", "--allowlist", "", BAD],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["summary"]["unsuppressed"] == doc["summary"]["findings"] > 0
+    rules = {f["rule"] for f in doc["findings"]}
+    assert rules == {"CC-GUARD", "CC-ORDER", "CC-BLOCK", "CC-THREAD",
+                     "CC-TORN"}
+
+
+def test_cli_clean_tree_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_concurrency.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_fix_rpc_cache_stats_snapshot():
+    """Behavioral pin for CC-GUARD:rpc/cache.py:RPCCache.*: stats()
+    returns an internally consistent snapshot (hit_rate computed from
+    the same hits/misses it reports)."""
+    from tendermint_tpu.rpc.cache import RPCCache
+
+    c = RPCCache(max_bytes=1 << 16)
+    s = c.stats()
+    total = s["hits"] + s["misses"]
+    assert s["hit_rate"] == (round(s["hits"] / total, 4) if total else 0.0)
+
+
+def test_fix_service_quit_event_tracks_restart():
+    """Behavioral pin for CC-GUARD:libs/service.py:BaseService._quit:
+    after a stop/start cycle, wait() must observe the CURRENT quit
+    event, not the pre-restart one."""
+    from tendermint_tpu.libs.service import BaseService
+
+    class S(BaseService):
+        def __init__(self):
+            super().__init__("s")
+
+    s = S()
+    s.start()
+    first = s.quit_event()
+    s.stop()
+    s.reset()  # swaps in a fresh _quit
+    s.start()
+    assert s.quit_event() is not first
+    assert s.wait(timeout=0.01) is False  # new event is unset
+    s.stop()
+    assert s.wait(timeout=1.0) is True

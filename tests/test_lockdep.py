@@ -1,0 +1,478 @@
+"""libs/lockdep.py — the runtime half of the PR-11 concurrency gate:
+lock-order-inversion detection over wrapped threading primitives,
+hold-time accounting, the GenStamp seqlock, and the torn-snapshot
+gates the consensus reactor adopted (regression per fixed
+get_round_state() call site). The slow section runs the
+partition_heal + churn_storm chaos scenarios under lockdep = the
+acceptance oracle (zero inversions across a real multi-node run).
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.libs import lockdep
+
+
+@pytest.fixture()
+def lockdep_on():
+    assert lockdep.enable(), "lockdep was already enabled (leak?)"
+    yield
+    lockdep.disable()
+    lockdep.reset()
+    lockdep.set_metrics(None)
+
+
+# --- lockdep proper ---------------------------------------------------
+
+
+def _run_in_thread(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive()
+
+
+def test_inversion_detected(lockdep_on):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    _run_in_thread(ab)
+    _run_in_thread(ba)
+    rep = lockdep.report()
+    assert lockdep.inversion_count() == 1
+    inv = rep["inversions"][0]
+    assert len(inv["locks"]) == 2
+    assert inv["first"]["order"] == list(reversed(inv["second"]["order"]))
+
+
+def test_consistent_order_is_clean(lockdep_on):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    for _ in range(3):
+        _run_in_thread(ab)
+    assert lockdep.inversion_count() == 0
+    rep = lockdep.report()
+    assert len(rep["edges"]) == 1
+    assert rep["edges"][0]["count"] == 3
+
+
+def test_hold_times_flow_to_metrics(lockdep_on):
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("t")
+    lockdep.set_metrics(m.lockdep)
+    lk = threading.Lock()
+    with lk:
+        time.sleep(0.01)
+    body = m.registry.render()
+    assert "t_lockdep_hold_seconds_count" in body
+    # the inversion counter records too
+    a = threading.Lock()
+    b = threading.Lock()
+    _run_in_thread(lambda: a.acquire() and b.acquire())
+
+    def rev():
+        with b:
+            with a:
+                pass
+
+    a.release()
+    b.release()
+    _run_in_thread(rev)
+    assert "t_lockdep_inversions_total 1" in m.registry.render()
+
+
+def test_rlock_condition_wait_keeps_books_balanced(lockdep_on):
+    rl = threading.RLock()
+    cv = threading.Condition(rl)
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5.0)
+            woke.append(1)
+        assert not lockdep._held_stack()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cv:
+        cv.notify()
+    t.join(5.0)
+    assert woke == [1]
+    # reentrant acquire on this thread balances too
+    with rl:
+        with rl:
+            pass
+    assert not lockdep._held_stack()
+
+
+def test_disable_restores_primitives():
+    assert lockdep.enable()
+    try:
+        assert threading.Lock is not lockdep._RealLock
+    finally:
+        lockdep.disable()
+        lockdep.reset()
+    assert threading.Lock is lockdep._RealLock
+    assert threading.RLock is lockdep._RealRLock
+
+
+# --- GenStamp / stamped_read -----------------------------------------
+
+
+def test_genstamp_reader_detects_mid_write():
+    st = lockdep.GenStamp()
+    st.write_begin()
+    out = []
+    _run_in_thread(lambda: out.append(
+        lockdep.stamped_read(st, lambda: 1, retries=2, backoff_s=0.001)))
+    snap, gen, ok = out[0]
+    assert ok is False
+    # the writer's own read never spins and is always consistent
+    assert lockdep.stamped_read(st, lambda: 2)[2] is True
+    st.write_end()
+    out2 = []
+    _run_in_thread(lambda: out2.append(
+        lockdep.stamped_read(st, lambda: 3)))
+    assert out2[0] == (3, 2, True)
+
+
+def test_genstamp_nested_brackets():
+    st = lockdep.GenStamp()
+    st.write_begin()
+    st.write_begin()
+    st.write_end()
+    assert st.gen % 2 == 1  # still mutating
+    st.write_end()
+    assert st.gen % 2 == 0
+
+
+def test_genstamp_generation_change_detected():
+    """A write that lands BETWEEN the reader's two fence reads forces a
+    retry; the reader converges once the writer is quiet."""
+    st = lockdep.GenStamp()
+    calls = []
+
+    def copy_fn():
+        calls.append(1)
+        if len(calls) == 1:
+            # interleave one full write burst inside the first copy
+            def burst():
+                st.write_begin()
+                st.write_end()
+            _run_in_thread(burst)
+        return len(calls)
+
+    out = []
+    _run_in_thread(lambda: out.append(lockdep.stamped_read(st, copy_fn)))
+    snap, gen, ok = out[0]
+    assert ok is True and snap >= 2  # first copy was discarded
+
+
+# --- consensus adoption: stamped get_round_state ----------------------
+
+
+def _make_cs():
+    """A ConsensusState-shaped stub carrying the real GenStamp +
+    get_round_state implementation against a real RoundState."""
+    from tendermint_tpu.consensus.cstypes import RoundState
+    from tendermint_tpu.consensus.state import ConsensusState
+
+    cs = SimpleNamespace(rs=RoundState(), _rs_stamp=lockdep.GenStamp(),
+                         _rs_published=None)
+    cs.rs.height = 7
+    cs.get_round_state = (
+        lambda: ConsensusState.get_round_state(cs))
+    return cs
+
+
+def test_get_round_state_is_stamped():
+    cs = _make_cs()
+    rs = cs.get_round_state()
+    assert rs.snapshot_consistent is True
+    assert rs.snapshot_gen == 0
+    assert rs.height == 7
+    # a reader during a mutation burst gets a flagged snapshot
+    cs._rs_stamp.write_begin()
+    out = []
+    _run_in_thread(lambda: out.append(cs.get_round_state()))
+    assert out[0].snapshot_consistent is False
+    cs._rs_stamp.write_end()
+    out2 = []
+    _run_in_thread(lambda: out2.append(cs.get_round_state()))
+    assert out2[0].snapshot_consistent is True
+
+
+# --- regression per fixed torn-read call site (satellite 1) -----------
+
+
+class _FakePeer:
+    def __init__(self):
+        self.id = "ab" * 20
+        self.sent = []
+        self._kv = {}
+
+    def send(self, ch, b):
+        self.sent.append((ch, bytes(b)))
+        return True
+
+    def try_send(self, ch, b):
+        self.sent.append((ch, bytes(b)))
+        return True
+
+    def is_running(self):
+        return False  # keeps add_peer's gossip threads from looping
+
+    def get(self, k):
+        return self._kv.get(k)
+
+    def set(self, k, v):
+        self._kv[k] = v
+
+
+class _ExplodingVotes:
+    """HeightVoteSet stand-in that fails the test if a gated path
+    touches it from a torn snapshot."""
+
+    def __getattr__(self, item):
+        raise AssertionError(
+            "votes accessed from a torn RoundState snapshot")
+
+
+def _torn_reactor():
+    """ConsensusReactor over a cs stub whose get_round_state always
+    returns an INCONSISTENT snapshot (mid-transition forever)."""
+    from tendermint_tpu.consensus.cstypes import RoundState
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+
+    rs = RoundState()
+    rs.height = 5
+    rs.votes = _ExplodingVotes()
+    rs.snapshot_gen = 1
+    rs.snapshot_consistent = False
+    cs = SimpleNamespace(rs=rs, get_round_state=lambda: rs, config=None)
+    return ConsensusReactor(cs), cs
+
+
+def test_gossip_data_once_skips_torn_snapshot():
+    """Fixed site: ConsensusReactor._gossip_data_once (wire sends of
+    proposals/block parts built from rs)."""
+    from tendermint_tpu.consensus.reactor import PeerState
+
+    reactor, _ = _torn_reactor()
+    peer = _FakePeer()
+    ps = PeerState(peer)
+    assert reactor._gossip_data_once(peer, ps) is False
+    assert peer.sent == []
+
+
+def test_gossip_votes_once_skips_torn_snapshot():
+    """Fixed site: ConsensusReactor._gossip_votes_once (VoteMessage /
+    aggregate-certificate sends built from rs)."""
+    from tendermint_tpu.consensus.reactor import PeerState
+
+    reactor, _ = _torn_reactor()
+    peer = _FakePeer()
+    ps = PeerState(peer)
+    assert reactor._gossip_votes_once(peer, ps) is False
+    assert peer.sent == []
+
+
+def test_vote_set_maj23_reply_gated_on_torn_snapshot():
+    """Fixed site: ConsensusReactor._handle_vote_set_maj23 (VoteSetBits
+    wire reply): a torn snapshot must produce NO reply and must not
+    touch rs.votes."""
+    from tendermint_tpu.consensus.messages import VoteSetMaj23Message
+    from tendermint_tpu.consensus.reactor import PeerState
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PREVOTE,
+        BlockID,
+    )
+
+    reactor, cs = _torn_reactor()
+    peer = _FakePeer()
+    ps = PeerState(peer)
+    msg = VoteSetMaj23Message(height=5, round=0, type=VOTE_TYPE_PREVOTE,
+                              block_id=BlockID())
+    reactor._handle_vote_set_maj23(peer, ps, msg)  # must not raise
+    assert peer.sent == []
+
+
+def test_add_peer_falls_back_to_cached_step_bytes():
+    """Fixed site: ConsensusReactor.add_peer — on a torn snapshot the
+    greeting falls back to the last receive-thread-built broadcast
+    bytes instead of encoding the torn rs."""
+    from tendermint_tpu.consensus.reactor import (
+        STATE_CHANNEL,
+        PeerState,
+    )
+
+    reactor, _ = _torn_reactor()
+    reactor._last_step_bcast = b"cached-step-bytes"
+    peer = _FakePeer()
+    peer.set("consensus_peer_state", PeerState(peer))
+    reactor.add_peer(peer)
+    assert peer.sent == [(STATE_CHANNEL, b"cached-step-bytes")]
+    # without cached bytes: stay quiet rather than send torn state
+    reactor2, _ = _torn_reactor()
+    peer2 = _FakePeer()
+    peer2.set("consensus_peer_state", PeerState(peer2))
+    reactor2.add_peer(peer2)
+    assert peer2.sent == []
+
+
+def test_dump_consensus_state_reports_stamp():
+    """Fixed site: rpc/core.py dump_consensus_state now serves a
+    stamped snapshot and reports snapshot_gen/snapshot_consistent."""
+    from tendermint_tpu.rpc import core as rpc_core
+
+    cs = _make_cs()
+    env = SimpleNamespace(
+        consensus_state=cs,
+        p2p_switch=SimpleNamespace(
+            peers=SimpleNamespace(list=lambda: [])),
+    )
+    out = rpc_core.dump_consensus_state(env, {})
+    assert out["snapshot_consistent"] is True
+    assert out["snapshot_gen"] == 0
+    out2 = rpc_core.consensus_state(env, {})
+    assert out2["snapshot_consistent"] is True
+
+
+# --- node wiring ------------------------------------------------------
+
+
+def test_node_lockdep_status_shape():
+    """/debug/lockdep provider returns the report bundle (empty shells
+    when the mode is off)."""
+    rep = lockdep.report()
+    assert set(rep) == {"enabled", "locks_created", "edges",
+                       "inversions", "holds"}
+    assert rep["enabled"] is False
+
+
+def test_config_knob_round_trips(tmp_path):
+    from tendermint_tpu import config as cfg
+
+    c = cfg.test_config()
+    assert c.instrumentation.lockdep is False
+    c.instrumentation.lockdep = True
+    c.save(str(tmp_path / "config.toml"))
+    c2 = cfg.Config.load(str(tmp_path / "config.toml"))
+    assert c2.instrumentation.lockdep is True
+
+
+def test_node_boot_with_lockdep_serves_debug_endpoint():
+    """[instrumentation] lockdep = true end to end: a single-validator
+    node boots with wrapped locks, commits blocks, serves the
+    /debug/lockdep bundle on prof_laddr with hold sites and zero
+    inversions, exposes lockdep_* metric samples, and restores the
+    real primitives on stop."""
+    import json
+    import os
+    import tempfile
+    import urllib.request
+
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    with tempfile.TemporaryDirectory(prefix="lockdep_e2e_") as root:
+        c = cfg.test_config()
+        c.set_root(root)
+        c.base.proxy_app = "kvstore"
+        c.rpc.laddr = ""
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.base.prof_laddr = "tcp://127.0.0.1:0"
+        c.consensus.wal_path = "data/cs.wal/wal"
+        c.instrumentation.prometheus = True
+        c.instrumentation.prometheus_listen_addr = "127.0.0.1:0"
+        c.instrumentation.lockdep = True
+        cfg.ensure_root(root)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        pv = load_or_gen_file_pv(c.base.priv_validator_path())
+        GenesisDoc(
+            chain_id="lockdep-chain",
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        ).save(c.base.genesis_path())
+
+        node = default_new_node(c)
+        node.start()
+        try:
+            deadline = time.time() + 60
+            while node.block_store.height() < 2 and time.time() < deadline:
+                time.sleep(0.2)
+            assert node.block_store.height() >= 2
+            addr = node._prof_server.listen_addr
+            with urllib.request.urlopen(
+                    f"http://{addr}/debug/lockdep", timeout=10) as resp:
+                rep = json.loads(resp.read())
+            assert rep["enabled"] is True
+            assert rep["locks_created"] > 0
+            assert rep["holds"], "no hold sites recorded"
+            assert rep["inversions"] == [], rep["inversions"]
+            maddr = node._metrics_server.listen_addr
+            with urllib.request.urlopen(
+                    f"http://{maddr}/metrics", timeout=10) as resp:
+                body = resp.read().decode()
+            assert "lockdep_hold_seconds_count" in body
+        finally:
+            node.stop()
+        assert threading.Lock is lockdep._RealLock
+        assert not lockdep.is_enabled()
+
+
+# --- chaos scenarios under lockdep (the acceptance oracle) ------------
+
+
+@pytest.mark.slow
+def test_partition_heal_under_lockdep():
+    """partition_heal completes under [instrumentation]-style lockdep
+    with ZERO lock-order inversions across the whole 4-node run — the
+    PR-11 acceptance oracle (multi-node, slow: runs standalone like the
+    other scenario e2es, never in tier-1)."""
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("partition_heal", seed=1, lockdep_on=True)
+    assert res["lockdep"]["inversions"] == 0, \
+        res["lockdep"]["inversion_detail"]
+    assert res["lockdep"]["locks_created"] > 0
+    assert res["ok"], res
+
+
+@pytest.mark.slow
+def test_churn_storm_under_lockdep():
+    """churn_storm (rotation epochs + disconnect storms) under lockdep:
+    zero inversions while the valset rewrites and peers churn."""
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("churn_storm", seed=4, lockdep_on=True)
+    assert res["lockdep"]["inversions"] == 0, \
+        res["lockdep"]["inversion_detail"]
+    assert res["ok"], res

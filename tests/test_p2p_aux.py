@@ -70,7 +70,7 @@ def test_trust_store_persistence():
     store = TrustMetricStore(db=db, interval=10.0)
     m = store.get_metric("peer1")
     m.good_events(5, now=0.0)
-    m._maybe_roll(now=20.0)
+    m._maybe_roll_locked(now=20.0)
     store.save()
 
     store2 = TrustMetricStore(db=db, interval=10.0)
