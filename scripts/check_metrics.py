@@ -275,6 +275,13 @@ REQUIRED_FAMILIES = (
     # scenarios are where these families go live)
     "lockdep_hold_seconds",
     "lockdep_inversions_total",
+    # PR-12 parallel block execution (declaration presence: with the
+    # default [execution] serial config, lanes reads 1 and the conflict/
+    # speculation counters legitimately never record)
+    "exec_parallel_lanes",
+    "exec_conflicts_total",
+    "exec_speculation_hits_total",
+    "exec_speculation_wasted_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -26,10 +26,18 @@ from .server import MAX_MSG_SIZE
 # this list (adding an ABCI method = add it here + a Client method)
 METHODS = (
     "echo", "flush", "info", "set_option", "query", "check_tx",
-    "init_chain", "begin_block", "deliver_tx", "end_block", "commit",
+    "init_chain", "begin_block", "deliver_tx", "deliver_tx_batch",
+    "end_block", "commit",
     "list_snapshots", "load_snapshot_chunk", "offer_snapshot",
     "apply_snapshot_chunk",
 )
+
+# max DeliverTx request frames written ahead of the response drain by
+# SocketClient.deliver_tx_batch — bounds both the per-request deadline
+# skew (a frame's clock starts at its WRITE, so the window is how far a
+# write may precede its response read) and the server-side response
+# bytes parked in TCP buffers
+DELIVER_TX_WINDOW = 64
 
 
 class ABCIClientError(Exception):
@@ -91,6 +99,14 @@ class Client:
 
     def deliver_tx(self, tx: bytes) -> abci.ResponseDeliverTx:
         raise NotImplementedError
+
+    def deliver_tx_batch(self, txs) -> list:
+        """DeliverTx for a whole block's txs, in order. The base
+        implementation is the plain serial loop; transports that can
+        pipeline (SocketClient) override it to batch-write request
+        frames before draining responses. Responses are positionally
+        matched and semantically identical to the per-tx loop."""
+        return [self.deliver_tx(tx) for tx in txs]
 
     def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
         raise NotImplementedError
@@ -302,6 +318,85 @@ class SocketClient(Client):
 
     def deliver_tx(self, tx):
         return RESPONSE_CODECS["deliver_tx"].decode(self._call("deliver_tx", tx))
+
+    def deliver_tx_batch(self, txs):
+        """Pipelined DeliverTx: write up to DELIVER_TX_WINDOW request
+        frames ahead of the response drain, so block execution pays one
+        socket round trip per WINDOW instead of per tx (the server
+        reads frames sequentially off the stream either way). Deadline
+        semantics match the per-call path: each request's absolute
+        clock starts when its frame is WRITTEN, so a response that
+        fails to arrive within request_timeout of its own send still
+        trips ABCITimeoutError and breaks the conn."""
+        txs = list(txs)
+        out = []
+        codec = RESPONSE_CODECS["deliver_tx"]
+        with self._lock:
+            if self._broken:
+                raise ABCIConnectionError(
+                    f"connection to {self.address} is broken (earlier "
+                    f"timeout/error); redial required")
+            deadlines = []  # parallel to the in-flight window
+            sent = 0
+            try:
+                while len(out) < len(txs):
+                    while sent < len(txs) \
+                            and sent - len(out) < DELIVER_TX_WINDOW:
+                        if self.request_timeout > 0:
+                            # re-arm the FULL budget for this frame's
+                            # send: _recv_exact leaves the remaining
+                            # budget of the previous response armed,
+                            # and a send blocked on a full TCP buffer
+                            # must be judged by its own clock (which
+                            # starts at this write), not a near-expired
+                            # leftover
+                            self._sock.settimeout(self.request_timeout)
+                        frame = msgpack.packb(
+                            ["deliver_tx", txs[sent]], use_bin_type=True)
+                        self._sock.sendall(
+                            struct.pack(">I", len(frame)) + frame)
+                        deadlines.append(
+                            time_monotonic() + self.request_timeout
+                            if self.request_timeout > 0 else None)
+                        sent += 1
+                    deadline = deadlines[len(out)]
+                    hdr = self._recv_exact(4, deadline)
+                    (n,) = struct.unpack(">I", hdr)
+                    if n > MAX_MSG_SIZE:
+                        raise ABCIConnectionError(
+                            f"response frame too large: {n}")
+                    data = self._recv_exact(n, deadline)
+                    try:
+                        kind, body = msgpack.unpackb(data, raw=False)
+                    except Exception:
+                        self._broken = True
+                        raise ABCIConnectionError(
+                            "undecodable response frame for 'deliver_tx'")
+                    if kind == "exception":
+                        # the app raised: the conn is desynchronized for
+                        # the frames already written past this response
+                        self._broken = True
+                        raise ABCIClientError(f"app exception: {body}")
+                    if kind != "deliver_tx":
+                        self._broken = True
+                        raise ABCIConnectionError(
+                            f"response {kind!r} for request 'deliver_tx'")
+                    out.append(codec.decode(body))
+            except socket.timeout:
+                self._broken = True
+                self.close()
+                raise ABCITimeoutError(
+                    f"ABCI deliver_tx (batched) exceeded request_timeout_s="
+                    f"{self.request_timeout:g} to {self.address}")
+            except ABCIConnectionError:
+                self._broken = True
+                raise
+            except ABCIClientError:
+                raise
+            except OSError as e:
+                self._broken = True
+                raise ABCIConnectionError(f"ABCI deliver_tx batch failed: {e}")
+        return out
 
     def end_block(self, req):
         return RESPONSE_CODECS["end_block"].decode(

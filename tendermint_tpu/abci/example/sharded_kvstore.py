@@ -1,0 +1,508 @@
+"""ShardedKVStoreApplication — the parallel-execution workload app.
+
+A ChurnKVStore-style kvstore whose state access is routed through a
+key-sharded, multi-versioned overlay so the node's parallel block
+executor (state/parallel.py) can run footprint-disjoint tx groups
+CONCURRENTLY and still produce byte-identical results to a serial
+replay:
+
+- **Overlay sessions** (`exec_open` .. `exec_promote`/`exec_discard`):
+  during an optimistic block attempt every db write is buffered as a
+  (tx index, value) version in one of `shards` independent stripes
+  (per-stripe locks — disjoint key sets never contend) instead of
+  touching the base db. Reads resolve MVCC-style: the highest version
+  below the reader's own tx index, else the base db. Nothing is
+  visible outside the session until `exec_promote` applies the final
+  version of every key in block order — which is also what makes
+  SPECULATIVE execution safe: a discarded session leaves zero trace.
+- **Access journaling**: per-tx read/write key sets the executor uses
+  for optimistic conflict detection (a tx that touched keys outside
+  its declared footprint is caught, not trusted).
+- **Workload knobs** (proxy address
+  ``sharded_kvstore:shards=16,io_us=0,epoch=1,frac=0.5,pool=0,seed=0``):
+  `io_us` simulates per-tx backend latency (storage/RPC waits — the
+  GIL-free stall parallel lanes actually overlap); the churn knobs are
+  inherited from ChurnKVStoreApplication (pool=0 keeps rotation inert).
+
+Tx format: the payload of a signed envelope (mempool/preverify.py v1
+or v2), or the raw bytes for a plain tx. Forms:
+
+- ``key=value``   write (the kvstore classic)
+- ``inc:K``       read-modify-write counter (order-sensitive)
+- ``cp:SRC:DST``  copy SRC's value to DST (read + write, cross-key)
+- ``ind:P:V``     indirect write: read pointer P, write V under the KEY
+                  P's value names (write target depends on a read — the
+                  adversarial shape for conflict detection)
+- ``val:pkhex!p`` validator update (PersistentKVStore semantics)
+
+`infer_footprint(payload)` maps a payload to its db-key footprint so
+even plain (unhinted) txs of these shapes can be partitioned; `val:`
+txs return None (global — they must serialize).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...libs.db import DB
+from .. import types as abci
+from .kvstore import ChurnKVStoreApplication
+
+_TOMBSTONE = object()  # overlay version value for a delete
+
+# sentinel tx indices for the non-tx phases of a block: begin_block's
+# writes sit below every tx, end_block's above every tx
+BEGIN_IDX = -1
+
+
+class _Stripe:
+    """One overlay shard: versions for the keys that hash here."""
+
+    __slots__ = ("lock", "versions")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # key -> [(idx, value|_TOMBSTONE)], kept sorted by idx
+        self.versions: Dict[bytes, List[Tuple[int, object]]] = {}
+
+
+class ExecSession:
+    """One optimistic block attempt: buffered writes + access journal.
+
+    Created by `exec_open`, driven by the executor through
+    `exec_begin_block`/`exec_deliver_tx`/`exec_end_block`, and closed
+    by exactly one of `exec_promote` (apply in block order) or
+    `exec_discard` (drop without trace)."""
+
+    def __init__(self, app: "ShardedKVStoreApplication", n_txs: int,
+                 shards: int):
+        self.app = app
+        self.n_txs = n_txs
+        self.end_idx = n_txs
+        self.base: DB = app.base_db()
+        self.stripes = [_Stripe() for _ in range(max(1, shards))]
+        self._journal_lock = threading.Lock()
+        # per-idx access journal (sentinel phases included, though only
+        # real tx indices take part in conflict detection)
+        self.reads: Dict[int, set] = {}
+        self.writes: Dict[int, set] = {}
+        # per-idx buffered scalar-attr deltas ({"size": +1, ...})
+        self.scalars: Dict[int, Dict[str, int]] = {}
+        # per-idx pending EndBlock validator updates (ordered by idx at
+        # read time, so a conflict re-run can cleanly replace its own)
+        self.val_updates: Dict[int, list] = {}
+        self.val_reset = False  # begin_block ran: ignore pre-session list
+        self.closed = False
+
+    # -- overlay plumbing (called by _SessionView) ---------------------
+
+    def _stripe(self, key: bytes) -> _Stripe:
+        return self.stripes[hash(key) % len(self.stripes)]
+
+    def mvcc_get(self, idx: int, key: bytes):
+        """(found, value) as seen by tx `idx`: highest overlay version
+        strictly below idx, else the base db."""
+        s = self._stripe(key)
+        with s.lock:
+            vers = s.versions.get(key)
+            if vers:
+                best = None
+                for vidx, val in vers:
+                    if vidx < idx:
+                        best = val
+                    else:
+                        break
+                if best is not None:
+                    if best is _TOMBSTONE:
+                        return True, None
+                    return True, best
+        return False, None
+
+    def mvcc_put(self, idx: int, key: bytes, value) -> None:
+        s = self._stripe(key)
+        with s.lock:
+            vers = s.versions.setdefault(key, [])
+            for i, (vidx, _) in enumerate(vers):
+                if vidx == idx:
+                    vers[i] = (idx, value)
+                    return
+                if vidx > idx:
+                    vers.insert(i, (idx, value))
+                    return
+            vers.append((idx, value))
+
+    def overlay_range(self, idx: int, start, end) -> Dict[bytes, object]:
+        """{key: final value below idx} for every overlay key in
+        [start, end) — the overlay half of a merged iterator."""
+        out: Dict[bytes, object] = {}
+        for s in self.stripes:
+            with s.lock:
+                for key, vers in s.versions.items():
+                    if start is not None and key < start:
+                        continue
+                    if end is not None and key >= end:
+                        continue
+                    best = None
+                    for vidx, val in vers:
+                        if vidx < idx:
+                            best = val
+                        else:
+                            break
+                    if best is not None:
+                        out[key] = best
+        return out
+
+    # -- journaling ----------------------------------------------------
+
+    def note_read(self, idx: int, key: bytes) -> None:
+        with self._journal_lock:
+            self.reads.setdefault(idx, set()).add(key)
+
+    def note_write(self, idx: int, key: bytes) -> None:
+        with self._journal_lock:
+            self.writes.setdefault(idx, set()).add(key)
+
+    def journal(self, idx: int) -> Tuple[set, set]:
+        with self._journal_lock:
+            return (set(self.reads.get(idx, ())),
+                    set(self.writes.get(idx, ())))
+
+    def clear_tx(self, idx: int) -> None:
+        """Erase every trace of tx `idx` (before a conflict re-run)."""
+        for s in self.stripes:
+            with s.lock:
+                dead = []
+                for key, vers in s.versions.items():
+                    s.versions[key] = [v for v in vers if v[0] != idx]
+                    if not s.versions[key]:
+                        dead.append(key)
+                for key in dead:
+                    del s.versions[key]
+        with self._journal_lock:
+            self.reads.pop(idx, None)
+            self.writes.pop(idx, None)
+            self.scalars.pop(idx, None)
+            self.val_updates.pop(idx, None)
+
+    # -- buffered instance attrs ---------------------------------------
+
+    def merge_scalars(self, idx: int, deltas: Dict[str, int]) -> None:
+        if deltas:
+            with self._journal_lock:
+                self.scalars[idx] = dict(deltas)
+
+    def scalar_total(self, name: str) -> int:
+        with self._journal_lock:
+            return sum(d.get(name, 0) for d in self.scalars.values())
+
+    def ordered_val_updates(self) -> list:
+        with self._journal_lock:
+            out = []
+            for idx in sorted(self.val_updates):
+                out.extend(self.val_updates[idx])
+            return out
+
+
+class _SessionView:
+    """The DB-shaped, journaling view one tx (or block phase) executes
+    against. Thread-confined: exactly one lane thread uses a view."""
+
+    def __init__(self, session: ExecSession, idx: int):
+        self.session = session
+        self.idx = idx
+        self.scalar_deltas: Dict[str, int] = {}
+
+    # DB interface used by the kvstore family: get/set/delete/iterator
+
+    def get(self, key: bytes):
+        s = self.session
+        if 0 <= self.idx < s.n_txs:
+            s.note_read(self.idx, bytes(key))
+        found, val = s.mvcc_get(self.idx, bytes(key))
+        if found:
+            return val
+        return s.base.get(key)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        s = self.session
+        if 0 <= self.idx < s.n_txs:
+            s.note_write(self.idx, bytes(key))
+        s.mvcc_put(self.idx, bytes(key), bytes(value))
+
+    def delete(self, key: bytes) -> None:
+        s = self.session
+        if 0 <= self.idx < s.n_txs:
+            s.note_write(self.idx, bytes(key))
+        s.mvcc_put(self.idx, bytes(key), _TOMBSTONE)
+
+    def iterator(self, start, end):
+        s = self.session
+        over = s.overlay_range(self.idx, start, end)
+        note = 0 <= self.idx < s.n_txs
+        seen = set(over)
+        merged = []
+        for k, v in s.base.iterator(start, end):
+            if k in seen:
+                continue
+            merged.append((k, v))
+        for k, v in over.items():
+            if v is not _TOMBSTONE:
+                merged.append((k, v))
+        merged.sort(key=lambda kv: kv[0])
+        for k, v in merged:
+            if note:
+                s.note_read(self.idx, k)
+            yield k, v
+
+
+class _ValUpdatesProxy:
+    """Stands in for PersistentKVStore._val_updates during an exec
+    session: appends journal to the ctx tx's slot, iteration (end_block)
+    yields every tx's updates in block order."""
+
+    def __init__(self, session: ExecSession, idx: int):
+        self._session = session
+        self._idx = idx
+
+    def append(self, update) -> None:
+        s = self._session
+        with s._journal_lock:
+            s.val_updates.setdefault(self._idx, []).append(update)
+
+    def __iter__(self):
+        return iter(self._session.ordered_val_updates())
+
+    def __len__(self):
+        return len(self._session.ordered_val_updates())
+
+
+class ShardedKVStoreApplication(ChurnKVStoreApplication):
+    """See module docstring. Safe for the node's parallel executor:
+    `supports_parallel_exec` advertises the exec-session surface."""
+
+    supports_parallel_exec = True
+
+    def __init__(self, db: Optional[DB] = None, shards: int = 16,
+                 io_us: int = 0, epoch_blocks: int = 1,
+                 rotation_fraction: float = 0.5, phantom_pool: int = 0,
+                 seed: int = 0):
+        from ...libs.db import MemDB
+
+        # the thread-local and buffered-scalar backing fields must exist
+        # BEFORE super().__init__ assigns self.db/self.size/... (all
+        # routed through the properties below)
+        self._tl = threading.local()
+        self._size = 0
+        self._epochs_run = 0
+        self._val_updates_base: list = []
+        self.shards = max(1, int(shards))
+        self.io_us = max(0, int(io_us))
+        super().__init__(db or MemDB(), epoch_blocks=epoch_blocks,
+                         rotation_fraction=rotation_fraction,
+                         phantom_pool=phantom_pool, seed=seed)
+
+    # -- routed state access -------------------------------------------
+    #
+    # Inside an exec session the executing thread sees the session view
+    # instead of the base db (and buffered deltas for the scalar
+    # counters deliver_tx/end_block mutate), so ALL inherited app logic
+    # — kv writes, validator updates, churn epochs — runs unchanged yet
+    # leaves the base state untouched until promote.
+
+    def base_db(self) -> DB:
+        return self._db
+
+    @property
+    def db(self):
+        view = getattr(self._tl, "view", None)
+        return view if view is not None else self._db
+
+    @db.setter
+    def db(self, value):
+        self._db = value
+
+    def _buffered_scalar_get(self, name: str, base: int) -> int:
+        view = getattr(self._tl, "view", None)
+        if view is not None:
+            return base + view.scalar_deltas.get(name, 0)
+        return base
+
+    def _buffered_scalar_set(self, name: str, base: int, value: int) -> bool:
+        view = getattr(self._tl, "view", None)
+        if view is not None:
+            view.scalar_deltas[name] = value - base
+            return True
+        return False
+
+    @property
+    def size(self) -> int:
+        return self._buffered_scalar_get("size", self._size)
+
+    @size.setter
+    def size(self, value: int) -> None:
+        if not self._buffered_scalar_set("size", self._size, value):
+            self._size = value
+
+    @property
+    def epochs_run(self) -> int:
+        return self._buffered_scalar_get("epochs_run", self._epochs_run)
+
+    @epochs_run.setter
+    def epochs_run(self, value: int) -> None:
+        if not self._buffered_scalar_set("epochs_run", self._epochs_run,
+                                         value):
+            self._epochs_run = value
+
+    @property
+    def _val_updates(self):
+        view = getattr(self._tl, "view", None)
+        if view is not None:
+            return _ValUpdatesProxy(view.session, view.idx)
+        return self._val_updates_base
+
+    @_val_updates.setter
+    def _val_updates(self, value) -> None:
+        view = getattr(self._tl, "view", None)
+        if view is not None:
+            # begin_block's reset inside a session: clear the buffered
+            # updates, never the base list
+            s = view.session
+            with s._journal_lock:
+                s.val_updates.clear()
+                s.val_reset = True
+            return
+        self._val_updates_base = value
+
+    # -- tx semantics ---------------------------------------------------
+
+    @staticmethod
+    def tx_body(tx: bytes) -> bytes:
+        """The app-level payload: enveloped txs unwrap, plain txs pass
+        through (differs from the plain kvstore, which hashes whole
+        envelope bytes into keys — documented in PARITY_DEVIATIONS)."""
+        from ...mempool import preverify
+
+        p = preverify.parse(tx)
+        return p.payload if p is not None else tx
+
+    @staticmethod
+    def infer_footprint(body: bytes) -> Optional[frozenset]:
+        """Declared-equivalent footprint for the app's own tx shapes;
+        None for anything global or unrecognized (conservative)."""
+        if body.startswith(b"val:"):
+            return None
+        if body.startswith(b"inc:"):
+            return frozenset((b"kv:" + body[4:],))
+        if body.startswith(b"cp:"):
+            parts = body[3:].split(b":", 1)
+            if len(parts) != 2:
+                return None
+            return frozenset((b"kv:" + parts[0], b"kv:" + parts[1]))
+        if body.startswith(b"ind:"):
+            return None  # write target is data-dependent: global
+        key = body.split(b"=", 1)[0] if b"=" in body else body
+        return frozenset((b"kv:" + key,))
+
+    def deliver_tx(self, tx: bytes):
+        if self.io_us:
+            # simulated backend latency (storage/remote-RPC wait): the
+            # GIL-released stall the parallel lanes overlap
+            time.sleep(self.io_us * 1e-6)
+        body = self.tx_body(tx)
+        if body.startswith(b"inc:"):
+            key = b"kv:" + body[4:]
+            raw = self.db.get(key)
+            try:
+                cur = int(raw) if raw else 0
+            except ValueError:
+                cur = 0
+            val = b"%d" % (cur + 1)
+            self.db.set(key, val)
+            self.size += 1
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, data=val)
+        if body.startswith(b"cp:"):
+            parts = body[3:].split(b":", 1)
+            if len(parts) != 2:
+                return abci.ResponseDeliverTx(code=1, log="bad cp tx")
+            src, dst = parts
+            val = self.db.get(b"kv:" + src) or b""
+            self.db.set(b"kv:" + dst, val)
+            self.size += 1
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK, data=val)
+        if body.startswith(b"ind:"):
+            parts = body[4:].split(b":", 1)
+            if len(parts) != 2:
+                return abci.ResponseDeliverTx(code=1, log="bad ind tx")
+            ptr, val = parts
+            target = self.db.get(b"kv:" + ptr) or b"dflt"
+            self.db.set(b"kv:" + target, val)
+            self.size += 1
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK,
+                                          data=target)
+        return super().deliver_tx(body)
+
+    # -- exec-session surface (driven by state/parallel.py) ------------
+
+    def exec_open(self, n_txs: int) -> ExecSession:
+        return ExecSession(self, n_txs, self.shards)
+
+    def _run_in_ctx(self, session: ExecSession, idx: int, fn):
+        view = _SessionView(session, idx)
+        self._tl.view = view
+        try:
+            return fn()
+        finally:
+            self._tl.view = None
+            session.merge_scalars(idx, view.scalar_deltas)
+
+    def exec_begin_block(self, session: ExecSession, req):
+        return self._run_in_ctx(session, BEGIN_IDX,
+                                lambda: self.begin_block(req))
+
+    def exec_deliver_tx(self, session: ExecSession, idx: int, tx: bytes):
+        return self._run_in_ctx(session, idx,
+                                lambda: self.deliver_tx(tx))
+
+    def exec_end_block(self, session: ExecSession, req):
+        return self._run_in_ctx(session, session.end_idx,
+                                lambda: self.end_block(req))
+
+    def exec_redeliver_tx(self, session: ExecSession, idx: int, tx: bytes):
+        """Conflict re-run: drop the first attempt's versions/journal,
+        then execute again (MVCC reads now see settled neighbors)."""
+        session.clear_tx(idx)
+        return self.exec_deliver_tx(session, idx, tx)
+
+    def exec_discard(self, session: ExecSession) -> None:
+        session.closed = True
+
+    def exec_promote(self, session: ExecSession) -> None:
+        """Apply the session in block order: per key the final version
+        wins (idx order), buffered scalars sum, pending validator
+        updates land on the base list for EndBlock parity."""
+        if session.closed:
+            raise RuntimeError("exec session already closed")
+        session.closed = True
+        end = session.end_idx + 1
+        for s in session.stripes:
+            with s.lock:
+                for key, vers in s.versions.items():
+                    best = None
+                    for vidx, val in vers:
+                        if vidx < end:
+                            best = val
+                    if best is None:
+                        continue
+                    if best is _TOMBSTONE:
+                        self._db.delete(key)
+                    else:
+                        self._db.set(key, best)
+        self._size += session.scalar_total("size")
+        self._epochs_run += session.scalar_total("epochs_run")
+        if session.val_reset:
+            self._val_updates_base = session.ordered_val_updates()
+        else:
+            self._val_updates_base = (list(self._val_updates_base)
+                                      + session.ordered_val_updates())

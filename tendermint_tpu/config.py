@@ -224,6 +224,26 @@ class ABCIConfig:
 
 
 @dataclass
+class ExecutionConfig:
+    """[execution] — deterministic parallel block execution (ours; the
+    reference drives DeliverTx strictly serially).
+
+    parallel_lanes: max concurrent execution lanes for footprint-
+    disjoint tx groups (state/parallel.py) against an app that supports
+    exec sessions (abci/example/sharded_kvstore.py). 1 (default) keeps
+    the exact serial DeliverTx loop — the conformance oracle. Apps
+    without the exec-session surface always run serial regardless.
+    speculative: execute the proposed block during the prevote/
+    precommit window on a background thread; the result is adopted at
+    commit only if the decided block matches (hash + base app state),
+    discarded otherwise — speculative state is never visible in state,
+    WAL, or RPC before finalize. Defaults off."""
+
+    parallel_lanes: int = 1
+    speculative: bool = False
+
+
+@dataclass
 class CryptoConfig:
     """[crypto] — batch-verification engine knobs (ours; the reference
     has no crypto section). async_dispatch gates the PIPELINED call
@@ -359,6 +379,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     abci: ABCIConfig = field(default_factory=ABCIConfig)
+    execution: ExecutionConfig = field(default_factory=ExecutionConfig)
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
@@ -403,6 +424,7 @@ class Config:
             emit("mempool", self.mempool),
             emit("consensus", self.consensus),
             abci_section,
+            emit("execution", self.execution),
             emit("crypto", self.crypto),
             emit("statesync", self.statesync),
             emit("chaos", self.chaos),
@@ -425,6 +447,7 @@ class Config:
             "p2p": cfg.p2p,
             "mempool": cfg.mempool,
             "consensus": cfg.consensus,
+            "execution": cfg.execution,
             "crypto": cfg.crypto,
             "statesync": cfg.statesync,
             "chaos": cfg.chaos,

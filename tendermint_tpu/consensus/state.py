@@ -197,6 +197,11 @@ class ConsensusState:
         self.ticker.stop()
         self._stopped.wait(timeout=5.0)
         self.wal.stop()
+        # settle any in-flight speculative execution so no exec-spec
+        # thread (or open overlay session) outlives consensus
+        stop_exec = getattr(self.block_exec, "stop", None)
+        if stop_exec is not None:
+            stop_exec()
 
     def wait_until_stopped(self, timeout: Optional[float] = None) -> bool:
         return self._stopped.wait(timeout)
@@ -868,6 +873,7 @@ class ConsensusState:
         """reference defaultDoPrevote :977-995"""
         rs = self.rs
         if rs.locked_block is not None:
+            self._speculate(rs.locked_block)
             self._sign_add_vote(VOTE_TYPE_PREVOTE, rs.locked_block.hash(), rs.locked_block_parts.header())
             return
         if rs.proposal_block is None:
@@ -879,9 +885,23 @@ class ConsensusState:
             LOG.warning("prevote: ProposalBlock is invalid: %s", e)
             self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", None)
             return
+        # the block we are about to prevote is the likely decision:
+        # start executing it NOW on the speculation thread so commit
+        # only finalizes already-computed state ([execution]
+        # speculative; adopted at finalize only on exact block +
+        # base-state match, discarded otherwise)
+        self._speculate(rs.proposal_block)
         self._sign_add_vote(
             VOTE_TYPE_PREVOTE, rs.proposal_block.hash(), rs.proposal_block_parts.header()
         )
+
+    def _speculate(self, block) -> None:
+        if block is None or not self.block_exec.speculation_enabled:
+            return
+        try:
+            self.block_exec.begin_speculation(self.state, block)
+        except Exception:  # noqa: BLE001 - speculation must never stall a vote
+            LOG.exception("begin_speculation failed (ignored)")
 
     def _enter_prevote_wait(self, height: int, round_: int) -> None:
         """reference enterPrevoteWait :997-1022"""

@@ -12,6 +12,19 @@ tampered without invalidating the tx. The priority byte also feeds the
 mempool's lane assignment and reap ordering. Txs without the magic are
 admitted exactly as before (no signature check, priority 0).
 
+The v2 envelope adds an optional ACCESS-HINT segment between the
+priority byte and the pubkey — the declared key footprint the parallel
+block executor (state/parallel.py) partitions txs by:
+
+    b"sgtx2" | priority(1) | nhints(1) | {hlen(1) | hint}*n
+             | pubkey(32) | sig(64) | payload
+
+Hints are app-level state keys (<= 255 bytes each, <= 255 of them) and
+are covered by the signature like everything else, so a relay cannot
+re-group a tx by rewriting its declared footprint. A v1 (or plain) tx
+simply has no hints, which the executor treats as "conflicts with
+everything" — conservatively correct, never wrong.
+
 The IngestQueue is the batching layer in front of Mempool admission:
 callers submit() and get a future; a single worker drains up to
 batch_max waiting txs, pre-verifies every enveloped signature in ONE
@@ -37,6 +50,7 @@ from ..abci import types as abci
 LOG = logging.getLogger("mempool.preverify")
 
 MAGIC = b"sgtx1"
+MAGIC2 = b"sgtx2"  # v2: carries the optional access-hint segment
 _PRIO_OFF = len(MAGIC)  # 5
 _PK_OFF = _PRIO_OFF + 1  # 6
 _SIG_OFF = _PK_OFF + 32  # 38
@@ -56,6 +70,11 @@ class SignedTx:
     sig: bytes
     payload: bytes
     msg: bytes  # the signed bytes: everything except sig
+    # declared access hints (v2 envelopes only; () = undeclared). The
+    # distinction between "declared empty" and "undeclared" doesn't
+    # arise: a v2 tx with zero hints is treated as unhinted too, since
+    # an empty footprint claims the tx touches nothing — not credible.
+    hints: tuple = ()
 
     def verify(self) -> bool:
         """Serial single-tx verification (the non-batched path)."""
@@ -68,7 +87,11 @@ class SignedTx:
 
 
 def parse(tx: bytes) -> Optional[SignedTx]:
-    """The envelope view of tx, or None for a plain (unsigned) tx."""
+    """The envelope view of tx (either version), or None for a plain
+    (unsigned) tx — including anything malformed, which stays opaque
+    app bytes exactly like pre-envelope behavior."""
+    if tx.startswith(MAGIC2):
+        return _parse_v2(tx)
     if len(tx) < _PAYLOAD_OFF or not tx.startswith(MAGIC):
         return None
     return SignedTx(
@@ -80,12 +103,57 @@ def parse(tx: bytes) -> Optional[SignedTx]:
     )
 
 
-def make_signed_tx(priv_key, payload: bytes, priority: int = 0) -> bytes:
-    """Build one enveloped tx (load harness / client-side helper)."""
+def _parse_v2(tx: bytes) -> Optional[SignedTx]:
+    # magic(5) | priority(1) | nhints(1) | {hlen(1)|hint}*n
+    #         | pubkey(32) | sig(64) | payload
+    if len(tx) < _PK_OFF + 1:  # through nhints
+        return None
+    off = _PK_OFF  # nhints byte position
+    n = tx[off]
+    off += 1
+    hints = []
+    for _ in range(n):
+        if off >= len(tx):
+            return None
+        hlen = tx[off]
+        off += 1
+        if off + hlen > len(tx):
+            return None
+        hints.append(tx[off:off + hlen])
+        off += hlen
+    pk_off, sig_off, payload_off = off, off + 32, off + 32 + 64
+    if len(tx) < payload_off:
+        return None
+    return SignedTx(
+        priority=tx[_PRIO_OFF],
+        pubkey=tx[pk_off:sig_off],
+        sig=tx[sig_off:payload_off],
+        payload=tx[payload_off:],
+        msg=tx[:sig_off] + tx[payload_off:],
+        hints=tuple(hints),
+    )
+
+
+def make_signed_tx(priv_key, payload: bytes, priority: int = 0,
+                   hints=None) -> bytes:
+    """Build one enveloped tx (load harness / client-side helper).
+    `hints` (an iterable of state-key bytes) selects the v2 envelope
+    carrying a declared access footprint for the parallel executor."""
     if not 0 <= priority <= 255:
         raise ValueError("priority must fit one byte")
     pk = priv_key.pub_key().bytes()
-    head = MAGIC + bytes([priority]) + pk
+    if hints is None:
+        head = MAGIC + bytes([priority]) + pk
+    else:
+        hints = [bytes(h) for h in hints]
+        if len(hints) > 255:
+            raise ValueError("at most 255 access hints per tx")
+        seg = bytes([len(hints)])
+        for h in hints:
+            if not 1 <= len(h) <= 255:
+                raise ValueError("each access hint must be 1..255 bytes")
+            seg += bytes([len(h)]) + h
+        head = MAGIC2 + bytes([priority]) + seg + pk
     sig = priv_key.sign(head + payload)
     return head + sig + payload
 

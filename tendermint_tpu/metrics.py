@@ -273,6 +273,15 @@ class StateMetrics:
     validator_updates: object = NOP
     # blocks whose EndBlock carried at least one validator update
     valset_changes: object = NOP
+    # parallel-execution lane count the executor is configured with
+    # (1 = serial oracle path)
+    exec_parallel_lanes: object = NOP
+    # txs re-run serially after an observed read/write conflict across
+    # concurrently executed groups
+    exec_conflicts: object = NOP
+    # speculative block executions adopted at commit / discarded
+    exec_speculation_hits: object = NOP
+    exec_speculation_wasted: object = NOP
 
 
 @dataclass
@@ -460,6 +469,20 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_churn_valset_changes_total",
             "Blocks whose EndBlock carried at least one validator "
             "update."),
+        exec_parallel_lanes=r.gauge(
+            f"{ns}_exec_parallel_lanes",
+            "Configured parallel execution lanes (1 = serial)."),
+        exec_conflicts=r.counter(
+            f"{ns}_exec_conflicts_total",
+            "Transactions re-run serially after an observed read/write "
+            "conflict between concurrently executed groups."),
+        exec_speculation_hits=r.counter(
+            f"{ns}_exec_speculation_hits_total",
+            "Speculative block executions adopted at commit."),
+        exec_speculation_wasted=r.counter(
+            f"{ns}_exec_speculation_wasted_total",
+            "Speculative block executions discarded (decided block or "
+            "base state did not match)."),
     )
     crypto = CryptoMetrics(
         batch_verify_seconds=r.histogram(

@@ -290,6 +290,7 @@ class Node:
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
             metrics=self.metrics.state,
+            exec_config=config.execution,
         )
 
         # --- consensus (node/node.go:309-326) ------------------------
@@ -862,6 +863,9 @@ class Node:
         if lockdep.get_metrics() is self.metrics.lockdep:
             lockdep.set_metrics(None)
         self.sw.stop()
+        # settle any in-flight speculative execution (exec-spec thread +
+        # overlay session) before the app conns go away
+        self.block_exec.stop()
         if self._chaos_installed:
             # only the installer tears the process-wide controller down
             # (scenario runs install their own outside any node)

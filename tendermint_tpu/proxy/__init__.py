@@ -69,6 +69,25 @@ def remote_client_creator(address: str, transport: str = "socket",
     return create
 
 
+def _parse_app_params(kind: str, spec: str, names: dict) -> dict:
+    """Parse a "k=v,k=v" app-address suffix against a {param: kwarg}
+    map (shared by the churn/sharded kvstore families); `frac` is the
+    one float-valued key."""
+    kw = {}
+    for part in filter(None, spec.split(",")):
+        k, _, v = part.partition("=")
+        if k not in names:
+            raise ValueError(f"unknown {kind} param {k!r}")
+        kw[names[k]] = float(v) if k == "frac" else int(v)
+    return kw
+
+
+# churn_kvstore's tunables; sharded_kvstore accepts the same family
+# plus its own shards/io_us
+_CHURN_PARAMS = {"epoch": "epoch_blocks", "frac": "rotation_fraction",
+                 "pool": "phantom_pool", "seed": "seed"}
+
+
 def default_client_creator(address: str, transport: str = "socket",
                            request_timeout: float = 0.0,
                            dial_timeout: float = 10.0) -> ClientCreator:
@@ -98,15 +117,22 @@ def default_client_creator(address: str, transport: str = "socket",
         from ..libs.db import MemDB
 
         _, _, spec = address.partition(":")
-        kw = {}
-        names = {"epoch": "epoch_blocks", "frac": "rotation_fraction",
-                 "pool": "phantom_pool", "seed": "seed"}
-        for part in filter(None, spec.split(",")):
-            k, _, v = part.partition("=")
-            if k not in names:
-                raise ValueError(f"unknown churn_kvstore param {k!r}")
-            kw[names[k]] = float(v) if k == "frac" else int(v)
+        kw = _parse_app_params("churn_kvstore", spec, _CHURN_PARAMS)
         return local_client_creator(ChurnKVStoreApplication(MemDB(), **kw))
+    if address == "sharded_kvstore" or address.startswith("sharded_kvstore:"):
+        # parallel-execution workload app: overlay exec sessions +
+        # access journaling (state/parallel.py drives it when
+        # [execution] parallel_lanes > 1 / speculative = true).
+        # "sharded_kvstore:shards=16,io_us=0,epoch=1,frac=0.5,pool=0,
+        # seed=0" tunes it; io_us simulates per-tx backend latency.
+        from ..abci.example.sharded_kvstore import ShardedKVStoreApplication
+        from ..libs.db import MemDB
+
+        _, _, spec = address.partition(":")
+        kw = _parse_app_params(
+            "sharded_kvstore", spec,
+            dict(_CHURN_PARAMS, shards="shards", io_us="io_us"))
+        return local_client_creator(ShardedKVStoreApplication(MemDB(), **kw))
     if address == "counter":
         from ..abci.example.counter import CounterApplication
 

@@ -129,7 +129,7 @@ def _load_height(env: RPCEnvironment, params: dict) -> int:
 
 CACHEABLE_METHODS = frozenset((
     "status", "genesis", "block", "block_results", "commit",
-    "validators", "blockchain",
+    "validators", "blockchain", "tx_search",
 ))
 
 
@@ -167,6 +167,23 @@ def cache_plan(env: RPCEnvironment, method: str, params: dict):
             min_p = _int(params, "minHeight", None)
             max_p = _int(params, "maxHeight", None)
             return ((min_p, max_p), True)
+        if method == "tx_search":
+            # indexer queries: keyed by the index GENERATION (a per-tx
+            # ingest counter — the result is a pure function of the
+            # index contents, which change exactly when it advances;
+            # the ROADMAP's "last uncached hot read"). Keying by
+            # indexed HEIGHT would be wrong: it bumps on a block's
+            # first tx, so a result computed mid-ingest would keep
+            # serving after the rest of the block landed. Still
+            # generational as a belt: TTL bounds any unforeseen
+            # staleness on a stalled chain.
+            qs = params.get("query")
+            if not qs:
+                return None  # handler produces the real error
+            page = max(_int(params, "page", 1) or 1, 1)
+            per_page = min(max(_int(params, "per_page", 30) or 30, 1), 100)
+            return ((str(qs), page, per_page,
+                     env.tx_indexer.index_generation()), True)
     except RPCError:
         return None
     return None

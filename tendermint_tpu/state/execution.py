@@ -128,7 +128,11 @@ class BlockExecutor:
         event_bus=None,
         logger: Optional[logging.Logger] = None,
         metrics=None,
+        exec_config=None,
     ):
+        import threading
+
+        from ..config import ExecutionConfig
         from ..metrics import StateMetrics
 
         self.db = db
@@ -138,9 +142,33 @@ class BlockExecutor:
         self.event_bus = event_bus
         self.logger = logger or logging.getLogger("state.BlockExecutor")
         self.metrics = metrics if metrics is not None else StateMetrics()
+        self.exec_config = (exec_config if exec_config is not None
+                            else ExecutionConfig())
+        self.metrics.exec_parallel_lanes.set(self.exec_config.parallel_lanes)
+        # speculation slot: written by the consensus thread, the worker
+        # thread only fills its own slot object (state/parallel.py)
+        self._spec_lock = threading.Lock()
+        self._spec_slot = None
+        self._spec_threads: list = []  # live exec-spec threads for stop()
+        self._warned_no_parallel_app = False
 
     def set_event_bus(self, event_bus) -> None:
         self.event_bus = event_bus
+
+    @property
+    def speculation_enabled(self) -> bool:
+        return bool(self.exec_config.speculative)
+
+    def stop(self) -> None:
+        """Settle any in-flight speculation so no exec-spec thread (or
+        undiscarded overlay session) outlives the executor's owner."""
+        with self._spec_lock:
+            slot, self._spec_slot = self._spec_slot, None
+            threads, self._spec_threads = list(self._spec_threads), []
+        if slot is not None:
+            slot.abandon()
+        for t in threads:
+            t.join(timeout=10)
 
     def validate_block(self, state: State, block: Block,
                        decided: bool = False) -> None:
@@ -181,7 +209,7 @@ class BlockExecutor:
         # drift bound must not reject them
         self.validate_block(state, block, decided=True)
 
-        abci_responses = self.exec_block_on_proxy_app(state, block)
+        abci_responses = self._exec_block(state, block)
 
         fail.fail_point("ApplyBlock.SaveABCIResponses")  # execution.go:103
         save_abci_responses(self.db, block.header.height, abci_responses)
@@ -237,9 +265,8 @@ class BlockExecutor:
             if self.mempool is not None:
                 self.mempool.unlock()
 
-    def exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:
-        """BeginBlock → DeliverTx× → EndBlock (reference execution.go:209-274).
-        DeliverTx calls are pipelined by the socket client's buffering."""
+    def _begin_block_request(self, state: State,
+                             block: Block) -> abci.RequestBeginBlock:
         commit_info = _last_commit_info(state, block)
         byz_vals = [
             abci.Evidence(
@@ -250,23 +277,31 @@ class BlockExecutor:
             )
             for ev in block.evidence.evidence
         ]
-
-        res_begin = self.proxy_app.begin_block(
-            abci.RequestBeginBlock(
-                hash=block.hash() or b"",
-                header=block.header,
-                last_commit_info=commit_info,
-                byzantine_validators=byz_vals,
-            )
+        return abci.RequestBeginBlock(
+            hash=block.hash() or b"",
+            header=block.header,
+            last_commit_info=commit_info,
+            byzantine_validators=byz_vals,
         )
 
-        deliver_txs: List[abci.ResponseDeliverTx] = []
-        invalid_count = 0
-        for tx in block.data.txs:
-            r = self.proxy_app.deliver_tx(tx)
-            if not r.is_ok:
-                invalid_count += 1
-            deliver_txs.append(r)
+    def exec_block_on_proxy_app(self, state: State, block: Block) -> ABCIResponses:
+        """BeginBlock → DeliverTx× → EndBlock (reference execution.go:209-274).
+        DeliverTx requests ARE pipelined: deliver_tx_batch batch-writes
+        frames ahead of the response drain on the socket transport (a
+        bounded in-flight window keeps the per-request deadline
+        semantics), and degrades to the per-tx loop everywhere else.
+        This is the serial conformance oracle the parallel lane
+        (state/parallel.py) is property-tested against."""
+        res_begin = self.proxy_app.begin_block(
+            self._begin_block_request(state, block))
+
+        txs = list(block.data.txs)
+        batch = getattr(self.proxy_app, "deliver_tx_batch", None)
+        if batch is not None:
+            deliver_txs = list(batch(txs))
+        else:  # foreign/stub app conns without the batched entry point
+            deliver_txs = [self.proxy_app.deliver_tx(tx) for tx in txs]
+        invalid_count = sum(1 for r in deliver_txs if not r.is_ok)
 
         res_end = self.proxy_app.end_block(abci.RequestEndBlock(height=block.header.height))
 
@@ -279,6 +314,123 @@ class BlockExecutor:
         responses = ABCIResponses(deliver_txs, res_end)
         responses.begin_block = res_begin
         return responses
+
+    # --- parallel / speculative execution (state/parallel.py) ---------
+
+    def _exec_block(self, state: State, block: Block) -> ABCIResponses:
+        """Execution dispatch: adopt a matching speculative run, else
+        run the optimistic parallel lane (capable app + lanes > 1),
+        else the serial oracle. Every path yields an ABCIResponses that
+        is byte-identical to the serial loop (property-tested)."""
+        from . import parallel as par
+
+        run = self._take_speculation(state, block)
+        if run is not None:
+            # promote through the session's OWN app handle: re-unwrapping
+            # the proxy here could yield None mid-reconnect (the
+            # ResilientClient swaps _client), and the session is bound to
+            # the app object it executed against anyway
+            run.session.app.exec_promote(run.session)
+            self.metrics.exec_speculation_hits.inc()
+            return self._finish_run(run, block)
+        if self.exec_config.parallel_lanes > 1:
+            app = par.unwrap_parallel_app(self.proxy_app)
+            if app is None:
+                if not self._warned_no_parallel_app:
+                    self._warned_no_parallel_app = True
+                    self.logger.warning(
+                        "[execution] parallel_lanes=%d but the app "
+                        "connection has no exec-session surface; "
+                        "executing serially",
+                        self.exec_config.parallel_lanes)
+            else:
+                run = par.run_block(
+                    app, block.data.txs,
+                    self._begin_block_request(state, block),
+                    abci.RequestEndBlock(height=block.header.height),
+                    lanes=self.exec_config.parallel_lanes,
+                    logger=self.logger)
+                app.exec_promote(run.session)
+                return self._finish_run(run, block)
+        return self.exec_block_on_proxy_app(state, block)
+
+    def _finish_run(self, run, block: Block) -> ABCIResponses:
+        if run.conflicts:
+            self.metrics.exec_conflicts.inc(run.conflicts)
+        invalid = sum(1 for r in run.deliver_res if not r.is_ok)
+        self.logger.info(
+            "executed block height=%d valid_txs=%d invalid_txs=%d "
+            "(parallel: conflicts=%d%s)",
+            block.header.height, len(run.deliver_res) - invalid, invalid,
+            run.conflicts, ", serial-fallback" if run.serial_fallback else "")
+        responses = ABCIResponses(list(run.deliver_res), run.end_res)
+        responses.begin_block = run.begin_res
+        return responses
+
+    def begin_speculation(self, state: State, block: Block) -> bool:
+        """Kick a speculative execution of `block` on a background
+        thread (consensus calls this once the proposal is complete and
+        valid, during the prevote window). No-op unless [execution]
+        speculative is on and the app supports exec sessions. Returns
+        True if a new speculation was started."""
+        if not self.speculation_enabled or block is None:
+            return False
+        from . import parallel as par
+
+        app = par.unwrap_parallel_app(self.proxy_app)
+        if app is None:
+            if not self._warned_no_parallel_app:
+                self._warned_no_parallel_app = True
+                self.logger.warning(
+                    "[execution] speculative=true but the app connection "
+                    "has no exec-session surface; speculation disabled")
+            return False
+        height = block.header.height
+        block_hash = block.hash() or b""
+        with self._spec_lock:
+            cur = self._spec_slot
+            if cur is not None and cur.matches(height, block_hash,
+                                               state.app_hash):
+                return False  # already speculating on this exact block
+            self._spec_slot = None
+        if cur is not None:
+            cur.abandon()
+            self.metrics.exec_speculation_wasted.inc()
+        slot = par.SpeculationSlot(app, height, block_hash, state.app_hash)
+        slot.start(list(block.data.txs),
+                   self._begin_block_request(state, block),
+                   abci.RequestEndBlock(height=height),
+                   lanes=max(1, self.exec_config.parallel_lanes))
+        with self._spec_lock:
+            self._spec_slot = slot
+            self._spec_threads = [t for t in self._spec_threads
+                                  if t.is_alive()]
+            self._spec_threads.append(slot.thread)
+        return True
+
+    def _take_speculation(self, state: State, block: Block):
+        """Settle the speculation slot against the DECIDED block:
+        matching slot → wait for the worker and hand its run to the
+        caller; anything else → abandon (the worker discards its own
+        session) and count it wasted."""
+        with self._spec_lock:
+            slot, self._spec_slot = self._spec_slot, None
+        if slot is None:
+            return None
+        if slot.matches(block.header.height, block.hash() or b"",
+                        state.app_hash):
+            run = slot.wait()
+            if run is None:
+                # worker failed: surface like a serial exec would have
+                if slot.error is not None:
+                    self.logger.warning(
+                        "speculative execution failed (%s); re-executing",
+                        slot.error)
+                self.metrics.exec_speculation_wasted.inc()
+            return run
+        slot.abandon()
+        self.metrics.exec_speculation_wasted.inc()
+        return None
 
     def _fire_events(self, block: Block, abci_responses: ABCIResponses, val_updates) -> None:
         """Reference execution.go fireEvents:475-506."""

@@ -1,0 +1,398 @@
+"""Optimistic-concurrency parallel block execution.
+
+The serial ABCI ceiling: `exec_block_on_proxy_app` drives DeliverTx one
+tx at a time, so block latency is the SUM of every tx's app latency.
+This module breaks it for apps that opt in (the exec-session surface of
+abci/example/sharded_kvstore.py) while keeping the serial path in-tree
+as the conformance oracle:
+
+1. **Partition** — every tx maps to a key footprint: declared access
+   hints from the v2 signed envelope (mempool/preverify.py), else the
+   app's own `infer_footprint` on the payload, else None. Unhinted txs
+   conservatively conflict with everything: they become BARRIERS that
+   split the block into segments executed in order. Within a segment,
+   union-find over footprint keys clusters txs into disjoint groups.
+2. **Execute** — groups run concurrently on up to `lanes` worker
+   threads ("exec-lane-*"), each group's txs in block order, every
+   state access buffered in the app's MVCC overlay session (reads
+   resolve to the highest version below the reader's tx index).
+3. **Detect & re-run** — after a segment, any tx whose OBSERVED
+   reads/writes overlap another group's writes (a footprint lie or an
+   inference miss) is re-run serially in block order against the now-
+   settled overlay. If a re-run's writes invalidate a clean tx's reads
+   (pathological), the whole block falls back to serial-through-overlay.
+4. **Promote or discard** — `exec_promote` applies final versions in
+   block order; a discarded session (failed speculation) leaves zero
+   trace in app state.
+
+Speculative execution rides the same machinery: `SpeculationSlot` runs
+the proposed block on a background thread ("exec-spec") during the
+prevote/precommit window with promote deferred to commit time; the
+decided block either adopts the precomputed session (hash + base-state
+match) or discards it, so speculative state is never visible in state,
+WAL, or RPC before finalize.
+
+Serial-equivalence argument (property-tested in
+tests/test_parallel_exec.py): a clean tx's observed accesses are
+disjoint from every concurrent group's writes, so its reads saw only
+base/own-group values — exactly its serial view — and its writes land
+by block order at promote. Conflicted txs re-run in block order after
+the segment settles, so their MVCC reads are serial-exact; re-runs
+execute in ascending index order, so an earlier re-run never sees a
+later one's stale versions (index filtering hides them).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+LOG = logging.getLogger("state.parallel")
+
+
+# --- footprints + planning -------------------------------------------
+
+
+def tx_footprint(tx: bytes, infer: Optional[Callable] = None,
+                 body_of: Optional[Callable] = None):
+    """The key footprint the planner partitions by: declared envelope
+    hints win; otherwise the app's inference on the payload; None means
+    'conflicts with everything' (barrier)."""
+    from ..mempool import preverify
+
+    p = preverify.parse(tx)
+    if p is not None and p.hints:
+        return frozenset(p.hints)
+    if infer is None:
+        return None
+    body = p.payload if p is not None else (
+        body_of(tx) if body_of is not None else tx)
+    try:
+        return infer(body)
+    except Exception:  # noqa: BLE001 - inference must never kill exec
+        return None
+
+
+class Segment:
+    """One barrier-delimited slice of the block: either a single serial
+    tx (barrier) or a set of footprint-disjoint parallel groups."""
+
+    __slots__ = ("serial_idx", "groups")
+
+    def __init__(self, serial_idx: Optional[int] = None,
+                 groups: Optional[List[List[int]]] = None):
+        self.serial_idx = serial_idx
+        self.groups = groups or []
+
+    @property
+    def is_barrier(self) -> bool:
+        return self.serial_idx is not None
+
+
+class BlockPlan:
+    __slots__ = ("segments", "n_txs", "parallel_txs", "barrier_txs")
+
+    def __init__(self, segments: List[Segment], n_txs: int):
+        self.segments = segments
+        self.n_txs = n_txs
+        self.barrier_txs = sum(1 for s in segments if s.is_barrier)
+        self.parallel_txs = n_txs - self.barrier_txs
+
+
+def plan_block(footprints: Sequence[Optional[frozenset]]) -> BlockPlan:
+    """Segments in block order; within each parallel segment, union-find
+    over footprint keys groups txs that share any key (those execute in
+    block order on ONE lane — ordering between same-key txs is free)."""
+    segments: List[Segment] = []
+    run: List[int] = []
+
+    def flush():
+        if run:
+            segments.append(Segment(groups=_group_disjoint(run, footprints)))
+            run.clear()
+
+    for i, f in enumerate(footprints):
+        if f is None or not f:
+            flush()
+            segments.append(Segment(serial_idx=i))
+        else:
+            run.append(i)
+    flush()
+    return BlockPlan(segments, len(footprints))
+
+
+def _group_disjoint(indices: List[int],
+                    footprints: Sequence[frozenset]) -> List[List[int]]:
+    parent: dict = {}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    key_owner: dict = {}
+    for i in indices:
+        parent[i] = i
+        for k in footprints[i]:
+            if k in key_owner:
+                union(key_owner[k], i)
+            else:
+                key_owner[k] = i
+    groups: dict = {}
+    for i in indices:
+        groups.setdefault(find(i), []).append(i)
+    # txs within a group stay in block order; group order is by first tx
+    return [sorted(g) for _, g in sorted(groups.items())]
+
+
+# --- the lane executor ------------------------------------------------
+
+
+def unwrap_parallel_app(proxy_app):
+    """The in-process app behind a consensus connection, if it supports
+    exec sessions: ResilientClient -> LocalClient -> Application. Socket
+    and gRPC apps return None (the exec-session protocol is not on the
+    ABCI wire — documented in PARITY_DEVIATIONS)."""
+    client = getattr(proxy_app, "_client", proxy_app)
+    app = getattr(client, "app", None)
+    if app is not None and getattr(app, "supports_parallel_exec", False):
+        return app
+    return None
+
+
+class BlockRun:
+    """Result of one optimistic execution: the open session plus the
+    collected responses (promote still pending)."""
+
+    __slots__ = ("session", "begin_res", "deliver_res", "end_res",
+                 "conflicts", "serial_fallback")
+
+    def __init__(self, session, begin_res, deliver_res, end_res,
+                 conflicts: int, serial_fallback: bool):
+        self.session = session
+        self.begin_res = begin_res
+        self.deliver_res = deliver_res
+        self.end_res = end_res
+        self.conflicts = conflicts
+        self.serial_fallback = serial_fallback
+
+
+def run_block(app, txs: Sequence[bytes], begin_req, end_req,
+              lanes: int = 1, logger=None) -> BlockRun:
+    """Execute one block optimistically against `app`'s exec-session
+    surface. Raises whatever the app raises (the caller treats it like
+    a serial execution failure); on unresolvable conflicts falls back
+    to serial-through-overlay (still session-buffered, so speculation
+    stays discardable)."""
+    logger = logger or LOG
+    txs = list(txs)
+    infer = getattr(app, "infer_footprint", None)
+    body_of = getattr(app, "tx_body", None)
+    footprints = [tx_footprint(tx, infer, body_of) for tx in txs]
+    plan = plan_block(footprints)
+
+    session = app.exec_open(len(txs))
+    try:
+        begin_res = app.exec_begin_block(session, begin_req)
+        responses: List = [None] * len(txs)
+        conflicts = 0
+        aborted = False
+        for seg in plan.segments:
+            if seg.is_barrier:
+                i = seg.serial_idx
+                responses[i] = app.exec_deliver_tx(session, i, txs[i])
+                continue
+            _run_segment(app, session, txs, seg, lanes, responses)
+            n_conf = _resolve_conflicts(app, session, txs, seg, responses)
+            if n_conf < 0:
+                aborted = True
+                break
+            conflicts += n_conf
+        if aborted:
+            # unresolvable interleaving: throw the attempt away and run
+            # every tx serially through a FRESH overlay (same
+            # discardability, exact serial semantics)
+            logger.warning(
+                "parallel execution aborted after conflict re-run; "
+                "falling back to serial-through-overlay")
+            app.exec_discard(session)
+            session = app.exec_open(len(txs))
+            begin_res = app.exec_begin_block(session, begin_req)
+            responses = [app.exec_deliver_tx(session, i, tx)
+                         for i, tx in enumerate(txs)]
+            end_res = app.exec_end_block(session, end_req)
+            return BlockRun(session, begin_res, responses, end_res,
+                            conflicts, True)
+        end_res = app.exec_end_block(session, end_req)
+        return BlockRun(session, begin_res, responses, end_res,
+                        conflicts, False)
+    except BaseException:
+        app.exec_discard(session)
+        raise
+
+
+def _run_segment(app, session, txs, seg: Segment, lanes: int,
+                 responses: List) -> None:
+    """Run a parallel segment's groups over up to `lanes` workers. Each
+    worker drains groups from a shared cursor; a group's txs execute in
+    block order. Worker exceptions re-raise here after the join."""
+    groups = seg.groups
+    n_workers = max(1, min(lanes, len(groups)))
+    if n_workers == 1:
+        for g in groups:
+            for i in g:
+                responses[i] = app.exec_deliver_tx(session, i, txs[i])
+        return
+    cursor_lock = threading.Lock()
+    cursor = [0]
+    errors: List[BaseException] = []
+
+    def lane():
+        while True:
+            with cursor_lock:
+                pos = cursor[0]
+                if pos >= len(groups) or errors:
+                    return
+                cursor[0] = pos + 1
+            try:
+                for i in groups[pos]:
+                    responses[i] = app.exec_deliver_tx(session, i, txs[i])
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors.append(e)
+                return
+
+    threads = []
+    for k in range(n_workers):
+        t = threading.Thread(target=lane, name=f"exec-lane-{k}")
+        threads.append(t)
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def _resolve_conflicts(app, session, txs, seg: Segment,
+                       responses: List) -> int:
+    """Detect observed-access conflicts across the segment's groups and
+    re-run the conflicted txs serially in block order. Returns the
+    number of re-run txs, or -1 if the re-runs invalidated a clean tx
+    (full-serial fallback required)."""
+    groups = seg.groups
+    if len(groups) <= 1:
+        return 0
+    group_of = {}
+    for gid, g in enumerate(groups):
+        for i in g:
+            group_of[i] = gid
+    indices = sorted(group_of)
+    journals = {i: session.journal(i) for i in indices}
+    writers: dict = {}  # key -> set of gids that wrote it
+    for i in indices:
+        for k in journals[i][1]:
+            writers.setdefault(k, set()).add(group_of[i])
+
+    conflicted = []
+    for i in indices:
+        reads, writes = journals[i]
+        mine = group_of[i]
+        for k in reads | writes:
+            gids = writers.get(k)
+            if gids and (gids - {mine}):
+                conflicted.append(i)
+                break
+    if not conflicted:
+        return 0
+
+    clean = [i for i in indices if i not in set(conflicted)]
+    clean_reads = {i: journals[i][0] for i in clean}
+    for i in sorted(conflicted):
+        responses[i] = app.exec_redeliver_tx(session, i, txs[i])
+        _, new_writes = session.journal(i)
+        # a re-run write under a LATER clean tx's read means that read
+        # saw a stale value — the optimistic attempt is unsalvageable
+        for j in clean:
+            if j > i and (new_writes & clean_reads[j]):
+                return -1
+    return len(conflicted)
+
+
+# --- speculation ------------------------------------------------------
+
+
+class SpeculationSlot:
+    """One in-flight speculative execution of a proposed block.
+
+    The worker thread runs `run_block` with promote deferred; the
+    consensus thread either adopts (matching decided block: wait, then
+    promote) or abandons it (the worker discards its own session when
+    it finds the slot abandoned — no one blocks on a loser)."""
+
+    def __init__(self, app, height: int, block_hash: bytes,
+                 base_app_hash: bytes):
+        self.app = app
+        self.height = height
+        self.block_hash = block_hash
+        self.base_app_hash = base_app_hash
+        self.run: Optional[BlockRun] = None
+        self.error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._abandoned = False
+        self._done = threading.Event()
+        self.thread: Optional[threading.Thread] = None
+
+    def start(self, txs, begin_req, end_req, lanes: int) -> None:
+        def work():
+            run = None
+            try:
+                run = run_block(self.app, txs, begin_req, end_req,
+                                lanes=lanes)
+            except BaseException as e:  # noqa: BLE001 - surfaced at adopt
+                self.error = e
+            with self._lock:
+                if self._abandoned:
+                    if run is not None:
+                        self.app.exec_discard(run.session)
+                else:
+                    self.run = run
+            self._done.set()
+
+        t = threading.Thread(target=work, name="exec-spec")
+        self.thread = t
+        t.start()
+
+    def matches(self, height: int, block_hash: bytes,
+                base_app_hash: bytes) -> bool:
+        return (self.height == height
+                and self.block_hash == block_hash
+                and self.base_app_hash == base_app_hash)
+
+    def abandon(self) -> None:
+        """Mark the slot dead without waiting for the worker; whoever
+        holds the session (worker or us) discards it."""
+        with self._lock:
+            self._abandoned = True
+            run, self.run = self.run, None
+        if run is not None:
+            self.app.exec_discard(run.session)
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[BlockRun]:
+        """Block until the worker finishes; returns the run (or None if
+        it failed/was abandoned). The caller takes ownership of the
+        session."""
+        self._done.wait(timeout)
+        with self._lock:
+            run, self.run = self.run, None
+        return run
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self.thread
+        if t is not None:
+            t.join(timeout)

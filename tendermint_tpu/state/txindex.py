@@ -64,6 +64,20 @@ class TxIndexer:
     def search(self, query: Query) -> List[TxResult]:
         raise NotImplementedError
 
+    def indexed_height(self) -> int:
+        """Highest block height this indexer has ingested txs for."""
+        return 0
+
+    def index_generation(self) -> int:
+        """Monotonic count of index() ingests — the generation key the
+        RPC cache stamps tx_search results with. A search result is a
+        pure function of the index contents, and the contents change
+        exactly when this advances; keying by per-TX generation (not
+        indexed height, which bumps on a block's FIRST tx) means a
+        result computed mid-block-ingest can never be served once the
+        rest of the block lands."""
+        return 0
+
 
 class NullTxIndexer(TxIndexer):
     """reference state/txindex/null/null.go"""
@@ -100,9 +114,22 @@ class KVTxIndexer(TxIndexer):
         self._tags = set(index_tags or [])
         self._all = index_all_tags
         self._lock = threading.Lock()
+        self._indexed_height = 0
+        self._index_generation = 0
+
+    def indexed_height(self) -> int:
+        with self._lock:
+            return self._indexed_height
+
+    def index_generation(self) -> int:
+        with self._lock:
+            return self._index_generation
 
     def index(self, result: TxResult) -> None:
         with self._lock:
+            self._index_generation += 1
+            if result.height > self._indexed_height:
+                self._indexed_height = result.height
             h = tx_hash(result.tx)
             batch = self._db.batch()
             for kv in result.result.tags:

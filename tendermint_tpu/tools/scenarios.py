@@ -50,6 +50,26 @@ def _load_factor() -> float:
         return 1.0
 
 
+# --parallel-exec N (or TM_TPU_SCENARIO_EXEC_LANES): every ScenarioNode
+# runs [execution] parallel_lanes=N + speculative=true against a
+# ShardedKVStoreApplication, so the chaos suite exercises the PR-12
+# lane scheduler under partitions/churn (0 = serial, the default)
+_PARALLEL_EXEC_LANES = [0]
+
+
+def parallel_exec_lanes() -> int:
+    if _PARALLEL_EXEC_LANES[0]:
+        return _PARALLEL_EXEC_LANES[0]
+    try:
+        return max(0, int(os.environ.get("TM_TPU_SCENARIO_EXEC_LANES", "0")))
+    except ValueError:
+        return 0
+
+
+def set_parallel_exec_lanes(n: int) -> None:
+    _PARALLEL_EXEC_LANES[0] = max(0, int(n))
+
+
 # warm/converge budgets scale with TM_TPU_TEST_LOAD_FACTOR: a loaded CI
 # box gets slack, a laptop stays fast (same knob the deflaked multi-node
 # tier-1 tests use). Generous defaults: in-process localnets on a
@@ -94,8 +114,17 @@ class ScenarioNode:
 
         db = MemDB()
         self.state = sm.load_state_from_db_or_genesis(db, doc)
-        self.app = (app_factory() if app_factory is not None
-                    else KVStoreApplication())
+        if app_factory is not None:
+            self.app = app_factory()
+        elif parallel_exec_lanes() > 0:
+            # --parallel-exec runs: the default app must carry the
+            # exec-session surface or the lanes silently fall back
+            from ..abci.example.sharded_kvstore import (
+                ShardedKVStoreApplication)
+
+            self.app = ShardedKVStoreApplication()
+        else:
+            self.app = KVStoreApplication()
         self.conns = AppConns(local_client_creator(self.app))
         self.conns.start()
         # the full node runs the ABCI handshake which InitChains the
@@ -113,9 +142,13 @@ class ScenarioNode:
         self.mempool = Mempool(cfg.MempoolConfig(), self.conns.mempool)
         self.bus = EventBus()
         self.bus.start()
+        exec_cfg = None
+        if parallel_exec_lanes() > 0:
+            exec_cfg = cfg.ExecutionConfig(
+                parallel_lanes=parallel_exec_lanes(), speculative=True)
         block_exec = sm.BlockExecutor(
             db, self.conns.consensus, mempool=self.mempool,
-            event_bus=self.bus)
+            event_bus=self.bus, exec_config=exec_cfg)
         self.bstore = BlockStore(MemDB())
         self.evpool = EvidencePool(EvidenceStore(MemDB()), self.state)
         self.ev_reactor = EvidenceReactor(self.evpool)
@@ -411,6 +444,16 @@ def delay_jitter(seed: int = 3, n: int = 3, fault_s: float = 10.0) -> dict:
 
 
 def _churn_factory(seed: int, epoch_blocks: int = 2, pool: int = 6):
+    # under --parallel-exec the churn scenarios must still exercise the
+    # lane scheduler: ShardedKVStoreApplication subclasses the churn app
+    # (same rotation semantics) and adds the exec-session surface — a
+    # plain ChurnKVStore would silently fall back to serial execution
+    if parallel_exec_lanes() > 0:
+        from ..abci.example.sharded_kvstore import ShardedKVStoreApplication
+
+        return lambda: ShardedKVStoreApplication(
+            MemDB(), epoch_blocks=epoch_blocks, rotation_fraction=0.5,
+            phantom_pool=pool, seed=seed)
     from ..abci.example.kvstore import ChurnKVStoreApplication
 
     return lambda: ChurnKVStoreApplication(
@@ -656,7 +699,13 @@ def main(argv=None) -> int:
     p.add_argument("--lockdep", action="store_true",
                    help="run under the runtime lock-discipline checker;"
                         " any lock-order inversion fails the scenario")
+    p.add_argument("--parallel-exec", type=int, default=0, metavar="LANES",
+                   help="run every node with [execution] parallel_lanes="
+                        "LANES + speculative=true against a sharded "
+                        "kvstore app (0 = serial, default)")
     args = p.parse_args(argv)
+    if args.parallel_exec:
+        set_parallel_exec_lanes(args.parallel_exec)
     names = sorted(SCENARIOS) if args.name == "all" else [args.name]
     rc = 0
     for name in names:

@@ -60,6 +60,10 @@ _THREAD_FAMILIES = (
     "replica-telemetry",  # replica-mode telemetry ticker
     "lockdep",            # lockdep reporter/debug threads (PR-11)
     "tx-indexer",         # indexer service drainer (joined on stop)
+    "exec-lane",          # parallel block-execution lane workers (PR-12;
+                          # joined per segment by state/parallel.py)
+    "exec-spec",          # speculative block execution (PR-12; settled
+                          # by BlockExecutor.stop / _take_speculation)
 )
 
 # Daemons allowed to outlive a test: process-wide singletons that are

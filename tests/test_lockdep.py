@@ -476,3 +476,22 @@ def test_churn_storm_under_lockdep():
     assert res["lockdep"]["inversions"] == 0, \
         res["lockdep"]["inversion_detail"]
     assert res["ok"], res
+
+
+@pytest.mark.slow
+def test_partition_heal_under_lockdep_with_parallel_exec():
+    """partition_heal with PR-12 parallel execution enabled on every
+    node ([execution] parallel_lanes=4 + speculative, sharded kvstore
+    app) still completes under lockdep with ZERO inversions — the lane
+    scheduler and speculation threads introduce no lock-order hazard
+    (PR-12 acceptance pin; same shape as the PR-11 oracle above)."""
+    from tendermint_tpu.tools import scenarios
+
+    scenarios.set_parallel_exec_lanes(4)
+    try:
+        res = scenarios.run("partition_heal", seed=1, lockdep_on=True)
+    finally:
+        scenarios.set_parallel_exec_lanes(0)
+    assert res["lockdep"]["inversions"] == 0, \
+        res["lockdep"]["inversion_detail"]
+    assert res["ok"], res

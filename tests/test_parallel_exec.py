@@ -1,0 +1,697 @@
+"""PR-12 deterministic parallel block execution.
+
+The conformance contract: for ANY block, the optimistic parallel lane
+(state/parallel.py + the sharded app's overlay sessions) must produce
+app state and ABCIResponses BYTE-IDENTICAL to the serial oracle
+(BlockExecutor.exec_block_on_proxy_app semantics). The conflict-fuzz
+property suite drives seeded random workloads — overlapping key
+distributions, lying access hints, unhinted barriers, read-dependent
+write targets — across lane counts 1..8 and asserts exactly that.
+Speculation tests pin that a discarded speculative execution leaves
+zero trace and a matching one is adopted.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.example.sharded_kvstore import (
+    ShardedKVStoreApplication,
+)
+from tendermint_tpu.config import ExecutionConfig
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db import MemDB
+from tendermint_tpu.mempool.preverify import make_signed_tx, parse
+from tendermint_tpu.state import parallel as par
+
+
+# --- envelope v2 ------------------------------------------------------
+
+
+def test_envelope_v2_roundtrip_and_signature_covers_hints():
+    sk = PrivKeyEd25519.generate()
+    tx = make_signed_tx(sk, b"k=v", priority=7,
+                        hints=[b"kv:k", b"kv:other"])
+    p = parse(tx)
+    assert p is not None
+    assert p.priority == 7
+    assert p.hints == (b"kv:k", b"kv:other")
+    assert p.payload == b"k=v"
+    assert p.verify()
+    # tampering with a declared hint must invalidate the signature
+    idx = tx.index(b"kv:other")
+    forged = tx[:idx] + b"kv:OTHER" + tx[idx + len(b"kv:other"):]
+    fp = parse(forged)
+    assert fp is not None and fp.hints == (b"kv:k", b"kv:OTHER")
+    assert not fp.verify()
+
+
+def test_envelope_v1_unchanged_and_malformed_v2_is_plain():
+    sk = PrivKeyEd25519.generate()
+    v1 = make_signed_tx(sk, b"payload", priority=2)
+    p = parse(v1)
+    assert p is not None and p.hints == () and p.verify()
+    # truncated v2: magic + garbage → opaque app bytes, not an error
+    assert parse(b"sgtx2\x01\xff") is None
+    # nhints pointing past the end
+    assert parse(b"sgtx2\x00\x05\xff") is None
+
+
+def test_make_signed_tx_hint_bounds():
+    sk = PrivKeyEd25519.generate()
+    with pytest.raises(ValueError):
+        make_signed_tx(sk, b"p", hints=[b""])
+    with pytest.raises(ValueError):
+        make_signed_tx(sk, b"p", hints=[b"x" * 256])
+
+
+# --- planner ----------------------------------------------------------
+
+
+def test_plan_block_groups_and_barriers():
+    f = [frozenset((b"a",)), frozenset((b"b",)), None,
+         frozenset((b"a", b"c")), frozenset((b"c",))]
+    plan = par.plan_block(f)
+    # segment 1: txs 0,1 in two disjoint groups; barrier tx 2;
+    # segment 3: txs 3,4 merged (share key c)
+    assert len(plan.segments) == 3
+    s0, s1, s2 = plan.segments
+    assert not s0.is_barrier and sorted(map(tuple, s0.groups)) == [(0,), (1,)]
+    assert s1.is_barrier and s1.serial_idx == 2
+    assert not s2.is_barrier and s2.groups == [[3, 4]]
+    assert plan.barrier_txs == 1 and plan.parallel_txs == 4
+
+
+def test_plan_block_transitive_union():
+    f = [frozenset((b"a", b"b")), frozenset((b"b", b"c")),
+         frozenset((b"c", b"d")), frozenset((b"e",))]
+    plan = par.plan_block(f)
+    assert len(plan.segments) == 1
+    groups = sorted(map(tuple, plan.segments[0].groups))
+    assert groups == [(0, 1, 2), (3,)]
+
+
+# --- conformance helpers ----------------------------------------------
+
+
+def _serial_oracle(app, txs, height=1):
+    app.begin_block(abci.RequestBeginBlock())
+    dres = [app.deliver_tx(tx) for tx in txs]
+    eres = app.end_block(abci.RequestEndBlock(height=height))
+    commit = app.commit()
+    return dres, eres, commit.data
+
+
+def _parallel_run(app, txs, lanes, height=1):
+    run = par.run_block(app, txs, abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=height), lanes=lanes)
+    app.exec_promote(run.session)
+    commit = app.commit()
+    return run, commit.data
+
+
+def _seeded_workload(rng, n_txs, n_keys, sk):
+    """Mixed tx soup: plain writes, counters, copies, indirect writes,
+    correctly-hinted envelopes, LYING envelopes (declared footprint !=
+    touched keys — must be caught, not trusted), and val-free barriers."""
+    txs = []
+    keys = [b"k%02d" % i for i in range(n_keys)]
+    for i in range(n_txs):
+        roll = rng.random()
+        k = rng.choice(keys)
+        k2 = rng.choice(keys)
+        if roll < 0.35:
+            body = k + b"=v%04d" % rng.randrange(10000)
+        elif roll < 0.55:
+            body = b"inc:" + k
+        elif roll < 0.70:
+            body = b"cp:" + k + b":" + k2
+        elif roll < 0.78:
+            body = b"ind:" + k + b":p%03d" % rng.randrange(1000)
+        elif roll < 0.90:
+            # correctly hinted envelope around a write/counter
+            inner = (k + b"=h%04d" % rng.randrange(10000)
+                     if rng.random() < 0.5 else b"inc:" + k)
+            txs.append(make_signed_tx(
+                sk, inner, priority=rng.randrange(2),
+                hints=sorted({b"kv:" + k})))
+            continue
+        else:
+            # LYING hints: declare a different key than the one touched
+            wrong = rng.choice(keys)
+            body = b"cp:" + k + b":" + k2
+            txs.append(make_signed_tx(
+                sk, body, priority=0, hints=[b"kv:" + wrong]))
+            continue
+        txs.append(body)
+    return txs
+
+
+@pytest.mark.parametrize("lanes", [1, 2, 3, 4, 8])
+def test_conflict_fuzz_parallel_equals_serial(lanes):
+    """THE conformance property: seeded random mixed workloads, lane
+    counts 1..8 — parallel app hash AND per-tx responses byte-identical
+    to the serial oracle."""
+    from tendermint_tpu.state.execution import ABCIResponses
+
+    for seed in range(6):
+        rng = random.Random(1000 * lanes + seed)
+        sk = PrivKeyEd25519.generate()
+        txs = _seeded_workload(rng, n_txs=rng.randrange(5, 40),
+                               n_keys=rng.randrange(2, 10), sk=sk)
+        a = ShardedKVStoreApplication(MemDB(), shards=rng.choice([1, 4, 16]))
+        b = ShardedKVStoreApplication(MemDB(), shards=rng.choice([1, 4, 16]))
+        # seed some pre-state so reads/indirect pointers have targets
+        for app in (a, b):
+            for j in range(3):
+                app.deliver_tx(b"k%02d=seed%d" % (j, j))
+            app.commit()
+        d1, e1, h1 = _serial_oracle(a, txs, height=2)
+        run, h2 = _parallel_run(b, txs, lanes, height=2)
+        assert h1 == h2, f"app hash diverged (seed={seed}, lanes={lanes})"
+        r1 = ABCIResponses(d1, e1)
+        r2 = ABCIResponses(run.deliver_res, run.end_res)
+        assert r1.to_bytes() == r2.to_bytes(), (
+            f"responses diverged (seed={seed}, lanes={lanes})")
+        assert r1.results_hash() == r2.results_hash()
+
+
+def test_mid_block_conflict_is_detected_and_rerun():
+    """Two groups whose DECLARED footprints are disjoint but whose
+    observed accesses collide: the later tx must be re-run and the
+    result must still match serial."""
+    sk = PrivKeyEd25519.generate()
+    # tx0 claims kv:q but actually writes kv:b (cp a->b);
+    # tx1 honestly declares kv:b (inc b) — different groups, real overlap
+    tx0 = make_signed_tx(sk, b"cp:a:b", hints=[b"kv:q"])
+    tx1 = make_signed_tx(sk, b"inc:b", hints=[b"kv:b"])
+    txs = [tx0, tx1]
+    a = ShardedKVStoreApplication(MemDB())
+    b = ShardedKVStoreApplication(MemDB())
+    for app in (a, b):
+        app.deliver_tx(b"a=base")
+        app.commit()
+    d1, e1, h1 = _serial_oracle(a, txs, height=2)
+    run, h2 = _parallel_run(b, txs, lanes=2, height=2)
+    assert h1 == h2
+    assert [r.data for r in run.deliver_res] == [r.data for r in d1]
+    assert run.conflicts >= 1  # the overlap was observed, not trusted
+
+
+def test_unresolvable_conflict_falls_back_to_serial():
+    """A lying hint around an INDIRECT write (target depends on a read)
+    can invalidate a clean tx on re-run → full serial-through-overlay
+    fallback, still byte-identical to serial."""
+    sk = PrivKeyEd25519.generate()
+    # pointer p starts at "x". tx0 (lying hints {kv:z}) writes p=y —
+    # group Z. tx1 honestly hinted {kv:p} reads p... build several
+    # interleavings; the exact fallback trigger depends on scheduling,
+    # so assert only on CONFORMANCE plus that the path executes.
+    txs = [
+        make_signed_tx(sk, b"ind:p:AAA", hints=[b"kv:z"]),   # lies
+        make_signed_tx(sk, b"p=y", hints=[b"kv:p"]),
+        make_signed_tx(sk, b"ind:p:BBB", hints=[b"kv:w"]),   # lies
+        make_signed_tx(sk, b"cp:p:out", hints=[b"kv:p", b"kv:out"]),
+    ]
+    for lanes in (2, 4):
+        a = ShardedKVStoreApplication(MemDB())
+        b = ShardedKVStoreApplication(MemDB())
+        for app in (a, b):
+            app.deliver_tx(b"p=x")
+            app.commit()
+        d1, e1, h1 = _serial_oracle(a, txs, height=2)
+        run, h2 = _parallel_run(b, txs, lanes, height=2)
+        assert h1 == h2
+        assert [r.data for r in run.deliver_res] == [r.data for r in d1]
+
+
+def test_unhinted_txs_serialize_as_barriers():
+    app = ShardedKVStoreApplication(MemDB())
+    infer = app.infer_footprint
+    # ind: and val: infer None → barrier
+    assert infer(b"ind:p:v") is None
+    assert infer(b"val:aa!1") is None
+    assert infer(b"a=1") == frozenset((b"kv:a",))
+    plan = par.plan_block([par.tx_footprint(b"a=1", infer),
+                           par.tx_footprint(b"ind:p:v", infer),
+                           par.tx_footprint(b"b=1", infer)])
+    assert [s.is_barrier for s in plan.segments] == [False, True, False]
+
+
+def test_churn_end_block_identical_through_overlay():
+    """EndBlock rotation (db iteration + writes) through the exec
+    session matches the serial run — the churn workload composes."""
+    a = ShardedKVStoreApplication(MemDB(), epoch_blocks=1, phantom_pool=4,
+                                  rotation_fraction=0.5, seed=3)
+    b = ShardedKVStoreApplication(MemDB(), epoch_blocks=1, phantom_pool=4,
+                                  rotation_fraction=0.5, seed=3)
+    init = abci.RequestInitChain(validators=[
+        abci.ValidatorUpdate(pub_key=b"\x01" * 33, power=20)])
+    a.init_chain(init)
+    b.init_chain(init)
+    txs = [b"x=1", b"inc:c", b"y=2"]
+    d1, e1, h1 = _serial_oracle(a, txs, height=1)
+    run, h2 = _parallel_run(b, txs, lanes=4, height=1)
+    assert h1 == h2
+    assert len(e1.validator_updates) > 0  # rotation actually fired
+    assert [(u.pub_key, u.power) for u in e1.validator_updates] == \
+        [(u.pub_key, u.power) for u in run.end_res.validator_updates]
+    assert a.epochs_run == b.epochs_run == 1
+
+
+# --- BlockExecutor integration + speculation --------------------------
+
+
+class _Hdr:
+    def __init__(self, height):
+        self.height = height
+        self.time = time.time_ns()
+
+
+class _Data:
+    def __init__(self, txs):
+        self.txs = txs
+
+
+class _Ev:
+    evidence = ()
+
+
+class _FakeBlock:
+    """Just enough block for _begin_block_request / speculation keys."""
+
+    def __init__(self, height, txs, tag=b"A"):
+        self.header = _Hdr(height)
+        self.data = _Data(txs)
+        self.evidence = _Ev()
+        self.last_commit = None
+        self._tag = tag
+
+    def hash(self):
+        return b"blk-" + self._tag + b"-%d" % self.header.height
+
+
+class _FakeState:
+    def __init__(self, height, app_hash):
+        self.last_block_height = height
+        self.app_hash = app_hash
+        self.last_validators = None
+
+
+def _executor(app, lanes=2, speculative=True):
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    bexec = sm.BlockExecutor(
+        MemDB(), conns.consensus,
+        exec_config=ExecutionConfig(parallel_lanes=lanes,
+                                    speculative=speculative))
+    return bexec, conns
+
+
+def test_speculation_adopted_on_matching_block():
+    app = ShardedKVStoreApplication(MemDB())
+    app.deliver_tx(b"seed=1")
+    base_hash = app.commit().data
+    bexec, conns = _executor(app)
+    try:
+        state = _FakeState(1, base_hash)
+        block = _FakeBlock(2, [b"a=1", b"b=2"])
+        assert bexec.begin_speculation(state, block)
+        responses = bexec._exec_block(state, block)
+        assert len(responses.deliver_tx) == 2
+        assert all(r.is_ok for r in responses.deliver_tx)
+        # promoted: visible in app base state now
+        assert app.base_db().get(b"kv:a") == b"1"
+        assert app.size == 3
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_speculation_discarded_on_mismatched_block():
+    """Decided block != proposed block: the speculative session must
+    leave ZERO trace and the decided block's execution must win."""
+    app = ShardedKVStoreApplication(MemDB())
+    app.deliver_tx(b"seed=1")
+    base_hash = app.commit().data
+    bexec, conns = _executor(app)
+    try:
+        state = _FakeState(1, base_hash)
+        proposed = _FakeBlock(2, [b"a=SPECULATIVE", b"leak=yes"], tag=b"A")
+        decided = _FakeBlock(2, [b"a=DECIDED"], tag=b"B")
+        assert bexec.begin_speculation(state, proposed)
+        responses = bexec._exec_block(state, decided)
+        bexec.stop()  # settle the abandoned worker before asserting
+        assert len(responses.deliver_tx) == 1
+        assert app.base_db().get(b"kv:a") == b"DECIDED"
+        assert app.base_db().get(b"kv:leak") is None  # no speculative leak
+        assert app.size == 2  # seed + decided tx only
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_speculation_never_visible_before_finalize():
+    app = ShardedKVStoreApplication(MemDB())
+    app.deliver_tx(b"seed=1")
+    base_hash = app.commit().data
+    bexec, conns = _executor(app)
+    try:
+        state = _FakeState(1, base_hash)
+        block = _FakeBlock(2, [b"vis=no"])
+        assert bexec.begin_speculation(state, block)
+        # wait for the worker to finish WITHOUT adopting
+        with bexec._spec_lock:
+            slot = bexec._spec_slot
+        assert slot is not None
+        slot.join(timeout=10)
+        # speculative writes must not be visible through any base read
+        assert app.base_db().get(b"kv:vis") is None
+        assert app.size == 1
+        q = app.query(abci.RequestQuery(data=b"vis", path="/store"))
+        assert q.value == b""
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_speculation_restarts_on_new_proposal():
+    app = ShardedKVStoreApplication(MemDB())
+    base_hash = app.commit().data
+    bexec, conns = _executor(app)
+    try:
+        state = _FakeState(1, base_hash)
+        b1 = _FakeBlock(2, [b"one=1"], tag=b"A")
+        b2 = _FakeBlock(2, [b"two=2"], tag=b"B")
+        assert bexec.begin_speculation(state, b1)
+        assert not bexec.begin_speculation(state, b1)  # same block: no-op
+        assert bexec.begin_speculation(state, b2)      # replaced
+        responses = bexec._exec_block(state, b2)
+        assert len(responses.deliver_tx) == 1
+        assert app.base_db().get(b"kv:two") == b"2"
+        assert app.base_db().get(b"kv:one") is None
+        assert bexec.metrics.exec_speculation_wasted is not None
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_parallel_lanes_via_block_executor_without_capable_app():
+    """[execution] parallel_lanes>1 against a plain kvstore app must
+    fall back to the serial oracle (warn once), not crash."""
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    app = KVStoreApplication()
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    try:
+        bexec = sm.BlockExecutor(
+            MemDB(), conns.consensus,
+            exec_config=ExecutionConfig(parallel_lanes=4, speculative=True))
+        state = _FakeState(0, b"")
+        block = _FakeBlock(1, [b"k=v"])
+        assert not bexec.begin_speculation(state, block)  # not capable
+        responses = bexec._exec_block(state, block)
+        assert responses.deliver_tx[0].is_ok
+        assert app.db.get(b"kv:k") == b"v"
+        bexec.stop()
+    finally:
+        conns.stop()
+
+
+def test_exec_defaults_keep_serial_path():
+    """[execution] defaults: _exec_block must route through the plain
+    serial oracle — no sessions opened, no speculation machinery."""
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    app = ShardedKVStoreApplication(MemDB())
+    opened = []
+    orig = app.exec_open
+    app.exec_open = lambda n: (opened.append(n), orig(n))[1]
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    try:
+        bexec = sm.BlockExecutor(MemDB(), conns.consensus)
+        assert not bexec.speculation_enabled
+        responses = bexec._exec_block(_FakeState(0, b""),
+                                      _FakeBlock(1, [b"k=v"]))
+        assert responses.deliver_tx[0].is_ok
+        assert opened == []  # serial oracle, no overlay session
+        bexec.stop()
+    finally:
+        conns.stop()
+
+
+def test_live_consensus_parallel_speculative_e2e():
+    """Single-validator localnet with [execution] parallel_lanes=4 +
+    speculative against the sharded app: blocks with mixed hinted/plain
+    txs commit, speculation is adopted, and the committed state matches
+    an offline serial replay of exactly the committed blocks."""
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.consensus import ConsensusState
+    from tendermint_tpu.crypto import batch as crypto_batch
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.metrics import StateMetrics
+    from tendermint_tpu.privval import FilePV
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK, EventBus, query_for_event)
+    from tendermint_tpu.types.validator_set import random_validator_set
+
+    class _Ctr:
+        def __init__(self):
+            self.value = 0
+
+        def inc(self, n=1):
+            self.value += n
+
+        def set(self, v):
+            self.value = v
+
+        def observe(self, v):
+            pass
+
+    crypto_batch.set_default_backend("cpu")
+    vs, vkeys = random_validator_set(1, 10)
+    doc = GenesisDoc(
+        chain_id="par-e2e", genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(v.pub_key, v.voting_power)
+                    for v in vs.validators])
+    db = MemDB()
+    state = sm.load_state_from_db_or_genesis(db, doc)
+    app = ShardedKVStoreApplication(MemDB(), shards=8)
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    mp = Mempool(cfg.MempoolConfig(size=5000, recheck=False), conns.mempool)
+    bus = EventBus()
+    bus.start()
+    metrics = StateMetrics(
+        block_processing_time=_Ctr(), validator_updates=_Ctr(),
+        valset_changes=_Ctr(), exec_parallel_lanes=_Ctr(),
+        exec_conflicts=_Ctr(), exec_speculation_hits=_Ctr(),
+        exec_speculation_wasted=_Ctr())
+    bexec = sm.BlockExecutor(
+        db, conns.consensus, mempool=mp, event_bus=bus, metrics=metrics,
+        exec_config=ExecutionConfig(parallel_lanes=4, speculative=True))
+    cs = ConsensusState(
+        cfg.test_config().consensus, state, bexec, BlockStore(MemDB()),
+        mempool=mp, event_bus=bus, priv_validator=FilePV(vkeys[0], None))
+    sub = bus.subscribe("par-e2e", query_for_event(EVENT_NEW_BLOCK), 256)
+    cs.start()
+    try:
+        sk = PrivKeyEd25519.generate()
+        want = []
+        for i in range(30):
+            if i % 5 == 4:
+                # plain counter, inferred footprint; two distinct keys
+                # so some blocks carry same-key (ordered) pairs
+                want.append(b"inc:ctr%d=%02d" % (i % 2, i))
+            elif i % 7 == 6:
+                want.append(make_signed_tx(
+                    sk, b"h%02d=sig" % i, hints=[b"kv:h%02d" % i]))
+            else:
+                want.append(b"p%02d=val" % i)
+        for tx in want:
+            assert mp.check_tx(tx).is_ok
+        committed_blocks = []
+        seen = 0
+        deadline = time.time() + 60
+        while seen < len(want) and time.time() < deadline:
+            msg = sub.get(timeout=1.0)
+            if msg is None:
+                continue
+            blk = msg.data["block"]
+            committed_blocks.append(blk)
+            seen += len(blk.data.txs)
+        assert seen >= len(want), f"only {seen} txs committed"
+        assert metrics.exec_speculation_hits.value > 0
+    finally:
+        cs.stop()
+        bus.stop()
+        mp.stop()
+        conns.stop()
+        crypto_batch.shutdown_dispatchers()
+
+    # offline serial replay of exactly the committed blocks on a fresh
+    # app must land on the same final app hash
+    oracle = ShardedKVStoreApplication(MemDB(), shards=8)
+    final = b""
+    for blk in committed_blocks:
+        oracle.begin_block(abci.RequestBeginBlock())
+        for tx in blk.data.txs:
+            oracle.deliver_tx(tx)
+        oracle.end_block(abci.RequestEndBlock(height=blk.header.height))
+        final = oracle.commit().data
+    assert final == app.app_hash
+
+
+# --- socket DeliverTx pipelining (satellite 1) ------------------------
+
+
+def test_socket_deliver_tx_batch_matches_loop():
+    from tendermint_tpu.abci.client import SocketClient
+    from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+    from tendermint_tpu.abci.server import ABCIServer
+
+    srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApplication())
+    srv.start()
+    try:
+        addr = f"tcp://127.0.0.1:{srv.local_port()}"
+        txs = [b"s%03d=v" % i for i in range(150)]  # > DELIVER_TX_WINDOW
+        c1 = SocketClient(addr)
+        loop_res = [c1.deliver_tx(tx) for tx in txs]
+        c1.close()
+        c2 = SocketClient(addr)
+        batch_res = c2.deliver_tx_batch(txs)
+        c2.close()
+        assert loop_res == batch_res
+    finally:
+        srv.stop()
+
+
+def test_socket_deliver_tx_batch_timeout_breaks_conn():
+    import socket as _socket
+    import struct as _struct
+
+    from tendermint_tpu.abci.client import (
+        ABCIConnectionError, ABCITimeoutError, SocketClient)
+
+    # a wedged "app": accepts the connection, never responds
+    lst = _socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    try:
+        c = SocketClient(f"tcp://127.0.0.1:{lst.getsockname()[1]}",
+                         request_timeout=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(ABCITimeoutError):
+            c.deliver_tx_batch([b"a", b"b", b"c"])
+        assert time.monotonic() - t0 < 3.0
+        with pytest.raises(ABCIConnectionError):
+            c.deliver_tx(b"x")  # conn marked broken
+    finally:
+        lst.close()
+
+
+def test_local_client_batch_equals_loop():
+    from tendermint_tpu.proxy import local_client_creator
+
+    app = ShardedKVStoreApplication(MemDB())
+    c = local_client_creator(app)()
+    txs = [b"a=1", b"inc:a", b"cp:a:b"]
+    res = c.deliver_tx_batch(txs)
+    assert [r.code for r in res] == [0, 0, 0]
+    # a=1, then inc bumps a to 2, then cp copies the bumped value
+    assert app.db.get(b"kv:b") == b"2"
+
+
+# --- mempool envelope-v2 integration ----------------------------------
+
+
+def test_mempool_admits_v2_envelopes_with_priority_lanes():
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    app = ShardedKVStoreApplication(MemDB())
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    try:
+        mp = Mempool(MempoolConfig(lanes=2), conns.mempool)
+        sk = PrivKeyEd25519.generate()
+        hi = make_signed_tx(sk, b"hi=1", priority=1, hints=[b"kv:hi"])
+        lo = make_signed_tx(sk, b"lo=1", priority=0, hints=[b"kv:lo"])
+        assert mp.check_tx(lo).is_ok
+        assert mp.check_tx(hi).is_ok
+        reaped = mp.reap_max_txs(-1)
+        assert reaped == [hi, lo]  # priority desc — v2 priority honored
+        # bad signature on a v2 envelope is rejected by the NODE
+        bad = bytearray(make_signed_tx(sk, b"x=1", hints=[b"kv:x"]))
+        bad[-1] ^= 0xFF
+        res = mp.check_tx(bytes(bad))
+        assert res.code != 0
+        mp.stop()
+    finally:
+        conns.stop()
+
+
+def test_execution_config_toml_roundtrip_and_defaults():
+    from tendermint_tpu.config import Config
+
+    c = Config()
+    assert c.execution.parallel_lanes == 1  # serial oracle by default
+    assert c.execution.speculative is False
+    c.execution.parallel_lanes = 8
+    c.execution.speculative = True
+    out = Config.from_toml(c.to_toml())
+    assert out.execution.parallel_lanes == 8
+    assert out.execution.speculative is True
+    # absent section keeps the serial defaults
+    d = Config.from_toml("[rpc]\nmax_open_connections = 5\n")
+    assert d.execution.parallel_lanes == 1
+    assert d.execution.speculative is False
+
+
+# --- lane/thread hygiene ---------------------------------------------
+
+
+def test_lane_threads_join_per_segment():
+    app = ShardedKVStoreApplication(MemDB())
+    txs = [b"k%d=v" % i for i in range(20)]
+    run = par.run_block(app, txs, abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=1), lanes=8)
+    app.exec_promote(run.session)
+    alive = [t for t in threading.enumerate()
+             if t.name.startswith("exec-lane")]
+    assert alive == []
+
+
+def test_lane_worker_exception_propagates_and_discards():
+    class Boom(ShardedKVStoreApplication):
+        def deliver_tx(self, tx):
+            if self.tx_body(tx).startswith(b"boom"):
+                raise RuntimeError("app exploded")
+            return super().deliver_tx(tx)
+
+    app = Boom(MemDB())
+    txs = [b"a=1", b"boom=1", b"b=2"]
+    with pytest.raises(RuntimeError):
+        par.run_block(app, txs, abci.RequestBeginBlock(),
+                      abci.RequestEndBlock(height=1), lanes=4)
+    # failed run discarded: no leak into base state
+    assert app.base_db().get(b"kv:a") is None
+    alive = [t for t in threading.enumerate()
+             if t.name.startswith("exec-lane")]
+    assert alive == []

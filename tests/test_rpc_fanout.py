@@ -109,7 +109,8 @@ def test_cache_disabled_is_noop():
 
 def test_cache_plan_keys():
     env = types.SimpleNamespace(
-        block_store=types.SimpleNamespace(height=lambda: 10))
+        block_store=types.SimpleNamespace(height=lambda: 10),
+        tx_indexer=types.SimpleNamespace(index_generation=lambda: 7))
     plan = rpc_core.cache_plan
     assert plan(env, "status", {}) == ((), True)
     assert plan(env, "genesis", {}) == ((), False)
@@ -129,8 +130,17 @@ def test_cache_plan_keys():
         == ((1, 5), True)
     assert plan(env, "blockchain", {"maxHeight": -1})[1] is True
     assert plan(env, "blockchain", {})[1] is True
+    # tx_search: generational, keyed by (query, page, per_page) AND the
+    # indexer's per-tx ingest generation — any ingest rotates the key
+    # (height would miss a block's 2nd..nth tx landing)
+    assert plan(env, "tx_search", {"query": "app.key='x'"}) \
+        == (("app.key='x'", 1, 30, 7), True)
+    assert plan(env, "tx_search",
+                {"query": "q", "page": 2, "per_page": 500}) \
+        == (("q", 2, 100, 7), True)  # per_page clamped like the handler
+    assert plan(env, "tx_search", {}) is None  # missing query: real error
     # non-cacheable routes never plan
-    for m in ("net_info", "tx", "tx_search", "abci_query",
+    for m in ("net_info", "tx", "abci_query",
               "broadcast_tx_sync", "unconfirmed_txs",
               "dump_consensus_state"):
         assert plan(env, m, {}) is None
@@ -402,6 +412,45 @@ def test_cache_hits_recorded_and_http_served(fanout_node):
     assert srv.cache.hits > h0
     st = srv.cache.stats()
     assert st["enabled"] and st["bytes"] > 0 and st["entries"] > 0
+
+
+def test_tx_search_cached_through_rpccache(fanout_node):
+    """Satellite: tx_search serves through the RPCCache — byte-identical
+    cached vs fresh, hits recorded, and the entry key rotates with the
+    indexer's per-tx ingest generation so a result computed against an
+    older (or mid-block partial) index is never served once more txs
+    land."""
+    node, client = fanout_node
+    srv = node._rpc_server
+    res = client.broadcast_tx_commit(b"txsearch-cache=probe")
+    assert res["deliver_tx"]["code"] == 0
+    # wait for the async indexer to ingest the committed tx
+    deadline = time.time() + 10
+    while (node.tx_indexer.indexed_height() < int(res["height"])
+           and time.time() < deadline):
+        time.sleep(0.05)
+    params = {"query": f"tx.height={res['height']}"}
+    for _ in range(10):
+        g0 = node.tx_indexer.index_generation()
+        h0, m0 = srv.cache.hits, srv.cache.misses
+        fill = srv.call_bytes("tx_search", params)
+        hit = srv.call_bytes("tx_search", params)
+        saved, srv.cache = srv.cache, None
+        try:
+            fresh = srv.call_bytes("tx_search", params)
+        finally:
+            srv.cache = saved
+        if node.tx_indexer.index_generation() == g0:
+            break
+    else:
+        pytest.fail("no stable index window in 10 tries")
+    assert fill == hit == fresh
+    assert srv.cache.hits > h0 and srv.cache.misses > m0
+    body = json.loads(fresh)
+    assert int(body["total_count"]) >= 1
+    # the key embeds the ingest generation: any further ingest is a miss
+    plan0 = rpc_core.cache_plan(srv.env, "tx_search", params)
+    assert plan0 is not None and plan0[0][-1] == g0
 
 
 def test_stale_status_never_served_past_one_generation(fanout_node):
