@@ -282,6 +282,10 @@ REQUIRED_FAMILIES = (
     "exec_conflicts_total",
     "exec_speculation_hits_total",
     "exec_speculation_wasted_total",
+    # PR-13 commit-path batching: per-stage commit profiler (live once
+    # blocks commit — execute/events/mempool_update record on every
+    # apply_block; index needs an indexing node, wal a consensus WAL)
+    "commit_stage_seconds",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -26,6 +26,7 @@ from .server import MAX_MSG_SIZE
 # this list (adding an ABCI method = add it here + a Client method)
 METHODS = (
     "echo", "flush", "info", "set_option", "query", "check_tx",
+    "check_tx_batch",
     "init_chain", "begin_block", "deliver_tx", "deliver_tx_batch",
     "end_block", "commit",
     "list_snapshots", "load_snapshot_chunk", "offer_snapshot",
@@ -90,6 +91,25 @@ class Client:
 
     def check_tx(self, tx: bytes) -> abci.ResponseCheckTx:
         raise NotImplementedError
+
+    def check_tx_batch(self, txs) -> list:
+        """CheckTx for a batch of txs, in order — the mempool's merged
+        post-commit recheck path. Base implementation is the serial
+        loop; SocketClient pipelines the request frames exactly like
+        deliver_tx_batch. Responses are positionally matched and
+        semantically identical to per-tx calls. On a mid-batch failure
+        the raised exception carries the verdicts already received as
+        `abci_partial_results` (a positional prefix), so callers can
+        apply them exactly like the per-tx loop would have before the
+        failure point."""
+        out: list = []
+        try:
+            for tx in txs:
+                out.append(self.check_tx(tx))
+        except Exception as e:
+            e.abci_partial_results = out
+            raise
+        return out
 
     def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
         raise NotImplementedError
@@ -165,6 +185,18 @@ class LocalClient(Client):
     def check_tx(self, tx):
         with self._lock:
             return self.app.check_tx(tx)
+
+    def check_tx_batch(self, txs):
+        # one lock acquisition for the whole recheck run, not one per tx
+        out = []
+        with self._lock:
+            try:
+                for tx in txs:
+                    out.append(self.app.check_tx(tx))
+            except Exception as e:
+                e.abci_partial_results = out
+                raise
+        return out
 
     def init_chain(self, req):
         with self._lock:
@@ -328,9 +360,17 @@ class SocketClient(Client):
         clock starts when its frame is WRITTEN, so a response that
         fails to arrive within request_timeout of its own send still
         trips ABCITimeoutError and breaks the conn."""
+        return self._pipelined_batch("deliver_tx", txs)
+
+    def check_tx_batch(self, txs):
+        """Pipelined CheckTx — the mempool's merged post-commit recheck
+        rides the same windowed frame pipeline as deliver_tx_batch."""
+        return self._pipelined_batch("check_tx", txs)
+
+    def _pipelined_batch(self, method: str, txs):
         txs = list(txs)
         out = []
-        codec = RESPONSE_CODECS["deliver_tx"]
+        codec = RESPONSE_CODECS[method]
         with self._lock:
             if self._broken:
                 raise ABCIConnectionError(
@@ -352,7 +392,7 @@ class SocketClient(Client):
                             # leftover
                             self._sock.settimeout(self.request_timeout)
                         frame = msgpack.packb(
-                            ["deliver_tx", txs[sent]], use_bin_type=True)
+                            [method, txs[sent]], use_bin_type=True)
                         self._sock.sendall(
                             struct.pack(">I", len(frame)) + frame)
                         deadlines.append(
@@ -371,31 +411,40 @@ class SocketClient(Client):
                     except Exception:
                         self._broken = True
                         raise ABCIConnectionError(
-                            "undecodable response frame for 'deliver_tx'")
+                            f"undecodable response frame for {method!r}")
                     if kind == "exception":
                         # the app raised: the conn is desynchronized for
                         # the frames already written past this response
                         self._broken = True
                         raise ABCIClientError(f"app exception: {body}")
-                    if kind != "deliver_tx":
+                    if kind != method:
                         self._broken = True
                         raise ABCIConnectionError(
-                            f"response {kind!r} for request 'deliver_tx'")
+                            f"response {kind!r} for request {method!r}")
                     out.append(codec.decode(body))
             except socket.timeout:
                 self._broken = True
                 self.close()
-                raise ABCITimeoutError(
-                    f"ABCI deliver_tx (batched) exceeded request_timeout_s="
+                err = ABCITimeoutError(
+                    f"ABCI {method} (batched) exceeded request_timeout_s="
                     f"{self.request_timeout:g} to {self.address}")
-            except ABCIConnectionError:
+                # responses decoded before the failure are real verdicts
+                # — carry them so callers can apply the prefix exactly
+                # like the per-call loop would have
+                err.abci_partial_results = out
+                raise err
+            except ABCIConnectionError as e:
                 self._broken = True
+                e.abci_partial_results = out
                 raise
-            except ABCIClientError:
+            except ABCIClientError as e:
+                e.abci_partial_results = out
                 raise
             except OSError as e:
                 self._broken = True
-                raise ABCIConnectionError(f"ABCI deliver_tx batch failed: {e}")
+                err = ABCIConnectionError(f"ABCI {method} batch failed: {e}")
+                err.abci_partial_results = out
+                raise err
         return out
 
     def end_block(self, req):

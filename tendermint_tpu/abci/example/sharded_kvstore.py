@@ -47,6 +47,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...libs.db import DB
+from ...mempool.preverify import parse as _preverify_parse
 from .. import types as abci
 from .kvstore import ChurnKVStoreApplication
 
@@ -164,6 +165,17 @@ class ExecSession:
         with self._journal_lock:
             self.writes.setdefault(idx, set()).add(key)
 
+    def merge_journal(self, idx: int, reads: set, writes: set) -> None:
+        """Publish a view's thread-local journal. Each idx is owned by
+        exactly one lane thread and the sets are freshly built per view
+        (a re-run cleared the old entry first), so a plain dict store —
+        atomic under the GIL — suffices; readers (_resolve_conflicts,
+        journal()) only run after the lanes joined."""
+        if reads:
+            self.reads[idx] = reads
+        if writes:
+            self.writes[idx] = writes
+
     def journal(self, idx: int) -> Tuple[set, set]:
         with self._journal_lock:
             return (set(self.reads.get(idx, ())),
@@ -189,9 +201,10 @@ class ExecSession:
     # -- buffered instance attrs ---------------------------------------
 
     def merge_scalars(self, idx: int, deltas: Dict[str, int]) -> None:
+        # the dict is freshly built per view and the idx thread-owned:
+        # a GIL-atomic store, same argument as merge_journal
         if deltas:
-            with self._journal_lock:
-                self.scalars[idx] = dict(deltas)
+            self.scalars[idx] = deltas
 
     def scalar_total(self, name: str) -> int:
         with self._journal_lock:
@@ -207,19 +220,34 @@ class ExecSession:
 
 class _SessionView:
     """The DB-shaped, journaling view one tx (or block phase) executes
-    against. Thread-confined: exactly one lane thread uses a view."""
+    against. Thread-confined: exactly one lane thread uses a view, so
+    the access journal accumulates in LOCAL sets and merges into the
+    session once per tx (`flush_journal`) — one journal-lock
+    acquisition per tx instead of one per key access (the old
+    per-access locking serialized all 64 lanes on one lock)."""
+
+    __slots__ = ("session", "idx", "scalar_deltas", "_journaling",
+                 "local_reads", "local_writes")
 
     def __init__(self, session: ExecSession, idx: int):
         self.session = session
         self.idx = idx
         self.scalar_deltas: Dict[str, int] = {}
+        self._journaling = 0 <= idx < session.n_txs
+        self.local_reads: set = set()
+        self.local_writes: set = set()
+
+    def flush_journal(self) -> None:
+        if self._journaling and (self.local_reads or self.local_writes):
+            self.session.merge_journal(self.idx, self.local_reads,
+                                       self.local_writes)
 
     # DB interface used by the kvstore family: get/set/delete/iterator
 
     def get(self, key: bytes):
         s = self.session
-        if 0 <= self.idx < s.n_txs:
-            s.note_read(self.idx, bytes(key))
+        if self._journaling:
+            self.local_reads.add(bytes(key))
         found, val = s.mvcc_get(self.idx, bytes(key))
         if found:
             return val
@@ -227,20 +255,20 @@ class _SessionView:
 
     def set(self, key: bytes, value: bytes) -> None:
         s = self.session
-        if 0 <= self.idx < s.n_txs:
-            s.note_write(self.idx, bytes(key))
+        if self._journaling:
+            self.local_writes.add(bytes(key))
         s.mvcc_put(self.idx, bytes(key), bytes(value))
 
     def delete(self, key: bytes) -> None:
         s = self.session
-        if 0 <= self.idx < s.n_txs:
-            s.note_write(self.idx, bytes(key))
+        if self._journaling:
+            self.local_writes.add(bytes(key))
         s.mvcc_put(self.idx, bytes(key), _TOMBSTONE)
 
     def iterator(self, start, end):
         s = self.session
         over = s.overlay_range(self.idx, start, end)
-        note = 0 <= self.idx < s.n_txs
+        note = self._journaling
         seen = set(over)
         merged = []
         for k, v in s.base.iterator(start, end):
@@ -253,7 +281,7 @@ class _SessionView:
         merged.sort(key=lambda kv: kv[0])
         for k, v in merged:
             if note:
-                s.note_read(self.idx, k)
+                self.local_reads.add(k)
             yield k, v
 
 
@@ -381,10 +409,10 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
     def tx_body(tx: bytes) -> bytes:
         """The app-level payload: enveloped txs unwrap, plain txs pass
         through (differs from the plain kvstore, which hashes whole
-        envelope bytes into keys — documented in PARITY_DEVIATIONS)."""
-        from ...mempool import preverify
-
-        p = preverify.parse(tx)
+        envelope bytes into keys — documented in PARITY_DEVIATIONS).
+        Called at least twice per tx (footprint planning + deliver), so
+        the parser import is hoisted to module scope."""
+        p = _preverify_parse(tx)
         return p.payload if p is not None else tx
 
     @staticmethod
@@ -455,6 +483,7 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
             return fn()
         finally:
             self._tl.view = None
+            view.flush_journal()
             session.merge_scalars(idx, view.scalar_deltas)
 
     def exec_begin_block(self, session: ExecSession, req):

@@ -96,6 +96,11 @@ class BlockchainReactor(Reactor):
             request_fn=self._send_block_request,
             error_fn=self._on_peer_error,
         )
+        # push-based tip announcement (enable_tip_announce)
+        self._tip_bus = None
+        self._tip_sub = None
+        self._tip_thread: Optional[threading.Thread] = None
+        self._tip_subscriber = f"bc-tip-{id(self):x}"
 
     def get_channels(self):
         return [
@@ -110,6 +115,7 @@ class BlockchainReactor(Reactor):
     def start(self) -> None:
         if self.fast_sync:
             self._start_pool()
+        self._start_tip_announce()
 
     def _start_pool(self) -> None:
         self.pool.start()
@@ -142,8 +148,54 @@ class BlockchainReactor(Reactor):
         # request routed to the (dead) pool; re-ask immediately
         self._broadcast_status_request()
 
+    def enable_tip_announce(self, event_bus) -> None:
+        """Arm push-based tip announcement: once started, every
+        committed block (NewBlock on the node's event bus — consensus
+        commits AND replica tail applies both fire it) broadcasts an
+        unsolicited status_response on the blockchain channel, so a
+        tailing replica learns the new height in one RTT instead of
+        waiting out its 0.5s status poll. Peers already absorb
+        unsolicited status_responses (receive() routes them to
+        pool.set_peer_height), so the announcement is wire-compatible
+        with every existing node. The subscription + announcer thread
+        spin up in start() (and are joined by stop()), so an armed but
+        never-started reactor owns no resources."""
+        self._tip_bus = event_bus
+
+    def _start_tip_announce(self) -> None:
+        from ..types.event_bus import EVENT_NEW_BLOCK, query_for_event
+
+        if self._tip_bus is None or self._tip_sub is not None:
+            return
+        self._tip_sub = self._tip_bus.subscribe(
+            self._tip_subscriber, query_for_event(EVENT_NEW_BLOCK), 64)
+        self._tip_thread = threading.Thread(
+            target=self._tip_announce_loop, name="bc-tip-announce",
+            daemon=True)
+        self._tip_thread.start()
+
+    def _tip_announce_loop(self) -> None:
+        sub = self._tip_sub
+        while not self._stop.is_set() and not sub.cancelled:
+            msgs = sub.get_batch(64, timeout=0.5)
+            if not msgs:
+                continue
+            # a burst coalesces: only the newest tip matters, and the
+            # store height is the authoritative one
+            if self.switch is not None:
+                self.switch.broadcast(
+                    BLOCKCHAIN_CHANNEL,
+                    _enc(["status_response", self.store.height()]))
+
     def stop(self) -> None:
         self._stop.set()
+        if self._tip_bus is not None:
+            self._tip_bus.unsubscribe_all(self._tip_subscriber)
+            self._tip_bus = None
+        t = self._tip_thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._tip_thread = None
         self.pool.stop()
 
     # -- peers ---------------------------------------------------------

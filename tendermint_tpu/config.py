@@ -241,6 +241,13 @@ class ExecutionConfig:
 
     parallel_lanes: int = 1
     speculative: bool = False
+    # block-scoped event publish: apply_block hands the whole block's
+    # tx events to the event bus in one publish_batch call (query
+    # matching per distinct tag-shape, one subscriber-buffer lock per
+    # block). Subscriber-observed event sequences are identical to the
+    # per-tx loop (property-tested); False restores the per-tx publish
+    # calls for bisecting.
+    event_batch: bool = True
 
 
 @dataclass
@@ -339,6 +346,12 @@ class TxIndexConfig:
     indexer: str = "kv"  # kv | null
     index_tags: str = ""
     index_all_tags: bool = False
+    # block-at-a-time ingest (ours): the IndexerService drains its
+    # event subscription in batches and writes ONE DB write-batch (and
+    # one index_generation bump) per block instead of per tx. Search
+    # and get results are identical to per-tx indexing
+    # (property-tested); False restores the per-tx index() path.
+    batch: bool = True
 
 
 @dataclass

@@ -1098,7 +1098,11 @@ class ConsensusState:
 
             # WAL EndHeight BEFORE ApplyBlock: on crash we replay from here and
             # the handshake re-applies the block to the app (reference :1271-1285)
+            _t_wal = time.perf_counter()
             self.wal.write_end_height(height)
+            _sp = getattr(self.block_exec, "stage_profile", None)
+            if _sp is not None:  # stub executors in tests have none
+                _sp.observe("wal", time.perf_counter() - _t_wal)
             self.timeline.mark(height, "wal_fsync", round_=rs.commit_round)
             fail.fail_point("FinalizeCommit.AfterWAL")  # :1282
 

@@ -42,6 +42,19 @@ class DB:
     def batch(self) -> "Batch":
         return Batch(self)
 
+    def apply_batch(self, ops: List[Tuple[str, bytes, Optional[bytes]]]) -> None:
+        """Apply a whole batch of ("set"|"del", key, value) ops in one
+        backend call. The base implementation is the per-op loop;
+        backends that can amortize (MemDB: one lock acquisition, FileDB:
+        one appended record run + one flush) override it — this is what
+        makes a block's indexer ingest one DB write instead of one per
+        tag row."""
+        for op, k, v in ops:
+            if op == "set":
+                self.set(k, v)
+            else:
+                self.delete(k)
+
     def close(self) -> None:
         pass
 
@@ -62,12 +75,11 @@ class Batch:
     def delete(self, key: bytes) -> None:
         self._ops.append(("del", key, None))
 
+    def __len__(self) -> int:
+        return len(self._ops)
+
     def write(self) -> None:
-        for op, k, v in self._ops:
-            if op == "set":
-                self._db.set(k, v)
-            else:
-                self._db.delete(k)
+        self._db.apply_batch(self._ops)
         self._ops.clear()
 
     def write_sync(self) -> None:
@@ -98,6 +110,20 @@ class MemDB(DB):
                 del self._data[key]
                 i = bisect.bisect_left(self._keys, key)
                 del self._keys[i]
+
+    def apply_batch(self, ops) -> None:
+        # one lock acquisition for the whole batch (a block's indexer
+        # ingest is hundreds of tag rows; per-op locking was the cost)
+        with self._lock:
+            for op, key, value in ops:
+                if op == "set":
+                    if key not in self._data:
+                        bisect.insort(self._keys, key)
+                    self._data[key] = bytes(value)
+                elif key in self._data:
+                    del self._data[key]
+                    i = bisect.bisect_left(self._keys, key)
+                    del self._keys[i]
 
     def iterator(self, start=None, end=None):
         with self._lock:
@@ -163,8 +189,14 @@ class FileDB(DB):
                 else:
                     self._mem.delete(k)
 
+    @staticmethod
+    def _record(op: int, key: bytes, value: bytes) -> bytes:
+        """One on-disk log record; the single owner of the framing that
+        _load parses (shared by the per-op and batch append paths)."""
+        return struct.pack(">BII", op, len(key), len(value)) + key + value
+
     def _append(self, op: int, key: bytes, value: bytes = b"") -> None:
-        self._fh.write(struct.pack(">BII", op, len(key), len(value)) + key + value)
+        self._fh.write(self._record(op, key, value))
         self._fh.flush()
 
     def get(self, key):
@@ -181,6 +213,19 @@ class FileDB(DB):
     def delete(self, key):
         self._mem.delete(key)
         self._append(0, key)
+
+    def apply_batch(self, ops):
+        # one in-memory batch apply + ONE appended record run and ONE
+        # flush (the per-op path flushes every row)
+        self._mem.apply_batch(ops)
+        chunks = [
+            self._record(1 if op == "set" else 0, key,
+                         value if op == "set" else b"")
+            for op, key, value in ops
+        ]
+        if chunks:
+            self._fh.write(b"".join(chunks))
+            self._fh.flush()
 
     def sync(self):
         self._fh.flush()
@@ -219,6 +264,10 @@ class PrefixDB(DB):
 
     def delete(self, key):
         self._db.delete(self._k(key))
+
+    def apply_batch(self, ops):
+        self._db.apply_batch(
+            [(op, self._k(k), v) for op, k, v in ops])
 
     def iterator(self, start=None, end=None):
         p = self._prefix

@@ -89,6 +89,11 @@ class MempoolTx:
     priority: int = 0
     sender: Optional[bytes] = None  # envelope pubkey; None = unsigned
     seq: int = 0  # global admission order (monotonic)
+    # sha256 cache key, computed ONCE at admission: the post-commit
+    # update used to re-hash every pending tx per block to diff it
+    # against the committed set — at depth that was the mempool's
+    # dominant per-block cost
+    key: bytes = b""
 
 
 class _Lane:
@@ -141,9 +146,11 @@ class TxCache:
         self._map: "OrderedDict[bytes, None]" = OrderedDict()
         self._lock = threading.Lock()
 
-    def push(self, tx: bytes) -> bool:
-        """False if already present."""
-        key = _tx_key(tx)
+    def push(self, tx: bytes, key: Optional[bytes] = None) -> bool:
+        """False if already present. `key` is the precomputed sha256
+        cache key when the caller already paid for it."""
+        if key is None:
+            key = _tx_key(tx)
         with self._lock:
             if key in self._map:
                 self._map.move_to_end(key)
@@ -153,9 +160,26 @@ class TxCache:
                 self._map.popitem(last=False)
             return True
 
+    def push_keys(self, keys: List[bytes]) -> None:
+        """Batch push of precomputed keys under ONE lock acquisition —
+        the post-commit update pins a whole block's committed txs in
+        the cache with one call."""
+        with self._lock:
+            for key in keys:
+                if key in self._map:
+                    self._map.move_to_end(key)
+                    continue
+                self._map[key] = None
+                if len(self._map) > self.size:
+                    self._map.popitem(last=False)
+
     def remove(self, tx: bytes) -> None:
         with self._lock:
             self._map.pop(_tx_key(tx), None)
+
+    def remove_key(self, key: bytes) -> None:
+        with self._lock:
+            self._map.pop(key, None)
 
     def reset(self) -> None:
         with self._lock:
@@ -192,6 +216,10 @@ class Mempool:
         self._nlanes = max(1, int(getattr(config, "lanes", 1)))
         self._lanes = [_Lane(i) for i in range(self._nlanes)]
         self._seq = 0  # admission counter (monotonic, under _lock)
+        # running pool count: lanes mutate only under _lock (class
+        # docstring), so this stays exact without the per-call
+        # lane-lock sweep size() used to pay — admission reads it per tx
+        self._count = 0
         self.cache = TxCache(config.cache_size)
         self.pre_check: Optional[Callable[[bytes], None]] = None
         self.post_check: Optional[Callable[[bytes, abci.ResponseCheckTx], None]] = None
@@ -246,7 +274,7 @@ class Mempool:
     # --- basic accessors ----------------------------------------------------
 
     def size(self) -> int:
-        return sum(len(lane) for lane in self._lanes)
+        return self._count
 
     def tx_bytes(self) -> int:
         total = 0
@@ -286,6 +314,7 @@ class Mempool:
         with self._lock:
             for lane in self._lanes:
                 lane.replace([])
+            self._count = 0
             self.cache.reset()
             self._set_lane_gauges()
 
@@ -413,10 +442,9 @@ class Mempool:
         """Admission after signature pre-verification (or for plain
         txs): size/dedup gates, the per-tx ABCI CheckTx, lane insert."""
         with self._lock:
-            # lanes mutate only under this lock, so the count stays
-            # exact through the admission below (computed once — the
-            # sweep takes every lane lock)
-            size = self.size()
+            # lanes mutate only under this lock, so the running count
+            # stays exact through the admission below
+            size = self._count
             if size >= self.config.size:
                 raise ErrMempoolIsFull(f"mempool is full: {size} txs")
             if self.pre_check is not None:
@@ -424,7 +452,8 @@ class Mempool:
                     self.pre_check(tx)
                 except Exception as e:
                     raise ErrPreCheck(str(e))
-            if not self.cache.push(tx):
+            key = _tx_key(tx)  # hashed once; reused by update()'s diff
+            if not self.cache.push(tx, key=key):
                 raise ErrTxInCache("tx already exists in cache")
 
             if self._wal is not None:
@@ -436,7 +465,7 @@ class Mempool:
             except Exception:
                 # conn-level failure (not an app verdict): evict from the
                 # cache so the tx can be resubmitted once the app is back
-                self.cache.remove(tx)
+                self.cache.remove_key(key)
                 raise
             if self.post_check is not None:
                 try:
@@ -451,13 +480,14 @@ class Mempool:
                     tx=tx, gas_wanted=res.gas_wanted, height=self.height,
                     priority=priority,
                     sender=parsed.pubkey if parsed is not None else None,
-                    seq=self._seq,
+                    seq=self._seq, key=key,
                 )
                 lane = self._lanes[self.lane_of(priority)]
                 lane.append(mtx)
+                self._count += 1
                 if LOG.isEnabledFor(logging.DEBUG):
                     LOG.debug("added good tx %s (lane=%d pool=%d)",
-                              _tx_key(tx).hex()[:12], lane.idx, size + 1)
+                              key.hex()[:12], lane.idx, size + 1)
                 self.metrics.lane_depth.with_labels(str(lane.idx)).set(
                     len(lane))
                 self.metrics.size.set(size + 1)
@@ -468,9 +498,123 @@ class Mempool:
                 self.metrics.failed_txs.inc()
                 # ineligible: evict from cache so a future fixed app state
                 # can re-admit it (reference :389-394)
-                self.cache.remove(tx)
+                self.cache.remove_key(key)
                 LOG.debug("rejected bad tx code=%d log=%s", res.code, res.log)
             return res
+
+    # txs admitted per _admit_preverified_batch lock hold: each chunk
+    # is gate+CheckTx+insert ATOMIC under the global mutex (exactly the
+    # per-tx path's invariant, widened to a chunk), but the lock is
+    # RELEASED between chunks so the consensus commit path (which takes
+    # the same mutex for app-commit + update) waits for at most one
+    # chunk's app round trip, not a whole 256-tx drain against a slow app
+    ADMIT_CHUNK = 32
+
+    def _admit_preverified_batch(self, items: List[tuple]) -> List[object]:
+        """Batched admission for the ingest drain: the same per-tx gate
+        sequence as _admit_preverified (size, pre_check, cache dedup,
+        WAL, app CheckTx, post_check, lane insert) driven in
+        ADMIT_CHUNK-sized lock holds, each chunk's eligible CheckTx as
+        ONE check_tx_batch call (pipelined frames on the socket
+        transport). `items` is [(tx, parsed_envelope_or_None)]; returns
+        a list aligned with it of ResponseCheckTx or the admission
+        Exception.
+
+        One deliberate approximation: the pool-size gate counts txs
+        that passed the local gates but whose app verdict is still
+        pending in this chunk — conservative at the full boundary
+        (admission there is already racy between concurrent callers)."""
+        out: List[object] = [None] * len(items)
+        for start in range(0, len(items), self.ADMIT_CHUNK):
+            self._admit_chunk_locked(
+                items[start:start + self.ADMIT_CHUNK], out, start)
+        return out
+
+    def _admit_chunk_locked(self, items: List[tuple], out: List[object],
+                            base: int) -> None:
+        with self._lock:
+            eligible: List[tuple] = []  # (slot, tx, parsed, key)
+            projected = self._count
+            for slot, (tx, parsed) in enumerate(items, start=base):
+                if projected >= self.config.size:
+                    out[slot] = ErrMempoolIsFull(
+                        f"mempool is full: {projected} txs")
+                    continue
+                if self.pre_check is not None:
+                    try:
+                        self.pre_check(tx)
+                    except Exception as e:
+                        out[slot] = ErrPreCheck(str(e))
+                        continue
+                key = _tx_key(tx)
+                if not self.cache.push(tx, key=key):
+                    out[slot] = ErrTxInCache("tx already exists in cache")
+                    continue
+                if self._wal is not None:
+                    self._wal.write(tx + b"\n")
+                projected += 1
+                eligible.append((slot, tx, parsed, key))
+            if self._wal is not None and eligible:
+                self._wal.flush()  # one flush per admitted chunk
+            if not eligible:
+                return
+
+            verdicts: List[abci.ResponseCheckTx] = []
+            conn_err: Optional[Exception] = None
+            batch_fn = getattr(self.proxy_app, "check_tx_batch", None)
+            try:
+                if batch_fn is not None:
+                    verdicts = list(
+                        batch_fn([tx for _, tx, _, _ in eligible]))
+                else:
+                    for _, tx, _, _ in eligible:
+                        verdicts.append(self.proxy_app.check_tx(tx))
+            except Exception as e:  # noqa: BLE001 - conn-level failure
+                conn_err = e
+                # verdicts the app returned before the failure are
+                # real — apply the prefix like the per-tx path would
+                verdicts = list(
+                    getattr(e, "abci_partial_results", ()) or verdicts)
+
+            admitted = 0
+            for pos, (slot, tx, parsed, key) in enumerate(eligible):
+                if pos >= len(verdicts):
+                    # no verdict (conn failure): evict from the cache so
+                    # the tx can be resubmitted once the app is back —
+                    # the same semantics as the per-tx path's except arm
+                    self.cache.remove_key(key)
+                    out[slot] = (conn_err if conn_err is not None else
+                                 RuntimeError("short check_tx_batch "
+                                              "response from app"))
+                    continue
+                res = verdicts[pos]
+                if self.post_check is not None:
+                    try:
+                        self.post_check(tx, res)
+                    except Exception as e:
+                        res = abci.ResponseCheckTx(
+                            code=1, log=f"postCheck: {e}")
+                if res.code == abci.CODE_TYPE_OK:
+                    priority = parsed.priority if parsed is not None else 0
+                    self._seq += 1
+                    lane = self._lanes[self.lane_of(priority)]
+                    lane.append(MempoolTx(
+                        tx=tx, gas_wanted=res.gas_wanted,
+                        height=self.height, priority=priority,
+                        sender=parsed.pubkey if parsed is not None else None,
+                        seq=self._seq, key=key,
+                    ))
+                    self._count += 1
+                    admitted += 1
+                    self.metrics.tx_size_bytes.observe(len(tx))
+                else:
+                    self.metrics.failed_txs.inc()
+                    self.cache.remove_key(key)
+                out[slot] = res
+            if admitted:
+                self._set_lane_gauges()
+                self._fire_txs_available()
+                self._cond.notify_all()
 
     # --- Reap ---------------------------------------------------------------
 
@@ -511,17 +655,27 @@ class Mempool:
     ) -> None:
         """Remove committed txs; recheck the remainder against the new app
         state (reference Update :526-567). Caller MUST hold the lock (the
-        BlockExecutor commits under mempool.lock())."""
+        BlockExecutor commits under mempool.lock()).
+
+        Every per-tx cost here is block-scoped: ONE pass builds the
+        committed key-set (pending txs carry their admission-time hash,
+        so the diff is set membership, not a re-hash of the pool), the
+        cache pins the committed set in one locked call, the
+        sender-touched set comes from one pass over the block, and the
+        recheck runs as ONE merged submission across all lanes
+        (pipelined through the app conn's check_tx_batch when the
+        transport has one). Reap order afterwards is identical to the
+        per-tx path (property-tested)."""
         self.height = height
         if pre_check is not None:
             self.pre_check = pre_check
         if post_check is not None:
             self.post_check = post_check
 
-        committed = {_tx_key(tx) for tx in txs}
+        committed_keys = [_tx_key(tx) for tx in txs]
+        committed = set(committed_keys)
         # commit txs stay in the cache so they can't re-enter
-        for tx in txs:
-            self.cache.push(tx)
+        self.cache.push_keys(committed_keys)
 
         # incremental recheck: only senders the committed set touched can
         # have had their pending txs invalidated (nonce bumps, balance
@@ -536,12 +690,17 @@ class Mempool:
                 if p is not None:
                     touched.add(p.pubkey)
 
+        any_kept = False
+        count = 0
         for lane in self._lanes:
             kept = [m for m in lane.snapshot()
-                    if _tx_key(m.tx) not in committed]
+                    if (m.key or _tx_key(m.tx)) not in committed]
             lane.replace(kept)
-            if kept and self.config.recheck:
-                self._recheck_lane(lane, touched if incremental else None)
+            count += len(kept)
+            any_kept = any_kept or bool(kept)
+        self._count = count
+        if any_kept and self.config.recheck:
+            self._recheck_lanes(touched if incremental else None)
         self._set_lane_gauges()
         if self.size():
             self._fire_txs_available()
@@ -562,37 +721,76 @@ class Mempool:
                 return True
         return False
 
-    def _recheck_lane(self, lane: _Lane, touched: Optional[set]) -> None:
-        """Re-run CheckTx on one lane's survivors (reference recheckTxs
-        :569-585 + resCbRecheck :399-442) — all of them in full mode,
-        only invalidated ones in incremental mode. Runs inside the
-        commit path: a transport-level failure aborts the recheck and
-        KEEPS the remaining txs (they are rechecked after the next
-        commit) instead of propagating into — and halting — consensus."""
-        txs = lane.snapshot()
-        still: List[MempoolTx] = []
-        rechecked = skipped = 0
-        for i, mtx in enumerate(txs):
-            if not self._should_recheck(mtx, touched):
-                skipped += 1
-                still.append(mtx)
+    def _recheck_lanes(self, touched: Optional[set]) -> None:
+        """Re-run CheckTx on every lane's survivors (reference
+        recheckTxs :569-585 + resCbRecheck :399-442) — all of them in
+        full mode, only invalidated ones in incremental mode — as ONE
+        merged submission: the to-recheck subset is gathered across
+        lanes in one pass and driven through the app conn's
+        check_tx_batch when it has one (the socket transport pipelines
+        the request frames like deliver_tx_batch), else a per-tx loop.
+        Runs inside the commit path: a transport-level failure aborts
+        the recheck and KEEPS every un-verdicted tx (rechecked after
+        the next commit) instead of propagating into — and halting —
+        consensus."""
+        plans = []  # (lane, survivors, recheck_flags)
+        to_check: List[bytes] = []
+        skipped = 0
+        for lane in self._lanes:
+            survivors = lane.snapshot()
+            if not survivors:
                 continue
-            try:
-                res = self.proxy_app.check_tx(mtx.tx)
-            except Exception as e:  # noqa: BLE001 - fail soft, keep txs
-                self._warn_app_failure("recheck", e)
-                still.extend(txs[i:])
-                break
-            rechecked += 1
-            if res.code == abci.CODE_TYPE_OK:
-                still.append(mtx)
-            else:
-                self.cache.remove(mtx.tx)
-        lane.replace(still)
-        if rechecked:
-            self.metrics.recheck_times.inc(rechecked)
+            flags = [self._should_recheck(m, touched) for m in survivors]
+            plans.append((lane, survivors, flags))
+            to_check.extend(m.tx for m, f in zip(survivors, flags) if f)
+            skipped += sum(1 for f in flags if not f)
         if skipped:
             self.metrics.recheck_skipped.inc(skipped)
+        if not to_check:
+            return
+
+        # one merged CheckTx run; verdicts positionally matched. On a
+        # transport failure, the verdicts already received (the batch
+        # exception's abci_partial_results prefix) still apply — same
+        # as the per-tx loop evicting up to the failure point — and
+        # every tx past it keeps its place (fail soft).
+        verdicts: List[Optional[abci.ResponseCheckTx]] = []
+        batch = getattr(self.proxy_app, "check_tx_batch", None)
+        if batch is not None:
+            try:
+                verdicts = list(batch(to_check))
+            except Exception as e:  # noqa: BLE001 - fail soft, keep txs
+                self._warn_app_failure("recheck", e)
+                verdicts = list(
+                    getattr(e, "abci_partial_results", ()) or ())
+        else:
+            for tx in to_check:
+                try:
+                    verdicts.append(self.proxy_app.check_tx(tx))
+                except Exception as e:  # noqa: BLE001 - fail soft
+                    self._warn_app_failure("recheck", e)
+                    break
+        rechecked = len(verdicts)
+
+        pos = 0
+        for lane, survivors, flags in plans:
+            still: List[MempoolTx] = []
+            for mtx, flagged in zip(survivors, flags):
+                if not flagged:
+                    still.append(mtx)
+                    continue
+                res = verdicts[pos] if pos < len(verdicts) else None
+                pos += 1
+                if res is None or res.code == abci.CODE_TYPE_OK:
+                    # no verdict (aborted run) keeps the tx, like the
+                    # old per-lane break did
+                    still.append(mtx)
+                else:
+                    self.cache.remove_key(mtx.key or _tx_key(mtx.tx))
+                    self._count -= 1
+            lane.replace(still)
+        if rechecked:
+            self.metrics.recheck_times.inc(rechecked)
 
     # --- gossip support -----------------------------------------------------
 

@@ -310,14 +310,25 @@ class IngestQueue:
                 mask = [parsed[i].verify() for i in signed_idx]
         verdict = dict(zip(signed_idx, mask))
 
+        # admission for the signature-valid subset is ONE batched call:
+        # one mempool-lock hold and one (pipelined) app CheckTx batch
+        # per drain, instead of a lock + app round trip per tx
+        admit_slots = []
+        admit_items = []
         for i, (tx, fut) in enumerate(batch):
             p = parsed[i]
             if p is not None and not verdict.get(i, False):
                 metrics.preverify_rejected.inc()
                 fut.set_result(reject_response())
                 continue
-            try:
-                fut.set_result(
-                    self.mempool._admit_preverified(tx, p))
-            except BaseException as e:  # noqa: BLE001 - surfaces at result()
-                fut.set_exception(e)
+            admit_slots.append(i)
+            admit_items.append((tx, p))
+        if not admit_items:
+            return
+        results = self.mempool._admit_preverified_batch(admit_items)
+        for i, res in zip(admit_slots, results):
+            fut = batch[i][1]
+            if isinstance(res, BaseException):
+                fut.set_exception(res)
+            else:
+                fut.set_result(res)

@@ -16,12 +16,18 @@ from .libs.metrics import Registry
 
 
 class _Nop:
-    """Absorbs inc/set/add/observe/with_labels calls."""
+    """Absorbs inc/set/add/observe/with_labels calls. Each absorbed
+    method is memoized onto the instance on first access — hot paths
+    (mempool admission, exec lanes) hit these per tx, and rebuilding a
+    lambda per call showed up in profiles."""
 
     def __getattr__(self, item):
         if item == "with_labels":
-            return lambda *a: self
-        return lambda *a, **k: None
+            fn = lambda *a: self  # noqa: E731
+        else:
+            fn = lambda *a, **k: None  # noqa: E731
+        object.__setattr__(self, item, fn)
+        return fn
 
 
 NOP = _Nop()
@@ -282,6 +288,12 @@ class StateMetrics:
     # speculative block executions adopted at commit / discarded
     exec_speculation_hits: object = NOP
     exec_speculation_wasted: object = NOP
+    # commit-path stage breakdown (state/execution.CommitStageProfile):
+    # wall seconds per commit-path stage, labeled
+    # stage=execute|app_commit|events|index|mempool_update|wal — the
+    # profiler that
+    # makes the post-executor pipeline ceiling attributable
+    commit_stage: object = NOP
 
 
 @dataclass
@@ -483,6 +495,13 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             f"{ns}_exec_speculation_wasted_total",
             "Speculative block executions discarded (decided block or "
             "base state did not match)."),
+        commit_stage=r.histogram(
+            f"{ns}_commit_stage_seconds",
+            "Wall time of each commit-path stage per block "
+            "(execute/app_commit/events/index/mempool_update/wal).",
+            ("stage",),
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1, 5)),
     )
     crypto = CryptoMetrics(
         batch_verify_seconds=r.histogram(

@@ -367,7 +367,15 @@ class Node:
             )
         else:
             self.tx_indexer = NullTxIndexer()
-        self.indexer_service = IndexerService(self.tx_indexer, self.event_bus)
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.event_bus,
+            batch=config.tx_index.batch,
+            stage_profile=self.block_exec.stage_profile,
+        )
+        # push-based tip announcement: peers (tailing replicas above
+        # all) learn a committed height in one RTT instead of waiting
+        # out their status poll
+        self.blockchain_reactor.enable_tip_announce(self.event_bus)
 
         # --- p2p (node/node.go:366-464) ------------------------------
         channels = NODE_CHANNELS + (b"\x00" if config.p2p.pex else b"")

@@ -779,6 +779,22 @@ def get_metrics():
     return _rpc_metrics
 
 
+def _render_payload_locked(msg) -> bytes:
+    """Render + memoize one message's payload. Caller holds
+    _render_lock and has checked the cache."""
+    global _events_rendered
+    _events_rendered += 1
+    if _rpc_metrics is not None:
+        _rpc_metrics.events_rendered.inc()
+    from . import jsonrpc as _jsonrpc
+
+    body = _jsonrpc.dumps(
+        {"data": _event_data_json(msg), "tags": msg.tags})
+    cached = body[1:-1]  # strip the object braces for splicing
+    msg._rpc_wire_payload = cached
+    return cached
+
+
 def render_event_payload(msg) -> bytes:
     """`"data":<...>,"tags":<...>` as JSON bytes (no surrounding
     braces), rendered once per EventBus Message and cached on it."""
@@ -788,16 +804,7 @@ def render_event_payload(msg) -> bytes:
     with _render_lock:
         cached = getattr(msg, "_rpc_wire_payload", None)
         if cached is None:
-            global _events_rendered
-            _events_rendered += 1
-            if _rpc_metrics is not None:
-                _rpc_metrics.events_rendered.inc()
-            from . import jsonrpc as _jsonrpc
-
-            body = _jsonrpc.dumps(
-                {"data": _event_data_json(msg), "tags": msg.tags})
-            cached = body[1:-1]  # strip the object braces for splicing
-            msg._rpc_wire_payload = cached
+            cached = _render_payload_locked(msg)
     return cached
 
 
@@ -809,6 +816,25 @@ def render_event_frame(msg, query_str: str) -> bytes:
     return (b'{"jsonrpc":"2.0","id":"#event","result":{"query":'
             + _jsonrpc.dumps(query_str) + b","
             + render_event_payload(msg) + b"}}")
+
+
+def render_event_frames(msgs, query_str: str) -> List[bytes]:
+    """Frames for a whole drained batch: any still-unrendered payloads
+    are rendered under ONE _render_lock acquisition (instead of
+    re-acquiring per tx), then each frame is a pure byte splice. The
+    render-once guarantee is unchanged — a payload another pump already
+    rendered is reused, and racing pumps still cost one render per
+    event process-wide."""
+    from . import jsonrpc as _jsonrpc
+
+    if any(getattr(m, "_rpc_wire_payload", None) is None for m in msgs):
+        with _render_lock:
+            for m in msgs:
+                if getattr(m, "_rpc_wire_payload", None) is None:
+                    _render_payload_locked(m)
+    prefix = (b'{"jsonrpc":"2.0","id":"#event","result":{"query":'
+              + _jsonrpc.dumps(query_str) + b",")
+    return [prefix + m._rpc_wire_payload + b"}}" for m in msgs]
 
 
 def _event_data_json(msg) -> dict:

@@ -115,6 +115,49 @@ def _params_from(o):
     )
 
 
+class CommitStageProfile:
+    """Per-stage commit-path timer: every per-block cost between block
+    execution and the RPC edge reports here, labeled
+    stage=execute|app_commit|events|index|mempool_update|wal.
+    Observations land in
+    the commit_stage_seconds{stage} metric family AND an in-process
+    accumulator, so the pipeline ceiling is attributable from a live
+    scrape, a tracer timeline, or a bench run's stage table — not
+    anecdotal. Writers: BlockExecutor (execute/app_commit/events/
+    mempool_update),
+    ConsensusState (wal), IndexerService (index)."""
+
+    def __init__(self, metrics=None):
+        import threading
+
+        self._metric = getattr(metrics, "commit_stage", None)
+        self._lock = threading.Lock()
+        self._totals: dict = {}  # stage -> [count, total_seconds]
+
+    def observe(self, stage: str, seconds: float) -> None:
+        if self._metric is not None:
+            self._metric.with_labels(stage).observe(seconds)
+        with self._lock:
+            ent = self._totals.get(stage)
+            if ent is None:
+                self._totals[stage] = [1, seconds]
+            else:
+                ent[0] += 1
+                ent[1] += seconds
+
+    def snapshot(self) -> dict:
+        """{stage: {count, total_ms, avg_ms}} — the bench/debug view."""
+        with self._lock:
+            return {
+                stage: {
+                    "count": n,
+                    "total_ms": round(total * 1000, 2),
+                    "avg_ms": round(total * 1000 / max(n, 1), 3),
+                }
+                for stage, (n, total) in sorted(self._totals.items())
+            }
+
+
 class BlockExecutor:
     """Reference state/execution.go:22-39. Handles block validation +
     execution; the ONLY writer of State past genesis."""
@@ -145,6 +188,9 @@ class BlockExecutor:
         self.exec_config = (exec_config if exec_config is not None
                             else ExecutionConfig())
         self.metrics.exec_parallel_lanes.set(self.exec_config.parallel_lanes)
+        # the commit-path profiler: shared with ConsensusState (wal
+        # stage) and the node's IndexerService (index stage)
+        self.stage_profile = CommitStageProfile(self.metrics)
         # speculation slot: written by the consensus thread, the worker
         # thread only fills its own slot object (state/parallel.py)
         self._spec_lock = threading.Lock()
@@ -209,7 +255,14 @@ class BlockExecutor:
         # drift bound must not reject them
         self.validate_block(state, block, decided=True)
 
-        abci_responses = self._exec_block(state, block)
+        from ..libs import tracing
+
+        _t_exec = _time.perf_counter()
+        with tracing.span("commit.execute", cat="state",
+                          height=block.header.height):
+            abci_responses = self._exec_block(state, block)
+        self.stage_profile.observe(
+            "execute", _time.perf_counter() - _t_exec)
 
         fail.fail_point("ApplyBlock.SaveABCIResponses")  # execution.go:103
         save_abci_responses(self.db, block.header.height, abci_responses)
@@ -237,7 +290,11 @@ class BlockExecutor:
         fail.fail_point("ApplyBlock.AfterSaveState")  # execution.go:145
 
         self.metrics.block_processing_time.observe(_time.monotonic() - _t0)
-        self._fire_events(block, abci_responses, val_updates)
+        _t_ev = _time.perf_counter()
+        with tracing.span("commit.events", cat="state",
+                          height=block.header.height):
+            self._fire_events(block, abci_responses, val_updates)
+        self.stage_profile.observe("events", _time.perf_counter() - _t_ev)
         return state
 
     def commit(self, state: State, block: Block) -> bytes:
@@ -248,18 +305,30 @@ class BlockExecutor:
         try:
             if self.mempool is not None:
                 self.mempool.flush_app_conn()
+            import time as _time
+
+            _t_ac = _time.perf_counter()
             res = self.proxy_app.commit()
+            self.stage_profile.observe(
+                "app_commit", _time.perf_counter() - _t_ac)
             self.logger.debug(
                 "committed state: height=%d app_hash=%s",
                 block.header.height,
                 res.data.hex()[:16],
             )
             if self.mempool is not None:
-                self.mempool.update(
-                    block.header.height,
-                    block.data.txs,
-                    pre_check=_tx_pre_check(state),
-                )
+                from ..libs import tracing
+
+                _t0 = _time.perf_counter()
+                with tracing.span("commit.mempool_update", cat="state",
+                                  height=block.header.height):
+                    self.mempool.update(
+                        block.header.height,
+                        block.data.txs,
+                        pre_check=_tx_pre_check(state),
+                    )
+                self.stage_profile.observe(
+                    "mempool_update", _time.perf_counter() - _t0)
             return res.data
         finally:
             if self.mempool is not None:
@@ -433,7 +502,11 @@ class BlockExecutor:
         return None
 
     def _fire_events(self, block: Block, abci_responses: ABCIResponses, val_updates) -> None:
-        """Reference execution.go fireEvents:475-506."""
+        """Reference execution.go fireEvents:475-506. The block's tx
+        events go to the bus in ONE publish_txs call when the bus has
+        the block-scoped path and [execution] event_batch is on
+        (default) — subscriber-observed sequences are identical to the
+        per-tx loop (property-tested), the per-tx cost is not."""
         if self.event_bus is None:
             return
         self.event_bus.publish_new_block(
@@ -442,10 +515,17 @@ class BlockExecutor:
         self.event_bus.publish_new_block_header(
             block.header, abci_responses.begin_block, abci_responses.end_block
         )
-        for i, tx in enumerate(block.data.txs):
-            self.event_bus.publish_tx(
-                block.header.height, i, tx, abci_responses.deliver_tx[i]
-            )
+        publish_txs = (getattr(self.event_bus, "publish_txs", None)
+                       if getattr(self.exec_config, "event_batch", True)
+                       else None)
+        if publish_txs is not None:
+            publish_txs(block.header.height, block.data.txs,
+                        abci_responses.deliver_tx)
+        else:
+            for i, tx in enumerate(block.data.txs):
+                self.event_bus.publish_tx(
+                    block.header.height, i, tx, abci_responses.deliver_tx[i]
+                )
         if val_updates:
             self.event_bus.publish_validator_set_updates(val_updates)
 

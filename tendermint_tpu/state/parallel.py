@@ -293,7 +293,17 @@ def _resolve_conflicts(app, session, txs, seg: Segment,
         for i in g:
             group_of[i] = gid
     indices = sorted(group_of)
-    journals = {i: session.journal(i) for i in indices}
+    # the lanes are joined: the session journal is quiescent, so read
+    # the per-idx sets directly — no per-tx lock round trip or set copy
+    # (the conflict-free common case is a pure scan)
+    s_reads = getattr(session, "reads", None)
+    s_writes = getattr(session, "writes", None)
+    if s_reads is not None and s_writes is not None:
+        journals = {i: (s_reads.get(i, frozenset()),
+                        s_writes.get(i, frozenset()))
+                    for i in indices}
+    else:  # foreign sessions expose only the copying journal() API
+        journals = {i: session.journal(i) for i in indices}
     writers: dict = {}  # key -> set of gids that wrote it
     for i in indices:
         for k in journals[i][1]:
@@ -303,16 +313,26 @@ def _resolve_conflicts(app, session, txs, seg: Segment,
     for i in indices:
         reads, writes = journals[i]
         mine = group_of[i]
-        for k in reads | writes:
+        hit = False
+        for k in writes:
             gids = writers.get(k)
-            if gids and (gids - {mine}):
-                conflicted.append(i)
+            if gids is not None and (len(gids) > 1 or mine not in gids):
+                hit = True
                 break
+        if not hit:
+            for k in reads:
+                gids = writers.get(k)
+                if gids is not None and (len(gids) > 1 or mine not in gids):
+                    hit = True
+                    break
+        if hit:
+            conflicted.append(i)
     if not conflicted:
         return 0
 
-    clean = [i for i in indices if i not in set(conflicted)]
-    clean_reads = {i: journals[i][0] for i in clean}
+    conflicted_set = set(conflicted)
+    clean = [i for i in indices if i not in conflicted_set]
+    clean_reads = {i: set(journals[i][0]) for i in clean}
     for i in sorted(conflicted):
         responses[i] = app.exec_redeliver_tx(session, i, txs[i])
         _, new_writes = session.journal(i)

@@ -58,6 +58,13 @@ class TxIndexer:
     def index(self, result: TxResult) -> None:
         raise NotImplementedError
 
+    def index_batch(self, height: int, results: List[TxResult]) -> None:
+        """Ingest a whole block's TxResults in one operation. The base
+        implementation loops index(); KVTxIndexer overrides it with one
+        DB write-batch and ONE generation bump for the block."""
+        for r in results:
+            self.index(r)
+
     def get(self, hash_: bytes) -> Optional[TxResult]:
         raise NotImplementedError
 
@@ -69,13 +76,15 @@ class TxIndexer:
         return 0
 
     def index_generation(self) -> int:
-        """Monotonic count of index() ingests — the generation key the
-        RPC cache stamps tx_search results with. A search result is a
-        pure function of the index contents, and the contents change
-        exactly when this advances; keying by per-TX generation (not
-        indexed height, which bumps on a block's FIRST tx) means a
-        result computed mid-block-ingest can never be served once the
-        rest of the block lands."""
+        """Monotonic ingest counter — the generation key the RPC cache
+        stamps tx_search results with. A search result is a pure
+        function of the index contents, and the contents change exactly
+        when this advances. Per-tx index() bumps it per ingest;
+        index_batch bumps it ONCE per block, AFTER the block's rows are
+        all written — so the tx_search cache invalidates per block, and
+        a search that read the pre-block generation while the batch was
+        being written can never be served once the block lands (its key
+        is stale the moment the bump happens)."""
         return 0
 
 
@@ -83,6 +92,9 @@ class NullTxIndexer(TxIndexer):
     """reference state/txindex/null/null.go"""
 
     def index(self, result: TxResult) -> None:
+        pass
+
+    def index_batch(self, height: int, results: List[TxResult]) -> None:
         pass
 
     def get(self, hash_: bytes) -> Optional[TxResult]:
@@ -125,26 +137,51 @@ class KVTxIndexer(TxIndexer):
         with self._lock:
             return self._index_generation
 
+    def _add_rows(self, batch, result: TxResult) -> None:
+        """One tx's primary + secondary rows into `batch` (shared by the
+        per-tx and block-batch ingest paths so they cannot drift)."""
+        h = tx_hash(result.tx)
+        for kv in result.result.tags:
+            try:
+                key = kv.key.decode()
+                val = kv.value.decode()
+            except UnicodeDecodeError:
+                continue
+            if self._all or key in self._tags:
+                batch.set(_tag_key(key, val, result.height, result.index), h)
+        batch.set(
+            _tag_key(TX_HEIGHT_KEY, str(result.height), result.height, result.index), h
+        )
+        batch.set(h, result.to_bytes())
+
     def index(self, result: TxResult) -> None:
         with self._lock:
             self._index_generation += 1
             if result.height > self._indexed_height:
                 self._indexed_height = result.height
-            h = tx_hash(result.tx)
             batch = self._db.batch()
-            for kv in result.result.tags:
-                try:
-                    key = kv.key.decode()
-                    val = kv.value.decode()
-                except UnicodeDecodeError:
-                    continue
-                if self._all or key in self._tags:
-                    batch.set(_tag_key(key, val, result.height, result.index), h)
-            batch.set(
-                _tag_key(TX_HEIGHT_KEY, str(result.height), result.height, result.index), h
-            )
-            batch.set(h, result.to_bytes())
+            self._add_rows(batch, result)
             batch.write()
+
+    def index_batch(self, height: int, results: List[TxResult]) -> None:
+        """Block-scoped ingest: compose ALL of the block's tag + primary
+        rows and write ONE DB batch, then bump the generation once —
+        search/get results are identical to per-tx index() calls in
+        order (property-tested), but the tx_search RPC cache now expires
+        once per block instead of once per tx, and the DB pays one
+        lock/flush instead of one per tx. The generation bump happens
+        AFTER the write so a search stamped with the pre-block
+        generation can never outlive the block's landing."""
+        if not results:
+            return
+        with self._lock:
+            batch = self._db.batch()
+            for result in results:
+                self._add_rows(batch, result)
+            batch.write()
+            self._index_generation += 1
+            if height > self._indexed_height:
+                self._indexed_height = height
 
     def get(self, hash_: bytes) -> Optional[TxResult]:
         raw = self._db.get(hash_)
@@ -184,15 +221,24 @@ class KVTxIndexer(TxIndexer):
 
 
 class IndexerService(BaseService):
-    """Event-bus subscriber indexing each committed tx (reference
-    state/txindex/indexer_service.go:17-69)."""
+    """Event-bus subscriber indexing committed txs (reference
+    state/txindex/indexer_service.go:17-69). With `batch` on (default)
+    the drainer takes everything buffered in one wakeup, groups it by
+    height, and hands each block to index_batch — one DB write-batch
+    and one generation bump per block instead of per tx. `batch=False`
+    restores the per-tx index() path ([tx_index] batch)."""
 
     SUBSCRIBER = "IndexerService"
 
-    def __init__(self, indexer: TxIndexer, event_bus: EventBus):
+    def __init__(self, indexer: TxIndexer, event_bus: EventBus,
+                 batch: bool = True, stage_profile=None):
         super().__init__("IndexerService")
         self.indexer = indexer
         self.event_bus = event_bus
+        self.batch = batch
+        # commit-path profiler hook (state/execution.CommitStageProfile):
+        # ingest wall time reports as the "index" stage
+        self.stage_profile = stage_profile
         self._thread: Optional[threading.Thread] = None
 
     def on_start(self) -> None:
@@ -202,15 +248,36 @@ class IndexerService(BaseService):
         self._thread = threading.Thread(target=self._run, name="tx-indexer", daemon=True)
         self._thread.start()
 
+    def _ingest(self, msgs) -> None:
+        import time as _time
+
+        results = [
+            TxResult(height=m.data["height"], index=m.data["index"],
+                     tx=m.data["tx"], result=m.data["result"])
+            for m in msgs
+        ]
+        _t0 = _time.perf_counter()
+        if not self.batch:
+            for r in results:
+                self.indexer.index(r)
+        else:
+            # group consecutive same-height runs: one index_batch per
+            # block even when a drain straddles several blocks
+            start = 0
+            for i in range(1, len(results) + 1):
+                if i == len(results) or results[i].height != results[start].height:
+                    self.indexer.index_batch(
+                        results[start].height, results[start:i])
+                    start = i
+        if self.stage_profile is not None and results:
+            self.stage_profile.observe(
+                "index", _time.perf_counter() - _t0)
+
     def _run(self) -> None:
         while not self._quit.is_set():
-            msg = self._sub.get(timeout=0.2)
-            if msg is None:
-                continue
-            d = msg.data
-            self.indexer.index(
-                TxResult(height=d["height"], index=d["index"], tx=d["tx"], result=d["result"])
-            )
+            msgs = self._sub.get_batch(8192, timeout=0.2)
+            if msgs:
+                self._ingest(msgs)
 
     def on_stop(self) -> None:
         self.event_bus.unsubscribe_all(self.SUBSCRIBER)

@@ -12,6 +12,7 @@ from typing import Dict, Optional
 
 from ..libs.events import PubSub, Query, Subscription
 from ..libs.service import BaseService
+from .block import tx_hash
 
 # event values for the tm.event tag (reference types/events.go:17-36)
 EVENT_NEW_BLOCK = "NewBlock"
@@ -84,11 +85,12 @@ class EventBus(BaseService):
             "result_end_block": result_end_block,
         })
 
-    def publish_tx(self, height: int, index: int, tx: bytes, result) -> None:
-        """EventDataTx: app tags for this tx become query-able event tags
-        (reference event_bus.go PublishEventTx:78-108)."""
-        from .block import tx_hash
-
+    def _tx_event(self, height: int, index: int, tx: bytes, result):
+        """One tx's (data, tags) pair — shared by the per-tx and the
+        block-scoped publish paths so they cannot drift (reference
+        event_bus.go PublishEventTx:78-108: app tags for this tx become
+        query-able event tags; the event-type tag wins on collision).
+        Runs once per committed tx — the hash import is hoisted."""
         tags: Dict[str, str] = {}
         res_tags = getattr(result, "tags", None) or []
         for kv in res_tags:
@@ -98,12 +100,30 @@ class EventBus(BaseService):
                 continue
         tags[TX_HASH_KEY] = tx_hash(tx).hex().upper()
         tags[TX_HEIGHT_KEY] = str(height)
-        self._publish(EVENT_TX, {
+        tags[EVENT_TYPE_KEY] = EVENT_TX
+        data = {
             "height": height,
             "index": index,
             "tx": tx,
             "result": result,
-        }, tags)
+        }
+        return data, tags
+
+    def publish_tx(self, height: int, index: int, tx: bytes, result) -> None:
+        """EventDataTx (reference event_bus.go PublishEventTx:78-108)."""
+        data, tags = self._tx_event(height, index, tx, result)
+        self._pubsub.publish(data, tags)
+
+    def publish_txs(self, height: int, txs, results) -> None:
+        """Block-scoped tx event publish: the whole block's tx events
+        hit the pubsub core in ONE publish_batch call (one subscription
+        snapshot, one buffer lock per subscription, query matching per
+        distinct tag-shape). Subscriber-observed event sequences are
+        identical to calling publish_tx per tx in index order."""
+        self._pubsub.publish_batch(
+            self._tx_event(height, i, tx, results[i])
+            for i, tx in enumerate(txs)
+        )
 
     def publish_vote(self, vote) -> None:
         self._publish(EVENT_VOTE, {"vote": vote})

@@ -60,6 +60,8 @@ _THREAD_FAMILIES = (
     "replica-telemetry",  # replica-mode telemetry ticker
     "lockdep",            # lockdep reporter/debug threads (PR-11)
     "tx-indexer",         # indexer service drainer (joined on stop)
+    "bc-tip-announce",    # push-based tip announcer (PR-13; joined by
+                          # BlockchainReactor.stop)
     "exec-lane",          # parallel block-execution lane workers (PR-12;
                           # joined per segment by state/parallel.py)
     "exec-spec",          # speculative block execution (PR-12; settled

@@ -1,0 +1,697 @@
+"""PR-13 commit-path batching: block-scoped event publish, batched
+indexer ingest, amortized mempool update — equivalence properties and
+the commit-stage profiler.
+
+The contract under test everywhere: the batched paths are COST
+refactors, not semantic ones. Subscriber-observed event sequences,
+tx_search/get results, and mempool reap order must be identical between
+the batched and per-tx paths, including the empty-block and
+all-txs-evicted edges.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.libs.db import Batch, FileDB, MemDB, PrefixDB
+from tendermint_tpu.libs.events import Message, PubSub, Query, Subscription
+from tendermint_tpu.state.txindex import (
+    IndexerService,
+    KVTxIndexer,
+    TxResult,
+)
+from tendermint_tpu.types.event_bus import EventBus, query_for_event
+
+
+def _mk_events(n, height=7, seed=0):
+    """n tx-shaped (data, tags) pairs with a mix of shared and
+    per-message tag values."""
+    rng = random.Random(seed)
+    items = []
+    for i in range(n):
+        tags = {
+            "tm.event": "Tx",
+            "tx.height": str(height),
+            "tx.hash": f"{i:064X}",
+            "app.kind": rng.choice(["mint", "burn", "move"]),
+        }
+        items.append(({"height": height, "index": i, "tx": b"tx%d" % i},
+                      tags))
+    return items
+
+
+QUERIES = [
+    "tm.event = 'Tx'",
+    "tm.event = 'Tx' AND tx.height > 5",
+    "tm.event = 'Tx' AND app.kind = 'mint'",
+    "tx.hash = '" + f"{3:064X}" + "'",
+    "tm.event = 'NewBlock'",  # matches nothing in the batch
+    "app.kind EXISTS",
+]
+
+
+class TestPublishBatch:
+    def test_batch_equals_per_tx_sequences(self):
+        """Property: for a diverse query set, the subscriber-observed
+        message sequence from publish_batch is identical to per-tx
+        publish calls in order."""
+        for seed in range(5):
+            items = _mk_events(40, seed=seed)
+
+            ps_serial, ps_batch = PubSub(), PubSub()
+            subs_serial = [ps_serial.subscribe(f"s{i}", Query(q))
+                           for i, q in enumerate(QUERIES)]
+            subs_batch = [ps_batch.subscribe(f"s{i}", Query(q))
+                          for i, q in enumerate(QUERIES)]
+
+            for data, tags in items:
+                ps_serial.publish(data, dict(tags))
+            ps_batch.publish_batch((d, dict(t)) for d, t in items)
+
+            for a, b in zip(subs_serial, subs_batch):
+                seq_a = [m.data for m in iter(a.poll, None)]
+                seq_b = [m.data for m in iter(b.poll, None)]
+                assert seq_a == seq_b
+
+    def test_empty_batch(self):
+        ps = PubSub()
+        sub = ps.subscribe("s", Query("tm.event = 'Tx'"))
+        ps.publish_batch([])
+        assert sub.poll() is None
+
+    def test_tag_shape_memo_does_not_leak_across_subs(self):
+        """Two subscriptions with different queries over one batch each
+        get exactly their own matches."""
+        ps = PubSub()
+        s_all = ps.subscribe("all", Query("tm.event = 'Tx'"))
+        s_mint = ps.subscribe("mint", Query("app.kind = 'mint'"))
+        items = _mk_events(30, seed=3)
+        ps.publish_batch(items)
+        n_mint = sum(1 for _, t in items if t["app.kind"] == "mint")
+        assert len([1 for _ in iter(s_all.poll, None)]) == 30
+        assert len([1 for _ in iter(s_mint.poll, None)]) == n_mint
+
+    def test_batch_drop_accounting_is_per_message(self):
+        """Satellite: a burst overflowing the buffer by k counts k
+        drops, not one per batch."""
+        sub = Subscription(Query(""), capacity=4)
+        msgs = [Message(i, {}) for i in range(10)]
+        appended = sub.publish_batch(msgs)
+        assert appended == 4
+        assert sub.dropped == 6
+        # and the serial path agrees
+        sub2 = Subscription(Query(""), capacity=4)
+        for m in msgs:
+            sub2.publish(m)
+        assert sub2.dropped == 6
+
+    def test_block_bigger_than_capacity_not_fully_shed_with_live_consumer(self):
+        """Regression (review finding): publish_batch must release the
+        buffer lock between chunks so a consumer draining concurrently
+        can keep up with a block larger than the subscription capacity
+        — instead of deterministically shedding everything past
+        `capacity` the way a single whole-block lock hold would. The
+        'consumer' here is deterministic: every time the publisher
+        releases the buffer lock, the drain hook empties the buffer —
+        a keeping-up consumer must then lose NOTHING."""
+        sub = Subscription(Query(""), capacity=64)
+        real_cond = sub._cond
+        got = []
+
+        class _DrainingCond:
+            """Counts publisher lock holds; drains after each release."""
+
+            def __init__(self):
+                self.holds = 0
+                self.draining = False
+
+            def __enter__(self):
+                self.holds += 1
+                return real_cond.__enter__()
+
+            def __exit__(self, *exc):
+                out = real_cond.__exit__(*exc)
+                if not self.draining:
+                    self.draining = True  # poll() re-enters this cond
+                    while True:
+                        m = sub.poll()
+                        if m is None:
+                            break
+                        got.append(m)
+                    self.draining = False
+                return out
+
+            def __getattr__(self, item):  # notify_all / wait
+                return getattr(real_cond, item)
+
+        cond = _DrainingCond()
+        sub._cond = cond
+        n = 1280
+        appended = sub.publish_batch([Message(i, {}) for i in range(n)])
+        assert appended == n
+        assert sub.dropped == 0
+        assert [m.data for m in got] == list(range(n))
+        # and the publisher really did chunk its lock holds
+        assert cond.holds >= n // Subscription.PUBLISH_CHUNK
+
+    def test_get_batch_drains_in_order_and_waits(self):
+        sub = Subscription(Query(""), capacity=64)
+        sub.publish_batch([Message(i, {}) for i in range(10)])
+        got = sub.get_batch(4)
+        assert [m.data for m in got] == [0, 1, 2, 3]
+        got = sub.get_batch(100)
+        assert [m.data for m in got] == [4, 5, 6, 7, 8, 9]
+        t0 = time.monotonic()
+        assert sub.get_batch(4, timeout=0.05) == []
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_event_bus_publish_txs_equals_publish_tx(self):
+        """EventBus level: tags (incl. app tags + hash/height) and data
+        are identical across the two paths."""
+        results = [
+            abci.ResponseDeliverTx(
+                code=0, tags=[abci.KVPair(b"app.kind", b"mint")]),
+            abci.ResponseDeliverTx(code=1),
+        ]
+        txs = [b"tx-a", b"tx-b"]
+
+        bus_a, bus_b = EventBus(), EventBus()
+        sub_a = bus_a.subscribe("s", Query("tm.event = 'Tx'"))
+        sub_b = bus_b.subscribe("s", Query("tm.event = 'Tx'"))
+        for i, tx in enumerate(txs):
+            bus_a.publish_tx(9, i, tx, results[i])
+        bus_b.publish_txs(9, txs, results)
+        msgs_a = list(iter(sub_a.poll, None))
+        msgs_b = list(iter(sub_b.poll, None))
+        assert [(m.data, m.tags) for m in msgs_a] == \
+            [(m.data, m.tags) for m in msgs_b]
+        assert msgs_b[0].tags["app.kind"] == "mint"
+        assert msgs_b[0].tags["tm.event"] == "Tx"
+
+
+class TestDBBatch:
+    @pytest.mark.parametrize("mk", [
+        lambda tmp: MemDB(),
+        lambda tmp: FileDB(str(tmp / "b.db")),
+        lambda tmp: PrefixDB(MemDB(), b"p/"),
+    ])
+    def test_apply_batch_equals_per_op(self, tmp_path, mk):
+        db_a, db_b = mk(tmp_path / "a"), mk(tmp_path / "b")
+        ops = [("set", b"k%d" % i, b"v%d" % i) for i in range(20)]
+        ops += [("del", b"k%d" % i, None) for i in range(0, 20, 3)]
+        for op, k, v in ops:
+            if op == "set":
+                db_a.set(k, v)
+            else:
+                db_a.delete(k)
+        db_b.apply_batch(ops)
+        assert list(db_a.iterator()) == list(db_b.iterator())
+
+    def test_filedb_batch_survives_reload(self, tmp_path):
+        path = str(tmp_path / "f.db")
+        db = FileDB(path)
+        b = Batch(db)
+        for i in range(8):
+            b.set(b"k%d" % i, b"v%d" % i)
+        b.delete(b"k3")
+        b.write()
+        db.close()
+        again = FileDB(path)
+        assert again.get(b"k5") == b"v5"
+        assert again.get(b"k3") is None
+        again.close()
+
+
+def _tx_result(height, index, tags=()):
+    return TxResult(
+        height=height, index=index, tx=b"h%d-i%d" % (height, index),
+        result=abci.ResponseDeliverTx(
+            code=0,
+            tags=[abci.KVPair(k.encode(), v.encode()) for k, v in tags]),
+    )
+
+
+class TestIndexBatch:
+    SEARCHES = [
+        "tx.height = 3",
+        "tx.height > 1",
+        "acct = 'alice'",
+        "acct = 'alice' AND tx.height > 2",
+    ]
+
+    def _fill(self, indexer, per_tx: bool):
+        rng = random.Random(42)
+        for h in (1, 2, 3):
+            results = []
+            for i in range(6):
+                who = rng.choice(["alice", "bob"])
+                results.append(_tx_result(h, i, tags=[("acct", who)]))
+            if per_tx:
+                for r in results:
+                    indexer.index(r)
+            else:
+                indexer.index_batch(h, results)
+
+    def test_batch_equals_per_tx_search_and_get(self):
+        a = KVTxIndexer(MemDB(), index_all_tags=True)
+        b = KVTxIndexer(MemDB(), index_all_tags=True)
+        self._fill(a, per_tx=True)
+        self._fill(b, per_tx=False)
+        from tendermint_tpu.types.block import tx_hash
+
+        for q in self.SEARCHES:
+            ra = [(r.height, r.index, r.tx) for r in a.search(Query(q))]
+            rb = [(r.height, r.index, r.tx) for r in b.search(Query(q))]
+            assert ra == rb, q
+        h = tx_hash(b"h2-i3")
+        assert a.get(h).tx == b.get(h).tx == b"h2-i3"
+        assert a.indexed_height() == b.indexed_height() == 3
+
+    def test_generation_bumps_once_per_block(self):
+        """The tx_search RPC cache key moves per BLOCK under batching
+        (MIGRATION: per-block index_generation semantics)."""
+        ix = KVTxIndexer(MemDB())
+        g0 = ix.index_generation()
+        ix.index_batch(1, [_tx_result(1, i) for i in range(5)])
+        assert ix.index_generation() == g0 + 1
+        ix.index_batch(2, [])  # empty block: no rows, no bump
+        assert ix.index_generation() == g0 + 1
+        ix.index(_tx_result(2, 0))  # per-tx path still bumps per ingest
+        assert ix.index_generation() == g0 + 2
+
+    @pytest.mark.parametrize("batch", [True, False])
+    def test_indexer_service_block_at_a_time(self, batch):
+        """The service drains a whole block per wakeup and the results
+        match per-tx indexing; batch=False keeps the per-tx path."""
+        bus = EventBus()
+        bus.start()
+        ix = KVTxIndexer(MemDB(), index_all_tags=True)
+        svc = IndexerService(ix, bus, batch=batch)
+        svc.start()
+        try:
+            txs = [b"blk-tx-%d" % i for i in range(8)]
+            results = [abci.ResponseDeliverTx(
+                code=0, tags=[abci.KVPair(b"acct", b"a%d" % (i % 2))])
+                for i in range(8)]
+            bus.publish_txs(5, txs, results)
+            deadline = time.monotonic() + 5
+            while (len(ix.search(Query("tx.height = 5"))) < 8
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            found = ix.search(Query("tx.height = 5"))
+            assert [(r.index, r.tx) for r in found] == \
+                [(i, txs[i]) for i in range(8)]
+            assert ix.search(Query("acct = 'a1'"))
+        finally:
+            svc.stop()
+            bus.stop()
+
+
+class _RecordingApp:
+    """CheckTx stub: accepts everything except txs in `reject`, records
+    call order, optionally fails transport-style after n calls."""
+
+    def __init__(self, reject=(), fail_after=None):
+        self.reject = set(reject)
+        self.calls = []
+        self.fail_after = fail_after
+
+    def check_tx(self, tx):
+        if self.fail_after is not None and len(self.calls) >= self.fail_after:
+            raise ConnectionError("app down")
+        self.calls.append(tx)
+        code = 1 if bytes(tx) in self.reject else abci.CODE_TYPE_OK
+        return abci.ResponseCheckTx(code=code)
+
+    def check_tx_batch(self, txs):
+        return [self.check_tx(tx) for tx in txs]
+
+    def flush(self):
+        pass
+
+
+def _mk_mempool(app, lanes=1, recheck=True, recheck_mode="full"):
+    from tendermint_tpu.mempool.mempool import Mempool
+
+    return Mempool(
+        MempoolConfig(size=10000, lanes=lanes, recheck=recheck,
+                      recheck_mode=recheck_mode),
+        app)
+
+
+def _fill_pool(mp, n=20, seed=0):
+    from tendermint_tpu.mempool import make_signed_tx
+    from tendermint_tpu.crypto import keys
+
+    rng = random.Random(seed)
+    sks = [keys.PrivKeyEd25519.generate() for _ in range(4)]
+    txs = []
+    for i in range(n):
+        if i % 3 == 0:
+            tx = b"plain-%04d" % i  # unsigned
+        else:
+            tx = make_signed_tx(rng.choice(sks), b"pay-%04d" % i,
+                                priority=rng.randint(0, 3))
+        mp.check_tx(tx)
+        txs.append(tx)
+    return txs, sks
+
+
+class TestMempoolBatchedUpdate:
+    @pytest.mark.parametrize("lanes", [1, 4])
+    @pytest.mark.parametrize("commit_frac", [0.0, 0.4, 1.0])
+    def test_reap_order_identical_after_update(self, lanes, commit_frac):
+        """Property: reap order after the batched update equals the
+        pool's merged order with the committed set removed — including
+        the empty-block (frac 0) and all-txs-evicted (frac 1) edges."""
+        app = _RecordingApp()
+        mp = _mk_mempool(app, lanes=lanes)
+        txs, _ = _fill_pool(mp, n=24, seed=lanes)
+        expected = [t for i, t in enumerate(mp.txs_snapshot())]
+        rng = random.Random(9)
+        committed = [t for t in txs if rng.random() < commit_frac]
+        if commit_frac == 1.0:
+            committed = list(txs)
+        expected = [t for t in expected if t not in set(committed)]
+        with mp._lock:
+            mp.update(2, committed)
+        assert mp.txs_snapshot() == expected
+        assert mp.size() == len(expected)
+
+    def test_update_rechecks_drop_app_rejected(self):
+        app = _RecordingApp()
+        mp = _mk_mempool(app)
+        txs, _ = _fill_pool(mp, n=10)
+        pending = mp.txs_snapshot()
+        # app starts rejecting two specific survivors at recheck time
+        app.reject = {pending[1], pending[4]}
+        with mp._lock:
+            mp.update(2, [])
+        left = mp.txs_snapshot()
+        assert pending[1] not in left and pending[4] not in left
+        assert len(left) == len(pending) - 2
+
+    def test_recheck_rides_check_tx_batch_when_present(self):
+        app = _RecordingApp()
+        batched = []
+        orig = app.check_tx_batch
+
+        def spy(txs):
+            batched.append(len(txs))
+            return orig(txs)
+
+        app.check_tx_batch = spy
+        mp = _mk_mempool(app)
+        _fill_pool(mp, n=8)
+        with mp._lock:
+            mp.update(2, [])
+        assert batched == [8]  # ONE merged submission across lanes
+
+    def test_recheck_partial_batch_verdicts_still_apply(self):
+        """Review regression: a check_tx_batch that dies mid-run still
+        carries the verdicts it received (abci_partial_results), and
+        the recheck applies that prefix — app-rejected txs before the
+        failure point are evicted exactly like the per-tx loop, only
+        the un-verdicted tail is kept."""
+        app = _RecordingApp()
+        mp = _mk_mempool(app)
+        for i in range(10):  # uniform priority: recheck order == reap order
+            mp.check_tx(b"rk-%02d" % i)
+        pending = mp.txs_snapshot()
+        app.reject = {pending[0], pending[2]}
+
+        def dying_batch(txs):
+            out = [app.check_tx(tx) for tx in list(txs)[:5]]
+            err = ConnectionError("conn died after 5")
+            err.abci_partial_results = out
+            raise err
+
+        app.check_tx_batch = dying_batch
+        with mp._lock:
+            mp.update(2, [])
+        left = mp.txs_snapshot()
+        # verdicts 0..4 applied: the two rejected ones are gone
+        assert pending[0] not in left and pending[2] not in left
+        # the un-verdicted tail (5..9) is fully kept
+        assert all(t in left for t in pending[5:])
+        assert len(left) == 8
+
+    def test_enqueue_events_chunks_lock_holds(self):
+        """Review regression: the ws event enqueue must release the
+        queue lock between chunks so the writer thread can interleave
+        pops during a big drained batch."""
+        from tendermint_tpu.rpc import server as rpc_server
+
+        class _Srv:
+            ws_slow_policy = "drop"
+
+            def _note_dropped(self, policy, n=1):
+                pass
+
+            def _note_enqueued(self, n=1):
+                pass
+
+        conn = rpc_server.WSConn.__new__(rpc_server.WSConn)
+        conn.server = _Srv()
+        conn._closed = threading.Event()
+        import collections
+
+        conn._q = collections.deque()
+        conn._q_cap = 10000
+        real_cond = threading.Condition()
+
+        class _CountingCond:
+            def __init__(self):
+                self.holds = 0
+
+            def __enter__(self):
+                self.holds += 1
+                return real_cond.__enter__()
+
+            def __exit__(self, *exc):
+                return real_cond.__exit__(*exc)
+
+            def __getattr__(self, item):
+                return getattr(real_cond, item)
+
+        cond = _CountingCond()
+        conn._q_cond = cond
+        conn._q_hwm = 0
+        conn.events_sent = 0
+        conn.events_dropped = 0
+        n = 256
+        assert conn.enqueue_events([b"f%d" % i for i in range(n)]) == n
+        assert cond.holds >= n // rpc_server.WSConn.ENQUEUE_CHUNK
+
+    def test_recheck_transport_failure_keeps_txs(self):
+        """Fail-soft parity with the per-tx path: un-verdicted txs stay
+        pooled after a mid-recheck transport failure."""
+        app = _RecordingApp()
+        mp = _mk_mempool(app)
+        _fill_pool(mp, n=10)
+        n0 = mp.size()
+        app.fail_after = len(app.calls) + 4  # die 4 rechecks in
+        app.check_tx_batch = lambda txs: (_ for _ in ()).throw(
+            ConnectionError("app down"))
+        with mp._lock:
+            mp.update(2, [])
+        assert mp.size() == n0  # everything kept
+        # next commit with a healthy app rechecks them again
+        app.fail_after = None
+        app.check_tx_batch = lambda txs: [app.check_tx(t) for t in txs]
+        with mp._lock:
+            mp.update(3, [])
+        assert mp.size() == n0
+
+    @pytest.mark.parametrize("lanes", [1, 3])
+    def test_incremental_recheck_equivalence(self, lanes):
+        """Incremental mode touches exactly the committed senders +
+        unsigned txs, batched or not."""
+        from tendermint_tpu.mempool import make_signed_tx
+        from tendermint_tpu.crypto import keys
+
+        sk_a, sk_b = (keys.PrivKeyEd25519.generate() for _ in range(2))
+        app = _RecordingApp()
+        mp = _mk_mempool(app, lanes=lanes, recheck_mode="incremental")
+        tx_a1 = make_signed_tx(sk_a, b"a1")
+        tx_a2 = make_signed_tx(sk_a, b"a2")
+        tx_b = make_signed_tx(sk_b, b"b1")
+        plain = b"plain-tx"
+        for t in (tx_a1, tx_a2, tx_b, plain):
+            mp.check_tx(t)
+        app.calls.clear()
+        with mp._lock:
+            mp.update(2, [tx_a1])  # commits sender A's tx
+        # rechecked: a2 (sender touched) + plain (unsigned); NOT b
+        assert set(app.calls) == {tx_a2, plain}
+        assert mp.size() == 3
+
+
+class TestCommitStageProfile:
+    def test_stages_recorded_through_apply_block(self):
+        """One in-process commit records execute/events/mempool_update
+        (+index via a live IndexerService), and the metric family
+        renders."""
+        from tendermint_tpu import config as cfg
+        from tendermint_tpu import state as sm
+        from tendermint_tpu.abci.example.kvstore import KVStoreApplication
+        from tendermint_tpu.libs.metrics import Registry
+        from tendermint_tpu.metrics import prometheus_metrics
+        from tendermint_tpu.proxy import AppConns, local_client_creator
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator
+        from tendermint_tpu.types.validator_set import random_validator_set
+        from tendermint_tpu.types.basic import BlockID
+        from tendermint_tpu.types.block import make_part_set
+
+        vs, vkeys = random_validator_set(1, 10)
+        doc = GenesisDoc(
+            chain_id="stage-test",
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(v.pub_key, v.voting_power)
+                        for v in vs.validators])
+        db = MemDB()
+        state = sm.load_state_from_db_or_genesis(db, doc)
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        conns.start()
+        metrics = prometheus_metrics("t")
+        from tendermint_tpu.mempool.mempool import Mempool
+
+        mp = Mempool(MempoolConfig(size=100), conns.mempool)
+        bus = EventBus()
+        bus.start()
+        block_exec = sm.BlockExecutor(
+            db, conns.consensus, mempool=mp, event_bus=bus,
+            metrics=metrics.state)
+        ix = KVTxIndexer(MemDB())
+        svc = IndexerService(ix, bus,
+                             stage_profile=block_exec.stage_profile)
+        svc.start()
+        try:
+            mp.check_tx(b"k=v")
+            txs = mp.reap_max_txs(-1)
+            block = state.make_block(
+                1, txs, None, [], vs.validators[0].address,
+                time_ns=doc.genesis_time)  # height 1 = genesis time
+            parts = make_part_set(block)
+            bid = BlockID(block.hash(), parts.header())
+            block_exec.apply_block(state, bid, block)
+            deadline = time.monotonic() + 5
+            while (ix.indexed_height() < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            snap = block_exec.stage_profile.snapshot()
+            for stage in ("execute", "events", "mempool_update", "index"):
+                assert stage in snap, snap
+                assert snap[stage]["count"] >= 1
+            body = metrics.registry.render()
+            assert "t_commit_stage_seconds" in body
+            assert 'stage="execute"' in body
+        finally:
+            svc.stop()
+            bus.stop()
+            mp.stop()
+            conns.stop()
+
+
+class TestWsBatchEnqueue:
+    def test_enqueue_events_per_frame_drop_accounting(self):
+        """Satellite: a frame burst past the queue cap counts every
+        shed frame in rpc_ws_dropped_total, not one per batch."""
+        from tendermint_tpu.rpc import server as rpc_server
+
+        class _Srv:
+            ws_slow_policy = "drop"
+            ws_send_queue = 4
+
+            def __init__(self):
+                self.dropped = []
+                self.enqueued = 0
+
+            def _note_dropped(self, policy, n=1):
+                self.dropped.append((policy, n))
+
+            def _note_enqueued(self, n=1):
+                self.enqueued += n
+
+        conn = rpc_server.WSConn.__new__(rpc_server.WSConn)
+        conn.server = _Srv()
+        conn._closed = threading.Event()
+        import collections
+
+        conn._q = collections.deque()
+        conn._q_cap = 4
+        conn._q_cond = threading.Condition()
+        conn._q_hwm = 0
+        conn.events_sent = 0
+        conn.events_dropped = 0
+        accepted = conn.enqueue_events([b"f%d" % i for i in range(10)])
+        assert accepted == 4
+        assert conn.events_dropped == 6
+        assert conn.server.dropped == [("drop", 6)]
+        assert conn.server.enqueued == 4
+
+
+def test_batching_knobs_roundtrip_toml():
+    from tendermint_tpu.config import Config
+
+    c = Config()
+    assert c.execution.event_batch is True and c.tx_index.batch is True
+    c.execution.event_batch = False
+    c.tx_index.batch = False
+    c2 = Config.from_toml(c.to_toml())
+    assert c2.execution.event_batch is False
+    assert c2.tx_index.batch is False
+
+
+class TestTipAnnounce:
+    def test_commit_broadcasts_status_response(self):
+        """Satellite: a NewBlock on the bus broadcasts an unsolicited
+        status_response with the store height — one RTT tip learning
+        for tailing replicas instead of the 0.5s poll."""
+        from tendermint_tpu.blockchain.reactor import (
+            BLOCKCHAIN_CHANNEL,
+            BlockchainReactor,
+        )
+        from tendermint_tpu.types import serde
+
+        class _Store:
+            def height(self):
+                return 41
+
+        class _Switch:
+            def __init__(self):
+                self.sent = []
+                self.cond = threading.Condition()
+
+            def broadcast(self, ch, payload):
+                with self.cond:
+                    self.sent.append((ch, payload))
+                    self.cond.notify_all()
+
+        r = BlockchainReactor(None, None, _Store(), fast_sync=False)
+        bus = EventBus()
+        bus.start()
+        sw = _Switch()
+        r.switch = sw
+        r.enable_tip_announce(bus)
+        r.start()
+        try:
+            bus.publish_new_block(object())
+            with sw.cond:
+                if not sw.sent:
+                    sw.cond.wait(3.0)
+            assert sw.sent, "no tip announcement within 3s"
+            ch, payload = sw.sent[0]
+            assert ch == BLOCKCHAIN_CHANNEL
+            assert serde.unpack(payload) == ["status_response", 41]
+        finally:
+            r.stop()
+            bus.stop()
+        assert not any(t.name.startswith("bc-tip") and t.is_alive()
+                       for t in threading.enumerate())
