@@ -62,13 +62,14 @@ RPCLOAD_MODE = "rpcload" in sys.argv[1:]  # RPC fan-out serving (PR 9)
 WARMSTART_MODE = "warmstart" in sys.argv[1:]  # compile-once readiness (PR 8)
 MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
 CHAOSNET_MODE = "chaosnet" in sys.argv[1:]  # partition-heal recovery (PR 10)
+CRASHREC_MODE = "crashrecovery" in sys.argv[1:]  # kill->committing (PR 14)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
-                      "--pipeline", "--parallel")]
+                      "crashrecovery", "--pipeline", "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -130,6 +131,9 @@ CHAOSNET_NVAL = _env_int("TM_TPU_BENCH_CHAOSNET_NVAL", 4)
 CHAOSNET_SEED = _env_int("TM_TPU_BENCH_CHAOSNET_SEED", 1)
 CHAOSNET_METRIC = (
     f"chaosnet_partition_heal_{CHAOSNET_NVAL}node_recovery_ms")
+CRASHREC_ROUNDS = _env_int("TM_TPU_BENCH_CRASHREC_ROUNDS", 3)
+CRASHREC_METRIC = (
+    f"crash_recovery_kill_to_committing_{CRASHREC_ROUNDS}rounds_ms")
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1655,6 +1659,68 @@ def chaosnet_main():
     return 0 if ok else 1
 
 
+def crashrecovery_main():
+    """`bench.py crashrecovery` — kill -> recovered-and-committing
+    latency: the crash-matrix harness (tools/crashmatrix.py) warms a
+    FileDB-backed single-validator node, kills it in-process at
+    ApplyBlock.AfterCommit (app committed, chain state unsaved — the
+    stored-responses handshake path, the most intricate replay case),
+    restarts from disk, and measures wall from the kill to the first
+    NEW committed block. The recovery oracle gates the number: any
+    failing clause (handshake, double-sign guard, index convergence,
+    app-hash-vs-uncrashed-replay) emits value -1 instead of a fake
+    latency. Pure host path: no TPU."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu.tools import crashmatrix
+
+    root = tempfile.mkdtemp(prefix="bench_crashrec_")
+    recoveries_ms = []
+    oracle_ok = True
+    results = []
+    try:
+        for i in range(CRASHREC_ROUNDS):
+            # one matrix cell per round: run_case owns the warm/kill/
+            # restart sequence AND the full recovery oracle (handshake,
+            # progression, double-sign guard vs the release ledger,
+            # index convergence, app-hash-vs-uncrashed-replay), so the
+            # published latency can never outlive the oracle's rigor
+            res = crashmatrix.run_case(
+                os.path.join(root, f"round{i}"),
+                "ApplyBlock.AfterCommit", mode="clean", nth=1,
+                timeout=60)
+            ok = bool(res.get("ok"))
+            oracle_ok = oracle_ok and ok
+            if ok and res.get("recommit_s"):
+                recoveries_ms.append(res["recommit_s"] * 1000)
+            results.append({"round": i,
+                            "crash_height": res.get("crash_height"),
+                            "oracle_ok": ok})
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    mean_ms = (sum(recoveries_ms) / len(recoveries_ms)
+               if recoveries_ms else -1)
+    print(json.dumps({
+        "metric": CRASHREC_METRIC,
+        "value": round(mean_ms, 1) if oracle_ok and recoveries_ms else -1,
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "rounds": results,
+        "note": ("wall from in-process kill at ApplyBlock.AfterCommit "
+                 "to the first NEW committed block after restart; "
+                 "best %.1f worst %.1f over %d rounds"
+                 % (min(recoveries_ms), max(recoveries_ms),
+                    len(recoveries_ms))) if recoveries_ms else
+                "no recovery completed",
+    }))
+    return 0 if oracle_ok else 1
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -1665,6 +1731,9 @@ def main():
     if CHAOSNET_MODE:
         # in-process localnet: pure host path, no TPU probe
         return chaosnet_main()
+    if CRASHREC_MODE:
+        # crash-matrix harness: pure host path, no TPU probe
+        return crashrecovery_main()
     if LOAD_MODE:
         if PARALLEL_FLAG:
             return load_parallel_main()
@@ -1860,6 +1929,8 @@ if __name__ == "__main__":
             metric = AGG_METRIC
         elif WARMSTART_MODE:
             metric = WARM_METRIC
+        elif CRASHREC_MODE:
+            metric = CRASHREC_METRIC
         else:
             mode = "_rlc" if RLC_MODE else ""
             metric = f"verify_commit_{METRIC_N}_sigs{mode}_wall_ms"

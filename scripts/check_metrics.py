@@ -286,6 +286,12 @@ REQUIRED_FAMILIES = (
     # blocks commit — execute/events/mempool_update record on every
     # apply_block; index needs an indexing node, wal a consensus WAL)
     "commit_stage_seconds",
+    # PR-14 crash-consistency engine (declaration presence: a clean
+    # boot replays nothing, recovery_time records one sample per boot,
+    # and storage faults flow only under an armed [storage] fault_plan)
+    "recovery_replayed_blocks_total",
+    "recovery_time_seconds",
+    "storage_faults_injected_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -32,9 +32,85 @@ from .. import types as abci
 SNAPSHOT_FORMAT = 1  # version of the serialized payload below
 
 
+class _CommitBufferDB:
+    """Block-scoped write buffer making the app's Commit atomic.
+
+    The ABCI contract lets a crashed app be replayed from its LAST
+    COMMITTED height — which is only sound if a crash mid-block leaves
+    the durable state exactly at that commit. Writing straight to the
+    backing db breaks that for every non-idempotent path: an `inc:`
+    re-reads its own half-applied bump, and the churn app's EndBlock
+    epoch batch (a read-modify-write over the phantom pool) emits a
+    DIFFERENT rotation on replay ("removing unknown validator" — found
+    by the crash matrix at Exec.AfterSpeculationAdopt). So all app
+    writes land here, reads/iteration merge pending over the backing
+    db, and commit() flushes the block's writes as ONE apply_batch —
+    on FileDB, one appended record run + one flush.
+
+    Speculative execution composes for free: exec_promote writes into
+    this buffer, so an adopted-but-uncommitted speculation lives only
+    in memory — "zero trace" is literal."""
+
+    def __init__(self, db: DB):
+        self.backing = db
+        self._pending: dict = {}  # key -> value bytes | None (= delete)
+
+    def get(self, key: bytes):
+        k = bytes(key)
+        if k in self._pending:
+            return self._pending[k]
+        return self.backing.get(k)
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._pending[bytes(key)] = bytes(value)
+
+    def delete(self, key: bytes) -> None:
+        self._pending[bytes(key)] = None
+
+    def iterator(self, start=None, end=None):
+        def _in(k):
+            return ((start is None or k >= start)
+                    and (end is None or k < end))
+
+        pend = {k: v for k, v in self._pending.items() if _in(k)}
+        merged = {k: v for k, v in self.backing.iterator(start, end)
+                  if k not in pend}
+        for k, v in pend.items():
+            if v is not None:
+                merged[k] = v
+        for k in sorted(merged):
+            yield k, merged[k]
+
+    def reverse_iterator(self, start=None, end=None):
+        yield from reversed(list(self.iterator(start, end)))
+
+    def flush(self) -> None:
+        """Apply the pending block as one batch (the commit point)."""
+        if not self._pending:
+            return
+        ops = [("set", k, v) if v is not None else ("del", k, None)
+               for k, v in self._pending.items()]
+        self._pending.clear()
+        self.backing.apply_batch(ops)
+
+    def discard(self) -> None:
+        self._pending.clear()
+
+    def close(self) -> None:
+        self.backing.close()
+
+    def stats(self) -> dict:
+        out = self.backing.stats()
+        out["pending_writes"] = len(self._pending)
+        return out
+
+
 class KVStoreApplication(abci.Application):
     def __init__(self, db: Optional[DB] = None):
-        self.db = db or MemDB()
+        self.db = _CommitBufferDB(db or MemDB())
         self.size = 0
         self.height = 0
         self.app_hash = b""
@@ -102,6 +178,10 @@ class KVStoreApplication(abci.Application):
         self.height += 1
         self.app_hash = self._compute_app_hash()
         self._save_state()
+        # the commit point: the whole block's writes (plus __state__)
+        # land in ONE backing-db batch — before this, a crash leaves
+        # the durable state exactly at the previous commit
+        self.db.flush()
         if self.snapshot_interval and self.height % self.snapshot_interval == 0:
             self._take_snapshot()
         return abci.ResponseCommit(data=self.app_hash)
@@ -228,6 +308,8 @@ class KVStoreApplication(abci.Application):
         self.height, self.size = height, size
         self.app_hash = computed
         self._save_state()
+        # restore happens outside any block: flush it like a commit
+        self.db.flush()
         return abci.ResponseApplySnapshotChunk(result=abci.APPLY_ACCEPT)
 
     def query(self, req):

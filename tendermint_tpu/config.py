@@ -321,6 +321,26 @@ class StateSyncConfig:
 
 
 @dataclass
+class StorageConfig:
+    """[storage] — the crash-consistency fault engine
+    (libs/storagechaos.py; ours, the durability counterpart of [chaos]).
+
+    fault_plan: path to a StorageFaultPlan JSON file
+    ({"seed": N, "faults": [[target, kind, at_op], ...]}). When set,
+    node boot installs a StorageFaultInjector and wraps every node DB
+    and the consensus WAL in fault-injecting shims: the named target's
+    at_op'th mutating operation injects the fault (torn_write /
+    partial_batch / lost_tail / bit_flip) and kills the process —
+    crash states become replayable experiments. Empty (default) = no
+    wrapping, zero overhead.
+    fault_seed: overrides the plan file's seed when != 0 (sweep one
+    plan shape across seeds without rewriting the file)."""
+
+    fault_plan: str = ""
+    fault_seed: int = 0
+
+
+@dataclass
 class ChaosConfig:
     """[chaos] — the deterministic network-fault engine (p2p/netchaos.py;
     ours, no reference equivalent — the reference's only fault tool is
@@ -396,6 +416,7 @@ class Config:
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 
@@ -441,6 +462,7 @@ class Config:
             emit("crypto", self.crypto),
             emit("statesync", self.statesync),
             emit("chaos", self.chaos),
+            emit("storage", self.storage),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
         ]
@@ -464,6 +486,7 @@ class Config:
             "crypto": cfg.crypto,
             "statesync": cfg.statesync,
             "chaos": cfg.chaos,
+            "storage": cfg.storage,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,
         }

@@ -43,6 +43,10 @@ class Handshaker:
         self.genesis_doc = genesis_doc
         self.event_bus = event_bus
         self.n_blocks = 0
+        # inclusive height span of replayed blocks, (0, 0) when none —
+        # recovery telemetry (/debug/recovery, tm-monitor [REPLAYED])
+        self.replay_from = 0
+        self.replay_to = 0
 
     def handshake(self, proxy_app) -> bytes:
         """Sync app ← chain; returns the app hash after sync (reference
@@ -159,9 +163,15 @@ class Handshaker:
             block = self.store.load_block(height)
             app_hash = _exec_block_on_app(proxy_app.consensus, block, self.state_db)
             self.n_blocks += 1
+            self._note_replayed(height)
         if mutate_state:
             return self._apply_block(state, proxy_app.consensus, store_block_height)
         return app_hash
+
+    def _note_replayed(self, height: int) -> None:
+        if self.replay_from == 0:
+            self.replay_from = height
+        self.replay_to = max(self.replay_to, height)
 
     def _apply_block(self, state, app_conn, height: int):
         """Full ApplyBlock for the stored block at `height` (reference
@@ -173,6 +183,7 @@ class Handshaker:
             state, BlockID(block.hash(), part_set.header()), block
         )
         self.n_blocks += 1
+        self._note_replayed(height)
         self.initial_state = new_state
         return new_state.app_hash
 

@@ -67,6 +67,9 @@ class WAL:
         self._corrupted_counter = (corrupted_counter
                                    if corrupted_counter is not None else NOP)
         self._corruption_warned = False
+        # plain process-local count mirroring the metric — the
+        # /debug/recovery provider reads it without a registry scrape
+        self.corrupted_records = 0
 
     def start(self) -> None:
         self._started = True
@@ -110,6 +113,7 @@ class WAL:
         stops there, the crash-recovery contract), but silently eaten
         records used to be invisible to operators."""
         self._corrupted_counter.inc()
+        self.corrupted_records += 1
         if not self._corruption_warned:
             self._corruption_warned = True
             LOG.warning(

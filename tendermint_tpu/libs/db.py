@@ -152,15 +152,27 @@ class MemDB(DB):
 
 class FileDB(DB):
     """Append-only log + in-memory index; compacts on close. Durable
-    default for nodes when the C++ backend isn't built."""
+    default for nodes when the C++ backend isn't built.
+
+    Crash-tail hygiene: a process that died mid-append leaves a torn
+    final record (prefix-only bytes). _load parses cleanly up to the
+    tear, DROPS the tail, and TRUNCATES the file back to the last
+    whole record — without the truncate, the next append would land
+    AFTER the torn bytes and every later (valid) record would be
+    unreachable on the following reload. `tail_dropped_bytes` (stats)
+    reports what a reload discarded."""
 
     MAGIC = b"TMFD1\n"
+    # a klen/vlen beyond this is a garbage header (bit rot / tear
+    # landing inside the length field), not a real record
+    MAX_RECORD_FIELD = 1 << 30
 
     def __init__(self, path: str):
         self._path = path
         self._mem = MemDB()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = None
+        self.tail_dropped_bytes = 0
         if os.path.exists(path):
             self._load()
         self._fh = open(path, "ab")
@@ -173,11 +185,15 @@ class FileDB(DB):
             magic = f.read(len(self.MAGIC))
             if magic != self.MAGIC:
                 raise ValueError(f"bad filedb magic in {self._path}")
+            valid_end = len(self.MAGIC)
             while True:
                 hdr = f.read(9)
                 if len(hdr) < 9:
                     break
                 op, klen, vlen = struct.unpack(">BII", hdr)
+                if (op not in (0, 1) or klen > self.MAX_RECORD_FIELD
+                        or vlen > self.MAX_RECORD_FIELD):
+                    break  # garbage header: stop at the last whole record
                 k = f.read(klen)
                 if len(k) < klen:
                     break
@@ -188,6 +204,20 @@ class FileDB(DB):
                     self._mem.set(k, v)
                 else:
                     self._mem.delete(k)
+                valid_end = f.tell()
+        total = os.path.getsize(self._path)
+        if total > valid_end:
+            # torn crash tail: drop it NOW so subsequent appends extend
+            # the valid log instead of burying themselves behind the tear
+            self.tail_dropped_bytes = total - valid_end
+            import logging
+
+            logging.getLogger("libs.db").warning(
+                "filedb %s: dropped %d-byte torn tail at offset %d "
+                "(crash artifact); log truncated to last whole record",
+                self._path, self.tail_dropped_bytes, valid_end)
+            with open(self._path, "rb+") as f:
+                f.truncate(valid_end)
 
     @staticmethod
     def _record(op: int, key: bytes, value: bytes) -> bytes:
@@ -243,7 +273,9 @@ class FileDB(DB):
             self._fh = None
 
     def stats(self):
-        return self._mem.stats()
+        out = self._mem.stats()
+        out["tail_dropped_bytes"] = self.tail_dropped_bytes
+        return out
 
 
 class PrefixDB(DB):

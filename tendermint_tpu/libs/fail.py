@@ -1,10 +1,19 @@
 """Fail-point injection for crash testing (reference libs/fail/fail.go).
 
-Each call to fail_point() increments a global counter; when the counter
-reaches int(FAIL_TEST_INDEX), the process exits hard (os._exit) —
-simulating a crash at exactly that point. The crash/restart test matrix
-(reference test/persist/test_failure_indices.sh) iterates the index over
-the 9 crash-critical spots in apply_block/finalize_commit.
+Two targeting modes:
+
+* ``FAIL_TEST_INDEX=N`` (reference test_failure_indices.sh): every
+  fail_point() call increments a global counter; when it reaches N the
+  process exits hard (os._exit) — a crash at exactly that point.
+* Named points (ours): ``arm_crash("Index.AfterBatchWrite", nth=2)``
+  crashes at the 2nd hit of that point, independent of how many other
+  points fire in between — the crash matrix iterates KNOWN_POINTS ×
+  storage-fault modes this way (tools/crashmatrix.py). The env
+  spelling ``FAIL_TEST_POINT=Name[:nth]`` does the same for
+  subprocess nodes. The default action is os._exit(1); an in-process
+  harness passes its own action (freeze storage + raise
+  SimulatedCrashError) so the "dead" node can be restarted inside one
+  test process.
 """
 
 from __future__ import annotations
@@ -20,6 +29,33 @@ _names: list[str] = []
 # run arbitrary code — e.g. a sleep that stalls the consensus thread so
 # the stall watchdog can be exercised without a crash/restart cycle
 _hooks: dict = {}
+# named crash arming: name -> [remaining_hits, action_or_None]
+_armed: dict = {}
+_env_point_loaded = False
+
+# every named fail point wired into the stack, in rough commit order —
+# the crash/restart matrix enumerates this (tools/crashmatrix.py).
+# Reference points map to consensus/state.go:1251-1308 +
+# state/execution.go:103-145; the rest cover the orderings PRs 12-13
+# introduced (batched indexer ingest, chunked mempool admission,
+# speculative execution) plus privval persistence and statesync apply.
+KNOWN_POINTS = (
+    "FinalizeCommit.BeforeSave",
+    "FinalizeCommit.AfterSave",
+    "FinalizeCommit.AfterWAL",
+    "FinalizeCommit.AfterApplyBlock",
+    "ApplyBlock.SaveABCIResponses",
+    "ApplyBlock.AfterSaveABCIResponses",
+    "ApplyBlock.AfterCommit",
+    "ApplyBlock.AfterSaveState",
+    "Index.BeforeBatchWrite",
+    "Index.AfterBatchWrite",
+    "Index.BeforeGenerationBump",
+    "Mempool.MidAdmitChunk",
+    "Exec.AfterSpeculationAdopt",
+    "Privval.BeforeSignStateSave",
+    "Statesync.MidChunkApply",
+)
 
 
 def env_index() -> int:
@@ -43,14 +79,74 @@ def clear_hook(name: str = "") -> None:
             _hooks.clear()
 
 
+def _default_crash(name: str) -> None:
+    sys.stderr.write(f"*** fail-point {name}: exiting ***\n")
+    sys.stderr.flush()
+    os._exit(1)
+
+
+def arm_crash(name: str, nth: int = 1, action=None) -> None:
+    """Crash at the `nth` hit of fail_point(name) (1-based). `action`
+    defaults to hard process exit; an in-process harness passes a
+    callable that freezes storage and raises instead."""
+    if nth < 1:
+        raise ValueError("nth must be >= 1")
+    with _lock:
+        _armed[name] = [nth, action]
+
+
+def disarm_crash(name: str = "") -> None:
+    with _lock:
+        if name:
+            _armed.pop(name, None)
+        else:
+            _armed.clear()
+
+
+def _ensure_env_point() -> None:
+    """FAIL_TEST_POINT=Name[:nth] arms a named crash once per process."""
+    global _env_point_loaded
+    if _env_point_loaded:
+        return
+    _env_point_loaded = True
+    spec = os.environ.get("FAIL_TEST_POINT")
+    if not spec:
+        return
+    name, _, nth = spec.partition(":")
+    try:
+        n = int(nth) if nth else 1
+    except ValueError:
+        n = 1
+    arm_crash(name, nth=max(1, n))
+
+
 def fail_point(name: str = "") -> None:
-    """Crash the process if this is the FAIL_TEST_INDEX'th fail point hit
-    (reference fail.Fail: libs/fail/fail.go:34-43); programmatic hooks
-    run first (set_hook)."""
+    """Crash the process if this point is targeted — by the legacy
+    global FAIL_TEST_INDEX counter (reference fail.Fail:
+    libs/fail/fail.go:34-43) or by a named arm_crash/FAIL_TEST_POINT.
+    Programmatic hooks run first (set_hook)."""
     global _counter
     hook = _hooks.get(name)
     if hook is not None:
         hook()
+    _ensure_env_point()
+    ent = _armed.get(name)
+    if ent is not None:
+        fire = False
+        action = None
+        with _lock:
+            ent = _armed.get(name)
+            if ent is not None:
+                ent[0] -= 1
+                if ent[0] <= 0:
+                    fire = True
+                    action = ent[1]
+                    del _armed[name]
+        if fire:
+            if action is None:
+                _default_crash(name)
+            else:
+                action(name)
     idx = env_index()
     if idx < 0:
         return
@@ -65,8 +161,10 @@ def fail_point(name: str = "") -> None:
 
 
 def reset() -> None:
-    global _counter
+    global _counter, _env_point_loaded
     with _lock:
         _counter = 0
         _names.clear()
         _hooks.clear()
+        _armed.clear()
+        _env_point_loaded = False

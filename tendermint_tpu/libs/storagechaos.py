@@ -1,0 +1,599 @@
+"""Seeded storage-fault injection — the durability counterpart of
+p2p/netchaos.py.
+
+The chaos engine (PR 10) proved that replayable, seeded fault timelines
+flush out real bugs at the network layer; this module is the same idea
+pointed at the storage/process layer: every durable artifact a node
+owns (the consensus WAL's autofile group, each libs/db FileDB) can be
+wrapped in a fault-injecting shim driven by a ``StorageFaultPlan`` —
+a seed plus a list of op-indexed faults, serializable both ways, so a
+crash state is a pure function of the plan and replays bit-for-bit.
+
+Fault kinds (each models a real storage failure):
+
+  torn_write     the op's on-disk record is cut to a seeded prefix —
+                 the classic mid-write power cut (prefix-only record)
+  partial_batch  an apply_batch run applies only a seeded prefix of
+                 its ops durably — a tear inside a one-flush batch
+  lost_tail      everything written since the last fsync vanishes —
+                 the page cache died with the kernel
+  bit_flip       one seeded bit in the just-written record flips —
+                 disk corruption, NOT a crash artifact (the WAL must
+                 tell these apart: CRC failure vs truncated tail)
+
+Every injected fault "kills the process": the injector freezes (all
+wrapped mutating ops raise ``SimulatedCrashError``), so the durable
+image cannot change after death, exactly like ``os._exit``. The crash
+matrix (tools/crashmatrix.py) composes this with libs/fail.py crash
+points: a named point fires, the injector applies the matrix's fault
+mode to the durable image, freezes, and the harness restarts the node
+from what the "dead process" left on disk.
+
+``SimulatedCrashError`` subclasses BaseException on purpose: the
+consensus receive loop (and every other worker) absorbs ``Exception``
+to stay alive under network garbage, but a process death must not be
+absorbable — the thread that "died" unwinds like the process would.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+def _derive_seed(key: str) -> int:
+    """Process-independent RNG seed from a derivation key. Builtin
+    hash() is salted per process (PYTHONHASHSEED) and would break the
+    replay-bit-for-bit contract; sha256 is the same derivation
+    netchaos uses per link."""
+    return int.from_bytes(
+        hashlib.sha256(key.encode()).digest()[:8], "big")
+
+LOG = logging.getLogger("storagechaos")
+
+KINDS = ("torn_write", "partial_batch", "lost_tail", "bit_flip")
+
+# kill-time fault modes the crash matrix composes with fail points:
+# mode -> (target, kind) applied to the durable image at the moment of
+# death (tools/crashmatrix.py drives these; "clean" is a bare kill)
+KILL_MODES = {
+    "clean": None,
+    "wal_torn": ("wal", "torn_write"),
+    "wal_bitflip": ("wal", "bit_flip"),
+    "wal_lost_tail": ("wal", "lost_tail"),
+    "idx_torn": ("db:tx_index", "torn_write"),
+    "state_torn": ("db:state", "torn_write"),
+    "block_torn": ("db:blockstore", "torn_write"),
+}
+
+
+class SimulatedCrashError(BaseException):
+    """The simulated process death. BaseException: worker loops that
+    absorb Exception must not survive it (a real crash wouldn't ask)."""
+
+
+@dataclass(frozen=True)
+class StorageFault:
+    """One injected fault: at the ``at_op``'th mutating operation on
+    ``target`` (0-based, per-target counter), inject ``kind`` and kill.
+    Targets: "wal" (the consensus WAL group) or "db:<name>" (a node DB
+    by provider name: state, blockstore, tx_index, statesync, app)."""
+
+    target: str
+    kind: str
+    at_op: int
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.at_op < 0:
+            raise ValueError("at_op must be >= 0")
+        if not (self.target == "wal" or self.target.startswith("db:")):
+            raise ValueError(f"unknown fault target {self.target!r}")
+
+    def to_obj(self) -> list:
+        return [self.target, self.kind, self.at_op]
+
+    @classmethod
+    def from_obj(cls, o) -> "StorageFault":
+        return cls(target=str(o[0]), kind=str(o[1]), at_op=int(o[2]))
+
+
+@dataclass
+class StorageFaultPlan:
+    """A crash experiment as a data object: seed + op-indexed faults.
+    Same JSON-both-ways contract as netchaos.FaultPlan — a matrix case
+    is replayable from the plan alone."""
+
+    seed: int = 0
+    faults: List[StorageFault] = field(default_factory=list)
+
+    def add(self, target: str, kind: str, at_op: int) -> "StorageFaultPlan":
+        self.faults.append(StorageFault(target, kind, at_op))
+        return self
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"seed": self.seed, "faults": [f.to_obj() for f in self.faults]},
+            sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "StorageFaultPlan":
+        o = json.loads(text)
+        plan = cls(seed=int(o.get("seed", 0)))
+        for f in o.get("faults", []):
+            plan.faults.append(StorageFault.from_obj(f))
+        return plan
+
+    def rng_for(self, fault: StorageFault) -> random.Random:
+        """Per-fault RNG derived from (seed, target, kind, at_op): the
+        torn prefix length / flipped bit / surviving batch prefix are
+        functions of the plan, independent of scheduling (the netchaos
+        per-link derivation, collapsed to per-fault)."""
+        return random.Random(_derive_seed(
+            f"{self.seed}|{fault.target}|{fault.kind}|{fault.at_op}"))
+
+
+class StorageFaultInjector:
+    """Owns a plan, per-target op counters, and the death switch.
+
+    Wrappers call ``take(target)`` before each mutating op: the result
+    is the fault to inject now (or None), and the call raises
+    ``SimulatedCrashError`` when the injector is already dead —
+    nothing durable can happen after death. ``kill()`` snapshots each
+    registered file's durable (OS-visible) size; ``apply_post_mortem``
+    truncates files back to those sizes after the harness tears the
+    "dead" objects down (Python buffered writers flush on close; a real
+    crash would have lost those buffers, so the harness re-loses them).
+    """
+
+    def __init__(self, plan: Optional[StorageFaultPlan] = None,
+                 exit_process: bool = False):
+        # exit_process: a REAL node ([storage] fault_plan) must die like
+        # os._exit when a fault fires — freezing alone leaves the main
+        # thread waiting forever. The in-process harness keeps the
+        # default (raise + freeze) so the "dead" node can be restarted
+        # inside one test process.
+        self.exit_process = exit_process
+        self.plan = plan or StorageFaultPlan()
+        self._lock = threading.Lock()
+        self._ops: Dict[str, int] = {}
+        self._dead = False
+        self._death_sizes: Dict[str, int] = {}
+        self._files: Dict[str, str] = {}  # target -> durable file path
+        self._sync_sizes: Dict[str, int] = {}  # target -> size at last fsync
+        self.injected: Dict[str, int] = {k: 0 for k in KINDS}
+        self._metric = None  # storage_faults_injected_total{kind}
+
+    # -- wiring --------------------------------------------------------
+
+    def set_metrics(self, counter) -> None:
+        self._metric = counter
+
+    def register_file(self, target: str, path: str) -> None:
+        """Tell the injector which on-disk file backs a target (used
+        for kill-time size snapshots and image mutation)."""
+        with self._lock:
+            self._files[target] = path
+
+    # -- liveness ------------------------------------------------------
+
+    @property
+    def dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    def check_alive(self) -> None:
+        with self._lock:
+            dead = self._dead
+        if dead:
+            raise SimulatedCrashError("process is dead")
+
+    def note_sync(self, target: str) -> None:
+        """A target fsync'd: its durable floor moves to the current
+        file size (the lost_tail fault truncates back to this)."""
+        with self._lock:
+            path = self._files.get(target)
+        if path is None:
+            return
+        try:
+            size = os.path.getsize(path)  # IO outside the lock
+        except OSError:
+            return
+        with self._lock:
+            self._sync_sizes[target] = size
+
+    def sync_floor(self, target: str) -> int:
+        """Durable floor of a target: its file size at the last fsync."""
+        with self._lock:
+            return self._sync_sizes.get(target, 0)
+
+    def take(self, target: str) -> Optional[StorageFault]:
+        """Account one mutating op on `target`; return the fault to
+        inject at this op, if any. Raises if already dead."""
+        self.check_alive()
+        with self._lock:
+            n = self._ops.get(target, 0)
+            self._ops[target] = n + 1
+            for f in self.plan.faults:
+                if f.target == target and f.at_op == n:
+                    return f
+        return None
+
+    def note_injected(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+        if self._metric is not None:
+            self._metric.with_labels(kind).inc()
+
+    # -- death ---------------------------------------------------------
+
+    def kill(self, mode: str = "clean") -> None:
+        """Simulate process death: freeze all wrapped storage and
+        snapshot every registered file's durable size. `mode` (a
+        KILL_MODES key) optionally marks a fault to apply to the
+        durable image in apply_post_mortem."""
+        if mode not in KILL_MODES:
+            raise ValueError(f"unknown kill mode {mode!r}")
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._kill_mode = mode
+            for target, path in self._files.items():
+                try:
+                    self._death_sizes[target] = os.path.getsize(path)
+                except OSError:
+                    pass
+
+    def crash(self, fault: StorageFault) -> None:
+        """Inject-and-die entry used by wrappers once they have applied
+        the fault's durable damage."""
+        import sys
+
+        self.note_injected(fault.kind)
+        self.kill()
+        if self.exit_process:
+            sys.stderr.write(
+                f"*** storage fault {fault.kind} on {fault.target} at "
+                f"op {fault.at_op}: exiting ***\n")
+            sys.stderr.flush()
+            os._exit(1)
+        raise SimulatedCrashError(
+            f"storage fault {fault.kind} on {fault.target} "
+            f"at op {fault.at_op}")
+
+    def apply_post_mortem(self) -> None:
+        """After the harness tore down the dead node's objects (handle
+        closes flushed whatever Python still buffered), restore each
+        file to its at-death durable size, then apply the kill mode's
+        image fault. Idempotent; call once before restart."""
+        with self._lock:
+            if not self._dead:
+                raise RuntimeError("apply_post_mortem before kill()")
+            death_sizes = dict(self._death_sizes)
+            files = dict(self._files)
+            mode = getattr(self, "_kill_mode", "clean")
+        for target, size in death_sizes.items():
+            path = files.get(target)
+            if path is None or not os.path.exists(path):
+                continue
+            try:
+                if os.path.getsize(path) > size:
+                    with open(path, "rb+") as f:
+                        f.truncate(size)
+            except OSError:
+                LOG.warning("post-mortem truncate failed for %s", path)
+        tk = KILL_MODES.get(mode)
+        if tk is not None:
+            target, kind = tk
+            self._mutate_image(target, kind)
+
+    def _mutate_image(self, target: str, kind: str) -> None:
+        """Apply a kill-mode fault to a target's durable image. The
+        damage is a pure function of the plan seed + mode."""
+        with self._lock:
+            path = self._files.get(target)
+            sync_floor = self._sync_sizes.get(target, 0)
+        if path is None or not os.path.exists(path):
+            return
+        size = os.path.getsize(path)
+        rng = random.Random(_derive_seed(
+            f"{self.plan.seed}|killmode|{target}|{kind}"))
+        if kind == "torn_write":
+            # tear the tail mid-record: drop 1..24 bytes (bounded so a
+            # short file keeps its magic/header). fsync'd bytes are on
+            # the platter — tears only reach the un-synced tail, which
+            # is what makes explicit durability barriers (the state
+            # db's pre-app-commit fsync) observable in the matrix
+            floor = max(sync_floor, 8)
+            drop = min(rng.randint(1, 24), max(size - floor, 0))
+            if drop > 0:
+                with open(path, "rb+") as f:
+                    f.truncate(size - drop)
+                self.note_injected(kind)
+        elif kind == "lost_tail":
+            if size > sync_floor > 0:
+                with open(path, "rb+") as f:
+                    f.truncate(sync_floor)
+                self.note_injected(kind)
+        elif kind == "bit_flip":
+            # flip one bit in the last ~256 durable bytes (the records
+            # most recently written — where crash damage lands)
+            if size > 16:
+                off = size - 1 - rng.randrange(min(256, size - 16))
+                with open(path, "rb+") as f:
+                    f.seek(off)
+                    b = f.read(1)
+                    f.seek(off)
+                    f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+                self.note_injected(kind)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "dead": self._dead,
+                "ops": dict(self._ops),
+                "injected": {k: v for k, v in self.injected.items() if v},
+                "plan": self.plan.to_json(),
+            }
+
+
+# --- wrappers ---------------------------------------------------------
+
+
+class FaultyDB:
+    """libs/db.DB shim: consults the injector before every mutating op.
+    Iteration/read paths pass through untouched (reads of a dead
+    process's memory don't matter — the harness discards the object).
+
+    Injection detail per kind (FileDB-backed targets get byte-level
+    damage; other backends degrade to the honest subset):
+      torn_write    append only a seeded prefix of the record, die
+      partial_batch apply only a seeded prefix of the ops, die
+      lost_tail     truncate back to the last-fsync size, die
+      bit_flip      apply the op, flip a seeded bit in its record, die
+    """
+
+    def __init__(self, inner, injector: StorageFaultInjector, target: str):
+        self._inner = inner
+        self._injector = injector
+        self._target = target
+        path = getattr(inner, "_path", None)
+        if path is not None:
+            injector.register_file(target, path)
+            injector.note_sync(target)  # boot state counts as durable
+
+    # -- mutating ops --------------------------------------------------
+
+    def set(self, key, value):
+        f = self._injector.take(self._target)
+        if f is not None:
+            self._inject_record(f, 1, key, value)
+        self._inner.set(key, value)
+
+    def set_sync(self, key, value):
+        f = self._injector.take(self._target)
+        if f is not None:
+            self._inject_record(f, 1, key, value)
+        self._inner.set_sync(key, value)
+        self._injector.note_sync(self._target)
+
+    def delete(self, key):
+        f = self._injector.take(self._target)
+        if f is not None:
+            self._inject_record(f, 0, key, b"")
+        self._inner.delete(key)
+
+    def apply_batch(self, ops):
+        f = self._injector.take(self._target)
+        if f is not None:
+            rng = self._injector.plan.rng_for(f)
+            if f.kind == "partial_batch" and ops:
+                keep = rng.randrange(len(ops))  # strict prefix
+                self._inner.apply_batch(list(ops)[:keep])
+                self._flush_inner()
+                self._injector.crash(f)
+            if f.kind == "torn_write" and ops:
+                # apply a prefix of whole ops plus a torn byte-prefix of
+                # the next record — the one-flush batch append cut mid-run
+                keep = rng.randrange(len(ops))
+                ops = list(ops)
+                self._inner.apply_batch(ops[:keep])
+                op, k, v = ops[keep]
+                self._torn_append(rng, 1 if op == "set" else 0, k, v or b"")
+                self._injector.crash(f)
+            if f.kind == "lost_tail":
+                self._lose_tail()
+                self._injector.crash(f)
+            if f.kind == "bit_flip":
+                # the whole batch lands, then one bit inside its byte
+                # run flips (disk corruption, not a crash artifact)
+                self._inner.apply_batch(ops)
+                self._flush_inner()
+                self._flip_tail_bit(rng)
+                self._injector.crash(f)
+        self._inner.apply_batch(ops)
+
+    def sync(self):
+        self._injector.check_alive()
+        if hasattr(self._inner, "sync"):
+            self._inner.sync()
+        self._injector.note_sync(self._target)
+
+    # -- injection helpers ---------------------------------------------
+
+    def _flush_inner(self):
+        fh = getattr(self._inner, "_fh", None)
+        if fh is not None:
+            fh.flush()
+
+    def _torn_append(self, rng: random.Random, op: int, key: bytes,
+                     value: bytes) -> None:
+        """Write a strict byte-prefix of one record straight to the
+        backing file (FileDB only; other backends leave no artifact —
+        the op simply never happened, the honest memdb equivalent)."""
+        record_fn = getattr(self._inner, "_record", None)
+        fh = getattr(self._inner, "_fh", None)
+        if record_fn is None or fh is None:
+            return
+        rec = record_fn(op, key, value)
+        cut = rng.randrange(1, len(rec)) if len(rec) > 1 else 0
+        fh.write(rec[:cut])
+        fh.flush()
+
+    def _lose_tail(self) -> None:
+        """Truncate the backing file to its last-fsync size — the
+        un-synced tail died with the page cache."""
+        path = getattr(self._inner, "_path", None)
+        if path is not None:
+            self._flush_inner()
+            floor = self._injector.sync_floor(self._target)
+            if floor > 0 and os.path.getsize(path) > floor:
+                with open(path, "rb+") as f:
+                    f.truncate(floor)
+
+    def _flip_tail_bit(self, rng: random.Random, span: int = 64) -> None:
+        """Flip one seeded bit within the last `span` durable bytes."""
+        path = getattr(self._inner, "_path", None)
+        if path is None:
+            return
+        size = os.path.getsize(path)
+        if size <= 8:
+            return
+        off = size - 1 - rng.randrange(min(span, size - 8))
+        with open(path, "rb+") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+
+    def _inject_record(self, fault: StorageFault, op: int, key: bytes,
+                       value: bytes) -> None:
+        rng = self._injector.plan.rng_for(fault)
+        if fault.kind == "torn_write":
+            self._torn_append(rng, op, key, value)
+            self._injector.crash(fault)
+        if fault.kind == "partial_batch":
+            # on a single op, "partial" = nothing applied
+            self._injector.crash(fault)
+        if fault.kind == "lost_tail":
+            self._lose_tail()
+            self._injector.crash(fault)
+        if fault.kind == "bit_flip":
+            # apply the op durably, then corrupt one bit inside it
+            if op == 1:
+                self._inner.set(key, value)
+            else:
+                self._inner.delete(key)
+            self._flush_inner()
+            record_fn = getattr(self._inner, "_record", None)
+            span = len(record_fn(op, key, value)) if record_fn else 64
+            self._flip_tail_bit(rng, span)
+            self._injector.crash(fault)
+
+    # -- passthrough ---------------------------------------------------
+
+    def get(self, key):
+        return self._inner.get(key)
+
+    def has(self, key):
+        return self._inner.has(key)
+
+    def iterator(self, start=None, end=None):
+        return self._inner.iterator(start, end)
+
+    def reverse_iterator(self, start=None, end=None):
+        return self._inner.reverse_iterator(start, end)
+
+    def batch(self):
+        from .db import Batch
+
+        return Batch(self)
+
+    def close(self):
+        self._inner.close()
+
+    def stats(self):
+        return self._inner.stats()
+
+
+class FaultyGroup:
+    """libs/autofile.Group shim for the consensus WAL: same injector
+    contract as FaultyDB, at the record-write level. WAL.group is
+    swapped for this by wrap_wal()."""
+
+    def __init__(self, inner, injector: StorageFaultInjector,
+                 target: str = "wal"):
+        self._inner = inner
+        self._injector = injector
+        self._target = target
+        injector.register_file(target, inner.head_path)
+        injector.note_sync(target)
+
+    @property
+    def head_path(self):
+        return self._inner.head_path
+
+    def write(self, data: bytes) -> None:
+        f = self._injector.take(self._target)
+        if f is not None:
+            rng = self._injector.plan.rng_for(f)
+            if f.kind in ("torn_write", "partial_batch"):
+                cut = rng.randrange(1, len(data)) if len(data) > 1 else 0
+                self._inner.write(data[:cut])
+                self._inner.flush()
+                self._injector.crash(f)
+            if f.kind == "lost_tail":
+                self._inner.flush()
+                floor = self._injector.sync_floor(self._target)
+                if floor > 0 and \
+                        os.path.getsize(self._inner.head_path) > floor:
+                    with open(self._inner.head_path, "rb+") as fh:
+                        fh.truncate(floor)
+                self._injector.crash(f)
+            if f.kind == "bit_flip":
+                self._inner.write(data)
+                self._inner.flush()
+                size = os.path.getsize(self._inner.head_path)
+                off = size - len(data) + rng.randrange(len(data))
+                with open(self._inner.head_path, "rb+") as fh:
+                    fh.seek(off)
+                    b = fh.read(1)
+                    fh.seek(off)
+                    fh.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+                self._injector.crash(f)
+        self._inner.write(data)
+
+    def flush(self) -> None:
+        self._injector.check_alive()
+        self._inner.flush()
+
+    def sync(self) -> None:
+        self._injector.check_alive()
+        self._inner.sync()
+        self._injector.note_sync(self._target)
+
+    def maybe_rotate(self) -> None:
+        self._injector.check_alive()
+        self._inner.maybe_rotate()
+
+    def paths_in_order(self):
+        return self._inner.paths_in_order()
+
+    def reader(self):
+        return self._inner.reader()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+def wrap_wal(wal, injector: StorageFaultInjector) -> None:
+    """Swap a consensus WAL's group for the fault-injecting shim."""
+    wal.group = FaultyGroup(wal.group, injector)

@@ -53,6 +53,7 @@ from typing import Callable, List, Optional, Tuple
 
 from ..abci import types as abci
 from ..config import MempoolConfig
+from ..libs import fail
 from . import preverify
 
 LOG = logging.getLogger("mempool")
@@ -526,6 +527,11 @@ class Mempool:
         (admission there is already racy between concurrent callers)."""
         out: List[object] = [None] * len(items)
         for start in range(0, len(items), self.ADMIT_CHUNK):
+            if start:
+                # crash between chunk lock holds: earlier chunks are
+                # admitted (and mempool-WAL'd), later ones never were —
+                # recovery must tolerate the half-admitted drain
+                fail.fail_point("Mempool.MidAdmitChunk")
             self._admit_chunk_locked(
                 items[start:start + self.ADMIT_CHUNK], out, start)
         return out

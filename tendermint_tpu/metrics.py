@@ -297,6 +297,24 @@ class StateMetrics:
 
 
 @dataclass
+class RecoveryMetrics:
+    """Crash-recovery telemetry (ours): what a restart had to repair.
+    Samples flow only on a boot that actually replayed/recovered, and
+    under armed storage-fault injection ([storage] fault_plan) — the
+    crash matrix's acceptance surface."""
+
+    # blocks re-driven through the app by the boot handshake (ABCI
+    # replay decision table) — nonzero exactly when a crash left the
+    # app behind the chain
+    replayed_blocks: object = NOP
+    # wall seconds of the whole boot recovery (handshake + index
+    # convergence), observed once per boot
+    recovery_time: object = NOP
+    # storage faults injected by the crash-consistency engine, by kind
+    storage_faults: object = NOP
+
+
+@dataclass
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
@@ -307,6 +325,7 @@ class NodeMetrics:
     statesync: StateSyncMetrics = field(default_factory=StateSyncMetrics)
     rpc: RPCMetrics = field(default_factory=RPCMetrics)
     lockdep: LockdepMetrics = field(default_factory=LockdepMetrics)
+    recovery: RecoveryMetrics = field(default_factory=RecoveryMetrics)
     registry: Optional[Registry] = None
 
 
@@ -638,6 +657,22 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "(latent deadlocks; records only under [instrumentation] "
             "lockdep)."),
     )
+    recovery = RecoveryMetrics(
+        replayed_blocks=r.counter(
+            f"{ns}_recovery_replayed_blocks_total",
+            "Blocks re-driven through the app by the boot handshake "
+            "(nonzero exactly when a crash left the app behind)."),
+        recovery_time=r.histogram(
+            f"{ns}_recovery_time_seconds",
+            "Wall time of boot recovery (ABCI handshake replay + tx "
+            "index convergence), one observation per boot.",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300)),
+        storage_faults=r.counter(
+            f"{ns}_storage_faults_injected_total",
+            "Storage faults injected by the crash-consistency engine, "
+            "by kind.", ("kind",)),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
-                       rpc=rpc, lockdep=lockdep, registry=r)
+                       rpc=rpc, lockdep=lockdep, recovery=recovery,
+                       registry=r)

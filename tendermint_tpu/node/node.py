@@ -219,8 +219,35 @@ class Node:
             lockdep.set_metrics(self.metrics.lockdep)
 
         # --- storage (node/node.go:162-171) --------------------------
-        self.block_store_db = db_provider("blockstore", backend, db_dir)
-        self.state_db = db_provider("state", backend, db_dir)
+        # crash-consistency fault engine ([storage] fault_plan, ours):
+        # when armed, every node DB and the consensus WAL are wrapped in
+        # seeded fault-injecting shims (libs/storagechaos.py) — the
+        # storage-layer counterpart of the [chaos] network engine
+        from ..libs import storagechaos
+
+        self.fault_injector = None
+        if config.storage.fault_plan:
+            with open(os.path.join(root, config.storage.fault_plan)
+                      if not os.path.isabs(config.storage.fault_plan)
+                      else config.storage.fault_plan) as f:
+                plan = storagechaos.StorageFaultPlan.from_json(f.read())
+            if config.storage.fault_seed:
+                plan.seed = config.storage.fault_seed
+            self.fault_injector = storagechaos.StorageFaultInjector(
+                plan, exit_process=True)
+            self.fault_injector.set_metrics(
+                self.metrics.recovery.storage_faults)
+
+        def _db(name: str):
+            d = db_provider(name, backend, db_dir)
+            if self.fault_injector is not None:
+                d = storagechaos.FaultyDB(d, self.fault_injector,
+                                          "db:" + name)
+            return d
+
+        self._db = _db
+        self.block_store_db = _db("blockstore")
+        self.state_db = _db("state")
         self.block_store = BlockStore(self.block_store_db)
 
         state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
@@ -236,10 +263,26 @@ class Node:
         self.proxy_app.start()
         self.proxy_app.set_consensus_resync(self._resync_app)
         self.event_bus = EventBus()
+        import time as _time
+
+        _recovery_t0 = _time.monotonic()
         handshaker = Handshaker(
             self.state_db, state, self.block_store, genesis_doc, self.event_bus
         )
         handshaker.handshake(self.proxy_app)
+        # recovery telemetry (/debug/recovery + recovery_* families):
+        # what this boot had to repair — completed below once the tx
+        # index has converged too
+        self._recovery = {
+            "handshake_outcome": "ok",
+            "replayed_blocks": handshaker.n_blocks,
+            "replay_from": handshaker.replay_from,
+            "replay_to": handshaker.replay_to,
+            "reindexed_blocks": 0,
+            "recovery_time_s": 0.0,
+        }
+        if handshaker.n_blocks:
+            self.metrics.recovery.replayed_blocks.inc(handshaker.n_blocks)
         # reload: handshake may have advanced state via replay
         state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
 
@@ -273,7 +316,7 @@ class Node:
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
 
         # --- evidence (node/node.go:273-291) -------------------------
-        self.evidence_db = db_provider("evidence", backend, db_dir)
+        self.evidence_db = _db("evidence")
         evidence_store = EvidenceStore(self.evidence_db)
         self.evidence_pool = EvidencePool(
             evidence_store,
@@ -305,6 +348,10 @@ class Node:
                 os.makedirs(os.path.dirname(wal_path), exist_ok=True)
                 wal = WAL(wal_path,
                           corrupted_counter=self.metrics.consensus.wal_corrupted)
+                if self.fault_injector is not None:
+                    from ..libs.storagechaos import wrap_wal
+
+                    wrap_wal(wal, self.fault_injector)
             self.consensus_state = ConsensusState(
                 config.consensus,
                 state,
@@ -354,7 +401,7 @@ class Node:
 
         # --- tx indexer (node/node.go:329-349) -----------------------
         if config.tx_index.indexer == "kv":
-            self.tx_index_db = db_provider("tx_index", backend, db_dir)
+            self.tx_index_db = _db("tx_index")
             tags = [
                 t.strip()
                 for t in config.tx_index.index_tags.split(",")
@@ -367,6 +414,18 @@ class Node:
             )
         else:
             self.tx_indexer = NullTxIndexer()
+        # index convergence: re-ingest committed blocks the crashed
+        # process never durably indexed (torn ingest batch, events lost
+        # before the service subscribed, handshake-replayed blocks) —
+        # after this, the index holds exactly the committed txs
+        from ..state.txindex import recover_index
+
+        self._recovery["reindexed_blocks"] = recover_index(
+            self.tx_indexer, self.block_store, self.state_db, logger=LOG)
+        self._recovery["recovery_time_s"] = round(
+            _time.monotonic() - _recovery_t0, 6)
+        self.metrics.recovery.recovery_time.observe(
+            self._recovery["recovery_time_s"])
         self.indexer_service = IndexerService(
             self.tx_indexer, self.event_bus,
             batch=config.tx_index.batch,
@@ -464,7 +523,7 @@ class Node:
         from ..p2p.trust import TrustMetricStore
 
         self.trust_store = TrustMetricStore(
-            db=db_provider("trust_history", backend, db_dir)
+            db=_db("trust_history")
         )
         self.sw = Switch(
             self.transport,
@@ -490,7 +549,7 @@ class Node:
         from ..statesync.reactor import SnapshotReactor
         from ..statesync.store import SnapshotStore
 
-        self.statesync_db = db_provider("statesync", backend, db_dir)
+        self.statesync_db = _db("statesync")
         self.snapshot_store = SnapshotStore(
             self.statesync_db, self.proxy_app.query,
             metrics=self.metrics.statesync)
@@ -770,6 +829,7 @@ class Node:
                 "/debug/crypto": lambda q: self._crypto_status(),
                 "/debug/rpc": lambda q: self._rpc_status(),
                 "/debug/lockdep": lambda q: self._lockdep_status(),
+                "/debug/recovery": lambda q: self._recovery_status(),
             },
         )
         self._prof_server.start()
@@ -789,6 +849,22 @@ class Node:
                      (self._consensus_absorber.absorbed
                       if self._consensus_absorber is not None else 0)},
         }
+
+    def _recovery_status(self) -> dict:
+        """/debug/recovery: what this boot repaired (handshake outcome,
+        replayed-block span, re-indexed blocks) plus the LIVE WAL
+        corruption count and, when the fault engine is armed, its
+        injection ledger — tm-monitor tags [REPLAYED h..h'] and
+        degrades health on corruption from this."""
+        out = dict(self._recovery)
+        wal_corrupted = 0
+        if self.consensus_state is not None:
+            wal_corrupted = getattr(self.consensus_state.wal,
+                                    "corrupted_records", 0)
+        out["wal_corrupted_records"] = wal_corrupted
+        if self.fault_injector is not None:
+            out["fault_engine"] = self.fault_injector.status()
+        return out
 
     def _rpc_status(self) -> dict:
         """/debug/rpc: response-cache pressure + websocket fan-out

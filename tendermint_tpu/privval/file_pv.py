@@ -12,8 +12,11 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import threading
 from typing import Optional
+
+from ..libs import fail
 
 from ..crypto import (
     PrivKey,
@@ -156,12 +159,16 @@ class FilePV:
         proposal.signature = sig
 
     def _save_signed(self, height: int, round_: int, step: int, sign_bytes: bytes, sig: bytes) -> None:
-        """Persist-before-release (reference :256-280)."""
+        """Persist-before-release (reference :256-280). A crash here —
+        signature computed, sign-state not yet durable — must lose the
+        signature entirely: it was never released to the caller, so the
+        recovered (older) last-sign state cannot enable a double sign."""
         self.last_height = height
         self.last_round = round_
         self.last_step = step
         self.last_signature = sig
         self.last_sign_bytes = sign_bytes
+        fail.fail_point("Privval.BeforeSignStateSave")
         self.save()
 
     # --- persistence --------------------------------------------------------
@@ -191,14 +198,29 @@ class FilePV:
         )
 
     def save(self) -> None:
+        """Atomic persist (the kernel_cache.py pattern): a UNIQUE
+        same-directory tempfile, fsync'd, then os.replace'd over the
+        target. A crash at any point leaves either the previous
+        complete file or the new complete file — never a truncated
+        double-sign guard; a fixed tmp name would let two racing
+        writers interleave into one torn tempfile before the rename."""
         if not self.file_path:
             return
-        tmp = self.file_path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(self.to_json())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.file_path)
+        payload = self.to_json()
+        d = os.path.dirname(self.file_path) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-privval-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.file_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     @classmethod
     def load(cls, file_path: str) -> "FilePV":

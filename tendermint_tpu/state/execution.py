@@ -266,6 +266,15 @@ class BlockExecutor:
 
         fail.fail_point("ApplyBlock.SaveABCIResponses")  # execution.go:103
         save_abci_responses(self.db, block.header.height, abci_responses)
+        # durability barrier: the app Commit below makes the app's state
+        # ahead of the chain's — recoverable ONLY through the stored
+        # responses (the app==store handshake path). If this record can
+        # vanish with an un-synced page-cache tail, that crash window is
+        # unrecoverable (found by the crash matrix:
+        # ApplyBlock.AfterCommit x state_torn), so fsync it FIRST.
+        sync = getattr(self.db, "sync", None)
+        if sync is not None:
+            sync()
         fail.fail_point("ApplyBlock.AfterSaveABCIResponses")  # execution.go:108
 
         val_updates = _abci_validator_updates(abci_responses)
@@ -400,6 +409,11 @@ class BlockExecutor:
             # ResilientClient swaps _client), and the session is bound to
             # the app object it executed against anyway
             run.session.app.exec_promote(run.session)
+            # crash here = speculative writes promoted into the app's
+            # working state but NOTHING committed (no app Commit, no
+            # chain-state save): recovery must re-execute the block and
+            # land on the same app hash — speculation leaves zero trace
+            fail.fail_point("Exec.AfterSpeculationAdopt")
             self.metrics.exec_speculation_hits.inc()
             return self._finish_run(run, block)
         if self.exec_config.parallel_lanes > 1:

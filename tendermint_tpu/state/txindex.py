@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from ..abci import types as abci
+from ..libs import fail
 from ..libs.db import DB
 from ..libs.events import Query
 from ..libs.service import BaseService
@@ -119,15 +120,54 @@ def _tag_key(key: str, value: str, height: int, index: int) -> bytes:
 
 class KVTxIndexer(TxIndexer):
     """reference state/txindex/kv/kv.go:28. Primary rows are hash->TxResult;
-    secondary rows are tagkey/value/height/index -> hash."""
+    secondary rows are tagkey/value/height/index -> hash.
+
+    Crash consistency: every ingest batch carries a durable marker row
+    (_META_HEIGHT, written LAST in the batch) holding the highest fully
+    ingested height. A torn batch append (FileDB tail tear) loses the
+    marker with the tail, so a partially-landed block reads as
+    not-ingested — recover_index() then re-indexes it from the stored
+    blocks + ABCI responses. Row keys are deterministic functions of
+    (tx, height, index), so re-indexing is idempotent."""
+
+    # NUL-prefixed, 21 bytes: cannot collide with tag rows (tag keys
+    # refuse NUL) or primary rows (32-byte tx hashes)
+    _META_HEIGHT = b"\x00meta:indexed_height"
 
     def __init__(self, db: DB, index_tags: Optional[List[str]] = None, index_all_tags: bool = False):
         self._db = db
         self._tags = set(index_tags or [])
         self._all = index_all_tags
         self._lock = threading.Lock()
-        self._indexed_height = 0
+        # _marker: the durable floor ("every block <= this is FULLY
+        # ingested" — what recovery trusts); _indexed_height: live
+        # ingest progress (highest height any tx landed for — what
+        # waiters poll). They coincide at boot and after every batch
+        # ingest; the per-tx path keeps the marker one block behind.
+        self._marker = self._load_marker()
+        self._indexed_height = self._marker
         self._index_generation = 0
+
+    def _load_marker(self) -> int:
+        raw = self._db.get(self._META_HEIGHT)
+        if raw:
+            try:
+                return int(serde.unpack(raw))
+            except (ValueError, TypeError):
+                return 0
+        # pre-marker data dir (or marker lost to a tear): seed the
+        # floor from the existing height tag rows in ONE read-only
+        # pass minus 1 (the top block may be half-ingested) — without
+        # this, every legacy boot would re-index the whole chain
+        top = 0
+        prefix = _tag_prefix(TX_HEIGHT_KEY)
+        for k, _v in self._db.iterator(prefix, prefix + b"\xff" * 8):
+            try:
+                _val, h, _i = serde.unpack(k[len(prefix):])
+                top = max(top, int(h))
+            except (ValueError, TypeError):
+                continue
+        return max(0, top - 1)
 
     def indexed_height(self) -> int:
         with self._lock:
@@ -157,10 +197,19 @@ class KVTxIndexer(TxIndexer):
     def index(self, result: TxResult) -> None:
         with self._lock:
             self._index_generation += 1
+            # per-tx ingest cannot know when a block is COMPLETE, so
+            # the durable marker only advances to height-1 (the prior
+            # block must be done once this one's txs arrive) — stamping
+            # the current height would mark a half-indexed block as
+            # fully ingested and recovery would skip its missing tail.
+            # Recovery re-indexes the in-flight block; rows are
+            # idempotent, so the overlap is harmless.
+            self._marker = max(self._marker, result.height - 1)
             if result.height > self._indexed_height:
                 self._indexed_height = result.height
             batch = self._db.batch()
             self._add_rows(batch, result)
+            batch.set(self._META_HEIGHT, serde.pack(self._marker))
             batch.write()
 
     def index_batch(self, height: int, results: List[TxResult]) -> None:
@@ -178,8 +227,26 @@ class KVTxIndexer(TxIndexer):
             batch = self._db.batch()
             for result in results:
                 self._add_rows(batch, result)
+            # durable commit record for the block's ingest: written LAST
+            # in the one-flush batch, so any tear strands the block's
+            # rows BELOW the marker and recovery re-indexes the block
+            self._marker = max(self._marker, height)
+            batch.set(self._META_HEIGHT, serde.pack(self._marker))
+            fail.fail_point("Index.BeforeBatchWrite")
             batch.write()
+            fail.fail_point("Index.AfterBatchWrite")
+            fail.fail_point("Index.BeforeGenerationBump")
             self._index_generation += 1
+            if height > self._indexed_height:
+                self._indexed_height = height
+
+    def advance_marker(self, height: int) -> None:
+        """Move the durable ingest marker forward without writing rows
+        (recovery bookkeeping for tx-less heights)."""
+        with self._lock:
+            if height > self._marker:
+                self._marker = height
+                self._db.set(self._META_HEIGHT, serde.pack(height))
             if height > self._indexed_height:
                 self._indexed_height = height
 
@@ -218,6 +285,57 @@ class KVTxIndexer(TxIndexer):
         out = [r for r in results if r is not None]
         out.sort(key=lambda r: (r.height, r.index))
         return out
+
+
+def recover_index(indexer: TxIndexer, block_store, state_db,
+                  logger=None) -> int:
+    """Boot-time index convergence: re-ingest every committed block
+    above the indexer's durable marker from the stored blocks + ABCI
+    responses (both durable before the indexer ever sees a tx).
+
+    This closes the two crash windows the event-driven IndexerService
+    cannot: (a) a block whose ingest batch was lost or torn mid-append
+    (the FileDB reload drops the torn tail, and the marker — written
+    last in the batch — vanished with it), and (b) blocks committed or
+    handshake-replayed while the service wasn't subscribed. Re-indexing
+    is idempotent (row keys are pure functions of tx/height/index), so
+    overlapping with a live ingest of the same block is harmless.
+    Returns the number of blocks re-indexed."""
+    if not isinstance(indexer, KVTxIndexer):
+        return 0
+    from .store import load_abci_responses
+
+    target = block_store.height()
+    n_blocks = 0
+    h = max(indexer.indexed_height() + 1, block_store.base())
+    while h <= target:
+        block = block_store.load_block(h)
+        if block is None:
+            break
+        if block.data.txs:
+            try:
+                responses = load_abci_responses(state_db, h)
+            except Exception:  # noqa: BLE001 - unreadable == not stored
+                responses = None
+            if (responses is None
+                    or len(responses.deliver_tx) < len(block.data.txs)):
+                # not applied yet (crash between block save and apply):
+                # the post-handshake re-apply will index it live
+                break
+            results = [
+                TxResult(height=h, index=i, tx=bytes(tx),
+                         result=responses.deliver_tx[i])
+                for i, tx in enumerate(block.data.txs)
+            ]
+            indexer.index_batch(h, results)
+            n_blocks += 1
+            if logger is not None:
+                logger.info("re-indexed block %d (%d txs) after restart",
+                            h, len(results))
+        else:
+            indexer.advance_marker(h)
+        h += 1
+    return n_blocks
 
 
 class IndexerService(BaseService):

@@ -41,7 +41,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..abci import types as abci
-from ..libs import tracing
+from ..libs import fail, tracing
 from ..lite.provider import MemProvider, Provider
 from ..lite.types import FullCommit
 from ..lite.verifier import BaseVerifier, DynamicVerifier, ErrLiteVerification
@@ -491,6 +491,11 @@ class StateSyncer:
                             "or was banned")
                     cond.wait(0.25)
                 data, sender = fetched[i]
+            # crash mid-restore: chunks 0..i-1 handed to the app, the
+            # rest never arrive — the app must hold its pre-restore
+            # state (payload installs only after the FINAL chunk
+            # validates) and a node restart falls back cleanly
+            fail.fail_point("Statesync.MidChunkApply")
             res = self.app.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(
                 index=i, chunk=data, sender=sender))
             if res.result == abci.APPLY_ACCEPT:

@@ -1,0 +1,570 @@
+"""Deterministic kill/restart recovery matrix.
+
+Drives a REAL single-validator node stack — FileDB-backed state/block/
+index/app stores, a file WAL, a file privval — entirely in one process,
+kills it at any named fail point (libs/fail.py KNOWN_POINTS) under any
+storage-fault mode (libs/storagechaos.py KILL_MODES), restarts it from
+whatever the "dead process" left on disk, and judges recovery with a
+strict oracle:
+
+  handshake_ok     boot handshake + WAL catchup replay completed
+  progressed       the chain commits NEW blocks past the crash height
+  no_double_sign   the recovered privval's last-sign state covers every
+                   signature the pre-crash process ever RELEASED (an
+                   fsync'd side ledger records each release; the
+                   recovered guard must be >= its max HRS)
+  index_converged  tx_search by height returns exactly each committed
+                   block's txs — no torn half-block, nothing missing
+  app_hash_ok      serially replaying ALL stored blocks through a fresh
+                   app (the "uncrashed peer") reproduces the recovered
+                   chain state's app hash — which also proves no block
+                   applied twice and that speculation left zero trace
+
+The in-process "kill" is honest about process death: the armed fail
+point freezes the storage injector (every later durable write raises
+SimulatedCrashError, like writes after os._exit), thread teardown is
+best-effort, and the injector then truncates each file back to its
+at-death durable size (Python buffered writers flush on close; a real
+crash would have lost those buffers, so the harness re-loses them)
+before applying the fault mode's image damage.
+
+Everything is a pure function of (crash point, nth, fault mode, plan
+seed): a failing case replays bit-for-bit.
+
+CLI: ``python -m tendermint_tpu.tools.crashmatrix [--fast | --point P
+--mode M] [--seed N]``; ``bench.py crashrecovery`` reports the
+kill -> recovered-and-committing latency as a standard BENCH line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shutil
+import time
+from typing import List, Optional
+
+from .. import config as cfg
+from .. import state as sm
+from ..libs import fail
+from ..libs.db import FileDB
+from ..libs.events import Query
+from ..libs.storagechaos import (
+    KILL_MODES,
+    FaultyDB,
+    SimulatedCrashError,
+    StorageFaultInjector,
+    StorageFaultPlan,
+    wrap_wal,
+)
+
+LOG = logging.getLogger("crashmatrix")
+
+# the matrix iterates every named fail point EXCEPT the statesync one
+# (a restore needs a producer peer; tests/test_crash_consistency.py
+# covers it with a targeted two-party harness instead)
+MATRIX_POINTS = tuple(p for p in fail.KNOWN_POINTS
+                      if not p.startswith("Statesync."))
+
+# fault modes composed with the crash points (storagechaos.KILL_MODES)
+MATRIX_MODES = tuple(KILL_MODES)
+
+# the tier-1 fast subset: one representative point per subsystem with a
+# clean kill, plus the two storage-fault modes that exercise the WAL
+# crash-tail distinction and the indexer's torn-batch recovery — ~≤30s
+# on a loaded 2-cpu box; everything else is the slow full matrix
+FAST_CASES = (
+    ("FinalizeCommit.AfterSave", "clean"),
+    ("ApplyBlock.AfterCommit", "clean"),
+    ("Index.BeforeBatchWrite", "clean"),
+    ("Privval.BeforeSignStateSave", "clean"),
+    ("FinalizeCommit.AfterWAL", "wal_torn"),
+    ("Index.AfterBatchWrite", "idx_torn"),
+)
+
+
+class _RecordingPV:
+    """Privval wrapper: delegates to a file-backed FilePV, refuses to
+    sign once the process is "dead", and appends every RELEASED
+    signature's (height, round, step) to an fsync'd side ledger — the
+    double-sign oracle's ground truth (a signature is dangerous only
+    once a caller could have broadcast it)."""
+
+    def __init__(self, inner, injector: StorageFaultInjector,
+                 ledger_path: str):
+        from ..privval.file_pv import vote_to_step
+
+        self._inner = inner
+        self._injector = injector
+        self._ledger_path = ledger_path
+        self._vote_to_step = vote_to_step
+
+    def get_pub_key(self):
+        return self._inner.get_pub_key()
+
+    def get_address(self):
+        return self._inner.get_address()
+
+    def _record(self, height: int, round_: int, step: int) -> None:
+        with open(self._ledger_path, "a") as f:
+            f.write(f"{height} {round_} {step}\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def sign_vote(self, chain_id, vote) -> None:
+        self._injector.check_alive()
+        self._inner.sign_vote(chain_id, vote)
+        self._record(vote.height, vote.round, self._vote_to_step(vote))
+
+    def sign_proposal(self, chain_id, proposal) -> None:
+        self._injector.check_alive()
+        self._inner.sign_proposal(chain_id, proposal)
+        self._record(proposal.height, proposal.round, 1)
+
+    def __str__(self):
+        return str(self._inner)
+
+
+def ledger_max(home: str):
+    """Highest (height, round, step) ever released, or None."""
+    path = os.path.join(home, "released.ledger")
+    if not os.path.exists(path):
+        return None
+    best = None
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) != 3:
+                continue  # torn ledger tail (harness crashed mid-append)
+            hrs = tuple(int(x) for x in parts)
+            if best is None or hrs > best:
+                best = hrs
+    return best
+
+
+class CrashNode:
+    """One bootable instance of the node stack rooted at `home`. Every
+    durable artifact lives under home/, so a second CrashNode over the
+    same home IS a restart of the same node."""
+
+    def __init__(self, home: str, app_kind: str = "persistent",
+                 plan: Optional[StorageFaultPlan] = None,
+                 exec_lanes: int = 0, speculative: bool = False):
+        self.home = home
+        self.app_kind = app_kind
+        self.exec_lanes = exec_lanes
+        self.speculative = speculative
+        self.injector = StorageFaultInjector(plan)
+        self.handshake_blocks = 0
+        self.reindexed_blocks = 0
+        self._dbs: List[FaultyDB] = []
+        self._started = False
+
+    # -- construction --------------------------------------------------
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.home, name)
+
+    def _open_db(self, name: str) -> FaultyDB:
+        db = FaultyDB(FileDB(self._path(name + ".db")), self.injector,
+                      "db:" + name)
+        self._dbs.append(db)
+        return db
+
+    def _make_app(self, db):
+        if self.app_kind == "sharded":
+            from ..abci.example.sharded_kvstore import (
+                ShardedKVStoreApplication)
+
+            return ShardedKVStoreApplication(db, epoch_blocks=4,
+                                             rotation_fraction=0.5,
+                                             phantom_pool=4, seed=11)
+        from ..abci.example.kvstore import PersistentKVStoreApplication
+
+        return PersistentKVStoreApplication(db)
+
+    def reference_app(self):
+        """A fresh app of the same kind over throwaway storage — the
+        'uncrashed peer' the app-hash oracle replays against."""
+        from ..libs.db import MemDB
+
+        return self._make_app(MemDB())
+
+    def boot(self) -> None:
+        """The node boot sequence (node/node.py's spine, minus p2p/rpc):
+        load state -> ABCI handshake -> index recovery -> WAL catchup ->
+        consensus. Raises on any recovery failure — that IS the first
+        oracle clause."""
+        from ..blockchain.store import BlockStore
+        from ..consensus import ConsensusState
+        from ..consensus.replay import Handshaker
+        from ..consensus.wal import WAL
+        from ..evidence import EvidencePool, EvidenceStore
+        from ..mempool import Mempool
+        from ..privval import FilePV
+        from ..privval.file_pv import load_or_gen_file_pv
+        from ..proxy import AppConns, local_client_creator
+        from ..state.txindex import (IndexerService, KVTxIndexer,
+                                     recover_index)
+        from ..types import GenesisDoc
+        from ..types.event_bus import (EVENT_NEW_BLOCK, EventBus,
+                                       query_for_event)
+
+        os.makedirs(self.home, exist_ok=True)
+        self.state_db = self._open_db("state")
+        self.block_store_db = self._open_db("blockstore")
+        self.tx_index_db = self._open_db("tx_index")
+        self.app_db = self._open_db("app")
+        self.evidence_db = self._open_db("evidence")
+
+        doc = GenesisDoc.load(self._path("genesis.json"))
+        inner_pv = load_or_gen_file_pv(self._path("priv_validator.json"))
+        self.pv = inner_pv
+        pv = _RecordingPV(inner_pv, self.injector,
+                          self._path("released.ledger"))
+
+        self.block_store = BlockStore(self.block_store_db)
+        state = sm.load_state_from_db_or_genesis(self.state_db, doc)
+
+        self.app = self._make_app(self.app_db)
+        self.conns = AppConns(local_client_creator(self.app))
+        self.conns.start()
+
+        self.bus = EventBus()
+        handshaker = Handshaker(self.state_db, state, self.block_store,
+                                doc, self.bus)
+        handshaker.handshake(self.conns)
+        self.handshake_blocks = handshaker.n_blocks
+        state = sm.load_state_from_db_or_genesis(self.state_db, doc)
+
+        self.tx_indexer = KVTxIndexer(self.tx_index_db)
+        self.reindexed_blocks = recover_index(
+            self.tx_indexer, self.block_store, self.state_db, logger=LOG)
+
+        self.bus.start()
+        self.indexer_service = IndexerService(self.tx_indexer, self.bus)
+        self.indexer_service.start()
+
+        self.mempool = Mempool(cfg.MempoolConfig(), self.conns.mempool,
+                               height=state.last_block_height)
+        self.evpool = EvidencePool(EvidenceStore(self.evidence_db), state)
+
+        exec_cfg = None
+        if self.exec_lanes > 0:
+            exec_cfg = cfg.ExecutionConfig(parallel_lanes=self.exec_lanes,
+                                           speculative=self.speculative)
+        self.block_exec = sm.BlockExecutor(
+            self.state_db, self.conns.consensus, mempool=self.mempool,
+            evidence_pool=self.evpool, event_bus=self.bus,
+            exec_config=exec_cfg)
+
+        wal = WAL(self._path("cs.wal"))
+        wrap_wal(wal, self.injector)
+        conf = cfg.test_config().consensus
+        conf.create_empty_blocks_interval = 0.05
+        self.cs = ConsensusState(
+            conf, state, self.block_exec, self.block_store,
+            mempool=self.mempool, evpool=self.evpool, event_bus=self.bus,
+            priv_validator=pv, wal=wal)
+        self.sub = self.bus.subscribe(
+            "crash-harness", query_for_event(EVENT_NEW_BLOCK), 256)
+        self.cs.start()
+        self._started = True
+
+    # -- driving -------------------------------------------------------
+
+    def height(self) -> int:
+        return self.block_store.height()
+
+    def feed_and_wait(self, min_height: int, timeout: float = 30.0,
+                      crash_event=None) -> bool:
+        """Feed txs (one per observed block) until the store reaches
+        `min_height`; returns False on timeout. Stops early (returning
+        True) when `crash_event` fires — the kill landed."""
+        deadline = time.time() + timeout
+        seq = self.height() * 100
+        while time.time() < deadline:
+            if crash_event is not None and crash_event.is_set():
+                return True
+            if self.height() >= min_height:
+                return True
+            try:
+                self.mempool.check_tx(
+                    b"k%d=%d" % (seq, self.height()))
+            except BaseException:  # noqa: BLE001 - full/dup/dead: keep going
+                pass
+            seq += 1
+            self.sub.get(timeout=0.1)
+        return crash_event is not None and crash_event.is_set()
+
+    def kill_at(self, point: str, nth: int, mode: str):
+        """Arm an in-process crash: at the nth hit of `point`, apply
+        `mode`'s storage fault to the durable image, freeze all wrapped
+        storage, and unwind the firing thread. Returns the Event that
+        fires at death."""
+        import threading
+
+        crashed = threading.Event()
+
+        def _action(name: str):
+            self.injector.kill(mode)
+            crashed.set()
+            raise SimulatedCrashError(f"killed at {name} (mode={mode})")
+
+        fail.arm_crash(point, nth=nth, action=_action)
+        return crashed
+
+    # -- teardown ------------------------------------------------------
+
+    def teardown(self, post_mortem: bool = True) -> None:
+        """Stop every thread best-effort (a dead node's storage raises;
+        that must not wedge the harness), close handles, then restore
+        the on-disk image to exactly what the dead process left."""
+        fail.disarm_crash()
+        for stopper in (
+            lambda: self.cs.stop() if self._started else None,
+            lambda: self.indexer_service.stop(),
+            lambda: self.bus.stop(),
+            lambda: self.mempool.stop(),
+            lambda: self.conns.stop(),
+            lambda: self.block_exec.stop(),
+        ):
+            try:
+                stopper()
+            except BaseException:  # noqa: BLE001 - dead storage raises
+                pass
+        try:
+            self.cs.wal.group.close()
+        except BaseException:  # noqa: BLE001
+            pass
+        for db in self._dbs:
+            try:
+                db.close()
+            except BaseException:  # noqa: BLE001
+                pass
+        if post_mortem and self.injector.dead:
+            self.injector.apply_post_mortem()
+
+    # -- oracle --------------------------------------------------------
+
+    def wait_index_converged(self, timeout: float = 10.0) -> bool:
+        """Until every committed block's txs are searchable by height
+        (exactly — no extras, none missing)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self._index_converged_once():
+                return True
+            time.sleep(0.2)
+        return False
+
+    def _index_converged_once(self) -> bool:
+        top = self.height()
+        for h in range(1, top + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                return False
+            expected = {bytes(tx) for tx in block.data.txs}
+            got = {bytes(r.tx)
+                   for r in self.tx_indexer.search(Query(f"tx.height = {h}"))}
+            if got != expected:
+                return False
+        return True
+
+    def replay_app_hash_ok(self) -> bool:
+        """The 'uncrashed peer' oracle: serially replay every stored
+        block through a fresh app; its final hash must equal the
+        recovered chain state's app hash. Catches double-applies,
+        speculation residue, and half-applied blocks in one check."""
+        from ..abci import types as abci
+        from ..consensus.replay import _exec_block_on_app
+        from ..crypto import pubkey_to_bytes
+        from ..types import GenesisDoc
+
+        state = sm.load_state(self.state_db)
+        if state is None:
+            return False
+        target = state.last_block_height
+        doc = GenesisDoc.load(self._path("genesis.json"))
+        app = self.reference_app()
+        app.init_chain(abci.RequestInitChain(
+            time=doc.genesis_time, chain_id=doc.chain_id,
+            validators=[abci.ValidatorUpdate(
+                pub_key=pubkey_to_bytes(v.pub_key), power=v.power)
+                for v in doc.validators],
+            app_state_bytes=b""))
+        app_hash = b""
+        for h in range(1, target + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                return False
+            app_hash = _exec_block_on_app(app, block, self.state_db)
+        return target == 0 or app_hash == state.app_hash
+
+
+def init_home(home: str, chain_id: str = "crash-matrix") -> None:
+    """Create genesis + privval for a fresh matrix home."""
+    from ..privval.file_pv import load_or_gen_file_pv
+    from ..types import GenesisDoc, GenesisValidator
+
+    os.makedirs(home, exist_ok=True)
+    pv = load_or_gen_file_pv(os.path.join(home, "priv_validator.json"))
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=time.time_ns() - 10**9,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)],
+    )
+    doc.save(os.path.join(home, "genesis.json"))
+
+
+def run_case(home: str, point: str, mode: str = "clean", nth: int = 2,
+             seed: int = 0, app_kind: str = "",
+             exec_lanes: int = -1, speculative: Optional[bool] = None,
+             warm_height: int = 2, timeout: float = 45.0) -> dict:
+    """One matrix cell: warm a fresh node, kill it at `point` (nth hit)
+    under `mode`, restart from disk, run the full recovery oracle.
+    Returns a result dict with ok + per-clause booleans and timings.
+    app_kind/exec_lanes/speculative default to whatever the crash point
+    needs to fire (the speculation point requires the sharded app with
+    lanes + speculation on; everything else runs the persistent app
+    serially)."""
+    needs_spec = point == "Exec.AfterSpeculationAdopt"
+    if not app_kind:
+        app_kind = "sharded" if needs_spec else "persistent"
+    if exec_lanes < 0:
+        exec_lanes = 4 if needs_spec else 0
+    if speculative is None:
+        speculative = needs_spec
+    if os.path.exists(home):
+        shutil.rmtree(home)
+    init_home(home)
+    plan = StorageFaultPlan(seed=seed)
+    res = {"point": point, "mode": mode, "nth": nth, "seed": seed,
+           "app": app_kind}
+
+    # special case: Mempool.MidAdmitChunk fires on the caller's thread
+    # during a >ADMIT_CHUNK batched admission, not on the commit path
+    driver_fires_point = point == "Mempool.MidAdmitChunk"
+
+    node = CrashNode(home, app_kind=app_kind, plan=plan,
+                     exec_lanes=exec_lanes, speculative=speculative)
+    crash_height = 0
+    try:
+        node.boot()
+        if not node.feed_and_wait(warm_height, timeout=timeout):
+            res.update(ok=False, error="warmup never reached "
+                       f"height {warm_height}")
+            return res
+        crashed = node.kill_at(point, nth=nth, mode=mode)
+        if driver_fires_point:
+            try:
+                node.mempool._admit_preverified_batch(
+                    [(b"madmit%d=%d" % (i, i), None) for i in range(96)])
+            except BaseException:  # noqa: BLE001 - the kill unwinds here
+                pass
+        else:
+            node.feed_and_wait(10**9, timeout=timeout, crash_event=crashed)
+        if not crashed.is_set():
+            res.update(ok=False, error=f"fail point {point} never fired")
+            return res
+        crash_height = node.height()
+    finally:
+        node.teardown()
+
+    # --- restart from whatever the dead process left ------------------
+    t0 = time.perf_counter()
+    node2 = CrashNode(home, app_kind=app_kind,
+                      exec_lanes=exec_lanes, speculative=speculative)
+    try:
+        try:
+            node2.boot()
+        except BaseException as e:  # noqa: BLE001 - oracle clause 1
+            res.update(ok=False, handshake_ok=False,
+                       error=f"recovery boot failed: {e}")
+            return res
+        recover_s = time.perf_counter() - t0
+        res["handshake_ok"] = True
+        res["replayed_blocks"] = node2.handshake_blocks
+        res["reindexed_blocks"] = node2.reindexed_blocks
+
+        # no-double-sign: the recovered guard covers every release
+        released = ledger_max(home)
+        last = (node2.pv.last_height, node2.pv.last_round,
+                node2.pv.last_step)
+        res["no_double_sign"] = released is None or last >= released
+
+        if node2.feed_and_wait(crash_height + 1, timeout=timeout):
+            # restart-begin -> first NEW committed block: the
+            # recovered-and-committing latency bench.py crashrecovery
+            # publishes (oracle-gated by this case's ok)
+            res["recommit_s"] = round(time.perf_counter() - t0, 3)
+        progressed = node2.feed_and_wait(crash_height + 2, timeout=timeout)
+        res["progressed"] = progressed
+        res["recover_s"] = round(recover_s, 3)
+        res["crash_height"] = crash_height
+        res["index_converged"] = node2.wait_index_converged(
+            timeout=timeout / 2)
+    finally:
+        node2.teardown(post_mortem=False)
+    # offline clauses (storage is quiescent now)
+    res["app_hash_ok"] = node2.replay_app_hash_ok()
+    res["ok"] = bool(res.get("handshake_ok") and res.get("progressed")
+                     and res.get("no_double_sign")
+                     and res.get("index_converged")
+                     and res.get("app_hash_ok"))
+    return res
+
+
+def run_matrix(root: str, cases, seed: int = 0, **kw) -> List[dict]:
+    return [run_case(os.path.join(root, f"case{i}"), point, mode=mode,
+                     seed=seed, **kw)
+            for i, (point, mode) in enumerate(cases)]
+
+
+def full_cases():
+    """The full grid: every matrix point with a clean kill, plus every
+    storage-fault mode at the three points whose durable write the mode
+    actually races (WAL modes around the WAL write, db modes around the
+    save/ingest writes)."""
+    cases = [(p, "clean") for p in MATRIX_POINTS]
+    for mode in ("wal_torn", "wal_bitflip", "wal_lost_tail"):
+        cases += [("FinalizeCommit.AfterWAL", mode),
+                  ("FinalizeCommit.AfterSave", mode),
+                  ("ApplyBlock.AfterCommit", mode)]
+    for mode, point in (("idx_torn", "Index.AfterBatchWrite"),
+                        ("idx_torn", "Index.BeforeGenerationBump"),
+                        ("state_torn", "ApplyBlock.AfterSaveState"),
+                        ("state_torn", "ApplyBlock.AfterCommit"),
+                        ("block_torn", "FinalizeCommit.AfterSave")):
+        cases.append((point, mode))
+    return cases
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="crashmatrix", description="kill/restart recovery matrix")
+    p.add_argument("--root", default="/tmp/tm_crashmatrix")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fast", action="store_true",
+                   help="the tier-1 fast subset only")
+    p.add_argument("--point", default="",
+                   help="run one crash point (with --mode)")
+    p.add_argument("--mode", default="clean", choices=list(KILL_MODES))
+    args = p.parse_args(argv)
+    if args.point:
+        cases = [(args.point, args.mode)]
+    elif args.fast:
+        cases = list(FAST_CASES)
+    else:
+        cases = full_cases()
+    rc = 0
+    for res in run_matrix(args.root, cases, seed=args.seed):
+        print(json.dumps(res, default=str))
+        if not res.get("ok"):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -176,6 +176,15 @@ class NodeStatus:
     # INTERVAL judgment; () means no baseline yet (first poll never
     # flags, and lifetime counters never mask current behavior)
     _cache_prev: tuple = ()
+    # crash-recovery view (from /debug/recovery): what the node's last
+    # boot repaired, and the LIVE WAL corruption count — a disk eating
+    # records degrades health even while the node keeps committing
+    replayed_blocks: int = 0
+    replay_from: int = 0
+    replay_to: int = 0
+    reindexed_blocks: int = 0
+    recovery_time_s: float = 0.0
+    wal_corrupted: int = 0
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
@@ -219,6 +228,19 @@ class NodeStatus:
     @property
     def restoring(self) -> bool:
         return self.restore_phase in self._RESTORE_ACTIVE
+
+    @property
+    def recovered(self) -> bool:
+        """The node's last boot replayed or re-indexed blocks — it came
+        back from a crash (informational tag, not a health downgrade)."""
+        return self.replayed_blocks > 0 or self.reindexed_blocks > 0
+
+    @property
+    def wal_corrupting(self) -> bool:
+        """The WAL has dropped corrupt records (bad CRC / garbage
+        header): the disk is eating data — degraded even though replay
+        tolerated it."""
+        return self.wal_corrupted > 0
 
     @property
     def abci_degraded(self) -> bool:
@@ -329,6 +351,12 @@ class NodeStatus:
         self.rpc_cache_evictions = 0
         self.cache_thrash = False
         self._cache_prev = ()
+        self.replayed_blocks = 0
+        self.replay_from = 0
+        self.replay_to = 0
+        self.reindexed_blocks = 0
+        self.recovery_time_s = 0.0
+        self.wal_corrupted = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -526,6 +554,23 @@ class Monitor:
             ns.ingest_capacity = 0
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/recovery", timeout=2.0) as r:
+                rec = json.load(r)
+            ns.replayed_blocks = int(rec.get("replayed_blocks", 0))
+            ns.replay_from = int(rec.get("replay_from", 0))
+            ns.replay_to = int(rec.get("replay_to", 0))
+            ns.reindexed_blocks = int(rec.get("reindexed_blocks", 0))
+            ns.recovery_time_s = float(rec.get("recovery_time_s", 0.0))
+            ns.wal_corrupted = int(rec.get("wal_corrupted_records", 0))
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.replayed_blocks = 0
+            ns.replay_from = 0
+            ns.replay_to = 0
+            ns.reindexed_blocks = 0
+            ns.recovery_time_s = 0.0
+            ns.wal_corrupted = 0
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/rpc", timeout=2.0) as r:
                 rp = json.load(r)
             ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
@@ -587,6 +632,9 @@ class Monitor:
                 # read path is silently back to full-price serving
                 and not any(n.ws_backed_up for n in online)
                 and not any(n.cache_thrash for n in online)
+                # a disk eating WAL records is degraded even while the
+                # node keeps committing (replay silently loses data)
+                and not any(n.wal_corrupting for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -662,6 +710,14 @@ class Monitor:
                     "rpc_cache_hit_rate": n.rpc_cache_hit_rate,
                     "rpc_cache_bytes": n.rpc_cache_bytes,
                     "cache_thrash": n.cache_thrash,
+                    "replayed_blocks": n.replayed_blocks,
+                    "replay_from": n.replay_from,
+                    "replay_to": n.replay_to,
+                    "reindexed_blocks": n.reindexed_blocks,
+                    "recovery_time_s": n.recovery_time_s,
+                    "recovered": n.recovered,
+                    "wal_corrupted": n.wal_corrupted,
+                    "wal_corrupting": n.wal_corrupting,
                 }
                 for n in self.nodes.values()
             ],
@@ -702,6 +758,14 @@ def main(argv=None) -> int:
                              f" stalls={n['stalls_total']}")
                     if n["stalled"]:
                         line += " [STALLED]"
+                    if n["recovered"]:
+                        span = (f" h{n['replay_from']}..{n['replay_to']}"
+                                if n["replayed_blocks"] else "")
+                        line += (f" [REPLAYED{span}"
+                                 f" +{n['reindexed_blocks']}idx]")
+                    if n["wal_corrupting"]:
+                        line += (f" [WAL CORRUPT"
+                                 f" records={n['wal_corrupted']}]")
                     if n["partition_suspect"]:
                         line += (f" [PARTITIONED? peers={n['n_peers']}"
                                  f"/{n['n_validators']}vals]")

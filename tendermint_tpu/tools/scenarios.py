@@ -24,6 +24,12 @@ Catalog (run one with `python -m tendermint_tpu.tools.scenarios NAME
   churn_storm              rotation epochs + forced-disconnect storms
   rotation_epoch           clean network, aggressive validator rotation
   statesync_join_under_churn  fresh node statesyncs in mid-rotation
+  localnet_crash           MULTI-PROCESS: real node subprocesses over
+                           kernel sockets; SIGKILL one mid-commit,
+                           restart it, require rejoin + convergence
+                           (the crash-consistency engine's end-to-end
+                           oracle — see also tools/crashmatrix.py for
+                           the in-process crash-point x fault matrix)
 
 The fault timeline is a pure function of the seed (see p2p/netchaos.py);
 `bench.py chaosnet` reports partition_heal's recovery latency as a
@@ -625,6 +631,201 @@ def statesync_join_under_churn(seed: int = 6, tmp_root: str = "") -> dict:
         if b is not None:
             b.stop()
         a.stop()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+@_scenario
+def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
+                   kills: int = 1) -> dict:
+    """Multi-process crash suite (ROADMAP: "multi-process localnet
+    variant ... real kernel sockets"): N real node subprocesses, one
+    SIGKILL'd mid-commit (seeded victim + seeded in-commit delay),
+    restarted over the same home dir, `kills` times. Oracle: survivors
+    keep committing while the victim is down (>2/3 power remains), the
+    restarted node reports a recovery (/debug/recovery) and catches
+    back up, and every node agrees on the block hash at a common
+    height — the kernel's SIGKILL plus the node's own durable state IS
+    the storage-fault injection here; the in-process matrix
+    (tools/crashmatrix.py) covers the synthetic fault modes."""
+    import random as _random
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    rng = _random.Random(seed)
+    own_tmp = None
+    if not tmp_root:
+        own_tmp = tempfile.TemporaryDirectory(prefix="localnet_crash_")
+        tmp_root = own_tmp.name
+    out_dir = os.path.join(tmp_root, "net")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    env = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu",
+               JAX_PLATFORMS="cpu", TM_TPU_WARMUP="0")
+    ports = [(free_port(), free_port(), free_port()) for _ in range(n)]
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+         "--v", str(n), "--o", out_dir, "--chain-id", "crashnet",
+         "--starting-port", "1"],
+        check=True, env=env, capture_output=True)
+
+    from ..p2p import NodeKey
+
+    ids = []
+    for i in range(n):
+        home = os.path.join(out_dir, f"node{i}")
+        ids.append(NodeKey.load(
+            os.path.join(home, "config", "node_key.json")).id)
+    peers = ",".join(f"{ids[i]}@127.0.0.1:{ports[i][1]}"
+                     for i in range(n))
+    for i in range(n):
+        home = os.path.join(out_dir, f"node{i}")
+        c = cfg.Config.load(os.path.join(home, "config", "config.toml"))
+        c.set_root(home)
+        c.base.db_backend = "filedb"
+        c.consensus = cfg.test_config().consensus
+        c.consensus.timeout_commit = 0.3
+        c.consensus.skip_timeout_commit = False
+        c.consensus.wal_path = "data/cs.wal/wal"
+        c.rpc.laddr = f"tcp://127.0.0.1:{ports[i][0]}"
+        c.p2p.laddr = f"tcp://127.0.0.1:{ports[i][1]}"
+        c.base.prof_laddr = f"tcp://127.0.0.1:{ports[i][2]}"
+        c.p2p.persistent_peers = peers
+        c.save(os.path.join(home, "config", "config.toml"))
+
+    def start_node(i: int):
+        home = os.path.join(out_dir, f"node{i}")
+        log = open(os.path.join(home, "node.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd.main",
+             "--home", home, "node",
+             "--proxy_app", f"persistent_kvstore:{home}/app.db"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        return proc
+
+    from ..rpc.client import HTTPClient
+
+    def height_of(i: int) -> int:
+        try:
+            st = HTTPClient(f"127.0.0.1:{ports[i][0]}",
+                            timeout=2.0).status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:  # noqa: BLE001 - down/booting
+            return -1
+
+    def wait_height(i: int, h: int, timeout: float) -> int:
+        deadline = time.time() + timeout
+        last = -1
+        while time.time() < deadline:
+            last = height_of(i)
+            if last >= h:
+                return last
+            time.sleep(0.25)
+        return last
+
+    def block_hash(i: int, h: int):
+        try:
+            b = HTTPClient(f"127.0.0.1:{ports[i][0]}",
+                           timeout=2.0).block(h)
+            return b["block_meta"]["block_id"]["hash"]
+        except Exception:  # noqa: BLE001
+            return None
+
+    procs = []
+    result = {"scenario": "localnet_crash", "seed": seed, "kills": kills}
+    try:
+        for i in range(n):
+            procs.append(start_node(i))
+        for i in range(n):
+            if wait_height(i, 3, WARM_TIMEOUT) < 3:
+                result.update(converged=False, ok=False,
+                              error=f"node{i} never warmed")
+                return result
+
+        recoveries = []
+        for round_ in range(max(1, kills)):
+            victim = rng.randrange(n)
+            # kill mid-commit: wait for the victim's NEXT height bump,
+            # then SIGKILL after a seeded in-window delay — the kill
+            # lands somewhere inside the following commit pipeline
+            h0 = height_of(victim)
+            wait_height(victim, h0 + 1, CONVERGE_TIMEOUT)
+            time.sleep(rng.uniform(0.0, 0.3))
+            t_kill = time.time()
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait(timeout=10)
+
+            # survivors keep committing (>2/3 of power remains)
+            ref = (victim + 1) % n
+            h_ref = height_of(ref)
+            if wait_height(ref, h_ref + 2, CONVERGE_TIMEOUT) < h_ref + 2:
+                result.update(converged=False, ok=False,
+                              error=f"survivors stalled after killing "
+                                    f"node{victim}")
+                return result
+
+            # restart over the same home: must recover + catch up
+            procs[victim] = start_node(victim)
+            target = height_of(ref) + 1
+            h_v = wait_height(victim, target, CONVERGE_TIMEOUT)
+            recovery_s = time.time() - t_kill
+            if h_v < target:
+                result.update(converged=False, ok=False,
+                              error=f"node{victim} stuck at {h_v} "
+                                    f"< {target} after restart")
+                return result
+            rec = {}
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports[victim][2]}"
+                        f"/debug/recovery", timeout=2.0) as r:
+                    rec = json.load(r)
+            except Exception:  # noqa: BLE001 - prof server still booting
+                pass
+            recoveries.append({
+                "victim": victim,
+                "recovery_s": round(recovery_s, 3),
+                "handshake_outcome": rec.get("handshake_outcome", ""),
+                "replayed_blocks": rec.get("replayed_blocks", -1),
+                "reindexed_blocks": rec.get("reindexed_blocks", -1),
+            })
+
+        # convergence + safety: all nodes carry the SAME block hash at
+        # a common height (the watchdog-independent safety oracle; with
+        # RPC answering everywhere and heights level, no stall remains)
+        h_common = min(h for h in (height_of(i) for i in range(n))) - 1
+        hashes = {block_hash(i, h_common) for i in range(n)}
+        safety_ok = len(hashes) == 1 and None not in hashes
+        heights = [height_of(i) for i in range(n)]
+        result.update(
+            converged=True, safety_ok=safety_ok, classified_ok=True,
+            heights=heights, common_height=h_common,
+            recovery_s=max(r["recovery_s"] for r in recoveries),
+            recoveries=recoveries,
+            ok=bool(safety_ok
+                    and all(r["handshake_outcome"] in ("ok", "")
+                            for r in recoveries)))
+        return result
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
         if own_tmp is not None:
             own_tmp.cleanup()
 
