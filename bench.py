@@ -63,13 +63,15 @@ WARMSTART_MODE = "warmstart" in sys.argv[1:]  # compile-once readiness (PR 8)
 MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
 CHAOSNET_MODE = "chaosnet" in sys.argv[1:]  # partition-heal recovery (PR 10)
 CRASHREC_MODE = "crashrecovery" in sys.argv[1:]  # kill->committing (PR 14)
+DETCHECK_MODE = "detcheck" in sys.argv[1:]  # replay-divergence oracle (PR 15)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
-                      "crashrecovery", "--pipeline", "--parallel")]
+                      "crashrecovery", "detcheck", "--pipeline",
+                      "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -134,6 +136,8 @@ CHAOSNET_METRIC = (
 CRASHREC_ROUNDS = _env_int("TM_TPU_BENCH_CRASHREC_ROUNDS", 3)
 CRASHREC_METRIC = (
     f"crash_recovery_kill_to_committing_{CRASHREC_ROUNDS}rounds_ms")
+DETCHECK_BLOCKS = _env_int("TM_TPU_BENCH_DETCHECK_BLOCKS", 10)
+DETCHECK_METRIC = f"detcheck_oracle_{DETCHECK_BLOCKS}blocks_wall_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1721,11 +1725,47 @@ def crashrecovery_main():
     return 0 if oracle_ok else 1
 
 
+def detcheck_main():
+    """`bench.py detcheck` — the replay-divergence oracle as a gated
+    BENCH line: the churn+sharded workload executed under serial,
+    parallel(2), parallel(4), speculative, and two cross-PYTHONHASHSEED
+    subprocess engines, every consensus-visible surface (app hashes,
+    DeliverTx results, event stream, tx-index rows, durable FileDB
+    image) diffed byte-for-byte. Any divergence gates the metric to -1:
+    a wall time is only worth publishing for a matrix that agrees.
+    Pure host path: no TPU."""
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu.tools import detcheck
+
+    t0 = time.perf_counter()
+    rep = detcheck.run_oracle(n_blocks=DETCHECK_BLOCKS)
+    wall_ms = (time.perf_counter() - t0) * 1000
+    ok = not rep["divergences"]
+    print(json.dumps({
+        "metric": DETCHECK_METRIC,
+        "value": round(wall_ms, 1) if ok else -1,
+        "unit": "ms",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "engines": rep["engines"],
+        "divergences": rep["divergences"],
+        "app_hash": rep["app_hash"][:16],
+        "note": ("serial==parallel(2,4)==speculative==cross-hashseed "
+                 "subprocesses on app_hashes/results/events/index/image"
+                 if ok else "DIVERGENT — see divergences"),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
         # pure host path: never touch (or wait for) the TPU backend
         return commit4_main()
+    if DETCHECK_MODE:
+        # in-process + subprocess oracle: pure host path, no TPU probe
+        return detcheck_main()
     if CHAOS_MODE:
         return chaos_main()
     if CHAOSNET_MODE:
@@ -1931,6 +1971,8 @@ if __name__ == "__main__":
             metric = WARM_METRIC
         elif CRASHREC_MODE:
             metric = CRASHREC_METRIC
+        elif DETCHECK_MODE:
+            metric = DETCHECK_METRIC
         else:
             mode = "_rlc" if RLC_MODE else ""
             metric = f"verify_commit_{METRIC_N}_sigs{mode}_wall_ms"

@@ -1,0 +1,78 @@
+"""allowlist_util — the shared suppression-list discipline for the
+static gates (check_concurrency, check_determinism).
+
+Both checkers suppress findings ONLY through a JSON allowlist whose
+every entry carries a non-empty justification (an entry is a reviewed
+design decision, not a mute button), and both surface stale entries
+(no matching finding) so the lists cannot rot. That loading/matching
+logic lives here once so the two gates cannot drift on the rules.
+
+Allowlist format::
+
+    {"entries": [{"key": "<finding key>", "justification": "why"}]}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Set, Tuple
+
+
+def load_allowlist(path: str) -> Dict[str, str]:
+    """{key: justification}; raises ValueError on entries with a
+    missing/empty justification — suppression must be explained.
+    An empty/missing path means no suppressions."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    entries = doc.get("entries", [])
+    out: Dict[str, str] = {}
+    for i, e in enumerate(entries):
+        key = e.get("key", "")
+        just = (e.get("justification") or "").strip()
+        if not key:
+            raise ValueError(f"allowlist entry {i} has no key")
+        if not just:
+            raise ValueError(
+                f"allowlist entry {key!r} has no justification — "
+                f"every suppression must say why")
+        out[key] = just
+    return out
+
+
+def apply_allowlist(findings, allowlist: Dict[str, str]) -> List[str]:
+    """Mark each finding whose .key is allowlisted (sets .suppressed_by
+    to the justification) and return the STALE allowlist keys — entries
+    that matched nothing and should be pruned."""
+    matched: Set[str] = set()
+    for f in findings:
+        if f.key in allowlist:
+            f.suppressed_by = allowlist[f.key]
+            matched.add(f.key)
+    return sorted(set(allowlist) - matched)
+
+
+def summarize(findings, files: int, extra: dict = None) -> dict:
+    """The common summary block both checkers report/test against."""
+    out = {
+        "files": files,
+        "findings": len(findings),
+        "suppressed": sum(1 for f in findings if f.suppressed_by),
+        "unsuppressed": sum(1 for f in findings if not f.suppressed_by),
+    }
+    if extra:
+        out.update(extra)
+    return out
+
+
+def counts_by_class(findings) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """({rule: total}, {rule: unsuppressed}) — the detlint metric view."""
+    total: Dict[str, int] = {}
+    unsup: Dict[str, int] = {}
+    for f in findings:
+        total[f.rule] = total.get(f.rule, 0) + 1
+        if not f.suppressed_by:
+            unsup[f.rule] = unsup.get(f.rule, 0) + 1
+    return total, unsup

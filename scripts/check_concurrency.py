@@ -702,28 +702,13 @@ def check_torn(all_classes: List[ClassFacts],
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "concurrency_allowlist.json")
 
+# the justification/stale-entry discipline is shared with
+# check_determinism (scripts/allowlist_util.py) so the gates can't
+# drift; load_allowlist stays exported under its historical name
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import allowlist_util  # noqa: E402
 
-def load_allowlist(path: str) -> Dict[str, str]:
-    """{key: justification}; raises ValueError on entries with a
-    missing/empty justification — suppression must be explained.
-    An empty/missing path means no suppressions."""
-    if not path or not os.path.exists(path):
-        return {}
-    with open(path, "r", encoding="utf-8") as fh:
-        doc = json.load(fh)
-    entries = doc.get("entries", [])
-    out: Dict[str, str] = {}
-    for i, e in enumerate(entries):
-        key = e.get("key", "")
-        just = (e.get("justification") or "").strip()
-        if not key:
-            raise ValueError(f"allowlist entry {i} has no key")
-        if not just:
-            raise ValueError(
-                f"allowlist entry {key!r} has no justification — "
-                f"every suppression must say why")
-        out[key] = just
-    return out
+load_allowlist = allowlist_util.load_allowlist
 
 
 def collect_files(paths: List[str], root: str) -> List[Tuple[str, str]]:
@@ -767,21 +752,11 @@ def run_check(paths: List[str], root: str,
     findings.extend(check_threads(all_classes, mod_funcs_by_file))
     findings.extend(check_torn(all_classes, mod_funcs_by_file))
 
-    matched: Set[str] = set()
-    for f in findings:
-        if f.key in allowlist:
-            f.suppressed_by = allowlist[f.key]
-            matched.add(f.key)
-    stale = sorted(set(allowlist) - matched)
-    summary = {
-        "files": len(files),
-        "classes": len(all_classes),
-        "findings": len(findings),
-        "suppressed": sum(1 for f in findings if f.suppressed_by),
-        "unsuppressed": sum(1 for f in findings if not f.suppressed_by),
-        "stale_allowlist": stale,
-        "parse_errors": errors,
-    }
+    stale = allowlist_util.apply_allowlist(findings, allowlist)
+    summary = allowlist_util.summarize(
+        findings, len(files),
+        {"classes": len(all_classes), "stale_allowlist": stale,
+         "parse_errors": errors})
     return findings, summary
 
 

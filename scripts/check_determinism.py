@@ -1,0 +1,746 @@
+#!/usr/bin/env python
+"""check_determinism — static nondeterminism analyzer for the
+consensus-critical call graph.
+
+Everything the replay/crash/parallel machinery leans on assumes that
+re-executing a block yields bit-identical state: one wall-clock read,
+unseeded RNG draw, or set-iteration order escaping into an app hash,
+event stream, stored row, or wire frame is a chain-splitting bug.
+This gate parses the consensus-critical modules (no imports, pure AST
+— the static half; tools/detcheck.py is the runtime twin) and enforces
+the determinism discipline rules (DT-1..DT-6, README "Correctness
+tooling"):
+
+  DT-CLOCK  wall-clock reads (time.time/time_ns, datetime.now/utcnow,
+            now_ns) whose value reaches hashed/serialized/stored state
+            or is returned into the consensus call graph
+  DT-RAND   unseeded entropy (module-level random.*, os.urandom,
+            secrets.*, uuid1/uuid4, SystemRandom, argless Random()) in
+            a deterministic path — seeded random.Random(seed)
+            instances are the sanctioned idiom
+  DT-ITER   set/frozenset iteration whose ORDER escapes into
+            accumulated, hashed, stored, or wire output (set order is
+            hash-randomized across processes), plus any builtin
+            hash() call — bytes/str hashing is PYTHONHASHSEED-seeded,
+            so hash-keyed partitioning diverges per process
+  DT-ENV    os.environ/getenv, platform.*, hostname/pid reads inside
+            state transitions
+  DT-FLOAT  float arithmetic feeding hashed/serialized/stored state,
+            or truncated via int() into consensus-affecting integers
+  DT-ID     id() / default object repr escaping into output (process-
+            address-dependent)
+
+Sanctioned escape hatches the analyzer recognizes: sorted(S) /
+V.sort() launder iteration-order taint; accumulating INTO a set stays
+order-free; random.Random(seed) is a seeded source.
+
+Findings are suppressed ONLY via scripts/determinism_allowlist.json
+(shared discipline with the concurrency gate — scripts/allowlist_util:
+every entry justified, stale entries surfaced). Wired into the test
+suite as a tier-1 gate (tests/test_check_determinism.py) and runnable
+standalone:
+
+    python scripts/check_determinism.py [--json] [paths...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import allowlist_util  # noqa: E402
+
+load_allowlist = allowlist_util.load_allowlist
+
+# the consensus-critical call graph: modules whose output is hashed,
+# serialized, stored, or gossiped. Directory scans restrict to these;
+# explicit file arguments (fixture corpora) are scanned wholesale.
+CRITICAL_SUFFIXES = (
+    "state/execution.py",
+    "state/parallel.py",
+    "state/state.py",
+    "state/store.py",
+    "state/txindex.py",
+    "state/validation.py",
+    "consensus/state.py",
+    "consensus/replay.py",
+    "types/basic.py",
+    "types/block.py",
+    "types/serde.py",
+    "types/part_set.py",
+    "types/evidence.py",
+    "types/event_bus.py",
+    "types/genesis.py",
+    "types/validator_set.py",
+    "types/vote_set.py",
+    "abci/example/kvstore.py",
+    "abci/example/counter.py",
+    "abci/example/sharded_kvstore.py",
+    "mempool/mempool.py",
+    "mempool/preverify.py",
+    "statesync/restore.py",
+    "statesync/chunker.py",
+)
+
+# wall-clock sources: attr name -> required receiver names (None entry
+# = bare-call form allowed, e.g. the repo's own now_ns())
+_CLOCK_CALLS = {
+    "time": ("time", "_time"),
+    "time_ns": ("time", "_time"),
+    "now": ("datetime", "date"),
+    "utcnow": ("datetime",),
+    "today": ("datetime", "date"),
+    "now_ns": None,
+}
+
+# unseeded-entropy sources (receiver-qualified module calls)
+_RAND_MODULES = ("random", "_random", "secrets")
+_RAND_EXEMPT_ATTRS = {"Random"}  # Random(seed) is the seeded idiom
+_RAND_DIRECT = {"urandom": ("os",), "uuid1": ("uuid",),
+                "uuid4": ("uuid",), "SystemRandom": (None,)}
+
+_ENV_ATTRS = {"environ", "getenv", "getpid", "gethostname", "getuser"}
+_ENV_RECEIVERS = ("os", "platform", "socket", "getpass")
+
+# sink shapes: where a nondeterministic value becomes consensus-visible
+_SERIALIZE_NAMES = {"pack", "packb", "to_bytes"}
+_HASH_NAMES = {"sha256", "sha512", "sha1", "blake2b", "md5",
+               "hash_from_byte_slices", "tx_hash", "simple_hash"}
+_HASHISH_RECV_RE = re.compile(r"(hash|dig|hasher|sha\d*|md)$")
+_DB_RECV_RE = re.compile(r"(db|batch|store|wal|backing)$", re.IGNORECASE)
+_DB_WRITE_ATTRS = {"set", "set_sync", "put"}
+_SEND_NAMES = {"send", "try_send", "broadcast", "sendall"}
+_CTOR_SINKS = {"TxResult", "KVPair", "ValidatorUpdate", "Vote",
+               "Proposal", "Snapshot", "Header", "Commit", "BlockID",
+               "make_block"}
+
+
+def _last_attr(expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _recv_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return _last_attr(fn.value)
+    return None
+
+
+class Finding:
+    def __init__(self, rule: str, key: str, path: str, line: int,
+                 message: str):
+        self.rule = rule
+        self.key = key
+        self.path = path
+        self.line = line
+        self.message = message
+        self.suppressed_by: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "key": self.key, "path": self.path,
+                "line": self.line, "message": self.message,
+                "suppressed": self.suppressed_by is not None}
+
+
+def _collect_imports(tree) -> Dict[str, Dict]:
+    """Per-file import aliasing so the usual idioms cannot bypass the
+    source tables: `import random as rnd` (module alias) and
+    `from time import time` / `from os import urandom` (bare names)."""
+    mod: Dict[str, str] = {}
+    frm: Dict[str, Tuple[str, str]] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                root = a.name.split(".")[0]
+                mod[a.asname or root] = root
+        elif isinstance(n, ast.ImportFrom):
+            m = (n.module or "").split(".")[-1]
+            for a in n.names:
+                frm[a.asname or a.name] = (m, a.name)
+    return {"mod": mod, "from": frm}
+
+
+def _source_of_call(call: ast.Call,
+                    imports: Optional[Dict] = None
+                    ) -> Optional[Tuple[str, str]]:
+    """(rule, label) when `call` is itself a nondeterminism source."""
+    attr = _last_attr(call.func)
+    if attr is None:
+        return None
+    recv = _recv_name(call)
+    if imports:
+        if recv is not None:
+            # import random as rnd → rnd.random() reads as random.*
+            recv = imports["mod"].get(recv, recv)
+        elif isinstance(call.func, ast.Name):
+            # from time import time → time() reads as time.time()
+            hit = imports["from"].get(call.func.id)
+            if hit is not None:
+                recv, attr = hit
+
+    if attr == "now_ns":  # the repo's own accessor, however imported
+        return "DT-CLOCK", "now_ns()"
+    want = _CLOCK_CALLS.get(attr)
+    if want is not None and recv in want:
+        return "DT-CLOCK", f"{recv}.{attr}()"
+
+    if recv in _RAND_MODULES and attr not in _RAND_EXEMPT_ATTRS:
+        return "DT-RAND", f"{recv}.{attr}()"
+    if attr in _RAND_DIRECT:
+        wanted = _RAND_DIRECT[attr]
+        if recv in wanted or (None in wanted):
+            return "DT-RAND", f"{recv or ''}.{attr}()".lstrip(".")
+    if attr == "Random" and not call.args and not call.keywords:
+        return "DT-RAND", "unseeded Random()"
+
+    if attr in _ENV_ATTRS and recv in _ENV_RECEIVERS:
+        return "DT-ENV", f"{recv}.{attr}"
+    if recv == "environ":  # os.environ.get(...) / .setdefault(...)
+        return "DT-ENV", f"os.environ.{attr}"
+    if recv == "platform":
+        return "DT-ENV", f"platform.{attr}()"
+
+    if isinstance(call.func, ast.Name):
+        if call.func.id == "id":
+            return "DT-ID", "id()"
+        if call.func.id == "hash":
+            return "DT-ITER", "builtin hash() (PYTHONHASHSEED-seeded)"
+    return None
+
+
+def _sink_label(call: ast.Call) -> Optional[str]:
+    """A short label when `call` is a consensus-visible output sink."""
+    attr = _last_attr(call.func)
+    if attr is None:
+        return None
+    recv = _recv_name(call)
+    if attr in _SERIALIZE_NAMES:
+        return f"serialize .{attr}()"
+    if attr in _HASH_NAMES:
+        return f"hash {attr}()"
+    if attr == "update" and recv and _HASHISH_RECV_RE.search(recv):
+        return f"hash {recv}.update()"
+    if attr in _DB_WRITE_ATTRS and recv and _DB_RECV_RE.search(recv):
+        return f"store {recv}.{attr}()"
+    if attr in _SEND_NAMES:
+        return f"wire .{attr}()"
+    if attr in _CTOR_SINKS and isinstance(call.func, (ast.Name,
+                                                      ast.Attribute)):
+        return f"{attr}(...)"
+    if attr.startswith("Response") and attr[8:9].isupper():
+        return f"{attr}(...)"
+    return None
+
+
+class _FuncDet(ast.NodeVisitor):
+    """Per-function walker: taint through locals (clock/rand/float/id),
+    set-typedness, iteration-order taint, sink detection."""
+
+    def __init__(self, owner: str, relpath: str, set_fields: Set[str],
+                 float_fields: Set[str], sink: List[Finding],
+                 imports: Optional[Dict] = None):
+        self.owner = owner
+        self.relpath = relpath
+        self.set_fields = set_fields
+        self.float_fields = float_fields
+        self.sink = sink
+        self.imports = imports
+        # name -> (rule, label): value-taint (clock/rand/float/id)
+        self.tainted: Dict[str, Tuple[str, str]] = {}
+        # names known to hold set/frozenset values (order-free to KEEP,
+        # dangerous to ITERATE)
+        self.setvars: Set[str] = set()
+        # name -> label: sequences whose ORDER came from set iteration
+        self.ordervars: Dict[str, str] = {}
+        self._emitted: Set[str] = set()
+        # stack of "iterating a set right now" labels
+        self._set_loop: List[str] = []
+
+    # -- emit ----------------------------------------------------------
+
+    def _emit(self, rule: str, detail: str, line: int, message: str):
+        key = f"{rule}:{self.relpath}:{self.owner}:{detail}"
+        if key in self._emitted:
+            return
+        self._emitted.add(key)
+        self.sink.append(Finding(rule, key, self.relpath, line, message))
+
+    # -- expression classification ------------------------------------
+
+    def _is_set_expr(self, expr) -> bool:
+        if isinstance(expr, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in self.setvars
+        if isinstance(expr, ast.Call):
+            name = _last_attr(expr.func)
+            # bare-name constructors only: `db.set(k, v)` is a store,
+            # not a set() construction
+            if name in ("set", "frozenset") \
+                    and isinstance(expr.func, ast.Name):
+                return True
+            # set-producing methods: union/intersection/difference of a
+            # set variable
+            if name in ("union", "intersection", "difference", "copy"):
+                recv = _recv_name(expr)
+                return recv in self.setvars
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr in self.set_fields
+        if isinstance(expr, ast.BinOp) \
+                and isinstance(expr.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+            return (self._is_set_expr(expr.left)
+                    or self._is_set_expr(expr.right))
+        if isinstance(expr, ast.BoolOp):
+            return any(self._is_set_expr(v) for v in expr.values)
+        if isinstance(expr, ast.IfExp):
+            return (self._is_set_expr(expr.body)
+                    or self._is_set_expr(expr.orelse))
+        return False
+
+    def _float_op(self, expr) -> bool:
+        """BinOp that is float arithmetic: true division, a float
+        constant operand, or an operand that is a known-float field."""
+        if not isinstance(expr, ast.BinOp):
+            return False
+        if isinstance(expr.op, ast.Div):
+            return True
+        for side in (expr.left, expr.right):
+            if isinstance(side, ast.Constant) and isinstance(side.value,
+                                                             float):
+                return True
+            if isinstance(side, ast.Attribute) \
+                    and isinstance(side.value, ast.Name) \
+                    and side.value.id == "self" \
+                    and side.attr in self.float_fields:
+                return True
+            if isinstance(side, ast.Name) \
+                    and self.tainted.get(side.id, ("",))[0] == "DT-FLOAT":
+                return True
+            if self._float_op(side):
+                return True
+        return False
+
+    def _taint_of(self, expr) -> Optional[Tuple[str, str]]:
+        """Value-taint of an expression: a source call, a tainted name,
+        float arithmetic, or propagation through calls/ops. sorted()
+        launders ITERATION-order taint only — never value taint."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                t = self.tainted.get(sub.id)
+                if t is not None:
+                    return t
+            elif isinstance(sub, ast.Call):
+                src = _source_of_call(sub, self.imports)
+                if src is not None and src[0] != "DT-ITER":
+                    # builtin hash() is flagged directly, not tainted
+                    return src
+        if self._float_op(expr):
+            return "DT-FLOAT", "float arithmetic"
+        return None
+
+    def _order_taint_of(self, expr) -> Optional[str]:
+        """Iteration-order taint of an expression: a sequence built by
+        iterating a set, unless laundered through sorted()."""
+        if isinstance(expr, ast.Name):
+            return self.ordervars.get(expr.id)
+        if isinstance(expr, ast.Call):
+            name = _last_attr(expr.func)
+            if name == "sorted":
+                return None  # laundered
+            if name in ("list", "tuple") and expr.args:
+                if self._is_set_expr(expr.args[0]):
+                    return f"{name}(<set>)"
+                return self._order_taint_of(expr.args[0])
+            if name == "join" and expr.args \
+                    and self._is_set_expr(expr.args[0]):
+                return "join(<set>)"
+            for a in expr.args:
+                t = self._order_taint_of(a)
+                if t is not None:
+                    return t
+        if isinstance(expr, (ast.ListComp, ast.GeneratorExp)):
+            for gen in expr.generators:
+                if self._is_set_expr(gen.iter):
+                    return "comprehension over set"
+                t = self._order_taint_of(gen.iter)
+                if t is not None:
+                    return t
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            return (self._order_taint_of(expr.left)
+                    or self._order_taint_of(expr.right))
+        return None
+
+    # -- visitors ------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign):
+        self.generic_visit(node)
+        taint = self._taint_of(node.value)
+        is_set = self._is_set_expr(node.value)
+        order = None if is_set else self._order_taint_of(node.value)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if taint is not None:
+                    # sticky across branches: the walker is flow-
+                    # insensitive, so an untainted reassignment in one
+                    # branch must not hide a tainted one in another
+                    self.tainted[tgt.id] = taint
+                if is_set:
+                    self.setvars.add(tgt.id)
+                    self.ordervars.pop(tgt.id, None)
+                elif order is not None:
+                    self.ordervars[tgt.id] = order
+                    self.setvars.discard(tgt.id)
+                else:
+                    self.setvars.discard(tgt.id)
+                    self.ordervars.pop(tgt.id, None)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.generic_visit(node)
+        if isinstance(node.target, ast.Name):
+            taint = self._taint_of(node.value)
+            if taint is not None:
+                self.tainted[node.target.id] = taint
+            order = self._order_taint_of(node.value)
+            if order is not None:
+                self.ordervars[node.target.id] = order
+
+    def visit_For(self, node: ast.For):
+        # the iterable expression itself can contain source calls
+        # (`for tx in random.sample(...)`) — run the normal call
+        # checks over it before entering the body
+        self.visit(node.iter)
+        entered = False
+        if self._is_set_expr(node.iter):
+            self._set_loop.append(
+                f"iterating {ast.unparse(node.iter)[:40]}"
+                if hasattr(ast, "unparse") else "iterating a set")
+            entered = True
+        else:
+            ot = self._order_taint_of(node.iter)
+            if ot is not None:
+                self._set_loop.append(ot)
+                entered = True
+        for stmt in node.body:
+            self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        if entered:
+            self._set_loop.pop()
+
+    def visit_Return(self, node: ast.Return):
+        self.generic_visit(node)
+        if node.value is None:
+            return
+        taint = self._taint_of(node.value)
+        if taint is not None and taint[0] in ("DT-CLOCK",):
+            self._emit(
+                taint[0], "return", node.lineno,
+                f"{self.owner} returns a value derived from {taint[1]} "
+                f"into the consensus call graph")
+        order = self._order_taint_of(node.value)
+        if order is not None:
+            self._emit(
+                "DT-ITER", "return", node.lineno,
+                f"{self.owner} returns a sequence whose order came from "
+                f"set iteration ({order}) — set order is hash-randomized "
+                f"across processes")
+
+    def visit_Yield(self, node: ast.Yield):
+        self.generic_visit(node)
+        if self._set_loop:
+            self._emit(
+                "DT-ITER", "yield", node.lineno,
+                f"{self.owner} yields while {self._set_loop[-1]} — the "
+                f"emitted order is hash-randomized across processes")
+
+    def visit_YieldFrom(self, node: ast.YieldFrom):
+        self.generic_visit(node)
+        if self._is_set_expr(node.value) \
+                or self._order_taint_of(node.value) is not None:
+            self._emit(
+                "DT-ITER", "yield-from", node.lineno,
+                f"{self.owner} yields from a set (or set-ordered "
+                f"sequence) — the emitted order is hash-randomized "
+                f"across processes")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        self.generic_visit(node)
+        # os.environ["X"] — the call-free env read
+        base = node.value
+        if _last_attr(base) == "environ":
+            self._emit(
+                "DT-ENV", "os.environ[]", node.lineno,
+                f"{self.owner} reads os.environ in a consensus-critical "
+                f"path")
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        attr = _last_attr(node.func)
+        recv = _recv_name(node)
+
+        # direct-flag sources that need no sink: entropy, env, id, hash
+        src = _source_of_call(node, self.imports)
+        if src is not None and src[0] in ("DT-RAND", "DT-ENV", "DT-ID",
+                                          "DT-ITER"):
+            self._emit(
+                src[0], src[1], node.lineno,
+                f"{self.owner} calls {src[1]} in a consensus-critical "
+                f"path")
+
+        # int() truncation of float arithmetic: the classic rounding
+        # chain-splitter (validator powers, batch sizes)
+        if isinstance(node.func, ast.Name) and node.func.id == "int" \
+                and node.args and self._float_op(node.args[0]):
+            self._emit(
+                "DT-FLOAT", "int-truncation", node.lineno,
+                f"{self.owner} truncates float arithmetic via int() — "
+                f"rounding must be integer-exact in consensus paths")
+
+        # .sort() launders order taint in place
+        if attr == "sort" and recv is not None:
+            self.ordervars.pop(recv, None)
+
+        # accumulating under a set-ordered loop: the accumulator's
+        # order is now hash-randomized (accumulating into a SET is fine)
+        if self._set_loop and attr in ("append", "extend", "appendleft",
+                                       "insert") and recv is not None:
+            if recv not in self.setvars:
+                self.ordervars[recv] = self._set_loop[-1]
+
+        label = _sink_label(node)
+        if label is None:
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            taint = self._taint_of(arg)
+            if taint is not None:
+                self._emit(
+                    taint[0], f"{taint[1]}->{label}", node.lineno,
+                    f"{self.owner} feeds a value derived from "
+                    f"{taint[1]} into {label}")
+            order = self._order_taint_of(arg)
+            if order is not None:
+                self._emit(
+                    "DT-ITER", f"order->{label}", node.lineno,
+                    f"{self.owner} feeds a set-iteration-ordered "
+                    f"sequence ({order}) into {label}")
+        if self._set_loop:
+            self._emit(
+                "DT-ITER", f"loop->{label}", node.lineno,
+                f"{self.owner} calls {label} while {self._set_loop[-1]} "
+                f"— per-iteration output lands in hash-randomized order")
+
+    # nested defs/lambdas: analyze separately via the class walker; do
+    # not leak this scope's taint into them
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        pass
+
+
+def _class_set_and_float_fields(cls: ast.ClassDef) -> Tuple[Set[str],
+                                                            Set[str]]:
+    """Fields assigned set()/frozenset() anywhere in the class, and
+    fields that are float-valued (assigned a float constant, or
+    assigned from an __init__ parameter whose default is a float)."""
+    set_fields: Set[str] = set()
+    float_fields: Set[str] = set()
+    float_params: Set[str] = set()
+    for sub in cls.body:
+        if isinstance(sub, ast.FunctionDef) and sub.name == "__init__":
+            args = sub.args
+            defaults = args.defaults
+            pos = args.args[len(args.args) - len(defaults):]
+            for a, d in zip(pos, defaults):
+                if isinstance(d, ast.Constant) and isinstance(d.value,
+                                                              float):
+                    float_params.add(a.arg)
+    for sub in ast.walk(cls):
+        if not isinstance(sub, ast.Assign):
+            continue
+        for tgt in sub.targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                v = sub.value
+                if isinstance(v, ast.Call) \
+                        and _last_attr(v.func) in ("set", "frozenset"):
+                    set_fields.add(tgt.attr)
+                elif isinstance(v, (ast.Set, ast.SetComp)):
+                    set_fields.add(tgt.attr)
+                elif isinstance(v, ast.Constant) \
+                        and isinstance(v.value, float):
+                    float_fields.add(tgt.attr)
+                elif isinstance(v, ast.Name) and v.id in float_params:
+                    float_fields.add(tgt.attr)
+    return set_fields, float_fields
+
+
+def analyze_file(path: str, relpath: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    findings: List[Finding] = []
+    imports = _collect_imports(tree)
+
+    def direct_inner_defs(fn):
+        """Function defs DIRECTLY inside fn — never descending into
+        them, so a def nested two levels down is analyzed exactly once
+        (by its own parent's recursion), not once per ancestor."""
+        out, stack = [], list(fn.body)
+        while stack:
+            n = stack.pop(0)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(n)
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def walk_func(fn, owner: str, set_fields=frozenset(),
+                  float_fields=frozenset()):
+        w = _FuncDet(owner, relpath, set(set_fields), set(float_fields),
+                     findings, imports)
+        for stmt in fn.body:
+            w.visit(stmt)
+        # nested functions get their own (taint-isolated) walk
+        for inner in direct_inner_defs(fn):
+            walk_func(inner, f"{owner}.{inner.name}",
+                      set_fields, float_fields)
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            set_fields, float_fields = _class_set_and_float_fields(node)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    walk_func(sub, f"{node.name}.{sub.name}",
+                              set_fields, float_fields)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walk_func(node, node.name)
+    return findings
+
+
+def collect_files(paths: List[str], root: str) -> List[Tuple[str, str]]:
+    """Explicit .py files are taken as-is (fixture corpora); directory
+    scans restrict to the consensus-critical module list."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.append((ap, os.path.relpath(ap, root)))
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    fp = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(fp, root)
+                    norm = rel.replace(os.sep, "/")
+                    # inside the production tree only the consensus-
+                    # critical modules are in scope; anything else
+                    # (fixture corpora) scans wholesale
+                    if "tendermint_tpu/" in norm + "/" or \
+                            norm.startswith("tendermint_tpu"):
+                        if any(norm.endswith(sfx)
+                               for sfx in CRITICAL_SUFFIXES):
+                            out.append((fp, rel))
+                    else:
+                        out.append((fp, rel))
+    return out
+
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "determinism_allowlist.json")
+
+
+def run_check(paths: List[str], root: str,
+              allowlist: Dict[str, str]) -> Tuple[List[Finding], dict]:
+    files = collect_files(paths, root)
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for path, rel in files:
+        try:
+            findings.extend(analyze_file(path, rel))
+        except SyntaxError as e:
+            errors.append(f"{rel}: {e}")
+    stale = allowlist_util.apply_allowlist(findings, allowlist)
+    summary = allowlist_util.summarize(
+        findings, len(files),
+        {"stale_allowlist": stale, "parse_errors": errors})
+    by_class, by_class_unsup = allowlist_util.counts_by_class(findings)
+    summary["by_class"] = by_class
+    summary["by_class_unsuppressed"] = by_class_unsup
+    return findings, summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: tendermint_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings (baseline mode)")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--all", action="store_true",
+                    help="show suppressed findings too")
+    args = ap.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = args.paths or [os.path.join(root, "tendermint_tpu")]
+    t0 = time.time()
+    try:
+        allowlist = load_allowlist(args.allowlist)
+    except ValueError as e:
+        print(f"check_determinism: FAIL: {e}", file=sys.stderr)
+        return 2
+    findings, summary = run_check(paths, root, allowlist)
+    elapsed = time.time() - t0
+
+    if args.json:
+        print(json.dumps(
+            {"findings": [f.as_dict() for f in findings],
+             "summary": summary, "elapsed_s": round(elapsed, 3)},
+            indent=1))
+    else:
+        shown = [f for f in findings
+                 if args.all or f.suppressed_by is None]
+        shown.sort(key=lambda f: (f.rule, f.path, f.line))
+        for f in shown:
+            tag = " [allowlisted]" if f.suppressed_by else ""
+            print(f"{f.rule}{tag} {f.path}:{f.line}\n  {f.message}\n"
+                  f"  key: {f.key}")
+        for s in summary["stale_allowlist"]:
+            print(f"WARNING: stale allowlist entry (no matching finding):"
+                  f" {s}")
+        for e in summary["parse_errors"]:
+            print(f"WARNING: parse error: {e}")
+        verdict = ("OK" if summary["unsuppressed"] == 0
+                   and not summary["parse_errors"] else "FAIL")
+        print(f"check_determinism: {verdict} — {summary['files']} files, "
+              f"{summary['findings']} findings "
+              f"({summary['suppressed']} allowlisted, "
+              f"{summary['unsuppressed']} unsuppressed) "
+              f"in {elapsed:.2f}s")
+    # an unparseable critical file means zero rules were checked on it
+    # — that is a gate failure, not a warning
+    return 0 if (summary["unsuppressed"] == 0
+                 and not summary["parse_errors"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

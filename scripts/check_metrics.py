@@ -292,6 +292,13 @@ REQUIRED_FAMILIES = (
     "recovery_replayed_blocks_total",
     "recovery_time_seconds",
     "storage_faults_injected_total",
+    # PR-15 determinism gate (declaration presence: samples flow only
+    # when a check_determinism lint or detcheck oracle run is driven
+    # in-process — bench.py detcheck, the test gates, scenario runs;
+    # divergence counters staying at zero IS the healthy signal)
+    "detlint_findings_total",
+    "detcheck_runs_total",
+    "detcheck_divergence_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -88,12 +88,21 @@ class _CommitBufferDB:
         yield from reversed(list(self.iterator(start, end)))
 
     def flush(self) -> None:
-        """Apply the pending block as one batch (the commit point)."""
+        """Apply the pending block as one batch (the commit point).
+
+        Ops are emitted in sorted-key order, not dict-insertion order:
+        insertion order is execution order, which under the parallel
+        exec lanes depends on scheduling — sorting makes the durable
+        image (FileDB's append log) a pure function of the block's
+        content, so crash/restart images and at_op-indexed storage-
+        fault plans replay identically across runs, engines, and
+        PYTHONHASHSEEDs (rule DT-3)."""
         if not self._pending:
             return
-        ops = [("set", k, v) if v is not None else ("del", k, None)
-               for k, v in self._pending.items()]
-        self._pending.clear()
+        pending, self._pending = self._pending, {}
+        ops = [("set", k, pending[k]) if pending[k] is not None
+               else ("del", k, None)
+               for k in sorted(pending)]
         self.backing.apply_batch(ops)
 
     def discard(self) -> None:

@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ...libs.db import DB
@@ -100,7 +101,11 @@ class ExecSession:
     # -- overlay plumbing (called by _SessionView) ---------------------
 
     def _stripe(self, key: bytes) -> _Stripe:
-        return self.stripes[hash(key) % len(self.stripes)]
+        # crc32, NOT builtin hash(): bytes hashing is PYTHONHASHSEED-
+        # randomized, so hash-keyed striping lands keys on different
+        # stripes in different processes — the stripe walk order then
+        # leaks into anything that iterates stripes (rule DT-3)
+        return self.stripes[zlib.crc32(key) % len(self.stripes)]
 
     def mvcc_get(self, idx: int, key: bytes):
         """(found, value) as seen by tx `idx`: highest overlay version
@@ -510,11 +515,21 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
     def exec_promote(self, session: ExecSession) -> None:
         """Apply the session in block order: per key the final version
         wins (idx order), buffered scalars sum, pending validator
-        updates land on the base list for EndBlock parity."""
+        updates land on the base list for EndBlock parity.
+
+        Keys apply in SORTED order, never stripe/insertion order: which
+        stripe a key lives on and when its version list was created are
+        scheduling artifacts (lane timing), so walking the stripes
+        directly would emit a different base-db write sequence on every
+        run — content-identical, but the durable image (FileDB append
+        log) and any at_op-indexed storage-fault plan would diverge
+        across runs and PYTHONHASHSEEDs (found by the detcheck oracle,
+        rule DT-3)."""
         if session.closed:
             raise RuntimeError("exec session already closed")
         session.closed = True
         end = session.end_idx + 1
+        final: Dict[bytes, object] = {}
         for s in session.stripes:
             with s.lock:
                 for key, vers in s.versions.items():
@@ -522,12 +537,14 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
                     for vidx, val in vers:
                         if vidx < end:
                             best = val
-                    if best is None:
-                        continue
-                    if best is _TOMBSTONE:
-                        self._db.delete(key)
-                    else:
-                        self._db.set(key, best)
+                    if best is not None:
+                        final[key] = best
+        for key in sorted(final):
+            best = final[key]
+            if best is _TOMBSTONE:
+                self._db.delete(key)
+            else:
+                self._db.set(key, best)
         self._size += session.scalar_total("size")
         self._epochs_run += session.scalar_total("epochs_run")
         if session.val_reset:

@@ -315,6 +315,26 @@ class RecoveryMetrics:
 
 
 @dataclass
+class DeterminismMetrics:
+    """Determinism-gate telemetry (ours; no reference equivalent):
+    the static analyzer's finding counts and the replay-divergence
+    oracle's run/divergence counters (tools/detcheck.py). Families are
+    registered unconditionally — declaration presence is the
+    check_metrics contract — and record samples only when a lint or
+    oracle run is driven in-process (tests, bench.py detcheck, the
+    scenario runner)."""
+
+    # static-gate findings observed per lint run, by DT-* class
+    lint_findings: object = NOP
+    # replay-divergence oracle executions completed
+    oracle_runs: object = NOP
+    # byte-level divergences between execution engines, by surface
+    # (app_hashes|results|events|index|image) — any nonzero value is a
+    # chain-splitting bug; tools/monitor.py degrades health on it
+    oracle_divergence: object = NOP
+
+
+@dataclass
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
@@ -326,6 +346,8 @@ class NodeMetrics:
     rpc: RPCMetrics = field(default_factory=RPCMetrics)
     lockdep: LockdepMetrics = field(default_factory=LockdepMetrics)
     recovery: RecoveryMetrics = field(default_factory=RecoveryMetrics)
+    determinism: DeterminismMetrics = field(
+        default_factory=DeterminismMetrics)
     registry: Optional[Registry] = None
 
 
@@ -672,7 +694,23 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Storage faults injected by the crash-consistency engine, "
             "by kind.", ("kind",)),
     )
+    determinism = DeterminismMetrics(
+        lint_findings=r.counter(
+            f"{ns}_detlint_findings_total",
+            "check_determinism findings observed per in-process lint "
+            "run, by DT-* class (allowlisted findings included).",
+            ("cls",)),
+        oracle_runs=r.counter(
+            f"{ns}_detcheck_runs_total",
+            "Replay-divergence oracle executions completed "
+            "(tools/detcheck.py)."),
+        oracle_divergence=r.counter(
+            f"{ns}_detcheck_divergence_total",
+            "Byte-level divergences between execution engines, by "
+            "surface — any nonzero value is a chain-splitting bug.",
+            ("surface",)),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
                        rpc=rpc, lockdep=lockdep, recovery=recovery,
-                       registry=r)
+                       determinism=determinism, registry=r)

@@ -217,6 +217,13 @@ class Node:
             self._enabled_lockdep = lockdep.enable()
         if config.instrumentation.prometheus:
             lockdep.set_metrics(self.metrics.lockdep)
+            # determinism-gate telemetry sink (tools/detcheck.py):
+            # process-global like the lockdep/crypto sinks — families
+            # declared unconditionally, samples only when a lint/oracle
+            # run is driven
+            from ..tools import detcheck
+
+            detcheck.set_metrics(self.metrics.determinism)
 
         # --- storage (node/node.go:162-171) --------------------------
         # crash-consistency fault engine ([storage] fault_plan, ours):
@@ -830,6 +837,7 @@ class Node:
                 "/debug/rpc": lambda q: self._rpc_status(),
                 "/debug/lockdep": lambda q: self._lockdep_status(),
                 "/debug/recovery": lambda q: self._recovery_status(),
+                "/debug/determinism": lambda q: self._determinism_status(),
             },
         )
         self._prof_server.start()
@@ -893,6 +901,14 @@ class Node:
 
         return lockdep.report()
 
+    def _determinism_status(self) -> dict:
+        """/debug/determinism: the determinism gate's runtime view —
+        last static-lint summary plus the replay-divergence oracle's
+        run/divergence counters (zero-shells until a run is driven)."""
+        from ..tools import detcheck
+
+        return detcheck.report()
+
     def _statesync_status(self) -> dict:
         """The /debug/statesync bundle: serve-side snapshot inventory +
         chunk counters, plus restore progress when this node is (or
@@ -946,6 +962,10 @@ class Node:
             lockdep.disable()
         if lockdep.get_metrics() is self.metrics.lockdep:
             lockdep.set_metrics(None)
+        from ..tools import detcheck
+
+        if detcheck.get_metrics() is self.metrics.determinism:
+            detcheck.set_metrics(None)
         self.sw.stop()
         # settle any in-flight speculative execution (exec-spec thread +
         # overlay session) before the app conns go away

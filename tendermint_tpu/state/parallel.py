@@ -148,8 +148,13 @@ def _group_disjoint(indices: List[int],
     groups: dict = {}
     for i in indices:
         groups.setdefault(find(i), []).append(i)
-    # txs within a group stay in block order; group order is by first tx
-    return [sorted(g) for _, g in sorted(groups.items())]
+    # txs within a group stay in block order (members are appended in
+    # ascending `indices` order); group ORDER is by first member tx —
+    # NOT by union-find root: the root a component lands on depends on
+    # the order the footprint frozensets iterate, which is
+    # hash-randomized across processes (rule DT-3), and the plan must
+    # be a pure function of the block
+    return sorted(groups.values(), key=lambda g: g[0])
 
 
 # --- the lane executor ------------------------------------------------

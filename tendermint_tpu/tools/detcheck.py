@@ -1,0 +1,470 @@
+"""detcheck — the replay-divergence oracle (runtime twin of
+scripts/check_determinism.py).
+
+The static gate reasons about source shapes; this tool executes a
+deterministic churn+sharded block sequence under every execution
+engine the node ships —
+
+  serial            the conformance-oracle DeliverTx loop
+  parallel(2|4)     optimistic-concurrency lanes (state/parallel.py)
+  speculative       SpeculationSlot pre-execution, promoted at commit
+  subprocess        the same engine in a FRESH process with a
+                    different PYTHONHASHSEED (set/dict hash order,
+                    striping, and anything seeded per-process shifts)
+
+— and diffs, byte-for-byte, every consensus-visible surface:
+
+  app_hashes   the per-block app hash chain
+  results      ABCIResponses bytes (DeliverTx codes/data/logs/tags +
+               EndBlock validator updates) per block
+  events       the EVENT_TX stream as a real EventBus subscriber
+               observes it (publish_txs path)
+  index        the full tx-index row set a KVTxIndexer ingested
+  image        the durable FileDB append-log bytes of the app db —
+               the surface PR-14's seeded crash/fault replay indexes
+               into by op position
+
+Any real nondeterminism the static pass flags (or misses) becomes a
+reproducible witness here. Divergence counters feed the node's
+/debug/determinism provider and the detcheck_* metric families so
+tools/monitor.py can degrade health when an oracle run diverges.
+
+CLI:  python -m tendermint_tpu.tools.detcheck [--blocks N] [--json]
+      (also `bench.py detcheck` for the BENCH-schema line)
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+# --- deterministic workload ------------------------------------------
+
+# the tx soup mirrors the PR-12 conflict-fuzz shapes: plain writes,
+# order-sensitive counters, cross-key copies, read-dependent writes
+# (barriers), correctly-hinted envelopes, LYING hints (observed-access
+# conflicts -> re-runs), and val:/churn traffic (EndBlock batches)
+DEFAULT_BLOCKS = 12
+DEFAULT_TXS = 14
+DEFAULT_KEYS = 8
+
+
+def signing_key():
+    """Deterministic workload signer: cross-process identical txs."""
+    from ..crypto.keys import PrivKeyEd25519
+
+    return PrivKeyEd25519.gen_from_secret(b"detcheck-workload")
+
+
+def build_blocks(seed: int = 99, n_blocks: int = DEFAULT_BLOCKS,
+                 n_txs: int = DEFAULT_TXS,
+                 n_keys: int = DEFAULT_KEYS) -> List[List[bytes]]:
+    """A pure function of (seed, sizes): the block sequence every
+    engine (and every subprocess) executes."""
+    from ..mempool.preverify import make_signed_tx
+
+    rng = random.Random(seed)
+    sk = signing_key()
+    keys = [b"k%02d" % i for i in range(n_keys)]
+    blocks: List[List[bytes]] = []
+    for _ in range(n_blocks):
+        txs: List[bytes] = []
+        for _ in range(n_txs):
+            roll = rng.random()
+            k = rng.choice(keys)
+            k2 = rng.choice(keys)
+            if roll < 0.25:
+                txs.append(k + b"=v%04d" % rng.randrange(10000))
+            elif roll < 0.45:
+                txs.append(b"inc:" + k)
+            elif roll < 0.60:
+                txs.append(b"cp:" + k + b":" + k2)
+            elif roll < 0.68:
+                # read-dependent write target: planner barrier
+                txs.append(b"ind:" + k + b":p%03d" % rng.randrange(1000))
+            elif roll < 0.88:
+                inner = (k + b"=h%04d" % rng.randrange(10000)
+                         if rng.random() < 0.5 else b"inc:" + k)
+                txs.append(make_signed_tx(sk, inner,
+                                          priority=rng.randrange(2),
+                                          hints=[b"kv:" + k]))
+            else:
+                # LYING hint: declared footprint != touched keys — the
+                # conflict-detection/re-run machinery must still land
+                # on serial-identical output
+                wrong = rng.choice(keys)
+                txs.append(make_signed_tx(sk, b"cp:" + k + b":" + k2,
+                                          priority=0,
+                                          hints=[b"kv:" + wrong]))
+        blocks.append(txs)
+    return blocks
+
+
+def make_app(db=None, shards: int = 8, seed: int = 7):
+    """The churn+sharded workload app with a small real-validator base
+    so the epoch rotation batches have power budget to rotate against."""
+    from ..abci import types as abci
+    from ..abci.example.sharded_kvstore import ShardedKVStoreApplication
+    from ..crypto import pubkey_to_bytes
+    from ..crypto.keys import PrivKeyEd25519
+    from ..libs.db import MemDB
+
+    app = ShardedKVStoreApplication(
+        db if db is not None else MemDB(), shards=shards, epoch_blocks=2,
+        rotation_fraction=0.5, phantom_pool=6, seed=seed)
+    vals = []
+    for i in range(4):
+        sk = PrivKeyEd25519.gen_from_secret(b"detcheck-val:%d" % i)
+        vals.append(abci.ValidatorUpdate(
+            pub_key=pubkey_to_bytes(sk.pub_key()), power=10))
+    app.init_chain(abci.RequestInitChain(validators=vals))
+    return app
+
+
+# --- engines ----------------------------------------------------------
+
+
+def _exec_serial(app, txs, breq, ereq):
+    app.begin_block(breq)
+    dres = [app.deliver_tx(tx) for tx in txs]
+    eres = app.end_block(ereq)
+    return dres, eres
+
+
+def _exec_parallel(app, txs, breq, ereq, lanes):
+    from ..state import parallel as par
+
+    run = par.run_block(app, txs, breq, ereq, lanes=lanes)
+    app.exec_promote(run.session)
+    return run.deliver_res, run.end_res
+
+
+def _exec_speculative(app, txs, breq, ereq, lanes):
+    """Drive the block through a SpeculationSlot (the exec-spec worker
+    thread) and adopt the finished run — the commit-time path minus the
+    consensus machinery around it."""
+    from ..state import parallel as par
+
+    slot = par.SpeculationSlot(app, 0, b"", b"")
+    slot.start(list(txs), breq, ereq, lanes=lanes)
+    run = slot.wait(timeout=60)
+    slot.join(timeout=60)
+    if run is None:
+        # abandon so a late-finishing worker discards its own session
+        # instead of parking an open overlay in the dead slot
+        slot.abandon()
+        raise (slot.error or RuntimeError("speculative run lost"))
+    app.exec_promote(run.session)
+    return run.deliver_res, run.end_res
+
+
+def run_engine(engine: str, blocks: List[List[bytes]],
+               workdir: Optional[str] = None,
+               app_seed: int = 7) -> Dict[str, object]:
+    """Execute `blocks` under one engine; return the surface digests.
+
+    engine: "serial" | "parallel2" | "parallel4" | "speculative"
+    workdir: when set, the app runs on a FileDB there and the digest of
+    the raw append-log bytes rides along as the `image` surface."""
+    from ..abci import types as abci
+    from ..libs.db import FileDB, MemDB
+    from ..libs.events import Query
+    from ..state.execution import ABCIResponses
+    from ..state.txindex import KVTxIndexer, TxResult
+    from ..types.event_bus import EVENT_TX, EventBus, query_for_event
+
+    db_path = None
+    if workdir:
+        db_path = os.path.join(workdir, f"app-{engine}.db")
+        if os.path.exists(db_path):
+            os.unlink(db_path)
+        db = FileDB(db_path)
+    else:
+        db = MemDB()
+    app = make_app(db, seed=app_seed)
+
+    bus = EventBus()
+    bus.start()
+    sub = bus.subscribe("detcheck", query_for_event(EVENT_TX),
+                        capacity=65536)
+    indexer = KVTxIndexer(MemDB(), index_all_tags=True)
+
+    app_hashes: List[str] = []
+    results = hashlib.sha256()
+    events = hashlib.sha256()
+    try:
+        for h, txs in enumerate(blocks, start=1):
+            breq = abci.RequestBeginBlock()
+            ereq = abci.RequestEndBlock(height=h)
+            if engine == "serial":
+                dres, eres = _exec_serial(app, txs, breq, ereq)
+            elif engine.startswith("parallel"):
+                dres, eres = _exec_parallel(app, txs, breq, ereq,
+                                            lanes=int(engine[8:] or 2))
+            elif engine == "speculative":
+                dres, eres = _exec_speculative(app, txs, breq, ereq,
+                                               lanes=4)
+            else:
+                raise ValueError(f"unknown engine {engine!r}")
+            commit = app.commit()
+            app_hashes.append(commit.data.hex())
+            results.update(ABCIResponses(list(dres), eres).to_bytes())
+            # the event stream exactly as a bus subscriber observes it
+            bus.publish_txs(h, txs, list(dres))
+            for m in sub.get_batch(max_n=len(txs) + 1, timeout=5.0):
+                d = m.data
+                events.update(
+                    b"%d|%d|" % (d["height"], d["index"]) + d["tx"])
+                for tk in sorted(m.tags):
+                    events.update(tk.encode() + b"=" +
+                                  m.tags[tk].encode() + b";")
+            indexer.index_batch(h, [
+                TxResult(height=h, index=i, tx=bytes(tx), result=dres[i])
+                for i, tx in enumerate(txs)])
+    finally:
+        bus.unsubscribe_all("detcheck")
+        bus.stop()
+        # close on every path: a raising engine must not leave the
+        # FileDB handle open across the workdir's cleanup (no-op for
+        # MemDB; closing also flushes the append log before the image
+        # read below)
+        db.close()
+
+    index = hashlib.sha256()
+    for k, v in indexer._db.iterator(None, None):
+        index.update(k + b"\x00" + v + b"\x01")
+    out: Dict[str, object] = {
+        "engine": engine,
+        "hashseed": os.environ.get("PYTHONHASHSEED", "random"),
+        "app_hashes": app_hashes,
+        "results": results.hexdigest(),
+        "events": events.hexdigest(),
+        "index": index.hexdigest(),
+    }
+    if db_path is not None:
+        with open(db_path, "rb") as fh:
+            out["image"] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+SURFACES = ("app_hashes", "results", "events", "index", "image")
+
+
+def diff_runs(a: Dict[str, object], b: Dict[str, object]) -> List[str]:
+    """Human-readable divergence list between two engine runs; empty
+    means byte-identical on every shared surface."""
+    out: List[str] = []
+    for s in SURFACES:
+        if s not in a or s not in b:
+            continue
+        if a[s] != b[s]:
+            detail = ""
+            if s == "app_hashes":
+                for i, (x, y) in enumerate(zip(a[s], b[s])):
+                    if x != y:
+                        detail = f" (first at height {i + 1})"
+                        break
+            out.append(
+                f"{s}: {a['engine']}[seed={a['hashseed']}] != "
+                f"{b['engine']}[seed={b['hashseed']}]{detail}")
+    return out
+
+
+def run_child(engine: str, blocks_n: int, txs_n: int, keys_n: int,
+              seed: int, workdir: str, hashseed: str,
+              timeout: float = 180.0) -> Dict[str, object]:
+    """The cross-process leg: the same engine in a fresh interpreter
+    with a pinned (different) PYTHONHASHSEED."""
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = hashseed
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.tools.detcheck",
+         "--child", "--engine", engine, "--blocks", str(blocks_n),
+         "--txs", str(txs_n), "--keys", str(keys_n),
+         "--seed", str(seed), "--workdir", workdir],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"detcheck child failed rc={proc.returncode}: "
+            f"{proc.stderr[-400:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def run_oracle(n_blocks: int = DEFAULT_BLOCKS, n_txs: int = DEFAULT_TXS,
+               n_keys: int = DEFAULT_KEYS, seed: int = 99,
+               lanes=(2, 4), speculative: bool = True,
+               cross_process: bool = True, workdir: Optional[str] = None,
+               child_hashseeds=("12345", "54321")) -> dict:
+    """The full matrix: serial ≡ parallel(lanes…) ≡ speculative ≡
+    cross-PYTHONHASHSEED subprocesses, on every surface. Returns the
+    report dict (also recorded into the module's /debug state and the
+    detcheck_* metric families)."""
+    t0 = time.time()
+    blocks = build_blocks(seed, n_blocks, n_txs, n_keys)
+    tmp = None
+    if workdir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="detcheck-")
+        workdir = tmp.name
+    try:
+        runs = [run_engine("serial", blocks, workdir)]
+        for n in lanes:
+            runs.append(run_engine(f"parallel{n}", blocks, workdir))
+        if speculative:
+            runs.append(run_engine("speculative", blocks, workdir))
+        if cross_process:
+            for hs in child_hashseeds:
+                child = run_child("parallel%d" % (lanes[-1] if lanes
+                                                  else 2),
+                                  n_blocks, n_txs, n_keys, seed,
+                                  workdir, hs)
+                child["engine"] = f"{child['engine']}@subprocess"
+                runs.append(child)
+        base = runs[0]
+        divergences: List[str] = []
+        for other in runs[1:]:
+            divergences.extend(diff_runs(base, other))
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+    report = {
+        "blocks": n_blocks,
+        "txs_per_block": n_txs,
+        "engines": [r["engine"] for r in runs],
+        "surfaces": list(SURFACES),
+        "divergences": divergences,
+        "app_hash": runs[0]["app_hashes"][-1],
+        "elapsed_s": round(time.time() - t0, 3),
+    }
+    _record_oracle(report)
+    return report
+
+
+# --- /debug + metrics surface ----------------------------------------
+
+_state_lock = threading.Lock()
+_STATE: dict = {
+    "oracle_runs": 0,
+    "oracle_divergences": 0,
+    "last_oracle": None,
+    "lint": None,
+}
+_metrics = None
+
+
+def set_metrics(m) -> None:
+    """Install a metrics.DeterminismMetrics sink (node wiring; the
+    identity-checked install/uninstall pattern the other tool sinks
+    use)."""
+    global _metrics
+    _metrics = m
+
+
+def get_metrics():
+    return _metrics
+
+
+def _record_oracle(report: dict) -> None:
+    m = _metrics
+    with _state_lock:
+        _STATE["oracle_runs"] += 1
+        _STATE["oracle_divergences"] += len(report["divergences"])
+        _STATE["last_oracle"] = report
+    if m is not None:
+        m.oracle_runs.inc()
+        for d in report["divergences"]:
+            surface = d.split(":", 1)[0]
+            m.oracle_divergence.with_labels(surface).inc()
+
+
+def record_lint(summary: dict) -> None:
+    """Record a scripts/check_determinism run's summary (the static
+    half of the /debug/determinism bundle + detlint_findings_total)."""
+    m = _metrics
+    with _state_lock:
+        _STATE["lint"] = {
+            "findings": summary.get("findings", 0),
+            "unsuppressed": summary.get("unsuppressed", 0),
+            "by_class": dict(summary.get("by_class", {})),
+            "stale_allowlist": list(summary.get("stale_allowlist", [])),
+        }
+    if m is not None:
+        for cls, n in (summary.get("by_class") or {}).items():
+            m.lint_findings.with_labels(cls).inc(n)
+
+
+def report() -> dict:
+    """The /debug/determinism bundle."""
+    with _state_lock:
+        last = _STATE["last_oracle"]
+        return {
+            "oracle": {
+                "runs": _STATE["oracle_runs"],
+                "divergences": _STATE["oracle_divergences"],
+                "last": dict(last) if last else None,
+            },
+            "lint": dict(_STATE["lint"]) if _STATE["lint"] else None,
+        }
+
+
+def reset_state() -> None:
+    """Test hook: forget recorded runs (module state is process-wide)."""
+    with _state_lock:
+        _STATE.update(oracle_runs=0, oracle_divergences=0,
+                      last_oracle=None, lint=None)
+
+
+# --- CLI --------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--blocks", type=int, default=DEFAULT_BLOCKS)
+    ap.add_argument("--txs", type=int, default=DEFAULT_TXS)
+    ap.add_argument("--keys", type=int, default=DEFAULT_KEYS)
+    ap.add_argument("--seed", type=int, default=99)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--no-subprocess", action="store_true",
+                    help="skip the cross-PYTHONHASHSEED child legs")
+    # child protocol (internal): execute ONE engine, print digests
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--engine", default="serial")
+    ap.add_argument("--workdir", default="")
+    args = ap.parse_args(argv)
+
+    if args.child:
+        blocks = build_blocks(args.seed, args.blocks, args.txs, args.keys)
+        out = run_engine(args.engine, blocks, args.workdir or None)
+        print(json.dumps(out))
+        return 0
+
+    rep = run_oracle(args.blocks, args.txs, args.keys, args.seed,
+                     cross_process=not args.no_subprocess)
+    if args.json:
+        print(json.dumps(rep, indent=1))
+    else:
+        print(f"detcheck: {len(rep['engines'])} engines x "
+              f"{rep['blocks']} blocks, surfaces: "
+              f"{', '.join(rep['surfaces'])}")
+        for d in rep["divergences"]:
+            print(f"  DIVERGENCE {d}")
+        verdict = "OK" if not rep["divergences"] else "FAIL"
+        print(f"detcheck: {verdict} — {len(rep['divergences'])} "
+              f"divergences, app_hash={rep['app_hash'][:16]} "
+              f"in {rep['elapsed_s']:.2f}s")
+    return 0 if not rep["divergences"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -185,6 +185,13 @@ class NodeStatus:
     reindexed_blocks: int = 0
     recovery_time_s: float = 0.0
     wal_corrupted: int = 0
+    # determinism-gate view (from /debug/determinism): replay-
+    # divergence oracle counters — ANY divergence is a chain-splitting
+    # bug on this node's execution stack, degraded immediately — plus
+    # the last in-process static-lint summary
+    det_oracle_runs: int = 0
+    det_divergences: int = 0
+    det_lint_unsuppressed: int = 0
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
@@ -241,6 +248,13 @@ class NodeStatus:
         header): the disk is eating data — degraded even though replay
         tolerated it."""
         return self.wal_corrupted > 0
+
+    @property
+    def det_diverging(self) -> bool:
+        """The node's replay-divergence oracle has witnessed engines
+        disagreeing (or an in-process lint run left unsuppressed
+        findings) — its execution stack can split from the chain."""
+        return self.det_divergences > 0 or self.det_lint_unsuppressed > 0
 
     @property
     def abci_degraded(self) -> bool:
@@ -357,6 +371,9 @@ class NodeStatus:
         self.reindexed_blocks = 0
         self.recovery_time_s = 0.0
         self.wal_corrupted = 0
+        self.det_oracle_runs = 0
+        self.det_divergences = 0
+        self.det_lint_unsuppressed = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -571,6 +588,19 @@ class Monitor:
             ns.wal_corrupted = 0
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/determinism", timeout=2.0) as r:
+                det = json.load(r)
+            oracle = det.get("oracle") or {}
+            ns.det_oracle_runs = int(oracle.get("runs", 0))
+            ns.det_divergences = int(oracle.get("divergences", 0))
+            lint = det.get("lint") or {}
+            ns.det_lint_unsuppressed = int(lint.get("unsuppressed", 0))
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.det_oracle_runs = 0
+            ns.det_divergences = 0
+            ns.det_lint_unsuppressed = 0
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/rpc", timeout=2.0) as r:
                 rp = json.load(r)
             ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
@@ -635,6 +665,10 @@ class Monitor:
                 # a disk eating WAL records is degraded even while the
                 # node keeps committing (replay silently loses data)
                 and not any(n.wal_corrupting for n in online)
+                # a node whose replay-divergence oracle has witnessed
+                # its execution engines disagree can split from the
+                # chain the next time the divergent path runs live
+                and not any(n.det_diverging for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -718,6 +752,10 @@ class Monitor:
                     "recovered": n.recovered,
                     "wal_corrupted": n.wal_corrupted,
                     "wal_corrupting": n.wal_corrupting,
+                    "det_oracle_runs": n.det_oracle_runs,
+                    "det_divergences": n.det_divergences,
+                    "det_lint_unsuppressed": n.det_lint_unsuppressed,
+                    "det_diverging": n.det_diverging,
                 }
                 for n in self.nodes.values()
             ],
@@ -766,6 +804,10 @@ def main(argv=None) -> int:
                     if n["wal_corrupting"]:
                         line += (f" [WAL CORRUPT"
                                  f" records={n['wal_corrupted']}]")
+                    if n["det_diverging"]:
+                        line += (f" [DETERMINISM DIVERGENT"
+                                 f" n={n['det_divergences']}"
+                                 f" lint={n['det_lint_unsuppressed']}]")
                     if n["partition_suspect"]:
                         line += (f" [PARTITIONED? peers={n['n_peers']}"
                                  f"/{n['n_validators']}vals]")

@@ -1,0 +1,36 @@
+"""Seeded DT-CLOCK violations: wall-clock reads escaping into stored,
+serialized, and returned consensus state."""
+
+import time
+from datetime import datetime
+from time import time as wallclock
+
+from serde import pack  # noqa: F401 - fixture, never imported
+
+
+class StampingStore:
+    """Wall time leaking into durable rows and serialized payloads."""
+
+    def __init__(self, db):
+        self.db = db
+
+    def put_row(self, key, value):
+        # BAD: the stored row embeds the writer's clock — replay writes
+        # a different byte string
+        stamp = time.time()
+        self.db.set(key, b"%f:%s" % (stamp, value))
+
+    def snapshot_payload(self, items):
+        # BAD: wall-clock taint through a local into serialization
+        t = datetime.utcnow()
+        header = [t, len(items)]
+        return pack([header, items])
+
+    def freshness(self):
+        # BAD: clock-derived value returned into the caller graph
+        return time.time_ns() - 1
+
+    def stamp_row(self, key):
+        # BAD: from-imported (aliased) wall clock into a stored row —
+        # import idioms must not bypass the gate
+        self.db.set(key, b"%f" % wallclock())

@@ -1,0 +1,24 @@
+"""Seeded DT-ENV violations: host environment reads inside state
+transitions."""
+
+import os
+import platform
+
+
+class EnvApp:
+    def begin_block(self, req):
+        # BAD: an env var steers a state transition
+        self.mode = os.environ.get("APP_MODE", "default")
+        return self.mode
+
+    def node_tag(self):
+        # BAD: platform identity in a deterministic path
+        return platform.node()
+
+    def operator(self):
+        # BAD: os.getenv read
+        return os.getenv("OPERATOR", "")
+
+    def subscript_read(self):
+        # BAD: the call-free env read must not bypass the gate
+        return os.environ["APP_MODE"]

@@ -1,0 +1,19 @@
+"""Seeded DT-FLOAT violations: float arithmetic feeding hashed state
+and int() truncation of float products."""
+
+from serde import pack  # noqa: F401 - fixture, never imported
+
+
+class RewardApp:
+    def __init__(self, db, rate=0.07):
+        self.db = db
+        self.rate = rate
+
+    def payout(self, stake):
+        # BAD: float product truncated into a consensus integer
+        return int(stake * self.rate)
+
+    def store_share(self, key, total):
+        # BAD: true-division result serialized into a stored row
+        share = total / 3
+        self.db.set(key, pack([share]))

@@ -1,0 +1,12 @@
+"""Seeded DT-ID violations: process-address-derived values escaping
+into output."""
+
+
+class SessionTagger:
+    def __init__(self, db):
+        self.db = db
+
+    def tag(self, session):
+        # BAD: id() is a process memory address
+        token = id(session)
+        self.db.set(b"session", b"%d" % token)

@@ -1,0 +1,49 @@
+"""Seeded DT-ITER violations: set-iteration order escaping into
+accumulated, serialized, and yielded output, plus hash-keyed striping
+(builtin hash() of bytes/str is PYTHONHASHSEED-randomized)."""
+
+from serde import pack  # noqa: F401 - fixture, never imported
+
+
+class JournalFlusher:
+    def __init__(self, db):
+        self.db = db
+        self.touched = set()
+
+    def flush(self):
+        # BAD: per-iteration stores land in hash-randomized order — a
+        # FileDB append log diverges across processes
+        for key in self.touched:
+            self.db.set(key, b"1")
+
+    def manifest(self):
+        # BAD: list built by iterating a set, then serialized
+        rows = []
+        for key in self.touched:
+            rows.append(key)
+        return pack(rows)
+
+    def stream(self):
+        # BAD: yields in set order
+        for key in self.touched:
+            yield key
+
+    def stream_direct(self):
+        # BAD: yield from a set emits hash-randomized order
+        yield from self.touched
+
+    def digest_input(self, extra):
+        # BAD: materializing a set straight into a serializer
+        merged = self.touched | set(extra)
+        return pack(list(merged))
+
+
+class HashStriper:
+    def __init__(self, n):
+        self.stripes = [[] for _ in range(n)]
+
+    def route(self, key):
+        # BAD: builtin hash() of bytes is seeded per process — the
+        # stripe a key lands on (and every order derived from stripe
+        # walks) differs under a different PYTHONHASHSEED
+        return self.stripes[hash(key) % len(self.stripes)]

@@ -1,0 +1,47 @@
+"""Seeded DT-RAND violations: unseeded entropy in deterministic paths."""
+
+import os
+import random
+import random as rnd
+import secrets
+import uuid
+from os import urandom
+
+
+class LotteryApp:
+    def deliver_tx(self, tx):
+        # BAD: process entropy decides a state transition
+        if random.random() < 0.5:
+            return 1
+        return 0
+
+    def make_key(self):
+        # BAD: urandom-derived state key
+        return os.urandom(16)
+
+    def tx_id(self):
+        # BAD: uuid4 is urandom underneath
+        return uuid.uuid4()
+
+    def pick(self, items):
+        # BAD: secrets in a consensus path
+        return secrets.choice(items)
+
+    def shuffle_pool(self, pool):
+        # BAD: Random() with no seed draws from system entropy
+        rng = random.Random()
+        rng.shuffle(pool)
+        return pool
+
+    def sample_loop(self, db, pool):
+        # BAD: entropy source in the loop HEADER (no local binding)
+        for tx in random.sample(pool, 3):
+            db.set(tx, b"x")
+
+    def aliased_draw(self):
+        # BAD: module alias must not bypass the gate
+        return rnd.random()
+
+    def bare_urandom(self):
+        # BAD: from-imported entropy must not bypass the gate
+        return urandom(8)

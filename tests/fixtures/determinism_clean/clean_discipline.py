@@ -1,0 +1,72 @@
+"""The disciplined mirror of the bad corpus: every shape the gate must
+stay SILENT on — sorted iteration, seeded RNGs, deterministic striping,
+integer arithmetic, membership-only set use."""
+
+import random
+import zlib
+
+from serde import pack  # noqa: F401 - fixture, never imported
+
+
+class DisciplinedFlusher:
+    def __init__(self, db):
+        self.db = db
+        self.touched = set()
+
+    def flush(self):
+        # GOOD: sorted() launders iteration order before anything
+        # order-sensitive happens
+        for key in sorted(self.touched):
+            self.db.set(key, b"1")
+
+    def manifest(self):
+        rows = []
+        for key in sorted(self.touched):
+            rows.append(key)
+        return pack(rows)
+
+    def union_members(self, extra):
+        # GOOD: accumulating INTO a set is order-free; membership tests
+        # never observe order
+        merged = self.touched | set(extra)
+        return b"k1" in merged
+
+    def ordered_view(self, items):
+        # GOOD: .sort() launders an order-tainted list in place
+        rows = [k for k in self.touched]
+        rows.sort()
+        return pack(rows)
+
+
+class SeededLottery:
+    def __init__(self, seed):
+        # GOOD: seeded Random instance — a pure function of the seed
+        self.rng = random.Random(seed)
+
+    def draw(self, pool):
+        return self.rng.choice(pool)
+
+
+class Crc32Striper:
+    def __init__(self, n):
+        self.stripes = [[] for _ in range(n)]
+
+    def route(self, key):
+        # GOOD: crc32 is a fixed function of the bytes
+        return self.stripes[zlib.crc32(key) % len(self.stripes)]
+
+
+class IntegerRewards:
+    RATE_NUM = 7
+    RATE_DEN = 100
+
+    def __init__(self, db):
+        self.db = db
+
+    def payout(self, stake):
+        # GOOD: integer-exact rounding
+        return stake * self.RATE_NUM // self.RATE_DEN
+
+    def store_share(self, key, total):
+        share = total // 3
+        self.db.set(key, pack([share]))

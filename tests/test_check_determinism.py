@@ -1,0 +1,267 @@
+"""scripts/check_determinism.py as a tier-1 guard (the static half of
+the PR-15 determinism gate, wired like check_concurrency/check_metrics):
+the analyzer must hold the consensus-critical tree at zero unsuppressed
+findings, flag every seeded violation in the bad corpus, stay silent on
+the disciplined corpus, keep its allowlist honest (shared machinery
+with the concurrency gate: scripts/allowlist_util.py), and fit far
+inside its ≤5s budget.
+
+The fixes this gate locked in (each erased a real finding key — they
+are fixed in code, NOT allowlisted):
+  DT-ITER:...:ExecSession._stripe:builtin hash() — the sharded app's
+    overlay striping was keyed by builtin hash(), which is
+    PYTHONHASHSEED-randomized: stripe assignment (and every order
+    derived from stripe walks) differed per process. Now crc32.
+  (exec_promote stripe-walk ordering and _CommitBufferDB.flush
+    insertion ordering are the runtime twins of the same bug — pinned
+    byte-for-byte by tests/test_detcheck.py.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_concurrency as cc  # noqa: E402
+import check_determinism as cd  # noqa: E402
+
+BAD = os.path.join(REPO, "tests", "fixtures", "determinism_bad")
+CLEAN = os.path.join(REPO, "tests", "fixtures", "determinism_clean")
+
+
+def _run(paths, allowlist=None):
+    return cd.run_check(paths, REPO, allowlist or {})
+
+
+def test_tree_is_clean_under_allowlist():
+    """The gate: zero unsuppressed findings on the consensus-critical
+    modules, every suppression justified, nothing stale, and the scan
+    fits the ≤5s acceptance budget with room."""
+    allow = cd.load_allowlist(cd.DEFAULT_ALLOWLIST)
+    assert allow, "allowlist should exist and be non-empty"
+    t0 = time.time()
+    findings, summary = _run([os.path.join(REPO, "tendermint_tpu")], allow)
+    elapsed = time.time() - t0
+    unsup = [f.key for f in findings if f.suppressed_by is None]
+    assert unsup == [], f"unsuppressed findings: {unsup}"
+    assert summary["stale_allowlist"] == [], (
+        "allowlist entries with no matching finding — remove them: "
+        f"{summary['stale_allowlist']}")
+    assert summary["parse_errors"] == []
+    assert summary["files"] >= 20, "critical-module scan looks truncated"
+    assert elapsed < 5.0, f"checker took {elapsed:.1f}s (budget 5s)"
+
+
+def test_fixed_finding_keys_stay_fixed():
+    """The true positives this PR fixed must not resurface."""
+    findings, _ = _run([os.path.join(REPO, "tendermint_tpu")])
+    keys = {f.key for f in findings}
+    fixed = ("DT-ITER:tendermint_tpu/abci/example/sharded_kvstore.py:"
+             "ExecSession._stripe:builtin hash() (PYTHONHASHSEED-seeded)")
+    assert fixed not in keys, f"fixed finding resurfaced: {fixed}"
+    # no builtin-hash finding anywhere in the production tree
+    assert not any("builtin hash()" in k for k in keys), (
+        [k for k in keys if "builtin hash()" in k])
+
+
+def test_bad_corpus_flags_every_rule():
+    findings, summary = _run([BAD])
+    assert summary["parse_errors"] == []
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.key)
+    assert set(by_rule) == {"DT-CLOCK", "DT-RAND", "DT-ITER", "DT-ENV",
+                            "DT-FLOAT", "DT-ID"}, by_rule
+    keys = {f.key for f in findings}
+    # the specific seeded shapes, by key
+    assert ("DT-CLOCK:tests/fixtures/determinism_bad/bad_clock.py:"
+            "StampingStore.put_row:time.time()->store db.set()") in keys
+    assert ("DT-CLOCK:tests/fixtures/determinism_bad/bad_clock.py:"
+            "StampingStore.snapshot_payload:datetime.utcnow()"
+            "->serialize .pack()") in keys
+    assert ("DT-RAND:tests/fixtures/determinism_bad/bad_rand.py:"
+            "LotteryApp.deliver_tx:random.random()") in keys
+    assert ("DT-RAND:tests/fixtures/determinism_bad/bad_rand.py:"
+            "LotteryApp.shuffle_pool:unseeded Random()") in keys
+    assert ("DT-RAND:tests/fixtures/determinism_bad/bad_rand.py:"
+            "LotteryApp.sample_loop:random.sample()") in keys
+    # import idioms must not bypass the source tables
+    assert ("DT-RAND:tests/fixtures/determinism_bad/bad_rand.py:"
+            "LotteryApp.aliased_draw:random.random()") in keys
+    assert ("DT-RAND:tests/fixtures/determinism_bad/bad_rand.py:"
+            "LotteryApp.bare_urandom:os.urandom()") in keys
+    assert ("DT-CLOCK:tests/fixtures/determinism_bad/bad_clock.py:"
+            "StampingStore.stamp_row:time.time()->store db.set()") in keys
+    assert ("DT-ITER:tests/fixtures/determinism_bad/bad_iter.py:"
+            "JournalFlusher.flush:loop->store db.set()") in keys
+    assert ("DT-ITER:tests/fixtures/determinism_bad/bad_iter.py:"
+            "JournalFlusher.stream:yield") in keys
+    assert ("DT-ITER:tests/fixtures/determinism_bad/bad_iter.py:"
+            "JournalFlusher.stream_direct:yield-from") in keys
+    assert ("DT-ENV:tests/fixtures/determinism_bad/bad_env.py:"
+            "EnvApp.subscript_read:os.environ[]") in keys
+    assert ("DT-ITER:tests/fixtures/determinism_bad/bad_iter.py:"
+            "HashStriper.route:builtin hash() "
+            "(PYTHONHASHSEED-seeded)") in keys
+    assert ("DT-ENV:tests/fixtures/determinism_bad/bad_env.py:"
+            "EnvApp.begin_block:os.environ.get") in keys
+    assert ("DT-ENV:tests/fixtures/determinism_bad/bad_env.py:"
+            "EnvApp.node_tag:platform.node()") in keys
+    assert ("DT-FLOAT:tests/fixtures/determinism_bad/bad_float.py:"
+            "RewardApp.payout:int-truncation") in keys
+    assert ("DT-FLOAT:tests/fixtures/determinism_bad/bad_float.py:"
+            "RewardApp.store_share:float arithmetic"
+            "->store db.set()") in keys
+    assert ("DT-ID:tests/fixtures/determinism_bad/bad_id.py:"
+            "SessionTagger.tag:id()") in keys
+
+
+def test_clean_corpus_is_silent():
+    findings, summary = _run([CLEAN])
+    assert summary["parse_errors"] == []
+    assert findings == [], [f.key for f in findings]
+
+
+def test_allowlist_machinery_shared_with_concurrency_gate():
+    """Satellite: both gates load suppressions through ONE helper
+    (scripts/allowlist_util.py) — same justification enforcement, same
+    stale-entry surfacing."""
+    assert cd.load_allowlist is cc.load_allowlist
+
+
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "allow.json"
+    p.write_text(json.dumps(
+        {"entries": [{"key": "DT-CLOCK:x:Y.z:w", "justification": ""}]}))
+    with pytest.raises(ValueError, match="no justification"):
+        cd.load_allowlist(str(p))
+    p.write_text(json.dumps({"entries": [{"justification": "why"}]}))
+    with pytest.raises(ValueError, match="no key"):
+        cd.load_allowlist(str(p))
+
+
+def test_stale_allowlist_entries_are_reported():
+    findings, summary = _run(
+        [CLEAN], {"DT-RAND:nonexistent:Thing.roll:random": "stale"})
+    assert summary["stale_allowlist"] == [
+        "DT-RAND:nonexistent:Thing.roll:random"]
+
+
+def test_summary_counts_by_class():
+    _findings, summary = _run([BAD])
+    assert set(summary["by_class"]) == {"DT-CLOCK", "DT-RAND", "DT-ITER",
+                                        "DT-ENV", "DT-FLOAT", "DT-ID"}
+    assert sum(summary["by_class"].values()) == summary["findings"]
+    assert summary["by_class_unsuppressed"] == summary["by_class"]
+
+
+def test_json_baseline_mode():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_determinism.py"),
+         "--json", "--allowlist", "", BAD],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["summary"]["unsuppressed"] == doc["summary"]["findings"] > 0
+    rules = {f["rule"] for f in doc["findings"]}
+    assert rules == {"DT-CLOCK", "DT-RAND", "DT-ITER", "DT-ENV",
+                     "DT-FLOAT", "DT-ID"}
+
+
+def test_parse_error_fails_gate(tmp_path):
+    """An unparseable file means zero rules were checked on it — the
+    gate must FAIL, not warn-and-pass."""
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    assert cd.main(["--allowlist", "", str(p)]) == 1
+
+
+def test_cli_clean_tree_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_determinism.py")],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_sanctioned_escapes_stay_clean(tmp_path):
+    """sorted()/.sort() launder order; set accumulation and membership
+    are order-free; seeded Random and crc32 are pure functions."""
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "import random, zlib\n"
+        "class C:\n"
+        "    def __init__(self, db):\n"
+        "        self.db = db\n"
+        "        self.s = set()\n"
+        "    def f(self):\n"
+        "        for k in sorted(self.s):\n"
+        "            self.db.set(k, b'1')\n"
+        "        rows = [k for k in self.s]\n"
+        "        rows.sort()\n"
+        "        return rows\n"
+        "    def g(self, seed, pool):\n"
+        "        return random.Random(seed).choice(pool)\n"
+        "    def h(self, key):\n"
+        "        return zlib.crc32(key) % 8\n")
+    findings = cd.analyze_file(str(p), "ok.py")
+    assert findings == [], [f.key for f in findings]
+    # a .set(...) STORE call is not a set() construction: iterating its
+    # result must not read as set-iteration
+    q = tmp_path / "store.py"
+    q.write_text(
+        "class D:\n"
+        "    def commit_rows(self, db):\n"
+        "        ok = db.set(b'k', b'v')\n"
+        "        return list(ok or ())\n")
+    findings = cd.analyze_file(str(q), "store.py")
+    assert findings == [], [f.key for f in findings]
+
+
+def test_doubly_nested_defs_analyzed_once(tmp_path):
+    """A def nested inside a nested def produces exactly ONE finding,
+    under its own parent's owner path — not one per ancestor scope
+    (duplicate keys would make allowlisting impossible)."""
+    p = tmp_path / "nested.py"
+    p.write_text(
+        "import time\n"
+        "def outer():\n"
+        "    def mid():\n"
+        "        def deep():\n"
+        "            return time.time()\n"
+        "        return deep\n"
+        "    return mid\n")
+    findings = cd.analyze_file(str(p), "nested.py")
+    keys = [f.key for f in findings]
+    assert keys == ["DT-CLOCK:nested.py:outer.mid.deep:return"], keys
+
+
+def test_lint_feeds_detcheck_debug_and_metrics():
+    """Satellite: the static gate's results surface through the
+    /debug/determinism bundle and the detlint_findings_total family."""
+    from tendermint_tpu.metrics import prometheus_metrics
+    from tendermint_tpu.tools import detcheck
+
+    _findings, summary = _run([BAD])
+    m = prometheus_metrics("detlint_test")
+    detcheck.set_metrics(m.determinism)
+    try:
+        detcheck.record_lint(summary)
+        rep = detcheck.report()
+        assert rep["lint"]["findings"] == summary["findings"]
+        assert rep["lint"]["unsuppressed"] == summary["unsuppressed"]
+        assert set(rep["lint"]["by_class"]) == set(summary["by_class"])
+        text = m.registry.render()
+        assert "detlint_test_detlint_findings_total" in text
+        assert 'cls="DT-RAND"' in text
+    finally:
+        detcheck.set_metrics(None)
+        detcheck.reset_state()

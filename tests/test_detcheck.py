@@ -1,0 +1,303 @@
+"""PR-15 replay-divergence oracle (tools/detcheck.py — the runtime
+twin of scripts/check_determinism.py) plus the regression pins for the
+real nondeterminism bugs the gate flushed out:
+
+  1. ExecSession striping was keyed by builtin hash() (PYTHONHASHSEED-
+     randomized): stripe assignment differed per process. Now crc32.
+  2. exec_promote applied overlay versions in stripe-walk/dict order
+     (lane-scheduling dependent) and _CommitBufferDB.flush emitted the
+     block batch in insertion order — the durable FileDB append log
+     diverged across engines AND hash seeds while app hashes agreed,
+     which breaks the PR-14 seeded crash-replay contract (fault plans
+     index into the op stream by position). Both now apply sorted.
+  3. plan_block group order came from union-find roots, which depend
+     on frozenset iteration order. Now ordered by first member tx.
+
+The known set-ordered structures named by the audit and found to be
+membership-only (no order escape, no fix needed): state/parallel.py
+conflict/writer sets (boolean hit tests + sorted re-run order), the
+sharded app's read/write journal sets (set intersection only), and the
+mempool's recheck-touched sender set (membership gate)."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tendermint_tpu.abci.example import kvstore as kv_mod
+from tendermint_tpu.tools import detcheck
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- regression pins for the fixed bugs -------------------------------
+
+
+def test_stripe_assignment_is_crc32_not_builtin_hash():
+    """Pin fix 1: the overlay stripe a key lands on is a pure function
+    of the key bytes."""
+    import zlib
+
+    from tendermint_tpu.libs.db import MemDB
+
+    app = detcheck.make_app(MemDB(), shards=8)
+    session = app.exec_open(4)
+    for key in (b"kv:a", b"kv:zz", b"__state__", b"valset:xyz"):
+        want = session.stripes[zlib.crc32(key) % len(session.stripes)]
+        assert session._stripe(key) is want
+
+
+def test_plan_group_order_is_by_first_member():
+    """Pin fix 3: group order is the first member's block position, not
+    the union-find root (which varies with frozenset iteration order —
+    under the old code this exact shape flipped group order depending
+    on PYTHONHASHSEED)."""
+    from tendermint_tpu.state import parallel as par
+
+    foot = [frozenset((b"a",)), frozenset((b"b",)), frozenset((b"c",)),
+            frozenset((b"a", b"c"))]
+    plan = par.plan_block(foot)
+    assert len(plan.segments) == 1
+    assert plan.segments[0].groups == [[0, 2, 3], [1]]
+
+
+def test_commit_buffer_flush_is_sorted():
+    """Pin fix 2 (flush half): the batch a commit hands the backing db
+    is in sorted-key order regardless of write order."""
+    from tendermint_tpu.libs.db import MemDB
+
+    class Spy(MemDB):
+        def __init__(self):
+            super().__init__()
+            self.batches = []
+
+        def apply_batch(self, ops):
+            self.batches.append(list(ops))
+            super().apply_batch(ops)
+
+    spy = Spy()
+    buf = kv_mod._CommitBufferDB(spy)
+    buf.set(b"zz", b"1")
+    buf.set(b"aa", b"2")
+    buf.delete(b"mm")
+    buf.flush()
+    assert [op[1] for op in spy.batches[0]] == [b"aa", b"mm", b"zz"]
+
+
+def test_oracle_catches_order_dependent_flush():
+    """THE witness pin: with the old insertion-order flush restored,
+    the oracle's durable-image surface diverges between serial and
+    parallel execution (content identical, byte stream not) — and with
+    the shipped sorted flush it does not."""
+    blocks = detcheck.build_blocks(seed=5, n_blocks=3, n_txs=10)
+
+    def old_flush(self):  # the pre-PR-15 implementation
+        if not self._pending:
+            return
+        ops = [("set", k, v) if v is not None else ("del", k, None)
+               for k, v in self._pending.items()]
+        self._pending.clear()
+        self.backing.apply_batch(ops)
+
+    fixed = kv_mod._CommitBufferDB.flush
+    kv_mod._CommitBufferDB.flush = old_flush
+    try:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            a = detcheck.run_engine("serial", blocks, d)
+            b = detcheck.run_engine("parallel4", blocks, d)
+            diverged = detcheck.diff_runs(a, b)
+        assert any(d.startswith("image:") for d in diverged), diverged
+        # content surfaces still agree — this bug was invisible to
+        # app-hash-only comparison, which is why the oracle diffs the
+        # durable image at all
+        assert a["app_hashes"] == b["app_hashes"]
+        assert a["results"] == b["results"]
+    finally:
+        kv_mod._CommitBufferDB.flush = fixed
+    with __import__("tempfile").TemporaryDirectory() as d:
+        a = detcheck.run_engine("serial", blocks, d)
+        b = detcheck.run_engine("parallel4", blocks, d)
+        assert detcheck.diff_runs(a, b) == []
+
+
+# --- the in-process oracle matrix -------------------------------------
+
+
+def test_engines_agree_in_process():
+    """serial ≡ parallel(2) ≡ parallel(4) ≡ speculative on every
+    surface (events, results, index rows, app hashes, durable image)."""
+    rep = detcheck.run_oracle(n_blocks=4, n_txs=10, cross_process=False)
+    try:
+        assert rep["divergences"] == []
+        assert rep["engines"] == ["serial", "parallel2", "parallel4",
+                                  "speculative"]
+        assert set(rep["surfaces"]) == {"app_hashes", "results",
+                                        "events", "index", "image"}
+    finally:
+        detcheck.reset_state()
+
+
+def test_oracle_records_debug_state_and_metrics():
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("detcheck_test")
+    detcheck.set_metrics(m.determinism)
+    try:
+        rep = detcheck.run_oracle(n_blocks=2, n_txs=6, lanes=(2,),
+                                  speculative=False, cross_process=False)
+        assert rep["divergences"] == []
+        view = detcheck.report()
+        assert view["oracle"]["runs"] == 1
+        assert view["oracle"]["divergences"] == 0
+        assert view["oracle"]["last"]["engines"] == ["serial",
+                                                     "parallel2"]
+        text = m.registry.render()
+        assert "detcheck_test_detcheck_runs_total 1" in text
+        assert "detcheck_test_detcheck_divergence_total" in text
+    finally:
+        detcheck.set_metrics(None)
+        detcheck.reset_state()
+
+
+def test_divergence_increments_counters():
+    """A divergent run must land in the /debug counters the monitor
+    degrades health on (driven via a synthetic report)."""
+    from tendermint_tpu.metrics import prometheus_metrics
+
+    m = prometheus_metrics("detcheck_div")
+    detcheck.set_metrics(m.determinism)
+    try:
+        detcheck._record_oracle({
+            "divergences": ["image: serial[seed=1] != parallel4[seed=2]"],
+            "engines": ["serial", "parallel4"], "blocks": 1,
+        })
+        view = detcheck.report()
+        assert view["oracle"]["divergences"] == 1
+        text = m.registry.render()
+        assert ('detcheck_div_detcheck_divergence_total'
+                '{surface="image"} 1') in text
+    finally:
+        detcheck.set_metrics(None)
+        detcheck.reset_state()
+
+
+# --- cross-process conformance (satellite 2) --------------------------
+
+
+def test_cross_hashseed_subprocess_conformance(tmp_path):
+    """Two subprocesses, different PYTHONHASHSEED, the 20-block
+    churn+sharded workload: identical app hashes and tx-index contents
+    (plus results/events/durable image — the full surface set)."""
+    a = detcheck.run_child("parallel4", 20, 12, 8, seed=99,
+                           workdir=str(tmp_path / "a"), hashseed="12345")
+    b = detcheck.run_child("parallel4", 20, 12, 8, seed=99,
+                           workdir=str(tmp_path / "b"), hashseed="54321")
+    assert a["hashseed"] == "12345" and b["hashseed"] == "54321"
+    assert a["app_hashes"] == b["app_hashes"]
+    assert a["index"] == b["index"]
+    assert detcheck.diff_runs(a, b) == []
+
+
+# --- monitor wiring ---------------------------------------------------
+
+
+def test_monitor_divergence_degrades_health():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from tendermint_tpu.tools.monitor import (HEALTH_FULL,
+                                              HEALTH_MODERATE, Monitor)
+
+    payloads = {
+        "/debug/consensus": {
+            "height": 5, "dwell_s": 0.1, "threshold_s": 30.0,
+            "stalls_total": 0, "stalls": [], "live": {"peers": []},
+        },
+        "/debug/determinism": {
+            "oracle": {"runs": 3, "divergences": 1, "last": None},
+            "lint": {"findings": 9, "unsuppressed": 0},
+        },
+    }
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(payloads.get(self.path, {})).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    daddr = "%s:%d" % srv.server_address[:2]
+    try:
+        mon = Monitor(["rpc"], debug_addrs=[daddr])
+        ns = mon.nodes["rpc"]
+        ns.mark_online()
+        ns.height = 5
+        mon._poll_debug(ns, daddr)
+        assert ns.det_oracle_runs == 3 and ns.det_divergences == 1
+        assert ns.det_diverging
+        snap = mon.snapshot()
+        assert snap["health"] == HEALTH_MODERATE
+        assert snap["nodes"][0]["det_diverging"]
+        # divergence-free oracle history: back to full health
+        payloads["/debug/determinism"]["oracle"]["divergences"] = 0
+        mon._poll_debug(ns, daddr)
+        assert not ns.det_diverging
+        assert mon.snapshot()["health"] == HEALTH_FULL
+        # endpoint loss clears the view instead of pinning moderate
+        ns.det_divergences = 7
+        ns.clear_debug_view()
+        assert not ns.det_diverging
+    finally:
+        srv.shutdown()
+
+
+def test_node_debug_determinism_route_shape():
+    """The provider returns zero-shells before any run is driven (the
+    monitor scrapes this on every poll)."""
+    detcheck.reset_state()
+    view = detcheck.report()
+    assert view["oracle"]["runs"] == 0
+    assert view["oracle"]["divergences"] == 0
+    assert view["oracle"]["last"] is None
+    assert view["lint"] is None
+
+
+# --- the full matrix + bench line (slow) ------------------------------
+
+
+@pytest.mark.slow
+def test_full_oracle_matrix_is_divergence_free():
+    rep = detcheck.run_oracle()
+    try:
+        assert rep["divergences"] == [], rep["divergences"]
+        assert len(rep["engines"]) == 6  # serial, 2, 4, spec, 2 children
+    finally:
+        detcheck.reset_state()
+
+
+@pytest.mark.slow
+def test_bench_detcheck_schema():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TM_TPU_BENCH_DETCHECK_BLOCKS"] = "6"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "detcheck"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    line = proc.stdout.strip().splitlines()[-1]
+    doc = json.loads(line)
+    assert doc["metric"] == "detcheck_oracle_6blocks_wall_ms"
+    assert doc["value"] > 0
+    assert doc["vs_baseline"] == 1.0
+    assert doc["divergences"] == []
+    assert proc.returncode == 0
