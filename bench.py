@@ -64,14 +64,15 @@ MEGA_MODE = "mega" in sys.argv[1:]  # 100k-sig mega-committee batch point
 CHAOSNET_MODE = "chaosnet" in sys.argv[1:]  # partition-heal recovery (PR 10)
 CRASHREC_MODE = "crashrecovery" in sys.argv[1:]  # kill->committing (PR 14)
 DETCHECK_MODE = "detcheck" in sys.argv[1:]  # replay-divergence oracle (PR 15)
+PROPTRACE_MODE = "proptrace" in sys.argv[1:]  # fleet causal tracing (PR 16)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
          if a not in ("rlc", "votes", "fastsync", "commit4", "cache",
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
-                      "crashrecovery", "detcheck", "--pipeline",
-                      "--parallel")]
+                      "crashrecovery", "detcheck", "proptrace",
+                      "--pipeline", "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -138,6 +139,10 @@ CRASHREC_METRIC = (
     f"crash_recovery_kill_to_committing_{CRASHREC_ROUNDS}rounds_ms")
 DETCHECK_BLOCKS = _env_int("TM_TPU_BENCH_DETCHECK_BLOCKS", 10)
 DETCHECK_METRIC = f"detcheck_oracle_{DETCHECK_BLOCKS}blocks_wall_ms"
+PROPTRACE_NVAL = _env_int("TM_TPU_BENCH_PROPTRACE_NVAL", 4)
+PROPTRACE_SEED = _env_int("TM_TPU_BENCH_PROPTRACE_SEED", 8)
+PROPTRACE_METRIC = (
+    f"proptrace_{PROPTRACE_NVAL}node_commit_attribution_coverage_pct")
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1040,6 +1045,12 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
         valset_changes=_Ctr(), exec_parallel_lanes=_Ctr(),
         exec_conflicts=_Ctr(), exec_speculation_hits=_Ctr(),
         exec_speculation_wasted=_Ctr())
+    # fresh flight-recorder rings so the leg's wakeup percentiles and
+    # busy ratios describe THIS leg only (serial legs record nothing —
+    # the inline path is not instrumented)
+    from tendermint_tpu.state.parallel import get_flight_recorder
+    recorder = get_flight_recorder()
+    recorder.reset()
     block_exec = sm.BlockExecutor(db, conns.consensus, mempool=mp,
                                   event_bus=bus, exec_config=exec_cfg,
                                   metrics=st_metrics)
@@ -1111,6 +1122,11 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
         return lat[min(len(lat) - 1, int(p * len(lat)))] if lat else -1.0
 
     m = block_exec.metrics
+    # exec-lane flight-recorder summary for the leg (PR 16): wakeup
+    # percentiles across lanes plus per-lane busy ratios. Serial legs
+    # report count=0 — the inline path records nothing.
+    wake = recorder.wakeup_percentiles()
+    lane_report = recorder.report()["lanes"]
     return {
         "target_tps": target_tps,
         "accepted": accepted,
@@ -1126,6 +1142,11 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
         # point: the ceiling is attributable, not anecdotal)
         "stages": block_exec.stage_profile.snapshot(),
         "indexed_height": indexer.indexed_height(),
+        "lane_wakeup_samples": wake["count"],
+        "lane_wakeup_p50_us": round(wake["p50_s"] * 1e6, 3),
+        "lane_wakeup_p99_us": round(wake["p99_s"] * 1e6, 3),
+        "lane_busy_ratio": {
+            lane: rep["busy_ratio"] for lane, rep in lane_report.items()},
     }
 
 
@@ -1152,6 +1173,11 @@ def load_parallel_main():
         "value": parallel["committed_tps"],
         "unit": "tps",
         "vs_baseline": round(parallel["committed_tps"] / s_tps, 2),
+        # exec-lane flight recorder (PR 16): spawn->first-instruction
+        # wakeup latency percentiles for the parallel leg's lanes
+        "lane_wakeup_p50_us": parallel["lane_wakeup_p50_us"],
+        "lane_wakeup_p99_us": parallel["lane_wakeup_p99_us"],
+        "lane_wakeup_samples": parallel["lane_wakeup_samples"],
         "serial": serial,
         "parallel": parallel,
         "io_us": EXEC_IO_US,
@@ -1758,6 +1784,53 @@ def detcheck_main():
     return 0 if ok else 1
 
 
+def proptrace_main():
+    """`bench.py proptrace` — fleet causal tracing as a gated BENCH
+    line: the proptrace scenario (tools/scenarios.py) runs a 4-node
+    in-process localnet with ±0.5s synthetic clock skew, probes each
+    node's /debug/clock over real HTTP (NTP-style min-RTT offset
+    estimation), stitches per-height propagation trees and the
+    proposal→commit stage waterfall from the nodes' rebased timelines,
+    and reports the MINIMUM attributed-coverage fraction across the
+    traced heights as a percentage. The scenario's oracle gates the
+    number: offsets recovered worse than the tolerance, missing
+    heights, or coverage under 95% emit value -1. Pure host path:
+    no TPU."""
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("proptrace", seed=PROPTRACE_SEED,
+                        n=PROPTRACE_NVAL)
+    ok = bool(res.get("ok"))
+    coverage_min = res.get("coverage_min")
+    value = (round(coverage_min * 100, 2)
+             if ok and coverage_min is not None else -1)
+    print(json.dumps({
+        "metric": PROPTRACE_METRIC,
+        "value": value,
+        "unit": "pct",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "seed": PROPTRACE_SEED,
+        "offset_error_ms": res.get("offset_error_ms"),
+        "offset_tol_ms": res.get("offset_tol_ms"),
+        "offsets_ok": res.get("offsets_ok"),
+        "coverages": res.get("coverages"),
+        "coverage_ok": res.get("coverage_ok"),
+        "stitched_heights": res.get("stitched_heights"),
+        "max_hop": res.get("max_hop"),
+        "converged": res.get("converged"),
+        "safety_ok": res.get("safety_ok"),
+        "note": ("min share of proposal->commit wall attributed to a "
+                 "named waterfall stage across traced heights; clock "
+                 "offsets recovered via /debug/clock min-RTT probes "
+                 "against ±0.5s synthetic skew"
+                 if ok else "ORACLE FAILED — see offsets/coverages"),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -1766,6 +1839,9 @@ def main():
     if DETCHECK_MODE:
         # in-process + subprocess oracle: pure host path, no TPU probe
         return detcheck_main()
+    if PROPTRACE_MODE:
+        # in-process localnet + loopback HTTP: pure host path, no TPU
+        return proptrace_main()
     if CHAOS_MODE:
         return chaos_main()
     if CHAOSNET_MODE:

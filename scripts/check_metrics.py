@@ -299,6 +299,11 @@ REQUIRED_FAMILIES = (
     "detlint_findings_total",
     "detcheck_runs_total",
     "detcheck_divergence_total",
+    # PR-16 exec-lane flight recorder (declaration presence: samples
+    # flow only on the threaded exec path — parallel_lanes=1 nodes
+    # structurally never record, which is the zero-overhead contract)
+    "exec_lane_wakeup_seconds",
+    "exec_lane_busy_ratio",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples
@@ -338,6 +343,76 @@ def check_body(body: str, namespace: str = "tendermint",
             raise ExpositionError(
                 f"metric families declared but never recorded: {dead}")
     return families
+
+
+# --- README drift lint ----------------------------------------------
+#
+# The README's metric tables and REQUIRED_FAMILIES drift independently:
+# a new PR adds a family here and forgets the docs, or a doc row
+# outlives a renamed metric. The lint closes the loop both ways:
+#   1. every REQUIRED_FAMILIES entry must appear in some README table
+#      row (first cell, backticked, `tendermint_` prefix optional);
+#   2. every README table row written WITH the `tendermint_` prefix
+#      (the explicit "this is a contract family" spelling, used by the
+#      reference table) must still be in REQUIRED_FAMILIES.
+# Unprefixed rows not in REQUIRED_FAMILIES are fine — the README also
+# documents real-but-unrequired families (e.g. flowrate gauges).
+
+_TABLE_NAME_RE = re.compile(r"`(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)`")
+
+
+def readme_metric_rows(readme_text: str) -> list:
+    """Backticked metric names from the FIRST cell of markdown table
+    rows, as (name, was_prefixed) pairs with the namespace stripped."""
+    rows = []
+    for line in readme_text.splitlines():
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = line.split("|")
+        if len(cells) < 3:
+            continue
+        first = cells[1]
+        if set(first.strip()) <= {"-", ":", " "}:  # separator row
+            continue
+        for m in _TABLE_NAME_RE.finditer(first):
+            name = m.group("name")
+            prefixed = name.startswith("tendermint_")
+            if prefixed:
+                name = name[len("tendermint_"):]
+            rows.append((name, prefixed))
+    return rows
+
+
+def check_readme_drift(readme_text: str,
+                       families=REQUIRED_FAMILIES) -> list:
+    """Both directions of REQUIRED_FAMILIES <-> README drift; returns a
+    list of human-readable problems (empty = in sync)."""
+    rows = readme_metric_rows(readme_text)
+    documented = {name for name, _ in rows}
+    problems = []
+    undocumented = sorted(f for f in families if f not in documented)
+    if undocumented:
+        problems.append(
+            "families required by check_metrics but missing from the "
+            f"README metric tables: {undocumented}")
+    stale = sorted({name for name, prefixed in rows
+                    if prefixed and name not in families})
+    if stale:
+        problems.append(
+            "tendermint_-prefixed README table rows not in "
+            f"REQUIRED_FAMILIES (renamed or removed?): {stale}")
+    return problems
+
+
+def run_readme_drift(readme_path: str = None) -> list:
+    import os
+
+    if readme_path is None:
+        readme_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "README.md")
+    with open(readme_path, encoding="utf-8") as f:
+        return check_readme_drift(f.read())
 
 
 def run_node_and_scrape(blocks: int = 3, timeout: float = 60.0) -> str:
@@ -414,6 +489,11 @@ def main(argv=None) -> int:
     ap.add_argument("--timeout", type=float, default=60.0,
                     help="seconds to wait for the blocks (default 60)")
     args = ap.parse_args(argv)
+    drift = run_readme_drift()
+    if drift:
+        for p in drift:
+            print(f"check_metrics: README drift: {p}", file=sys.stderr)
+        return 1
     try:
         body = run_node_and_scrape(args.blocks, args.timeout)
         families = check_body(body)
@@ -422,7 +502,8 @@ def main(argv=None) -> int:
         return 1
     n_series = sum(len(f["samples"]) for f in families.values())
     print(f"check_metrics: OK — {len(families)} families, "
-          f"{n_series} series, strict exposition parse clean")
+          f"{n_series} series, README tables in sync, "
+          f"strict exposition parse clean")
     return 0
 
 

@@ -402,6 +402,20 @@ class InstrumentationConfig:
     # mode: ~5us per acquire/release pair on a throttled CPU — leave
     # off in production (see README "Correctness tooling")
     lockdep: bool = False
+    # exec-lane flight recorder (state/parallel.py): per-lane bounded
+    # ring of (wakeup latency, run span, txs, conflict outcome) samples
+    # taken on the THREADED parallel-exec path only; served at
+    # /debug/exec and as exec_lane_* metric families. Default-on: with
+    # parallel_lanes <= 1 the threaded path never runs, so the recorder
+    # is structurally zero-cost
+    flight_recorder: bool = True
+    flight_recorder_samples: int = 512
+    # synthetic wall-clock offset applied to timeline marks and
+    # /debug/clock (test/chaos knob: lets an in-process localnet, which
+    # shares one real clock, present skewed per-node clocks for
+    # tools/fleettrace.py offset recovery to find). Leave 0 in
+    # production
+    clock_skew_s: float = 0.0
 
 
 @dataclass

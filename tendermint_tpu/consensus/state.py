@@ -688,6 +688,10 @@ class ConsensusState:
 
             if rs.round != round_:
                 self._round_entered = time.time()
+            # round-churn accounting: entry counts per (height, round)
+            # let stitched fleet traces tell "extra rounds" apart from
+            # "slow gossip" (first-wins marks alone cannot)
+            self.timeline.mark_round(height, round_)
             rs.round = round_
             rs.step = STEP_NEW_ROUND
             rs.validators = validators
@@ -798,6 +802,9 @@ class ConsensusState:
         except Exception:
             LOG.exception("propose: failed to sign proposal")
             return
+        # proposer-only mark: the signed proposal leaves for gossip HERE
+        # — fleettrace's proposal_build/delivery boundary
+        self.timeline.mark(height, "proposal_emit", round_=round_)
         self._send_internal(ProposalMessage(proposal))
         for i in range(block_parts.total()):
             self._send_internal(BlockPartMessage(height, round_, block_parts.get_part(i)))

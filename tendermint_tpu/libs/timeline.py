@@ -27,9 +27,13 @@ from typing import Dict, List, Optional
 DEFAULT_HEIGHTS = 64
 
 # canonical phase order, for readers of the exported record; marks land
-# first-wins except the last_* phases, which track the newest occurrence
+# first-wins except the last_* phases, which track the newest occurrence.
+# proposal_emit is proposer-only (dropped when the signed proposal is
+# handed to gossip) — the fleet stitcher's proposal_build/delivery
+# boundary; non-proposers never carry it.
 PHASES = (
     "new_height",
+    "proposal_emit",
     "proposal_received",
     "first_prevote",
     "last_prevote",
@@ -59,7 +63,8 @@ COMMITTED_PHASES = (
 
 
 class _HeightRecord:
-    __slots__ = ("height", "marks", "votes", "max_round")
+    __slots__ = ("height", "marks", "votes", "max_round",
+                 "round_entries")
 
     def __init__(self, height: int):
         self.height = height
@@ -68,6 +73,11 @@ class _HeightRecord:
         # kind ("prevote"/"precommit") -> validator_index -> first-seen
         self.votes: Dict[str, Dict[int, dict]] = {}
         self.max_round = 0
+        # round -> times entered; a count > 1 means the state machine
+        # RE-entered an already-visited round (catch-up / skip churn) —
+        # first-wins marks from the first pass would otherwise read as
+        # slow gossip in stitched traces
+        self.round_entries: Dict[int, int] = {}
 
 
 class Timeline:
@@ -80,6 +90,13 @@ class Timeline:
         self._heights: "collections.OrderedDict[int, _HeightRecord]" = (
             collections.OrderedDict())
         self._enabled = enabled
+        self._skew_s = 0.0
+
+    def set_skew(self, skew_s: float) -> None:
+        """Synthetic clock offset added to every mark (test/chaos knob:
+        in-process localnets share one wall clock, so fleet-level offset
+        recovery needs the skew injected here AND at /debug/clock)."""
+        self._skew_s = float(skew_s)
 
     @property
     def enabled(self) -> bool:
@@ -123,7 +140,7 @@ class Timeline:
         `update` (used by the last_* phases)."""
         if not self._enabled or height <= 0:
             return
-        now = time.time()
+        now = time.time() + self._skew_s
         with self._lock:
             rec = self._rec_locked(height)
             if round_ > rec.max_round:
@@ -140,7 +157,7 @@ class Timeline:
         (always), and the per-validator first-delivery attribution."""
         if not self._enabled or height <= 0:
             return
-        now = time.time()
+        now = time.time() + self._skew_s
         with self._lock:
             rec = self._rec_locked(height)
             if round_ > rec.max_round:
@@ -152,6 +169,19 @@ class Timeline:
             by_val = rec.votes.setdefault(kind, {})
             by_val.setdefault(validator_index,
                               {"t": now, "peer_id": peer_id})
+
+    def mark_round(self, height: int, round_: int) -> None:
+        """Count one entry into (height, round): round churn that the
+        first-wins marks cannot represent, so stitched traces can tell
+        extra rounds apart from slow gossip."""
+        if not self._enabled or height <= 0:
+            return
+        with self._lock:
+            rec = self._rec_locked(height)
+            if round_ > rec.max_round:
+                rec.max_round = round_
+            rec.round_entries[round_] = (
+                rec.round_entries.get(round_, 0) + 1)
 
     # -- export --------------------------------------------------------
 
@@ -175,12 +205,18 @@ class Timeline:
                 for kind, by_val in rec.votes.items()
             }
             max_round = rec.max_round
+            round_entries = dict(rec.round_entries)
         ts = [m["t"] for m in marks.values()]
         return {
             "height": height,
             "max_round": max_round,
             "marks": marks,
             "votes": votes,
+            "rounds_seen": sorted(round_entries),
+            "round_entries": {str(r): c
+                              for r, c in sorted(round_entries.items())},
+            "re_entries": sum(c - 1 for c in round_entries.values()
+                              if c > 1),
             "phases_present": [p for p in PHASES if p in marks],
             "duration_s": round(max(ts) - min(ts), 6) if ts else 0.0,
         }

@@ -111,6 +111,13 @@ class Tracer:
         # timestamps are comparable across processes' traces
         self._epoch_wall_us = time.time() * 1e6
         self._epoch_perf_ns = time.perf_counter_ns()
+        self._skew_us = 0.0
+
+    def set_skew(self, skew_s: float) -> None:
+        """Synthetic wall-clock offset on exported timestamps, matching
+        Timeline.set_skew — keeps /debug/trace spans coherent with the
+        skewed timeline marks fleettrace rebases (test/chaos knob)."""
+        self._skew_us = float(skew_s) * 1e6
 
     @property
     def enabled(self) -> bool:
@@ -150,7 +157,8 @@ class Tracer:
     # --- export -------------------------------------------------------------
 
     def _ts_us(self, t_ns: int) -> float:
-        return self._epoch_wall_us + (t_ns - self._epoch_perf_ns) / 1e3
+        return (self._epoch_wall_us + self._skew_us
+                + (t_ns - self._epoch_perf_ns) / 1e3)
 
     def chrome_trace(self) -> dict:
         """Chrome trace event format: {"traceEvents": [...]} with "X"

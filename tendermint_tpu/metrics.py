@@ -294,6 +294,13 @@ class StateMetrics:
     # profiler that
     # makes the post-executor pipeline ceiling attributable
     commit_stage: object = NOP
+    # exec-lane flight recorder (state/parallel.FlightRecorder): lane
+    # spawn->first-instruction latency — the thread-wakeup convoy the
+    # Block-STM retry-DAG work regresses against
+    exec_lane_wakeup: object = NOP
+    # fraction of a lane's lifetime spent executing txs (1.0 = no
+    # scheduling overhead), labeled by lane index
+    exec_lane_busy: object = NOP
 
 
 @dataclass
@@ -543,6 +550,17 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             ("stage",),
             buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
                      0.5, 1, 5)),
+        exec_lane_wakeup=r.histogram(
+            f"{ns}_exec_lane_wakeup_seconds",
+            "Exec-lane thread wakeup latency: spawn to first "
+            "instruction (flight recorder, threaded path only).",
+            buckets=(0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+                     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05)),
+        exec_lane_busy=r.gauge(
+            f"{ns}_exec_lane_busy_ratio",
+            "Fraction of an exec lane's lifetime spent executing txs "
+            "(1.0 = zero scheduling overhead).",
+            ("lane",)),
     )
     crypto = CryptoMetrics(
         batch_verify_seconds=r.histogram(

@@ -204,6 +204,11 @@ class Node:
             # it already on leaves it alone in stop() too
             self._enabled_tracing = not tracer.enabled
             tracer.enable(config.instrumentation.tracing_buffer_size)
+            if self._enabled_tracing and config.instrumentation.clock_skew_s:
+                # only the enabling owner may skew the process-global
+                # tracer (in-process localnets share it; per-node skew
+                # there comes from the per-instance Timeline instead)
+                tracer.set_skew(config.instrumentation.clock_skew_s)
         # runtime lock-discipline checker ([instrumentation] lockdep):
         # enabled HERE, before any subsystem constructs its locks, so
         # the whole threaded stack below gets wrapped primitives. Same
@@ -224,6 +229,16 @@ class Node:
             from ..tools import detcheck
 
             detcheck.set_metrics(self.metrics.determinism)
+
+        # exec-lane flight recorder ([instrumentation] flight_recorder):
+        # process-global bounded rings, default-on (structurally free at
+        # parallel_lanes=1 — the threaded exec path never runs); the
+        # metrics sink rides on BlockExecutor, this only sizes/arms it
+        from ..state import parallel as _parallel
+
+        _parallel.get_flight_recorder().configure(
+            enabled=config.instrumentation.flight_recorder,
+            samples=config.instrumentation.flight_recorder_samples)
 
         # --- storage (node/node.go:162-171) --------------------------
         # crash-consistency fault engine ([storage] fault_plan, ours):
@@ -378,6 +393,12 @@ class Node:
             if config.instrumentation.timeline_heights > 0:
                 self.consensus_state.timeline.enable(
                     config.instrumentation.timeline_heights)
+            if config.instrumentation.clock_skew_s:
+                # synthetic skew (chaos/fleettrace testing): marks and
+                # /debug/clock shift together so offset recovery sees a
+                # consistent per-node clock
+                self.consensus_state.timeline.set_skew(
+                    config.instrumentation.clock_skew_s)
             # while state sync runs, consensus must stay parked
             # (fast_sync mode) and the blockchain pool must NOT start at
             # height 1 — resume_fast_sync re-arms it at the restored
@@ -838,9 +859,25 @@ class Node:
                 "/debug/lockdep": lambda q: self._lockdep_status(),
                 "/debug/recovery": lambda q: self._recovery_status(),
                 "/debug/determinism": lambda q: self._determinism_status(),
+                "/debug/exec": lambda q: self._exec_status(),
             },
+            identity={"node_id": self.node_key.id,
+                      "moniker": self.config.base.moniker},
+            clock_skew_s=self.config.instrumentation.clock_skew_s,
         )
         self._prof_server.start()
+
+    def _exec_status(self) -> dict:
+        """/debug/exec: the exec-lane flight recorder report plus the
+        executor's configured lane count — empty-but-stable shape on a
+        lanes=1 or replica node (the threaded path never runs there)."""
+        from ..state import parallel as par
+
+        report = par.get_flight_recorder().report()
+        report["parallel_lanes"] = (
+            self.block_exec.exec_config.parallel_lanes
+            if self.block_exec is not None else 1)
+        return report
 
     def _consensus_status(self) -> dict:
         """/debug/consensus: the watchdog bundle on a full node; a

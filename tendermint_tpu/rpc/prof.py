@@ -11,6 +11,10 @@ Serves:
 - /debug/timeline?height=N block-lifecycle record for one height
                            (libs/timeline.py marks stitched with the
                            tracer spans tagged height=N)
+- /debug/clock             wall + monotonic timestamps and the node's
+                           identity — the echo half of fleettrace's
+                           NTP-style RTT-symmetric offset probe
+                           (tools/fleettrace.py)
 - plus any `providers` routes the node mounts: /debug/consensus (the
   stall watchdog's diagnostic bundle), /debug/statesync (snapshot
   inventory, chunk counters, and live restore progress), /debug/abci
@@ -28,6 +32,7 @@ import json
 import pstats
 import sys
 import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
@@ -41,11 +46,18 @@ class ProfServer:
     def __init__(self, host: str, port: int,
                  tracer: Optional[tracing.Tracer] = None,
                  timeline: Optional[timeline_mod.Timeline] = None,
-                 providers: Optional[Dict[str, Callable]] = None):
+                 providers: Optional[Dict[str, Callable]] = None,
+                 identity: Optional[dict] = None,
+                 clock_skew_s: float = 0.0):
         """`timeline` is the node's per-instance lifecycle recorder
         (falls back to the process-global one for standalone servers);
         `providers` maps a path (e.g. "/debug/consensus") to a
-        callable(query_params: dict) -> JSON-able object."""
+        callable(query_params: dict) -> JSON-able object. `identity`
+        (node_id/moniker) is echoed at /debug/clock so fleettrace can
+        map scrape endpoints to p2p peer ids; `clock_skew_s` offsets the
+        wall timestamp there — a test/chaos knob matching
+        Timeline.set_skew, so in-process localnets present genuinely
+        skewed clocks for offset-recovery to find."""
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         # the handler reaches the tracer through the server instance
@@ -53,6 +65,8 @@ class ProfServer:
         self._httpd.timeline = (timeline if timeline is not None
                                 else timeline_mod.get_timeline())
         self._httpd.providers = dict(providers or {})
+        self._httpd.identity = dict(identity or {})
+        self._httpd.clock_skew_s = float(clock_skew_s)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -131,7 +145,8 @@ class _Handler(BaseHTTPRequestHandler):
             extra = "".join(f" {p.rsplit('/', 1)[-1]}"
                             for p in sorted(self.server.providers))
             self._text(
-                f"profiles: goroutine heap profile trace timeline{extra}\n")
+                f"profiles: goroutine heap profile trace timeline"
+                f" clock{extra}\n")
         elif path == "/debug/pprof/goroutine":
             self._text(_thread_dump())
         elif path == "/debug/pprof/heap":
@@ -155,6 +170,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(body, content_type="application/json")
         elif path == "/debug/timeline":
             self._serve_timeline(dict(parse_qsl(parsed.query)))
+        elif path == "/debug/clock":
+            # the echo half of the fleettrace offset probe: the caller
+            # brackets this request with its own monotonic clock and
+            # treats wall_s as sampled at the request midpoint (NTP
+            # midpoint estimate); mono_ns lets it detect server-side
+            # wall-clock steps between probes
+            self._json({
+                "wall_s": time.time() + self.server.clock_skew_s,
+                "mono_ns": time.monotonic_ns(),
+                "identity": self.server.identity,
+            })
         elif path in self.server.providers:
             q = dict(parse_qsl(parsed.query))
             try:
@@ -172,8 +198,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_timeline(self, q: dict) -> None:
         """One height's lifecycle record, stitched with the tracer spans
-        tagged with that height."""
+        tagged with that height; ?list=1 enumerates recorded heights
+        (the fleettrace collector's common-height discovery)."""
         tl: timeline_mod.Timeline = self.server.timeline
+        if q.get("list"):
+            self._json({"heights": tl.heights(),
+                        "latest": tl.latest_height()})
+            return
         try:
             height = int(q.get("height", 0))
         except ValueError:

@@ -191,6 +191,13 @@ class BlockExecutor:
         # the commit-path profiler: shared with ConsensusState (wal
         # stage) and the node's IndexerService (index stage)
         self.stage_profile = CommitStageProfile(self.metrics)
+        # exec-lane flight recorder: process-global (state/parallel.py);
+        # the executor only hands it a metrics sink when the parallel
+        # path can actually run, so a lanes=1 node never touches it
+        if self.exec_config.parallel_lanes > 1:
+            from . import parallel as par
+
+            par.get_flight_recorder().set_metrics(self.metrics)
         # speculation slot: written by the consensus thread, the worker
         # thread only fills its own slot object (state/parallel.py)
         self._spec_lock = threading.Lock()
@@ -215,6 +222,13 @@ class BlockExecutor:
             slot.abandon()
         for t in threads:
             t.join(timeout=10)
+        # uninstall only OUR metrics sink from the process-global flight
+        # recorder (same identity contract as crypto_batch.set_metrics)
+        from . import parallel as par
+
+        rec = par.get_flight_recorder()
+        if rec.get_metrics() is self.metrics:
+            rec.set_metrics(None)
 
     def validate_block(self, state: State, block: Block,
                        decided: bool = False) -> None:

@@ -44,11 +44,184 @@ later one's stale versions (index filtering hides them).
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
-from typing import Callable, List, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 LOG = logging.getLogger("state.parallel")
+
+
+# --- exec-lane flight recorder ---------------------------------------
+
+
+class FlightRecorder:
+    """Per-lane ring buffer of exec-lane scheduling samples.
+
+    PR 13's stage profiler showed ~0.15ms/tx of the parallel path is
+    thread-WAKEUP convoy, not execution — this recorder makes that a
+    live, per-lane attribution instead of a number in a PR description.
+    Each threaded `_run_segment` lane contributes one sample at exit:
+    (wakeup latency = spawn→first instruction, busy span, txs, groups),
+    stamped with `time.monotonic_ns()` (never the wall clock — this
+    file is inside the determinism gate's consensus scope). `run_block`
+    adds one per-block outcome row (conflicts, serial fallback).
+
+    Zero overhead at `parallel_lanes=1` is structural: the serial
+    dispatch path never calls run_block, and _run_segment's inline
+    n_workers==1 branch is not instrumented. One process-global
+    instance (`get_flight_recorder()`), exported at /debug/exec and —
+    when a metrics sink is installed — as the
+    exec_lane_wakeup_seconds / exec_lane_busy_ratio{lane} families."""
+
+    DEFAULT_SAMPLES = 512
+
+    def __init__(self, samples: int = DEFAULT_SAMPLES):
+        self._lock = threading.Lock()
+        self._capacity = max(1, samples)
+        self._enabled = True
+        # lane -> ring of {"wakeup_ns", "busy_ns", "txs", "groups"}
+        self._lanes: Dict[int, collections.deque] = {}
+        self._blocks: collections.deque = collections.deque(
+            maxlen=self._capacity)
+        self._block_count = 0
+        self._conflict_txs = 0
+        self._serial_fallbacks = 0
+        self._metrics = None  # StateMetrics sink or None
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    def configure(self, enabled: Optional[bool] = None,
+                  samples: Optional[int] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+            if samples is not None and samples > 0:
+                self._capacity = samples
+                for lane, ring in list(self._lanes.items()):
+                    self._lanes[lane] = collections.deque(
+                        ring, maxlen=samples)
+                self._blocks = collections.deque(
+                    self._blocks, maxlen=samples)
+
+    def set_metrics(self, sink) -> None:
+        """Install/clear the StateMetrics sink (same install-by-identity
+        contract as crypto_batch.set_metrics: the owner uninstalls only
+        its own sink on stop)."""
+        self._metrics = sink
+
+    def get_metrics(self):
+        return self._metrics
+
+    def reset(self) -> None:
+        with self._lock:
+            self._lanes.clear()
+            self._blocks.clear()
+            self._block_count = 0
+            self._conflict_txs = 0
+            self._serial_fallbacks = 0
+
+    # -- recording (threaded exec path only) ---------------------------
+
+    def record_lane(self, lane: int, wakeup_ns: int, busy_ns: int,
+                    txs: int, groups: int) -> None:
+        """One lane lifetime: spawn→first-instruction latency plus the
+        busy span draining the group cursor."""
+        wakeup_ns = max(0, wakeup_ns)
+        busy_ns = max(0, busy_ns)
+        with self._lock:
+            ring = self._lanes.get(lane)
+            if ring is None:
+                ring = self._lanes[lane] = collections.deque(
+                    maxlen=self._capacity)
+            ring.append({"wakeup_ns": wakeup_ns, "busy_ns": busy_ns,
+                         "txs": txs, "groups": groups})
+        m = self._metrics
+        if m is not None:
+            m.exec_lane_wakeup.observe(wakeup_ns / 1e9)
+            life = wakeup_ns + busy_ns
+            if life > 0:
+                m.exec_lane_busy.with_labels(str(lane)).set(
+                    busy_ns / life)
+
+    def note_block(self, txs: int, parallel_txs: int, conflicts: int,
+                   serial_fallback: bool, lanes: int) -> None:
+        with self._lock:
+            self._block_count += 1
+            self._conflict_txs += conflicts
+            if serial_fallback:
+                self._serial_fallbacks += 1
+            self._blocks.append({
+                "txs": txs, "parallel_txs": parallel_txs,
+                "conflicts": conflicts,
+                "serial_fallback": serial_fallback, "lanes": lanes,
+            })
+
+    # -- export --------------------------------------------------------
+
+    @staticmethod
+    def _pctl(sorted_vals: List[int], q: float) -> int:
+        if not sorted_vals:
+            return 0
+        idx = min(len(sorted_vals) - 1,
+                  max(0, int(round(q * (len(sorted_vals) - 1)))))
+        return sorted_vals[idx]
+
+    def wakeup_percentiles(self) -> Dict[str, float]:
+        """p50/p99 wakeup latency in SECONDS across all lanes (the
+        `bench.py load --parallel` BENCH-line summary)."""
+        with self._lock:
+            all_w = sorted(s["wakeup_ns"] for ring in self._lanes.values()
+                           for s in ring)
+        return {
+            "count": len(all_w),
+            "p50_s": self._pctl(all_w, 0.50) / 1e9,
+            "p99_s": self._pctl(all_w, 0.99) / 1e9,
+        }
+
+    def report(self) -> dict:
+        """The /debug/exec payload: JSON-able, schema-stable."""
+        with self._lock:
+            lanes = {}
+            for lane, ring in sorted(self._lanes.items()):
+                wake = sorted(s["wakeup_ns"] for s in ring)
+                busy = sum(s["busy_ns"] for s in ring)
+                life = busy + sum(wake)
+                lanes[str(lane)] = {
+                    "samples": len(ring),
+                    "wakeup_p50_us": round(
+                        self._pctl(wake, 0.50) / 1e3, 3),
+                    "wakeup_p99_us": round(
+                        self._pctl(wake, 0.99) / 1e3, 3),
+                    "busy_ratio": round(busy / life, 6) if life else 0.0,
+                    "txs": sum(s["txs"] for s in ring),
+                    "groups": sum(s["groups"] for s in ring),
+                }
+            blocks = {
+                "count": self._block_count,
+                "conflict_txs": self._conflict_txs,
+                "serial_fallbacks": self._serial_fallbacks,
+                "recent": list(self._blocks)[-32:],
+            }
+            enabled = self._enabled
+            capacity = self._capacity
+        return {"enabled": enabled, "capacity": capacity,
+                "lanes": lanes, "blocks": blocks}
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-global exec-lane flight recorder (always on; bounded
+    rings make that safe — configure via [instrumentation])."""
+    return _RECORDER
 
 
 # --- footprints + planning -------------------------------------------
@@ -233,9 +406,15 @@ def run_block(app, txs: Sequence[bytes], begin_req, end_req,
             responses = [app.exec_deliver_tx(session, i, tx)
                          for i, tx in enumerate(txs)]
             end_res = app.exec_end_block(session, end_req)
+            if _RECORDER.enabled:
+                _RECORDER.note_block(len(txs), plan.parallel_txs,
+                                     conflicts, True, lanes)
             return BlockRun(session, begin_res, responses, end_res,
                             conflicts, True)
         end_res = app.exec_end_block(session, end_req)
+        if _RECORDER.enabled:
+            _RECORDER.note_block(len(txs), plan.parallel_txs,
+                                 conflicts, False, lanes)
         return BlockRun(session, begin_res, responses, end_res,
                         conflicts, False)
     except BaseException:
@@ -258,25 +437,44 @@ def _run_segment(app, session, txs, seg: Segment, lanes: int,
     cursor_lock = threading.Lock()
     cursor = [0]
     errors: List[BaseException] = []
+    recorder = _RECORDER if _RECORDER.enabled else None
+    spawn_ns = [0] * n_workers
 
-    def lane():
-        while True:
-            with cursor_lock:
-                pos = cursor[0]
-                if pos >= len(groups) or errors:
+    def lane(k: int):
+        # first instruction: the spawn→here gap IS the wakeup convoy
+        # the flight recorder exists to attribute (monotonic, never
+        # wall — consensus-scope determinism rule)
+        t0 = time.monotonic_ns() if recorder is not None else 0
+        n_txs = 0
+        n_groups = 0
+        try:
+            while True:
+                with cursor_lock:
+                    pos = cursor[0]
+                    if pos >= len(groups) or errors:
+                        return
+                    cursor[0] = pos + 1
+                try:
+                    for i in groups[pos]:
+                        responses[i] = app.exec_deliver_tx(
+                            session, i, txs[i])
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errors.append(e)
                     return
-                cursor[0] = pos + 1
-            try:
-                for i in groups[pos]:
-                    responses[i] = app.exec_deliver_tx(session, i, txs[i])
-            except BaseException as e:  # noqa: BLE001 - re-raised below
-                errors.append(e)
-                return
+                n_groups += 1
+                n_txs += len(groups[pos])
+        finally:
+            if recorder is not None:
+                recorder.record_lane(
+                    k, t0 - spawn_ns[k], time.monotonic_ns() - t0,
+                    n_txs, n_groups)
 
     threads = []
     for k in range(n_workers):
-        t = threading.Thread(target=lane, name=f"exec-lane-{k}")
+        t = threading.Thread(target=lane, args=(k,),
+                             name=f"exec-lane-{k}")
         threads.append(t)
+        spawn_ns[k] = time.monotonic_ns()
         t.start()
     for t in threads:
         t.join()

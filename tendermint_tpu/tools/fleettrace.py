@@ -1,0 +1,611 @@
+"""Fleet-level causal tracing (no reference equivalent).
+
+Every node already exports its own story: per-height lifecycle marks
+with per-peer delivery attribution (/debug/timeline, libs/timeline.py),
+ring-buffered spans (/debug/trace, libs/tracing.py), the commit-stage
+profile (/metrics) and the exec-lane flight recorder (/debug/exec).
+What no single node can answer is *where a block's time went across the
+fleet* — this module stitches the per-node stories into one picture,
+purely by scraping; there are no wire-protocol changes.
+
+Three parts:
+
+1. **Clock-offset estimation** — per-height marks are wall-clock stamps
+   on N independent clocks. `probe_offset` runs an NTP-style
+   RTT-symmetric probe against each node's /debug/clock (ProfServer):
+   bracket the request with local wall stamps t0/t1, treat the echoed
+   remote wall as sampled at the midpoint, offset = remote − midpoint,
+   uncertainty = RTT/2; the best (min-RTT) of K probes wins. Offsets
+   are against the COLLECTOR's clock, which becomes the fleet's
+   reference clock: a node mark at remote time t rebases to t − offset.
+
+2. **Propagation stitching** — `stitch_height` reconstructs, per
+   height, the proposal's propagation tree (who proposed via the
+   proposer-only `proposal_emit` mark, which peer delivered the
+   proposal to whom via each mark's `peer_id`, hop depth by walking
+   parents) and per-validator vote-delivery latency (straggler
+   ranking), plus a fleet stage waterfall on the proposer-clock spine
+   (proposal_build → gossip first/last delivery → prevote quorum →
+   precommit quorum → commit → apply) with each node's commit_stage
+   breakdown spliced in. A stage is *attributed* only when both of its
+   boundary marks exist; anything else is honest "unaccounted" time —
+   the acceptance oracle (≥95% attributed) fails on mark loss, not
+   just on wild clocks.
+
+3. **Export** — Chrome-trace JSON (one track per node on the rebased
+   fleet clock), a JSONL history (one stitched height per line), and a
+   text summary (also rendered by tools/monitor.py --history runs).
+
+The collector is read-only and pull-based: a node that is never
+scraped does zero extra work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence
+
+# the proposer-clock spine: consecutive (stage_name, boundary_mark)
+# pairs; a stage covers [previous boundary, its boundary] and is
+# attributed only when both ends are present. fleet_* boundaries come
+# from OTHER nodes' rebased marks, the rest from the proposer's own
+# clock (strictly causal on one clock).
+WATERFALL = (
+    ("proposal_build", "proposal_emit"),
+    ("gossip_first_delivery", "fleet_first_delivery"),
+    ("gossip_last_delivery", "fleet_last_delivery"),
+    ("prevote_quorum", "prevote_23"),
+    ("precommit_quorum", "precommit_23"),
+    ("commit", "commit"),
+    ("apply", "apply_block"),
+)
+
+
+# --- clock-offset estimation -----------------------------------------
+
+
+def probe_offset(clock_fn: Callable[[], dict], repeats: int = 5,
+                 now_fn: Callable[[], float] = time.time,
+                 spacing_s: float = 0.0,
+                 good_rtt_s: float = 0.0) -> dict:
+    """NTP-style offset of one remote clock vs ours. `clock_fn` fetches
+    the node's /debug/clock payload; the min-RTT probe of `repeats`
+    wins (least queueing noise — the estimate error is bounded by the
+    winning probe's RTT/2, reported as uncertainty_s). offset_s > 0
+    means the remote clock is AHEAD of ours; a remote mark t rebases to
+    t - offset_s. `spacing_s` sleeps between probes so repeats sample
+    different scheduler/GIL phases on a busy host; `good_rtt_s` > 0
+    stops early once a probe that crisp lands."""
+    best: Optional[dict] = None
+    identity: dict = {}
+    for i in range(max(1, repeats)):
+        if i and spacing_s > 0:
+            time.sleep(spacing_s)
+        t0 = now_fn()
+        payload = clock_fn()
+        t1 = now_fn()
+        identity = payload.get("identity", identity) or identity
+        rtt = max(0.0, t1 - t0)
+        est = {
+            "offset_s": payload["wall_s"] - (t0 + t1) / 2.0,
+            "uncertainty_s": rtt / 2.0,
+            "rtt_s": rtt,
+        }
+        if best is None or est["rtt_s"] < best["rtt_s"]:
+            best = est
+        if good_rtt_s > 0 and best["rtt_s"] <= good_rtt_s:
+            break
+    assert best is not None
+    best["identity"] = identity
+    best["probes"] = i + 1
+    return best
+
+
+# --- per-height stitching --------------------------------------------
+
+
+def _rebased(node: dict, phase: str) -> Optional[float]:
+    m = node["timeline"]["marks"].get(phase)
+    if m is None:
+        return None
+    return m["t"] - node.get("offset_s", 0.0)
+
+
+def _proposer_of(nodes: Sequence[dict]) -> Optional[dict]:
+    """proposal_emit is dropped only by the proposer; fall back to the
+    self-delivered proposal (peer_id == "") for pre-PR-16 records."""
+    for n in nodes:
+        if "proposal_emit" in n["timeline"]["marks"]:
+            return n
+    for n in nodes:
+        m = n["timeline"]["marks"].get("proposal_received")
+        if m is not None and not m.get("peer_id"):
+            return n
+    return None
+
+
+def _propagation_tree(nodes: Sequence[dict], proposer: dict) -> dict:
+    """Delivery edges from each node's proposal_received peer_id; hop
+    depth by walking parents (proposer = hop 0). An edge whose parent
+    peer id is not a scraped node still counts as one hop from an
+    unknown relay."""
+    by_peer = {n.get("node_id", ""): n for n in nodes if n.get("node_id")}
+    parent: Dict[str, Optional[str]] = {}
+    deliver_t: Dict[str, Optional[float]] = {}
+    for n in nodes:
+        name = n["name"]
+        if n is proposer:
+            parent[name] = None
+            deliver_t[name] = _rebased(n, "proposal_emit")
+            continue
+        m = n["timeline"]["marks"].get("proposal_received")
+        if m is None:
+            parent[name] = None
+            deliver_t[name] = None
+            continue
+        src = by_peer.get(m.get("peer_id", ""))
+        parent[name] = src["name"] if src is not None else "?"
+        deliver_t[name] = _rebased(n, "proposal_received")
+
+    def hop(name: str, seen=None) -> int:
+        seen = seen or set()
+        p = parent.get(name)
+        if p is None:
+            return 0 if name == proposer["name"] else -1
+        if p == "?" or p in seen:
+            return 1
+        seen.add(name)
+        up = hop(p, seen)
+        return up + 1 if up >= 0 else 1
+
+    edges = [
+        {"to": n["name"], "from": parent[n["name"]],
+         "hop": hop(n["name"]),
+         "t_s": deliver_t[n["name"]]}
+        for n in nodes if n is not proposer
+    ]
+    return {
+        "proposer": proposer["name"],
+        "edges": sorted(edges, key=lambda e: (e["t_s"] is None,
+                                              e["t_s"] or 0.0)),
+        "max_hop": max((e["hop"] for e in edges), default=0),
+    }
+
+
+def _vote_latency(nodes: Sequence[dict], proposer: dict,
+                  kind: str = "prevote") -> List[dict]:
+    """Per-validator first-seen vote latency vs proposal_emit, earliest
+    sighting across the fleet: the straggler ranking Handel-style
+    gossip scoring needs (slowest validator first)."""
+    t0 = _rebased(proposer, "proposal_emit")
+    if t0 is None:
+        t0 = _rebased(proposer, "new_height")
+    first: Dict[int, float] = {}
+    for n in nodes:
+        off = n.get("offset_s", 0.0)
+        for idx, m in (n["timeline"].get("votes", {})
+                       .get(kind, {}) or {}).items():
+            t = m["t"] - off
+            i = int(idx)
+            if i not in first or t < first[i]:
+                first[i] = t
+    out = [
+        {"validator_index": i,
+         "latency_s": round(t - t0, 6) if t0 is not None else None}
+        for i, t in first.items()
+    ]
+    out.sort(key=lambda v: -(v["latency_s"] or 0.0))
+    return out
+
+
+def stitch_height(height: int, nodes: Sequence[dict]) -> Optional[dict]:
+    """One stitched record: propagation tree + stage waterfall + vote
+    stragglers + round churn, all on the collector's reference clock.
+
+    Each `nodes` entry: {"name", "node_id", "offset_s",
+    "uncertainty_s", "timeline": /debug/timeline record,
+    "commit_stages": optional {stage: {...}} splice}."""
+    nodes = [n for n in nodes if n.get("timeline")]
+    if not nodes:
+        return None
+    proposer = _proposer_of(nodes)
+    if proposer is None:
+        return None
+
+    tree = _propagation_tree(nodes, proposer)
+
+    # -- waterfall boundaries (see WATERFALL): proposer-clock spine
+    # with the fleet's delivery envelope spliced between emit and the
+    # proposer's prevote quorum
+    deliveries = [t for t in (e["t_s"] for e in tree["edges"])
+                  if t is not None]
+    fleet_marks = {
+        "fleet_first_delivery": min(deliveries) if deliveries else None,
+        "fleet_last_delivery": max(deliveries) if deliveries else None,
+    }
+
+    def boundary(mark: str) -> Optional[float]:
+        if mark in fleet_marks:
+            return fleet_marks[mark]
+        return _rebased(proposer, mark)
+
+    t_start = boundary("new_height")
+    t_end = boundary("apply_block")
+    if t_start is not None and t_end is not None and t_end > t_start:
+        span = t_end - t_start
+        stages, _unacc = _strict_stages(
+            t_start, [(n, boundary(m)) for n, m in WATERFALL])
+        attributed = sum(s["dur_s"] for s in stages)
+        coverage = min(1.0, attributed / span) if span > 0 else 0.0
+        waterfall = {
+            "span_s": round(span, 6),
+            "stages": stages,
+            "attributed_s": round(attributed, 6),
+            "unaccounted_s": round(max(0.0, span - attributed), 6),
+            "coverage": round(coverage, 6),
+        }
+    else:
+        waterfall = {"span_s": 0.0, "stages": [], "attributed_s": 0.0,
+                     "unaccounted_s": 0.0, "coverage": 0.0}
+
+    rounds = {
+        n["name"]: {
+            "max_round": n["timeline"].get("max_round", 0),
+            "rounds_seen": n["timeline"].get("rounds_seen", []),
+            "re_entries": n["timeline"].get("re_entries", 0),
+        }
+        for n in nodes
+    }
+    commit_stages = {
+        n["name"]: n["commit_stages"]
+        for n in nodes if n.get("commit_stages")
+    }
+    return {
+        "height": height,
+        "reference": "collector",
+        "t0_s": t_start,
+        "offsets": {
+            n["name"]: {"offset_s": round(n.get("offset_s", 0.0), 9),
+                        "uncertainty_s": round(
+                            n.get("uncertainty_s", 0.0), 9)}
+            for n in nodes
+        },
+        "tree": tree,
+        "waterfall": waterfall,
+        "stragglers": _vote_latency(nodes, proposer)[:8],
+        "rounds": rounds,
+        "round_churn": any(r["re_entries"] or r["max_round"]
+                           for r in rounds.values()),
+        "commit_stages": commit_stages,
+    }
+
+
+def _strict_stages(t_start, named_boundaries):
+    """Stage walk where an interval bordered by ANY missing boundary is
+    unaccounted: consecutive present boundaries that are also adjacent
+    in the spec become stages, everything else is a gap."""
+    stages: List[dict] = []
+    unaccounted = 0.0
+    cursor = t_start
+    last_idx = -1  # index into WATERFALL of the last present boundary
+    for idx, (name, t) in enumerate(named_boundaries):
+        if t is None:
+            continue
+        dur = max(0.0, t - cursor)
+        if idx == last_idx + 1:
+            stages.append({"stage": name,
+                           "start_s": round(cursor - t_start, 6),
+                           "dur_s": round(dur, 6)})
+        else:
+            unaccounted += dur
+        cursor = max(cursor, t)
+        last_idx = idx
+    return stages, unaccounted
+
+
+# --- exports ----------------------------------------------------------
+
+
+def chrome_trace(stitched: Sequence[dict],
+                 nodes: Sequence[dict]) -> dict:
+    """Chrome trace-event JSON: one pid per fleet, one tid per node,
+    every timestamp rebased onto the collector clock. Load next to a
+    single node's /debug/trace dump to line local spans up with the
+    fleet waterfall."""
+    tids = {n["name"]: i + 1 for i, n in enumerate(nodes)}
+    events: List[dict] = [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+         "args": {"name": name}}
+        for name, tid in tids.items()
+    ]
+    for rec in stitched:
+        prop_tid = tids.get(rec["tree"]["proposer"], 0)
+        t0 = rec.get("t0_s")
+        if t0 is None:
+            continue
+        base_us = t0 * 1e6
+        for s in rec["waterfall"]["stages"]:
+            events.append({
+                "name": f"h{rec['height']}:{s['stage']}",
+                "cat": "fleet", "ph": "X",
+                "ts": base_us + s["start_s"] * 1e6,
+                "dur": max(s["dur_s"] * 1e6, 1.0),
+                "pid": 1, "tid": prop_tid,
+                "args": {"height": rec["height"]},
+            })
+        for e in rec["tree"]["edges"]:
+            if e["t_s"] is None:
+                continue
+            events.append({
+                "name": f"h{rec['height']}:delivery",
+                "cat": "gossip", "ph": "i", "s": "t",
+                "ts": e["t_s"] * 1e6,
+                "pid": 1, "tid": tids.get(e["to"], 0),
+                "args": {"from": e["from"], "hop": e["hop"]},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summarize(rec: dict) -> str:
+    """One stitched height as a compact text block (the monitor's
+    fleettrace rendering)."""
+    w = rec["waterfall"]
+    lines = [
+        f"height {rec['height']}: proposer={rec['tree']['proposer']} "
+        f"span={w['span_s'] * 1e3:.1f}ms "
+        f"coverage={w['coverage'] * 100:.1f}% "
+        f"max_hop={rec['tree']['max_hop']}"
+        + (" ROUND-CHURN" if rec.get("round_churn") else "")
+    ]
+    for s in w["stages"]:
+        lines.append(f"  {s['stage']:<22} {s['dur_s'] * 1e3:9.2f}ms")
+    if w["unaccounted_s"]:
+        lines.append(f"  {'(unaccounted)':<22} "
+                     f"{w['unaccounted_s'] * 1e3:9.2f}ms")
+    for e in rec["tree"]["edges"]:
+        lines.append(
+            f"  deliver -> {e['to']} via {e['from']} hop={e['hop']}")
+    strag = [v for v in rec.get("stragglers", [])
+             if v.get("latency_s") is not None][:3]
+    if strag:
+        lines.append("  slowest validators: " + ", ".join(
+            f"v{v['validator_index']}+{v['latency_s'] * 1e3:.1f}ms"
+            for v in strag))
+    return "\n".join(lines)
+
+
+# --- the collector ----------------------------------------------------
+
+
+def _http_get_json(url: str, timeout: float = 5.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # prof debug routes answer errors as JSON bodies (e.g. a
+        # timeline 404 lists the heights it DOES have) — surface them
+        body = e.read().decode()
+        try:
+            return json.loads(body)
+        except ValueError:
+            raise e from None
+
+
+def _http_get_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def parse_commit_stages(metrics_body: str,
+                        namespace: str = "tendermint") -> dict:
+    """Pull the per-stage commit profile out of a Prometheus exposition
+    body: {stage: {"count": n, "total_s": s}}."""
+    out: Dict[str, dict] = {}
+    for suffix, key in (("_sum", "total_s"), ("_count", "count")):
+        needle = f"{namespace}_commit_stage_seconds{suffix}{{"
+        for line in metrics_body.splitlines():
+            if not line.startswith(needle):
+                continue
+            rest = line[len(needle):]
+            try:
+                labels, val = rest.split("}", 1)
+                stage = dict(
+                    kv.split("=", 1)
+                    for kv in labels.split(","))["stage"].strip('"')
+                out.setdefault(stage, {})[key] = float(val)
+            except (ValueError, KeyError):
+                continue
+    return out
+
+
+class FleetTrace:
+    """Scrape-and-stitch collector over N prof endpoints.
+
+    `endpoints` are ProfServer addresses ("host:port"). `fetch_json` /
+    `fetch_text` are injectable for tests; production uses urllib
+    against http://addr/path. The collector is stateless between
+    `collect()` calls except the JSONL history sink."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 probes: int = 5,
+                 probe_spacing_s: float = 0.0,
+                 probe_good_rtt_s: float = 0.0,
+                 namespace: str = "tendermint",
+                 fetch_json: Callable = _http_get_json,
+                 fetch_text: Callable = _http_get_text,
+                 scrape_metrics: Optional[Dict[str, str]] = None,
+                 history_path: Optional[str] = None):
+        self.endpoints = list(endpoints)
+        self.probes = probes
+        self.probe_spacing_s = probe_spacing_s
+        self.probe_good_rtt_s = probe_good_rtt_s
+        self.namespace = namespace
+        self._fetch_json = fetch_json
+        self._fetch_text = fetch_text
+        # optional prof-endpoint -> prometheus-endpoint map for the
+        # commit_stage splice (the two listeners are separate servers)
+        self.scrape_metrics = dict(scrape_metrics or {})
+        self.history_path = history_path
+
+    # -- scraping ------------------------------------------------------
+
+    def probe_all(self) -> Dict[str, dict]:
+        """Offset estimate per endpoint (collector clock reference)."""
+        out = {}
+        for ep in self.endpoints:
+            try:
+                out[ep] = probe_offset(
+                    lambda ep=ep: self._fetch_json(
+                        f"http://{ep}/debug/clock"),
+                    repeats=self.probes,
+                    spacing_s=self.probe_spacing_s,
+                    good_rtt_s=self.probe_good_rtt_s)
+            except Exception as e:  # noqa: BLE001 - skip dead nodes
+                out[ep] = {"error": str(e)}
+        return out
+
+    def _node_snapshot(self, ep: str, probe: dict,
+                       height: int) -> Optional[dict]:
+        if "error" in probe:
+            return None
+        try:
+            tl = self._fetch_json(
+                f"http://{ep}/debug/timeline?height={height}")
+        except Exception:  # noqa: BLE001 - node may lack the height
+            return None
+        if not isinstance(tl, dict) or "marks" not in tl:
+            return None
+        snap = {
+            "name": ep,
+            "node_id": probe.get("identity", {}).get("node_id", ""),
+            "offset_s": probe["offset_s"],
+            "uncertainty_s": probe["uncertainty_s"],
+            "timeline": tl,
+        }
+        mep = self.scrape_metrics.get(ep)
+        if mep:
+            try:
+                snap["commit_stages"] = parse_commit_stages(
+                    self._fetch_text(f"http://{mep}/metrics"),
+                    self.namespace)
+            except Exception:  # noqa: BLE001 - splice is best-effort
+                pass
+        return snap
+
+    def heights(self, last: int = 4) -> List[int]:
+        """Heights present on EVERY reachable node (stitching needs the
+        full fleet's view of a height)."""
+        per_node: List[set] = []
+        for ep in self.endpoints:
+            try:
+                tl = self._fetch_json(
+                    f"http://{ep}/debug/timeline?list=1")
+                per_node.append(set(tl.get("heights", [])))
+            except Exception:  # noqa: BLE001
+                continue
+        if not per_node:
+            return []
+        common = set.intersection(*per_node)
+        return sorted(common)[-last:]
+
+    def collect(self, heights: Optional[Sequence[int]] = None,
+                last: int = 4) -> dict:
+        """One full pass: probe offsets, scrape timelines, stitch every
+        requested (default: common) height; append to the JSONL
+        history when configured."""
+        probes = self.probe_all()
+        if heights is None:
+            heights = self.heights(last=last)
+        stitched = []
+        node_lists: Dict[int, List[dict]] = {}
+        for h in heights:
+            nodes = [s for s in
+                     (self._node_snapshot(ep, probes[ep], h)
+                      for ep in self.endpoints) if s is not None]
+            node_lists[h] = nodes
+            rec = stitch_height(h, nodes)
+            if rec is not None:
+                stitched.append(rec)
+        exec_reports = {}
+        for ep in self.endpoints:
+            try:
+                exec_reports[ep] = self._fetch_json(
+                    f"http://{ep}/debug/exec")
+            except Exception:  # noqa: BLE001 - older nodes lack it
+                continue
+        result = {
+            "probes": probes,
+            "heights": list(heights),
+            "stitched": stitched,
+            "exec": exec_reports,
+        }
+        if self.history_path:
+            with open(self.history_path, "a") as f:
+                for rec in stitched:
+                    f.write(json.dumps(rec, separators=(",", ":"),
+                                       default=str) + "\n")
+        # keep the raw node snapshots available to chrome_trace callers
+        result["_nodes"] = (node_lists[heights[-1]]
+                            if heights else [])
+        return result
+
+
+# --- CLI --------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleettrace",
+        description="Stitch N nodes' /debug/timeline into one "
+                    "fleet-level causal trace.")
+    p.add_argument("endpoints", nargs="+",
+                   help="prof endpoints (host:port)")
+    p.add_argument("--heights", type=int, default=4,
+                   help="stitch the last N common heights")
+    p.add_argument("--probes", type=int, default=5,
+                   help="clock probes per node (min-RTT wins)")
+    p.add_argument("--chrome", metavar="PATH",
+                   help="write a Chrome trace JSON here")
+    p.add_argument("--jsonl", metavar="PATH",
+                   help="append stitched records here as JSONL")
+    p.add_argument("--metrics", action="append", default=[],
+                   metavar="PROF=PROM",
+                   help="prometheus endpoint for a prof endpoint "
+                        "(commit-stage splice)")
+    p.add_argument("--namespace", default="tendermint")
+    args = p.parse_args(argv)
+
+    scrape = {}
+    for m in args.metrics:
+        prof_ep, _, prom_ep = m.partition("=")
+        if prom_ep:
+            scrape[prof_ep] = prom_ep
+    ft = FleetTrace(args.endpoints, probes=args.probes,
+                    namespace=args.namespace, scrape_metrics=scrape,
+                    history_path=args.jsonl)
+    result = ft.collect(last=args.heights)
+    for ep, pr in result["probes"].items():
+        if "error" in pr:
+            print(f"{ep}: UNREACHABLE ({pr['error']})")
+        else:
+            print(f"{ep}: offset {pr['offset_s'] * 1e3:+.3f}ms "
+                  f"± {pr['uncertainty_s'] * 1e3:.3f}ms "
+                  f"(rtt {pr['rtt_s'] * 1e3:.3f}ms)")
+    for rec in result["stitched"]:
+        print(summarize(rec))
+    if args.chrome:
+        nodes = result.get("_nodes", [])
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(result["stitched"], nodes), f,
+                      separators=(",", ":"))
+        print(f"chrome trace -> {args.chrome}")
+    return 0 if result["stitched"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -413,10 +413,16 @@ class Monitor:
     auto-reconnecting websockets."""
 
     def __init__(self, addrs: List[str], poll_interval: float = 1.0,
-                 debug_addrs: Optional[List[str]] = None):
+                 debug_addrs: Optional[List[str]] = None,
+                 history_path: Optional[str] = None,
+                 fleettrace: bool = False):
         """`debug_addrs` pairs index-wise with `addrs`: each entry is
         that node's ProfServer host:port (prof_laddr), scraped for
-        /debug/consensus every poll; None/"" entries are skipped."""
+        /debug/consensus every poll; None/"" entries are skipped.
+        `history_path` appends one JSONL line per poll (the offline
+        record fleet/chaos runs analyze after the fact); `fleettrace`
+        additionally runs the tools/fleettrace.py collector over the
+        debug endpoints each poll and includes its stitched heights."""
         self.nodes: Dict[str, NodeStatus] = {
             a: NodeStatus(addr=a) for a in addrs
         }
@@ -426,6 +432,14 @@ class Monitor:
                 if d:
                     self.debug_addrs[a] = d
         self.poll_interval = poll_interval
+        self.history_path = history_path
+        self._fleet = None
+        if fleettrace and self.debug_addrs:
+            from . import fleettrace as fleettrace_mod
+
+            self._fleet = fleettrace_mod.FleetTrace(
+                list(self.debug_addrs.values()))
+        self.last_fleet: List[dict] = []
         self._ws: Dict[str, ReconnectingWSClient] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -438,6 +452,11 @@ class Monitor:
             )
             t.start()
             self._threads.append(t)
+        if self.history_path or self._fleet is not None:
+            t = threading.Thread(target=self._history_loop, daemon=True,
+                                 name="monitor-history")
+            t.start()
+            self._threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
@@ -445,9 +464,16 @@ class Monitor:
             ws.close()
 
     def _watch_node(self, addr: str) -> None:
+        import random
+
         ns = self.nodes[addr]
         client = HTTPClient(addr, timeout=2.0)
         ws: Optional[ReconnectingWSClient] = None
+        # per-node tick jitter (±15%): N identical poll loops started
+        # together otherwise phase-lock into synchronized scrape spikes
+        # against every node at once. Seeded per addr only so restarts
+        # of the same monitor stay spread the same way.
+        rng = random.Random(addr)
         while not self._stop.is_set():
             try:
                 st = client.status()
@@ -485,6 +511,30 @@ class Monitor:
                     self._poll_debug(ns, daddr)
                 except Exception:  # noqa: BLE001 - debug scrape optional
                     ns.clear_debug_view()
+            self._stop.wait(
+                self.poll_interval * (0.85 + 0.30 * rng.random()))
+
+    def _history_loop(self) -> None:
+        """One JSONL line per poll: the full snapshot plus — when the
+        fleettrace collector is on — the newest stitched heights. Both
+        halves are best-effort; a bad disk or an unreachable fleet
+        never kills the monitor."""
+        while not self._stop.is_set():
+            entry = {"t": time.time(), "snapshot": self.snapshot()}
+            if self._fleet is not None:
+                try:
+                    res = self._fleet.collect(last=2)
+                    entry["fleettrace"] = res["stitched"]
+                    self.last_fleet = res["stitched"]
+                except Exception as e:  # noqa: BLE001 - best-effort
+                    entry["fleettrace_error"] = str(e)
+            if self.history_path:
+                try:
+                    with open(self.history_path, "a") as f:
+                        f.write(json.dumps(entry, separators=(",", ":"),
+                                           default=str) + "\n")
+                except OSError:
+                    pass
             self._stop.wait(self.poll_interval)
 
     def _poll_debug(self, ns: NodeStatus, daddr: str) -> None:
@@ -773,10 +823,19 @@ def main(argv=None) -> int:
                    help="comma-separated host:port ProfServer endpoints "
                         "(prof_laddr), index-paired with `endpoints`; "
                         "enables /debug/consensus stall + peer-lag alerts")
+    p.add_argument("--history", metavar="PATH", default=None,
+                   help="append one JSONL snapshot per poll here "
+                        "(offline-analyzable fleet/chaos record)")
+    p.add_argument("--fleettrace", action="store_true",
+                   help="run the fleettrace collector over the debug "
+                        "endpoints each poll; stitched heights go to "
+                        "--history and a per-height summary is printed")
     args = p.parse_args(argv)
     debug = (args.debug_endpoints.split(",")
              if args.debug_endpoints else None)
-    mon = Monitor(args.endpoints.split(","), debug_addrs=debug)
+    mon = Monitor(args.endpoints.split(","), debug_addrs=debug,
+                  history_path=args.history,
+                  fleettrace=args.fleettrace)
     mon.start()
     try:
         while True:
@@ -842,6 +901,10 @@ def main(argv=None) -> int:
             for a in snap["stall_alerts"]:
                 print(f"  ALERT {a['addr']}: stall h={a.get('round_state', {}).get('height')} "
                       f"reason={a.get('reason')} dwell={a.get('dwell_s')}s")
+            if args.fleettrace and mon.last_fleet:
+                from . import fleettrace as fleettrace_mod
+
+                print(fleettrace_mod.summarize(mon.last_fleet[-1]))
     except KeyboardInterrupt:
         mon.stop()
     return 0

@@ -30,6 +30,11 @@ Catalog (run one with `python -m tendermint_tpu.tools.scenarios NAME
                            (the crash-consistency engine's end-to-end
                            oracle — see also tools/crashmatrix.py for
                            the in-process crash-point x fault matrix)
+  proptrace                fleet-tracing oracle: per-node ProfServers
+                           with injected clock skew (±0.5s); the
+                           tools/fleettrace.py collector must recover
+                           the offsets (≤10ms) and attribute ≥95% of
+                           each block's wall time to named stages
 
 The fault timeline is a pure function of the seed (see p2p/netchaos.py);
 `bench.py chaosnet` reports partition_heal's recovery latency as a
@@ -828,6 +833,90 @@ def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
                 p.kill()
         if own_tmp is not None:
             own_tmp.cleanup()
+
+
+# default injected skews (seconds) for the fleet-tracing oracle: the
+# acceptance spread is ±0.5s, far beyond anything NTP leaves behind
+PROPTRACE_SKEWS = (0.5, -0.5, 0.25, -0.25)
+
+
+@_scenario
+def proptrace(seed: int = 8, n: int = 4, heights: int = 3,
+              offset_tol_s: float = 0.010,
+              min_coverage: float = 0.95) -> dict:
+    """Fleet-tracing acceptance oracle: an n-node localnet where every
+    node's clocks (timeline marks AND /debug/clock) are skewed by a
+    known per-node offset (±0.5s), each node serving a real ProfServer.
+    tools/fleettrace.py must, over actual HTTP scrapes, (1) recover
+    every injected offset to within `offset_tol_s` on loopback and
+    (2) attribute at least `min_coverage` of each stitched block's
+    proposal→commit wall time to named waterfall stages."""
+    from ..rpc.prof import ProfServer
+    from . import fleettrace
+
+    skews = [PROPTRACE_SKEWS[i % len(PROPTRACE_SKEWS)]
+             for i in range(n)]
+    net = ChaosNet(n, seed)
+    profs: List[ProfServer] = []
+    try:
+        for i, (node, skew) in enumerate(zip(net.nodes, skews)):
+            node.cs.timeline.enable(64)
+            node.cs.timeline.set_skew(skew)
+            ps = ProfServer(
+                "127.0.0.1", 0,
+                timeline=node.cs.timeline,
+                identity={"node_id": node.id,
+                          "moniker": f"scenario-node{i}"},
+                clock_skew_s=skew)
+            ps.start()
+            profs.append(ps)
+        # timelines went live mid-flight: stitch only heights proposed
+        # AFTER every recorder was on (the fastest node may already be
+        # inside max+1, so start at max+2)
+        h_first = max(net.heights()) + 2
+        target = h_first + heights + 1
+        if not net.wait_min_height(target, WARM_TIMEOUT):
+            return _result("proptrace", seed, net, False, None, ())
+
+        eps = [ps.listen_addr for ps in profs]
+        # the localnet keeps committing while we probe: many spaced
+        # repeats + early exit on a crisp (low-RTT) probe ride out GIL
+        # convoys; the min-RTT winner's error is bounded by RTT/2
+        ft = fleettrace.FleetTrace(eps, probes=60,
+                                   probe_spacing_s=0.005,
+                                   probe_good_rtt_s=0.004)
+        probes = ft.probe_all()
+        offset_err_ms = {}
+        for ep, skew in zip(eps, skews):
+            pr = probes[ep]
+            offset_err_ms[ep] = (
+                round(abs(pr["offset_s"] - skew) * 1e3, 4)
+                if "error" not in pr else None)
+        hs = list(range(h_first, h_first + heights))
+        res = ft.collect(heights=hs)
+        stitched = res["stitched"]
+        coverages = [r["waterfall"]["coverage"] for r in stitched]
+        offsets_ok = all(e is not None and e <= offset_tol_s * 1e3
+                         for e in offset_err_ms.values())
+        coverage_ok = (len(stitched) == len(hs)
+                       and all(c >= min_coverage for c in coverages))
+        return _result(
+            "proptrace", seed, net, offsets_ok and coverage_ok, None,
+            (),
+            {"offset_error_ms": offset_err_ms,
+             "offset_tol_ms": offset_tol_s * 1e3,
+             "offsets_ok": offsets_ok,
+             "stitched_heights": [r["height"] for r in stitched],
+             "coverages": coverages,
+             "coverage_min": min(coverages) if coverages else 0.0,
+             "coverage_ok": coverage_ok,
+             "max_hop": max((r["tree"]["max_hop"] for r in stitched),
+                            default=0),
+             "summaries": [fleettrace.summarize(r) for r in stitched]})
+    finally:
+        for ps in profs:
+            ps.stop()
+        net.stop()
 
 
 # --- entry points -----------------------------------------------------

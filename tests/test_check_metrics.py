@@ -96,6 +96,41 @@ def test_check_body_flags_declared_but_never_recorded():
     cm.check_body(m.registry.render())
 
 
+def test_readme_drift_lint_both_directions():
+    """The README metric table and REQUIRED_FAMILIES must agree:
+    required families may not go undocumented, and tendermint_-prefixed
+    table rows may not name families the script no longer requires."""
+    fams = ("consensus_height", "mempool_size")
+    ok = ("| `tendermint_consensus_height` | gauge | — | height |\n"
+          "|---|---|---|---|\n"
+          "| `tendermint_mempool_size` | gauge | — | txs |\n"
+          "| `p2p_peer_send_rate_bytes` | gauge | `peer_id` | legacy |\n")
+    assert cm.check_readme_drift(ok, families=fams) == []
+
+    missing = cm.check_readme_drift(
+        "| `tendermint_consensus_height` | gauge | — | height |\n",
+        families=fams)
+    assert len(missing) == 1 and "mempool_size" in missing[0]
+
+    stale = cm.check_readme_drift(
+        ok + "| `tendermint_ghost_total` | counter | — | gone |\n",
+        families=fams)
+    assert len(stale) == 1 and "ghost_total" in stale[0]
+
+    # backticks OUTSIDE the first cell (e.g. a labels column) and
+    # separator rows never count as documented names
+    labels_only = cm.check_readme_drift(
+        "| plain text | gauge | `tendermint_consensus_height` | x |\n",
+        families=fams)
+    assert any("missing from" in p for p in labels_only)
+
+
+def test_readme_drift_real_readme_in_sync():
+    """The shipped README's metric table stays in lockstep with the
+    gate — this is the satellite's actual CI teeth."""
+    assert cm.run_readme_drift() == []
+
+
 def test_live_node_scrape_passes_strict_check():
     """The script's end-to-end path: boot a node, commit 3 blocks,
     scrape /metrics, strict-parse, assert the promised families."""

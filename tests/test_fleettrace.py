@@ -1,0 +1,552 @@
+"""Fleet-level causal tracing (tools/fleettrace.py) and the exec-lane
+flight recorder (state/parallel.py):
+
+- NTP-style clock-offset probe: min-RTT selection, uncertainty = RTT/2,
+  early exit on a crisp probe
+- golden 4-node stitch: known ±offsets, a two-hop relay, a straggler
+  validator — exact offsets, propagation edges, stage waterfall, and
+  100% attribution recovered from synthetic timeline records
+- missing-marks honesty: dropped quorum marks become unaccounted time
+  (coverage drops), never misattributed to a neighboring stage
+- commit-stage splice parsing from a Prometheus exposition body
+- chrome_trace / summarize exports
+- FleetTrace collector against injected fetchers: common-height
+  intersection, offset recovery, metrics splice, JSONL history
+- FlightRecorder unit behavior (rings, percentiles, metrics sink)
+- tier-1 provider contract: every /debug/* provider answers
+  JSON-serializable, schema-stable payloads in validator AND replica
+  modes, including /debug/exec and /debug/clock
+- monitor --history JSONL sink
+- slow: the proptrace scenario oracle end-to-end (live localnet over
+  real HTTP with ±0.5s injected skews)
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts"))
+
+from test_node import init_files, make_config
+
+from tendermint_tpu.tools import fleettrace
+
+
+# --- clock-offset probe ------------------------------------------------
+
+
+def test_probe_offset_min_rtt_wins():
+    """Three probes with RTTs 10/2/30ms and per-probe true offsets
+    0.4/0.5/0.6s: the crisp middle probe must win, so the estimate is
+    exactly its offset with uncertainty RTT/2."""
+    times = iter([0.0, 0.010, 10.0, 10.002, 20.0, 20.030])
+    clocks = iter([0.005 + 0.4, 10.001 + 0.5, 20.015 + 0.6])
+
+    def clock_fn():
+        return {"wall_s": next(clocks), "identity": {"node_id": "abc"}}
+
+    est = fleettrace.probe_offset(
+        clock_fn, repeats=3, now_fn=lambda: next(times))
+    assert est["offset_s"] == pytest.approx(0.5)
+    assert est["rtt_s"] == pytest.approx(0.002)
+    assert est["uncertainty_s"] == pytest.approx(0.001)
+    assert est["probes"] == 3
+    assert est["identity"]["node_id"] == "abc"
+
+
+def test_probe_offset_good_rtt_early_exit():
+    times = iter([0.0, 0.010, 10.0, 10.002, 20.0, 20.030])
+    clocks = iter([0.005, 10.001, 20.015])
+    est = fleettrace.probe_offset(
+        lambda: {"wall_s": next(clocks)}, repeats=5,
+        now_fn=lambda: next(times), good_rtt_s=0.005)
+    assert est["probes"] == 2  # second probe was crisp enough
+    assert est["rtt_s"] == pytest.approx(0.002)
+
+
+# --- golden stitch -----------------------------------------------------
+
+# fleet-clock truth for the golden height: proposer n0 emits at
+# T0+10ms, n1 hears it from n0 at +20ms, n2 from n1 at +30ms (hop 2),
+# n3 from n0 at +40ms; quorums at +60/+80ms, commit +90ms, apply
+# +100ms. Every node stores marks on its OWN skewed clock.
+_T0 = 100.0
+_OFFSETS = {"n0": 0.5, "n1": -0.5, "n2": 0.25, "n3": 0.0}
+
+
+def _tl(marks, votes=None):
+    return {"marks": marks, "votes": votes or {}, "max_round": 0,
+            "rounds_seen": [0], "round_entries": {"0": 1},
+            "re_entries": 0}
+
+
+def _mark(fleet_t, offset, peer_id=""):
+    return {"t": fleet_t + offset, "peer_id": peer_id}
+
+
+def _golden_nodes():
+    o0, o1, o2, o3 = (_OFFSETS[n] for n in ("n0", "n1", "n2", "n3"))
+    n0 = {
+        "name": "n0", "node_id": "id0", "offset_s": o0,
+        "uncertainty_s": 0.0005,
+        "timeline": _tl(
+            {
+                "new_height": _mark(_T0, o0),
+                "proposal_emit": _mark(_T0 + 0.010, o0),
+                "prevote_23": _mark(_T0 + 0.060, o0),
+                "precommit_23": _mark(_T0 + 0.080, o0),
+                "commit": _mark(_T0 + 0.090, o0),
+                "apply_block": _mark(_T0 + 0.100, o0),
+            },
+            votes={"prevote": {
+                "0": _mark(_T0 + 0.020, o0),
+                "1": _mark(_T0 + 0.030, o0, "id1"),
+                "2": _mark(_T0 + 0.035, o0, "id2"),
+                "3": _mark(_T0 + 0.055, o0, "id3"),
+            }}),
+    }
+    n1 = {
+        "name": "n1", "node_id": "id1", "offset_s": o1,
+        "uncertainty_s": 0.0005,
+        "timeline": _tl(
+            {"proposal_received": _mark(_T0 + 0.020, o1, "id0")}),
+    }
+    n2 = {
+        "name": "n2", "node_id": "id2", "offset_s": o2,
+        "uncertainty_s": 0.0005,
+        "timeline": _tl(
+            {"proposal_received": _mark(_T0 + 0.030, o2, "id1")}),
+    }
+    n3 = {
+        "name": "n3", "node_id": "id3", "offset_s": o3,
+        "uncertainty_s": 0.0005,
+        "timeline": _tl(
+            {"proposal_received": _mark(_T0 + 0.040, o3, "id0")}),
+    }
+    return [n0, n1, n2, n3]
+
+
+def test_golden_four_node_stitch():
+    nodes = _golden_nodes()
+    rec = fleettrace.stitch_height(9, nodes)
+    assert rec is not None
+    assert rec["height"] == 9
+    assert rec["reference"] == "collector"
+
+    # offsets echoed per node
+    for name, off in _OFFSETS.items():
+        assert rec["offsets"][name]["offset_s"] == pytest.approx(off)
+
+    # propagation tree: proposer n0; n2 heard it via n1 (hop 2)
+    tree = rec["tree"]
+    assert tree["proposer"] == "n0"
+    assert [e["to"] for e in tree["edges"]] == ["n1", "n2", "n3"]
+    by_to = {e["to"]: e for e in tree["edges"]}
+    assert by_to["n1"]["from"] == "n0" and by_to["n1"]["hop"] == 1
+    assert by_to["n2"]["from"] == "n1" and by_to["n2"]["hop"] == 2
+    assert by_to["n3"]["from"] == "n0" and by_to["n3"]["hop"] == 1
+    assert tree["max_hop"] == 2
+    # delivery times rebased back onto the fleet clock
+    assert by_to["n1"]["t_s"] == pytest.approx(_T0 + 0.020, abs=1e-6)
+    assert by_to["n3"]["t_s"] == pytest.approx(_T0 + 0.040, abs=1e-6)
+
+    # full waterfall: every stage attributed, in spec order
+    w = rec["waterfall"]
+    assert w["span_s"] == pytest.approx(0.100, abs=1e-6)
+    names = [s["stage"] for s in w["stages"]]
+    assert names == [n for n, _ in fleettrace.WATERFALL]
+    durs = {s["stage"]: s["dur_s"] for s in w["stages"]}
+    assert durs["proposal_build"] == pytest.approx(0.010, abs=1e-6)
+    assert durs["gossip_first_delivery"] == pytest.approx(0.010, abs=1e-6)
+    assert durs["gossip_last_delivery"] == pytest.approx(0.020, abs=1e-6)
+    assert durs["prevote_quorum"] == pytest.approx(0.020, abs=1e-6)
+    assert durs["precommit_quorum"] == pytest.approx(0.020, abs=1e-6)
+    assert durs["commit"] == pytest.approx(0.010, abs=1e-6)
+    assert durs["apply"] == pytest.approx(0.010, abs=1e-6)
+    assert w["coverage"] == pytest.approx(1.0, abs=1e-4)
+    assert w["unaccounted_s"] == pytest.approx(0.0, abs=1e-5)
+
+    # straggler ranking: validator 3's prevote landed last
+    assert rec["stragglers"][0]["validator_index"] == 3
+    assert rec["stragglers"][0]["latency_s"] == pytest.approx(
+        0.045, abs=1e-5)
+    assert rec["round_churn"] is False
+
+
+def test_stitch_missing_marks_stay_unaccounted():
+    """Drop both quorum marks: the commit boundary is no longer
+    adjacent to the last present boundary, so the quorum→commit span is
+    honest unaccounted time and coverage falls to 50% — the acceptance
+    oracle fails on mark loss instead of silently passing."""
+    nodes = _golden_nodes()
+    del nodes[0]["timeline"]["marks"]["prevote_23"]
+    del nodes[0]["timeline"]["marks"]["precommit_23"]
+    rec = fleettrace.stitch_height(9, nodes)
+    w = rec["waterfall"]
+    assert [s["stage"] for s in w["stages"]] == [
+        "proposal_build", "gossip_first_delivery",
+        "gossip_last_delivery", "apply"]
+    assert w["attributed_s"] == pytest.approx(0.050, abs=1e-5)
+    assert w["unaccounted_s"] == pytest.approx(0.050, abs=1e-5)
+    assert w["coverage"] == pytest.approx(0.5, abs=1e-3)
+
+
+def test_stitch_degenerate_inputs():
+    assert fleettrace.stitch_height(1, []) is None
+    # no proposer anywhere (every proposal came from a peer, no emit)
+    orphan = {"name": "x", "node_id": "idx", "offset_s": 0.0,
+              "uncertainty_s": 0.0,
+              "timeline": _tl(
+                  {"proposal_received": _mark(1.0, 0.0, "ghost")})}
+    assert fleettrace.stitch_height(1, [orphan]) is None
+
+
+# --- commit-stage splice + exports ------------------------------------
+
+
+def test_parse_commit_stages():
+    body = (
+        "# TYPE tendermint_commit_stage_seconds histogram\n"
+        'tendermint_commit_stage_seconds_sum{stage="wal_fsync"} 0.5\n'
+        'tendermint_commit_stage_seconds_count{stage="wal_fsync"} 10\n'
+        'tendermint_commit_stage_seconds_sum{stage="apply"} 1.25\n'
+        'tendermint_commit_stage_seconds_count{stage="apply"} 10\n'
+        "unrelated_total 3\n")
+    out = fleettrace.parse_commit_stages(body)
+    assert out == {
+        "wal_fsync": {"total_s": 0.5, "count": 10.0},
+        "apply": {"total_s": 1.25, "count": 10.0},
+    }
+    assert fleettrace.parse_commit_stages("nothing 1\n") == {}
+
+
+def test_chrome_trace_and_summarize():
+    nodes = _golden_nodes()
+    rec = fleettrace.stitch_height(9, nodes)
+    doc = fleettrace.chrome_trace([rec], nodes)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {"n0", "n1", "n2", "n3"}
+    stages = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(stages) == len(fleettrace.WATERFALL)
+    assert all(e["name"].startswith("h9:") for e in stages)
+    deliveries = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(deliveries) == 3
+    json.dumps(doc)  # JSON-serializable end to end
+
+    text = fleettrace.summarize(rec)
+    assert "height 9" in text and "proposer=n0" in text
+    assert "deliver -> n2 via n1 hop=2" in text
+    assert "slowest validators" in text and "v3+" in text
+
+
+# --- collector with injected fetchers ---------------------------------
+
+
+class _FakeFleet:
+    """Two fake nodes behind injectable fetchers: n0 skewed +0.25s,
+    n1 -0.25s, n0 proposes heights 5..7 but n1 only saw 6..7."""
+
+    def __init__(self):
+        self.skews = {"n0:1": 0.25, "n1:1": -0.25}
+        self.ids = {"n0:1": "id0", "n1:1": "id1"}
+        self.heights = {"n0:1": [5, 6, 7], "n1:1": [6, 7]}
+
+    def _timeline(self, ep, h):
+        base, skew = 100.0 * h, self.skews[ep]
+        if ep == "n0:1":
+            return _tl({
+                "new_height": _mark(base, skew),
+                "proposal_emit": _mark(base + 0.01, skew),
+                "prevote_23": _mark(base + 0.05, skew),
+                "precommit_23": _mark(base + 0.07, skew),
+                "commit": _mark(base + 0.09, skew),
+                "apply_block": _mark(base + 0.10, skew),
+            })
+        return _tl(
+            {"proposal_received": _mark(base + 0.02, skew, "id0")})
+
+    def fetch_json(self, url, timeout=5.0):
+        _, _, rest = url.partition("http://")
+        ep, _, path = rest.partition("/")
+        if path == "debug/clock":
+            return {"wall_s": time.time() + self.skews[ep],
+                    "mono_ns": 0,
+                    "identity": {"node_id": self.ids[ep]}}
+        if path == "debug/timeline?list=1":
+            return {"heights": self.heights[ep],
+                    "latest": self.heights[ep][-1]}
+        if path.startswith("debug/timeline?height="):
+            h = int(path.rsplit("=", 1)[1])
+            if h not in self.heights[ep]:
+                raise KeyError(h)
+            return self._timeline(ep, h)
+        if path == "debug/exec":
+            return {"enabled": True, "lanes": {}, "blocks": {}}
+        raise AssertionError(f"unexpected url {url}")
+
+    def fetch_text(self, url, timeout=5.0):
+        assert url == "http://m0:1/metrics"
+        return ('tendermint_commit_stage_seconds_sum'
+                '{stage="wal_fsync"} 0.25\n'
+                'tendermint_commit_stage_seconds_count'
+                '{stage="wal_fsync"} 5\n')
+
+
+def test_fleettrace_collector_stitches_common_heights(tmp_path):
+    fake = _FakeFleet()
+    hist = tmp_path / "fleet.jsonl"
+    ft = fleettrace.FleetTrace(
+        ["n0:1", "n1:1"], probes=3,
+        fetch_json=fake.fetch_json, fetch_text=fake.fetch_text,
+        scrape_metrics={"n0:1": "m0:1"}, history_path=str(hist))
+
+    # offsets recovered against the collector clock: the fetchers are
+    # in-process calls, so the probe error is microseconds
+    probes = ft.probe_all()
+    for ep, skew in fake.skews.items():
+        assert probes[ep]["offset_s"] == pytest.approx(skew, abs=0.05)
+        assert probes[ep]["identity"]["node_id"] == fake.ids[ep]
+
+    # only heights EVERY node saw are stitchable
+    assert ft.heights(last=4) == [6, 7]
+
+    res = ft.collect()
+    assert res["heights"] == [6, 7]
+    assert [r["height"] for r in res["stitched"]] == [6, 7]
+    for rec in res["stitched"]:
+        assert rec["tree"]["proposer"] == "n0:1"
+        assert rec["tree"]["edges"][0]["to"] == "n1:1"
+        # the commit-stage splice rode in from the metrics endpoint
+        assert rec["commit_stages"]["n0:1"]["wal_fsync"]["count"] == 5
+    assert set(res["exec"]) == {"n0:1", "n1:1"}
+
+    # JSONL history: one parseable stitched record per line
+    lines = [json.loads(ln) for ln in
+             hist.read_text().strip().splitlines()]
+    assert [r["height"] for r in lines] == [6, 7]
+
+
+# --- exec-lane flight recorder ----------------------------------------
+
+
+def test_flight_recorder_rings_and_percentiles():
+    from tendermint_tpu.state.parallel import FlightRecorder
+
+    fr = FlightRecorder(samples=4)
+    assert fr.enabled
+    fr.record_lane(0, 1000, 9000, txs=5, groups=2)
+    fr.record_lane(0, 3000, 7000, txs=5, groups=1)
+    fr.record_lane(1, -50, 0, txs=0, groups=0)  # negatives clamp to 0
+    fr.note_block(10, 8, conflicts=2, serial_fallback=False, lanes=2)
+    fr.note_block(4, 0, conflicts=0, serial_fallback=True, lanes=2)
+
+    rep = fr.report()
+    assert set(rep) == {"enabled", "capacity", "lanes", "blocks"}
+    lane0 = rep["lanes"]["0"]
+    assert lane0["samples"] == 2
+    assert lane0["txs"] == 10 and lane0["groups"] == 3
+    # busy 16µs of a 20µs lifetime
+    assert lane0["busy_ratio"] == pytest.approx(0.8)
+    assert rep["lanes"]["1"]["busy_ratio"] == 0.0
+    assert rep["blocks"]["count"] == 2
+    assert rep["blocks"]["conflict_txs"] == 2
+    assert rep["blocks"]["serial_fallbacks"] == 1
+    assert rep["blocks"]["recent"][-1]["serial_fallback"] is True
+    json.dumps(rep)  # /debug/exec payload must serialize
+
+    wp = fr.wakeup_percentiles()
+    assert wp["count"] == 3
+    assert wp["p50_s"] == pytest.approx(1000 / 1e9)
+    assert wp["p99_s"] == pytest.approx(3000 / 1e9)
+
+    # shrink-in-place keeps only the newest samples
+    fr.configure(samples=1)
+    assert fr.report()["lanes"]["0"]["samples"] == 1
+    fr.configure(enabled=False)
+    assert fr.report()["enabled"] is False
+    fr.reset()
+    rep = fr.report()
+    assert rep["lanes"] == {} and rep["blocks"]["count"] == 0
+
+
+def test_flight_recorder_metrics_sink():
+    from tendermint_tpu.state.parallel import FlightRecorder
+
+    observed, gauges = [], {}
+
+    class _Hist:
+        def observe(self, v):
+            observed.append(v)
+
+    class _Gauge:
+        def with_labels(self, lane):
+            class _S:
+                def set(_self, v):
+                    gauges[lane] = v
+            return _S()
+
+    class _Sink:
+        exec_lane_wakeup = _Hist()
+        exec_lane_busy = _Gauge()
+
+    fr = FlightRecorder(samples=8)
+    fr.set_metrics(_Sink())
+    fr.record_lane(2, 2_000_000, 8_000_000, txs=1, groups=1)
+    assert observed == [pytest.approx(0.002)]
+    assert gauges["2"] == pytest.approx(0.8)
+    fr.set_metrics(None)
+    fr.record_lane(2, 1_000_000, 1_000_000, txs=1, groups=1)
+    assert len(observed) == 1  # sink uninstalled, nothing observed
+
+
+# --- tier-1 provider contract -----------------------------------------
+
+_DEBUG_ROUTES = ("consensus", "statesync", "abci", "mempool", "crypto",
+                 "rpc", "lockdep", "recovery", "determinism", "exec")
+
+
+def _scrape(addr, path):
+    with urllib.request.urlopen(
+            f"http://{addr}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _assert_provider_contract(addr, node_id, mode):
+    # every provider answers JSON and keeps its top-level schema stable
+    # across scrapes (the fleet collector's compatibility contract)
+    first = {rt: _scrape(addr, f"/debug/{rt}") for rt in _DEBUG_ROUTES}
+    for rt, payload in first.items():
+        assert isinstance(payload, dict), (mode, rt)
+    second = {rt: _scrape(addr, f"/debug/{rt}") for rt in _DEBUG_ROUTES}
+    for rt in _DEBUG_ROUTES:
+        assert set(second[rt]) == set(first[rt]), (
+            f"{mode}: /debug/{rt} schema drifted between scrapes: "
+            f"{sorted(set(first[rt]) ^ set(second[rt]))}")
+
+    ex = first["exec"]
+    assert set(ex) == {"enabled", "capacity", "lanes", "blocks",
+                       "parallel_lanes"}, (mode, sorted(ex))
+    assert set(ex["blocks"]) == {"count", "conflict_txs",
+                                 "serial_fallbacks", "recent"}
+
+    clk = _scrape(addr, "/debug/clock")
+    assert set(clk) == {"wall_s", "mono_ns", "identity"}
+    assert clk["identity"]["node_id"] == node_id
+    assert abs(clk["wall_s"] - time.time()) < 5.0
+    return first
+
+
+def test_debug_provider_contract_validator_mode(tmp_path):
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.types.event_bus import (
+        EVENT_NEW_BLOCK,
+        query_for_event,
+    )
+
+    c = make_config(tmp_path, "prov")
+    c.base.prof_laddr = "tcp://127.0.0.1:0"
+    init_files(c)
+    node = default_new_node(c)
+    sub = node.event_bus.subscribe(
+        "prov", query_for_event(EVENT_NEW_BLOCK), 16)
+    node.start()
+    try:
+        h, deadline = 0, time.time() + 30
+        while h < 2 and time.time() < deadline:
+            m = sub.get(timeout=1.0)
+            if m is not None:
+                h = m.data["block"].header.height
+        assert h >= 2
+
+        addr = node._prof_server.listen_addr
+        payloads = _assert_provider_contract(
+            addr, node.node_key.id, "validator")
+        assert payloads["consensus"]["live"]["round_state"]["height"] >= 1
+        # the ?list=1 satellite: heights inventory for the collector
+        listing = _scrape(addr, "/debug/timeline?list=1")
+        assert set(listing) == {"heights", "latest"}
+        assert listing["latest"] >= 2
+        assert listing["latest"] in listing["heights"]
+    finally:
+        node.stop()
+
+
+def test_debug_provider_contract_replica_mode(tmp_path):
+    """Replica boots (no consensus machinery, no peers, statesync off)
+    must serve the same /debug/* surface — including /debug/exec — so
+    fleet scrapers never special-case node modes."""
+    from tendermint_tpu.node import default_new_node
+
+    c = make_config(tmp_path, "replica")
+    c.base.mode = "replica"
+    c.base.prof_laddr = "tcp://127.0.0.1:0"
+    c.statesync.enable = False
+    init_files(c)
+    node = default_new_node(c)
+    node.start()
+    try:
+        assert node.consensus_state is None
+        addr = node._prof_server.listen_addr
+        payloads = _assert_provider_contract(
+            addr, node.node_key.id, "replica")
+        assert payloads["consensus"]["mode"] == "replica"
+    finally:
+        node.stop()
+
+
+# --- monitor history sink ---------------------------------------------
+
+
+def test_monitor_history_jsonl(tmp_path):
+    from test_observability import _stub_debug_server
+
+    from tendermint_tpu.tools.monitor import Monitor
+
+    srv, daddr = _stub_debug_server({"height": 3, "stalls_total": 0})
+    hist = tmp_path / "history.jsonl"
+    mon = Monitor(["127.0.0.1:1"], poll_interval=0.2,
+                  debug_addrs=[daddr], history_path=str(hist))
+    mon.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if hist.exists() and hist.read_text().count("\n") >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        mon.stop()
+        srv.shutdown()
+    lines = [json.loads(ln) for ln in
+             hist.read_text().strip().splitlines()]
+    assert len(lines) >= 2
+    for entry in lines:
+        assert entry["t"] > 0
+        assert "snapshot" in entry
+
+
+# --- slow: the live acceptance oracle ---------------------------------
+
+
+@pytest.mark.slow
+def test_proptrace_scenario_end_to_end():
+    """The PR's acceptance gate over real HTTP: a 4-node localnet with
+    ±0.5s injected clock skews; fleettrace must recover every offset to
+    ≤10ms on loopback and attribute ≥95% of each stitched block's
+    proposal→apply span to named stages."""
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("proptrace", seed=8, n=4)
+    assert res["converged"] and res["safety_ok"], res
+    assert res["offsets_ok"], res["offset_error_ms"]
+    assert res["coverage_ok"], (res["coverages"],
+                                res["stitched_heights"])
+    assert res["coverage_min"] >= 0.95
+    assert res["max_hop"] >= 1
+    assert res["ok"], res

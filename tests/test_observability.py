@@ -55,6 +55,28 @@ def test_timeline_marks_and_vote_attribution():
     assert rec["duration_s"] >= 0.0
 
 
+def test_timeline_round_churn_counters():
+    """mark_round counts every entry into (height, round): re-entries
+    (catch-up churn) are distinguishable from slow gossip in stitched
+    traces, which first-wins marks alone cannot express."""
+    tl = Timeline(capacity=8, enabled=True)
+    tl.mark_round(7, 0)
+    tl.mark_round(7, 1)
+    tl.mark_round(7, 1)  # re-entered round 1
+    rec = tl.record(7)
+    assert rec["rounds_seen"] == [0, 1]
+    assert rec["round_entries"] == {"0": 1, "1": 2}
+    assert rec["re_entries"] == 1
+    assert rec["max_round"] == 1
+    # disabled and non-positive heights never record
+    tl.disable()
+    tl.mark_round(8, 0)
+    assert tl.record(8) is None
+    tl.enable()
+    tl.mark_round(0, 0)
+    assert tl.record(0) is None
+
+
 def test_timeline_disabled_records_nothing_and_eviction_bounds():
     tl = Timeline(capacity=4, enabled=False)
     tl.mark(1, "commit")
