@@ -120,6 +120,17 @@ EXEC_PAR_TPS = _env_int("TM_TPU_BENCH_EXEC_PAR_TPS", 4000)
 EXEC_SECS = _env_int("TM_TPU_BENCH_EXEC_SECS", 4)
 EXEC_METRIC = (f"exec_parallel_{EXEC_LANES}lanes_"
                f"{EXEC_IO_US}us_committed_tps")
+# high-conflict legs (PR 17): EXEC_CONFLICT_PCT percent of txs carry a
+# LYING access hint and actually touch one of EXEC_HOT_KEYS shared
+# keys, so the planner spreads them across lanes and the merge sees
+# real read/write overlap. Run once on the PR-16 engine (segment
+# re-run + whole-block serial fallback) and once on the retry-DAG +
+# lane-pool engine; the ratio is the conflict-path speedup.
+EXEC_HC_TPS = _env_int("TM_TPU_BENCH_EXEC_HC_TPS", 800)
+EXEC_HC_SECS = _env_int("TM_TPU_BENCH_EXEC_HC_SECS", 3)
+EXEC_CONFLICT_PCT = _env_int("TM_TPU_BENCH_EXEC_CONFLICT_PCT", 30)
+EXEC_HOT_KEYS = _env_int("TM_TPU_BENCH_EXEC_HOT_KEYS", 16)
+EXEC_RETRY_ROUNDS = _env_int("TM_TPU_BENCH_EXEC_RETRY_ROUNDS", 3)
 PREVERIFY_N = _env_int("TM_TPU_BENCH_PREVERIFY_N", 2000)
 PREVERIFY_METRIC = f"mempool_preverify_{PREVERIFY_N}tx_wall_ms"
 AGG_NVAL = _env_int("TM_TPU_BENCH_AGG_NVAL", 10000)
@@ -986,11 +997,16 @@ def _socket_deliver_measure(n: int = 256):
 
 
 def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
-                   mp_size: int = 200000):
+                   mp_size: int = 200000, conflict_pct: int = 0,
+                   hot_keys: int = EXEC_HOT_KEYS):
     """One parallel-exec load leg: a single-validator in-process
     localnet against `app_addr`, plain `k=v` txs (footprints come from
     the app's inference — no signing/verify on the measurement path),
-    paced at target_tps for secs. Returns a stats dict."""
+    paced at target_tps for secs. conflict_pct > 0 swaps that share of
+    the stream for signed txs with LYING access hints that really
+    touch one of `hot_keys` shared keys (alternating writers and
+    readers), so the planner schedules them concurrently and the merge
+    observes genuine conflicts. Returns a stats dict."""
     import hashlib
 
     from tendermint_tpu import config as cfg
@@ -1070,7 +1086,41 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
     cs.start()
 
     n = target_tps * secs
-    txs = [b"bench-exec-%08d=v" % i for i in range(n)]
+    if conflict_pct > 0:
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519
+        from tendermint_tpu.mempool.preverify import make_signed_tx
+        signer = PrivKeyEd25519.gen_from_secret(b"bench-exec-conflict")
+        txs = []
+        j = 0  # running conflict-tx index; j//3 numbers the triple
+        for i in range(n):
+            if i % 100 >= conflict_pct:
+                txs.append(b"bench-exec-%08d=v" % i)
+                continue
+            # conflict triples with LYING hints, all landing in
+            # different groups: (A) points p_t at a hot key, (B) an
+            # indirect write THROUGH p_t — its re-run retargets to the
+            # hot key, a write that only appears on re-execution — and
+            # (C) an honest-looking read OF that hot key. On the PR-16
+            # engine B's re-run invalidates clean C → whole-block
+            # serial fallback; the retry DAG converges in two rounds
+            # re-running only the cone.
+            t, role = j // 3, j % 3
+            hot = b"h%02d" % (t % hot_keys)
+            if role == 0:
+                txs.append(make_signed_tx(
+                    signer, b"p%05d=" % t + hot,
+                    hints=[b"kv:a%05d" % t]))
+            elif role == 1:
+                txs.append(make_signed_tx(
+                    signer, b"ind:p%05d:V%05d" % (t, t),
+                    hints=[b"kv:b%05d" % t]))
+            else:
+                txs.append(make_signed_tx(
+                    signer, b"cp:" + hot + b":c%05d" % t,
+                    hints=[b"kv:c%05d" % t]))
+            j += 1
+    else:
+        txs = [b"bench-exec-%08d=v" % i for i in range(n)]
     submit_at = {}
     latencies_ms = []
     committed = set()
@@ -1126,7 +1176,10 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
     # percentiles across lanes plus per-lane busy ratios. Serial legs
     # report count=0 — the inline path records nothing.
     wake = recorder.wakeup_percentiles()
-    lane_report = recorder.report()["lanes"]
+    disp = recorder.dispatch_percentiles()
+    full_report = recorder.report()
+    lane_report = full_report["lanes"]
+    rstats = recorder.retry_stats()
     return {
         "target_tps": target_tps,
         "accepted": accepted,
@@ -1136,6 +1189,15 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
         "p50_ms": round(_pct(0.50), 1),
         "p99_ms": round(_pct(0.99), 1),
         "conflict_reruns": m.exec_conflicts.value,
+        # observed-conflict rate over the committed stream, plus the
+        # PR-17 engine counters (all zero when retry/pool are off)
+        "conflict_rate": round(
+            m.exec_conflicts.value / max(len(committed), 1), 4),
+        "retry_rounds_p99": rstats["retry_rounds_p99"],
+        "retried_txs": rstats["retried_txs"],
+        "steals": rstats["steals"],
+        "steal_ratio": rstats["steal_ratio"],
+        "serial_fallbacks": full_report["blocks"]["serial_fallbacks"],
         "speculation_hits": m.exec_speculation_hits.value,
         "speculation_wasted": m.exec_speculation_wasted.value,
         # the commit-path profiler's per-stage breakdown (the PR-13
@@ -1145,50 +1207,105 @@ def _exec_load_leg(app_addr: str, exec_cfg, target_tps: int, secs: int,
         "lane_wakeup_samples": wake["count"],
         "lane_wakeup_p50_us": round(wake["p50_s"] * 1e6, 3),
         "lane_wakeup_p99_us": round(wake["p99_s"] * 1e6, 3),
+        # per-run critical-path lane-launch cost (PR 17): the wall time
+        # the submitter spends getting all lanes going — serialized
+        # blocking t.start() calls on the spawn engine vs non-blocking
+        # pokes on the pool. This is the convoy number the two engines
+        # can be compared on; per-lane wakeup samples can't be, because
+        # t.start() blocks until the thread runs and so hides the spawn
+        # convoy inside the submit loop.
+        "dispatch_samples": disp["count"],
+        "dispatch_p50_us": round(disp["p50_s"] * 1e6, 3),
+        "dispatch_p99_us": round(disp["p99_s"] * 1e6, 3),
         "lane_busy_ratio": {
             lane: rep["busy_ratio"] for lane, rep in lane_report.items()},
     }
 
 
 def load_parallel_main():
-    """`bench.py load --parallel` — the PR-12 tentpole point: the same
-    sharded kvstore workload (EXEC_IO_US of simulated per-tx backend
-    latency) executed serially ([execution] defaults — the committed
-    baseline, BENCH_LOAD_SERIAL.json) and then with EXEC_LANES
-    optimistic-concurrency lanes + speculative execution. vs_baseline
-    is parallel/serial committed TPS, both measured in THIS run so the
-    ratio is like-for-like on the current box."""
+    """`bench.py load --parallel` — the PR-12 tentpole point, extended
+    by PR 17: the same sharded kvstore workload (EXEC_IO_US of
+    simulated per-tx backend latency) executed serially ([execution]
+    defaults — the committed baseline, BENCH_LOAD_SERIAL.json), with
+    the PR-16 spawn-per-block engine, and with the PR-17 persistent
+    lane pool + retry DAG. Two extra high-conflict legs
+    (EXEC_CONFLICT_PCT% of txs carrying lying hints over EXEC_HOT_KEYS
+    shared keys) compare the old conflict path (segment re-run /
+    whole-block serial fallback) against the conflict-cone retry
+    engine. vs_baseline is pooled-parallel/serial committed TPS, both
+    measured in THIS run so the ratio is like-for-like on the box."""
     from tendermint_tpu.config import ExecutionConfig
 
     app = f"sharded_kvstore:shards=64,io_us={EXEC_IO_US}"
+    spawn_cfg = dict(parallel_lanes=EXEC_LANES, speculative=True)
+    pool_cfg = dict(parallel_lanes=EXEC_LANES, speculative=True,
+                    lane_pool=True, retry_max_rounds=EXEC_RETRY_ROUNDS)
     serial = _exec_load_leg(app, ExecutionConfig(), EXEC_SERIAL_TPS,
                             EXEC_SECS)
-    parallel = _exec_load_leg(
-        app,
-        ExecutionConfig(parallel_lanes=EXEC_LANES, speculative=True),
-        EXEC_PAR_TPS, EXEC_SECS)
+    spawn = _exec_load_leg(app, ExecutionConfig(**spawn_cfg),
+                           EXEC_PAR_TPS, EXEC_SECS)
+    pooled = _exec_load_leg(app, ExecutionConfig(**pool_cfg),
+                            EXEC_PAR_TPS, EXEC_SECS)
+    hc_spawn = _exec_load_leg(app, ExecutionConfig(**spawn_cfg),
+                              EXEC_HC_TPS, EXEC_HC_SECS,
+                              conflict_pct=EXEC_CONFLICT_PCT)
+    hc_retry = _exec_load_leg(app, ExecutionConfig(**pool_cfg),
+                              EXEC_HC_TPS, EXEC_HC_SECS,
+                              conflict_pct=EXEC_CONFLICT_PCT)
     s_tps = max(serial["committed_tps"], 1e-9)
     print(json.dumps({
         "metric": EXEC_METRIC,
-        "value": parallel["committed_tps"],
+        "value": pooled["committed_tps"],
         "unit": "tps",
-        "vs_baseline": round(parallel["committed_tps"] / s_tps, 2),
-        # exec-lane flight recorder (PR 16): spawn->first-instruction
-        # wakeup latency percentiles for the parallel leg's lanes
-        "lane_wakeup_p50_us": parallel["lane_wakeup_p50_us"],
-        "lane_wakeup_p99_us": parallel["lane_wakeup_p99_us"],
-        "lane_wakeup_samples": parallel["lane_wakeup_samples"],
+        "vs_baseline": round(pooled["committed_tps"] / s_tps, 2),
+        # exec-lane flight recorder (PR 16/17): the wakeup convoy is
+        # compared on the per-run DISPATCH span — the submitter-side
+        # critical path of getting every lane going. On the spawn
+        # engine that is n_lanes serialized blocking t.start() calls;
+        # on the pool it is the non-blocking per-lane poke loop.
+        # (Per-lane wakeup samples are reported per leg but are NOT
+        # comparable across engines: t.start() blocks until the new
+        # thread runs, so the spawn path's per-thread samples hide the
+        # convoy the submit loop pays.)
+        "lane_wakeup_p50_us": pooled["lane_wakeup_p50_us"],
+        "lane_wakeup_p99_us": pooled["lane_wakeup_p99_us"],
+        "lane_wakeup_samples": pooled["lane_wakeup_samples"],
+        "dispatch_p99_us": pooled["dispatch_p99_us"],
+        "spawn_dispatch_p99_us": spawn["dispatch_p99_us"],
+        "wakeup_p99_speedup": round(
+            spawn["dispatch_p99_us"]
+            / max(pooled["dispatch_p99_us"], 1e-9), 2),
+        # PR-17 conflict-path summary (from the retry-DAG high-conflict
+        # leg; hc_speedup = retry-DAG tps / PR-16-engine tps on the
+        # identical lying-hint stream)
+        "conflict_rate": hc_retry["conflict_rate"],
+        "retry_rounds_p99": hc_retry["retry_rounds_p99"],
+        "steal_ratio": hc_retry["steal_ratio"],
+        "hc_speedup": round(
+            hc_retry["committed_tps"]
+            / max(hc_spawn["committed_tps"], 1e-9), 2),
         "serial": serial,
-        "parallel": parallel,
+        "parallel": spawn,
+        "pooled": pooled,
+        "hc_spawn": hc_spawn,
+        "hc_retry": hc_retry,
         "io_us": EXEC_IO_US,
         "lanes": EXEC_LANES,
+        "conflict_pct": EXEC_CONFLICT_PCT,
+        "hot_keys": EXEC_HOT_KEYS,
+        "retry_rounds": EXEC_RETRY_ROUNDS,
         "note": ("single-validator in-process localnet, sharded_kvstore "
                  f"with {EXEC_IO_US}us simulated per-tx backend latency "
                  "(GIL-released stall), plain k=v txs partitioned via "
                  "app footprint inference; serial leg = [execution] "
-                 "defaults (the conformance oracle), parallel leg = "
-                 f"{EXEC_LANES} lanes + speculative execution; "
-                 "vs_baseline = parallel/serial committed TPS"),
+                 "defaults (the conformance oracle), parallel legs = "
+                 f"{EXEC_LANES} lanes + speculative execution, spawn-"
+                 "per-block vs persistent work-stealing lane pool + "
+                 f"retry DAG; hc_* legs add {EXEC_CONFLICT_PCT}% lying-"
+                 f"hint txs over {EXEC_HOT_KEYS} hot keys; vs_baseline "
+                 "= pooled/serial committed TPS; wakeup_p99_speedup = "
+                 "spawn/pooled per-run lane-launch (dispatch) p99 — "
+                 "the submit-side convoy, comparable across engines"),
     }))
     return 0
 

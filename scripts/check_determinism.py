@@ -65,6 +65,7 @@ load_allowlist = allowlist_util.load_allowlist
 CRITICAL_SUFFIXES = (
     "state/execution.py",
     "state/parallel.py",
+    "state/lanepool.py",
     "state/state.py",
     "state/store.py",
     "state/txindex.py",

@@ -304,6 +304,9 @@ REQUIRED_FAMILIES = (
     # structurally never record, which is the zero-overhead contract)
     "exec_lane_wakeup_seconds",
     "exec_lane_busy_ratio",
+    # PR-17 Block-STM engine: conflict-cone retry + work-stealing pool
+    "exec_lane_retries_total",
+    "exec_lane_steals_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

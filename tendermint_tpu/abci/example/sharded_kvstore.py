@@ -79,11 +79,29 @@ class ExecSession:
     `exec_discard` (drop without trace)."""
 
     def __init__(self, app: "ShardedKVStoreApplication", n_txs: int,
-                 shards: int):
+                 shards: int, parent: "Optional[ExecSession]" = None):
         self.app = app
         self.n_txs = n_txs
         self.end_idx = n_txs
         self.base: DB = app.base_db()
+        # cross-height chaining: reads that miss this session's overlay
+        # resolve through the parent's FINAL versions before the base
+        # db (h+1 speculating on h's un-promoted overlay). `promoted`
+        # orders the chain: a child may only promote after its parent.
+        self.parent = parent
+        self.promoted = False
+        # scalar counters are snapshotted at open, NEVER read live off
+        # the app during the session: a chained child races its
+        # parent's promote (which mutates app._size), so the base must
+        # be the chain-final value computed from overlay state alone
+        if parent is not None:
+            self._scalar_base = {
+                n: parent.scalar_base(n) + parent.scalar_total(n)
+                for n in ("size", "epochs_run")}
+        else:
+            self._scalar_base = {
+                "size": getattr(app, "_size", 0),
+                "epochs_run": getattr(app, "_epochs_run", 0)}
         self.stripes = [_Stripe() for _ in range(max(1, shards))]
         self._journal_lock = threading.Lock()
         # per-idx access journal (sentinel phases included, though only
@@ -124,6 +142,31 @@ class ExecSession:
                     if best is _TOMBSTONE:
                         return True, None
                     return True, best
+        if self.parent is not None:
+            return self.parent.final_get(key)
+        return False, None
+
+    def final_get(self, key: bytes):
+        """(found, value) at this session's FINAL state — every tx plus
+        end_block applied — recursing through the chain. What a chained
+        child's reads resolve against before touching the base db."""
+        end = self.end_idx + 1
+        s = self._stripe(key)
+        with s.lock:
+            vers = s.versions.get(key)
+            if vers:
+                best = None
+                for vidx, val in vers:
+                    if vidx < end:
+                        best = val
+                    else:
+                        break
+                if best is not None:
+                    if best is _TOMBSTONE:
+                        return True, None
+                    return True, best
+        if self.parent is not None:
+            return self.parent.final_get(key)
         return False, None
 
     def mvcc_put(self, idx: int, key: bytes, value) -> None:
@@ -141,8 +184,12 @@ class ExecSession:
 
     def overlay_range(self, idx: int, start, end) -> Dict[bytes, object]:
         """{key: final value below idx} for every overlay key in
-        [start, end) — the overlay half of a merged iterator."""
-        out: Dict[bytes, object] = {}
+        [start, end) — the overlay half of a merged iterator. A chained
+        session's range starts from the parent chain's FINAL versions;
+        own versions win."""
+        out: Dict[bytes, object] = (
+            self.parent.final_range(start, end)
+            if self.parent is not None else {})
         for s in self.stripes:
             with s.lock:
                 for key, vers in s.versions.items():
@@ -159,6 +206,45 @@ class ExecSession:
                     if best is not None:
                         out[key] = best
         return out
+
+    def final_range(self, start, end) -> Dict[bytes, object]:
+        """{key: chain-final value} over [start, end) — the end-of-block
+        view of this session and its ancestors (own versions win)."""
+        out: Dict[bytes, object] = (
+            self.parent.final_range(start, end)
+            if self.parent is not None else {})
+        cut = self.end_idx + 1
+        for s in self.stripes:
+            with s.lock:
+                for key, vers in s.versions.items():
+                    if start is not None and key < start:
+                        continue
+                    if end is not None and key >= end:
+                        continue
+                    best = None
+                    for vidx, val in vers:
+                        if vidx < cut:
+                            best = val
+                        else:
+                            break
+                    if best is not None:
+                        out[key] = best
+        return out
+
+    def release(self) -> None:
+        """Free every overlay version, journal, and buffered update and
+        detach from the chain. Abandoned cross-height speculation MUST
+        call this (via exec_discard): a dropped slot otherwise pins its
+        whole ancestor chain — and every MVCC version in it — alive."""
+        for s in self.stripes:
+            with s.lock:
+                s.versions.clear()
+        with self._journal_lock:
+            self.reads.clear()
+            self.writes.clear()
+            self.scalars.clear()
+            self.val_updates.clear()
+        self.parent = None
 
     # -- journaling ----------------------------------------------------
 
@@ -214,6 +300,11 @@ class ExecSession:
     def scalar_total(self, name: str) -> int:
         with self._journal_lock:
             return sum(d.get(name, 0) for d in self.scalars.values())
+
+    def scalar_base(self, name: str) -> int:
+        """The counter's value as of this session's open (chain-final
+        for chained sessions) — the base the views' deltas apply to."""
+        return self._scalar_base.get(name, 0)
 
     def ordered_val_updates(self) -> list:
         with self._journal_lock:
@@ -359,13 +450,18 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
     def _buffered_scalar_get(self, name: str, base: int) -> int:
         view = getattr(self._tl, "view", None)
         if view is not None:
-            return base + view.scalar_deltas.get(name, 0)
+            # the session's open-time snapshot, never the live attr: a
+            # chained child races its parent's promote (which bumps
+            # self._size mid-session)
+            return (view.session.scalar_base(name)
+                    + view.scalar_deltas.get(name, 0))
         return base
 
     def _buffered_scalar_set(self, name: str, base: int, value: int) -> bool:
         view = getattr(self._tl, "view", None)
         if view is not None:
-            view.scalar_deltas[name] = value - base
+            view.scalar_deltas[name] = (
+                value - view.session.scalar_base(name))
             return True
         return False
 
@@ -478,8 +574,9 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
 
     # -- exec-session surface (driven by state/parallel.py) ------------
 
-    def exec_open(self, n_txs: int) -> ExecSession:
-        return ExecSession(self, n_txs, self.shards)
+    def exec_open(self, n_txs: int,
+                  parent: Optional[ExecSession] = None) -> ExecSession:
+        return ExecSession(self, n_txs, self.shards, parent=parent)
 
     def _run_in_ctx(self, session: ExecSession, idx: int, fn):
         view = _SessionView(session, idx)
@@ -511,11 +608,16 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
 
     def exec_discard(self, session: ExecSession) -> None:
         session.closed = True
+        session.release()
 
     def exec_promote(self, session: ExecSession) -> None:
         """Apply the session in block order: per key the final version
         wins (idx order), buffered scalars sum, pending validator
-        updates land on the base list for EndBlock parity.
+        updates land on the base list for EndBlock parity. A chained
+        session refuses to promote before its parent (chain order is
+        commit order); promote does NOT release the overlay — a live
+        child keeps reading the parent's final versions, which are
+        identical to the post-promote base.
 
         Keys apply in SORTED order, never stripe/insertion order: which
         stripe a key lives on and when its version list was created are
@@ -527,6 +629,9 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
         rule DT-3)."""
         if session.closed:
             raise RuntimeError("exec session already closed")
+        if session.parent is not None and not session.parent.promoted:
+            raise RuntimeError(
+                "chained session promoted before its parent")
         session.closed = True
         end = session.end_idx + 1
         final: Dict[bytes, object] = {}
@@ -552,3 +657,4 @@ class ShardedKVStoreApplication(ChurnKVStoreApplication):
         else:
             self._val_updates_base = (list(self._val_updates_base)
                                       + session.ordered_val_updates())
+        session.promoted = True

@@ -351,6 +351,14 @@ class BlockchainReactor(Reactor):
                 return processed > 0
             self.pool.pop_request()
             self.store.save_block(first, first_parts, second.last_commit)
+            # the pool head moved to k+1 after pop: stage it so the
+            # executor can run it speculatively on k's un-promoted
+            # overlay ([execution] speculate_depth >= 2; no-op default)
+            stage = getattr(self.block_exec, "stage_next_block", None)
+            if stage is not None:
+                nfirst, _ = self.pool.peek_two_blocks()
+                if nfirst is not None:
+                    stage(nfirst)
             self.state = self.block_exec.apply_block(self.state, first_id, first)
             self.blocks_synced += 1
             processed += 1
@@ -394,6 +402,13 @@ class BlockchainReactor(Reactor):
                 nfirst, nsecond = self.pool.peek_two_blocks()
                 if nfirst is not None and nsecond is not None:
                     nxt = self._begin_block_verify(nfirst, nsecond)
+                    # cross-height speculation: let k+1 execute on k's
+                    # un-promoted overlay while k applies (no-op unless
+                    # [execution] speculate_depth >= 2)
+                    stage = getattr(self.block_exec, "stage_next_block",
+                                    None)
+                    if stage is not None:
+                        stage(nfirst)
             self.state = self.block_exec.apply_block(
                 self.state, spec.block_id, spec.first)
             self.blocks_synced += 1

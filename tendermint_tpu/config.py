@@ -248,6 +248,23 @@ class ExecutionConfig:
     # per-tx loop (property-tested); False restores the per-tx publish
     # calls for bisecting.
     event_batch: bool = True
+    # persistent work-stealing lane pool (state/lanepool.py): lanes
+    # become long-lived workers created at node start instead of
+    # threads spawned per block — kills the per-block wakeup convoy
+    # the flight recorder measures. Default off = per-block spawning
+    # (the PR 12–16 behavior). Only meaningful with parallel_lanes > 1.
+    lane_pool: bool = False
+    # Block-STM conflict-cone retry: > 0 arms the fixpoint engine that
+    # re-executes only invalidated dependency cones in parallel rounds
+    # (at most this many) instead of one serial re-run pass; falls back
+    # to serial-through-overlay beyond the bound. 0 (default) keeps the
+    # legacy conflict path.
+    retry_max_rounds: int = 0
+    # cross-height speculation chain depth: 1 (default) speculates only
+    # on the committed base (the PR 12 behavior); >= 2 lets height h+1
+    # execute speculatively on h's still-un-promoted overlay, chained
+    # promote-or-discard at commit. Requires speculative = true.
+    speculate_depth: int = 1
 
 
 @dataclass

@@ -53,6 +53,8 @@ KNOWN_POINTS = (
     "Index.BeforeGenerationBump",
     "Mempool.MidAdmitChunk",
     "Exec.AfterSpeculationAdopt",
+    "Exec.MidRetryRound",
+    "Exec.AfterChainSpeculationStart",
     "Privval.BeforeSignStateSave",
     "Statesync.MidChunkApply",
 )

@@ -301,6 +301,12 @@ class StateMetrics:
     # fraction of a lane's lifetime spent executing txs (1.0 = no
     # scheduling overhead), labeled by lane index
     exec_lane_busy: object = NOP
+    # conflict-cone retry engine: txs re-executed in retry rounds
+    # (per-lane attribution lives in the flight recorder report)
+    exec_lane_retries: object = NOP
+    # work-stealing lane pool: groups a lane stole from a sibling's
+    # deque tail (nonzero = the pool is actually load-balancing)
+    exec_lane_steals: object = NOP
 
 
 @dataclass
@@ -561,6 +567,14 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Fraction of an exec lane's lifetime spent executing txs "
             "(1.0 = zero scheduling overhead).",
             ("lane",)),
+        exec_lane_retries=r.counter(
+            f"{ns}_exec_lane_retries_total",
+            "Transactions re-executed by the conflict-cone retry "
+            "engine (Block-STM fixpoint rounds)."),
+        exec_lane_steals=r.counter(
+            f"{ns}_exec_lane_steals_total",
+            "Groups stolen from a sibling lane's deque by the "
+            "persistent work-stealing pool."),
     )
     crypto = CryptoMetrics(
         batch_verify_seconds=r.histogram(

@@ -868,15 +868,22 @@ class Node:
         self._prof_server.start()
 
     def _exec_status(self) -> dict:
-        """/debug/exec: the exec-lane flight recorder report plus the
-        executor's configured lane count — empty-but-stable shape on a
-        lanes=1 or replica node (the threaded path never runs there)."""
+        """/debug/exec: the exec-lane flight recorder report (per-lane
+        wakeup/busy plus retry-round and work-steal attribution) and
+        the executor's configured lane count — empty-but-stable shape
+        on a lanes=1 or replica node (the threaded path never runs
+        there)."""
         from ..state import parallel as par
 
-        report = par.get_flight_recorder().report()
+        rec = par.get_flight_recorder()
+        report = rec.report()
+        report["retry"] = rec.retry_stats()
+        exec_cfg = (self.block_exec.exec_config
+                    if self.block_exec is not None else None)
         report["parallel_lanes"] = (
-            self.block_exec.exec_config.parallel_lanes
-            if self.block_exec is not None else 1)
+            exec_cfg.parallel_lanes if exec_cfg is not None else 1)
+        report["lane_pool"] = bool(
+            exec_cfg is not None and getattr(exec_cfg, "lane_pool", False))
         return report
 
     def _consensus_status(self) -> dict:

@@ -198,11 +198,30 @@ class BlockExecutor:
             from . import parallel as par
 
             par.get_flight_recorder().set_metrics(self.metrics)
-        # speculation slot: written by the consensus thread, the worker
-        # thread only fills its own slot object (state/parallel.py)
+        # persistent work-stealing lane pool ([execution] lane_pool):
+        # workers live from here to stop() — blocks are handed off by
+        # condition notify instead of per-block thread spawns
+        self._lane_pool = None
+        if (self.exec_config.parallel_lanes > 1
+                and getattr(self.exec_config, "lane_pool", False)):
+            from .lanepool import LanePool
+
+            self._lane_pool = LanePool(self.exec_config.parallel_lanes)
+            self._lane_pool.start()
+        # speculation slots, ascending height (> 1 entry only while a
+        # cross-height chained child is in flight): written by the
+        # consensus/sync thread, workers only fill their own slot
+        # objects (state/parallel.py)
         self._spec_lock = threading.Lock()
-        self._spec_slot = None
+        self._spec_slots: list = []
         self._spec_threads: list = []  # live exec-spec threads for stop()
+        # identity of the last overlay session promoted into the app —
+        # the adoption gate for chained slots (a child is only valid on
+        # the EXACT parent overlay it executed against)
+        self._last_promoted_session = None
+        # next-block hint from the sync reactors (stage_next_block):
+        # consumed by _exec_block to launch cross-height speculation
+        self._staged_next = None
         self._warned_no_parallel_app = False
 
     def set_event_bus(self, event_bus) -> None:
@@ -213,13 +232,21 @@ class BlockExecutor:
         return bool(self.exec_config.speculative)
 
     def stop(self) -> None:
-        """Settle any in-flight speculation so no exec-spec thread (or
-        undiscarded overlay session) outlives the executor's owner."""
+        """Settle any in-flight speculation and drain the persistent
+        lane pool so no exec thread (or undiscarded overlay session)
+        outlives the executor's owner."""
         with self._spec_lock:
-            slot, self._spec_slot = self._spec_slot, None
+            slots, self._spec_slots = self._spec_slots, []
             threads, self._spec_threads = list(self._spec_threads), []
-        if slot is not None:
+        # children first: a chained child must detach from its parent's
+        # overlay before the parent's sessions are released
+        for slot in reversed(slots):
             slot.abandon()
+        # stopping the pool unblocks any worker mid-run (its caller —
+        # an exec-spec thread — sees a RuntimeError and discards), so
+        # the pool goes down BEFORE the spec-thread joins
+        if self._lane_pool is not None:
+            self._lane_pool.stop()
         for t in threads:
             t.join(timeout=10)
         # uninstall only OUR metrics sink from the process-global flight
@@ -418,11 +445,16 @@ class BlockExecutor:
 
         run = self._take_speculation(state, block)
         if run is not None:
+            # chain BEFORE promote: the staged next block must execute
+            # against this block's genuinely un-promoted overlay (the
+            # cross-height speculation contract)
+            self._launch_chained(state, block, run)
             # promote through the session's OWN app handle: re-unwrapping
             # the proxy here could yield None mid-reconnect (the
             # ResilientClient swaps _client), and the session is bound to
             # the app object it executed against anyway
             run.session.app.exec_promote(run.session)
+            self._last_promoted_session = run.session
             # crash here = speculative writes promoted into the app's
             # working state but NOTHING committed (no app Commit, no
             # chain-state save): recovery must re-execute the block and
@@ -446,10 +478,76 @@ class BlockExecutor:
                     self._begin_block_request(state, block),
                     abci.RequestEndBlock(height=block.header.height),
                     lanes=self.exec_config.parallel_lanes,
-                    logger=self.logger)
+                    logger=self.logger,
+                    pool=self._lane_pool,
+                    retry_rounds=getattr(self.exec_config,
+                                         "retry_max_rounds", 0))
+                self._launch_chained(state, block, run)
                 app.exec_promote(run.session)
+                self._last_promoted_session = run.session
                 return self._finish_run(run, block)
+        self._staged_next = None
         return self.exec_block_on_proxy_app(state, block)
+
+    def stage_next_block(self, block) -> None:
+        """Sync-reactor hint: `block` is the block that will be applied
+        AFTER the one currently being applied. With [execution]
+        speculate_depth >= 2, _exec_block launches it speculatively on
+        the current block's un-promoted overlay. Cheap no-op otherwise
+        (the hint is dropped at the next dispatch)."""
+        if (self.speculation_enabled
+                and getattr(self.exec_config, "speculate_depth", 1) >= 2):
+            self._staged_next = block
+
+    def _launch_chained(self, state: State, block: Block, run) -> None:
+        """Launch the staged next block speculatively on `run`'s
+        still-un-promoted overlay (chained SpeculationSlot). `state` is
+        the PRE-apply state of `block`: the post-apply state's
+        last_validators — what the next block's LastCommitInfo is built
+        from — is exactly state.validators (update_state's shift)."""
+        nxt, self._staged_next = self._staged_next, None
+        if (nxt is None or not self.speculation_enabled
+                or getattr(self.exec_config, "speculate_depth", 1) < 2):
+            return
+        if nxt.header.height != block.header.height + 1:
+            return
+        from . import parallel as par
+
+        app = par.unwrap_parallel_app(self.proxy_app)
+        if app is None or app is not run.session.app:
+            return
+        breq = abci.RequestBeginBlock(
+            hash=nxt.hash() or b"",
+            header=nxt.header,
+            last_commit_info=make_last_commit_info(state.validators, nxt),
+            byzantine_validators=[
+                abci.Evidence(
+                    type="duplicate/vote",
+                    validator_address=ev.address(),
+                    height=ev.height(),
+                    time=nxt.header.time,
+                )
+                for ev in nxt.evidence.evidence
+            ],
+        )
+        slot = par.SpeculationSlot(
+            app, nxt.header.height, nxt.hash() or b"", b"",
+            parent_session=run.session)
+        slot.start(list(nxt.data.txs), breq,
+                   abci.RequestEndBlock(height=nxt.header.height),
+                   lanes=max(1, self.exec_config.parallel_lanes),
+                   pool=self._lane_pool,
+                   retry_rounds=getattr(self.exec_config,
+                                        "retry_max_rounds", 0))
+        # crash here = a speculative child is executing against an
+        # un-promoted parent overlay; NOTHING is durable (both sessions
+        # are memory-only) — replay must land on the same image
+        fail.fail_point("Exec.AfterChainSpeculationStart")
+        with self._spec_lock:
+            self._spec_slots.append(slot)
+            self._spec_threads = [t for t in self._spec_threads
+                                  if t.is_alive()]
+            self._spec_threads.append(slot.thread)
 
     def _finish_run(self, run, block: Block) -> ABCIResponses:
         if run.conflicts:
@@ -457,9 +555,10 @@ class BlockExecutor:
         invalid = sum(1 for r in run.deliver_res if not r.is_ok)
         self.logger.info(
             "executed block height=%d valid_txs=%d invalid_txs=%d "
-            "(parallel: conflicts=%d%s)",
+            "(parallel: conflicts=%d retry_rounds=%d%s)",
             block.header.height, len(run.deliver_res) - invalid, invalid,
-            run.conflicts, ", serial-fallback" if run.serial_fallback else "")
+            run.conflicts, getattr(run, "retry_rounds", 0),
+            ", serial-fallback" if run.serial_fallback else "")
         responses = ABCIResponses(list(run.deliver_res), run.end_res)
         responses.begin_block = run.begin_res
         return responses
@@ -485,48 +584,80 @@ class BlockExecutor:
         height = block.header.height
         block_hash = block.hash() or b""
         with self._spec_lock:
-            cur = self._spec_slot
-            if cur is not None and cur.matches(height, block_hash,
-                                               state.app_hash):
-                return False  # already speculating on this exact block
-            self._spec_slot = None
-        if cur is not None:
+            for cur in self._spec_slots:
+                if (cur.height == height and cur.block_hash == block_hash
+                        and (cur.parent_session is not None
+                             or cur.base_app_hash == state.app_hash)):
+                    # already speculating on this exact block (chained
+                    # slots settle their base via parent identity at
+                    # adoption time, not the app hash)
+                    return False
+            stale, self._spec_slots = self._spec_slots, []
+        for cur in reversed(stale):  # children before parents
             cur.abandon()
             self.metrics.exec_speculation_wasted.inc()
         slot = par.SpeculationSlot(app, height, block_hash, state.app_hash)
         slot.start(list(block.data.txs),
                    self._begin_block_request(state, block),
                    abci.RequestEndBlock(height=height),
-                   lanes=max(1, self.exec_config.parallel_lanes))
+                   lanes=max(1, self.exec_config.parallel_lanes),
+                   pool=self._lane_pool,
+                   retry_rounds=getattr(self.exec_config,
+                                        "retry_max_rounds", 0))
         with self._spec_lock:
-            self._spec_slot = slot
+            self._spec_slots.append(slot)
             self._spec_threads = [t for t in self._spec_threads
                                   if t.is_alive()]
             self._spec_threads.append(slot.thread)
         return True
 
+    def _slot_matches(self, slot, state: State, block: Block) -> bool:
+        height = block.header.height
+        block_hash = block.hash() or b""
+        if slot.parent_session is not None:
+            # a chained slot executed against an overlay, not the
+            # committed base: it is adoptable iff the decided block
+            # matches AND its parent overlay is the EXACT session that
+            # was just promoted (identity, not hash — two sessions can
+            # agree on state yet differ in un-promoted buffers)
+            return (slot.height == height
+                    and slot.block_hash == block_hash
+                    and slot.parent_session is self._last_promoted_session)
+        return slot.matches(height, block_hash, state.app_hash)
+
     def _take_speculation(self, state: State, block: Block):
-        """Settle the speculation slot against the DECIDED block:
-        matching slot → wait for the worker and hand its run to the
-        caller; anything else → abandon (the worker discards its own
+        """Settle the speculation slots against the DECIDED block: a
+        matching head slot → wait for the worker and hand its run to
+        the caller (descendant chained slots stay live — they become
+        adoptable once this run promotes); anything else → abandon the
+        whole chain children-first (each worker discards its own
         session) and count it wasted."""
         with self._spec_lock:
-            slot, self._spec_slot = self._spec_slot, None
-        if slot is None:
+            slots, self._spec_slots = self._spec_slots, []
+        if not slots:
             return None
-        if slot.matches(block.header.height, block.hash() or b"",
-                        state.app_hash):
-            run = slot.wait()
-            if run is None:
-                # worker failed: surface like a serial exec would have
-                if slot.error is not None:
-                    self.logger.warning(
-                        "speculative execution failed (%s); re-executing",
-                        slot.error)
+        head, rest = slots[0], slots[1:]
+        if self._slot_matches(head, state, block):
+            run = head.wait()
+            if run is not None:
+                with self._spec_lock:
+                    self._spec_slots = rest + self._spec_slots
+                return run
+            # worker failed: surface like a serial exec would have —
+            # and any chained descendants are rooted in the dead
+            # session, so the rest of the chain is garbage
+            if head.error is not None:
+                self.logger.warning(
+                    "speculative execution failed (%s); re-executing",
+                    head.error)
+            self.metrics.exec_speculation_wasted.inc()
+            for slot in reversed(rest):
+                slot.abandon()
                 self.metrics.exec_speculation_wasted.inc()
-            return run
-        slot.abandon()
-        self.metrics.exec_speculation_wasted.inc()
+            return None
+        for slot in reversed(slots):
+            slot.abandon()
+            self.metrics.exec_speculation_wasted.inc()
         return None
 
     def _fire_events(self, block: Block, abci_responses: ABCIResponses, val_updates) -> None:

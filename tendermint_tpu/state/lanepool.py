@@ -1,0 +1,297 @@
+"""Persistent work-stealing exec-lane pool.
+
+PR 16's flight recorder put a number on the parallel executor's
+remaining ceiling: every block SPAWNED its lanes, and the
+spawn→first-instruction convoy cost ~0.15ms/tx (~9ms p99 at 64 lanes
+on a loaded 2-cpu box). This module replaces per-block thread creation
+with a pool of long-lived "exec-lane-*" workers created once at node
+start (BlockExecutor owns the lifecycle; Node.stop drains and joins it
+— the conftest thread-hygiene families enforce that) and fed work via
+per-lane condition handoffs: one targeted poke per participating lane
+instead of N clone(2) calls (or a notify_all stampede through a single
+wait queue).
+
+Scheduling model:
+
+- A **run** is one batch of footprint-disjoint tx groups (a parallel
+  segment, or one retry round of the conflict-cone engine in
+  state/parallel.py). `run_groups` distributes the groups round-robin
+  across per-lane deques and blocks until the run drains.
+- Workers pop their OWN deque from the head (FIFO) and, when empty,
+  STEAL from the tail of the busiest sibling — classic work-stealing,
+  so a lane stuck behind a heavy group sheds its queue to idle lanes.
+  Steal events are reported to the flight recorder per lane
+  (`exec_lane_steals_total`).
+- Several runs may be in flight at once (a block's segment plus a
+  cross-height speculative block): workers scan the active-run list in
+  submission order, so speculation work fills lanes the current block
+  leaves idle — the cross-height work-stealing the ROADMAP names.
+
+Determinism: groups within a run are access-disjoint by construction
+(the planner/retry engine guarantees it), so lane placement and steal
+order affect only TIMING, never results — same argument as the PR 12
+per-segment threads. A group's txs always execute in block order on
+whichever lane runs the group.
+
+Error semantics match the legacy spawned lanes: the first exception
+cancels the run's remaining groups (workers drain them unexecuted) and
+re-raises from `run_groups`; the caller discards the overlay session.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+__all__ = ["LanePool"]
+
+
+class _PoolRun:
+    """One submitted batch of groups plus its per-lane accounting."""
+
+    __slots__ = ("deques", "execute", "remaining", "errors", "cancelled",
+                 "done", "submit_ns", "lane_first_ns", "lane_idle_wake",
+                 "lane_wake_ns", "lane_busy_ns", "lane_txs",
+                 "lane_groups", "lane_steals")
+
+    def __init__(self, groups: Sequence[Sequence[int]],
+                 execute: Callable, lanes: int):
+        self.deques: List[collections.deque] = [
+            collections.deque() for _ in range(lanes)]
+        for n, g in enumerate(groups):
+            self.deques[n % lanes].append(g)
+        self.execute = execute
+        self.remaining = len(groups)
+        self.errors: List[BaseException] = []
+        self.cancelled = False
+        self.done = threading.Event()
+        self.submit_ns = 0
+        # per-lane slots: each index is touched only by that worker
+        # thread (and read after done.set()), so no lock is needed
+        self.lane_first_ns = [0] * lanes
+        # True when the lane's FIRST dequeue of this run came off a
+        # cond.wait (idle → woken by this run's notify): only those
+        # lanes yield a wakeup sample — a lane rolling straight from a
+        # previous run's group has zero handoff convoy by construction,
+        # and submit→first-dequeue for it would measure queueing behind
+        # real work, not wakeup latency
+        self.lane_idle_wake = [False] * lanes
+        # poke→first-dequeue span for idle-woken lanes: the per-lane
+        # handoff latency, same clock semantics as the spawned path's
+        # per-thread spawn→first-instruction sample
+        self.lane_wake_ns = [0] * lanes
+        self.lane_busy_ns = [0] * lanes
+        self.lane_txs = [0] * lanes
+        self.lane_groups = [0] * lanes
+        self.lane_steals = [0] * lanes
+
+
+class LanePool:
+    """`lanes` persistent exec-lane workers with work stealing.
+
+    Created started=False; the owner calls start() once (node boot /
+    first parallel block) and stop() exactly once on shutdown. All
+    workers are named "exec-lane-<k>" — the same thread family the
+    per-segment spawned lanes used, so the conftest leak assert covers
+    the pool without a new family."""
+
+    def __init__(self, lanes: int):
+        self.lanes = max(1, int(lanes))
+        self._lock = threading.Lock()
+        # one condition PER LANE (all over the same mutex): submission
+        # pokes lanes individually instead of notify_all, so 64 lanes
+        # don't stampede one wait queue — and each poke stamps that
+        # lane's wakeup clock base, mirroring the spawned path's
+        # per-thread spawn timestamp
+        self._conds = [threading.Condition(self._lock)
+                       for _ in range(self.lanes)]
+        self._notify_ns = [0] * self.lanes
+        self._waiting = [False] * self.lanes
+        self._runs: List[_PoolRun] = []
+        self._threads: List[threading.Thread] = []
+        self._started = False
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        with self._lock:
+            if self._started or self._stopped:
+                return
+            self._started = True
+        for k in range(self.lanes):
+            t = threading.Thread(target=self._worker, args=(k,),
+                                 name=f"exec-lane-{k}")
+            self._threads.append(t)
+            t.start()
+
+    @property
+    def started(self) -> bool:
+        with self._lock:
+            return self._started and not self._stopped
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain and join every worker. In-flight runs are cancelled
+        (their callers unblock with a RuntimeError), queued groups are
+        dropped — stop is a shutdown, not a flush."""
+        with self._lock:
+            self._stopped = True
+            for run in self._runs:
+                if not run.cancelled:
+                    run.cancelled = True
+                    run.errors.append(
+                        RuntimeError("lane pool stopped mid-run"))
+                run.done.set()
+            for cond in self._conds:
+                cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []
+
+    # -- submission ----------------------------------------------------
+
+    def run_groups(self, groups: Sequence[Sequence[int]],
+                   execute: Callable, recorder=None,
+                   retry: bool = False) -> None:
+        """Execute `execute(group)` for every group; blocks until all
+        finished (or the run is cancelled by an error/stop). Raises the
+        first group exception. When `recorder` (a FlightRecorder) is
+        given, each participating lane reports one sample: wakeup =
+        submit→first-dequeue for lanes this run woke from idle (the
+        handoff latency that replaces the spawn convoy — lanes that
+        rolled straight from another run's work contribute busy time
+        but no wakeup sample), busy = summed execute time, plus steals;
+        `retry` marks the run as a conflict-cone re-execution round so
+        the lane's txs count as retries (`exec_lane_retries_total`)."""
+        if not groups:
+            return
+        run = _PoolRun(groups, execute, self.lanes)
+        with self._lock:
+            if self._stopped or not self._started:
+                raise RuntimeError("lane pool is not running")
+            run.submit_ns = time.monotonic_ns()
+            self._runs.append(run)
+        # staggered per-lane pokes, one lock window each: lanes start
+        # the moment their poke lands instead of stampeding a single
+        # notify_all, and each poke stamps the lane's own wakeup clock
+        # base. Lanes 0..n_targets-1 hold this run's deques; an idle
+        # NON-target lane is only worth waking as a thief when some
+        # target is busy with another run's work (stealing moves whole
+        # queued groups, so with groups <= lanes and all targets awake
+        # there is nothing a thief could ever take).
+        n_targets = min(self.lanes, len(groups))
+        for k in range(self.lanes):
+            if run.done.is_set():
+                break
+            with self._lock:
+                if k >= n_targets and not (
+                        self._waiting[k]
+                        and any(not self._waiting[j]
+                                for j in range(n_targets))):
+                    continue
+                self._notify_ns[k] = time.monotonic_ns()
+                self._conds[k].notify()
+        if recorder is not None:
+            # submit→last-poke: the pool's critical-path dispatch cost,
+            # the apples-to-apples twin of the spawned path's serialized
+            # t.start() loop (pokes never block on the woken lane)
+            recorder.record_dispatch(time.monotonic_ns() - run.submit_ns)
+        run.done.wait()
+        with self._lock:
+            if run in self._runs:
+                self._runs.remove(run)
+        if recorder is not None:
+            for k in range(self.lanes):
+                if run.lane_first_ns[k]:
+                    wake = (run.lane_wake_ns[k]
+                            if run.lane_idle_wake[k] else None)
+                    recorder.record_lane(
+                        k, wake, run.lane_busy_ns[k], run.lane_txs[k],
+                        run.lane_groups[k])
+                    if retry and run.lane_txs[k]:
+                        recorder.record_retries(k, run.lane_txs[k])
+                if run.lane_steals[k]:
+                    recorder.record_steals(k, run.lane_steals[k])
+        if run.errors:
+            raise run.errors[0]
+
+    # -- workers -------------------------------------------------------
+
+    def _take_locked(self, k: int):
+        """One scheduling decision under the pool lock: own deque head
+        across active runs first, else steal from the longest sibling
+        deque's tail. Returns (run, group, stolen) or None."""
+        for run in self._runs:
+            if run.cancelled:
+                continue
+            if run.deques[k]:
+                return run, run.deques[k].popleft(), False
+        best = None
+        best_len = 0
+        for run in self._runs:
+            if run.cancelled:
+                continue
+            for j in range(self.lanes):
+                if j != k and len(run.deques[j]) > best_len:
+                    best = (run, j)
+                    best_len = len(run.deques[j])
+        if best is not None:
+            run, j = best
+            return run, run.deques[j].pop(), True
+        return None
+
+    def _finish_one(self, run: _PoolRun) -> None:
+        with self._lock:
+            run.remaining -= 1
+            if run.remaining <= 0 or run.cancelled:
+                run.done.set()
+
+    def _worker(self, k: int) -> None:
+        while True:
+            with self._lock:
+                task = None
+                waited = False
+                while task is None:
+                    if self._stopped:
+                        return
+                    task = self._take_locked(k)
+                    if task is None:
+                        waited = True
+                        self._waiting[k] = True
+                        self._conds[k].wait()
+                        self._waiting[k] = False
+                now = time.monotonic_ns()
+                poked_at = self._notify_ns[k]
+            run, group, stolen = task
+            if run.lane_first_ns[k] == 0:
+                run.lane_first_ns[k] = now
+                run.lane_idle_wake[k] = waited
+                if waited:
+                    # handoff span: OUR poke → first dequeue (clock
+                    # base per lane, like the spawned path's per-thread
+                    # spawn timestamp); 0-base means a spurious wake
+                    # raced a poke — fall back to the submit instant
+                    base = poked_at or run.submit_ns
+                    run.lane_wake_ns[k] = max(0, now - base)
+            if stolen:
+                run.lane_steals[k] += 1
+            if run.cancelled:
+                self._finish_one(run)
+                continue
+            t0 = time.monotonic_ns()
+            try:
+                run.execute(group)
+            except BaseException as e:  # noqa: BLE001 - re-raised by run_groups
+                with self._lock:
+                    run.errors.append(e)
+                    run.cancelled = True
+            finally:
+                run.lane_busy_ns[k] += time.monotonic_ns() - t0
+                run.lane_groups[k] += 1
+                try:
+                    run.lane_txs[k] += len(group)
+                except TypeError:
+                    pass
+            self._finish_one(run)

@@ -16,21 +16,34 @@ as the conformance oracle:
    threads ("exec-lane-*"), each group's txs in block order, every
    state access buffered in the app's MVCC overlay session (reads
    resolve to the highest version below the reader's tx index).
-3. **Detect & re-run** — after a segment, any tx whose OBSERVED
+3. **Detect & retry** — after a segment, any tx whose OBSERVED
    reads/writes overlap another group's writes (a footprint lie or an
-   inference miss) is re-run serially in block order against the now-
-   settled overlay. If a re-run's writes invalidate a clean tx's reads
-   (pathological), the whole block falls back to serial-through-overlay.
+   inference miss) is invalidated. With `retry_max_rounds > 0` the
+   Block-STM-style conflict-cone engine takes over: the dirty txs are
+   regrouped by their observed journals and re-executed IN PARALLEL,
+   then every later tx whose reads overlap a re-run's write delta
+   joins the next round's cone — iterating to fixpoint
+   (`_retry_fixpoint`), so high-conflict blocks stay parallel. The
+   legacy path (`retry_max_rounds = 0`) re-runs conflicted txs
+   serially once. Either way, an unsettled cone falls back to
+   serial-through-overlay on a fresh session.
 4. **Promote or discard** — `exec_promote` applies final versions in
    block order; a discarded session (failed speculation) leaves zero
    trace in app state.
+
+Lanes are either per-segment spawned threads (legacy) or, with
+`[execution] lane_pool = true`, a persistent work-stealing pool
+(state/lanepool.py) fed by condition-variable handoff — the
+spawn-convoy fix the PR 16 flight recorder motivated.
 
 Speculative execution rides the same machinery: `SpeculationSlot` runs
 the proposed block on a background thread ("exec-spec") during the
 prevote/precommit window with promote deferred to commit time; the
 decided block either adopts the precomputed session (hash + base-state
 match) or discards it, so speculative state is never visible in state,
-WAL, or RPC before finalize.
+WAL, or RPC before finalize. With `speculate_depth >= 2` slots CHAIN:
+h+1 executes on h's still-un-promoted overlay (`parent_session`),
+adoptable only if that exact parent session was promoted.
 
 Serial-equivalence argument (property-tested in
 tests/test_parallel_exec.py): a clean tx's observed accesses are
@@ -88,6 +101,22 @@ class FlightRecorder:
         self._block_count = 0
         self._conflict_txs = 0
         self._serial_fallbacks = 0
+        # retry-DAG + work-stealing attribution (PR 17): per-lane
+        # cumulative steal/retried-tx counters plus a ring of per-block
+        # retry round counts for the BENCH-line p99
+        self._steals: Dict[int, int] = {}
+        self._retries: Dict[int, int] = {}
+        self._retry_rounds: collections.deque = collections.deque(
+            maxlen=self._capacity)
+        # per-run critical-path dispatch cost: the wall time the
+        # SUBMITTER spends launching lanes (spawn loop of t.start()
+        # calls, or the pool's poke loop). This is the convoy number
+        # the two engines can be compared on — per-lane wakeup samples
+        # cannot: Thread.start() blocks until the new thread runs, so
+        # the spawned path hides its convoy in the submit loop, while
+        # the pool's non-blocking pokes surface theirs in the samples.
+        self._dispatch: collections.deque = collections.deque(
+            maxlen=self._capacity)
         self._metrics = None  # StateMetrics sink or None
 
     # -- lifecycle -----------------------------------------------------
@@ -126,14 +155,21 @@ class FlightRecorder:
             self._block_count = 0
             self._conflict_txs = 0
             self._serial_fallbacks = 0
+            self._steals.clear()
+            self._retries.clear()
+            self._retry_rounds.clear()
+            self._dispatch.clear()
 
     # -- recording (threaded exec path only) ---------------------------
 
-    def record_lane(self, lane: int, wakeup_ns: int, busy_ns: int,
+    def record_lane(self, lane: int, wakeup_ns, busy_ns: int,
                     txs: int, groups: int) -> None:
         """One lane lifetime: spawn→first-instruction latency plus the
-        busy span draining the group cursor."""
-        wakeup_ns = max(0, wakeup_ns)
+        busy span draining the group cursor. wakeup_ns=None records the
+        busy/throughput sample WITHOUT a wakeup observation (pool lanes
+        that rolled straight from a previous run's work: no handoff
+        convoy happened, so there is nothing to measure)."""
+        wakeup_ns = -1 if wakeup_ns is None else max(0, wakeup_ns)
         busy_ns = max(0, busy_ns)
         with self._lock:
             ring = self._lanes.get(lane)
@@ -144,22 +180,51 @@ class FlightRecorder:
                          "txs": txs, "groups": groups})
         m = self._metrics
         if m is not None:
-            m.exec_lane_wakeup.observe(wakeup_ns / 1e9)
-            life = wakeup_ns + busy_ns
+            if wakeup_ns >= 0:
+                m.exec_lane_wakeup.observe(wakeup_ns / 1e9)
+            life = max(wakeup_ns, 0) + busy_ns
             if life > 0:
                 m.exec_lane_busy.with_labels(str(lane)).set(
                     busy_ns / life)
 
+    def record_dispatch(self, ns: int) -> None:
+        """One run's critical-path lane-launch span (see __init__)."""
+        with self._lock:
+            self._dispatch.append(max(0, ns))
+
+    def record_steals(self, lane: int, n: int = 1) -> None:
+        """`n` work-steal events on `lane` (pool path only: a spawned
+        per-segment lane never steals — it drains a shared cursor)."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._steals[lane] = self._steals.get(lane, 0) + n
+        m = self._metrics
+        if m is not None:
+            m.exec_lane_steals.inc(n)
+
+    def record_retries(self, lane: int, n: int = 1) -> None:
+        """`n` txs re-executed on `lane` by a retry-DAG round."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._retries[lane] = self._retries.get(lane, 0) + n
+        m = self._metrics
+        if m is not None:
+            m.exec_lane_retries.inc(n)
+
     def note_block(self, txs: int, parallel_txs: int, conflicts: int,
-                   serial_fallback: bool, lanes: int) -> None:
+                   serial_fallback: bool, lanes: int,
+                   retry_rounds: int = 0) -> None:
         with self._lock:
             self._block_count += 1
             self._conflict_txs += conflicts
             if serial_fallback:
                 self._serial_fallbacks += 1
+            self._retry_rounds.append(retry_rounds)
             self._blocks.append({
                 "txs": txs, "parallel_txs": parallel_txs,
-                "conflicts": conflicts,
+                "conflicts": conflicts, "retry_rounds": retry_rounds,
                 "serial_fallback": serial_fallback, "lanes": lanes,
             })
 
@@ -178,11 +243,41 @@ class FlightRecorder:
         `bench.py load --parallel` BENCH-line summary)."""
         with self._lock:
             all_w = sorted(s["wakeup_ns"] for ring in self._lanes.values()
-                           for s in ring)
+                           for s in ring if s["wakeup_ns"] >= 0)
         return {
             "count": len(all_w),
             "p50_s": self._pctl(all_w, 0.50) / 1e9,
             "p99_s": self._pctl(all_w, 0.99) / 1e9,
+        }
+
+    def dispatch_percentiles(self) -> Dict[str, float]:
+        """p50/p99 per-run lane-launch (dispatch) cost in SECONDS: the
+        submitter-side convoy — the one number comparable between the
+        spawned path and the pool (see __init__ for why per-lane wakeup
+        samples are not)."""
+        with self._lock:
+            d = sorted(self._dispatch)
+        return {
+            "count": len(d),
+            "p50_s": self._pctl(d, 0.50) / 1e9,
+            "p99_s": self._pctl(d, 0.99) / 1e9,
+        }
+
+    def retry_stats(self) -> Dict[str, float]:
+        """Retry-DAG/steal summary for the `load --parallel` BENCH
+        line: per-block retry-round p99, total retried txs, and the
+        steal ratio (steals / group executions on the pool)."""
+        with self._lock:
+            rounds = sorted(self._retry_rounds)
+            steals = sum(self._steals.values())
+            retried = sum(self._retries.values())
+            tasks = sum(s["groups"] for ring in self._lanes.values()
+                        for s in ring)
+        return {
+            "retry_rounds_p99": self._pctl(rounds, 0.99),
+            "retried_txs": retried,
+            "steals": steals,
+            "steal_ratio": round(steals / tasks, 6) if tasks else 0.0,
         }
 
     def report(self) -> dict:
@@ -190,7 +285,8 @@ class FlightRecorder:
         with self._lock:
             lanes = {}
             for lane, ring in sorted(self._lanes.items()):
-                wake = sorted(s["wakeup_ns"] for s in ring)
+                wake = sorted(s["wakeup_ns"] for s in ring
+                              if s["wakeup_ns"] >= 0)
                 busy = sum(s["busy_ns"] for s in ring)
                 life = busy + sum(wake)
                 lanes[str(lane)] = {
@@ -202,11 +298,18 @@ class FlightRecorder:
                     "busy_ratio": round(busy / life, 6) if life else 0.0,
                     "txs": sum(s["txs"] for s in ring),
                     "groups": sum(s["groups"] for s in ring),
+                    "steals": self._steals.get(lane, 0),
+                    "retried_txs": self._retries.get(lane, 0),
                 }
+            rounds = sorted(self._retry_rounds)
+            disp = sorted(self._dispatch)
             blocks = {
                 "count": self._block_count,
                 "conflict_txs": self._conflict_txs,
                 "serial_fallbacks": self._serial_fallbacks,
+                "retry_rounds_p99": self._pctl(rounds, 0.99),
+                "dispatch_p50_us": round(self._pctl(disp, 0.50) / 1e3, 3),
+                "dispatch_p99_us": round(self._pctl(disp, 0.99) / 1e3, 3),
                 "recent": list(self._blocks)[-32:],
             }
             enabled = self._enabled
@@ -350,25 +453,48 @@ class BlockRun:
     collected responses (promote still pending)."""
 
     __slots__ = ("session", "begin_res", "deliver_res", "end_res",
-                 "conflicts", "serial_fallback")
+                 "conflicts", "serial_fallback", "retry_rounds")
 
     def __init__(self, session, begin_res, deliver_res, end_res,
-                 conflicts: int, serial_fallback: bool):
+                 conflicts: int, serial_fallback: bool,
+                 retry_rounds: int = 0):
         self.session = session
         self.begin_res = begin_res
         self.deliver_res = deliver_res
         self.end_res = end_res
         self.conflicts = conflicts
         self.serial_fallback = serial_fallback
+        self.retry_rounds = retry_rounds
+
+
+def _open_session(app, n_txs: int, parent):
+    """exec_open, chaining onto a parent overlay session when given
+    (cross-height speculation). Plain apps that predate the parent
+    keyword keep working for the unchained path."""
+    if parent is None:
+        return app.exec_open(n_txs)
+    return app.exec_open(n_txs, parent=parent)
 
 
 def run_block(app, txs: Sequence[bytes], begin_req, end_req,
-              lanes: int = 1, logger=None) -> BlockRun:
+              lanes: int = 1, logger=None, pool=None,
+              retry_rounds: int = 0, parent=None) -> BlockRun:
     """Execute one block optimistically against `app`'s exec-session
     surface. Raises whatever the app raises (the caller treats it like
     a serial execution failure); on unresolvable conflicts falls back
     to serial-through-overlay (still session-buffered, so speculation
-    stays discardable)."""
+    stays discardable).
+
+    pool: a started lanepool.LanePool — groups run on the persistent
+    workers instead of per-segment spawned threads (kills the wakeup
+    convoy). retry_rounds > 0 arms the Block-STM-style conflict-cone
+    engine: instead of one segment-scoped re-run pass (and a whole-
+    block serial fallback on any cross-invalidation), conflicted txs
+    and their dependency cones re-execute in parallel rounds to
+    fixpoint — serial fallback only if the cone hasn't settled after
+    `retry_rounds` rounds. parent: an un-promoted overlay session the
+    new session reads THROUGH (cross-height speculation: h+1 executes
+    on h's final versions before h promotes)."""
     logger = logger or LOG
     txs = list(txs)
     infer = getattr(app, "infer_footprint", None)
@@ -376,19 +502,28 @@ def run_block(app, txs: Sequence[bytes], begin_req, end_req,
     footprints = [tx_footprint(tx, infer, body_of) for tx in txs]
     plan = plan_block(footprints)
 
-    session = app.exec_open(len(txs))
+    session = _open_session(app, len(txs), parent)
     try:
         begin_res = app.exec_begin_block(session, begin_req)
         responses: List = [None] * len(txs)
         conflicts = 0
+        rounds = 0
         aborted = False
         for seg in plan.segments:
             if seg.is_barrier:
                 i = seg.serial_idx
                 responses[i] = app.exec_deliver_tx(session, i, txs[i])
                 continue
-            _run_segment(app, session, txs, seg, lanes, responses)
-            n_conf = _resolve_conflicts(app, session, txs, seg, responses)
+            _execute_groups(app, session, txs, seg.groups, lanes,
+                            responses, pool)
+            if retry_rounds > 0:
+                n_conf, n_rounds = _retry_fixpoint(
+                    app, session, txs, seg, responses, retry_rounds,
+                    lanes, pool)
+                rounds = max(rounds, n_rounds)
+            else:
+                n_conf = _resolve_conflicts(app, session, txs, seg,
+                                            responses)
             if n_conf < 0:
                 aborted = True
                 break
@@ -401,48 +536,73 @@ def run_block(app, txs: Sequence[bytes], begin_req, end_req,
                 "parallel execution aborted after conflict re-run; "
                 "falling back to serial-through-overlay")
             app.exec_discard(session)
-            session = app.exec_open(len(txs))
+            session = _open_session(app, len(txs), parent)
             begin_res = app.exec_begin_block(session, begin_req)
             responses = [app.exec_deliver_tx(session, i, tx)
                          for i, tx in enumerate(txs)]
             end_res = app.exec_end_block(session, end_req)
             if _RECORDER.enabled:
                 _RECORDER.note_block(len(txs), plan.parallel_txs,
-                                     conflicts, True, lanes)
+                                     conflicts, True, lanes, rounds)
             return BlockRun(session, begin_res, responses, end_res,
-                            conflicts, True)
+                            conflicts, True, rounds)
         end_res = app.exec_end_block(session, end_req)
         if _RECORDER.enabled:
             _RECORDER.note_block(len(txs), plan.parallel_txs,
-                                 conflicts, False, lanes)
+                                 conflicts, False, lanes, rounds)
         return BlockRun(session, begin_res, responses, end_res,
-                        conflicts, False)
+                        conflicts, False, rounds)
     except BaseException:
         app.exec_discard(session)
         raise
 
 
-def _run_segment(app, session, txs, seg: Segment, lanes: int,
-                 responses: List) -> None:
-    """Run a parallel segment's groups over up to `lanes` workers. Each
-    worker drains groups from a shared cursor; a group's txs execute in
-    block order. Worker exceptions re-raise here after the join."""
-    groups = seg.groups
-    n_workers = max(1, min(lanes, len(groups)))
-    if n_workers == 1:
+def _execute_groups(app, session, txs, groups: List[List[int]],
+                    lanes: int, responses: List, pool=None,
+                    redeliver: bool = False) -> None:
+    """Run access-disjoint groups concurrently — on the persistent
+    pool when one is live, else per-call spawned threads (the legacy
+    path, kept for pool-less callers and as the spawn-convoy baseline
+    the flight recorder measures). A group's txs execute in block
+    order; the first group exception re-raises here."""
+    if not groups:
+        return
+    deliver = app.exec_redeliver_tx if redeliver else app.exec_deliver_tx
+    if len(groups) == 1 or lanes <= 1 or (
+            pool is None and min(lanes, len(groups)) <= 1):
         for g in groups:
             for i in g:
-                responses[i] = app.exec_deliver_tx(session, i, txs[i])
+                responses[i] = deliver(session, i, txs[i])
         return
+    recorder = _RECORDER if _RECORDER.enabled else None
+    if pool is not None and getattr(pool, "started", False):
+
+        def run_group(g):
+            for i in g:
+                responses[i] = deliver(session, i, txs[i])
+
+        pool.run_groups(groups, run_group, recorder=recorder,
+                        retry=redeliver)
+        return
+    _run_groups_threads(groups, deliver, session, txs, lanes, responses,
+                        recorder, redeliver)
+
+
+def _run_groups_threads(groups, deliver, session, txs, lanes: int,
+                        responses: List, recorder,
+                        redeliver: bool = False) -> None:
+    """Per-call spawned lanes draining a shared group cursor (the
+    PR-12 execution path). The spawn→first-instruction gap IS the
+    wakeup convoy the flight recorder attributes — and the persistent
+    pool exists to kill."""
+    n_workers = max(1, min(lanes, len(groups)))
     cursor_lock = threading.Lock()
     cursor = [0]
     errors: List[BaseException] = []
-    recorder = _RECORDER if _RECORDER.enabled else None
     spawn_ns = [0] * n_workers
 
     def lane(k: int):
-        # first instruction: the spawn→here gap IS the wakeup convoy
-        # the flight recorder exists to attribute (monotonic, never
+        # first instruction: the spawn→here gap (monotonic, never
         # wall — consensus-scope determinism rule)
         t0 = time.monotonic_ns() if recorder is not None else 0
         n_txs = 0
@@ -456,8 +616,7 @@ def _run_segment(app, session, txs, seg: Segment, lanes: int,
                     cursor[0] = pos + 1
                 try:
                     for i in groups[pos]:
-                        responses[i] = app.exec_deliver_tx(
-                            session, i, txs[i])
+                        responses[i] = deliver(session, i, txs[i])
                 except BaseException as e:  # noqa: BLE001 - re-raised below
                     errors.append(e)
                     return
@@ -468,45 +627,56 @@ def _run_segment(app, session, txs, seg: Segment, lanes: int,
                 recorder.record_lane(
                     k, t0 - spawn_ns[k], time.monotonic_ns() - t0,
                     n_txs, n_groups)
+                if redeliver:
+                    recorder.record_retries(k, n_txs)
 
     threads = []
+    d0 = time.monotonic_ns()
     for k in range(n_workers):
         t = threading.Thread(target=lane, args=(k,),
                              name=f"exec-lane-{k}")
         threads.append(t)
         spawn_ns[k] = time.monotonic_ns()
         t.start()
+    if recorder is not None:
+        # t.start() blocks until the lane thread actually runs, so
+        # this span is the submit loop's serialized clone(2) convoy —
+        # the critical-path cost the pool's poke loop replaces
+        recorder.record_dispatch(time.monotonic_ns() - d0)
     for t in threads:
         t.join()
     if errors:
         raise errors[0]
 
 
-def _resolve_conflicts(app, session, txs, seg: Segment,
-                       responses: List) -> int:
-    """Detect observed-access conflicts across the segment's groups and
-    re-run the conflicted txs serially in block order. Returns the
-    number of re-run txs, or -1 if the re-runs invalidated a clean tx
-    (full-serial fallback required)."""
+def _segment_journals(session, indices: List[int]) -> Dict[int, tuple]:
+    """Per-idx (reads, writes) after the lanes joined. The session
+    journal is quiescent, so read the sets directly when the session
+    exposes them — no per-tx lock round trip or set copy (the
+    conflict-free common case is a pure scan)."""
+    s_reads = getattr(session, "reads", None)
+    s_writes = getattr(session, "writes", None)
+    if s_reads is not None and s_writes is not None:
+        return {i: (s_reads.get(i, frozenset()),
+                    s_writes.get(i, frozenset()))
+                for i in indices}
+    # foreign sessions expose only the copying journal() API
+    return {i: session.journal(i) for i in indices}
+
+
+def _detect_conflicts(session, seg: Segment) -> List[int]:
+    """Observed-access conflict scan across the segment's groups: every
+    tx whose reads or writes overlap ANOTHER group's writes (a
+    footprint lie or an inference miss), ascending tx order."""
     groups = seg.groups
     if len(groups) <= 1:
-        return 0
+        return []
     group_of = {}
     for gid, g in enumerate(groups):
         for i in g:
             group_of[i] = gid
     indices = sorted(group_of)
-    # the lanes are joined: the session journal is quiescent, so read
-    # the per-idx sets directly — no per-tx lock round trip or set copy
-    # (the conflict-free common case is a pure scan)
-    s_reads = getattr(session, "reads", None)
-    s_writes = getattr(session, "writes", None)
-    if s_reads is not None and s_writes is not None:
-        journals = {i: (s_reads.get(i, frozenset()),
-                        s_writes.get(i, frozenset()))
-                    for i in indices}
-    else:  # foreign sessions expose only the copying journal() API
-        journals = {i: session.journal(i) for i in indices}
+    journals = _segment_journals(session, indices)
     writers: dict = {}  # key -> set of gids that wrote it
     for i in indices:
         for k in journals[i][1]:
@@ -530,13 +700,24 @@ def _resolve_conflicts(app, session, txs, seg: Segment,
                     break
         if hit:
             conflicted.append(i)
+    return conflicted
+
+
+def _resolve_conflicts(app, session, txs, seg: Segment,
+                       responses: List) -> int:
+    """The legacy (retry_max_rounds = 0) conflict path: re-run the
+    conflicted txs serially in block order. Returns the number of
+    re-run txs, or -1 if the re-runs invalidated a clean tx
+    (full-serial fallback required)."""
+    conflicted = _detect_conflicts(session, seg)
     if not conflicted:
         return 0
-
+    indices = sorted(i for g in seg.groups for i in g)
+    journals = _segment_journals(session, indices)
     conflicted_set = set(conflicted)
     clean = [i for i in indices if i not in conflicted_set]
     clean_reads = {i: set(journals[i][0]) for i in clean}
-    for i in sorted(conflicted):
+    for i in conflicted:
         responses[i] = app.exec_redeliver_tx(session, i, txs[i])
         _, new_writes = session.journal(i)
         # a re-run write under a LATER clean tx's read means that read
@@ -545,6 +726,86 @@ def _resolve_conflicts(app, session, txs, seg: Segment,
             if j > i and (new_writes & clean_reads[j]):
                 return -1
     return len(conflicted)
+
+
+def _retry_fixpoint(app, session, txs, seg: Segment, responses: List,
+                    max_rounds: int, lanes: int, pool=None) -> tuple:
+    """Block-STM-style conflict-cone retry: iterate PARALLEL re-execute
+    rounds over exactly the invalidated dependency cone until fixpoint.
+
+    Round 0's dirty set is the conservative cross-group overlap scan.
+    Each round: group the dirty txs by their OBSERVED access journals
+    (union-find, same deterministic ordering as the planner), re-run
+    the groups concurrently, then invalidate every later tx whose reads
+    overlap a re-run's write delta (old writes ∪ new writes — a re-run
+    that STOPPED writing a key invalidates that key's readers too).
+    Same-round same-group readers are exempt: groups run their txs in
+    ascending order, so they already saw the fresh versions.
+
+    Convergence is structural: a round's new dirty set only contains
+    indices STRICTLY ABOVE the round's minimum re-run index (MVCC reads
+    never see versions at-or-above the reader), so the dirty frontier
+    marches right and the loop terminates in at most n_txs rounds —
+    `max_rounds` bounds it long before that; an unsettled cone after
+    that returns -1 for the serial-through-overlay fallback.
+
+    At fixpoint every tx's last execution observed exactly the final
+    versions below its index — the serial view — which is the same
+    serial-equivalence argument as the clean path. Returns
+    (re-executed tx count, rounds used) or (-1, rounds) on fallback."""
+    from ..libs import fail
+
+    dirty = _detect_conflicts(session, seg)
+    if not dirty:
+        return 0, 0
+    all_idx = sorted(i for g in seg.groups for i in g)
+    conflicts = 0
+    rounds = 0
+    while dirty:
+        if rounds >= max_rounds:
+            return -1, rounds
+        rounds += 1
+        conflicts += len(dirty)
+        # crash window the matrix exercises: retry state (journals,
+        # overlay versions of re-run txs) must be memory-only — a kill
+        # mid-round leaves the durable image at the previous block
+        fail.fail_point("Exec.MidRetryRound")
+        # snapshot the pre-round write sets: the delta below must cover
+        # keys the re-run STOPS writing, not just the ones it writes
+        old_writes = {i: set(session.journal(i)[1]) for i in dirty}
+        # regroup by observed journals so mutually-conflicting txs
+        # land on one lane in block order (a tx with an empty journal
+        # gets a private sentinel key: it conflicts with nothing)
+        jfoot: List[Optional[frozenset]] = [None] * (max(dirty) + 1)
+        for i in dirty:
+            r, w = session.journal(i)
+            jfoot[i] = frozenset(r | w) or frozenset((b"\x00idx:%d" % i,))
+        groups = _group_disjoint(dirty, jfoot)
+        group_of = {}
+        for gid, g in enumerate(groups):
+            for i in g:
+                group_of[i] = gid
+        _execute_groups(app, session, txs, groups, lanes, responses,
+                        pool, redeliver=True)
+        new_dirty: set = set()
+        for i in dirty:  # ascending (dirty is kept sorted)
+            _, new_w = session.journal(i)
+            delta = old_writes[i] | set(new_w)
+            if not delta:
+                continue
+            gid = group_of[i]
+            for j in all_idx:
+                if j <= i or j in new_dirty:
+                    continue
+                if group_of.get(j) == gid:
+                    # ran after i on the same lane this round: its
+                    # reads already saw i's settled versions
+                    continue
+                reads_j = session.journal(j)[0]
+                if reads_j & delta:
+                    new_dirty.add(j)
+        dirty = sorted(new_dirty)
+    return conflicts, rounds
 
 
 # --- speculation ------------------------------------------------------
@@ -559,11 +820,16 @@ class SpeculationSlot:
     it finds the slot abandoned — no one blocks on a loser)."""
 
     def __init__(self, app, height: int, block_hash: bytes,
-                 base_app_hash: bytes):
+                 base_app_hash: bytes, parent_session=None):
         self.app = app
         self.height = height
         self.block_hash = block_hash
         self.base_app_hash = base_app_hash
+        # cross-height chaining: when set, this slot's session reads
+        # THROUGH the given un-promoted overlay (the previous height's
+        # run) — adoption additionally requires that exact session to
+        # have been promoted (the executor checks identity)
+        self.parent_session = parent_session
         self.run: Optional[BlockRun] = None
         self.error: Optional[BaseException] = None
         self._lock = threading.Lock()
@@ -571,12 +837,15 @@ class SpeculationSlot:
         self._done = threading.Event()
         self.thread: Optional[threading.Thread] = None
 
-    def start(self, txs, begin_req, end_req, lanes: int) -> None:
+    def start(self, txs, begin_req, end_req, lanes: int, pool=None,
+              retry_rounds: int = 0) -> None:
         def work():
             run = None
             try:
                 run = run_block(self.app, txs, begin_req, end_req,
-                                lanes=lanes)
+                                lanes=lanes, pool=pool,
+                                retry_rounds=retry_rounds,
+                                parent=self.parent_session)
             except BaseException as e:  # noqa: BLE001 - surfaced at adopt
                 self.error = e
             with self._lock:

@@ -64,9 +64,13 @@ LOG = logging.getLogger("crashmatrix")
 
 # the matrix iterates every named fail point EXCEPT the statesync one
 # (a restore needs a producer peer; tests/test_crash_consistency.py
-# covers it with a targeted two-party harness instead)
+# covers it with a targeted two-party harness instead) and the chained-
+# speculation one (it fires only on the sync-reactor stage_next_block
+# path, which this consensus-driven harness never takes; the targeted
+# in-process crash test in tests/test_parallel_exec.py covers it)
 MATRIX_POINTS = tuple(p for p in fail.KNOWN_POINTS
-                      if not p.startswith("Statesync."))
+                      if not p.startswith("Statesync.")
+                      and p != "Exec.AfterChainSpeculationStart")
 
 # fault modes composed with the crash points (storagechaos.KILL_MODES)
 MATRIX_MODES = tuple(KILL_MODES)
@@ -151,11 +155,19 @@ class CrashNode:
 
     def __init__(self, home: str, app_kind: str = "persistent",
                  plan: Optional[StorageFaultPlan] = None,
-                 exec_lanes: int = 0, speculative: bool = False):
+                 exec_lanes: int = 0, speculative: bool = False,
+                 retry_rounds: int = 0, lane_pool: bool = False,
+                 conflict_feed: bool = False):
         self.home = home
         self.app_kind = app_kind
         self.exec_lanes = exec_lanes
         self.speculative = speculative
+        self.retry_rounds = retry_rounds
+        self.lane_pool = lane_pool
+        # feed_and_wait submits guaranteed-conflicting txs (a lying
+        # hinted write + an honest write on one hot key) so retry-round
+        # fail points actually fire under consensus load
+        self.conflict_feed = conflict_feed
         self.injector = StorageFaultInjector(plan)
         self.handshake_blocks = 0
         self.reindexed_blocks = 0
@@ -254,7 +266,9 @@ class CrashNode:
         exec_cfg = None
         if self.exec_lanes > 0:
             exec_cfg = cfg.ExecutionConfig(parallel_lanes=self.exec_lanes,
-                                           speculative=self.speculative)
+                                           speculative=self.speculative,
+                                           retry_max_rounds=self.retry_rounds,
+                                           lane_pool=self.lane_pool)
         self.block_exec = sm.BlockExecutor(
             self.state_db, self.conns.consensus, mempool=self.mempool,
             evidence_pool=self.evpool, event_bus=self.bus,
@@ -285,12 +299,31 @@ class CrashNode:
         True) when `crash_event` fires — the kill landed."""
         deadline = time.time() + timeout
         seq = self.height() * 100
+        signer = None
+        if self.conflict_feed:
+            from ..crypto.keys import PrivKeyEd25519
+
+            signer = PrivKeyEd25519.gen_from_secret(b"crashmatrix-conflict")
         while time.time() < deadline:
             if crash_event is not None and crash_event.is_set():
                 return True
             if self.height() >= min_height:
                 return True
             try:
+                if signer is not None:
+                    # a lying-hinted write on the hot key (declares a
+                    # key it never touches) plus an honest hinted write:
+                    # they land in DIFFERENT groups but touch the SAME
+                    # key — a guaranteed observed conflict, so the
+                    # retry engine (and Exec.MidRetryRound) fires
+                    from ..mempool.preverify import make_signed_tx
+
+                    self.mempool.check_tx(make_signed_tx(
+                        signer, b"hot=L%d" % seq,
+                        hints=[b"kv:wrong%d" % seq]))
+                    self.mempool.check_tx(make_signed_tx(
+                        signer, b"hot=H%d" % seq,
+                        hints=[b"kv:hot"]))
                 self.mempool.check_tx(
                     b"k%d=%d" % (seq, self.height()))
             except BaseException:  # noqa: BLE001 - full/dup/dead: keep going
@@ -427,15 +460,19 @@ def run_case(home: str, point: str, mode: str = "clean", nth: int = 2,
     Returns a result dict with ok + per-clause booleans and timings.
     app_kind/exec_lanes/speculative default to whatever the crash point
     needs to fire (the speculation point requires the sharded app with
-    lanes + speculation on; everything else runs the persistent app
-    serially)."""
+    lanes + speculation on; the retry-round point additionally needs
+    the conflict-cone engine armed over a conflicting feed + the lane
+    pool live; everything else runs the persistent app serially)."""
     needs_spec = point == "Exec.AfterSpeculationAdopt"
+    needs_retry = point == "Exec.MidRetryRound"
     if not app_kind:
-        app_kind = "sharded" if needs_spec else "persistent"
+        app_kind = "sharded" if (needs_spec or needs_retry) else "persistent"
     if exec_lanes < 0:
-        exec_lanes = 4 if needs_spec else 0
+        exec_lanes = 4 if (needs_spec or needs_retry) else 0
     if speculative is None:
         speculative = needs_spec
+    retry_rounds = 3 if needs_retry else 0
+    lane_pool = needs_retry
     if os.path.exists(home):
         shutil.rmtree(home)
     init_home(home)
@@ -448,7 +485,9 @@ def run_case(home: str, point: str, mode: str = "clean", nth: int = 2,
     driver_fires_point = point == "Mempool.MidAdmitChunk"
 
     node = CrashNode(home, app_kind=app_kind, plan=plan,
-                     exec_lanes=exec_lanes, speculative=speculative)
+                     exec_lanes=exec_lanes, speculative=speculative,
+                     retry_rounds=retry_rounds, lane_pool=lane_pool,
+                     conflict_feed=needs_retry)
     crash_height = 0
     try:
         node.boot()
@@ -475,7 +514,9 @@ def run_case(home: str, point: str, mode: str = "clean", nth: int = 2,
     # --- restart from whatever the dead process left ------------------
     t0 = time.perf_counter()
     node2 = CrashNode(home, app_kind=app_kind,
-                      exec_lanes=exec_lanes, speculative=speculative)
+                      exec_lanes=exec_lanes, speculative=speculative,
+                      retry_rounds=retry_rounds, lane_pool=lane_pool,
+                      conflict_feed=needs_retry)
     try:
         try:
             node2.boot()

@@ -167,12 +167,73 @@ def _exec_speculative(app, txs, breq, ereq, lanes):
     return run.deliver_res, run.end_res
 
 
+def _exec_retrydag(app, txs, breq, ereq, lanes, pool):
+    """The Block-STM conflict-cone engine: parallel retry rounds to
+    fixpoint instead of serial re-runs, on the persistent lane pool."""
+    from ..state import parallel as par
+
+    run = par.run_block(app, txs, breq, ereq, lanes=lanes, pool=pool,
+                        retry_rounds=3)
+    app.exec_promote(run.session)
+    return run.deliver_res, run.end_res
+
+
+class _ChainDriver:
+    """Cross-height chained speculation: before block h promotes, block
+    h+1 launches speculatively on h's still-un-promoted overlay
+    (SpeculationSlot parent_session); the next iteration adopts it —
+    the sync-reactor stage_next_block path minus the reactor."""
+
+    def __init__(self, app, lanes: int = 4):
+        self.app = app
+        self.lanes = lanes
+        self.pending = None
+
+    def exec_block(self, h, txs, breq, ereq, next_txs=None):
+        from ..abci import types as abci
+        from ..state import parallel as par
+
+        slot, self.pending = self.pending, None
+        if slot is not None and slot.height == h:
+            run = slot.wait(timeout=60)
+            slot.join(timeout=60)
+            if run is None:
+                slot.abandon()
+                raise (slot.error
+                       or RuntimeError("chained speculative run lost"))
+        else:
+            if slot is not None:
+                slot.abandon()
+                slot.join(timeout=60)
+            run = par.run_block(self.app, txs, breq, ereq,
+                                lanes=self.lanes)
+        if next_txs is not None:
+            # launch h+1 BEFORE h promotes: the child must read h's
+            # results through the overlay chain, not the base db
+            nslot = par.SpeculationSlot(self.app, h + 1, b"", b"",
+                                        parent_session=run.session)
+            nslot.start(list(next_txs), abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=h + 1),
+                        lanes=self.lanes)
+            self.pending = nslot
+        self.app.exec_promote(run.session)
+        return run.deliver_res, run.end_res
+
+    def close(self):
+        slot, self.pending = self.pending, None
+        if slot is not None:
+            slot.abandon()
+            slot.join(timeout=60)
+
+
 def run_engine(engine: str, blocks: List[List[bytes]],
                workdir: Optional[str] = None,
                app_seed: int = 7) -> Dict[str, object]:
     """Execute `blocks` under one engine; return the surface digests.
 
-    engine: "serial" | "parallel2" | "parallel4" | "speculative"
+    engine: "serial" | "parallel2" | "parallel4" | "speculative" |
+    "retrydag" (conflict-cone fixpoint on the persistent lane pool) |
+    "specchain" (cross-height chained speculation)
     workdir: when set, the app runs on a FileDB there and the digest of
     the raw append-log bytes rides along as the `image` surface."""
     from ..abci import types as abci
@@ -201,6 +262,15 @@ def run_engine(engine: str, blocks: List[List[bytes]],
     app_hashes: List[str] = []
     results = hashlib.sha256()
     events = hashlib.sha256()
+    pool = None
+    driver = None
+    if engine == "retrydag":
+        from ..state.lanepool import LanePool
+
+        pool = LanePool(4)
+        pool.start()
+    elif engine == "specchain":
+        driver = _ChainDriver(app, lanes=4)
     try:
         for h, txs in enumerate(blocks, start=1):
             breq = abci.RequestBeginBlock()
@@ -213,6 +283,13 @@ def run_engine(engine: str, blocks: List[List[bytes]],
             elif engine == "speculative":
                 dres, eres = _exec_speculative(app, txs, breq, ereq,
                                                lanes=4)
+            elif engine == "retrydag":
+                dres, eres = _exec_retrydag(app, txs, breq, ereq,
+                                            lanes=4, pool=pool)
+            elif engine == "specchain":
+                nxt = blocks[h] if h < len(blocks) else None
+                dres, eres = driver.exec_block(h, txs, breq, ereq,
+                                               next_txs=nxt)
             else:
                 raise ValueError(f"unknown engine {engine!r}")
             commit = app.commit()
@@ -231,6 +308,10 @@ def run_engine(engine: str, blocks: List[List[bytes]],
                 TxResult(height=h, index=i, tx=bytes(tx), result=dres[i])
                 for i, tx in enumerate(txs)])
     finally:
+        if driver is not None:
+            driver.close()
+        if pool is not None:
+            pool.stop()
         bus.unsubscribe_all("detcheck")
         bus.stop()
         # close on every path: a raising engine must not leave the
@@ -323,10 +404,16 @@ def run_oracle(n_blocks: int = DEFAULT_BLOCKS, n_txs: int = DEFAULT_TXS,
             runs.append(run_engine(f"parallel{n}", blocks, workdir))
         if speculative:
             runs.append(run_engine("speculative", blocks, workdir))
+            runs.append(run_engine("specchain", blocks, workdir))
+        runs.append(run_engine("retrydag", blocks, workdir))
         if cross_process:
-            for hs in child_hashseeds:
-                child = run_child("parallel%d" % (lanes[-1] if lanes
-                                                  else 2),
+            # alternate the subprocess legs across engines so the
+            # cross-PYTHONHASHSEED axis also covers the retry-DAG
+            # engine at zero extra subprocess cost
+            child_engines = ("parallel%d" % (lanes[-1] if lanes else 2),
+                             "retrydag")
+            for i, hs in enumerate(child_hashseeds):
+                child = run_child(child_engines[i % len(child_engines)],
                                   n_blocks, n_txs, n_keys, seed,
                                   workdir, hs)
                 child["engine"] = f"{child['engine']}@subprocess"

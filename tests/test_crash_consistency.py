@@ -744,8 +744,9 @@ class TestNamedFailPoints:
         root = os.path.dirname(tendermint_tpu.__file__)
         blob = ""
         for sub in ("consensus/state.py", "state/execution.py",
-                    "state/txindex.py", "mempool/mempool.py",
-                    "privval/file_pv.py", "statesync/restore.py"):
+                    "state/parallel.py", "state/txindex.py",
+                    "mempool/mempool.py", "privval/file_pv.py",
+                    "statesync/restore.py"):
             blob += open(os.path.join(root, sub)).read()
         for point in fail.KNOWN_POINTS:
             assert f'fail_point("{point}")' in blob, point

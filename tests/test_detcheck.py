@@ -130,13 +130,14 @@ def test_oracle_catches_order_dependent_flush():
 
 
 def test_engines_agree_in_process():
-    """serial ≡ parallel(2) ≡ parallel(4) ≡ speculative on every
-    surface (events, results, index rows, app hashes, durable image)."""
+    """serial ≡ parallel(2) ≡ parallel(4) ≡ speculative ≡ chained
+    cross-height speculation ≡ retry-DAG on every surface (events,
+    results, index rows, app hashes, durable image)."""
     rep = detcheck.run_oracle(n_blocks=4, n_txs=10, cross_process=False)
     try:
         assert rep["divergences"] == []
         assert rep["engines"] == ["serial", "parallel2", "parallel4",
-                                  "speculative"]
+                                  "speculative", "specchain", "retrydag"]
         assert set(rep["surfaces"]) == {"app_hashes", "results",
                                         "events", "index", "image"}
     finally:
@@ -156,7 +157,8 @@ def test_oracle_records_debug_state_and_metrics():
         assert view["oracle"]["runs"] == 1
         assert view["oracle"]["divergences"] == 0
         assert view["oracle"]["last"]["engines"] == ["serial",
-                                                     "parallel2"]
+                                                     "parallel2",
+                                                     "retrydag"]
         text = m.registry.render()
         assert "detcheck_test_detcheck_runs_total 1" in text
         assert "detcheck_test_detcheck_divergence_total" in text
@@ -281,7 +283,8 @@ def test_full_oracle_matrix_is_divergence_free():
     rep = detcheck.run_oracle()
     try:
         assert rep["divergences"] == [], rep["divergences"]
-        assert len(rep["engines"]) == 6  # serial, 2, 4, spec, 2 children
+        # serial, 2, 4, spec, specchain, retrydag, 2 children
+        assert len(rep["engines"]) == 8
     finally:
         detcheck.reset_state()
 
@@ -301,3 +304,20 @@ def test_bench_detcheck_schema():
     assert doc["vs_baseline"] == 1.0
     assert doc["divergences"] == []
     assert proc.returncode == 0
+
+
+def test_cross_hashseed_retry_and_chain_engines_conform(tmp_path):
+    """PR-17 engines under the cross-process axis: the retry-DAG (on
+    the persistent lane pool) and chained cross-height speculation in
+    separate interpreters with DIFFERENT hash seeds must produce the
+    identical full surface set — vs each other AND vs in-process
+    serial."""
+    a = detcheck.run_child("retrydag", 6, 10, 6, seed=31,
+                           workdir=str(tmp_path / "a"), hashseed="777")
+    b = detcheck.run_child("specchain", 6, 10, 6, seed=31,
+                           workdir=str(tmp_path / "b"), hashseed="888")
+    assert detcheck.diff_runs(a, b) == []
+    blocks = detcheck.build_blocks(seed=31, n_blocks=6, n_txs=10,
+                                   n_keys=6)
+    c = detcheck.run_engine("serial", blocks, str(tmp_path / "c"))
+    assert detcheck.diff_runs(a, c) == []

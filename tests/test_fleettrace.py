@@ -432,9 +432,14 @@ def _assert_provider_contract(addr, node_id, mode):
 
     ex = first["exec"]
     assert set(ex) == {"enabled", "capacity", "lanes", "blocks",
-                       "parallel_lanes"}, (mode, sorted(ex))
+                       "parallel_lanes", "lane_pool", "retry"}, (
+        mode, sorted(ex))
     assert set(ex["blocks"]) == {"count", "conflict_txs",
-                                 "serial_fallbacks", "recent"}
+                                 "serial_fallbacks", "retry_rounds_p99",
+                                 "dispatch_p50_us", "dispatch_p99_us",
+                                 "recent"}
+    assert set(ex["retry"]) == {"retry_rounds_p99", "retried_txs",
+                                "steals", "steal_ratio"}
 
     clk = _scrape(addr, "/debug/clock")
     assert set(clk) == {"wall_s", "mono_ns", "identity"}

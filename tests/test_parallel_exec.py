@@ -368,7 +368,7 @@ def test_speculation_never_visible_before_finalize():
         assert bexec.begin_speculation(state, block)
         # wait for the worker to finish WITHOUT adopting
         with bexec._spec_lock:
-            slot = bexec._spec_slot
+            slot = bexec._spec_slots[0] if bexec._spec_slots else None
         assert slot is not None
         slot.join(timeout=10)
         # speculative writes must not be visible through any base read
@@ -695,3 +695,557 @@ def test_lane_worker_exception_propagates_and_discards():
     alive = [t for t in threading.enumerate()
              if t.name.startswith("exec-lane")]
     assert alive == []
+
+
+# --- PR 17: conflict-cone retry DAG -----------------------------------
+
+
+@pytest.mark.parametrize("lanes,use_pool", [(2, False), (4, False),
+                                            (8, False), (4, True)])
+def test_retry_dag_matches_serial_fuzz(lanes, use_pool):
+    """The retry engine under the same conformance property as the
+    legacy conflict path: seeded mixed workloads (incl. lying hints and
+    barriers), parallel retry rounds to fixpoint, spawned lanes AND the
+    persistent pool — byte-identical to serial."""
+    from tendermint_tpu.state.execution import ABCIResponses
+    from tendermint_tpu.state.lanepool import LanePool
+
+    pool = None
+    if use_pool:
+        pool = LanePool(lanes)
+        pool.start()
+    try:
+        for seed in range(5):
+            rng = random.Random(7000 * lanes + seed)
+            sk = PrivKeyEd25519.generate()
+            txs = _seeded_workload(rng, n_txs=rng.randrange(5, 40),
+                                   n_keys=rng.randrange(2, 10), sk=sk)
+            a = ShardedKVStoreApplication(MemDB(), shards=8)
+            b = ShardedKVStoreApplication(MemDB(), shards=8)
+            for app in (a, b):
+                for j in range(3):
+                    app.deliver_tx(b"k%02d=seed%d" % (j, j))
+                app.commit()
+            d1, e1, h1 = _serial_oracle(a, txs, height=2)
+            run = par.run_block(b, txs, abci.RequestBeginBlock(),
+                                abci.RequestEndBlock(height=2),
+                                lanes=lanes, pool=pool, retry_rounds=3)
+            b.exec_promote(run.session)
+            h2 = b.commit().data
+            assert h1 == h2, f"app hash diverged (seed={seed})"
+            r1 = ABCIResponses(d1, e1)
+            r2 = ABCIResponses(run.deliver_res, run.end_res)
+            assert r1.to_bytes() == r2.to_bytes(), f"seed={seed}"
+    finally:
+        if pool is not None:
+            pool.stop()
+
+
+class _StaleReadApp(ShardedKVStoreApplication):
+    """Forces the cascade race deterministically: the pointer-setter's
+    FIRST execution blocks until the indirect writer has done its first
+    (stale) read — so the re-run is guaranteed to retarget its write."""
+
+    def __init__(self, db):
+        super().__init__(db)
+        self.b_ran_once = threading.Event()
+
+    def deliver_tx(self, tx):
+        body = self.tx_body(tx)
+        if body.startswith(b"ind:"):
+            try:
+                return super().deliver_tx(tx)
+            finally:
+                self.b_ran_once.set()
+        if body.startswith(b"p0=") and not self.b_ran_once.is_set():
+            assert self.b_ran_once.wait(timeout=30)
+        return super().deliver_tx(tx)
+
+
+def test_pointer_cascade_retry_converges_legacy_falls_back():
+    """The cascade the high-conflict bench leg is built from: A sets a
+    pointer (lying hint), B writes THROUGH the pointer (lying hint —
+    its re-run retargets the write to the hot key, a write that only
+    appears on re-execution), C cleanly reads the hot key. Legacy path:
+    B's re-run invalidates clean C → whole-block serial fallback. Retry
+    DAG: round 1 re-runs B, round 2 re-runs C — fixpoint, no fallback.
+    Both byte-identical to serial."""
+    sk = PrivKeyEd25519.generate()
+    txs = [
+        make_signed_tx(sk, b"p0=h00", hints=[b"kv:a0"]),       # A (lies)
+        make_signed_tx(sk, b"ind:p0:VAL", hints=[b"kv:b0"]),   # B (lies)
+        make_signed_tx(sk, b"cp:h00:c0", hints=[b"kv:c0"]),    # C (clean)
+    ]
+
+    def fresh(cls):
+        app = cls(MemDB())
+        app.deliver_tx(b"h00=base")
+        app.commit()
+        return app
+
+    oracle = fresh(ShardedKVStoreApplication)
+    d1, e1, h1 = _serial_oracle(oracle, txs, height=2)
+
+    retry_app = fresh(_StaleReadApp)
+    run = par.run_block(retry_app, txs, abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=2), lanes=4,
+                        retry_rounds=3)
+    assert not run.serial_fallback
+    assert run.retry_rounds == 2  # B's cone, then C's
+    retry_app.exec_promote(run.session)
+    assert retry_app.commit().data == h1
+    assert [r.data for r in run.deliver_res] == [r.data for r in d1]
+
+    legacy_app = fresh(_StaleReadApp)
+    run2 = par.run_block(legacy_app, txs, abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=2), lanes=4,
+                         retry_rounds=0)
+    assert run2.serial_fallback  # the fallback the retry DAG removes
+    legacy_app.exec_promote(run2.session)
+    assert legacy_app.commit().data == h1
+
+
+def test_retry_budget_exhaustion_falls_back_to_serial():
+    """A cone that needs 2 rounds but is only granted 1 must take the
+    serial-through-overlay fallback — and still match serial."""
+    sk = PrivKeyEd25519.generate()
+    txs = [
+        make_signed_tx(sk, b"p0=h00", hints=[b"kv:a0"]),
+        make_signed_tx(sk, b"ind:p0:VAL", hints=[b"kv:b0"]),
+        make_signed_tx(sk, b"cp:h00:c0", hints=[b"kv:c0"]),
+    ]
+    a = ShardedKVStoreApplication(MemDB())
+    b = _StaleReadApp(MemDB())
+    for app in (a, b):
+        app.deliver_tx(b"h00=base")
+        app.commit()
+    d1, e1, h1 = _serial_oracle(a, txs, height=2)
+    run = par.run_block(b, txs, abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=2), lanes=4,
+                        retry_rounds=1)
+    assert run.serial_fallback
+    b.exec_promote(run.session)
+    assert b.commit().data == h1
+    assert [r.data for r in run.deliver_res] == [r.data for r in d1]
+
+
+# --- PR 17: persistent work-stealing lane pool ------------------------
+
+
+def test_lane_pool_workers_persist_across_runs():
+    from tendermint_tpu.state.lanepool import LanePool
+
+    pool = LanePool(3)
+    pool.start()
+    try:
+        idents = set()
+        lock = threading.Lock()
+
+        def execute(group):
+            with lock:
+                idents.add(threading.get_ident())
+
+        for _ in range(4):
+            pool.run_groups([[0], [1], [2]], execute)
+        workers = [t for t in threading.enumerate()
+                   if t.name.startswith("exec-lane-")]
+        assert len(workers) == 3  # same threads, every run
+        assert idents <= {t.ident for t in workers}
+    finally:
+        pool.stop()
+    assert [t for t in threading.enumerate()
+            if t.name.startswith("exec-lane-")] == []
+    with pytest.raises(RuntimeError):
+        pool.run_groups([[0]], lambda g: None)
+
+
+def test_lane_pool_steals_from_backlogged_sibling():
+    """Lane 0 wedges on its first group; the sibling must drain lane
+    0's queued group from the tail (and the theft must be attributed in
+    the flight recorder). The stolen group releases the wedge — if
+    stealing were broken this test would deadlock, not just fail."""
+    from tendermint_tpu.state.lanepool import LanePool
+
+    pool = LanePool(2)
+    pool.start()
+    rec = par.FlightRecorder()
+    gate = threading.Event()
+    try:
+        def execute(group):
+            if group == [0]:       # lane 0's head group: wedge
+                assert gate.wait(timeout=30)
+            elif group == [2]:     # lane 0's queued group: the loot
+                gate.set()
+
+        # deques: lane0=[g0,g2], lane1=[g1,g3]
+        pool.run_groups([[0], [1], [2], [3]], execute, recorder=rec)
+        report = rec.report()
+        assert sum(l["steals"] for l in report["lanes"].values()) >= 1
+    finally:
+        gate.set()
+        pool.stop()
+
+
+def test_lane_pool_error_cancels_run_and_recovers():
+    from tendermint_tpu.state.lanepool import LanePool
+
+    pool = LanePool(2)
+    pool.start()
+    try:
+        def boom(group):
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            pool.run_groups([[0], [1], [2]], boom)
+        done = []
+        pool.run_groups([[0], [1]], lambda g: done.append(tuple(g)))
+        assert sorted(done) == [(0,), (1,)]  # pool survives the error
+    finally:
+        pool.stop()
+
+
+def test_lane_pool_concurrent_runs_both_complete():
+    """Two runs submitted from two threads share the worker set (the
+    cross-height case: block h's segment + h+1's speculation)."""
+    from tendermint_tpu.state.lanepool import LanePool
+
+    pool = LanePool(4)
+    pool.start()
+    try:
+        seen = {"a": [], "b": []}
+        lock = threading.Lock()
+
+        def make_exec(tag):
+            def execute(group):
+                time.sleep(0.005)
+                with lock:
+                    seen[tag].append(tuple(group))
+            return execute
+
+        t = threading.Thread(target=lambda: pool.run_groups(
+            [[i] for i in range(6)], make_exec("a")))
+        t.start()
+        pool.run_groups([[i] for i in range(6, 12)], make_exec("b"))
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert sorted(seen["a"]) == [(i,) for i in range(6)]
+        assert sorted(seen["b"]) == [(i,) for i in range(6, 12)]
+    finally:
+        pool.stop()
+
+
+def test_executor_lane_pool_lifecycle():
+    """[execution] lane_pool=true: the executor starts the pool, blocks
+    execute on it, and stop() drains it (no exec-lane thread survives —
+    the conftest leak families depend on this)."""
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    app = ShardedKVStoreApplication(MemDB())
+    base_hash = app.commit().data
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    try:
+        bexec = sm.BlockExecutor(
+            MemDB(), conns.consensus,
+            exec_config=ExecutionConfig(parallel_lanes=4, speculative=False,
+                                        lane_pool=True, retry_max_rounds=3))
+        assert bexec._lane_pool is not None and bexec._lane_pool.started
+        state = _FakeState(1, base_hash)
+        responses = bexec._exec_block(state, _FakeBlock(2, [b"a=1", b"b=2"]))
+        assert all(r.is_ok for r in responses.deliver_tx)
+        assert app.base_db().get(b"kv:a") == b"1"
+        bexec.stop()
+        assert not bexec._lane_pool.started
+        assert [t for t in threading.enumerate()
+                if t.name.startswith("exec-lane-")] == []
+    finally:
+        conns.stop()
+
+
+# --- PR 17: cross-height chained speculation --------------------------
+
+
+def test_chained_session_reads_parent_overlay_matches_serial():
+    """h+1 executed on h's UN-promoted overlay (parent=), then both
+    promoted in chain order — identical to committing the two blocks
+    serially."""
+    oracle = ShardedKVStoreApplication(MemDB())
+    app = ShardedKVStoreApplication(MemDB())
+    txs1 = [b"a=1", b"inc:a"]          # a -> 2
+    txs2 = [b"cp:a:b", b"inc:a"]       # b = 2 (reads h's overlay), a -> 3
+    for t in txs1:
+        oracle.deliver_tx(t)
+    oracle.commit()
+    for t in txs2:
+        oracle.deliver_tx(t)
+    want = oracle.commit().data
+
+    run1 = par.run_block(app, txs1, abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=1), lanes=2)
+    # h+1 executes BEFORE h promotes — reads flow through the parent
+    run2 = par.run_block(app, txs2, abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=2), lanes=2,
+                         parent=run1.session)
+    assert run2.deliver_res[0].is_ok
+    app.exec_promote(run1.session)
+    app.commit()
+    app.exec_promote(run2.session)
+    assert app.commit().data == want
+    assert app.base_db().get(b"kv:b") == b"2"
+    assert app.base_db().get(b"kv:a") == b"3"
+
+
+def test_chained_child_cannot_promote_before_parent():
+    app = ShardedKVStoreApplication(MemDB())
+    run1 = par.run_block(app, [b"a=1"], abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=1), lanes=2)
+    run2 = par.run_block(app, [b"b=2"], abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=2), lanes=2,
+                         parent=run1.session)
+    with pytest.raises(RuntimeError):
+        app.exec_promote(run2.session)  # chain order is commit order
+    app.exec_promote(run1.session)
+    app.exec_promote(run2.session)
+    app.commit()
+    assert app.base_db().get(b"kv:b") == b"2"
+
+
+def test_abandoned_chain_releases_sessions():
+    """Discarding a chained child must free its overlay AND unpin the
+    parent chain (ExecSession.release contract) — a dropped slot must
+    not keep MVCC versions alive."""
+    app = ShardedKVStoreApplication(MemDB())
+    run1 = par.run_block(app, [b"a=1"], abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=1), lanes=2)
+    run2 = par.run_block(app, [b"b=2"], abci.RequestBeginBlock(),
+                         abci.RequestEndBlock(height=2), lanes=2,
+                         parent=run1.session)
+    child = run2.session
+    app.exec_discard(child)
+    assert child.parent is None
+    assert all(not s.versions for s in child.stripes)
+    app.exec_discard(run1.session)
+    assert all(not s.versions for s in run1.session.stripes)
+
+
+def _chained_executor(app, depth=2):
+    from tendermint_tpu import state as sm
+    from tendermint_tpu.metrics import StateMetrics
+    from tendermint_tpu.proxy import AppConns, local_client_creator
+
+    class _Ctr:
+        def __init__(self):
+            self.value = 0
+
+        def inc(self, n=1):
+            self.value += n
+
+        def set(self, v):
+            self.value = v
+
+        def observe(self, v):
+            pass
+
+    conns = AppConns(local_client_creator(app))
+    conns.start()
+    metrics = StateMetrics(
+        block_processing_time=_Ctr(), validator_updates=_Ctr(),
+        valset_changes=_Ctr(), exec_parallel_lanes=_Ctr(),
+        exec_conflicts=_Ctr(), exec_speculation_hits=_Ctr(),
+        exec_speculation_wasted=_Ctr())
+    bexec = sm.BlockExecutor(
+        MemDB(), conns.consensus, metrics=metrics,
+        exec_config=ExecutionConfig(parallel_lanes=2, speculative=True,
+                                    speculate_depth=depth))
+    return bexec, conns
+
+
+def test_executor_adopts_chained_next_block():
+    """stage_next_block + speculate_depth=2: h+1 launches on h's
+    un-promoted overlay at h's adoption and is itself adopted when h+1
+    is decided (exec_speculation_hits counts it)."""
+    app = ShardedKVStoreApplication(MemDB())
+    app.deliver_tx(b"seed=1")
+    base_hash = app.commit().data
+    bexec, conns = _chained_executor(app)
+    try:
+        s1 = _FakeState(1, base_hash)
+        s1.validators = None
+        b2 = _FakeBlock(2, [b"a=1"], tag=b"A")
+        b3 = _FakeBlock(3, [b"cp:a:b"], tag=b"B")
+        bexec.stage_next_block(b3)
+        r2 = bexec._exec_block(s1, b2)
+        assert r2.deliver_tx[0].is_ok
+        with bexec._spec_lock:
+            slots = list(bexec._spec_slots)
+        assert len(slots) == 1 and slots[0].parent_session is not None
+        s2 = _FakeState(2, app.app_hash)
+        s2.validators = None
+        hits0 = bexec.metrics.exec_speculation_hits.value
+        r3 = bexec._exec_block(s2, b3)
+        assert r3.deliver_tx[0].is_ok
+        assert bexec.metrics.exec_speculation_hits.value == hits0 + 1
+        assert app.base_db().get(b"kv:b") == b"1"  # read h's overlay value
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_executor_abandons_chained_speculation_on_mismatch():
+    """The decided h+1 differs from the staged one: the chained slot is
+    abandoned (wasted++), its overlay leaves zero trace, and the
+    decided block re-executes correctly."""
+    app = ShardedKVStoreApplication(MemDB())
+    base_hash = app.commit().data
+    bexec, conns = _chained_executor(app)
+    try:
+        s1 = _FakeState(1, base_hash)
+        s1.validators = None
+        b2 = _FakeBlock(2, [b"a=1"], tag=b"A")
+        staged = _FakeBlock(3, [b"leak=yes"], tag=b"S")
+        decided = _FakeBlock(3, [b"b=real"], tag=b"D")
+        bexec.stage_next_block(staged)
+        bexec._exec_block(s1, b2)
+        s2 = _FakeState(2, app.app_hash)
+        s2.validators = None
+        wasted0 = bexec.metrics.exec_speculation_wasted.value
+        r3 = bexec._exec_block(s2, decided)
+        bexec.stop()  # settle the abandoned worker before asserting
+        assert r3.deliver_tx[0].is_ok
+        assert bexec.metrics.exec_speculation_wasted.value > wasted0
+        assert app.base_db().get(b"kv:b") == b"real"
+        assert app.base_db().get(b"kv:leak") is None
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+def test_stage_next_block_noop_at_depth_one():
+    app = ShardedKVStoreApplication(MemDB())
+    base_hash = app.commit().data
+    bexec, conns = _chained_executor(app, depth=1)
+    try:
+        s1 = _FakeState(1, base_hash)
+        s1.validators = None
+        bexec.stage_next_block(_FakeBlock(3, [b"x=1"]))
+        assert bexec._staged_next is None  # hint dropped, not armed
+        bexec._exec_block(s1, _FakeBlock(2, [b"a=1"]))
+        with bexec._spec_lock:
+            assert bexec._spec_slots == []
+    finally:
+        bexec.stop()
+        conns.stop()
+
+
+# --- PR 17: crash points in the new exec windows ----------------------
+
+
+def test_crash_mid_retry_round_leaves_no_trace():
+    """A kill in the middle of a conflict-cone retry round: every
+    journal/overlay version is memory-only, so the durable state stays
+    at the previous block and a clean re-execution matches serial
+    (the crashmatrix drives the same point through a full node;
+    this pins the window at the engine level)."""
+    from tendermint_tpu.libs import fail
+
+    sk = PrivKeyEd25519.generate()
+    txs = [
+        make_signed_tx(sk, b"p0=h00", hints=[b"kv:a0"]),
+        make_signed_tx(sk, b"ind:p0:VAL", hints=[b"kv:b0"]),
+        make_signed_tx(sk, b"cp:h00:c0", hints=[b"kv:c0"]),
+    ]
+    oracle = ShardedKVStoreApplication(MemDB())
+    oracle.deliver_tx(b"h00=base")
+    oracle.commit()
+    d1, e1, h1 = _serial_oracle(oracle, txs, height=2)
+
+    app = _StaleReadApp(MemDB())
+    app.deliver_tx(b"h00=base")
+    before = app.commit().data
+
+    def boom(name):
+        raise RuntimeError(f"killed at {name}")
+
+    fail.arm_crash("Exec.MidRetryRound", nth=1, action=boom)
+    try:
+        with pytest.raises(RuntimeError, match="Exec.MidRetryRound"):
+            par.run_block(app, txs, abci.RequestBeginBlock(),
+                          abci.RequestEndBlock(height=2), lanes=4,
+                          retry_rounds=3)
+    finally:
+        fail.disarm_crash()
+    # nothing promoted, nothing durable: the base is the pre-block state
+    assert app.app_hash == before
+    assert app.base_db().get(b"kv:p0") is None
+    assert app.base_db().get(b"kv:c0") is None
+    # replay lands exactly on the serial image
+    run = par.run_block(app, txs, abci.RequestBeginBlock(),
+                        abci.RequestEndBlock(height=2), lanes=4,
+                        retry_rounds=3)
+    app.exec_promote(run.session)
+    assert app.commit().data == h1
+
+
+def test_crash_after_chain_speculation_start_leaves_no_trace():
+    """A kill right after the chained h+1 speculation launches (both
+    the parent overlay and the child session are memory-only): durable
+    state must stay pre-h, and a fresh executor re-applies h and h+1 to
+    the serial result. Covers the matrix cell crashmatrix excludes
+    (the point only fires on the sync-reactor stage_next_block path)."""
+    from tendermint_tpu.libs import fail
+
+    oracle = ShardedKVStoreApplication(MemDB())
+    oracle.deliver_tx(b"seed=1")
+    oracle.commit()
+    oracle.deliver_tx(b"a=1")
+    oracle.commit()
+    oracle.deliver_tx(b"cp:a:b")
+    want = oracle.commit().data
+
+    app = ShardedKVStoreApplication(MemDB())
+    app.deliver_tx(b"seed=1")
+    base_hash = app.commit().data
+    bexec, conns = _chained_executor(app)
+
+    def boom(name):
+        raise RuntimeError(f"killed at {name}")
+
+    try:
+        s1 = _FakeState(1, base_hash)
+        s1.validators = None
+        b2 = _FakeBlock(2, [b"a=1"], tag=b"A")
+        b3 = _FakeBlock(3, [b"cp:a:b"], tag=b"B")
+        bexec.stage_next_block(b3)
+        fail.arm_crash("Exec.AfterChainSpeculationStart", nth=1,
+                       action=boom)
+        try:
+            with pytest.raises(RuntimeError,
+                               match="Exec.AfterChainSpeculationStart"):
+                bexec._exec_block(s1, b2)
+        finally:
+            fail.disarm_crash()
+        # the crash landed between run_block(h) and promote: neither
+        # h's writes nor the chained child's are visible anywhere
+        assert app.app_hash == base_hash
+        assert app.base_db().get(b"kv:a") is None
+        assert app.base_db().get(b"kv:b") is None
+    finally:
+        bexec.stop()
+        conns.stop()
+
+    # "restart": a fresh executor replays h then h+1 → serial image
+    bexec2, conns2 = _chained_executor(app)
+    try:
+        s1 = _FakeState(1, base_hash)
+        s1.validators = None
+        bexec2.stage_next_block(_FakeBlock(3, [b"cp:a:b"], tag=b"B"))
+        bexec2._exec_block(s1, _FakeBlock(2, [b"a=1"], tag=b"A"))
+        app.commit()
+        s2 = _FakeState(2, app.app_hash)
+        s2.validators = None
+        bexec2._exec_block(s2, _FakeBlock(3, [b"cp:a:b"], tag=b"B"))
+        assert app.commit().data == want
+    finally:
+        bexec2.stop()
+        conns2.stop()
