@@ -65,6 +65,7 @@ CHAOSNET_MODE = "chaosnet" in sys.argv[1:]  # partition-heal recovery (PR 10)
 CRASHREC_MODE = "crashrecovery" in sys.argv[1:]  # kill->committing (PR 14)
 DETCHECK_MODE = "detcheck" in sys.argv[1:]  # replay-divergence oracle (PR 15)
 PROPTRACE_MODE = "proptrace" in sys.argv[1:]  # fleet causal tracing (PR 16)
+INCIDENT_MODE = "incident" in sys.argv[1:]  # incident MTTD/MTTR (PR 18)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
@@ -72,7 +73,7 @@ _args = [a for a in sys.argv[1:]
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
                       "crashrecovery", "detcheck", "proptrace",
-                      "--pipeline", "--parallel")]
+                      "incident", "--pipeline", "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -154,6 +155,10 @@ PROPTRACE_NVAL = _env_int("TM_TPU_BENCH_PROPTRACE_NVAL", 4)
 PROPTRACE_SEED = _env_int("TM_TPU_BENCH_PROPTRACE_SEED", 8)
 PROPTRACE_METRIC = (
     f"proptrace_{PROPTRACE_NVAL}node_commit_attribution_coverage_pct")
+INCIDENT_NVAL = _env_int("TM_TPU_BENCH_INCIDENT_NVAL", 4)
+INCIDENT_SEED = _env_int("TM_TPU_BENCH_INCIDENT_SEED", 9)
+INCIDENT_METRIC = (
+    f"incident_{INCIDENT_NVAL}node_composed_mttr_p50_ms")
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1948,6 +1953,54 @@ def proptrace_main():
     return 0 if ok else 1
 
 
+def incident_main():
+    """`bench.py incident` — the incident observatory as a gated BENCH
+    line: the incident scenario (tools/scenarios.py) composes a seeded
+    netchaos partition with a seeded torn-WAL crash on a 4-node
+    subprocess localnet, scrapes every node's /debug/incidents, stitches
+    the fleet incident report (tools/fleettrace.py) with the
+    orchestrator's kill stamp merged in, and reports the p50 MTTR
+    (heal -> first fresh-height commit) in ms, with p50 MTTD alongside.
+    The scenario's oracle gates the number: every injected phase must be
+    detected AND classified correctly (partition stall reasons for the
+    net phase, unclean_shutdown for the crash), zero double-commits, and
+    each survivor's seeded ledger projection byte-identical to the
+    plan-derived prediction — otherwise value -1. Pure host path:
+    no TPU."""
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("incident", seed=INCIDENT_SEED, n=INCIDENT_NVAL)
+    ok = bool(res.get("ok"))
+    mttr_p50 = res.get("mttr_p50_s")
+    mttd_p50 = res.get("mttd_p50_s")
+    value = (round(mttr_p50 * 1000, 1)
+             if ok and mttr_p50 is not None else -1)
+    print(json.dumps({
+        "metric": INCIDENT_METRIC,
+        "value": value,
+        "unit": "ms",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "seed": INCIDENT_SEED,
+        "mttd_p50_ms": (round(mttd_p50 * 1000, 1)
+                        if mttd_p50 is not None else -1),
+        "total_phases": res.get("total_phases"),
+        "attribution": res.get("attribution"),
+        "replay_identical": res.get("replay_identical"),
+        "safety_ok": res.get("safety_ok"),
+        "classified_ok": res.get("classified_ok"),
+        "recovered_ok": res.get("recovered_ok"),
+        "note": ("p50 heal->fresh-commit MTTR across a composed "
+                 "partition + torn-WAL timeline; fault ledger "
+                 f"replayable from seed {INCIDENT_SEED} "
+                 "(canonical projection byte-checked per survivor)"
+                 if ok else "ORACLE FAILED — see attribution/replay"),
+    }))
+    return 0 if ok else 1
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -1959,6 +2012,9 @@ def main():
     if PROPTRACE_MODE:
         # in-process localnet + loopback HTTP: pure host path, no TPU
         return proptrace_main()
+    if INCIDENT_MODE:
+        # subprocess localnet + loopback HTTP: pure host path, no TPU
+        return incident_main()
     if CHAOS_MODE:
         return chaos_main()
     if CHAOSNET_MODE:

@@ -307,6 +307,13 @@ REQUIRED_FAMILIES = (
     # PR-17 Block-STM engine: conflict-cone retry + work-stealing pool
     "exec_lane_retries_total",
     "exec_lane_steals_total",
+    # PR-18 incident observatory (declaration presence: MTTD/MTTR
+    # histograms record only when the ledger pairs an injected fault
+    # with a detection/fresh-commit; a fault-free node records nothing
+    # and incident_open reads 0 — the healthy signal)
+    "incident_detection_seconds",
+    "incident_recovery_seconds",
+    "incident_open",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

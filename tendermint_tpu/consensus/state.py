@@ -119,6 +119,11 @@ class ConsensusState:
         # node's marks and peer attribution must stay its own, even with
         # several in-process nodes (tests, sim harnesses)
         self.timeline = timeline_mod.Timeline()
+        # incident ledger (libs/incident.py): the node (or scenario
+        # runner) wires one in; None = every incident hook is a no-op.
+        # The commit path closes healed incidents (the MTTR clock) and
+        # the watchdog attaches stall classifications (the MTTD clock)
+        self.incidents = None
         # wall clock of the last (height, round) change — the stall
         # watchdog's dwell anchor; written only by the receive thread.
         # _height_entered anchors the HEIGHT-level dwell: a partition
@@ -1125,6 +1130,8 @@ class ConsensusState:
             fail.fail_point("FinalizeCommit.AfterApplyBlock")  # :1300
 
             self.n_height_committed += 1
+            if self.incidents is not None:
+                self.incidents.note_commit(height)
             self._record_metrics(block, block_parts)
             self.update_to_state(state_copy)  # :1306
             self._schedule_round0(self.rs)  # :1312
@@ -1767,6 +1774,10 @@ class StallWatchdog:
               reason: str) -> None:
         self.cs.metrics.stalls.with_labels(reason).inc()
         self._stalls_total += 1
+        if self.cs.incidents is not None:
+            self.cs.incidents.note_detection(
+                reason, height=rs.height, round=rs.round,
+                scope=scope, dwell_s=round(dwell, 3))
         bundle = self.cs.stall_snapshot(
             switch=self.switch, reason=reason, dwell_s=dwell)
         bundle["scope"] = scope  # which dwell crossed: round | height

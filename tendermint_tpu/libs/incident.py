@@ -1,0 +1,306 @@
+"""incident — the process-wide fault/detection/recovery ledger.
+
+The chaos engines are deliberately silent at fire time: netchaos bumps
+counters (p2p/netchaos.py), storage faults leave a private tally behind
+/debug/recovery, and a scenario SIGKILL is only visible to the process
+that sent it. This module makes every fault phase and every response to
+one a first-class, timestamped observable:
+
+* **injection** — a fault phase went live (a netchaos rule activated, a
+  storage fault fired, a crash was discovered at boot). Opens an
+  incident.
+* **heal** — the fault phase ended (rule deactivated, handshake replay
+  finished). The incident stays open until the chain proves liveness.
+* **detection** — the stall watchdog classified a stall while an
+  incident was open. MTTD = injection -> detection.
+* **recovery** — the first commit at a FRESH height (beyond the height
+  reached when the fault healed) closed the incident. MTTR = heal ->
+  recovery.
+
+Every entry carries BOTH a monotonic stamp (exact node-local deltas —
+MTTD/MTTR never cross clocks) and a wall stamp on the same skewed clock
+as /debug/clock and the timeline marks, so tools/fleettrace.py can
+rebase entries from N nodes onto the collector's reference clock and
+attribute fault phases fleet-wide.
+
+Seeded-run reproducibility: injection and heal entries are identified
+by a deterministic `uid` derived from the plan seed and the fault's
+position in it (``net:<seed>:<phase_idx>``,
+``storage:<seed>:<target>:<kind>:<at_op>``), and their detail is
+plan-derived only. `canonical_bytes()` projects those entries minus the
+clock stamps, sorted by uid — two runs of the same seeded plan produce
+byte-identical canonical ledgers regardless of thread interleaving,
+which is the replay contract the determinism gate audits. Detections
+and recoveries are *measurements* of the run, not part of the seeded
+surface, and are excluded by default.
+
+One ledger per node: node boot creates it, hands it to the chaos
+engines and the consensus machine, and serves `status()` at the
+ProfServer's /debug/incidents. The in-process scenario runner shares a
+single ledger across all its nodes (one process, one monotonic clock),
+which is what makes scenario MTTD/MTTR exact.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+CATEGORIES = ("injection", "heal", "detection", "recovery")
+
+# an open incident is "overdue" (monitor drops health to moderate) when
+# it outlives its plan phase window — or its heal — by this much
+DEFAULT_OVERDUE_GRACE_S = 5.0
+
+# uids under these prefixes are SEEDED: their detail is a pure function
+# of a fault plan, so they belong to the byte-identical replay surface.
+# ``crash:<moniker>`` entries are discoveries (replayed_blocks etc. are
+# measurements of the run) and are excluded from it.
+SEEDED_UID_PREFIXES = ("net:", "storage:")
+
+
+def canonical_projection(entries,
+                         categories=("injection", "heal"),
+                         uid_prefixes=SEEDED_UID_PREFIXES) -> bytes:
+    """The seeded-replay surface of a ledger (or of scraped
+    /debug/incidents entries): entries of the given categories under
+    the seeded uid prefixes, clock stamps and sequence numbers
+    stripped, sorted by (uid, category, kind). Cross-thread
+    interleaving of independent fault sources varies run to run; the
+    per-source content and order do not — so this projection is
+    byte-identical across same-seed runs."""
+    picked = [
+        {"uid": e["uid"], "category": e["category"],
+         "kind": e["kind"], "detail": e["detail"]}
+        for e in entries
+        if e["category"] in categories
+        and (not uid_prefixes
+             or any(e["uid"].startswith(p) for p in uid_prefixes))
+    ]
+    picked.sort(key=lambda e: (e["uid"], e["category"], e["kind"]))
+    return json.dumps(picked, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+class IncidentLedger:
+    """Bounded, thread-safe event ledger with incident pairing.
+
+    Pairing model: `open_incident` opens one incident per uid;
+    `note_detection` attaches to the oldest open incident that has no
+    detection yet (an unmatched detection is still recorded — an honest
+    "the watchdog fired and no injection explains it"); `note_heal`
+    marks the fault phase over and snapshots the height reached;
+    `note_commit` closes every healed incident once a commit lands at a
+    height beyond its heal-time height, which is the liveness proof
+    MTTR is defined against."""
+
+    def __init__(self, maxlen: int = 4096, skew_s: float = 0.0,
+                 overdue_grace_s: float = DEFAULT_OVERDUE_GRACE_S):
+        self._lock = threading.Lock()
+        self._entries: deque = deque(maxlen=maxlen)
+        self._open: "OrderedDict[str, dict]" = OrderedDict()
+        self._seq = 0
+        self._skew_s = skew_s
+        self._grace_s = overdue_grace_s
+        self._last_height = 0
+        self._metrics = None  # IncidentMetrics (metrics.py)
+        self._counts: Dict[str, int] = {c: 0 for c in CATEGORIES}
+
+    # -- wiring --------------------------------------------------------
+
+    def set_skew(self, skew_s: float) -> None:
+        """Wall stamps use time.time() + skew — the SAME synthetic skew
+        [instrumentation] clock_skew_s applies to timeline marks and
+        /debug/clock, so fleettrace's one offset rebases all three."""
+        with self._lock:
+            self._skew_s = skew_s
+
+    def set_metrics(self, metrics) -> None:
+        self._metrics = metrics
+
+    def set_height(self, height: int) -> None:
+        """Seed the committed-height watermark (boot calls this with the
+        store tip so "fresh height" means beyond the pre-crash chain,
+        not beyond zero)."""
+        with self._lock:
+            self._last_height = max(self._last_height, int(height))
+
+    # -- recording core ------------------------------------------------
+
+    def _record_locked(self, category: str, kind: str, uid: str,
+                       detail: dict) -> dict:
+        entry = {
+            "seq": self._seq,
+            "category": category,
+            "kind": kind,
+            "uid": uid,
+            "mono_ns": time.monotonic_ns(),
+            "wall_s": time.time() + self._skew_s,
+            "detail": detail,
+        }
+        self._seq += 1
+        self._counts[category] = self._counts.get(category, 0) + 1
+        self._entries.append(entry)
+        return entry
+
+    def _set_open_gauge_locked(self) -> None:
+        if self._metrics is not None:
+            self._metrics.open.set(len(self._open))
+
+    # -- the four event kinds ------------------------------------------
+
+    def open_incident(self, uid: str, kind: str, **detail) -> Optional[dict]:
+        """A fault phase went live. Idempotent per uid (netchaos may
+        observe the same activation from several send paths)."""
+        with self._lock:
+            if uid in self._open:
+                return None
+            entry = self._record_locked("injection", kind, uid, detail)
+            self._open[uid] = {
+                "uid": uid,
+                "kind": kind,
+                "open_seq": entry["seq"],
+                "open_mono_ns": entry["mono_ns"],
+                "open_wall_s": entry["wall_s"],
+                "detail": detail,
+                "detected": False,
+                "healed": False,
+                "heal_mono_ns": None,
+                "height_at_heal": None,
+            }
+            self._set_open_gauge_locked()
+            return entry
+
+    def note_detection(self, kind: str, **detail) -> dict:
+        """The watchdog (or any detector) classified a fault. Attaches
+        to the oldest open undetected incident; records honestly
+        unmatched otherwise."""
+        with self._lock:
+            target = next((inc for inc in self._open.values()
+                           if not inc["detected"]), None)
+            entry = self._record_locked("detection", kind, "", detail)
+            if target is None:
+                entry["detail"] = dict(detail, matched_uid=None)
+                return entry
+            target["detected"] = True
+            mttd_s = (entry["mono_ns"] - target["open_mono_ns"]) / 1e9
+            entry["detail"] = dict(detail, matched_uid=target["uid"],
+                                   mttd_s=round(mttd_s, 6))
+            if self._metrics is not None:
+                self._metrics.detection.with_labels(
+                    target["kind"]).observe(mttd_s)
+            return entry
+
+    def note_heal(self, uid: str, **detail) -> Optional[dict]:
+        """The fault phase is over (rule deactivated / replay done).
+        Starts the MTTR clock; the incident closes at the next fresh
+        commit. Idempotent; a heal for an unknown uid is dropped (the
+        matching activation was never observed — nothing to measure)."""
+        with self._lock:
+            inc = self._open.get(uid)
+            if inc is None or inc["healed"]:
+                return None
+            entry = self._record_locked(
+                "heal", inc["kind"], uid, detail)
+            inc["healed"] = True
+            inc["heal_mono_ns"] = entry["mono_ns"]
+            inc["height_at_heal"] = self._last_height
+            return entry
+
+    def note_commit(self, height: int) -> None:
+        """A block committed. Cheap on the happy path (no open
+        incidents -> one lock round and out); closes every healed
+        incident this height is fresh for."""
+        with self._lock:
+            if height > self._last_height:
+                self._last_height = height
+            if not self._open:
+                return
+            closed = [uid for uid, inc in self._open.items()
+                      if inc["healed"] and height > inc["height_at_heal"]]
+            for uid in closed:
+                inc = self._open.pop(uid)
+                mttr_s = (time.monotonic_ns() - inc["heal_mono_ns"]) / 1e9
+                self._record_locked(
+                    "recovery", inc["kind"], uid,
+                    {"height": height,
+                     "height_at_heal": inc["height_at_heal"],
+                     "mttr_s": round(mttr_s, 6)})
+                if self._metrics is not None:
+                    self._metrics.recovery.with_labels(
+                        inc["kind"]).observe(mttr_s)
+            if closed:
+                self._set_open_gauge_locked()
+
+    # -- export --------------------------------------------------------
+
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def open_incidents(self) -> List[dict]:
+        """Open incidents with live age and the overdue verdict the
+        monitor keys health on: an incident is overdue when it outlived
+        its plan phase window (unhealed) or its heal (healed but no
+        fresh commit) by the grace."""
+        now = time.monotonic_ns()
+        with self._lock:
+            out = []
+            for inc in self._open.values():
+                age_s = (now - inc["open_mono_ns"]) / 1e9
+                # plan-derived expected duration, when the injection
+                # carried its phase window
+                d = inc["detail"]
+                expected_s = None
+                if "until_s" in d and "at_s" in d:
+                    expected_s = float(d["until_s"]) - float(d["at_s"])
+                if inc["healed"]:
+                    overdue = ((now - inc["heal_mono_ns"]) / 1e9
+                               > self._grace_s)
+                elif expected_s is not None:
+                    overdue = age_s > expected_s + self._grace_s
+                else:
+                    overdue = age_s > self._grace_s
+                out.append({
+                    "uid": inc["uid"],
+                    "kind": inc["kind"],
+                    "age_s": round(age_s, 3),
+                    "detected": inc["detected"],
+                    "healed": inc["healed"],
+                    "expected_s": expected_s,
+                    "overdue": overdue,
+                    "opened_wall_s": inc["open_wall_s"],
+                })
+            return out
+
+    def status(self) -> dict:
+        """The /debug/incidents payload."""
+        open_list = self.open_incidents()
+        with self._lock:
+            return {
+                "entries": [dict(e) for e in self._entries],
+                "open": open_list,
+                "counts": dict(self._counts),
+                "last_height": self._last_height,
+                "skew_s": self._skew_s,
+            }
+
+    def canonical_bytes(self, categories=("injection", "heal"),
+                        uid_prefixes=SEEDED_UID_PREFIXES) -> bytes:
+        """See canonical_projection: the byte-identical seeded-replay
+        surface of this ledger."""
+        with self._lock:
+            snapshot = [dict(e) for e in self._entries]
+        return canonical_projection(snapshot, categories=categories,
+                                    uid_prefixes=uid_prefixes)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._open.clear()
+            self._counts = {c: 0 for c in CATEGORIES}
+            self._last_height = 0
+            self._set_open_gauge_locked()

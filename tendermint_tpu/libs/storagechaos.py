@@ -170,11 +170,21 @@ class StorageFaultInjector:
         self._sync_sizes: Dict[str, int] = {}  # target -> size at last fsync
         self.injected: Dict[str, int] = {k: 0 for k in KINDS}
         self._metric = None  # storage_faults_injected_total{kind}
+        self._incidents = None  # IncidentLedger (libs/incident.py)
 
     # -- wiring --------------------------------------------------------
 
     def set_metrics(self, counter) -> None:
         self._metric = counter
+
+    def set_incidents(self, ledger) -> None:
+        """Ledger every fired fault as an incident injection: uid
+        ``storage:<seed>:<target>:<kind>:<at_op>`` — plan-derived, so
+        same-seed runs replay byte-identical injection entries. With
+        exit_process the entry dies with the victim; the orchestrator
+        (scenario / fleettrace extra_injections) carries the kill stamp
+        across the restart."""
+        self._incidents = ledger
 
     def register_file(self, target: str, path: str) -> None:
         """Tell the injector which on-disk file backs a target (used
@@ -257,6 +267,11 @@ class StorageFaultInjector:
         the fault's durable damage."""
         import sys
 
+        if self._incidents is not None:
+            self._incidents.open_incident(
+                f"storage:{self.plan.seed}:{fault.target}:"
+                f"{fault.kind}:{fault.at_op}",
+                fault.kind, target=fault.target, at_op=fault.at_op)
         self.note_injected(fault.kind)
         self.kill()
         if self.exit_process:

@@ -8,6 +8,11 @@ batch-verify engine always-available spans:
 
 - Ring-buffered: a bounded deque of finished spans; steady-state
   tracing never grows memory, the newest `capacity` spans win.
+- In-flight visible: spans open at export time are synthesized into
+  the trace with `dur = now - start` and `args.inflight = true`, so a
+  snapshot taken mid-operation still nests correctly (a finished child
+  is never exported without its enclosing span) and a stuck thread's
+  open span shows up instead of silently missing.
 - Thread-safe: appends, snapshot, clear and enable (which may swap the
   buffer for a capacity change) all share one uncontended lock.
 - Near-zero overhead when disabled: `span()` returns one shared no-op
@@ -77,7 +82,13 @@ class _Span:
         self._args = args
 
     def __enter__(self):
+        t = threading.current_thread()
+        tracer = self._tracer
         self._start_ns = time.perf_counter_ns()
+        with tracer._lock:
+            tracer._open[id(self)] = (
+                self._name, self._cat, self._start_ns,
+                t.ident or 0, t.name, self._args or None)
         return self
 
     def __exit__(self, *exc):
@@ -96,6 +107,7 @@ class _Span:
         # under the lock so an enable(capacity) buffer swap can't strand
         # this record in the discarded deque
         with tracer._lock:
+            tracer._open.pop(id(self), None)
             tracer._buf.append(rec)
         return False
 
@@ -106,6 +118,11 @@ class Tracer:
     def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = False):
         self._lock = threading.Lock()
         self._buf: collections.deque = collections.deque(maxlen=capacity)
+        # spans entered but not yet exited, keyed by span identity —
+        # exported as in-flight events so a snapshot taken mid-operation
+        # still shows every enclosing span (a closed child is never
+        # orphaned), and a stuck thread's open span stays visible
+        self._open: Dict[int, tuple] = {}
         self._enabled = enabled
         # epoch pins perf_counter to the wall clock once, so exported
         # timestamps are comparable across processes' traces
@@ -162,11 +179,21 @@ class Tracer:
 
     def chrome_trace(self) -> dict:
         """Chrome trace event format: {"traceEvents": [...]} with "X"
-        (complete) events plus thread-name metadata, ts/dur in µs."""
+        (complete) events plus thread-name metadata, ts/dur in µs.
+
+        Spans still open at snapshot time are included too, with
+        `dur = now - start` and `args.inflight = true`. One lock
+        acquisition covers both the finished and the open snapshot, so
+        a finished child span always has its enclosing span present —
+        either finished in the buffer or synthesized as in-flight."""
         pid = os.getpid()
+        with self._lock:
+            finished = list(self._buf)
+            open_spans = list(self._open.values())
+        now_ns = time.perf_counter_ns()
         events = []
         seen_threads: Dict[int, str] = {}
-        for rec in self.events():
+        for rec in finished:
             if rec.thread_id not in seen_threads:
                 seen_threads[rec.thread_id] = rec.thread_name
             ev = {
@@ -181,6 +208,20 @@ class Tracer:
             if rec.args:
                 ev["args"] = rec.args
             events.append(ev)
+        for name, cat, start_ns, tid, tname, args in open_spans:
+            if tid not in seen_threads:
+                seen_threads[tid] = tname
+            events.append({
+                "name": name,
+                "cat": cat or "default",
+                "ph": "X",
+                "ts": self._ts_us(start_ns),
+                "dur": (now_ns - start_ns) / 1e3,
+                "pid": pid,
+                "tid": tid,
+                "args": dict(args, inflight=True) if args
+                        else {"inflight": True},
+            })
         meta = [
             {
                 "name": "thread_name",

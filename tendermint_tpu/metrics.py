@@ -348,6 +348,23 @@ class DeterminismMetrics:
 
 
 @dataclass
+class IncidentMetrics:
+    """Incident-observatory telemetry (ours; libs/incident.py): how
+    fast this node notices and outlives injected faults. Samples flow
+    only when the ledger pairs events — a fault-free node records
+    nothing, which is the healthy signal."""
+
+    # injection -> correct watchdog stall classification, by the
+    # INJECTED fault's kind (MTTD)
+    detection: object = NOP
+    # fault heal -> first commit at a fresh height, by kind (MTTR)
+    recovery: object = NOP
+    # incidents currently open on this node (injected, not yet closed
+    # by a fresh-height commit)
+    open: object = NOP
+
+
+@dataclass
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
@@ -361,6 +378,7 @@ class NodeMetrics:
     recovery: RecoveryMetrics = field(default_factory=RecoveryMetrics)
     determinism: DeterminismMetrics = field(
         default_factory=DeterminismMetrics)
+    incident: IncidentMetrics = field(default_factory=IncidentMetrics)
     registry: Optional[Registry] = None
 
 
@@ -742,7 +760,24 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "surface — any nonzero value is a chain-splitting bug.",
             ("surface",)),
     )
+    incident = IncidentMetrics(
+        detection=r.histogram(
+            f"{ns}_incident_detection_seconds",
+            "Fault injection to correct watchdog stall classification "
+            "(MTTD), by injected fault kind.", ("kind",),
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 300)),
+        recovery=r.histogram(
+            f"{ns}_incident_recovery_seconds",
+            "Fault heal to the first commit at a fresh height (MTTR), "
+            "by injected fault kind.", ("kind",),
+            buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 300)),
+        open=r.gauge(
+            f"{ns}_incident_open",
+            "Incidents currently open on this node (fault injected, "
+            "no fresh-height commit yet)."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
                        rpc=rpc, lockdep=lockdep, recovery=recovery,
-                       determinism=determinism, registry=r)
+                       determinism=determinism, incident=incident,
+                       registry=r)

@@ -240,6 +240,18 @@ class Node:
             enabled=config.instrumentation.flight_recorder,
             samples=config.instrumentation.flight_recorder_samples)
 
+        # incident ledger (libs/incident.py): one per node, fed by the
+        # chaos engines (injections/heals), the stall watchdog
+        # (detections) and the commit path (recoveries); served at
+        # /debug/incidents. Wall stamps share the synthetic
+        # [instrumentation] clock_skew_s with timeline marks and
+        # /debug/clock so fleettrace rebases all three with one offset
+        from ..libs import incident as incident_mod
+
+        self.incidents = incident_mod.IncidentLedger(
+            skew_s=config.instrumentation.clock_skew_s)
+        self.incidents.set_metrics(self.metrics.incident)
+
         # --- storage (node/node.go:162-171) --------------------------
         # crash-consistency fault engine ([storage] fault_plan, ours):
         # when armed, every node DB and the consensus WAL are wrapped in
@@ -259,6 +271,7 @@ class Node:
                 plan, exit_process=True)
             self.fault_injector.set_metrics(
                 self.metrics.recovery.storage_faults)
+            self.fault_injector.set_incidents(self.incidents)
 
         def _db(name: str):
             d = db_provider(name, backend, db_dir)
@@ -307,6 +320,40 @@ class Node:
             self.metrics.recovery.replayed_blocks.inc(handshaker.n_blocks)
         # reload: handshake may have advanced state via replay
         state = sm.load_state_from_db_or_genesis(self.state_db, genesis_doc)
+
+        # incident view of the boot: fresh heights start beyond the tip
+        # we restarted with. An unclean shutdown is discovered either by
+        # the handshake having blocks to replay OR by the dirty-boot
+        # marker a clean stop() would have removed — a crash between two
+        # heights leaves app and chain state equal (nothing to replay)
+        # but still skips the marker cleanup. Ledger it (injection) and
+        # mark the replay completion (heal); the first commit at a fresh
+        # height closes it with the node-local MTTR.
+        self._dirty_marker = (os.path.join(db_dir, "dirty")
+                              if backend != "memdb" else None)
+        unclean_boot = (self._dirty_marker is not None
+                        and os.path.exists(self._dirty_marker))
+        self.incidents.set_height(state.last_block_height)
+        if handshaker.n_blocks or unclean_boot:
+            # uid carries the moniker so an orchestrator-side kill
+            # record (fleettrace extra_injections) merges with the
+            # reboot's own view of the same incident
+            _crash_uid = f"crash:{config.base.moniker}"
+            self.incidents.open_incident(
+                _crash_uid, "crash",
+                replayed_blocks=handshaker.n_blocks,
+                replay_from=handshaker.replay_from,
+                replay_to=handshaker.replay_to)
+            # the recovery handshake IS the crash detector: a stall
+            # watchdog can't classify a dead process, but the reboot
+            # classifying its own unclean shutdown can — and against an
+            # orchestrator-side kill stamp (fleettrace extra_injections)
+            # this detection carries the fleet-level MTTD
+            self.incidents.note_detection(
+                "unclean_shutdown", height=state.last_block_height,
+                replayed_blocks=handshaker.n_blocks)
+            self.incidents.note_heal(
+                _crash_uid, replayed_blocks=handshaker.n_blocks)
 
         # fast-sync only makes sense with peers to sync from; a sole
         # validator skips it (reference node/node.go:240-246). A replica
@@ -399,6 +446,7 @@ class Node:
                 # consistent per-node clock
                 self.consensus_state.timeline.set_skew(
                     config.instrumentation.clock_skew_s)
+            self.consensus_state.incidents = self.incidents
             # while state sync runs, consensus must stay parked
             # (fast_sync mode) and the blockchain pool must NOT start at
             # height 1 — resume_fast_sync re-arms it at the restored
@@ -538,8 +586,10 @@ class Node:
                 plan.seed = config.chaos.seed or plan.seed
             else:
                 plan = netchaos.FaultPlan(seed=config.chaos.seed)
-            netchaos.install(netchaos.NetChaosController(
-                plan, metrics=self.metrics.p2p))
+            ctrl = netchaos.NetChaosController(
+                plan, metrics=self.metrics.p2p)
+            ctrl.set_incidents(self.incidents)
+            netchaos.install(ctrl)
             self._chaos_installed = True
 
         self.transport = MultiplexTransport(
@@ -650,6 +700,16 @@ class Node:
     def start(self) -> None:
         self._running = True
         self._stopped.clear()
+        # dirty-boot marker: exists for exactly the running lifetime of
+        # the node; a boot that finds one knows the previous run never
+        # reached its clean stop() (see the incident block in __init__)
+        if self._dirty_marker is not None:
+            try:
+                with open(self._dirty_marker, "w"):
+                    pass
+            except OSError:
+                LOG.warning("could not write dirty-boot marker %s",
+                            self._dirty_marker)
         self.event_bus.start()
         self.indexer_service.start()
         self._start_verify_warmup()
@@ -860,12 +920,25 @@ class Node:
                 "/debug/recovery": lambda q: self._recovery_status(),
                 "/debug/determinism": lambda q: self._determinism_status(),
                 "/debug/exec": lambda q: self._exec_status(),
+                "/debug/incidents": lambda q: self._incidents_status(),
             },
             identity={"node_id": self.node_key.id,
                       "moniker": self.config.base.moniker},
             clock_skew_s=self.config.instrumentation.clock_skew_s,
         )
         self._prof_server.start()
+
+    def _incidents_status(self) -> dict:
+        """/debug/incidents: the incident ledger (libs/incident.py).
+        Poking the chaos controller's status first lets phase
+        expirations on a QUIET network (a healed partition with no
+        traffic yet) be observed by the scrape itself."""
+        from ..p2p import netchaos
+
+        ctrl = netchaos.get_controller()
+        if ctrl is not None:
+            ctrl.status()  # side effect: observe phase transitions
+        return self.incidents.status()
 
     def _exec_status(self) -> dict:
         """/debug/exec: the exec-lane flight recorder report (per-lane
@@ -1039,6 +1112,13 @@ class Node:
         # the signer process sees EOF and the laddr can be re-bound
         if hasattr(self.priv_validator, "close"):
             self.priv_validator.close()
+        # the last act of a clean stop: the next boot of this home dir
+        # must not ledger a crash incident
+        if self._dirty_marker is not None:
+            try:
+                os.unlink(self._dirty_marker)
+            except OSError:
+                pass
         self._stopped.set()
 
     def wait(self, timeout: Optional[float] = None) -> None:

@@ -213,17 +213,60 @@ class NetChaosController:
         # on every frame of every link, so the gauge only pays a
         # registry write when the active-phase count actually changes
         self._last_active_gauge: Optional[int] = None
+        # incident ledger (libs/incident.py) + the phase-index set it
+        # last saw, so activations/deactivations are recorded exactly
+        # once each no matter how many links observe them
+        self._incidents = None
+        self._active_idx: Optional[frozenset] = None
 
     # -- lifecycle -----------------------------------------------------
+
+    def set_incidents(self, ledger) -> None:
+        """Record every phase activation/deactivation into an
+        IncidentLedger: uid ``net:<seed>:<phase_idx>``, detail fully
+        plan-derived (the seeded-replay contract)."""
+        self._incidents = ledger
+
+    def _observe_phases(self, t: float) -> None:
+        """Diff the active phase-index set against the last one seen and
+        ledger the transitions. Driven by outbound() (every write) and
+        status() (every /debug scrape — catches phases expiring on a
+        quiet network)."""
+        if self._incidents is None:
+            return
+        idx = frozenset(i for i, p in enumerate(self.plan.phases)
+                        if p.at_s <= t < p.until_s)
+        # diff-and-swap under the lock (every send path races through
+        # here); the ledger calls run outside it — the ledger has its
+        # own lock and never calls back into the controller
+        with self._lock:
+            prev = self._active_idx
+            if idx == prev:
+                return
+            self._active_idx = idx
+        prev = prev or frozenset()
+        for i in sorted(idx - prev):
+            p = self.plan.phases[i]
+            self._incidents.open_incident(
+                f"net:{self.plan.seed}:{i}", p.rule.kind,
+                phase=i, at_s=p.at_s, until_s=p.until_s,
+                rule=p.rule.to_obj())
+        for i in sorted(prev - idx):
+            p = self.plan.phases[i]
+            self._incidents.note_heal(
+                f"net:{self.plan.seed}:{i}",
+                phase=i, at_s=p.at_s, until_s=p.until_s)
 
     def start(self) -> None:
         """Pin the plan's t=0. Idempotent."""
         with self._lock:
             if self._t0 is None:
                 self._t0 = self._time()
-        n = len(self.plan.active(self.elapsed()))
+        t = self.elapsed()
+        n = len(self.plan.active(t))
         self._last_active_gauge = n
         self.metrics.chaos_active_rules.set(n)
+        self._observe_phases(t)
 
     def elapsed(self) -> float:
         with self._lock:
@@ -243,6 +286,7 @@ class NetChaosController:
             self._rngs.clear()
             self._monitors.clear()
             self._last_active_gauge = None  # re-publish on next decision
+            self._active_idx = None  # re-diff against the new plan
 
     # -- determinism core ----------------------------------------------
 
@@ -289,6 +333,7 @@ class NetChaosController:
         if len(active) != self._last_active_gauge:
             self._last_active_gauge = len(active)
             self.metrics.chaos_active_rules.set(len(active))
+            self._observe_phases(t)
         if not active:
             return Decision()
         rules = [r for r in active if r.matches(sender, receiver)]
@@ -328,6 +373,7 @@ class NetChaosController:
         with self._lock:
             injected = dict(self.injected)
         t = self.elapsed()
+        self._observe_phases(t)
         return {
             "seed": self.plan.seed,
             "elapsed_s": round(t, 3),

@@ -308,11 +308,219 @@ def _strict_stages(t_start, named_boundaries):
     return stages, unaccounted
 
 
+# --- incident stitching -----------------------------------------------
+#
+# Each node's /debug/incidents is a ledger of fault injections/heals
+# (uid-identified, plan-derived), watchdog detections, and fresh-height
+# recoveries (libs/incident.py). N nodes observing one seeded plan
+# record the SAME uids on N skewed clocks; rebasing every entry onto
+# the collector clock and deduping by uid yields one fleet-level fault
+# phase per injected fault, to which detections and recoveries are
+# attributed. A phase with no detection stays honestly unattributed —
+# the acceptance oracle (≥95% attribution) fails on silent watchdogs,
+# not just on wild clocks.
+
+# a detection may legitimately precede its injection's REBASED stamp by
+# the clock-probe uncertainty; anything earlier belongs to no phase
+DETECT_SLACK_S = 0.25
+
+
+def _incident_entries(node_incidents: Dict[str, dict]) -> List[dict]:
+    """Flatten {node_name: {"status": /debug/incidents payload,
+    "offset_s": o}} into rebased entries tagged with their node."""
+    out = []
+    for name, rec in node_incidents.items():
+        status = rec.get("status") or {}
+        off = rec.get("offset_s", 0.0)
+        for e in status.get("entries", []):
+            r = dict(e)
+            r["node"] = name
+            r["t_s"] = e["wall_s"] - off
+            out.append(r)
+    out.sort(key=lambda e: e["t_s"])
+    return out
+
+
+def incident_report(node_incidents: Dict[str, dict],
+                    extra_injections: Optional[List[dict]] = None,
+                    detect_slack_s: float = DETECT_SLACK_S) -> dict:
+    """Fleet-level incident report: one phase per injected fault uid.
+
+    `extra_injections` carries orchestrator-side events the victims
+    could not ledger themselves (a SIGKILL's send time, a storage fault
+    whose entry died with the process): dicts with uid/kind/wall_s
+    (collector clock, offset 0) and optional heal_wall_s. A uid that a
+    node also recorded merges — earliest stamp wins, so the
+    orchestrator's kill time beats the reboot's discovery time and MTTD
+    measures the real outage, not the bookkeeping."""
+    entries = _incident_entries(node_incidents)
+
+    phases: Dict[str, dict] = {}
+    for e in entries:
+        if e["category"] != "injection":
+            continue
+        ph = phases.get(e["uid"])
+        if ph is None or e["t_s"] < ph["injected_at"]:
+            phases[e["uid"]] = ph = {
+                "uid": e["uid"], "kind": e["kind"],
+                "injected_at": e["t_s"],
+                "detail": e.get("detail", {}),
+                "nodes": set(ph["nodes"]) if ph else set(),
+            }
+        ph["nodes"].add(e["node"])
+    for x in extra_injections or []:
+        ph = phases.get(x["uid"])
+        if ph is None:
+            phases[x["uid"]] = ph = {
+                "uid": x["uid"], "kind": x["kind"],
+                "injected_at": x["wall_s"],
+                "detail": {k: v for k, v in x.items()
+                           if k not in ("uid", "kind", "wall_s",
+                                        "heal_wall_s")},
+                "nodes": {x.get("node", "orchestrator")},
+            }
+        else:
+            ph["injected_at"] = min(ph["injected_at"], x["wall_s"])
+            ph["nodes"].add(x.get("node", "orchestrator"))
+        if x.get("heal_wall_s") is not None:
+            ph["extra_heal"] = x["heal_wall_s"]
+
+    heals: Dict[str, float] = {}
+    for e in entries:
+        if e["category"] == "heal":
+            t = heals.get(e["uid"])
+            heals[e["uid"]] = e["t_s"] if t is None else min(t, e["t_s"])
+
+    detections = [e for e in entries if e["category"] == "detection"]
+    recoveries = [e for e in entries if e["category"] == "recovery"]
+
+    report_phases = []
+    claimed_det: set = set()
+    claimed_rec: set = set()
+    for uid in sorted(phases, key=lambda u: phases[u]["injected_at"]):
+        ph = phases[uid]
+        t_inj = ph["injected_at"]
+        t_heal = heals.get(uid, ph.get("extra_heal"))
+
+        # detection: a node-ledger uid match wins; otherwise the
+        # earliest unclaimed detection after injection (minus probe
+        # slack) and — when the phase healed — not absurdly late
+        det = None
+        for i, d in enumerate(detections):
+            if i in claimed_det:
+                continue
+            if d["detail"].get("matched_uid") == uid:
+                det = (i, d)
+                break
+        if det is None:
+            for i, d in enumerate(detections):
+                if i in claimed_det:
+                    continue
+                if d["t_s"] >= t_inj - detect_slack_s and (
+                        t_heal is None or d["t_s"] <= t_heal
+                        + detect_slack_s or d["detail"].get(
+                            "matched_uid") is not None):
+                    det = (i, d)
+                    break
+        detection = None
+        if det is not None:
+            claimed_det.add(det[0])
+            d = det[1]
+            detection = {
+                "node": d["node"], "reason": d["kind"],
+                "t_s": d["t_s"],
+                "height": d["detail"].get("height"),
+                "scope": d["detail"].get("scope"),
+                "mttd_s": round(max(0.0, d["t_s"] - t_inj), 6),
+            }
+
+        # recovery: uid match first (the node-local mttr is exact),
+        # else earliest unclaimed recovery after the heal
+        rec = None
+        for i, r in enumerate(recoveries):
+            if i not in claimed_rec and r["uid"] == uid:
+                rec = (i, r)
+                break
+        if rec is None and t_heal is not None:
+            for i, r in enumerate(recoveries):
+                if i not in claimed_rec and r["t_s"] >= t_heal:
+                    rec = (i, r)
+                    break
+        recovery = None
+        if rec is not None:
+            claimed_rec.add(rec[0])
+            r = rec[1]
+            mttr = r["detail"].get("mttr_s") if r["uid"] == uid else None
+            if mttr is None and t_heal is not None:
+                mttr = round(max(0.0, r["t_s"] - t_heal), 6)
+            recovery = {
+                "node": r["node"], "t_s": r["t_s"],
+                "height": r["detail"].get("height"),
+                "mttr_s": mttr,
+            }
+
+        heights_stalled = None
+        if detection and recovery and detection.get("height") is not None \
+                and recovery.get("height") is not None:
+            heights_stalled = [detection["height"], recovery["height"]]
+        report_phases.append({
+            "uid": uid, "kind": ph["kind"],
+            "injected_at": t_inj,
+            "healed_at": t_heal,
+            "affected": sorted(ph["nodes"]),
+            "detail": ph["detail"],
+            "detection": detection,
+            "recovery": recovery,
+            "heights_stalled": heights_stalled,
+        })
+
+    total = len(report_phases)
+    attributed = sum(1 for p in report_phases if p["detection"])
+    return {
+        "phases": report_phases,
+        "total": total,
+        "attributed": attributed,
+        "attribution": round(attributed / total, 6) if total else None,
+        "open": {name: (rec.get("status") or {}).get("open", [])
+                 for name, rec in node_incidents.items()},
+    }
+
+
+def summarize_incidents(report: dict) -> str:
+    """The incident report as compact text (CLI + monitor rendering):
+    'partition 0|1<->2|3 -> partition_suspected +1.2s -> heal ->
+    commit +24s' on one clock."""
+    lines = [f"incidents: {report['attributed']}/{report['total']} "
+             f"fault phases attributed"]
+    for p in report["phases"]:
+        bits = [f"  {p['kind']} {p['uid']}"]
+        d = p["detection"]
+        if d:
+            bits.append(f"-> {d['reason']}@{d['node']} "
+                        f"+{d['mttd_s']:.2f}s")
+        else:
+            bits.append("-> UNDETECTED")
+        if p["healed_at"] is not None:
+            bits.append("-> heal")
+        r = p["recovery"]
+        if r and r.get("mttr_s") is not None:
+            bits.append(f"-> commit h{r.get('height')} "
+                        f"+{r['mttr_s']:.2f}s")
+        elif p["healed_at"] is not None:
+            bits.append("-> NO FRESH COMMIT")
+        if p["heights_stalled"]:
+            bits.append(f"(heights {p['heights_stalled'][0]}"
+                        f"->{p['heights_stalled'][1]})")
+        lines.append(" ".join(bits))
+    return "\n".join(lines)
+
+
 # --- exports ----------------------------------------------------------
 
 
 def chrome_trace(stitched: Sequence[dict],
-                 nodes: Sequence[dict]) -> dict:
+                 nodes: Sequence[dict],
+                 incidents: Optional[dict] = None) -> dict:
     """Chrome trace-event JSON: one pid per fleet, one tid per node,
     every timestamp rebased onto the collector clock. Load next to a
     single node's /debug/trace dump to line local spans up with the
@@ -323,6 +531,44 @@ def chrome_trace(stitched: Sequence[dict],
          "args": {"name": name}}
         for name, tid in tids.items()
     ]
+    if incidents and incidents.get("phases"):
+        # the fault lane: tid 0, above every node track — injected
+        # phases as spans, detections/recoveries as instants, so
+        # "partition -> partition_suspected -> heal -> commit" reads on
+        # the same rebased clock as the propagation waterfall
+        fault_tid = 0
+        events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                       "tid": fault_tid, "args": {"name": "faults"}})
+        for p in incidents["phases"]:
+            t0 = p["injected_at"]
+            t1 = p["healed_at"]
+            events.append({
+                "name": f"fault:{p['kind']}", "cat": "incident",
+                "ph": "X", "ts": t0 * 1e6,
+                "dur": max(((t1 or t0) - t0) * 1e6, 1.0),
+                "pid": 1, "tid": fault_tid,
+                "args": {"uid": p["uid"], "affected": p["affected"],
+                         "heights_stalled": p["heights_stalled"]},
+            })
+            d = p["detection"]
+            if d:
+                events.append({
+                    "name": f"detect:{d['reason']}", "cat": "incident",
+                    "ph": "i", "s": "g", "ts": d["t_s"] * 1e6,
+                    "pid": 1, "tid": fault_tid,
+                    "args": {"uid": p["uid"], "node": d["node"],
+                             "mttd_s": d["mttd_s"]},
+                })
+            r = p["recovery"]
+            if r:
+                events.append({
+                    "name": "recover:commit", "cat": "incident",
+                    "ph": "i", "s": "g", "ts": r["t_s"] * 1e6,
+                    "pid": 1, "tid": fault_tid,
+                    "args": {"uid": p["uid"], "node": r["node"],
+                             "height": r["height"],
+                             "mttr_s": r["mttr_s"]},
+                })
     for rec in stitched:
         prop_tid = tids.get(rec["tree"]["proposer"], 0)
         t0 = rec.get("t0_s")
@@ -497,6 +743,32 @@ class FleetTrace:
                 pass
         return snap
 
+    def collect_incidents(self, probes: Optional[Dict[str, dict]] = None,
+                          extra_injections: Optional[List[dict]] = None
+                          ) -> dict:
+        """Scrape every node's /debug/incidents, rebase onto the
+        collector clock, and stitch the fleet incident report."""
+        if probes is None:
+            probes = self.probe_all()
+        node_incidents: Dict[str, dict] = {}
+        for ep in self.endpoints:
+            pr = probes.get(ep, {})
+            if "error" in pr:
+                continue
+            try:
+                status = self._fetch_json(
+                    f"http://{ep}/debug/incidents")
+            except Exception:  # noqa: BLE001 - older nodes lack it
+                continue
+            if not isinstance(status, dict) or "entries" not in status:
+                continue
+            node_incidents[ep] = {
+                "status": status,
+                "offset_s": pr.get("offset_s", 0.0),
+            }
+        return incident_report(node_incidents,
+                               extra_injections=extra_injections)
+
     def heights(self, last: int = 4) -> List[int]:
         """Heights present on EVERY reachable node (stitching needs the
         full fleet's view of a height)."""
@@ -543,6 +815,7 @@ class FleetTrace:
             "heights": list(heights),
             "stitched": stitched,
             "exec": exec_reports,
+            "incidents": self.collect_incidents(probes),
         }
         if self.history_path:
             with open(self.history_path, "a") as f:
@@ -598,10 +871,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"(rtt {pr['rtt_s'] * 1e3:.3f}ms)")
     for rec in result["stitched"]:
         print(summarize(rec))
+    inc = result.get("incidents")
+    if inc and inc.get("total"):
+        print(summarize_incidents(inc))
     if args.chrome:
         nodes = result.get("_nodes", [])
         with open(args.chrome, "w") as f:
-            json.dump(chrome_trace(result["stitched"], nodes), f,
+            json.dump(chrome_trace(result["stitched"], nodes,
+                                   incidents=inc), f,
                       separators=(",", ":"))
         print(f"chrome trace -> {args.chrome}")
     return 0 if result["stitched"] else 1

@@ -28,6 +28,14 @@ link (proxy/resilient.py). Any conn off "healthy" flags the node
 abci_degraded and drops network health to "moderate" — a node whose
 mempool conn is down keeps committing (and looks fine to /status) while
 silently rejecting every CheckTx.
+
+And /debug/incidents: the node's incident ledger (libs/incident.py).
+Open incidents surface as `[INCIDENT kind=partition age=12s]` CLI tags
+and ride every --history JSONL line; an incident still open past its
+plan phase window (the ledger's own "overdue" verdict) drops network
+health to "moderate" — the fault is gone, but the chain has not
+committed a fresh height to prove it recovered. The view clears with
+the rest of the debug state when the endpoint stops answering.
 """
 
 from __future__ import annotations
@@ -192,6 +200,13 @@ class NodeStatus:
     det_oracle_runs: int = 0
     det_divergences: int = 0
     det_lint_unsuppressed: int = 0
+    # incident-ledger view (from /debug/incidents, libs/incident.py):
+    # the node's OPEN incidents (fault injected, no fresh-height commit
+    # yet) with live age and the ledger's own overdue verdict — an
+    # incident that outlives its plan phase window (or its heal) is a
+    # recovery that should have happened and didn't
+    incidents_open: List[dict] = field(default_factory=list)
+    incident_counts: Dict[str, int] = field(default_factory=dict)
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
@@ -255,6 +270,13 @@ class NodeStatus:
         disagreeing (or an in-process lint run left unsuppressed
         findings) — its execution stack can split from the chain."""
         return self.det_divergences > 0 or self.det_lint_unsuppressed > 0
+
+    @property
+    def incident_overdue(self) -> bool:
+        """Some open incident outlived its plan phase window (or its
+        heal) without the fresh-height commit that closes it — the
+        fault engine says the network should have recovered by now."""
+        return any(i.get("overdue") for i in self.incidents_open)
 
     @property
     def abci_degraded(self) -> bool:
@@ -374,6 +396,8 @@ class NodeStatus:
         self.det_oracle_runs = 0
         self.det_divergences = 0
         self.det_lint_unsuppressed = 0
+        self.incidents_open = []
+        self.incident_counts = {}
 
     def mark_online(self) -> None:
         now = time.time()
@@ -651,6 +675,17 @@ class Monitor:
             ns.det_lint_unsuppressed = 0
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/incidents", timeout=2.0) as r:
+                inc = json.load(r)
+            ns.incidents_open = list(inc.get("open") or [])
+            ns.incident_counts = {
+                str(k): int(v)
+                for k, v in (inc.get("counts") or {}).items()}
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.incidents_open = []
+            ns.incident_counts = {}
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/rpc", timeout=2.0) as r:
                 rp = json.load(r)
             ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
@@ -719,6 +754,10 @@ class Monitor:
                 # its execution engines disagree can split from the
                 # chain the next time the divergent path runs live
                 and not any(n.det_diverging for n in online)
+                # an incident open past its plan phase window is a
+                # recovery that should have happened and didn't — the
+                # fault is gone but the chain hasn't proven liveness
+                and not any(n.incident_overdue for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -806,6 +845,9 @@ class Monitor:
                     "det_divergences": n.det_divergences,
                     "det_lint_unsuppressed": n.det_lint_unsuppressed,
                     "det_diverging": n.det_diverging,
+                    "incidents_open": list(n.incidents_open),
+                    "incident_counts": dict(n.incident_counts),
+                    "incident_overdue": n.incident_overdue,
                 }
                 for n in self.nodes.values()
             ],
@@ -870,6 +912,11 @@ def main(argv=None) -> int:
                     if n["partition_suspect"]:
                         line += (f" [PARTITIONED? peers={n['n_peers']}"
                                  f"/{n['n_validators']}vals]")
+                    for i in n["incidents_open"]:
+                        line += (f" [INCIDENT kind={i.get('kind')}"
+                                 f" age={i.get('age_s', 0):.0f}s"
+                                 + (" OVERDUE" if i.get("overdue")
+                                    else "") + "]")
                     if n["abci_degraded"]:
                         bad = ",".join(
                             f"{k}={v}" for k, v in n["abci_conns"].items()

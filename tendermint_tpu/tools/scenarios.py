@@ -35,6 +35,12 @@ Catalog (run one with `python -m tendermint_tpu.tools.scenarios NAME
                            tools/fleettrace.py collector must recover
                            the offsets (≤10ms) and attribute ≥95% of
                            each block's wall time to named stages
+  incident                 MULTI-PROCESS: composed network×storage
+                           timeline from ONE seed — config-loaded
+                           [chaos] partition + [storage] torn-WAL kill;
+                           judged by the fleet-stitched incident report
+                           (every phase attributed, MTTD/MTTR
+                           published, seeded ledger byte-replayable)
 
 The fault timeline is a pure function of the seed (see p2p/netchaos.py);
 `bench.py chaosnet` reports partition_heal's recovery latency as a
@@ -44,6 +50,7 @@ standard BENCH line.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import time
@@ -234,9 +241,16 @@ class ChaosNet:
         from ..types.event_bus import EVENT_NEW_BLOCK, query_for_event
         from ..types.validator_set import random_validator_set
 
+        from ..libs.incident import IncidentLedger
+
         self.seed = seed
+        # ONE ledger for the whole localnet: every node shares the
+        # process (and the monotonic clock), so scenario MTTD/MTTR are
+        # exact node-local deltas, not cross-clock estimates
+        self.incidents = IncidentLedger()
         self.controller = netchaos.install(
             netchaos.NetChaosController(netchaos.FaultPlan(seed=seed)))
+        self.controller.set_incidents(self.incidents)
         vs, keys = random_validator_set(n, power)
         doc = GenesisDoc(
             chain_id=chain_id,
@@ -247,6 +261,8 @@ class ChaosNet:
         self.nodes = [ScenarioNode(i, doc, keys[i], chain_id,
                                    app_factory=app_factory)
                       for i in range(n)]
+        for node in self.nodes:
+            node.cs.incidents = self.incidents
         self.subs = [
             node.bus.subscribe(f"sc{i}", query_for_event(EVENT_NEW_BLOCK), 256)
             for i, node in enumerate(self.nodes)
@@ -327,6 +343,32 @@ class ChaosNet:
             out.extend(n.stall_reasons())
         return out
 
+    def incident_summary(self) -> dict:
+        """Ledger-derived fault observability for the scenario result:
+        per-incident MTTD (injection -> watchdog classification) and
+        MTTR (heal -> first fresh-height commit), both exact monotonic
+        deltas on the shared ledger — this supersedes the per-scenario
+        wall stopwatches as the recovery measurement."""
+        self.controller.status()  # observe phase expiry on quiet nets
+        mttd, mttr, unmatched = [], [], 0
+        for e in self.incidents.entries():
+            if e["category"] == "detection":
+                if e["detail"].get("matched_uid") is None:
+                    unmatched += 1
+                else:
+                    mttd.append(e["detail"]["mttd_s"])
+            elif e["category"] == "recovery":
+                mttr.append(e["detail"]["mttr_s"])
+        return {
+            "counts": dict(self.incidents.status()["counts"]),
+            "open": self.incidents.open_incidents(),
+            "mttd_s": [round(v, 3) for v in mttd],
+            "mttr_s": [round(v, 3) for v in mttr],
+            "unmatched_detections": unmatched,
+            "canonical_sha256": hashlib.sha256(
+                self.incidents.canonical_bytes()).hexdigest(),
+        }
+
     def stop(self) -> None:
         netchaos.uninstall()
         for n in self.nodes:
@@ -347,11 +389,21 @@ def _result(name: str, seed: int, net: Optional[ChaosNet],
             converged: bool, recovery_s: Optional[float],
             expect_reasons, extra: Optional[dict] = None) -> dict:
     reasons = net.stall_reasons() if net is not None else []
+    incidents = net.incident_summary() if net is not None else {}
+    # recovery_s: the ledger's MTTR (heal -> first fresh-height commit,
+    # exact monotonic delta) supersedes the scenario's wall stopwatch;
+    # the stopwatch survives as stopwatch_s (it also times full-fleet
+    # convergence, which the per-incident MTTR deliberately does not)
+    mttrs = incidents.get("mttr_s") or []
+    ledger_mttr = max(mttrs) if mttrs else None
     out = {
         "scenario": name,
         "seed": seed,
         "converged": bool(converged),
-        "recovery_s": round(recovery_s, 3) if recovery_s is not None else None,
+        "recovery_s": (ledger_mttr if ledger_mttr is not None
+                       else round(recovery_s, 3)
+                       if recovery_s is not None else None),
+        "stopwatch_s": round(recovery_s, 3) if recovery_s is not None else None,
         "safety_ok": net.safety_ok() if net is not None else True,
         "heights": net.heights() if net is not None else [],
         "stall_reasons": reasons,
@@ -359,6 +411,7 @@ def _result(name: str, seed: int, net: Optional[ChaosNet],
                           or any(r in expect_reasons for r in reasons)),
         "injected": dict(net.controller.injected) if net is not None else {},
         "plan": net.controller.plan.to_json() if net is not None else "",
+        "incidents": incidents,
     }
     if extra:
         out.update(extra)
@@ -640,9 +693,50 @@ def statesync_join_under_churn(seed: int = 6, tmp_root: str = "") -> dict:
             own_tmp.cleanup()
 
 
+def _write_chaos_plan(home: str, plan: netchaos.FaultPlan,
+                      c) -> None:
+    """Persist a per-node [chaos] FaultPlan and point the node's config
+    at it: the node BOOT arms the plan (config-driven orchestration,
+    ROADMAP 5a) — the scenario runner never calls arm()."""
+    rel = os.path.join("config", "chaos_plan.json")
+    with open(os.path.join(home, rel), "w") as f:
+        f.write(plan.to_json())
+    c.chaos.enable = True
+    c.chaos.seed = plan.seed
+    c.chaos.plan = rel
+
+
+def _scrape_incidents(prof_port: int, timeout: float = 2.0) -> dict:
+    """One node's /debug/incidents payload ({} when unreachable)."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{prof_port}/debug/incidents",
+                timeout=timeout) as r:
+            return json.load(r)
+    except Exception:  # noqa: BLE001 - prof server down/booting
+        return {}
+
+
+def _crash_ledger_times(status: dict, moniker: str):
+    """(mttd_s, mttr_s) of the `crash:<moniker>` incident from a
+    scraped ledger — the ledger-derived replacement for the restart
+    stopwatch (None where the ledger has no matching entry)."""
+    uid = f"crash:{moniker}"
+    mttd = mttr = None
+    for e in status.get("entries", []):
+        d = e.get("detail", {})
+        if e["category"] == "detection" and d.get("matched_uid") == uid:
+            mttd = d.get("mttd_s")
+        elif e["category"] == "recovery" and e.get("uid") == uid:
+            mttr = d.get("mttr_s")
+    return mttd, mttr
+
+
 @_scenario
 def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
-                   kills: int = 1) -> dict:
+                   kills: int = 1, chaos_window_s: float = 4.0) -> dict:
     """Multi-process crash suite (ROADMAP: "multi-process localnet
     variant ... real kernel sockets"): N real node subprocesses, one
     SIGKILL'd mid-commit (seeded victim + seeded in-commit delay),
@@ -652,7 +746,13 @@ def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
     back up, and every node agrees on the block hash at a common
     height — the kernel's SIGKILL plus the node's own durable state IS
     the storage-fault injection here; the in-process matrix
-    (tools/crashmatrix.py) covers the synthetic fault modes."""
+    (tools/crashmatrix.py) covers the synthetic fault modes.
+
+    Every node also boots with a config-loaded [chaos] plan (a mild
+    seeded delay phase over the first `chaos_window_s` seconds): the
+    per-node FaultPlan orchestration path across REAL kernel sockets,
+    exercised on every run; the kill/recovery oracle is unchanged
+    because a 15ms±25ms delay never stops the chain. 0 disables."""
     import random as _random
     import signal
     import socket
@@ -706,6 +806,11 @@ def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
         c.p2p.laddr = f"tcp://127.0.0.1:{ports[i][1]}"
         c.base.prof_laddr = f"tcp://127.0.0.1:{ports[i][2]}"
         c.p2p.persistent_peers = peers
+        if chaos_window_s > 0:
+            plan = netchaos.FaultPlan(seed=seed)
+            plan.add(0.0, chaos_window_s,
+                     netchaos.delay(0.015, jitter_s=0.025))
+            _write_chaos_plan(home, plan, c)
         c.save(os.path.join(home, "config", "config.toml"))
 
     def start_node(i: int):
@@ -798,9 +903,18 @@ def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
                     rec = json.load(r)
             except Exception:  # noqa: BLE001 - prof server still booting
                 pass
+            # ledger-derived times off the victim's own /debug/incidents
+            # (replaces the wall stopwatch as the recovery measurement;
+            # the stopwatch stays for the kill-to-caught-up wall view)
+            mttd_s, mttr_s = _crash_ledger_times(
+                _scrape_incidents(ports[victim][2]), f"node{victim}")
             recoveries.append({
                 "victim": victim,
-                "recovery_s": round(recovery_s, 3),
+                "recovery_s": mttr_s if mttr_s is not None
+                else round(recovery_s, 3),
+                "stopwatch_s": round(recovery_s, 3),
+                "mttd_s": mttd_s,
+                "mttr_s": mttr_s,
                 "handshake_outcome": rec.get("handshake_outcome", ""),
                 "replayed_blocks": rec.get("replayed_blocks", -1),
                 "reindexed_blocks": rec.get("reindexed_blocks", -1),
@@ -823,6 +937,305 @@ def localnet_crash(seed: int = 7, n: int = 4, tmp_root: str = "",
                             for r in recoveries)))
         return result
     finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+# stall reasons a full partition may legitimately classify as: with the
+# drop-rule partition TCP conns stay up, so the watchdog usually sees
+# missing votes rather than missing peers
+_PARTITION_REASONS = ("partition_suspected", "no_prevote_quorum",
+                      "no_precommit_quorum", "no_proposal",
+                      "commit_not_finalized")
+
+
+@_scenario
+def incident(seed: int = 9, n: int = 4, tmp_root: str = "",
+             fault_s: float = 6.0, chaos_at_s: float = 8.0,
+             wal_at_op: int = 0) -> dict:
+    """Composed network × storage fault timeline over a REAL n-node
+    subprocess localnet, judged end to end by the incident observatory
+    (this is what `bench.py incident` runs).
+
+    ONE seed drives BOTH engines, and both plans are loaded from each
+    node's config at boot — the runner never arms anything in-process
+    (ROADMAP 5a's composed-chaos wiring): every node's [chaos] plan
+    fully partitions the two halves over [chaos_at_s, chaos_at_s +
+    fault_s) on its own fault clock, and a seeded victim's [storage]
+    fault_plan tears a WAL write at a seeded op and kills the process.
+    The orchestrator stamps the observed death (the victim's own
+    injection entry died with it — fleettrace extra_injections),
+    restarts the victim DISARMED over the same home, and scrapes every
+    /debug/incidents through tools/fleettrace.py. Oracle: the incident
+    report attributes EVERY injected phase to a detection (partition →
+    a quorum/partition stall classification, crash → the reboot's
+    unclean_shutdown replay mark) with published MTTD/MTTR, no
+    double-commit anywhere, and every survivor's seeded ledger
+    projection is byte-identical to the plan-derived prediction — the
+    replay contract, checked against real subprocess interleaving."""
+    import random as _random
+    import socket
+    import statistics
+    import subprocess
+    import sys
+    import tempfile
+
+    from ..libs import incident as incident_mod
+    from . import fleettrace
+
+    rng = _random.Random(seed)
+    victim = rng.randrange(n)
+    at_op = wal_at_op or rng.randrange(130, 170)
+    own_tmp = None
+    if not tmp_root:
+        own_tmp = tempfile.TemporaryDirectory(prefix="incident_")
+        tmp_root = own_tmp.name
+    out_dir = os.path.join(tmp_root, "net")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    env = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu",
+               JAX_PLATFORMS="cpu", TM_TPU_WARMUP="0")
+    ports = [(free_port(), free_port(), free_port()) for _ in range(n)]
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+         "--v", str(n), "--o", out_dir, "--chain-id", "incidentnet",
+         "--starting-port", "1"],
+        check=True, env=env, capture_output=True)
+
+    from ..p2p import NodeKey
+
+    ids = []
+    for i in range(n):
+        home = os.path.join(out_dir, f"node{i}")
+        ids.append(NodeKey.load(
+            os.path.join(home, "config", "node_key.json")).id)
+    peers = ",".join(f"{ids[i]}@127.0.0.1:{ports[i][1]}"
+                     for i in range(n))
+    half_a = frozenset(ids[:n // 2])
+    half_b = frozenset(ids[n // 2:])
+    chaos_plan = netchaos.FaultPlan(seed=seed)
+    chaos_plan.add(chaos_at_s, chaos_at_s + fault_s,
+                   netchaos.partition(half_a, half_b))
+
+    from ..libs import storagechaos
+
+    for i in range(n):
+        home = os.path.join(out_dir, f"node{i}")
+        c = cfg.Config.load(os.path.join(home, "config", "config.toml"))
+        c.set_root(home)
+        c.base.db_backend = "filedb"
+        c.consensus = cfg.test_config().consensus
+        c.consensus.timeout_commit = 0.3
+        c.consensus.skip_timeout_commit = False
+        c.consensus.wal_path = "data/cs.wal/wal"
+        c.rpc.laddr = f"tcp://127.0.0.1:{ports[i][0]}"
+        c.p2p.laddr = f"tcp://127.0.0.1:{ports[i][1]}"
+        c.base.prof_laddr = f"tcp://127.0.0.1:{ports[i][2]}"
+        c.p2p.persistent_peers = peers
+        # a 6s partition must be classified well before it heals
+        c.instrumentation.stall_threshold_s = 1.0
+        _write_chaos_plan(home, chaos_plan, c)
+        if i == victim:
+            splan = storagechaos.StorageFaultPlan(seed=seed)
+            splan.add("wal", "torn_write", at_op)
+            rel = os.path.join("config", "storage_plan.json")
+            with open(os.path.join(home, rel), "w") as f:
+                f.write(splan.to_json())
+            c.storage.fault_plan = rel
+        c.save(os.path.join(home, "config", "config.toml"))
+
+    def start_node(i: int):
+        home = os.path.join(out_dir, f"node{i}")
+        log = open(os.path.join(home, "node.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd.main",
+             "--home", home, "node",
+             "--proxy_app", f"persistent_kvstore:{home}/app.db"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        return proc
+
+    from ..rpc.client import HTTPClient
+
+    def height_of(i: int) -> int:
+        try:
+            st = HTTPClient(f"127.0.0.1:{ports[i][0]}",
+                            timeout=2.0).status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:  # noqa: BLE001 - down/booting
+            return -1
+
+    def wait_height(i: int, h: int, timeout: float) -> int:
+        deadline = time.time() + timeout
+        last = -1
+        while time.time() < deadline:
+            last = height_of(i)
+            if last >= h:
+                return last
+            time.sleep(0.25)
+        return last
+
+    def block_hash(i: int, h: int):
+        try:
+            b = HTTPClient(f"127.0.0.1:{ports[i][0]}",
+                           timeout=2.0).block(h)
+            return b["block_meta"]["block_id"]["hash"]
+        except Exception:  # noqa: BLE001
+            return None
+
+    procs = []
+    result = {"scenario": "incident", "seed": seed, "victim": victim,
+              "wal_at_op": at_op, "fault_s": fault_s,
+              "chaos_at_s": chaos_at_s}
+    try:
+        for i in range(n):
+            procs.append(start_node(i))
+        warm_budget = WARM_TIMEOUT + chaos_at_s + fault_s
+        for i in range(n):
+            if wait_height(i, 3, warm_budget) < 3:
+                result.update(converged=False, ok=False,
+                              error=f"node{i} never warmed")
+                return result
+
+        # the torn WAL write fires at a seeded op count and kills the
+        # victim; the orchestrator's death stamp is the fleet-level
+        # injection time (the victim's own entry died with it)
+        deadline = time.time() + CONVERGE_TIMEOUT + chaos_at_s + fault_s
+        while time.time() < deadline and procs[victim].poll() is None:
+            time.sleep(0.05)
+        if procs[victim].poll() is None:
+            result.update(converged=False, ok=False,
+                          error="storage fault never fired")
+            return result
+        t_kill = time.time()
+        procs[victim].wait(timeout=10)
+
+        # restart DISARMED over the same home: the fault is a one-shot
+        # experiment (rearming would tear the same op again), and the
+        # reboot must classify the unclean shutdown + catch back up
+        home = os.path.join(out_dir, f"node{victim}")
+        c = cfg.Config.load(os.path.join(home, "config", "config.toml"))
+        c.set_root(home)
+        c.storage.fault_plan = ""
+        c.chaos.enable = False
+        c.save(os.path.join(home, "config", "config.toml"))
+        procs[victim] = start_node(victim)
+
+        ref = (victim + 1) % n
+        target = height_of(ref) + 1
+        if wait_height(victim, target, CONVERGE_TIMEOUT) < target:
+            result.update(converged=False, ok=False,
+                          error=f"node{victim} never caught up")
+            return result
+
+        # every ledger must settle (partition healed + closed by a
+        # fresh commit, crash closed post-replay) before the scrape
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            opens = [(_scrape_incidents(ports[i][2]) or {}).get("open")
+                     for i in range(n)]
+            if all(o == [] for o in opens):
+                break
+            time.sleep(0.25)
+
+        # no double-commit anywhere: one hash at a common height
+        h_common = min(height_of(i) for i in range(n)) - 1
+        hashes = {block_hash(i, h_common) for i in range(n)}
+        safety_ok = len(hashes) == 1 and None not in hashes
+
+        # fleet-stitched incident report over real HTTP scrapes
+        eps = [f"127.0.0.1:{ports[i][2]}" for i in range(n)]
+        ft = fleettrace.FleetTrace(eps, probes=20,
+                                   probe_spacing_s=0.005,
+                                   probe_good_rtt_s=0.004)
+        report = ft.collect_incidents(extra_injections=[{
+            "uid": f"crash:node{victim}", "kind": "crash",
+            "wall_s": t_kill, "node": "orchestrator",
+            "target": "wal", "fault": "torn_write", "at_op": at_op}])
+        by_uid = {p["uid"]: p for p in report["phases"]}
+        net_ph = by_uid.get(f"net:{seed}:0")
+        crash_ph = by_uid.get(f"crash:node{victim}")
+        net_reason = (net_ph or {}).get("detection") or {}
+        crash_reason = (crash_ph or {}).get("detection") or {}
+        classified_ok = (
+            net_reason.get("reason") in _PARTITION_REASONS
+            and crash_reason.get("reason") == "unclean_shutdown")
+        recovered_ok = all(
+            ph is not None and ph.get("recovery")
+            and ph["recovery"].get("mttr_s") is not None
+            for ph in (net_ph, crash_ph))
+
+        # the replay contract against real subprocess interleaving:
+        # every survivor's seeded ledger projection must be EXACTLY the
+        # plan-derived prediction (the victim's reboot ledger is empty
+        # of seeded entries — its pre-death ledger died with it)
+        ph0 = chaos_plan.phases[0]
+        expected = incident_mod.canonical_projection([
+            {"uid": f"net:{seed}:0", "category": "injection",
+             "kind": ph0.rule.kind,
+             "detail": {"phase": 0, "at_s": ph0.at_s,
+                        "until_s": ph0.until_s,
+                        "rule": ph0.rule.to_obj()}},
+            {"uid": f"net:{seed}:0", "category": "heal",
+             "kind": ph0.rule.kind,
+             "detail": {"phase": 0, "at_s": ph0.at_s,
+                        "until_s": ph0.until_s}},
+        ])
+        empty = incident_mod.canonical_projection([])
+        replay_identical = True
+        canonical = {}
+        for i in range(n):
+            st = _scrape_incidents(ports[i][2])
+            proj = incident_mod.canonical_projection(
+                st.get("entries", []))
+            canonical[f"node{i}"] = hashlib.sha256(proj).hexdigest()
+            want = empty if i == victim else expected
+            if proj != want:
+                replay_identical = False
+
+        mttds = [p["detection"]["mttd_s"] for p in report["phases"]
+                 if p.get("detection")]
+        mttrs = [p["recovery"]["mttr_s"] for p in report["phases"]
+                 if p.get("recovery")
+                 and p["recovery"].get("mttr_s") is not None]
+        result.update(
+            converged=True, safety_ok=safety_ok,
+            classified_ok=classified_ok,
+            heights=[height_of(i) for i in range(n)],
+            common_height=h_common,
+            total_phases=report["total"],
+            attribution=report["attribution"],
+            recovered_ok=recovered_ok,
+            mttd_p50_s=(round(statistics.median(mttds), 3)
+                        if mttds else None),
+            mttr_p50_s=(round(statistics.median(mttrs), 3)
+                        if mttrs else None),
+            replay_identical=replay_identical,
+            canonical_sha256=canonical,
+            summary=fleettrace.summarize_incidents(report),
+            phases=report["phases"],
+            ok=bool(safety_ok and classified_ok and recovered_ok
+                    and report["total"] == 2
+                    and report["attribution"] == 1.0
+                    and replay_identical))
+        return result
+    finally:
+        import signal
+
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)

@@ -409,7 +409,8 @@ def test_flight_recorder_metrics_sink():
 # --- tier-1 provider contract -----------------------------------------
 
 _DEBUG_ROUTES = ("consensus", "statesync", "abci", "mempool", "crypto",
-                 "rpc", "lockdep", "recovery", "determinism", "exec")
+                 "rpc", "lockdep", "recovery", "determinism", "exec",
+                 "incidents")
 
 
 def _scrape(addr, path):
@@ -429,6 +430,13 @@ def _assert_provider_contract(addr, node_id, mode):
         assert set(second[rt]) == set(first[rt]), (
             f"{mode}: /debug/{rt} schema drifted between scrapes: "
             f"{sorted(set(first[rt]) ^ set(second[rt]))}")
+
+    inc = first["incidents"]
+    assert set(inc) == {"entries", "open", "counts", "last_height",
+                        "skew_s"}, (mode, sorted(inc))
+    assert set(inc["counts"]) == {"injection", "heal", "detection",
+                                  "recovery"}
+    assert inc["open"] == []  # fault-free boot: nothing open
 
     ex = first["exec"]
     assert set(ex) == {"enabled", "capacity", "lanes", "blocks",

@@ -1,0 +1,336 @@
+"""The incident observatory (libs/incident.py + the fleettrace
+incident report):
+
+- IncidentLedger pairing semantics: injection -> detection (MTTD on
+  one monotonic clock), heal -> first fresh-height commit (MTTR);
+  idempotent opens, dropped unknown heals, honestly-unmatched
+  detections, the overdue verdict the monitor keys health on
+- seeded-replay contract: two same-seed runs of the composed
+  netchaos + storagechaos fault sources produce byte-identical
+  canonical ledgers regardless of event interleaving, while
+  measurements (detections, recoveries, crash:* discoveries) are
+  excluded from the surface
+- golden incident stitch: known fault phases recorded by 4 nodes on
+  skewed clocks are recovered exactly — dedupe by uid, fleet MTTD from
+  rebased stamps, node-local MTTR passthrough — and a phase whose
+  detection mark is missing stays an honest unattributed gap
+- orchestrator-side extra_injections merge: earliest stamp wins, so
+  the kill time beats the reboot's discovery time
+- slow: the composed incident scenario oracle end-to-end (subprocess
+  localnet, partition + torn WAL from one seed)
+"""
+
+import json
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+
+import pytest
+
+from tendermint_tpu.libs import storagechaos
+from tendermint_tpu.libs.incident import (
+    IncidentLedger,
+    canonical_projection,
+)
+from tendermint_tpu.p2p import netchaos
+from tendermint_tpu.tools import fleettrace
+
+
+# --- ledger pairing ----------------------------------------------------
+
+
+def test_ledger_pairs_injection_detection_heal_recovery():
+    led = IncidentLedger()
+    led.set_height(5)
+
+    assert led.open_incident("net:1:0", "partition", phase=0) is not None
+    assert led.open_incident("net:1:0", "partition", phase=0) is None
+
+    det = led.note_detection("partition_suspected", height=5)
+    assert det["detail"]["matched_uid"] == "net:1:0"
+    assert det["detail"]["mttd_s"] >= 0.0
+
+    assert led.note_heal("net:1:0") is not None
+    assert led.note_heal("net:1:0") is None  # idempotent
+    assert led.note_heal("net:1:999") is None  # unknown uid dropped
+
+    # a commit at the heal-time height is NOT fresh: still open
+    led.note_commit(5)
+    assert len(led.open_incidents()) == 1
+
+    led.note_commit(6)
+    assert led.open_incidents() == []
+    recs = [e for e in led.entries() if e["category"] == "recovery"]
+    assert len(recs) == 1
+    assert recs[0]["uid"] == "net:1:0"
+    assert recs[0]["detail"]["height"] == 6
+    assert recs[0]["detail"]["height_at_heal"] == 5
+    assert recs[0]["detail"]["mttr_s"] >= 0.0
+
+    st = led.status()
+    assert st["counts"] == {"injection": 1, "heal": 1,
+                            "detection": 1, "recovery": 1}
+    json.dumps(st)  # /debug/incidents payload must serialize
+
+
+def test_ledger_unmatched_detection_is_honest():
+    led = IncidentLedger()
+    det = led.note_detection("no_prevote_quorum", height=3)
+    assert det["detail"]["matched_uid"] is None
+    assert "mttd_s" not in det["detail"]
+
+
+def test_ledger_detection_attaches_oldest_undetected():
+    led = IncidentLedger()
+    led.open_incident("net:1:0", "partition")
+    led.open_incident("net:1:1", "delay")
+    d1 = led.note_detection("partition_suspected")
+    d2 = led.note_detection("no_proposal")
+    assert d1["detail"]["matched_uid"] == "net:1:0"
+    assert d2["detail"]["matched_uid"] == "net:1:1"
+
+
+def test_ledger_overdue_verdict():
+    # zero grace: an incident whose plan window is already over is
+    # overdue the moment it is inspected
+    led = IncidentLedger(overdue_grace_s=0.0)
+    led.open_incident("net:1:0", "partition", at_s=1.0, until_s=1.0)
+    (inc,) = led.open_incidents()
+    assert inc["expected_s"] == 0.0
+    assert inc["overdue"]
+
+    # generous grace: a fresh incident mid-window is not overdue
+    led2 = IncidentLedger(overdue_grace_s=60.0)
+    led2.open_incident("net:1:0", "partition", at_s=0.0, until_s=30.0)
+    (inc2,) = led2.open_incidents()
+    assert inc2["expected_s"] == 30.0
+    assert not inc2["overdue"]
+
+
+def test_ledger_wall_stamps_carry_skew():
+    import time as _time
+
+    led = IncidentLedger(skew_s=100.0)
+    e = led.open_incident("net:1:0", "partition")
+    assert abs(e["wall_s"] - (_time.time() + 100.0)) < 5.0
+
+
+# --- the seeded-replay contract ---------------------------------------
+
+
+def _composed_run(seed: int, order: str) -> IncidentLedger:
+    """One seeded run of both fault sources against a fake clock,
+    plus run-varying measurements. `order` flips which engine records
+    first — the interleaving canonical_bytes must be blind to."""
+    led = IncidentLedger()
+
+    clock = {"t": 0.0}
+    ctrl = netchaos.NetChaosController(
+        netchaos.FaultPlan(seed=seed).add(
+            1.0, 2.0, netchaos.partition({"aa", "bb"}, {"cc", "dd"})),
+        time_fn=lambda: clock["t"])
+    ctrl.set_incidents(led)
+
+    splan = storagechaos.StorageFaultPlan(seed=seed)
+    splan.add("wal", "torn_write", 40)
+    inj = storagechaos.StorageFaultInjector(splan, exit_process=False)
+    inj.set_incidents(led)
+
+    def drive_net():
+        ctrl.start()
+        clock["t"] = 1.5
+        ctrl.status()  # phase active -> injection
+        clock["t"] = 2.5
+        ctrl.status()  # phase over -> heal
+
+    def drive_storage():
+        with pytest.raises(storagechaos.SimulatedCrashError):
+            inj.crash(splan.faults[0])
+
+    if order == "net_first":
+        drive_net()
+        drive_storage()
+    else:
+        drive_storage()
+        drive_net()
+
+    # measurements vary run to run and must not leak into the surface
+    led.note_detection("partition_suspected", height=seed * 11)
+    led.open_incident("crash:node0", "crash",
+                      replayed_blocks=len(order))
+    return led
+
+
+def test_same_seed_byte_identical_canonical_ledger():
+    a = _composed_run(5, "net_first")
+    b = _composed_run(5, "storage_first")
+    assert a.canonical_bytes() == b.canonical_bytes()
+    # the surface is non-trivial: both sources' seeded entries are in it
+    surface = json.loads(a.canonical_bytes())
+    uids = {e["uid"] for e in surface}
+    assert uids == {"net:5:0", "storage:5:wal:torn_write:40"}
+    assert {e["category"] for e in surface} == {"injection", "heal"}
+
+    # a different seed is a different surface
+    c = _composed_run(6, "net_first")
+    assert c.canonical_bytes() != a.canonical_bytes()
+
+
+def test_canonical_projection_excludes_measurements():
+    led = _composed_run(5, "net_first")
+    # crash:* discoveries and detections are in the ledger...
+    cats = {e["category"] for e in led.entries()}
+    assert "detection" in cats
+    assert any(e["uid"].startswith("crash:") for e in led.entries())
+    # ...but not in the seeded-replay surface
+    surface = json.loads(led.canonical_bytes())
+    assert all(not e["uid"].startswith("crash:") for e in surface)
+    assert all(e["category"] in ("injection", "heal") for e in surface)
+    # and the projection of scraped entries equals the ledger's own
+    assert canonical_projection(led.entries()) == led.canonical_bytes()
+
+
+def test_netchaos_rule_obj_is_order_independent():
+    # LinkRule.to_obj sorts id sets, so the canonical surface cannot
+    # depend on set-iteration order (PYTHONHASHSEED)
+    r1 = netchaos.partition({"bb", "aa"}, {"dd", "cc"})
+    r2 = netchaos.partition({"aa", "bb"}, {"cc", "dd"})
+    assert r1.to_obj() == r2.to_obj()
+
+
+# --- golden incident stitch -------------------------------------------
+
+# fleet-clock truth: the partition phase goes live at T0+5.0 observed
+# by three survivors, heals at T0+11.0; n1's watchdog classifies it at
+# T0+6.2 (fleet MTTD 1.2s); n1 records the fresh-height recovery with
+# its exact node-local mttr_s. Every node stamps on its OWN skewed
+# clock; the stitcher must rebase before pairing.
+_T0 = 1000.0
+_INC_OFFSETS = {"n0": 0.5, "n1": -0.5, "n2": 0.25, "n3": 0.0}
+
+
+def _entry(category, kind, uid, fleet_t, offset, **detail):
+    return {"category": category, "kind": kind, "uid": uid,
+            "wall_s": fleet_t + offset, "detail": detail}
+
+
+def _golden_incidents(drop_detection=False):
+    node_incidents = {}
+    for name in ("n0", "n1", "n2"):
+        off = _INC_OFFSETS[name]
+        entries = [
+            _entry("injection", "partition", "net:7:0", _T0 + 5.0, off,
+                   phase=0, at_s=5.0, until_s=11.0),
+            _entry("heal", "partition", "net:7:0", _T0 + 11.0, off,
+                   phase=0, at_s=5.0, until_s=11.0),
+        ]
+        if name == "n1":
+            if not drop_detection:
+                entries.append(_entry(
+                    "detection", "partition_suspected", "",
+                    _T0 + 6.2, off, height=42, matched_uid="net:7:0"))
+            entries.append(_entry(
+                "recovery", "partition", "net:7:0", _T0 + 13.5, off,
+                height=44, height_at_heal=42, mttr_s=2.5))
+        node_incidents[name] = {
+            "status": {"entries": entries, "open": []},
+            "offset_s": off,
+        }
+    # n3 scraped but fault-free (it was on the majority side)
+    node_incidents["n3"] = {
+        "status": {"entries": [], "open": []},
+        "offset_s": _INC_OFFSETS["n3"],
+    }
+    return node_incidents
+
+
+def test_golden_incident_stitch_skewed_clocks():
+    rep = fleettrace.incident_report(_golden_incidents())
+    assert rep["total"] == 1
+    assert rep["attributed"] == 1
+    assert rep["attribution"] == 1.0
+
+    (ph,) = rep["phases"]
+    assert ph["uid"] == "net:7:0"
+    assert ph["kind"] == "partition"
+    # dedupe by uid across the three observers, rebased exactly
+    assert ph["affected"] == ["n0", "n1", "n2"]
+    assert ph["injected_at"] == pytest.approx(_T0 + 5.0)
+    assert ph["healed_at"] == pytest.approx(_T0 + 11.0)
+
+    det = ph["detection"]
+    assert det["node"] == "n1"
+    assert det["reason"] == "partition_suspected"
+    assert det["mttd_s"] == pytest.approx(1.2)
+    assert det["height"] == 42
+
+    rec = ph["recovery"]
+    assert rec["node"] == "n1"
+    assert rec["mttr_s"] == pytest.approx(2.5)  # node-local, exact
+    assert ph["heights_stalled"] == [42, 44]
+
+    text = fleettrace.summarize_incidents(rep)
+    assert "1/1" in text and "partition" in text
+
+
+def test_incident_stitch_missing_detection_stays_unattributed():
+    rep = fleettrace.incident_report(_golden_incidents(
+        drop_detection=True))
+    assert rep["total"] == 1
+    assert rep["attributed"] == 0
+    assert rep["attribution"] == 0.0
+    (ph,) = rep["phases"]
+    assert ph["detection"] is None
+    # the recovery is still paired (uid match) — only detection is gone
+    assert ph["recovery"]["mttr_s"] == pytest.approx(2.5)
+    assert "UNDETECTED" in fleettrace.summarize_incidents(rep)
+
+
+def test_incident_extra_injection_merges_earliest_wins():
+    node_incidents = _golden_incidents()
+    rep = fleettrace.incident_report(node_incidents, extra_injections=[
+        # the orchestrator saw the same phase 0.4s before any node
+        {"uid": "net:7:0", "kind": "partition",
+         "wall_s": _T0 + 4.6, "node": "orchestrator"},
+        # and a kill no node could ledger for itself
+        {"uid": "crash:node3", "kind": "crash", "wall_s": _T0 + 20.0,
+         "heal_wall_s": _T0 + 21.0, "node": "orchestrator",
+         "target": "wal"},
+    ])
+    assert rep["total"] == 2
+    by_uid = {p["uid"]: p for p in rep["phases"]}
+
+    net = by_uid["net:7:0"]
+    assert net["injected_at"] == pytest.approx(_T0 + 4.6)
+    assert "orchestrator" in net["affected"]
+    # MTTD now measured from the orchestrator's earlier stamp
+    assert net["detection"]["mttd_s"] == pytest.approx(1.6)
+
+    crash = by_uid["crash:node3"]
+    assert crash["healed_at"] == pytest.approx(_T0 + 21.0)
+    assert crash["detail"]["target"] == "wal"
+    assert crash["detection"] is None  # nothing claimed it — honest
+
+
+# --- slow: the composed acceptance oracle -----------------------------
+
+
+@pytest.mark.slow
+def test_incident_scenario_end_to_end():
+    """The PR's acceptance gate: a 4-node subprocess localnet where one
+    seed derives a netchaos partition AND a torn-WAL crash; every
+    injected phase must be detected and classified, zero
+    double-commits, and every survivor's seeded ledger projection
+    byte-identical to the plan-derived prediction."""
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("incident", seed=9, n=4)
+    assert res["safety_ok"], res
+    assert res["classified_ok"], res.get("phases")
+    assert res["recovered_ok"], res.get("phases")
+    assert res["total_phases"] == 2
+    assert res["attribution"] == 1.0
+    assert res["replay_identical"], res.get("canonical_sha256")
+    assert res["mttd_p50_s"] is not None
+    assert res["mttr_p50_s"] is not None
+    assert res["ok"], res

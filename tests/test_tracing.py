@@ -75,6 +75,26 @@ def test_chrome_trace_schema():
         assert isinstance(ev[key], int)
 
 
+def test_inflight_span_exported_with_running_duration():
+    t = Tracer(enabled=True)
+    with t.span("outer", cat="consensus", height=7):
+        with t.span("inner", cat="state"):
+            pass
+        doc = t.chrome_trace()
+        spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+        inner, outer = spans["inner"], spans["outer"]
+        assert outer["args"] == {"height": 7, "inflight": True}
+        assert "inflight" not in (inner.get("args") or {})
+        # the open parent still encloses its finished child
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    # once closed it exports as a normal finished span
+    doc = t.chrome_trace()
+    outer = [e for e in doc["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "outer"]
+    assert len(outer) == 1 and outer[0]["args"] == {"height": 7}
+
+
 def test_enable_disable_and_clear():
     t = Tracer()
     t.enable(capacity=128)
