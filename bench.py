@@ -66,6 +66,7 @@ CRASHREC_MODE = "crashrecovery" in sys.argv[1:]  # kill->committing (PR 14)
 DETCHECK_MODE = "detcheck" in sys.argv[1:]  # replay-divergence oracle (PR 15)
 PROPTRACE_MODE = "proptrace" in sys.argv[1:]  # fleet causal tracing (PR 16)
 INCIDENT_MODE = "incident" in sys.argv[1:]  # incident MTTD/MTTR (PR 18)
+HANDEL_MODE = "handel" in sys.argv[1:]  # aggregation overlay (PR 19)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
@@ -73,7 +74,7 @@ _args = [a for a in sys.argv[1:]
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
                       "crashrecovery", "detcheck", "proptrace",
-                      "incident", "--pipeline", "--parallel")]
+                      "incident", "handel", "--pipeline", "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -159,6 +160,8 @@ INCIDENT_NVAL = _env_int("TM_TPU_BENCH_INCIDENT_NVAL", 4)
 INCIDENT_SEED = _env_int("TM_TPU_BENCH_INCIDENT_SEED", 9)
 INCIDENT_METRIC = (
     f"incident_{INCIDENT_NVAL}node_composed_mttr_p50_ms")
+HANDEL_NVAL = _env_int("TM_TPU_BENCH_HANDEL_NVAL", 1024)
+HANDEL_METRIC = f"handel_overlay_{HANDEL_NVAL}val_per_node_verify_ops"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -1619,6 +1622,182 @@ def aggverify_main():
     return 0
 
 
+def handel_main():
+    """`bench.py handel` — the Handel aggregation overlay vs the flat
+    per-vote lane at committee size HANDEL_NVAL (default 1024): run the
+    REAL per-session state machine for every committee member (actual
+    binomial-tree routing, windowed sends, wire-encoded contribution
+    messages, real G2 aggregation) and count what one node pays to
+    assemble a full-committee certificate.
+
+    The verify_fn is a counting stub — per-item pairing work is what
+    the mode MEASURES, and correctness is enforced end-to-end by the
+    oracle instead: every session's final certificate must byte-equal
+    the flat-lane aggregate [sum sk_i]H(m) for the same vote set, or
+    the metric value is -1. Signature fixtures use consecutive scalars
+    (sig_i = [s0+i]H(m), built by incremental G2 adds) so setup stays
+    O(n) adds instead of n scalar multiplications."""
+    from tendermint_tpu.consensus.handel import HandelSession, num_levels
+    from tendermint_tpu.consensus.messages import (
+        HandelContributionMessage,
+        VoteMessage,
+    )
+    from tendermint_tpu.consensus.reactor import encode_msg
+    from tendermint_tpu.crypto import bls
+    from tendermint_tpu.crypto.bls import curve as bc
+    from tendermint_tpu.crypto.bls.fields import R_ORDER
+    from tendermint_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+        canonical_vote_sign_bytes,
+    )
+
+    n = HANDEL_NVAL
+    chain = "bench-handel"
+    bid = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x0c" * 32))
+    msg = canonical_vote_sign_bytes(
+        chain, VOTE_TYPE_PRECOMMIT, 1, 0, bid, 0)
+    hm = hash_to_g2(msg, bls.DST_SIG)
+
+    # per-validator precommit signatures sig_i = [s0+i] H(m)
+    s0 = 424_242
+    pts, pt = [], bc.g2_mul(hm, s0)
+    for _ in range(n):
+        pts.append(pt)
+        pt = bc.g2_add(pt, hm)
+    sigs = [bc.g2_compress(p) for p in pts]
+    # flat-lane reference certificate over the same vote set
+    sum_sk = sum(s0 + i for i in range(n)) % R_ORDER
+    flat_cert = bc.g2_compress(bc.g2_mul(hm, sum_sk))
+
+    counters = {"calls": 0, "items": 0}
+
+    def verify_fn(items):
+        counters["calls"] += 1
+        counters["items"] += len(items)
+        return [True] * len(items)
+
+    t0 = time.perf_counter()
+    sessions = [
+        HandelSession(
+            n, i, [1] * n, sigs[i], verify_fn=verify_fn,
+            parse_fn=bls._parse_signature_point, add_fn=bc.g2_add,
+            compress_fn=bc.g2_compress, seed=1, height=1, round_=0,
+            window=4, level_timeout_s=1e9, resend_ticks=2,
+            reshuffle_ticks=8)
+        for i in range(n)
+    ]
+    sent_bytes = 0
+    inboxes = [[] for _ in range(n)]
+    certs = {}
+    now = 0.0
+    rounds = 0
+    max_rounds = 6 * num_levels(n) + 8
+    while rounds < max_rounds:
+        rounds += 1
+        now += 0.05
+        for i, s in enumerate(sessions):
+            for target, level, bits, sig in s.tick(now):
+                sent_bytes += len(encode_msg(HandelContributionMessage(
+                    1, 0, level, i, bid, bits, sig)))
+                inboxes[target].append((i, level, bits, sig))
+        for i, s in enumerate(sessions):
+            if inboxes[i]:
+                s.add_contributions(inboxes[i], now)
+                inboxes[i] = []
+            c = s.take_certificate()
+            if c is not None:
+                certs[i] = c
+        if len(certs) == n and all(
+                b.num_true() == n for b, _ in certs.values()):
+            break
+    wall_ms = (time.perf_counter() - t0) * 1000
+
+    byte_equal = len(certs) == n and all(
+        bits.num_true() == n and sig == flat_cert
+        for bits, sig in certs.values())
+
+    # per-node accounting. Overlay: measured from the run (verify items
+    # feed ONE multi-pair check per absorb batch -> items + calls
+    # Miller loops). Flat lane: every node verifies n-1 individual
+    # precommits (2 pairings each) and receives n-1 wire votes.
+    ov_verify = counters["items"] / n
+    ov_pairings = (counters["items"] + counters["calls"]) / n
+    ov_bytes = sent_bytes / n
+    flat_verify = n - 1
+    flat_pairings = 2 * (n - 1)
+    vote_wire = len(encode_msg(VoteMessage(Vote(
+        b"\x01" * 20, 0, 1, 0, 0, VOTE_TYPE_PRECOMMIT, bid, sigs[0]))))
+    flat_bytes = (n - 1) * vote_wire
+
+    print(json.dumps({
+        "metric": HANDEL_METRIC,
+        "value": round(ov_verify, 2) if byte_equal else -1,
+        "unit": "aggregate verifications/node/round",
+        "oracle_cert_byte_equal": byte_equal,
+        "converged_sessions": len(certs),
+        "rounds": rounds,
+        "wall_ms": round(wall_ms, 1),
+        "flat_verify_ops": flat_verify,
+        "verify_ops_ratio": round(flat_verify / max(ov_verify, 1e-9), 1),
+        "overlay_pairings": round(ov_pairings, 2),
+        "flat_pairings": flat_pairings,
+        "pairings_ratio": round(flat_pairings / max(ov_pairings, 1e-9), 1),
+        "overlay_gossip_bytes": round(ov_bytes),
+        "flat_gossip_bytes": flat_bytes,
+        "gossip_bytes_ratio": round(flat_bytes / max(ov_bytes, 1e-9), 1),
+        "note": ("%d real HandelSessions to full-committee certificate; "
+                 "flat lane = n-1 per-vote verifies (2 pairings each) + "
+                 "n-1 wire votes (%dB each) per node; value -1 unless "
+                 "every overlay certificate byte-equals the flat "
+                 "aggregate" % (n, vote_wire)),
+    }))
+
+    # -- satellite line: verify_aggregates_many batching at k=8 --------
+    # (the Handel level-verify workhorse: one 2k-pair Miller loop vs k
+    # sequential fast_aggregate_verify calls, REAL pairings both ways)
+    k, m = 8, 8
+    t0sk = 31_337
+    g1pts, gp = [], bc.g1_mul(bc.G1_GEN, t0sk)
+    for _ in range(m):
+        g1pts.append(gp)
+        gp = bc.g1_add(gp, bc.G1_GEN)
+    pks = [bc.g1_compress(p) for p in g1pts]
+    sum_pk_sk = sum(t0sk + i for i in range(m)) % R_ORDER
+    items = []
+    for j in range(k):
+        mj = b"bench-handel-batch-%d" % j
+        sj = bc.g2_compress(bc.g2_mul(
+            hash_to_g2(mj, bls.DST_SIG), sum_pk_sk))
+        items.append((pks, mj, sj))
+
+    def batched():
+        assert all(bls.verify_aggregates_many(items))
+
+    def sequential():
+        for pk_list, mj, sj in items:
+            assert bls.fast_aggregate_verify(
+                pk_list, mj, sj, require_pop=False)
+
+    batched()  # warm point-parse caches for both paths
+    batch_ms = _best_of(batched, 3)
+    seq_ms = _best_of(sequential, 3)
+    print(json.dumps({
+        "metric": f"verify_aggregates_many_k{k}_wall_ms",
+        "value": round(batch_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(seq_ms / batch_ms, 2),
+        "sequential_ms": round(seq_ms, 3),
+        "note": (f"{k} aggregate certificates ({m} signers each) in one "
+                 "RLC multi-pair check vs sequential 2-pairing "
+                 "fast_aggregate_verify calls"),
+    }))
+    return 0 if byte_equal else 1
+
+
 def chaos_main():
     """`bench.py chaos` — ABCI reconnect recovery latency: a real
     kvstore socket app, a ResilientClient(retry) supervising the
@@ -2032,6 +2211,9 @@ def main():
     if AGGVERIFY_MODE:
         # pure host path like commit4/preverify: no TPU probe
         return aggverify_main()
+    if HANDEL_MODE:
+        # in-process overlay simulation: pure host path, no TPU probe
+        return handel_main()
     if RPCLOAD_MODE:
         # pure host serving path: no TPU probe
         return rpcload_main()

@@ -72,6 +72,7 @@ CRITICAL_SUFFIXES = (
     "state/validation.py",
     "consensus/state.py",
     "consensus/replay.py",
+    "consensus/handel.py",
     "types/basic.py",
     "types/block.py",
     "types/serde.py",

@@ -314,6 +314,13 @@ REQUIRED_FAMILIES = (
     "incident_detection_seconds",
     "incident_recovery_seconds",
     "incident_open",
+    # PR-19 Handel aggregation overlay (declaration presence: every
+    # family stays silent on Ed25519 chains and with [handel] off —
+    # absence of samples is the disabled signal)
+    "handel_level",
+    "handel_contributions_total",
+    "handel_verify_seconds",
+    "handel_pruned_peers_total",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

@@ -216,6 +216,20 @@ class BlockPool:
             r2 = self._requesters.get(self.height + 1)
             return (r1.block if r1 else None, r2.block if r2 else None)
 
+    def peek_window(self, k: int):
+        """Contiguous run of downloaded blocks starting at the pool
+        head, up to k blocks (ours: the aggregate-certificate
+        pre-verification window — BLS catch-up batches the whole run's
+        commit checks into one multi-pair product check)."""
+        with self._lock:
+            out = []
+            for h in range(self.height, self.height + k):
+                r = self._requesters.get(h)
+                if r is None or r.block is None:
+                    break
+                out.append(r.block)
+            return out
+
     def pop_request(self) -> None:
         """pool.go:217-222: first block verified — advance."""
         with self._lock:

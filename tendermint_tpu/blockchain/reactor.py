@@ -326,29 +326,87 @@ class BlockchainReactor(Reactor):
         while block k applies on the host."""
         from ..crypto import batch as crypto_batch
 
-        if crypto_batch.async_enabled():
+        # BLS chains take the serial path even with async dispatch on:
+        # aggregate certificates have no Ed25519 device batch to
+        # overlap, and the serial loop batches the window's pairing
+        # checks into one multi-pair product check instead
+        if (crypto_batch.async_enabled()
+                and not self.state.validators.is_bls()):
             return self._try_sync_batch_pipelined()
         return self._try_sync_batch_serial()
 
+    def _preverify_agg_window(self):
+        """Replica catch-up certificate batching: when commits are BLS
+        AggregateCommits, the contiguous downloaded window's pairing
+        checks collapse into ONE bls.verify_aggregates_many call
+        (2k pairs, one Miller loop) instead of up to SYNC_BATCH
+        sequential 2-pairing checks. Only certificates that PASS are
+        memoized — any failure is left for the per-block verify path to
+        re-derive its exact error (and redo the height). The memo pins
+        the validator-set hash plus the exact block/commit objects, so
+        a val-set change mid-window or a redone block simply misses."""
+        vals = self.state.validators
+        if not vals.is_bls():
+            return {}
+        from ..types.block import AggregateCommit
+
+        window = self.pool.peek_window(SYNC_BATCH + 1)
+        if len(window) < 3:  # fewer than two pairs: nothing to batch
+            return {}
+        checks = []
+        meta = []  # (first, second, parts, block_id)
+        for first, second in zip(window, window[1:]):
+            commit = second.last_commit
+            if not isinstance(commit, AggregateCommit):
+                continue
+            parts = make_part_set(first)
+            block_id = BlockID(hash=first.hash(),
+                               parts_header=parts.header())
+            checks.append((block_id, first.header.height, commit))
+            meta.append((first, second, parts, block_id))
+        if len(checks) < 2:
+            return {}
+        errs = vals.verify_commits_aggregate_many(self.state.chain_id,
+                                                  checks)
+        vhash = vals.hash()
+        pre = {}
+        for err, (first, second, parts, block_id) in zip(errs, meta):
+            if err is None:
+                pre[first.header.height] = (vhash, first,
+                                            second.last_commit, parts,
+                                            block_id)
+        return pre
+
     def _try_sync_batch_serial(self) -> bool:
         processed = 0
+        pre = self._preverify_agg_window()
         for _ in range(SYNC_BATCH):
             first, second = self.pool.peek_two_blocks()
             if first is None or second is None:
                 break
-            first_parts = make_part_set(first)
-            first_id = BlockID(hash=first.hash(), parts_header=first_parts.header())
-            try:
-                # ★ batch-verify the +2/3 commit for `first` carried in
-                # `second.last_commit` (reactor.go:310) — one TPU batch
-                self.state.validators.verify_commit(
-                    self.state.chain_id, first_id, first.header.height,
-                    second.last_commit,
-                )
-            except Exception as e:
-                LOG.warning("invalid block %d during fast sync: %s", first.header.height, e)
-                self.pool.redo_request(first.header.height)
-                return processed > 0
+            hit = pre.pop(first.header.height, None)
+            if (hit is not None and hit[1] is first
+                    and hit[2] is second.last_commit
+                    and hit[0] == self.state.validators.hash()):
+                # certificate already verified in the window batch
+                first_parts, first_id = hit[3], hit[4]
+            else:
+                first_parts = make_part_set(first)
+                first_id = BlockID(hash=first.hash(),
+                                   parts_header=first_parts.header())
+                try:
+                    # ★ batch-verify the +2/3 commit for `first` carried
+                    # in `second.last_commit` (reactor.go:310) — one TPU
+                    # batch
+                    self.state.validators.verify_commit(
+                        self.state.chain_id, first_id, first.header.height,
+                        second.last_commit,
+                    )
+                except Exception as e:
+                    LOG.warning("invalid block %d during fast sync: %s",
+                                first.header.height, e)
+                    self.pool.redo_request(first.header.height)
+                    return processed > 0
             self.pool.pop_request()
             self.store.save_block(first, first_parts, second.last_commit)
             # the pool head moved to k+1 after pop: stage it so the

@@ -377,6 +377,38 @@ class ChaosConfig:
 
 
 @dataclass
+class HandelConfig:
+    """[handel] — the Handel aggregation overlay (consensus/handel.py,
+    arXiv:1906.05132; ours, no reference equivalent). Only meaningful
+    on BLS validator sets; default OFF, which keeps gossip
+    byte-identical to the flat certificate lane.
+
+    enable: run per-(height, round) binomial-tree aggregation sessions
+    and open the HANDEL p2p channel (0x24).
+    window: candidate peers contacted per level per tick.
+    tick_ms: overlay gossip tick cadence.
+    level_timeout_ms: a level incomplete past this stops gating higher
+    levels, and a stuck frontier re-enables flat certificate gossip
+    (byzantine-silent subtrees cost latency, never liveness).
+    fail_budget: garbage contributions a peer may send at a level
+    before it is pruned from the candidate set.
+    resend_ticks: ticks between re-contacts of a silent candidate.
+    reshuffle_ticks: ticks between deterministic candidate-window
+    reshuffles.
+    seed: the candidate-shuffle RNG seed — same seed, same walk (the
+    scoring/pruning determinism story; see tests/test_handel.py)."""
+
+    enable: bool = False
+    window: int = 4
+    tick_ms: int = 50
+    level_timeout_ms: int = 1000
+    fail_budget: int = 8
+    resend_ticks: int = 4
+    reshuffle_ticks: int = 8
+    seed: int = 0
+
+
+@dataclass
 class TxIndexConfig:
     """reference config/config.go:723-760"""
 
@@ -447,6 +479,7 @@ class Config:
     crypto: CryptoConfig = field(default_factory=CryptoConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    handel: HandelConfig = field(default_factory=HandelConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
@@ -493,6 +526,7 @@ class Config:
             emit("crypto", self.crypto),
             emit("statesync", self.statesync),
             emit("chaos", self.chaos),
+            emit("handel", self.handel),
             emit("storage", self.storage),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
@@ -517,6 +551,7 @@ class Config:
             "crypto": cfg.crypto,
             "statesync": cfg.statesync,
             "chaos": cfg.chaos,
+            "handel": cfg.handel,
             "storage": cfg.storage,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,

@@ -1,0 +1,694 @@
+"""Handel aggregation overlay: O(log n) in-round vote aggregation
+(arXiv:1906.05132; ours — the reference implementation has no
+counterpart at any committee size).
+
+PR 7's BLS lane made the commit CERTIFICATE O(1), but in-round work
+stayed O(n): every validator verifies every individual precommit and
+the flat certificate lane gossips best-effort pairwise. Handel makes
+the aggregation itself logarithmic. Validators are arranged by index
+into a binomial tree of ceil(log2 n) levels; node i's level-l peer
+group is the complementary half-subtree
+
+    group_l(i) = { j : (i ^ j).bit_length() == l }
+               = [base, base + 2^(l-1)) ∩ [0, n),
+      base = ((i >> (l-1)) ^ 1) << (l-1)
+
+— a contiguous index range, since levels partition by high bits. At
+level l a node SENDS its combined aggregate over its own half
+(own signature + verified bests of levels < l) to a scored,
+periodically-reshuffled window of candidates in group_l(i), and
+RECEIVES aggregates covering group_l(i), verified as ONE aggregate
+pairing check each (batched through bls.verify_aggregates_many when
+several arrive together) rather than per-vote checks. Completed
+levels promote upward until the full-committee certificate emerges;
+every quorum-crossing improvement is handed to the caller, who feeds
+it through VoteSet.absorb_certificate unchanged — tally soundness,
+the timestamp-0 sign-bytes rule, and the PoP trust story live there,
+not here.
+
+Scoring and liveness: candidates that deliver verified contributions
+score up (first verified contribution at a level scores highest —
+"fastest-verified" priority on later rounds); candidates that stay
+silent across contacts drift down; garbage contributions burn a
+per-peer fail budget (the _AGG_CERT_FAIL_BUDGET idiom) and pruned
+peers are never contacted again. A level that stays incomplete past
+its timeout stops gating the levels above it, and a session whose
+frontier is stuck reports it (`stuck_level`) so the reactor can fall
+back to flat certificate gossip — byzantine-silent subtrees cost
+latency, never liveness.
+
+Determinism: the module never reads a clock (callers pass `now`,
+monotonic seconds) and all shuffling comes from a seeded
+random.Random derived from (seed, height, round) — two nodes with
+the same seed walk identical candidate windows, which is what makes
+scoring/pruning unit-testable and scenario replays exact. Scanned by
+scripts/check_determinism.py with zero allowlist entries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..libs.bit_array import BitArray
+
+# score deltas (relative weights matter, absolute values don't):
+# verified contribution >> everything; first verified contribution at
+# a level wins the fastest-responder bonus; each unanswered contact
+# drifts the candidate down one notch
+SCORE_VERIFIED = 100
+SCORE_FIRST_BONUS = 50
+SCORE_SILENT = -1
+
+# emitted certificate guard: never hand the caller an aggregate below
+# this many signers (mirrors vote_set._AGG_MIN_CERT_SIGNERS — a
+# 1-signer "aggregate" is just a vote)
+MIN_CERT_SIGNERS = 2
+
+
+def level_of(i: int, j: int) -> int:
+    """The unique level at which validators i and j are in each
+    other's complementary group: the position of their highest
+    differing index bit."""
+    if i == j:
+        raise ValueError("a validator has no level to itself")
+    return (i ^ j).bit_length()
+
+
+def level_range(i: int, level: int, n: int) -> Tuple[int, int]:
+    """Complementary group of node i at `level`, as the half-open
+    index range [lo, hi) clipped to the committee size (levels
+    partition by high index bits, so every group is contiguous)."""
+    base = ((i >> (level - 1)) ^ 1) << (level - 1)
+    return min(base, n), min(base + (1 << (level - 1)), n)
+
+
+def num_levels(n: int) -> int:
+    """ceil(log2 n): levels in the binomial tree for n validators."""
+    if n <= 1:
+        return 0
+    return (n - 1).bit_length()
+
+
+class _Level:
+    """Per-level state: candidate scoring plus the best verified
+    incoming aggregate over the complementary group."""
+
+    __slots__ = ("level", "lo", "hi", "candidates", "score", "asked",
+                 "fails", "pruned", "best_bits", "best_point",
+                 "complete", "activated_at", "sent_version",
+                 "last_sent_tick", "got_first", "answered")
+
+    def __init__(self, level: int, lo: int, hi: int):
+        self.level = level
+        self.lo = lo
+        self.hi = hi
+        self.candidates = list(range(lo, hi))
+        self.score: Dict[int, int] = {j: 0 for j in self.candidates}
+        self.asked: Dict[int, int] = {j: 0 for j in self.candidates}
+        self.fails: Dict[int, int] = {j: 0 for j in self.candidates}
+        self.pruned: set = set()
+        self.best_bits: Optional[BitArray] = None
+        self.best_point = None  # G2 point paired with best_bits
+        self.complete = self.lo >= self.hi  # empty group (n truncation)
+        self.activated_at: Optional[float] = None
+        # outgoing bookkeeping: which combined-version each window
+        # candidate last saw, so improved payloads re-send and
+        # unchanged ones only retry on the resend cadence
+        self.sent_version: Dict[int, int] = {}
+        self.last_sent_tick: Dict[int, int] = {}
+        self.got_first = False
+        # origins that delivered a verified contribution: an implicit
+        # ack — they are alive and hold our address, so cadence
+        # re-sends (a lost-message hedge) stop for them and only
+        # payload improvements (version bumps) go out
+        self.answered: set = set()
+
+    def window_candidates(self, k: int, rng_order: List[int]) -> List[int]:
+        """The k candidates to contact this tick: unpruned, ordered by
+        descending score, then fewest unanswered contacts, then the
+        current reshuffle order (rng_order maps id -> shuffle rank)."""
+        live = [j for j in self.candidates if j not in self.pruned]
+        live.sort(key=lambda j: (-self.score[j], self.asked[j],
+                                 rng_order[j - self.lo]))
+        return live[:k]
+
+
+class HandelSession:
+    """One aggregation session: a single (height, round, block_id)
+    precommit message aggregated across the committee.
+
+    The session is crypto-light by construction: it stores signatures
+    as opaque bytes plus parsed G2 points, combines them with the
+    injected `combine` (G2 addition) and validates incoming
+    contributions with the injected `verify_fn` — production wires
+    bls.verify_aggregates_many through the valset's pubkeys, tests
+    and bench inject counting or failing verifiers. It never touches
+    VoteSet: completed aggregates surface via `take_certificate()` and
+    the caller routes them through absorb_certificate, which re-checks
+    everything under its own DoS gates.
+    """
+
+    def __init__(self, n: int, my_index: int, powers: List[int],
+                 own_signature: Optional[bytes] = None, *,
+                 verify_fn: Callable[[List[Tuple[Tuple[int, ...], bytes]]],
+                                     List[bool]],
+                 parse_fn: Callable[[bytes], object],
+                 add_fn: Callable[[object, object], object],
+                 compress_fn: Callable[[object], bytes],
+                 seed: int = 0, height: int = 0, round_: int = 0,
+                 window: int = 4, fail_budget: int = 8,
+                 level_timeout_s: float = 1.0, resend_ticks: int = 4,
+                 reshuffle_ticks: int = 8):
+        if not (0 <= my_index < n):
+            raise ValueError(f"validator index {my_index} outside 0..{n-1}")
+        self.n = n
+        self.my_index = my_index
+        self.powers = list(powers)
+        self.total_power = sum(powers)
+        self.window = max(1, window)
+        self.fail_budget = max(1, fail_budget)
+        self.level_timeout_s = level_timeout_s
+        self.resend_ticks = max(1, resend_ticks)
+        self.reshuffle_ticks = max(1, reshuffle_ticks)
+        self._verify_fn = verify_fn
+        self._parse = parse_fn
+        self._add = add_fn
+        self._compress = compress_fn
+        # deterministic shuffle source: same (seed, height, round) →
+        # same candidate walk on every node and every replay
+        digest = hashlib.sha256(
+            b"handel:%d:%d:%d:%d" % (seed, height, round_, my_index)
+        ).digest()
+        self._rng = random.Random(int.from_bytes(digest[:8], "big"))
+        self.levels: Dict[int, _Level] = {}
+        for l in range(1, num_levels(n) + 1):
+            lo, hi = level_range(my_index, l, n)
+            self.levels[l] = _Level(l, lo, hi)
+        self._shuffle_orders: Dict[int, List[int]] = {}
+        self._reshuffle()
+        # own contribution
+        self.own_point = None
+        self.own_bits = BitArray(n)
+        if own_signature is not None:
+            pt = self._parse(own_signature)
+            if pt is None:
+                raise ValueError("own signature does not parse")
+            self.own_point = pt
+            self.own_bits.set_index(my_index, True)
+        # per-source improvement counters: index 0 is our own signature,
+        # index l a level-l best. A level-l payload aggregates sources
+        # strictly below l, so its version is sum(_improves[:l]) — an
+        # improvement at level k only re-triggers sends at levels ABOVE
+        # k, never re-sends of unchanged lower payloads
+        self._improves = [0] * (num_levels(n) + 1)
+        self._tick_no = 0
+        self.started_at: Optional[float] = None
+        # counters the caller mirrors into metrics
+        self.verified_total = 0
+        self.rejected_total = 0
+        self.pruned_total = 0
+        self.sends_total = 0
+        self._emitted_bits = -1  # num_true of the last emitted cert
+        self._pending_cert: Optional[Tuple[BitArray, bytes]] = None
+
+    # -- structure helpers --------------------------------------------
+
+    def _reshuffle(self) -> None:
+        for l, lv in self.levels.items():
+            order = list(range(len(lv.candidates)))
+            self._rng.shuffle(order)
+            self._shuffle_orders[l] = order
+
+    def _combined_through(self, max_level: int):
+        """(bits, point) aggregating our own signature with every
+        verified level best strictly below max_level — exactly the
+        payload a level-`max_level` contribution may carry."""
+        bits = self.own_bits.copy()
+        point = self.own_point
+        for l in range(1, max_level):
+            lv = self.levels[l]
+            if lv.best_bits is not None:
+                bits.or_update(lv.best_bits)
+                point = self._add(point, lv.best_point)
+        return bits, point
+
+    def _level_power(self, bits: BitArray) -> int:
+        return sum(self.powers[k] for k in bits.true_indices())
+
+    def _frontier(self) -> int:
+        """Lowest incomplete level (len+1 when everything completed)."""
+        for l in range(1, len(self.levels) + 1):
+            if not self.levels[l].complete:
+                return l
+        return len(self.levels) + 1
+
+    # -- receiving ----------------------------------------------------
+
+    def add_contributions(self, contribs, now: float):
+        """Absorb a batch of incoming contributions:
+        contribs = [(origin, level, bits: BitArray, agg_sig: bytes)].
+        Structural gates run per item; the survivors verify in ONE
+        verify_fn call (the multi-pair Miller loop). Returns
+        (n_verified, n_rejected). Garbage burns the origin's fail
+        budget at its level; pruned origins are dropped unseen."""
+        if self.started_at is None:
+            self.started_at = now
+        pending = []  # (origin, level_obj, bits, sig, indices)
+        rejected = 0
+        for origin, level, bits, sig in contribs:
+            lv = self.levels.get(level)
+            if (lv is None or origin == self.my_index
+                    or not (0 <= origin < self.n)
+                    or level_of(self.my_index, origin) != level):
+                rejected += 1
+                continue
+            if origin in lv.pruned:
+                rejected += 1
+                continue
+            idxs = bits.true_indices()
+            if not idxs or bits.size() != self.n:
+                rejected += 1
+                self._fail(lv, origin)
+                continue
+            # a level-l contribution may only cover the sender's own
+            # half — OUR complementary range at l
+            if idxs[0] < lv.lo or idxs[-1] >= lv.hi:
+                rejected += 1
+                self._fail(lv, origin)
+                continue
+            if lv.best_bits is not None and \
+                    len(idxs) <= lv.best_bits.num_true():
+                # no improvement: drop without paying a pairing (an
+                # honest re-send, not garbage — no budget burn)
+                continue
+            pending.append((origin, lv, bits, sig, tuple(idxs)))
+        verified = 0
+        if pending:
+            verdicts = self._verify_fn(
+                [(p[4], p[3]) for p in pending])
+            for (origin, lv, bits, sig, idxs), ok in zip(pending, verdicts):
+                if not ok:
+                    rejected += 1
+                    self._fail(lv, origin)
+                    continue
+                pt = self._parse(sig)
+                if pt is None:
+                    rejected += 1
+                    self._fail(lv, origin)
+                    continue
+                verified += 1
+                lv.answered.add(origin)
+                if not lv.got_first:
+                    lv.got_first = True
+                    lv.score[origin] = lv.score.get(origin, 0) + \
+                        SCORE_FIRST_BONUS
+                lv.score[origin] = lv.score.get(origin, 0) + SCORE_VERIFIED
+                if lv.best_bits is None or \
+                        len(idxs) > lv.best_bits.num_true():
+                    lv.best_bits = bits.copy()
+                    lv.best_point = pt
+                    self._improves[lv.level] += 1
+                    if len(idxs) == lv.hi - lv.lo:
+                        lv.complete = True
+        self.verified_total += verified
+        self.rejected_total += rejected
+        if verified:
+            self._maybe_emit()
+        return verified, rejected
+
+    def _fail(self, lv: _Level, origin: int) -> None:
+        lv.fails[origin] = lv.fails.get(origin, 0) + 1
+        if lv.fails[origin] >= self.fail_budget and \
+                origin not in lv.pruned:
+            lv.pruned.add(origin)
+            self.pruned_total += 1
+
+    # -- sending ------------------------------------------------------
+
+    def tick(self, now: float) -> List[Tuple[int, int, BitArray, bytes]]:
+        """One gossip tick: activate levels whose gate opened (prior
+        levels complete, or their timeout lapsed), reshuffle candidate
+        windows on cadence, and return the (target, level, bits, sig)
+        contributions to send. The caller owns the wire."""
+        if self.started_at is None:
+            self.started_at = now
+        self._tick_no += 1
+        if self._tick_no % self.reshuffle_ticks == 0:
+            self._reshuffle()
+        out: List[Tuple[int, int, BitArray, bytes]] = []
+        for l in range(1, len(self.levels) + 1):
+            lv = self.levels[l]
+            if lv.lo >= lv.hi:
+                continue
+            if not self._level_active(l, now):
+                break
+            if lv.activated_at is None:
+                lv.activated_at = now
+            bits, point = self._combined_through(l)
+            if point is None:
+                continue  # nothing to offer yet (no own sig, no bests)
+            sig = self._compress(point)
+            version = sum(self._improves[:l])
+            for j in lv.window_candidates(self.window,
+                                          self._shuffle_orders[l]):
+                seen = lv.sent_version.get(j)
+                last = lv.last_sent_tick.get(j, -10**9)
+                if seen == version and \
+                        (j in lv.answered
+                         or self._tick_no - last < self.resend_ticks):
+                    continue
+                if seen is not None and j not in lv.answered:
+                    # re-contact without an answer: drift the score
+                    lv.score[j] = lv.score.get(j, 0) + SCORE_SILENT
+                lv.asked[j] = lv.asked.get(j, 0) + 1
+                lv.sent_version[j] = version
+                lv.last_sent_tick[j] = self._tick_no
+                out.append((j, l, bits, sig))
+        self.sends_total += len(out)
+        return out
+
+    def _level_active(self, level: int, now: float) -> bool:
+        """Level l activates once every level below it is complete OR
+        the session has aged past (l-1) level-timeouts — a silent
+        subtree delays the frontier, it does not freeze it."""
+        if level == 1:
+            return True
+        if all(self.levels[k].complete for k in range(1, level)):
+            return True
+        if self.started_at is None:
+            return False
+        return now - self.started_at >= (level - 1) * self.level_timeout_s
+
+    # -- certificates -------------------------------------------------
+
+    def _maybe_emit(self) -> None:
+        bits, point = self._combined_through(len(self.levels) + 1)
+        k = bits.num_true()
+        if point is None or k < MIN_CERT_SIGNERS or k <= self._emitted_bits:
+            return
+        if 3 * self._level_power(bits) <= 2 * self.total_power:
+            return
+        self._emitted_bits = k
+        self._pending_cert = (bits, self._compress(point))
+
+    def take_certificate(self) -> Optional[Tuple[BitArray, bytes]]:
+        """The latest quorum-crossing aggregate not yet handed out, or
+        None. Each take is a strict improvement (more signers) over the
+        previous one, so the caller pays absorb_certificate's pairing
+        only for progress."""
+        cert, self._pending_cert = self._pending_cert, None
+        return cert
+
+    # -- diagnostics --------------------------------------------------
+
+    def stuck_level(self, now: float) -> int:
+        """The frontier level if it has been incomplete past its
+        timeout, else 0 — the reactor's flat-gossip fallback signal and
+        the monitor's [HANDEL STUCK lvl=k] source."""
+        f = self._frontier()
+        if f > len(self.levels):
+            return 0
+        lv = self.levels[f]
+        anchor = lv.activated_at if lv.activated_at is not None \
+            else self.started_at
+        if anchor is None:
+            return 0
+        return f if now - anchor > self.level_timeout_s else 0
+
+    def complete(self) -> bool:
+        return self._frontier() > len(self.levels)
+
+    def status(self, now: float) -> dict:
+        """Structured view for /debug/handel (read-only; every field is
+        plain JSON)."""
+        return {
+            "n": self.n,
+            "my_index": self.my_index,
+            "levels": len(self.levels),
+            "frontier": self._frontier(),
+            "stuck_level": self.stuck_level(now),
+            "complete": self.complete(),
+            "verified": self.verified_total,
+            "rejected": self.rejected_total,
+            "pruned": self.pruned_total,
+            "sends": self.sends_total,
+            "level_fill": [
+                (self.levels[l].best_bits.num_true()
+                 if self.levels[l].best_bits is not None else 0)
+                for l in range(1, len(self.levels) + 1)
+            ],
+            "level_sizes": [
+                self.levels[l].hi - self.levels[l].lo
+                for l in range(1, len(self.levels) + 1)
+            ],
+        }
+
+    def set_own_signature(self, signature: bytes) -> None:
+        """Late-bind our own precommit signature (sessions created by an
+        incoming contribution before we signed start without one)."""
+        if self.own_point is not None:
+            return
+        pt = self._parse(signature)
+        if pt is None:
+            raise ValueError("own signature does not parse")
+        self.own_point = pt
+        self.own_bits.set_index(self.my_index, True)
+        self._improves[0] += 1
+        self._maybe_emit()
+
+
+class HandelManager:
+    """Session registry between ConsensusState and the reactor.
+
+    Owned by ConsensusState; touched from two threads (the state's
+    receive loop absorbs contributions and our own precommit, the
+    reactor's handel tick thread drains outgoing sends), so every
+    session operation runs under one leaf lock. Sessions are keyed by
+    (height, round, block_id) — competing proposals at a round simply
+    aggregate in parallel and the first to cross 2/3 wins, exactly as
+    the flat lane behaves.
+
+    Soundness note: nothing the manager emits is trusted. Certificates
+    assembled here flow through ConsensusState._add_aggregate_certificate
+    → VoteSet.absorb_certificate, which re-verifies the aggregate under
+    its own fail budget. Handel is purely a cheaper way to FIND the
+    certificate."""
+
+    def __init__(self, cfg, chain_id: str, my_address: Optional[bytes]):
+        self.cfg = cfg
+        self.chain_id = chain_id
+        self.my_address = my_address
+        self.metrics = None  # HandelMetrics; node wires it post-build
+        self._lock = threading.Lock()
+        # (height, round, hash, psh_hash, psh_total) -> (session, block_id)
+        self._sessions: Dict[tuple, tuple] = {}
+        self._height = 0
+        self.certs_emitted = 0
+
+    # -- wiring -------------------------------------------------------
+
+    def set_metrics(self, metrics) -> None:
+        self.metrics = metrics
+
+    def enabled(self, validators) -> bool:
+        """The overlay runs only when configured on, the committee is
+        BLS, and this node is IN the committee (replicas and
+        non-validators stay on flat certificate gossip)."""
+        if not (self.cfg.enable and validators is not None
+                and len(validators.validators) > 1 and validators.is_bls()):
+            return False
+        if self.my_address is None:
+            return False
+        idx, _ = validators.get_by_address(self.my_address)
+        return idx >= 0
+
+    @staticmethod
+    def _key(height: int, round_: int, block_id) -> tuple:
+        return (height, round_, bytes(block_id.hash),
+                bytes(block_id.parts_header.hash),
+                block_id.parts_header.total)
+
+    def _session_for_locked(self, validators, height: int, round_: int,
+                     block_id, create: bool):
+        key = self._key(height, round_, block_id)
+        ent = self._sessions.get(key)
+        if ent is not None or not create:
+            return ent[0] if ent else None
+        from ..crypto import bls as _bls
+        from ..crypto.bls.curve import g2_add as _g2_add, \
+            g2_compress as _g2_compress
+        from ..types.basic import VOTE_TYPE_PRECOMMIT, \
+            canonical_vote_sign_bytes
+        my_index, _ = validators.get_by_address(self.my_address)
+        if my_index < 0:
+            return None
+        vals = validators.validators
+        pubkeys = [v.pub_key.bytes() for v in vals]
+        powers = [v.voting_power for v in vals]
+        msg = canonical_vote_sign_bytes(
+            self.chain_id, VOTE_TYPE_PRECOMMIT, height, round_, block_id, 0)
+        metrics = self.metrics
+
+        def verify_fn(items):
+            import time as _time
+            t0 = _time.perf_counter()
+            out = _bls.verify_aggregates_many(
+                [([pubkeys[k] for k in idxs], msg, sig)
+                 for idxs, sig in items])
+            if metrics is not None:
+                metrics.verify_seconds.observe(_time.perf_counter() - t0)
+            return out
+
+        session = HandelSession(
+            len(vals), my_index, powers, None,
+            verify_fn=verify_fn,
+            parse_fn=_bls._parse_signature_point,
+            add_fn=_g2_add,
+            compress_fn=_g2_compress,
+            seed=self.cfg.seed, height=height, round_=round_,
+            window=self.cfg.window,
+            fail_budget=self.cfg.fail_budget,
+            level_timeout_s=self.cfg.level_timeout_ms / 1000.0,
+            resend_ticks=self.cfg.resend_ticks,
+            reshuffle_ticks=self.cfg.reshuffle_ticks)
+        self._sessions[key] = (session, block_id)
+        return session
+
+    # -- state-machine hooks (receive-loop thread) --------------------
+
+    def note_own_precommit(self, vote, validators) -> None:
+        """Seed/refresh the session for our own non-nil precommit. The
+        session then starts offering level-1 contributions on the next
+        tick."""
+        if vote.block_id.hash == b"" or not self.enabled(validators):
+            return
+        with self._lock:
+            if vote.height < self._height:
+                return
+            self._height = max(self._height, vote.height)
+            s = self._session_for_locked(validators, vote.height, vote.round,
+                                  vote.block_id, create=True)
+            if s is not None:
+                try:
+                    s.set_own_signature(vote.signature)
+                except ValueError:
+                    pass
+
+    def absorb(self, msgs, validators, height: int, now: float):
+        """Feed incoming HandelContributionMessages into their sessions.
+        Returns (n_verified, n_rejected, certs) where certs are
+        quorum-crossing types.block.AggregateCommit candidates the
+        caller must route through _add_aggregate_certificate."""
+        from ..types.block import AggregateCommit
+        verified = rejected = 0
+        certs = []
+        if not self.enabled(validators):
+            return 0, len(msgs), []
+        with self._lock:
+            self._height = max(self._height, height)
+            by_key: Dict[tuple, list] = {}
+            for m in msgs:
+                if m.height != height:
+                    rejected += 1
+                    continue
+                by_key.setdefault(
+                    self._key(m.height, m.round, m.block_id), []).append(m)
+            for key, group in by_key.items():
+                m0 = group[0]
+                s = self._session_for_locked(validators, m0.height, m0.round,
+                                      m0.block_id, create=True)
+                if s is None:
+                    rejected += len(group)
+                    continue
+                v, r = s.add_contributions(
+                    [(m.origin, m.level, m.signers, m.agg_sig)
+                     for m in group], now)
+                verified += v
+                rejected += r
+                cert = s.take_certificate()
+                if cert is not None:
+                    bits, sig = cert
+                    certs.append(AggregateCommit(
+                        m0.block_id, m0.height, m0.round, bits, sig))
+                    self.certs_emitted += 1
+        if self.metrics is not None:
+            if verified:
+                self.metrics.contributions.with_labels("verified") \
+                    .inc(verified)
+            if rejected:
+                self.metrics.contributions.with_labels("rejected") \
+                    .inc(rejected)
+        return verified, rejected, certs
+
+    # -- reactor hooks (handel tick thread) ---------------------------
+
+    def outgoing(self, validators, height: int, now: float):
+        """Drain one gossip tick across current-height sessions:
+        [(target_validator_index, HandelContributionMessage)]. The
+        reactor resolves indices to peers; unknown targets drop (an
+        unreachable candidate scores down and rotates out)."""
+        from .messages import HandelContributionMessage
+        if not self.enabled(validators):
+            return []
+        out = []
+        pruned = 0
+        with self._lock:
+            for key in sorted(self._sessions):
+                if key[0] != self._height:
+                    continue
+                session, block_id = self._sessions[key]
+                before = session.pruned_total
+                for target, level, bits, sig in session.tick(now):
+                    out.append((target, HandelContributionMessage(
+                        key[0], key[1], level, session.my_index,
+                        block_id, bits, sig)))
+                pruned += session.pruned_total - before
+        if self.metrics is not None and pruned:
+            self.metrics.pruned_peers.inc(pruned)
+        return out
+
+    def advance_height(self, height: int) -> None:
+        """GC sessions for committed heights (called on height advance;
+        round churn within a height keeps its sessions — late rounds
+        still need early-round certificates for last_commit)."""
+        with self._lock:
+            self._height = max(self._height, height)
+            for key in [k for k in self._sessions if k[0] < height]:
+                del self._sessions[key]
+
+    # -- diagnostics --------------------------------------------------
+
+    def stuck(self, now: float) -> int:
+        """Max stuck level across current-height sessions (0 = healthy);
+        the reactor's signal to re-open flat certificate gossip."""
+        with self._lock:
+            worst = 0
+            for key, (session, _) in self._sessions.items():
+                if key[0] == self._height:
+                    worst = max(worst, session.stuck_level(now))
+            return worst
+
+    def status(self, now: float) -> dict:
+        """/debug/handel payload."""
+        with self._lock:
+            sessions = []
+            for key in sorted(self._sessions):
+                session, _ = self._sessions[key]
+                st = session.status(now)
+                st["height"] = key[0]
+                st["round"] = key[1]
+                sessions.append(st)
+                if self.metrics is not None and key[0] == self._height:
+                    for i, fill in enumerate(st["level_fill"]):
+                        size = st["level_sizes"][i] or 1
+                        self.metrics.level.with_labels(str(i + 1)) \
+                            .set(fill / size)
+            return {
+                "enabled": bool(self.cfg.enable),
+                "height": self._height,
+                "certs_emitted": self.certs_emitted,
+                "sessions": sessions,
+            }

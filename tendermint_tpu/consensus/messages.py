@@ -106,6 +106,24 @@ class AggregateCommitMessage:
     commit: object
 
 
+@dataclass
+class HandelContributionMessage:
+    """Handel overlay level contribution (consensus/handel.py; no
+    reference equivalent): origin's combined aggregate over its own
+    half-subtree at `level` for the precommit on (height, round,
+    block_id). signers is a full-committee-sized bitmap (the level
+    constrains which bits may be set); agg_sig is the 96-byte BLS
+    aggregate over exactly those signers."""
+
+    height: int
+    round: int
+    level: int
+    origin: int
+    block_id: BlockID
+    signers: BitArray
+    agg_sig: bytes
+
+
 def _ba_obj(ba: Optional[BitArray]):
     return None if ba is None else [ba.bits, ba.to_bytes()]
 
@@ -139,6 +157,10 @@ def message_to_obj(m) -> list:
                 serde.block_id_obj(m.block_id), _ba_obj(m.votes)]
     if isinstance(m, AggregateCommitMessage):
         return ["agg_commit", serde.commit_obj(m.commit)]
+    if isinstance(m, HandelContributionMessage):
+        return ["handel", m.height, m.round, m.level, m.origin,
+                serde.block_id_obj(m.block_id), _ba_obj(m.signers),
+                m.agg_sig]
     raise TypeError(f"unknown consensus message {type(m)}")
 
 
@@ -164,4 +186,8 @@ def message_from_obj(o: list):
         return VoteSetBitsMessage(o[1], o[2], o[3], serde.block_id_from(o[4]), _ba_from(o[5]))
     if kind == "agg_commit":
         return AggregateCommitMessage(serde.commit_from(o[1]))
+    if kind == "handel":
+        return HandelContributionMessage(o[1], o[2], o[3], o[4],
+                                         serde.block_id_from(o[5]),
+                                         _ba_from(o[6]), o[7])
     raise ValueError(f"unknown consensus message kind {kind!r}")

@@ -39,6 +39,7 @@ from .messages import (
     AggregateCommitMessage,
     BlockPartMessage,
     CommitStepMessage,
+    HandelContributionMessage,
     HasVoteMessage,
     NewRoundStepMessage,
     ProposalMessage,
@@ -56,9 +57,22 @@ STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
+# Handel overlay contributions (consensus/handel.py). Advertised only
+# when [handel] enable is set — with it off the channel vector, and
+# therefore the p2p handshake, is byte-identical to a build without
+# the overlay.
+HANDEL_CHANNEL = 0x24
 
 PEER_GOSSIP_SLEEP = 0.1  # reactor.go:36 peerGossipSleepDuration
 PEER_QUERY_MAJ23_SLEEP = 2.0  # reactor.go:39
+
+# flat certificate lane re-send gate (PR 19): a merged cert re-sends to
+# a peer only after this interval, UNLESS it grew by at least
+# _AGG_RESEND_DELTA signers since the last send — steady-state chatter
+# collapses to one message per interval while real aggregation progress
+# still propagates immediately
+_AGG_RESEND_MIN_S = 0.25
+_AGG_RESEND_DELTA = 8
 
 
 def encode_msg(m) -> bytes:
@@ -102,6 +116,9 @@ class PeerState:
         # (see expire_gossip_marks_if_stalled)
         self.last_height_advance = time.monotonic()
         self._marks_expired_at = time.monotonic()
+        # flat-lane cert re-send gate: (height, round) -> (sent_at,
+        # num_signers at send) — see agg_cert_should_send
+        self._agg_sent: Dict[tuple, tuple] = {}
 
     # -- queries -------------------------------------------------------
 
@@ -252,6 +269,32 @@ class PeerState:
             # 2×size() per-bit lock acquisitions per gossip tick
             return not cert.signers.sub(ba).is_empty()
 
+    def agg_cert_should_send(self, cert, now: float,
+                             min_s: float, delta: int) -> bool:
+        """agg_cert_has_news PLUS the per-peer re-send gate: a growing
+        certificate re-sends immediately once it gained `delta` signers,
+        anything else waits out `min_s`. apply_agg_commit normally stops
+        pure duplicates already — this bounds the chatter left when mark
+        expiry (expire_gossip_marks_if_stalled) wipes the peer's bitmap
+        during a stall and every tick would otherwise re-offer the same
+        bytes."""
+        if not self.agg_cert_has_news(cert):
+            return False
+        with self._lock:
+            sent_at, sent_n = self._agg_sent.get(
+                (cert.agg_height, cert.agg_round), (0.0, 0))
+            n = cert.num_signers()
+            return now - sent_at >= min_s or n - sent_n >= delta
+
+    def note_agg_cert_sent(self, cert, now: float) -> None:
+        with self._lock:
+            self._agg_sent[(cert.agg_height, cert.agg_round)] = (
+                now, cert.num_signers())
+            if len(self._agg_sent) > 8:  # GC: committed heights
+                for k in [k for k in self._agg_sent
+                          if k[0] < cert.agg_height - 1]:
+                    del self._agg_sent[k]
+
     def ensure_catchup_commit_round(self, height: int, round_: int, num_validators: int) -> None:
         """reactor.go:975-994."""
         with self._lock:
@@ -395,12 +438,13 @@ class ReplicaConsensusAbsorber(Reactor):
     sleep (reactor.go's prs.height == 0 guards). The replica itself
     never sends a consensus message."""
 
-    def __init__(self):
+    def __init__(self, handel: bool = False):
         super().__init__("ReplicaConsensusAbsorber")
         self.absorbed = 0  # frames dropped; /debug visibility only
+        self._handel = handel
 
     def get_channels(self):
-        return [
+        channels = [
             ChannelDescriptor(id=STATE_CHANNEL, priority=1,
                               send_queue_capacity=2),
             ChannelDescriptor(id=DATA_CHANNEL, priority=1,
@@ -413,6 +457,14 @@ class ReplicaConsensusAbsorber(Reactor):
                               send_queue_capacity=2,
                               recv_message_capacity=1024),
         ]
+        if self._handel:
+            # a [handel]-enabled fleet advertises 0x24; the replica must
+            # own it too or the first inbound contribution disconnects
+            # the validator (unowned channel = protocol error)
+            channels.append(ChannelDescriptor(
+                id=HANDEL_CHANNEL, priority=1, send_queue_capacity=2,
+                recv_message_capacity=100 * 1024))
+        return channels
 
     def receive(self, ch_id: int, peer, msg_bytes: bytes) -> None:
         self.absorbed += 1
@@ -435,6 +487,10 @@ class ConsensusReactor(Reactor):
         self._peer_threads: Dict[str, list] = {}
         self._stop = threading.Event()
         self._bcast_thread: Optional[threading.Thread] = None
+        self._handel_thread: Optional[threading.Thread] = None
+        # validator index -> peer id, learned from the `origin` field of
+        # received contributions (GIL-atomic dict ops; no lock needed)
+        self._handel_val_peer: Dict[int, str] = {}
         self._subs = []
         # gossip-mark expiry horizon (expire_gossip_marks_if_stalled):
         # roughly one full round at this chain's timeouts — long enough
@@ -450,7 +506,7 @@ class ConsensusReactor(Reactor):
 
     def get_channels(self):
         """reactor.go:125-157."""
-        return [
+        channels = [
             ChannelDescriptor(id=STATE_CHANNEL, priority=5, send_queue_capacity=100),
             ChannelDescriptor(
                 id=DATA_CHANNEL, priority=10, send_queue_capacity=100,
@@ -465,6 +521,12 @@ class ConsensusReactor(Reactor):
                 recv_message_capacity=1024,
             ),
         ]
+        if getattr(self.cs, "handel", None) is not None:
+            channels.append(ChannelDescriptor(
+                id=HANDEL_CHANNEL, priority=5, send_queue_capacity=100,
+                recv_message_capacity=100 * 1024,
+            ))
+        return channels
 
     # -- lifecycle -----------------------------------------------------
 
@@ -480,6 +542,11 @@ class ConsensusReactor(Reactor):
             target=self._step_refresh_routine, name="cons-step-refresh",
             daemon=True)
         self._step_refresh_thread.start()
+        if getattr(self.cs, "handel", None) is not None:
+            self._handel_thread = threading.Thread(
+                target=self._handel_tick_routine, name="cons-handel",
+                daemon=True)
+            self._handel_thread.start()
 
     def _step_refresh_routine(self) -> None:
         """Periodically re-announce our round step to every peer.
@@ -574,6 +641,9 @@ class ConsensusReactor(Reactor):
     def remove_peer(self, peer, reason) -> None:
         self._peer_states.pop(peer.id, None)
         self._peer_threads.pop(peer.id, None)
+        for idx in [i for i, pid in self._handel_val_peer.items()
+                    if pid == peer.id]:
+            self._handel_val_peer.pop(idx, None)
         # threads exit on peer.is_running() checks
 
     # -- inbound -------------------------------------------------------
@@ -627,6 +697,17 @@ class ConsensusReactor(Reactor):
                     ps.ensure_vote_bit_arrays(rs.height - 1, n)
                     ps.apply_agg_commit(msg.commit)
                     self.cs.add_peer_message(msg, peer.id)
+        elif ch_id == HANDEL_CHANNEL:
+            if self.fast_sync:
+                return
+            if isinstance(msg, HandelContributionMessage):
+                # pin down the peer's validator index from the claimed
+                # origin — a lie only misroutes that peer's OWN window
+                # traffic (contribution verification is unaffected), and
+                # the session's scoring prunes senders of garbage
+                if 0 <= msg.origin < (1 << 20):
+                    self._handel_val_peer[msg.origin] = peer.id
+                self.cs.add_peer_message(msg, peer.id)
         elif ch_id == VOTE_SET_BITS_CHANNEL:
             if self.fast_sync:
                 return
@@ -910,31 +991,105 @@ class ConsensusReactor(Reactor):
         if rs.validators is None or not rs.validators.is_bls():
             return False
         try:
+            now = time.monotonic()
+            # Handel overlay suppression: while the overlay is on and its
+            # frontier is healthy, same-height certificates travel as
+            # O(log n) level contributions instead — the flat lane stays
+            # armed as the fallback and re-opens the moment a session
+            # reports a stuck level (byzantine-silent subtree, partition)
+            mgr = getattr(self.cs, "handel", None)
+            handel_quiet = (mgr is not None and mgr.enabled(rs.validators)
+                            and mgr.stuck(now) == 0)
             # same height: the peer's current round precommits
-            if (prs.height == rs.height and rs.votes is not None
+            if (not handel_quiet and prs.height == rs.height
+                    and rs.votes is not None
                     and 0 <= prs.round <= rs.round):
                 pc = rs.votes.precommits(prs.round)
                 cert = pc.aggregate_certificate() if pc is not None else None
                 if cert is not None and cert.num_signers() > 1:
                     ps.ensure_vote_bit_arrays(rs.height, cert.size())
-                    if ps.agg_cert_has_news(cert) and peer.send(
+                    if ps.agg_cert_should_send(
+                        cert, now, _AGG_RESEND_MIN_S, _AGG_RESEND_DELTA
+                    ) and peer.send(
                         VOTE_CHANNEL, encode_msg(AggregateCommitMessage(cert))
                     ):
                         ps.apply_agg_commit(cert)
+                        ps.note_agg_cert_sent(cert, now)
                         return True
             # peer one height behind: our last commit as one certificate
+            # (never suppressed — catch-up is not an aggregation problem)
             if prs.height + 1 == rs.height and rs.last_commit is not None:
                 cert = rs.last_commit.aggregate_certificate()
                 if cert is not None:
                     ps.ensure_vote_bit_arrays(prs.height, cert.size())
-                    if ps.agg_cert_has_news(cert) and peer.send(
+                    if ps.agg_cert_should_send(
+                        cert, now, _AGG_RESEND_MIN_S, _AGG_RESEND_DELTA
+                    ) and peer.send(
                         VOTE_CHANNEL, encode_msg(AggregateCommitMessage(cert))
                     ):
                         ps.apply_agg_commit(cert)
+                        ps.note_agg_cert_sent(cert, now)
                         return True
         except Exception:
             LOG.exception("aggregate cert gossip error for %s", peer.id[:8])
         return False
+
+    def _handel_tick_routine(self) -> None:
+        """One thread drives every Handel session's gossip (not
+        per-peer: a tick drains ALL sessions and fans the sends out to
+        whichever peers currently back the target validator indices).
+        Unmapped targets mean we have not yet seen that validator's
+        peer; one representative contribution per still-unmapped peer
+        per tick bootstraps the index map (receivers learn OUR index
+        from `origin` and their replies pin theirs) without flooding."""
+        mgr = self.cs.handel
+        interval = max(0.01, getattr(mgr.cfg, "tick_ms", 50) / 1000.0)
+        while not self._stop.wait(interval):
+            if self.fast_sync:
+                continue
+            try:
+                rs = self.cs.get_round_state()
+                # contributions are wire messages: only a consistent
+                # snapshot may pick the (height, validators) they bind
+                # to (CD-5); retry next tick
+                if not getattr(rs, "snapshot_consistent", True):
+                    continue
+                if rs.validators is None:
+                    continue
+                sends = mgr.outgoing(rs.validators, rs.height,
+                                     time.monotonic())
+                if not sends:
+                    continue
+                self._handel_fan_out(sends)
+            except Exception:  # noqa: BLE001 - overlay must outlive bugs
+                LOG.exception("handel tick failed")
+
+    def _handel_fan_out(self, sends) -> None:
+        """Route [(validator_index, HandelContributionMessage)] to peers.
+        Only peers ADVERTISING the channel may receive on it: a frame on
+        an undeclared channel is a protocol error that tears down the
+        connection (connection.py recv loop), so a mixed fleet — handel
+        validators peered with [handel]-off nodes or replicas — would
+        flap without this gate."""
+        peers = {
+            pid: ps for pid, ps in self._peer_states.items()
+            if HANDEL_CHANNEL in ps.peer.node_info.channels
+        }
+        val_peer = self._handel_val_peer
+        bootstrap_msg = None
+        for target, m in sends:
+            pid = val_peer.get(target)
+            ps = peers.get(pid) if pid is not None else None
+            if ps is not None and ps.peer.is_running():
+                ps.peer.try_send(HANDEL_CHANNEL, encode_msg(m))
+            else:
+                bootstrap_msg = m
+        if bootstrap_msg is not None:
+            data = encode_msg(bootstrap_msg)
+            mapped = set(val_peer.values())
+            for pid, ps in peers.items():
+                if pid not in mapped and ps.peer.is_running():
+                    ps.peer.try_send(HANDEL_CHANNEL, data)
 
     def _query_maj23_routine(self, peer, ps: PeerState) -> None:
         """reactor.go:720-802: periodically ask the peer for vote bits of

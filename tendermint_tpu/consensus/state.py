@@ -68,6 +68,7 @@ from .cstypes import (
 from .messages import (
     AggregateCommitMessage,
     BlockPartMessage,
+    HandelContributionMessage,
     ProposalMessage,
     VoteMessage,
 )
@@ -98,6 +99,7 @@ class ConsensusState:
         priv_validator=None,
         wal=None,
         metrics=None,
+        handel_cfg=None,
     ):
         self.config = config
         self.block_exec = block_exec
@@ -176,6 +178,18 @@ class ConsensusState:
         # BLS aggregate lane diagnostics (stall_snapshot / monitor)
         self.n_agg_merges = 0
         self.last_agg_cert_bytes = 0
+
+        # Handel aggregation overlay (consensus/handel.py): built only
+        # when [handel] enable is set — None keeps every hook below a
+        # no-op and the flat certificate lane byte-identical to a build
+        # without the overlay
+        self.handel = None
+        if handel_cfg is not None and getattr(handel_cfg, "enable", False):
+            from .handel import HandelManager
+
+            addr = (priv_validator.get_address()
+                    if priv_validator is not None else None)
+            self.handel = HandelManager(handel_cfg, state.chain_id, addr)
 
         self.update_to_state(state)
         self._reconstruct_last_commit_if_needed(state)
@@ -310,6 +324,9 @@ class ConsensusState:
         rs.last_validators = state.last_validators
         rs.triggered_timeout_precommit = False
 
+        if self.handel is not None:
+            self.handel.advance_height(height)
+
         self._round_entered = time.time()
         self._height_entered = time.time()
         self.timeline.mark(height, "new_height")
@@ -442,6 +459,31 @@ class ConsensusState:
                             # be lost to a WAL or vote-handling exception
                             if tail is not None:
                                 self._handle_item(tail)
+                    elif item[0] == "msg" and isinstance(
+                            item[1][1], HandelContributionMessage):
+                        # same drain idiom for Handel contributions: a
+                        # contiguous run becomes ONE multi-pair check in
+                        # the session (bls.verify_aggregates_many)
+                        run = [item[1][1]]
+                        tail = None
+                        while len(run) < MAX_VOTE_BATCH:
+                            try:
+                                nxt = self._queue.get_nowait()
+                            except queue.Empty:
+                                break
+                            if nxt[0] == "msg" and isinstance(
+                                    nxt[1][1], HandelContributionMessage):
+                                run.append(nxt[1][1])
+                            else:
+                                tail = nxt
+                                break
+                        try:
+                            with self._mutating():
+                                self._add_handel_contributions(
+                                    run, item[1][0])
+                        finally:
+                            if tail is not None:
+                                self._handle_item(tail)
                     else:
                         self._handle_item(item)
                 except Exception:
@@ -476,6 +518,14 @@ class ConsensusState:
         kind, payload = item
         if kind == "msg":
             peer_id, msg = payload
+            if isinstance(msg, HandelContributionMessage):
+                # transient overlay traffic is never WAL'd: it is
+                # re-derivable, and replaying pairing checks would slow
+                # crash recovery for zero safety (the certificates it
+                # yields re-enter through absorb_certificate's gates)
+                with self._mutating():
+                    self._handle_msg(msg, peer_id)
+                return
             if peer_id == "":
                 self.wal.write_sync((peer_id, msg))  # :604-609
             else:
@@ -610,8 +660,28 @@ class ConsensusState:
             self._try_add_vote(msg.vote, peer_id)
         elif isinstance(msg, AggregateCommitMessage):
             self._add_aggregate_certificate(msg.commit, peer_id)
+        elif isinstance(msg, HandelContributionMessage):
+            self._add_handel_contributions([msg], peer_id)
         else:
             LOG.warning("unknown message type %s", type(msg))
+
+    def _add_handel_contributions(self, msgs, peer_id: str) -> None:
+        """Handel overlay receive lane: feed a drained run of level
+        contributions into their sessions (one multi-pair aggregate
+        check per run via bls.verify_aggregates_many) and route any
+        quorum-crossing aggregate through the SAME
+        _add_aggregate_certificate gate the flat gossip lane uses —
+        absorb_certificate re-verifies it, so the overlay adds zero
+        trust surface."""
+        rs = self.rs
+        if self.handel is None or rs.validators is None:
+            return
+        _, _, certs = self.handel.absorb(
+            msgs, rs.validators, rs.height, time.monotonic())
+        for cert in certs:
+            # "" peer attribution: the certificate was assembled locally
+            # from verified contributions, not received on the wire
+            self._add_aggregate_certificate(cert, peer_id="")
 
     def _add_aggregate_certificate(self, cert, peer_id: str) -> None:
         """Handel-lite lane: merge a gossiped precommit certificate into
@@ -1432,6 +1502,14 @@ class ConsensusState:
                 LOG.exception("failed signing %s vote", "prevote" if type_ == VOTE_TYPE_PREVOTE else "precommit")
             return None
         self._send_internal(VoteMessage(vote))
+        if (self.handel is not None and type_ == VOTE_TYPE_PRECOMMIT
+                and hash_ != b"" and not self._replay_mode):
+            # seed the Handel session with our own precommit — level 1
+            # starts offering it on the next reactor tick
+            try:
+                self.handel.note_own_precommit(vote, rs.validators)
+            except Exception:  # noqa: BLE001 - overlay must not kill voting
+                LOG.exception("handel: seeding own precommit failed")
         LOG.debug("signed and queued vote %s", vote)
         return vote
 
@@ -1448,6 +1526,18 @@ class ConsensusState:
         nil prevotes → next round) keeps every per-round dwell short
         while the height itself goes nowhere."""
         return max(0.0, time.time() - self._height_entered)
+
+    def handel_status(self) -> dict:
+        """Handel overlay view for /debug/handel and stall_snapshot —
+        {"enabled": False} when the overlay is off so the route surface
+        is identical either way."""
+        if self.handel is None:
+            return {"enabled": False}
+        try:
+            return self.handel.status(time.monotonic())
+        except Exception:  # noqa: BLE001 - diagnostics must not raise
+            LOG.exception("handel status failed")
+            return {"enabled": True, "error": "status failed"}
 
     def stall_snapshot(self, switch=None, reason: str = "",
                        dwell_s: float = 0.0) -> dict:
@@ -1488,6 +1578,7 @@ class ConsensusState:
                 "gossip_merges": self.n_agg_merges,
                 "last_cert_bytes": self.last_agg_cert_bytes,
             },
+            "handel": self.handel_status(),
         }
         try:
             if rs.votes is not None and rs.validators is not None:

@@ -168,6 +168,15 @@ def pop_registered(pubkey: bytes) -> bool:
         return pubkey in _pop_registry
 
 
+def register_pop_trusted(pubkey: bytes) -> None:
+    """Harness-only: record a key as possession-proven WITHOUT checking
+    a proof. Scenario fixtures with thousands of phantom validators use
+    this to skip ~2 pairings per key at genesis load; the phantoms never
+    sign, so nothing downstream ever relies on their proofs. Never call
+    this for keys that arrived on the wire."""
+    _register_pop_unchecked(pubkey)
+
+
 _pop_verify_cache = _PointCache(4096)
 
 
@@ -347,6 +356,108 @@ def fast_aggregate_verify(
     ok = pairing_product_is_one([(agg_pk, hm), (_NEG_G1_GEN, sig_pt)])
     _record_agg_metrics(time.perf_counter() - t0, len(pubkeys))
     return ok
+
+
+def verify_aggregates_many(
+    items: Sequence[tuple], backend: Optional[str] = None,
+    require_pop: bool = False,
+) -> List[bool]:
+    """Verify k same-message aggregate certificates in ONE multi-pair
+    product check (2k pairs through a single shared-squaring Miller
+    loop + one final exponentiation) instead of k sequential 2-pairing
+    checks. items = [(pubkeys, msg, signature), ...]; returns one
+    verdict per item, order-aligned.
+
+    Soundness rides a random linear combination: each certificate i is
+    scaled by an independent 128-bit scalar r_i and the combined check
+    prod_i e(r_i*agg_pk_i, H(m_i)) * e(r_i*(-G1), sig_i) == 1 holds iff
+    every per-certificate relation holds, except with probability
+    ~2^-128 over the scalars. Scalars come from a Fiat-Shamir sha256
+    transcript of every batched input — deterministic and replayable,
+    no RNG in the verify path — and ride the G1 side only (two cheap
+    G1 muls per certificate; the G2 points are untouched). r_0 is
+    pinned to 1 so the first certificate's muls are free. If the
+    combined check fails, each batched item is re-verified alone so
+    callers still get exact per-certificate verdicts (the slow path
+    only runs when something IS invalid).
+
+    require_pop defaults False here (unlike fast_aggregate_verify):
+    every call site — statesync anchor commits, replica catch-up
+    certificates, Handel level contributions — verifies against a
+    hash-chained valset whose keys passed proof-of-possession at
+    registration time."""
+    items = list(items)
+    if not items:
+        return []
+    if len(items) == 1:
+        pks, msg, sig = items[0]
+        return [fast_aggregate_verify(pks, msg, sig, backend=backend,
+                                      require_pop=require_pop)]
+    t0 = time.perf_counter()
+    verdicts: List[Optional[bool]] = [None] * len(items)
+    parsed = []  # (item index, agg_pk, H(m), sig point)
+    hm_memo = {}  # distinct messages hash once per call
+    for i, (pks, msg, sig) in enumerate(items):
+        if not pks or len(sig) != BLS_SIGNATURE_SIZE:
+            verdicts[i] = False
+            continue
+        if require_pop and not all(pop_registered(pk) for pk in pks):
+            verdicts[i] = False
+            continue
+        sig_pt = _parse_signature_point(sig)
+        if sig_pt is None:
+            verdicts[i] = False
+            continue
+        try:
+            agg_pk = aggregate_pubkeys(pks, backend=backend)
+        except ValueError:
+            verdicts[i] = False
+            continue
+        if agg_pk is None:  # keys summed to infinity (attack-shaped)
+            verdicts[i] = False
+            continue
+        hm = hm_memo.get(msg)
+        if hm is None:
+            hm = hash_to_g2(msg, DST_SIG)
+            hm_memo[msg] = hm
+        parsed.append((i, agg_pk, hm, sig_pt))
+    if parsed:
+        tr = hashlib.sha256()
+        for i, _, _, _ in parsed:
+            pks, msg, sig = items[i]
+            tr.update(len(pks).to_bytes(4, "big"))
+            for pk in pks:
+                tr.update(pk)
+            tr.update(len(msg).to_bytes(4, "big"))
+            tr.update(msg)
+            tr.update(sig)
+        seed = tr.digest()
+        pairs = []
+        total_signers = 0
+        for k, (i, agg_pk, hm, sig_pt) in enumerate(parsed):
+            total_signers += len(items[i][0])
+            if k == 0:
+                r = 1
+            else:
+                r = int.from_bytes(
+                    hashlib.sha256(seed + k.to_bytes(4, "big")).digest()[:16],
+                    "big") or 1
+            if r == 1:
+                pairs.append((agg_pk, hm))
+                pairs.append((_NEG_G1_GEN, sig_pt))
+            else:
+                pairs.append((g1_mul(agg_pk, r), hm))
+                pairs.append((g1_mul(_NEG_G1_GEN, r), sig_pt))
+        if pairing_product_is_one(pairs):
+            for i, _, _, _ in parsed:
+                verdicts[i] = True
+        else:
+            for i, _, _, _ in parsed:
+                pks, msg, sig = items[i]
+                verdicts[i] = fast_aggregate_verify(
+                    pks, msg, sig, backend=backend, require_pop=require_pop)
+        _record_agg_metrics(time.perf_counter() - t0, total_signers)
+    return [bool(v) for v in verdicts]
 
 
 def _record_agg_metrics(dt: float, signers: int) -> None:

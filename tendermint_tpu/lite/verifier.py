@@ -41,7 +41,8 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
                             signed_header: SignedHeader,
                             trust_fraction_num: int = 2,
                             trust_fraction_den: int = 3,
-                            commit_vals: ValidatorSet = None) -> None:
+                            commit_vals: ValidatorSet = None,
+                            defer_signature: bool = False) -> None:
     """types/validator_set.go VerifyFutureCommit-style check: >2/3 of
     OUR trusted set must have signed the new header (used while
     stepping across valset changes, validator_set.go:409-434; the
@@ -137,6 +138,12 @@ def _verify_commit_trusting(vals: ValidatorSet, chain_id: str,
                 f"aggregate signer {val.address.hex()[:12]} is outside "
                 "the trusted set and has no verifying proof of "
                 "possession (rogue-key defense)")
+        if defer_signature:
+            # caller pledges to run verify_commit_aggregate on this
+            # same certificate against this same commit_vals (the
+            # bisection step's new-set +2/3 check IS that call) — the
+            # two pairings are byte-identical, so pay only one
+            return
         try:
             commit_vals.verify_commit_aggregate(
                 chain_id, commit.block_id, signed_header.height, commit)
@@ -313,11 +320,21 @@ class DynamicVerifier:
                 # valset changed (reference VerifyFutureCommit,
                 # validator_set.go:409-434): BOTH >2/3 of the old
                 # trusted set signed it AND +2/3 of the commit's own
-                # claimed valset signed it
+                # claimed valset signed it. BLS lane: the trusting
+                # arm's terminal pairing and the BaseVerifier check
+                # below verify the SAME certificate against the SAME
+                # valset (commit_vals IS source_fc.validators), so the
+                # trusting pairing defers and each statesync bisection
+                # step costs ONE pairing product check instead of two
+                from ..types.block import AggregateCommit
+
+                defer = isinstance(source_fc.signed_header.commit,
+                                   AggregateCommit)
                 _verify_commit_trusting(
                     trusted_fc.next_validators or trusted_fc.validators,
                     self.chain_id, source_fc.signed_header,
-                    commit_vals=source_fc.validators)
+                    commit_vals=source_fc.validators,
+                    defer_signature=defer)
                 _validate_full(source_fc, self.chain_id)
                 BaseVerifier(
                     self.chain_id, source_fc.height, source_fc.validators,

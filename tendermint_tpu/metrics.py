@@ -365,6 +365,24 @@ class IncidentMetrics:
 
 
 @dataclass
+class HandelMetrics:
+    """Handel aggregation overlay telemetry (ours; consensus/handel.py).
+    All families stay silent on Ed25519 chains and when [handel] is
+    off — absence is the disabled signal."""
+
+    # current session's per-level fill fraction (0..1 of the
+    # complementary group covered by the best verified aggregate)
+    level: object = NOP
+    # incoming contributions by verdict (verified | rejected)
+    contributions: object = NOP
+    # wall seconds per contribution verification batch (one multi-pair
+    # aggregate check per drained run)
+    verify_seconds: object = NOP
+    # candidates pruned after exhausting their garbage fail budget
+    pruned_peers: object = NOP
+
+
+@dataclass
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
@@ -379,6 +397,7 @@ class NodeMetrics:
     determinism: DeterminismMetrics = field(
         default_factory=DeterminismMetrics)
     incident: IncidentMetrics = field(default_factory=IncidentMetrics)
+    handel: HandelMetrics = field(default_factory=HandelMetrics)
     registry: Optional[Registry] = None
 
 
@@ -776,8 +795,29 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Incidents currently open on this node (fault injected, "
             "no fresh-height commit yet)."),
     )
+    handel = HandelMetrics(
+        level=r.gauge(
+            f"{ns}_handel_level",
+            "Current Handel session's per-level fill fraction (best "
+            "verified aggregate coverage of the complementary group).",
+            ("level",)),
+        contributions=r.counter(
+            f"{ns}_handel_contributions_total",
+            "Incoming Handel level contributions, by verdict.",
+            ("verdict",)),
+        verify_seconds=r.histogram(
+            f"{ns}_handel_verify_seconds",
+            "Wall seconds per Handel contribution verification batch "
+            "(one multi-pair aggregate check per drained run).",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1, 2.5)),
+        pruned_peers=r.counter(
+            f"{ns}_handel_pruned_peers_total",
+            "Handel candidates pruned after exhausting their garbage "
+            "fail budget."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
                        rpc=rpc, lockdep=lockdep, recovery=recovery,
                        determinism=determinism, incident=incident,
-                       registry=r)
+                       handel=handel, registry=r)

@@ -432,7 +432,10 @@ class Node:
                 priv_validator=priv_validator,
                 wal=wal,
                 metrics=self.metrics.consensus,
+                handel_cfg=config.handel,
             )
+            if self.consensus_state.handel is not None:
+                self.consensus_state.handel.set_metrics(self.metrics.handel)
             # per-height lifecycle timelines (libs/timeline.py): the
             # recorder lives on the ConsensusState (per-node, not
             # process-global); marks are a dict write per consensus
@@ -466,7 +469,8 @@ class Node:
 
             self.consensus_state = None
             self.consensus_reactor = None
-            self._consensus_absorber = ReplicaConsensusAbsorber()
+            self._consensus_absorber = ReplicaConsensusAbsorber(
+                handel=config.handel.enable)
             self.blockchain_reactor = BlockchainReactor(
                 state,
                 self.block_exec,
@@ -514,6 +518,10 @@ class Node:
 
         # --- p2p (node/node.go:366-464) ------------------------------
         channels = NODE_CHANNELS + (b"\x00" if config.p2p.pex else b"")
+        if config.handel.enable:
+            # Handel overlay channel: advertised only when [handel] is
+            # on, so a default build's handshake stays byte-identical
+            channels += bytes([0x24])
         node_info = NodeInfo(
             protocol_version=ProtocolVersion(),
             id=node_key.id,
@@ -921,12 +929,23 @@ class Node:
                 "/debug/determinism": lambda q: self._determinism_status(),
                 "/debug/exec": lambda q: self._exec_status(),
                 "/debug/incidents": lambda q: self._incidents_status(),
+                "/debug/handel": lambda q: self._handel_status(),
             },
             identity={"node_id": self.node_key.id,
                       "moniker": self.config.base.moniker},
             clock_skew_s=self.config.instrumentation.clock_skew_s,
         )
         self._prof_server.start()
+
+    def _handel_status(self) -> dict:
+        """/debug/handel: per-session Handel overlay state (level fill,
+        frontier, stuck level, contribution counters). Registered in
+        BOTH validator and replica modes — the fleettrace provider
+        contract requires an identical route surface — and reports
+        {"enabled": false} wherever the overlay is off or absent."""
+        if self.consensus_state is None:
+            return {"enabled": False, "mode": "replica"}
+        return self.consensus_state.handel_status()
 
     def _incidents_status(self) -> dict:
         """/debug/incidents: the incident ledger (libs/incident.py).

@@ -36,6 +36,12 @@ plan phase window (the ledger's own "overdue" verdict) drops network
 health to "moderate" — the fault is gone, but the chain has not
 committed a fresh height to prove it recovered. The view clears with
 the rest of the debug state when the endpoint stops answering.
+
+And /debug/handel: the Handel aggregation overlay (consensus/handel.py).
+A session whose frontier level sat past its timeout surfaces as a
+`[HANDEL STUCK lvl=k]` CLI tag and drops network health to "moderate" —
+the round still commits over the flat-certificate fallback, but the
+O(log n) overlay is limping on a silent subtree.
 """
 
 from __future__ import annotations
@@ -207,6 +213,13 @@ class NodeStatus:
     # recovery that should have happened and didn't
     incidents_open: List[dict] = field(default_factory=list)
     incident_counts: Dict[str, int] = field(default_factory=dict)
+    # Handel overlay view (from /debug/handel, consensus/handel.py):
+    # enabled flag plus the worst stuck level across the node's current
+    # sessions — a nonzero stuck level means a subtree went silent and
+    # the flat-certificate fallback is carrying the round
+    handel_enabled: bool = False
+    handel_stuck_level: int = 0
+    handel_sessions: int = 0
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
@@ -277,6 +290,12 @@ class NodeStatus:
         heal) without the fresh-height commit that closes it — the
         fault engine says the network should have recovered by now."""
         return any(i.get("overdue") for i in self.incidents_open)
+
+    @property
+    def handel_stuck(self) -> bool:
+        """Some Handel session's frontier sat past its level timeout —
+        aggregation is limping on the flat-gossip fallback."""
+        return self.handel_enabled and self.handel_stuck_level > 0
 
     @property
     def abci_degraded(self) -> bool:
@@ -398,6 +417,9 @@ class NodeStatus:
         self.det_lint_unsuppressed = 0
         self.incidents_open = []
         self.incident_counts = {}
+        self.handel_enabled = False
+        self.handel_stuck_level = 0
+        self.handel_sessions = 0
 
     def mark_online(self) -> None:
         now = time.time()
@@ -686,6 +708,20 @@ class Monitor:
             ns.incident_counts = {}
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/handel", timeout=2.0) as r:
+                hd = json.load(r)
+            ns.handel_enabled = bool(hd.get("enabled"))
+            sessions = list(hd.get("sessions") or [])
+            ns.handel_sessions = len(sessions)
+            ns.handel_stuck_level = max(
+                (int(s.get("stuck_level", 0)) for s in sessions),
+                default=0)
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.handel_enabled = False
+            ns.handel_stuck_level = 0
+            ns.handel_sessions = 0
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/rpc", timeout=2.0) as r:
                 rp = json.load(r)
             ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
@@ -758,6 +794,9 @@ class Monitor:
                 # recovery that should have happened and didn't — the
                 # fault is gone but the chain hasn't proven liveness
                 and not any(n.incident_overdue for n in online)
+                # a stuck Handel frontier means aggregation fell back
+                # to flat certificate gossip — alive, but not "full"
+                and not any(n.handel_stuck for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -848,6 +887,10 @@ class Monitor:
                     "incidents_open": list(n.incidents_open),
                     "incident_counts": dict(n.incident_counts),
                     "incident_overdue": n.incident_overdue,
+                    "handel_enabled": n.handel_enabled,
+                    "handel_stuck_level": n.handel_stuck_level,
+                    "handel_sessions": n.handel_sessions,
+                    "handel_stuck": n.handel_stuck,
                 }
                 for n in self.nodes.values()
             ],
@@ -917,6 +960,9 @@ def main(argv=None) -> int:
                                  f" age={i.get('age_s', 0):.0f}s"
                                  + (" OVERDUE" if i.get("overdue")
                                     else "") + "]")
+                    if n["handel_stuck"]:
+                        line += (f" [HANDEL STUCK"
+                                 f" lvl={n['handel_stuck_level']}]")
                     if n["abci_degraded"]:
                         bad = ",".join(
                             f"{k}={v}" for k, v in n["abci_conns"].items()

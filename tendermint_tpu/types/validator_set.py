@@ -270,21 +270,14 @@ class ValidatorSet:
         mask, psum_tally = self._run_batch_verify(bv, entries, block_id)
         self._finish_commit_verify(mask, psum_tally, entries, block_id)
 
-    def verify_commit_aggregate(self, chain_id: str, block_id: BlockID,
-                                height: int, commit) -> None:
-        """Verify an AggregateCommit: structural checks, the voting-power
-        tally over the signer bitmap, then ONE fast_aggregate_verify
-        (bitmap->aggregate-pubkey MSM + a 2-pairing product check)
-        instead of N signature checks.
-
-        PoP note: rogue-key safety for the aggregate check rests on
-        proof-of-possession at key REGISTRATION time (genesis validation
-        / the app's validator updates); a valset reaching this method is
-        hash-chained from that trust root, so the per-call registry
-        check is skipped (require_pop=False)."""
-        from ..crypto import batch as crypto_batch
-        from ..crypto import bls
-
+    def _gate_commit_aggregate(self, chain_id: str, block_id: BlockID,
+                               height: int, commit):
+        """Crypto-free front of aggregate-commit verification: structural
+        checks and the voting-power tally over the signer bitmap. Returns
+        (pubkeys, sign_bytes) ready for the pairing check; raises
+        ErrInvalidCommit subclasses on any gate failure — an
+        under-powered or malformed certificate must not cost a
+        pairing."""
         if commit.signers.size() != len(self.validators):
             raise ErrInvalidCommit(
                 f"invalid aggregate commit: {commit.signers.size()} signer "
@@ -302,13 +295,29 @@ class ValidatorSet:
                 val = self.validators[idx]
                 pubkeys.append(val.pub_key.bytes())
                 tallied += val.voting_power
-        # cheap power gate FIRST: an under-powered certificate must not
-        # cost a pairing
         if 3 * tallied <= 2 * self.total_voting_power():
             raise ErrNotEnoughVotingPower(
                 f"invalid aggregate commit: tallied {tallied} <= 2/3 of "
                 f"{self.total_voting_power()}")
-        msg = commit.sign_bytes(chain_id)
+        return pubkeys, commit.sign_bytes(chain_id)
+
+    def verify_commit_aggregate(self, chain_id: str, block_id: BlockID,
+                                height: int, commit) -> None:
+        """Verify an AggregateCommit: structural checks, the voting-power
+        tally over the signer bitmap, then ONE fast_aggregate_verify
+        (bitmap->aggregate-pubkey MSM + a 2-pairing product check)
+        instead of N signature checks.
+
+        PoP note: rogue-key safety for the aggregate check rests on
+        proof-of-possession at key REGISTRATION time (genesis validation
+        / the app's validator updates); a valset reaching this method is
+        hash-chained from that trust root, so the per-call registry
+        check is skipped (require_pop=False)."""
+        from ..crypto import batch as crypto_batch
+        from ..crypto import bls
+
+        pubkeys, msg = self._gate_commit_aggregate(
+            chain_id, block_id, height, commit)
         if not bls.fast_aggregate_verify(pubkeys, msg, commit.agg_sig,
                                          require_pop=False):
             raise ErrInvalidCommitSignatures(
@@ -316,6 +325,39 @@ class ValidatorSet:
         m = crypto_batch.get_metrics()
         if m is not None:
             m.agg_commit_size_bytes.set(commit.size_bytes())
+
+    def verify_commits_aggregate_many(self, chain_id: str, checks):
+        """Batched aggregate-commit verification: checks =
+        [(block_id, height, commit), ...], every certificate against
+        THIS validator set. The per-certificate structural/power gates
+        are exactly verify_commit_aggregate's; the k certificates that
+        survive them collapse into ONE bls.verify_aggregates_many
+        multi-pair product check instead of k sequential 2-pairing
+        checks. Returns one Optional[Exception] per check (None =
+        verified) — the replica catch-up and statesync bisection
+        callers want per-height verdicts, not a first-failure raise."""
+        from ..crypto import bls
+
+        results = [None] * len(checks)
+        idxs = []
+        items = []
+        for i, (block_id, height, commit) in enumerate(checks):
+            try:
+                pubkeys, msg = self._gate_commit_aggregate(
+                    chain_id, block_id, height, commit)
+            except ErrInvalidCommit as e:
+                results[i] = e
+                continue
+            idxs.append(i)
+            items.append((pubkeys, msg, commit.agg_sig))
+        if items:
+            verdicts = bls.verify_aggregates_many(items)
+            for i, ok in zip(idxs, verdicts):
+                if not ok:
+                    results[i] = ErrInvalidCommitSignatures(
+                        "invalid aggregate signature over "
+                        f"{checks[i][2].signers.num_true()} signers")
+        return results
 
     def begin_verify_commit(
         self, chain_id: str, block_id: BlockID, height: int, commit

@@ -410,7 +410,7 @@ def test_flight_recorder_metrics_sink():
 
 _DEBUG_ROUTES = ("consensus", "statesync", "abci", "mempool", "crypto",
                  "rpc", "lockdep", "recovery", "determinism", "exec",
-                 "incidents")
+                 "incidents", "handel")
 
 
 def _scrape(addr, path):

@@ -1,0 +1,428 @@
+"""Handel aggregation overlay (consensus/handel.py) — tree math,
+session state machine (scoring, pruning, timeouts), wire serde, the
+manager round-trip over real BLS keys, and the slow handel_storm chaos
+scenario.
+
+The session tests inject a fake "crypto": a signature is the sorted
+comma-joined signer list, a point is a frozenset of signer indices,
+aggregation is set union, and verification checks the signature names
+exactly the claimed bitmap. That keeps every state-machine branch
+exact and fast; real pairings are covered by the manager test and
+`bench.py handel`'s byte-equality oracle.
+"""
+
+import os
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.consensus.handel import (
+    MIN_CERT_SIGNERS,
+    HandelManager,
+    HandelSession,
+    level_of,
+    level_range,
+    num_levels,
+)
+from tendermint_tpu.consensus.messages import HandelContributionMessage
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types.basic import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    PartSetHeader,
+    canonical_vote_sign_bytes,
+)
+
+
+# --- fake crypto ------------------------------------------------------
+
+
+def _sig(idxs) -> bytes:
+    return b",".join(b"%d" % i for i in sorted(idxs))
+
+
+def _parse(sig):
+    if not sig or sig == b"bad":
+        return None
+    try:
+        return frozenset(int(x) for x in sig.split(b","))
+    except ValueError:
+        return None
+
+
+def _add(a, b):
+    return (a or frozenset()) | (b or frozenset())
+
+
+def _verify(items):
+    return [_parse(sig) == frozenset(idxs) for idxs, sig in items]
+
+
+def _session(n, i, own=True, verify_fn=None, **kw):
+    kw.setdefault("window", 4)
+    kw.setdefault("level_timeout_s", 1.0)
+    return HandelSession(
+        n, i, [1] * n, _sig({i}) if own else None,
+        verify_fn=verify_fn or _verify, parse_fn=_parse, add_fn=_add,
+        compress_fn=_sig, **kw)
+
+
+def _bits(n, idxs) -> BitArray:
+    b = BitArray(n)
+    for i in idxs:
+        b.set_index(i, True)
+    return b
+
+
+# --- tree math --------------------------------------------------------
+
+
+class TestTreeMath:
+    @pytest.mark.parametrize("n", [2, 3, 4, 5, 8, 13, 16, 100, 1024])
+    def test_levels_partition_the_committee(self, n):
+        for i in range(0, n, max(1, n // 7)):
+            seen = set()
+            for l in range(1, num_levels(n) + 1):
+                lo, hi = level_range(i, l, n)
+                group = set(range(lo, hi))
+                assert not (group & seen), "levels must be disjoint"
+                assert i not in group
+                for j in group:
+                    assert level_of(i, j) == l
+                seen |= group
+            assert seen == set(range(n)) - {i}
+
+    def test_level_of_is_symmetric(self):
+        for i in range(16):
+            for j in range(16):
+                if i != j:
+                    assert level_of(i, j) == level_of(j, i)
+        with pytest.raises(ValueError):
+            level_of(3, 3)
+
+    def test_num_levels(self):
+        assert num_levels(1) == 0
+        assert num_levels(2) == 1
+        assert num_levels(8) == 3
+        assert num_levels(9) == 4
+        assert num_levels(1024) == 10
+        assert num_levels(1025) == 11
+
+
+# --- session state machine -------------------------------------------
+
+
+class TestSessionConvergence:
+    @pytest.mark.parametrize("n", [2, 4, 8, 11])
+    def test_full_committee_converges_to_one_certificate(self, n):
+        """All n sessions gossiping to each other produce the SAME
+        full-committee certificate, equal to the flat aggregate of all
+        n signatures — the overlay changes the route, never the
+        bytes."""
+        sessions = [_session(n, i, resend_ticks=1) for i in range(n)]
+        certs = {}
+        now = 0.0
+        for _ in range(12 * max(1, num_levels(n))):
+            now += 0.05
+            sends = [(i, s.tick(now)) for i, s in enumerate(sessions)]
+            for i, batch in sends:
+                for target, level, bits, sig in batch:
+                    sessions[target].add_contributions(
+                        [(i, level, bits, sig)], now)
+            for i, s in enumerate(sessions):
+                c = s.take_certificate()
+                if c is not None:
+                    certs[i] = c
+            if len(certs) == n and all(
+                    b.num_true() == n for b, _ in certs.values()):
+                break
+        assert len(certs) == n
+        flat = _sig(range(n))
+        for bits, sig in certs.values():
+            assert bits.true_indices() == list(range(n))
+            assert sig == flat
+
+    def test_quorum_gating_and_strict_improvement(self):
+        """Certificates only emit past 2/3 power, above MIN_CERT_SIGNERS,
+        and each take is a strict signer-count improvement."""
+        s = _session(4, 0)
+        assert s.take_certificate() is None  # own sig alone: 1 signer
+        s.add_contributions([(1, 1, _bits(4, {1}), _sig({1}))], 0.0)
+        # 2 signers, power 2/4: 3*2 <= 2*4 -> below quorum, no cert
+        assert s.take_certificate() is None
+        s.add_contributions([(2, 2, _bits(4, {2, 3}), _sig({2, 3}))], 0.0)
+        cert = s.take_certificate()
+        assert cert is not None
+        bits, sig = cert
+        assert bits.num_true() == 4 >= MIN_CERT_SIGNERS
+        assert sig == _sig({0, 1, 2, 3})
+        # re-absorbing the same coverage is not an improvement
+        assert s.take_certificate() is None
+
+    def test_contribution_from_session_without_own_signature(self):
+        """A session seeded by an incoming contribution (we have not
+        precommitted yet) still absorbs and relays verified bests; our
+        own bit only appears after set_own_signature late-binds."""
+        s = _session(4, 0, own=False, resend_ticks=1)
+        v, r = s.add_contributions([(1, 1, _bits(4, {1}), _sig({1}))], 0.0)
+        assert (v, r) == (1, 0)
+        sends = s.tick(0.1)
+        assert sends
+        assert all(not bits.get_index(0) for _, _, bits, _ in sends)
+        s.set_own_signature(_sig({0}))
+        assert any(bits.get_index(0) for _, _, bits, _ in s.tick(0.2))
+
+
+class TestSessionGates:
+    def test_structural_garbage_burns_fail_budget_and_prunes(self):
+        calls = []
+
+        def counting_verify(items):
+            calls.append(len(items))
+            return _verify(items)
+
+        s = _session(8, 0, verify_fn=counting_verify, fail_budget=2)
+        # bits outside the level-1 range [1,2): structural, no pairing
+        v, r = s.add_contributions([(1, 1, _bits(8, {2}), _sig({2}))], 0.0)
+        assert (v, r) == (0, 1) and calls == []
+        # second strike prunes origin 1
+        s.add_contributions([(1, 1, _bits(8, {3}), _sig({3}))], 0.0)
+        assert s.pruned_total == 1
+        # pruned origin: dropped unseen, even with a valid payload
+        v, r = s.add_contributions([(1, 1, _bits(8, {1}), _sig({1}))], 0.0)
+        assert (v, r) == (0, 1) and calls == []
+        assert s.levels[1].best_bits is None
+
+    def test_bad_signature_burns_budget_via_verify(self):
+        s = _session(8, 0, fail_budget=1)
+        v, r = s.add_contributions([(1, 1, _bits(8, {1}), b"bad")], 0.0)
+        assert (v, r) == (0, 1)
+        assert s.pruned_total == 1
+
+    def test_wrong_level_and_self_echo_reject_without_budget_burn(self):
+        s = _session(8, 0, fail_budget=1)
+        # origin 1 is level 1 to node 0; claiming level 2 is a routing
+        # error (stale peer map), not garbage: reject, never prune
+        v, r = s.add_contributions([(1, 2, _bits(8, {1}), _sig({1}))], 0.0)
+        assert (v, r) == (0, 1)
+        v, r = s.add_contributions([(0, 1, _bits(8, {0}), _sig({0}))], 0.0)
+        assert (v, r) == (0, 1)
+        assert s.pruned_total == 0
+
+    def test_no_improvement_skips_the_pairing(self):
+        calls = []
+
+        def counting_verify(items):
+            calls.append(len(items))
+            return _verify(items)
+
+        s = _session(8, 0, verify_fn=counting_verify)
+        s.add_contributions([(2, 2, _bits(8, {2, 3}), _sig({2, 3}))], 0.0)
+        assert calls == [1]
+        # an honest re-send of equal coverage: dropped pre-verify
+        v, r = s.add_contributions(
+            [(3, 2, _bits(8, {2, 3}), _sig({2, 3}))], 0.0)
+        assert (v, r) == (0, 0)
+        assert calls == [1]
+
+
+class TestSessionLiveness:
+    def test_level_timeout_unblocks_frontier_and_reports_stuck(self):
+        """A silent level-1 peer delays level 2, it does not freeze it:
+        past the timeout the frontier advances and stuck_level names
+        the hole."""
+        s = _session(8, 0, level_timeout_s=0.5, resend_ticks=1)
+        first = s.tick(0.0)
+        assert {level for _, level, _, _ in first} == {1}
+        assert s.stuck_level(0.3) == 0  # within budget
+        later = s.tick(1.1)  # > 2 timeouts: levels 2 and 3 activate
+        assert {level for _, level, _, _ in later} >= {1, 2, 3}
+        assert s.stuck_level(1.1) == 1
+        # the silent level completing clears the stall
+        s.add_contributions([(1, 1, _bits(8, {1}), _sig({1}))], 1.2)
+        assert s.stuck_level(1.3) == 0
+
+    def test_windows_are_deterministic_from_seed(self):
+        """Same (seed, height, round, index) -> identical candidate
+        walk; a different seed diverges (the replay/determinism
+        contract)."""
+
+        def walk(seed):
+            s = _session(64, 0, seed=seed, height=7, round_=1,
+                         window=2, resend_ticks=1, reshuffle_ticks=2,
+                         level_timeout_s=0.01)
+            out = []
+            for t in range(12):
+                out.append([(j, l) for j, l, _, _ in s.tick(t * 1.0)])
+            return out
+
+        assert walk(5) == walk(5)
+        assert walk(5) != walk(6)
+
+    def test_silent_candidates_drift_down_and_rotate_out(self):
+        s = _session(8, 0, window=1, resend_ticks=1, reshuffle_ticks=100,
+                     level_timeout_s=100.0)
+        [(first_target, _, _, _)] = s.tick(0.0)
+        for t in range(1, 6):
+            s.tick(float(t))
+        lv = s.levels[1]
+        assert lv.score[first_target] < 0  # unanswered contacts drift
+
+
+# --- wire serde -------------------------------------------------------
+
+
+class TestSerde:
+    def test_contribution_roundtrip(self):
+        from tendermint_tpu.consensus.reactor import decode_msg, encode_msg
+
+        bid = BlockID(b"\xaa" * 32, PartSetHeader(3, b"\xbb" * 32))
+        msg = HandelContributionMessage(
+            7, 1, 3, 42, bid, _bits(1024, {1, 5, 999}), b"\x02" + b"\x11" * 95)
+        got = decode_msg(encode_msg(msg))
+        assert isinstance(got, HandelContributionMessage)
+        assert got == msg
+        assert got.signers.true_indices() == [1, 5, 999]
+
+    def test_fan_out_skips_peers_not_advertising_channel(self):
+        # A frame on an undeclared channel is a p2p protocol error that
+        # tears the connection down, so the reactor must never route a
+        # contribution to a [handel]-off peer or replica — even when the
+        # validator-index map points at one.
+        from tendermint_tpu.consensus import reactor as creactor
+
+        sent = []
+
+        def _peer(pid, channels):
+            p = SimpleNamespace(
+                node_info=SimpleNamespace(channels=channels),
+                is_running=lambda: True)
+            p.try_send = (
+                lambda ch, data, _pid=pid: sent.append((_pid, ch)) or True)
+            return p
+
+        stub = SimpleNamespace(
+            _peer_states={
+                "on": SimpleNamespace(
+                    peer=_peer("on", bytes([0x20, 0x24]))),
+                "off": SimpleNamespace(peer=_peer("off", bytes([0x20]))),
+            },
+            _handel_val_peer={1: "off"},
+        )
+        bid = BlockID(b"\xaa" * 32, PartSetHeader(3, b"\xbb" * 32))
+        msg = HandelContributionMessage(
+            7, 1, 3, 42, bid, _bits(8, {1}), b"\x02" + b"\x11" * 95)
+        creactor.ConsensusReactor._handel_fan_out(stub, [(1, msg)])
+        # the mapped peer lacks 0x24 -> target treated as unmapped and
+        # the bootstrap copy goes only to the advertising peer
+        assert sent == [("on", creactor.HANDEL_CHANNEL)]
+
+
+# --- manager round-trip over real BLS ---------------------------------
+
+
+CHAIN = "handel-mgr-chain"
+
+
+def _mgr_committee(n_live=3):
+    from tendermint_tpu import config as cfg_mod
+    from tendermint_tpu.types.validator_set import random_bls_validator_set
+
+    vs, keys = random_bls_validator_set(4, power=10, seed=b"handel-mgr")
+    hcfg = cfg_mod.HandelConfig(
+        enable=True, window=4, level_timeout_ms=100, resend_ticks=1)
+    mgrs = [HandelManager(hcfg, CHAIN, keys[i].pub_key().address())
+            for i in range(n_live)]
+    return vs, keys, mgrs
+
+
+def _precommit(keys, i, bid, height=5, round_=0):
+    sb = canonical_vote_sign_bytes(
+        CHAIN, VOTE_TYPE_PRECOMMIT, height, round_, bid, 0)
+    return SimpleNamespace(height=height, round=round_, block_id=bid,
+                           signature=keys[i].sign(sb))
+
+
+class TestManager:
+    def test_three_of_four_reach_quorum_certificate(self):
+        """3 of 4 real BLS validators (one silent) pump contributions
+        manager-to-manager until a 2/3+ AggregateCommit emerges; the
+        silent subtree costs a level timeout, not liveness."""
+        vs, keys, mgrs = _mgr_committee()
+        bid = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+        for i, m in enumerate(mgrs):
+            assert m.enabled(vs)
+            m.note_own_precommit(_precommit(keys, i, bid), vs)
+        certs, now = [], 0.0
+        for _ in range(60):
+            now += 0.05
+            moved = []
+            for i, m in enumerate(mgrs):
+                moved.extend((t, msg) for t, msg in m.outgoing(vs, 5, now))
+            for target, msg in moved:
+                if target < len(mgrs):
+                    _, _, got = mgrs[target].absorb([msg], vs, 5, now)
+                    certs.extend(got)
+            if certs:
+                break
+        assert certs, "no quorum certificate after 60 ticks"
+        cert = certs[0]
+        assert cert.agg_height == 5 and cert.block_id == bid
+        signers = set(cert.signers.true_indices())
+        assert len(signers) >= 3 and signers <= {0, 1, 2}
+        # the aggregate actually verifies against the committee
+        from tendermint_tpu.crypto import bls
+
+        sb = canonical_vote_sign_bytes(
+            CHAIN, VOTE_TYPE_PRECOMMIT, 5, 0, bid, 0)
+        pks = [vs.validators[k].pub_key.bytes() for k in sorted(signers)]
+        assert bls.fast_aggregate_verify(pks, sb, cert.agg_sig)
+
+    def test_absorb_rejects_when_disabled_or_stale(self):
+        vs, keys, mgrs = _mgr_committee(n_live=1)
+        m = mgrs[0]
+        bid = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+        stale = HandelContributionMessage(
+            3, 0, 1, 1, bid, _bits(4, {1}), b"\x00" * 96)
+        m.note_own_precommit(_precommit(keys, 0, bid), vs)
+        v, r, certs = m.absorb([stale], vs, 5, 0.0)  # height 3 < 5
+        assert (v, r, certs) == (0, 1, [])
+        off = HandelManager(m.cfg.__class__(), CHAIN,
+                            keys[0].pub_key().address())
+        assert not off.enabled(vs)
+        assert off.absorb([stale], vs, 5, 0.0) == (0, 1, [])
+
+    def test_advance_height_gcs_sessions_and_status_reports(self):
+        vs, keys, mgrs = _mgr_committee(n_live=1)
+        m = mgrs[0]
+        bid = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+        m.note_own_precommit(_precommit(keys, 0, bid), vs)
+        st = m.status(0.0)
+        assert st["enabled"] and len(st["sessions"]) == 1
+        sess = st["sessions"][0]
+        assert sess["height"] == 5 and sess["n"] == 4
+        m.outgoing(vs, 5, 0.0)  # first tick starts the level-1 clock
+        assert m.stuck(10.0) >= 1  # nobody answered: frontier stalls
+        m.advance_height(6)
+        assert m.status(0.0)["sessions"] == []
+        assert m.stuck(10.0) == 0
+
+
+# --- the storm scenario (slow: real localnet + 1k phantoms) -----------
+
+
+@pytest.mark.slow
+def test_scenario_handel_storm():
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("handel_storm")
+    assert res["ok"], res
+    assert all(res["handel_enabled"]), res
+    assert res["handel_sessions_seen"] > 0
+    # 1k silent phantoms make the upper levels unfillable: the overlay
+    # MUST report stuck (that is what re-opens flat certificate gossip)
+    assert res["handel_max_stuck_level"] > 0
