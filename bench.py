@@ -67,6 +67,7 @@ DETCHECK_MODE = "detcheck" in sys.argv[1:]  # replay-divergence oracle (PR 15)
 PROPTRACE_MODE = "proptrace" in sys.argv[1:]  # fleet causal tracing (PR 16)
 INCIDENT_MODE = "incident" in sys.argv[1:]  # incident MTTD/MTTR (PR 18)
 HANDEL_MODE = "handel" in sys.argv[1:]  # aggregation overlay (PR 19)
+FLEET_MODE = "fleet" in sys.argv[1:]  # replica fan-out serving (PR 20)
 PIPELINE_FLAG = "--pipeline" in sys.argv[1:]  # fastsync: 2-stage pipeline
 PARALLEL_FLAG = "--parallel" in sys.argv[1:]  # load: parallel exec lanes
 _args = [a for a in sys.argv[1:]
@@ -74,7 +75,8 @@ _args = [a for a in sys.argv[1:]
                       "statesync", "chaos", "load", "preverify",
                       "aggverify", "warmstart", "mega", "chaosnet",
                       "crashrecovery", "detcheck", "proptrace",
-                      "incident", "handel", "--pipeline", "--parallel")]
+                      "incident", "handel", "fleet",
+                      "--pipeline", "--parallel")]
 try:
     METRIC_N = int(_args[0]) if _args else (100000 if MEGA_MODE else 10000)
 except ValueError:
@@ -162,6 +164,13 @@ INCIDENT_METRIC = (
     f"incident_{INCIDENT_NVAL}node_composed_mttr_p50_ms")
 HANDEL_NVAL = _env_int("TM_TPU_BENCH_HANDEL_NVAL", 1024)
 HANDEL_METRIC = f"handel_overlay_{HANDEL_NVAL}val_per_node_verify_ops"
+# replica fan-out tree serving (PR 20): N in-process replicas behind
+# one validator, tiered via [replica] prefer_replicas, answering a
+# round-robin read load while tailing live
+FLEET_REPLICAS = _env_int("TM_TPU_BENCH_FLEET_REPLICAS", 4)
+FLEET_SECS = _env_int("TM_TPU_BENCH_FLEET_SECS", 6)
+FLEET_CLIENTS = _env_int("TM_TPU_BENCH_FLEET_CLIENTS", 8)
+FLEET_METRIC = f"fleet_serve_{FLEET_REPLICAS}replica_tree_rpc_p50_ms"
 
 
 def _best_of(fn, reps: int) -> float:
@@ -2180,6 +2189,199 @@ def incident_main():
     return 0 if ok else 1
 
 
+def fleet_main():
+    """`bench.py fleet` — the replica fan-out tree as a serving
+    benchmark: FLEET_REPLICAS in-process replicas tier up behind ONE
+    validator ([replica] prefer_replicas: deeper replicas tail other
+    replicas, never the validator), then FLEET_CLIENTS round-robin
+    clients hammer the replicas' RPC serving layer for FLEET_SECS while
+    the tree keeps tailing live blocks. The BENCH value is the hot
+    /status p50 across the round-robin load; the oracle gates it on
+    ZERO stale tips (every replica within lag_budget_blocks of the
+    validator tip at the end), every replica parented, and the
+    validator carrying only O(fan-in) peer connections — the point of
+    the tree. Pure host path: no TPU."""
+    import tempfile
+    import threading
+
+    os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+    os.environ.setdefault("TM_TPU_WARMUP", "0")
+
+    from tendermint_tpu import config as cfg
+    from tendermint_tpu.node import default_new_node
+    from tendermint_tpu.p2p import NodeKey
+    from tendermint_tpu.privval import load_or_gen_file_pv
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    n = max(2, FLEET_REPLICAS)
+    tier1_n = min(2, n)
+
+    def _mk_config(root, name, mode):
+        c = cfg.test_config()
+        c.set_root(os.path.join(root, name))
+        c.base.proxy_app = "kvstore"
+        c.base.moniker = name
+        c.base.mode = mode
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.p2p.pex = False
+        c.consensus.create_empty_blocks_interval = 0.5
+        c.statesync.enable = False
+        c.statesync.snapshot_interval = 0
+        c.replica.prefer_replicas = True
+        c.replica.lag_budget_blocks = 8
+        c.replica.silence_budget_s = 5.0
+        cfg.ensure_root(c.root_dir)
+        NodeKey.load_or_gen(c.base.node_key_path())
+        return c
+
+    started = []
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_") as root:
+        vc = _mk_config(root, "fleet-val", "full")
+        pv = load_or_gen_file_pv(vc.base.priv_validator_path())
+        genesis = GenesisDoc(
+            chain_id="bench-fleet",
+            genesis_time=time.time_ns() - 10**9,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        genesis.save(vc.base.genesis_path())
+        validator = default_new_node(vc)
+        validator.start()
+        started.append(validator)
+        try:
+            deadline = time.time() + 60
+            while validator.block_store.height() < 2 \
+                    and time.time() < deadline:
+                time.sleep(0.1)
+            if validator.block_store.height() < 2:
+                raise RuntimeError("validator never warmed")
+            val_peer = (f"{validator.node_key.id}@"
+                        f"{validator.transport.listen_addr}")
+
+            # tier-1 replicas dial the validator; deeper replicas dial
+            # ONLY the tier-1 replicas (prefer_replicas then keeps them
+            # parented inside the tree)
+            replicas = []
+            for i in range(n):
+                c = _mk_config(root, f"fleet-rep{i}", "replica")
+                load_or_gen_file_pv(c.base.priv_validator_path())
+                genesis.save(c.base.genesis_path())
+                if i < tier1_n:
+                    c.p2p.persistent_peers = val_peer
+                else:
+                    c.p2p.persistent_peers = ",".join(
+                        f"{r.node_key.id}@{r.transport.listen_addr}"
+                        for r in replicas[:tier1_n])
+                node = default_new_node(c)
+                node.start()
+                started.append(node)
+                replicas.append(node)
+
+            # the tree settles: every replica parented + tailing near
+            # the validator tip
+            deadline = time.time() + 90
+            settled = False
+            while time.time() < deadline:
+                sts = [r.replica_tree.status() for r in replicas]
+                vh = validator.block_store.height()
+                if (all(not s["orphaned"] for s in sts)
+                        and all(vh - r.block_store.height() <= 3
+                                for r in replicas)):
+                    settled = True
+                    break
+                time.sleep(0.2)
+            if not settled:
+                raise RuntimeError(
+                    "fleet tree never settled: " + json.dumps(
+                        [{"parent": s["parent"][:8],
+                          "lag": s["lag_blocks"]}
+                         for s in (r.replica_tree.status()
+                                   for r in replicas)]))
+
+            # round-robin read load across the replicas' serving layers
+            servers = [r._rpc_server for r in replicas]
+            lats = []
+            lock = threading.Lock()
+            stop_at = time.time() + FLEET_SECS
+
+            def client(k):
+                local = []
+                j = k
+                while time.time() < stop_at:
+                    t0 = time.perf_counter()
+                    servers[j % len(servers)].call_bytes("status", {})
+                    local.append((time.perf_counter() - t0) * 1000)
+                    servers[(j + 1) % len(servers)].call_bytes(
+                        "block", {"height": 1})
+                    j += 1
+                with lock:
+                    lats.extend(local)
+
+            ts = [threading.Thread(target=client, args=(k,))
+                  for k in range(FLEET_CLIENTS)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+
+            sts = [r.replica_tree.status() for r in replicas]
+            budget = sts[0]["lag_budget_blocks"]
+            vh = validator.block_store.height()
+            lags = [max(0, vh - r.block_store.height())
+                    for r in replicas]
+            stale = sum(1 for lag in lags if lag > budget)
+            orphans = sum(1 for s in sts if s["orphaned"])
+            out_p, in_p, _ = validator.sw.num_peers()
+            val_conns = out_p + in_p
+            # per-node subscriber ceiling: children each upstream serves
+            children = {r.node_key.id: 0 for r in replicas}
+            children[validator.node_key.id] = 0
+            for s in sts:
+                if s["parent"] in children:
+                    children[s["parent"]] += 1
+            max_children = max(children.values())
+            depths = [s["depth"] for s in sts]
+
+            s_lats = sorted(lats)
+            p50 = s_lats[len(s_lats) // 2] if s_lats else -1.0
+            p99 = (s_lats[min(len(s_lats) - 1, int(0.99 * len(s_lats)))]
+                   if s_lats else -1.0)
+            ok = bool(stale == 0 and orphans == 0 and s_lats
+                      and val_conns <= tier1_n
+                      and (n <= tier1_n or max(depths) >= 2))
+            _emit({
+                "metric": FLEET_METRIC,
+                "value": round(p50, 3) if ok else -1,
+                "unit": "ms",
+                "vs_baseline": 1.0 if ok else 0.0,
+                "p99_ms": round(p99, 3),
+                "queries": 2 * len(lats),
+                "qps": round(2 * len(lats) / FLEET_SECS, 1),
+                "replicas": n,
+                "clients": FLEET_CLIENTS,
+                "depths": depths,
+                "validator_conns": val_conns,
+                "tier1": tier1_n,
+                "max_children": max_children,
+                "lag_blocks": lags,
+                "lag_budget_blocks": budget,
+                "stale_tips": stale,
+                "orphaned": orphans,
+                "note": ("hot /status p50 over a round-robin read load "
+                         f"across {n} tree replicas; validator serves "
+                         f"{val_conns} conns (O(fan-in), not O(N))"
+                         if ok else "ORACLE FAILED — see stale_tips/"
+                                    "orphaned/validator_conns"),
+            }, None)
+            return 0 if ok else 1
+        finally:
+            for node in reversed(started):
+                try:
+                    node.stop()
+                except Exception:
+                    pass
+
+
 def main():
     n = METRIC_N
     if COMMIT4_MODE:
@@ -2214,6 +2416,9 @@ def main():
     if HANDEL_MODE:
         # in-process overlay simulation: pure host path, no TPU probe
         return handel_main()
+    if FLEET_MODE:
+        # in-process replica tree + serving layer: pure host, no TPU
+        return fleet_main()
     if RPCLOAD_MODE:
         # pure host serving path: no TPU probe
         return rpcload_main()

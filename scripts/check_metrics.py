@@ -321,6 +321,12 @@ REQUIRED_FAMILIES = (
     "handel_contributions_total",
     "handel_verify_seconds",
     "handel_pruned_peers_total",
+    # PR-20 replica fan-out tree (declaration presence: every family
+    # stays silent on full nodes — absence of samples is the
+    # flat-topology signal)
+    "replica_tree_depth",
+    "replica_parent_switches_total",
+    "replica_lag_blocks",
 )
 
 # ...and of those, the hot-path families that must have RECORDED samples

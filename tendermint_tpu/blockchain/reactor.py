@@ -96,6 +96,10 @@ class BlockchainReactor(Reactor):
             request_fn=self._send_block_request,
             error_fn=self._on_peer_error,
         )
+        # replica fan-out tree (attach_tree): when set, only the
+        # current parent's heights feed the pool and every
+        # status_response we send carries the tree meta element
+        self.tree = None
         # push-based tip announcement (enable_tip_announce)
         self._tip_bus = None
         self._tip_sub = None
@@ -148,6 +152,32 @@ class BlockchainReactor(Reactor):
         # request routed to the (dead) pool; re-ask immediately
         self._broadcast_status_request()
 
+    def attach_tree(self, tree) -> None:
+        """Arm the replica fan-out tree (blockchain/replica_tree.py).
+        From here on the pool tails exactly one upstream — the tree's
+        current parent — and re-parenting re-wires the pool: the old
+        parent's in-flight requests redispatch, the new parent's height
+        seeds the pool, and the tail resumes from our own store height
+        (the pool never rewinds)."""
+        self.tree = tree
+        tree.on_switch = self._on_tree_switch
+
+    def _on_tree_switch(self, old_parent, new_parent, reason,
+                        new_height) -> None:
+        if old_parent is not None:
+            self.pool.remove_peer(old_parent)
+        if new_parent is not None and new_height > 0:
+            self.pool.set_peer_height(new_parent, new_height)
+
+    def _status_msg(self) -> bytes:
+        """Our status_response; carries the tree meta element when the
+        fan-out tree is armed (wire-compatible: untreed peers unpack
+        the 2-element form, treed peers tolerate its absence)."""
+        msg = ["status_response", self.store.height()]
+        if self.tree is not None:
+            msg.append(self.tree.local_meta())
+        return _enc(msg)
+
     def enable_tip_announce(self, event_bus) -> None:
         """Arm push-based tip announcement: once started, every
         committed block (NewBlock on the node's event bus — consensus
@@ -183,9 +213,8 @@ class BlockchainReactor(Reactor):
             # a burst coalesces: only the newest tip matters, and the
             # store height is the authoritative one
             if self.switch is not None:
-                self.switch.broadcast(
-                    BLOCKCHAIN_CHANNEL,
-                    _enc(["status_response", self.store.height()]))
+                self.switch.broadcast(BLOCKCHAIN_CHANNEL,
+                                      self._status_msg())
 
     def stop(self) -> None:
         self._stop.set()
@@ -202,11 +231,11 @@ class BlockchainReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         """reactor.go:139-148: tell the new peer our height."""
-        peer.try_send(
-            BLOCKCHAIN_CHANNEL, _enc(["status_response", self.store.height()])
-        )
+        peer.try_send(BLOCKCHAIN_CHANNEL, self._status_msg())
 
     def remove_peer(self, peer, reason) -> None:
+        if self.tree is not None:
+            self.tree.on_peer_removed(peer.id)
         self.pool.remove_peer(peer.id)
 
     # -- inbound -------------------------------------------------------
@@ -232,15 +261,22 @@ class BlockchainReactor(Reactor):
                 peer.try_send(BLOCKCHAIN_CHANNEL, _enc(["no_block_response", height]))
         elif kind == "block_response":
             block = serde.block_from(obj[1])
+            if self.tree is not None:
+                self.tree.note_delivery(peer.id)
             self.pool.add_block(peer.id, block, len(msg_bytes))
         elif kind == "no_block_response":
             LOG.debug("peer %s has no block at %d", peer.id[:8], obj[1])
         elif kind == "status_request":
-            peer.try_send(
-                BLOCKCHAIN_CHANNEL, _enc(["status_response", self.store.height()])
-            )
+            peer.try_send(BLOCKCHAIN_CHANNEL, self._status_msg())
         elif kind == "status_response":
-            self.pool.set_peer_height(peer.id, obj[1])
+            if self.tree is not None:
+                # tree gating: only the (possibly just-adopted) parent
+                # feeds the pool — everyone else is a scored candidate
+                meta = obj[2] if len(obj) > 2 else None
+                if self.tree.note_status(peer.id, obj[1], meta):
+                    self.pool.set_peer_height(peer.id, obj[1])
+            else:
+                self.pool.set_peer_height(peer.id, obj[1])
         else:
             raise ValueError(f"unknown blockchain message {kind!r}")
 
@@ -252,6 +288,8 @@ class BlockchainReactor(Reactor):
             peer.try_send(BLOCKCHAIN_CHANNEL, _enc(["block_request", height]))
 
     def _on_peer_error(self, peer_id: str, reason: str) -> None:
+        if self.tree is not None:
+            self.tree.note_garbage(peer_id)
         if self.switch is not None:
             peer = self.switch.peers.get(peer_id)
             if peer is not None:

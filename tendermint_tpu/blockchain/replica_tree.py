@@ -1,0 +1,398 @@
+"""ReplicaTreeManager — the self-healing replica fan-out tree.
+
+ROADMAP item 3(a): replicas serving read traffic used to hang flat off
+the validators, so validator load grew O(subscribers) and one dead
+tier-1 replica stranded its whole subtree. This module turns the
+serving topology into a scored tree with the same discipline Handel
+(arXiv:1906.05132) applies to aggregation peers: score your upstream
+(delivery rate up, silence/garbage down), abandon it deterministically
+when it dies / partitions / blows the lag budget, and re-attach to the
+best alternate.
+
+Wire surface: the blockchain channel's status exchange grows an
+OPTIONAL third element, ``["status_response", height, meta]`` where
+meta is ``{"mode", "depth", "chain", "base"}`` — the sender's node
+mode, tree depth (validators/full nodes are depth 0), parent chain
+(its own node id first; the cycle check), and block-store base (the
+snapshot horizon a late joiner can still tail from). Nodes without a
+tree manager send the two-element form and absorb the three-element
+one, so the extension is wire-compatible both ways.
+
+Gating: the BlockchainReactor feeds ONLY the current parent's heights
+into its BlockPool, so a tailing replica downloads from exactly one
+upstream; every other peer is just a scored candidate. On re-parent
+the old parent is removed from the pool (in-flight requests
+redispatch) and the tail resumes from the replica's own store height —
+subscribers see one bounded stall, never a disconnect. If the chosen
+alternate's store base is beyond our next height the tail cannot
+resume by block transfer alone; status() raises ``behind_horizon`` so
+operators (and the fleet_heal oracle) see it, and the statesync boot
+path handles the fresh-join case.
+
+Failure taxonomy (the parent_switches_total{reason} label set):
+``attach`` first adoption, ``peer_down`` TCP session died,
+``silence`` no status/delivery inside silence_budget_s (SIGKILL looks
+like this long before TCP notices), ``lag_budget`` parent tip fell
+more than lag_budget_blocks behind the best fleet tip we can see.
+
+Incidents: every orphaning opens a ``replica:<moniker>:<n>`` incident
+(outside the seeded replay surface by uid-prefix design), detection is
+noted at the same instant (the manager IS the detector), heal lands on
+re-parent, and the incident closes at the next fresh store height —
+so the ledger attributes MTTD/MTTR for re-parenting exactly like it
+does for netchaos and storage faults.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+LOG = logging.getLogger("blockchain.replica_tree")
+
+# score deltas, Handel-style: a delivered block is worth one point, a
+# garbage/error event erases four; clamped so one long happy tail
+# cannot bank unbounded forgiveness
+SCORE_DELIVERY = 1.0
+SCORE_GARBAGE = -4.0
+SCORE_MAX = 32.0
+SCORE_MIN = -32.0
+
+SWITCH_REASONS = ("attach", "peer_down", "silence", "lag_budget", "cycle")
+
+# the depth an UNATTACHED replica advertises: it has no upstream
+# feeding its store, so a child adopting it would tail a frozen tip.
+# Any sane max_depth excludes it; once parented it advertises truth.
+UNADOPTABLE_DEPTH = 1 << 20
+
+
+class _Candidate:
+    """One scored upstream candidate (everything we learned from its
+    status exchange plus our delivery bookkeeping)."""
+
+    __slots__ = ("peer_id", "mode", "depth", "chain", "base", "height",
+                 "last_seen", "score", "deliveries", "garbage")
+
+    def __init__(self, peer_id: str, now: float):
+        self.peer_id = peer_id
+        self.mode = "full"
+        self.depth = 0
+        self.chain: List[str] = [peer_id]
+        self.base = 1
+        self.height = 0
+        self.last_seen = now
+        self.score = 0.0
+        self.deliveries = 0
+        self.garbage = 0
+
+
+class ReplicaTreeManager:
+    """Tree membership + scoring + failover for one tailing replica.
+
+    Thread model: note_* / on_peer_removed arrive on p2p receive
+    threads, evaluate() on the node's telemetry ticker — one lock
+    covers all state. The on_switch callback (pool re-wiring) is
+    invoked OUTSIDE the lock so it may call back into note_status.
+    """
+
+    def __init__(self, cfg, node_id: str, moniker: str,
+                 store_height_fn: Callable[[], int],
+                 store_base_fn: Optional[Callable[[], int]] = None,
+                 metrics=None, ledger=None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.cfg = cfg
+        self.node_id = node_id
+        self.moniker = moniker
+        self._store_height = store_height_fn
+        self._store_base = store_base_fn or (lambda: 1)
+        self._metrics = metrics
+        self._ledger = ledger
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._candidates: Dict[str, _Candidate] = {}
+        self.parent_id: Optional[str] = None
+        # the parent we last abandoned, until the next adoption hands
+        # it to on_switch as `old` — the pool must drop the abandoned
+        # upstream even when it is still connected (silence/lag cases)
+        self._prev_parent: Optional[str] = None
+        self._parent_chain: List[str] = []
+        self.depth = 0
+        self._switches = 0
+        self._last_reason = ""
+        self._behind_horizon = False
+        # bounded-exponential re-parent backoff: streak grows per
+        # switch, decays after a stable stretch, and gates BOTH the
+        # soft abandon checks (lag/silence) and orphan re-attach
+        self._streak = 0
+        self._cooldown_until = 0.0
+        self._last_switch_at = 0.0
+        self._incident_seq = 0
+        self._open_uid: Optional[str] = None
+        # (old, new, reason, new_height) -> None; set by the reactor
+        self.on_switch: Optional[Callable[..., None]] = None
+
+    # -- wire ----------------------------------------------------------
+
+    def local_meta(self) -> dict:
+        """The meta element this node appends to its own
+        status_response messages."""
+        with self._lock:
+            return {
+                "mode": "replica",
+                "depth": (self.depth if self.parent_id is not None
+                          else UNADOPTABLE_DEPTH),
+                "chain": [self.node_id] + list(self._parent_chain),
+                "base": self._store_base(),
+            }
+
+    # -- inbound bookkeeping -------------------------------------------
+
+    def note_status(self, peer_id: str, height: int,
+                    meta: Optional[dict]) -> bool:
+        """Absorb one status_response. Returns True iff this peer's
+        height should feed the block pool (it is — or just became —
+        the current parent)."""
+        switch_args = None
+        with self._lock:
+            now = self._clock()
+            c = self._candidates.get(peer_id)
+            if c is None:
+                c = self._candidates[peer_id] = _Candidate(peer_id, now)
+            c.height = max(c.height, int(height))
+            c.last_seen = now
+            if isinstance(meta, dict):
+                c.mode = str(meta.get("mode", "full"))
+                try:
+                    c.depth = int(meta.get("depth", 0))
+                    c.base = int(meta.get("base", 1))
+                except (TypeError, ValueError):
+                    c.depth, c.base = 0, 1
+                chain = meta.get("chain")
+                if isinstance(chain, (list, tuple)):
+                    c.chain = [str(x) for x in chain][:64]
+                else:
+                    c.chain = [peer_id]
+            if self.parent_id is None and now >= self._cooldown_until:
+                # orphan (or fresh boot) and out of backoff: adopt the
+                # best candidate right here — first attach must not
+                # wait out a ticker interval
+                switch_args = self._adopt_locked(now)
+            fed = peer_id == self.parent_id
+        self._fire_switch(switch_args)
+        return fed
+
+    def note_delivery(self, peer_id: str) -> None:
+        with self._lock:
+            c = self._candidates.get(peer_id)
+            if c is not None:
+                c.deliveries += 1
+                c.last_seen = self._clock()
+                c.score = min(SCORE_MAX, c.score + SCORE_DELIVERY)
+
+    def note_garbage(self, peer_id: str) -> None:
+        with self._lock:
+            c = self._candidates.get(peer_id)
+            if c is not None:
+                c.garbage += 1
+                c.score = max(SCORE_MIN, c.score + SCORE_GARBAGE)
+
+    def on_peer_removed(self, peer_id: str) -> None:
+        switch_args = None
+        with self._lock:
+            self._candidates.pop(peer_id, None)
+            if peer_id == self.parent_id:
+                now = self._clock()
+                self._orphan_locked("peer_down", now)
+                # a hard disconnect bypasses the soft-abandon cooldown:
+                # there is nothing left to be patient with
+                if now >= self._cooldown_until:
+                    switch_args = self._adopt_locked(now)
+        self._fire_switch(switch_args)
+
+    # -- the periodic evaluation (telemetry ticker) --------------------
+
+    def evaluate(self) -> None:
+        """Budget enforcement + orphan re-attach. Called periodically
+        (the node's telemetry ticker); cheap when healthy."""
+        switch_args = None
+        with self._lock:
+            now = self._clock()
+            if self._ledger is not None:
+                # closes any healed replica incident once the tail
+                # commits a height fresh past the heal point
+                self._ledger.note_commit(self._store_height())
+            if (self._streak and self._last_switch_at
+                    and now - self._last_switch_at
+                    > 4 * self.cfg.reparent_backoff_max_s):
+                self._streak = 0  # stable stretch: forgive the past
+            if self.parent_id is not None and now >= self._cooldown_until:
+                reason = self._parent_fault_locked(now)
+                if reason is not None:
+                    self._orphan_locked(reason, now)
+            if self.parent_id is None and now >= self._cooldown_until:
+                switch_args = self._adopt_locked(now)
+            self._export_locked()
+        self._fire_switch(switch_args)
+
+    def _parent_fault_locked(self, now: float) -> Optional[str]:
+        c = self._candidates.get(self.parent_id)
+        if c is None:
+            return "peer_down"
+        if self.node_id in c.chain:
+            # the parent's advertised ancestry now runs through US: a
+            # tail cycle formed while chains were still propagating
+            # (both ends adopted each other before either knew). Nobody
+            # inside a cycle ever sees a new block — break it here.
+            return "cycle"
+        if now - c.last_seen > self.cfg.silence_budget_s:
+            return "silence"
+        best = self._best_tip_locked()
+        if best - c.height > self.cfg.lag_budget_blocks:
+            return "lag_budget"
+        return None
+
+    def _best_tip_locked(self) -> int:
+        best = self._store_height()
+        for c in self._candidates.values():
+            if c.height > best:
+                best = c.height
+        return best
+
+    def lag_blocks(self) -> int:
+        """Our tip age against the best fleet tip we can see."""
+        with self._lock:
+            return max(0, self._best_tip_locked() - self._store_height())
+
+    # -- selection -----------------------------------------------------
+
+    def _eligible_locked(self, now: float) -> List[_Candidate]:
+        out = []
+        horizon = 3 * self.cfg.silence_budget_s
+        for c in self._candidates.values():
+            if self.node_id in c.chain:
+                continue  # would create a cycle through us
+            if c.depth + 1 > self.cfg.max_depth:
+                continue
+            if now - c.last_seen > horizon:
+                continue  # long-stale record: don't chase ghosts
+            out.append(c)
+        if self.cfg.prefer_replicas:
+            reps = [c for c in out if c.mode == "replica"]
+            if reps:
+                return reps
+        return out
+
+    def _adopt_locked(self, now: float):
+        """Pick the best eligible candidate deterministically: score
+        desc, depth asc (shallower = shorter propagation path), then
+        peer id. Returns the on_switch args or None."""
+        cands = self._eligible_locked(now)
+        if not cands:
+            self._arm_backoff_locked(now)
+            return None
+        best = min(cands, key=lambda c: (-c.score, c.depth, c.peer_id))
+        old = self.parent_id or self._prev_parent
+        self._prev_parent = None
+        reason = self._last_reason or "attach"
+        self.parent_id = best.peer_id
+        self._parent_chain = list(best.chain)
+        self.depth = best.depth + 1
+        self._behind_horizon = best.base > self._store_height() + 1
+        self._switches += 1
+        self._last_switch_at = now
+        self._arm_backoff_locked(now)
+        if self._metrics is not None:
+            self._metrics.parent_switches_total.with_labels(reason).inc()
+        if self._ledger is not None and self._open_uid is not None:
+            self._ledger.note_heal(self._open_uid, new_parent=best.peer_id,
+                                   depth=self.depth)
+            self._open_uid = None
+        if self._behind_horizon:
+            LOG.warning(
+                "re-parented to %s but its store base %d is past our "
+                "height %d — tail cannot resume by block transfer; "
+                "statesync bisection required",
+                best.peer_id[:8], best.base, self._store_height())
+        LOG.info("replica parent -> %s (reason=%s depth=%d)",
+                 best.peer_id[:8], reason, self.depth)
+        self._last_reason = reason
+        return (old, best.peer_id, reason, best.height)
+
+    def _orphan_locked(self, reason: str, now: float) -> None:
+        old = self.parent_id
+        self._prev_parent = old or self._prev_parent
+        self.parent_id = None
+        self._parent_chain = []
+        self._last_reason = reason
+        if self._ledger is not None and self._open_uid is None:
+            self._incident_seq += 1
+            uid = f"replica:{self.moniker}:{self._incident_seq}"
+            self._open_uid = uid
+            self._ledger.open_incident(uid, "replica_orphan",
+                                       reason=reason, parent=old or "")
+            # the manager is its own detector: the instant it classes
+            # the parent dead IS the detection (MTTD from the fault's
+            # own injection entry when the scenario seeded one)
+            self._ledger.note_detection("replica_orphan", reason=reason)
+        LOG.warning("replica orphaned (reason=%s, was parent %s)",
+                    reason, (old or "?")[:8])
+
+    def _arm_backoff_locked(self, now: float) -> None:
+        delay = min(self.cfg.reparent_backoff_max_s,
+                    self.cfg.reparent_backoff_base_s * (2 ** self._streak))
+        self._streak += 1
+        self._cooldown_until = now + delay
+
+    def _fire_switch(self, args) -> None:
+        if args is not None and self.on_switch is not None:
+            try:
+                self.on_switch(*args)
+            except Exception:
+                LOG.exception("on_switch callback failed")
+
+    # -- export --------------------------------------------------------
+
+    def _export_locked(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.tree_depth.set(self.depth if self.parent_id else 0)
+        lag = max(0, self._best_tip_locked() - self._store_height())
+        self._metrics.lag_blocks.set(lag)
+
+    def status(self) -> dict:
+        """The /debug/replica payload (and the /status sync_info
+        fields): parent, depth, lag, switch history, candidate view."""
+        with self._lock:
+            now = self._clock()
+            cands = sorted(
+                ({"peer": c.peer_id, "mode": c.mode, "depth": c.depth,
+                  "height": c.height, "score": c.score,
+                  "age_s": round(now - c.last_seen, 3)}
+                 for c in self._candidates.values()),
+                key=lambda d: d["peer"])
+            return {
+                "enabled": True,
+                "mode": "replica",
+                "parent": self.parent_id or "",
+                "orphaned": self.parent_id is None,
+                "depth": self.depth if self.parent_id else 0,
+                "chain": [self.node_id] + list(self._parent_chain),
+                "lag_blocks": max(0, self._best_tip_locked()
+                                  - self._store_height()),
+                "switches": self._switches,
+                "last_reason": self._last_reason,
+                "behind_horizon": self._behind_horizon,
+                "prefer_replicas": self.cfg.prefer_replicas,
+                "max_depth": self.cfg.max_depth,
+                "lag_budget_blocks": self.cfg.lag_budget_blocks,
+                "candidates": cands,
+            }
+
+    def is_replica_peer(self, peer_id: str) -> bool:
+        """Statesync peer preference: did this peer advertise replica
+        mode in its status meta?"""
+        with self._lock:
+            c = self._candidates.get(peer_id)
+            return c is not None and c.mode == "replica"

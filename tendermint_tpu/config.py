@@ -409,6 +409,38 @@ class HandelConfig:
 
 
 @dataclass
+class ReplicaConfig:
+    """[replica] — the self-healing replica fan-out tree
+    (blockchain/replica_tree.py; ours, no reference equivalent). Only
+    meaningful with [base] mode = "replica"; full nodes ignore it.
+
+    prefer_replicas: statesync-boot from and tail OTHER REPLICAS when
+    any are reachable, falling back to validators only when no replica
+    peer qualifies — validators then serve O(fan-in) tier-1 replicas
+    instead of O(subscribers). Off (default) keeps the flat PR-9
+    topology where every replica hangs off the validators.
+    max_depth: deepest tree position this replica will accept (our
+    depth = chosen parent's depth + 1; validators/full nodes are depth
+    0). A candidate whose adoption would exceed this is ineligible.
+    lag_budget_blocks: tip age (best fleet tip minus parent tip, via
+    the PR-13 push announce) past which the parent is declared lagging
+    and abandoned. Also the oracle bound chaos scenarios assert on.
+    silence_budget_s: seconds without any status/delivery from the
+    parent before it is scored dead (SIGKILL shows up as silence long
+    before the TCP session dies).
+    reparent_backoff_base_s/_max_s: bounded exponential backoff
+    between re-parenting attempts — the same discipline as [abci]
+    redials, so a flapping fleet cannot make an orphan thrash."""
+
+    prefer_replicas: bool = False
+    max_depth: int = 4
+    lag_budget_blocks: int = 8
+    silence_budget_s: float = 10.0
+    reparent_backoff_base_s: float = 0.5
+    reparent_backoff_max_s: float = 8.0
+
+
+@dataclass
 class TxIndexConfig:
     """reference config/config.go:723-760"""
 
@@ -480,6 +512,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     handel: HandelConfig = field(default_factory=HandelConfig)
+    replica: ReplicaConfig = field(default_factory=ReplicaConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
@@ -527,6 +560,7 @@ class Config:
             emit("statesync", self.statesync),
             emit("chaos", self.chaos),
             emit("handel", self.handel),
+            emit("replica", self.replica),
             emit("storage", self.storage),
             emit("tx_index", self.tx_index),
             emit("instrumentation", self.instrumentation),
@@ -552,6 +586,7 @@ class Config:
             "statesync": cfg.statesync,
             "chaos": cfg.chaos,
             "handel": cfg.handel,
+            "replica": cfg.replica,
             "storage": cfg.storage,
             "tx_index": cfg.tx_index,
             "instrumentation": cfg.instrumentation,

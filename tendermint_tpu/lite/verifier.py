@@ -224,6 +224,61 @@ class BaseVerifier:
             raise ErrLiteVerification(str(e))
 
 
+def certify_many(chain_id: str, pairs):
+    """Batched BaseVerifier.verify across HETEROGENEOUS validator sets:
+    pairs = [(valset, signed_header), ...]. Each pair runs the exact
+    crypto-free BaseVerifier prefix (validate_basic, valset-hash check,
+    aggregate structural/power gate); the aggregate certificates that
+    survive collapse into ONE bls.verify_aggregates_many multi-pair
+    product check instead of k sequential 2-pairing checks — the
+    statesync anchor pair (H against its own set, H+1 against H's next
+    set) is the canonical caller (ROADMAP 2a tail). Non-aggregate
+    commits fall back to the plain BaseVerifier per pair. Returns one
+    Optional[ErrLiteVerification] per pair (None = certified)."""
+    from ..crypto import bls
+    from ..types.block import AggregateCommit
+
+    results = [None] * len(pairs)
+    idxs, items = [], []
+    for i, (valset, signed_header) in enumerate(pairs):
+        try:
+            signed_header.validate_basic(chain_id)
+        except ValueError as e:
+            results[i] = ErrLiteVerification(str(e))
+            continue
+        if signed_header.header.validators_hash != valset.hash():
+            results[i] = ErrUnknownValidators(
+                f"unknown validators at height {signed_header.height}")
+            continue
+        commit = signed_header.commit
+        if not isinstance(commit, AggregateCommit):
+            try:
+                BaseVerifier(chain_id, signed_header.height,
+                             valset).verify(signed_header)
+            except ErrLiteVerification as e:
+                results[i] = e
+            continue
+        try:
+            pubkeys, msg = valset._gate_commit_aggregate(
+                chain_id, commit.block_id, signed_header.height, commit)
+        except ErrInvalidCommit as e:
+            results[i] = ErrLiteVerification(str(e))
+            continue
+        idxs.append(i)
+        items.append((pubkeys, msg, commit.agg_sig))
+    if items:
+        # PoP note: same trust argument as verify_commit_aggregate —
+        # possession was proven at key registration, and every valset
+        # reaching this function is hash-chained from the trust root
+        oks = bls.verify_aggregates_many(items, require_pop=False)
+        for i, ok in zip(idxs, oks):
+            if not ok:
+                results[i] = ErrLiteVerification(
+                    "invalid aggregate signature at height "
+                    f"{pairs[i][1].height}")
+    return results
+
+
 def _validate_full(fc, chain_id: str) -> None:
     """validate_full with the lite error contract: structural failures
     from a (possibly malicious) source are verification failures."""
@@ -252,31 +307,38 @@ class DynamicVerifier:
 
     def verify(self, signed_header: SignedHeader) -> None:
         """dynamic_verifier.go Verify:74-120."""
+        vals = self.resolve_valset(signed_header)
+        BaseVerifier(self.chain_id, signed_header.height,
+                     vals).verify(signed_header)
+
+    def resolve_valset(self, signed_header: SignedHeader) -> ValidatorSet:
+        """The valset-establishment half of verify(): walk/bisect until
+        a trusted set proves the header's validators_hash, and return
+        that set WITHOUT paying the terminal commit check — callers
+        batching several terminal certificates (lite.certify_many)
+        resolve first, then collapse the pairings into one call."""
         h = signed_header.height
         trusted_fc = self.trusted.latest_full_commit(self.chain_id, h)
         if trusted_fc is None:
             raise ErrLiteVerification("no trusted full commit; call "
                                       "init_trust first")
         if trusted_fc.height == h:
-            vals = trusted_fc.validators
-        elif (trusted_fc.next_validators is not None
-              and trusted_fc.next_validators.hash()
-              == signed_header.header.validators_hash):
+            return trusted_fc.validators
+        if (trusted_fc.next_validators is not None
+                and trusted_fc.next_validators.hash()
+                == signed_header.header.validators_hash):
             # immediately-next height: next valset is already proven
-            vals = trusted_fc.next_validators
-        else:
-            self._update_to_height(h, signed_header)
-            trusted_fc = self.trusted.latest_full_commit(self.chain_id, h)
-            if (trusted_fc.height == h):
-                vals = trusted_fc.validators
-            elif (trusted_fc.next_validators is not None
-                  and trusted_fc.next_validators.hash()
-                  == signed_header.header.validators_hash):
-                vals = trusted_fc.next_validators
-            else:
-                raise ErrUnknownValidators(
-                    f"cannot establish validators for height {h}")
-        BaseVerifier(self.chain_id, h, vals).verify(signed_header)
+            return trusted_fc.next_validators
+        self._update_to_height(h, signed_header)
+        trusted_fc = self.trusted.latest_full_commit(self.chain_id, h)
+        if trusted_fc.height == h:
+            return trusted_fc.validators
+        if (trusted_fc.next_validators is not None
+                and trusted_fc.next_validators.hash()
+                == signed_header.header.validators_hash):
+            return trusted_fc.next_validators
+        raise ErrUnknownValidators(
+            f"cannot establish validators for height {h}")
 
     def _update_to_height(self, h: int,
                           signed_header: SignedHeader) -> None:

@@ -383,6 +383,24 @@ class HandelMetrics:
 
 
 @dataclass
+class ReplicaMetrics:
+    """Replica fan-out tree telemetry (ours;
+    blockchain/replica_tree.py). All families stay silent on full
+    nodes and on replicas without a tree manager — absence is the
+    flat-topology signal."""
+
+    # this replica's current tree depth (0 while orphaned; validators
+    # and full nodes are depth 0 by definition)
+    tree_depth: object = NOP
+    # parent re-adoptions, by reason
+    # (attach | peer_down | silence | lag_budget)
+    parent_switches_total: object = NOP
+    # tip age: best fleet tip this replica can see minus its own
+    # store height
+    lag_blocks: object = NOP
+
+
+@dataclass
 class NodeMetrics:
     consensus: ConsensusMetrics = field(default_factory=ConsensusMetrics)
     p2p: P2PMetrics = field(default_factory=P2PMetrics)
@@ -398,6 +416,7 @@ class NodeMetrics:
         default_factory=DeterminismMetrics)
     incident: IncidentMetrics = field(default_factory=IncidentMetrics)
     handel: HandelMetrics = field(default_factory=HandelMetrics)
+    replica: ReplicaMetrics = field(default_factory=ReplicaMetrics)
     registry: Optional[Registry] = None
 
 
@@ -816,8 +835,21 @@ def prometheus_metrics(namespace: str = "tendermint") -> NodeMetrics:
             "Handel candidates pruned after exhausting their garbage "
             "fail budget."),
     )
+    replica = ReplicaMetrics(
+        tree_depth=r.gauge(
+            f"{ns}_replica_tree_depth",
+            "This replica's current fan-out tree depth (0 while "
+            "orphaned; validators are depth 0)."),
+        parent_switches_total=r.counter(
+            f"{ns}_replica_parent_switches_total",
+            "Replica parent re-adoptions, by reason.", ("reason",)),
+        lag_blocks=r.gauge(
+            f"{ns}_replica_lag_blocks",
+            "Tip age: best fleet tip this replica can see minus its "
+            "own store height."),
+    )
     return NodeMetrics(consensus=cons, p2p=p2p, abci=abci_m, mempool=mem,
                        state=state, crypto=crypto, statesync=statesync,
                        rpc=rpc, lockdep=lockdep, recovery=recovery,
                        determinism=determinism, incident=incident,
-                       handel=handel, registry=r)
+                       handel=handel, replica=replica, registry=r)

@@ -410,6 +410,7 @@ class Node:
         # blockchain reactor tails blocks forever and a channel
         # absorber keeps the p2p protocol intact for validator peers
         self._consensus_absorber = None
+        self.replica_tree = None
         if self.mode == "full":
             wal = None
             if config.consensus.wal_path:
@@ -478,6 +479,17 @@ class Node:
                 fast_sync and not state_sync,
                 tail_forever=True,
             )
+            # the self-healing fan-out tree (blockchain/replica_tree.py):
+            # scores upstream candidates from the status exchange, gates
+            # the pool to exactly one parent, and re-parents on
+            # death / partition / blown lag budget
+            from ..blockchain.replica_tree import ReplicaTreeManager
+
+            self.replica_tree = ReplicaTreeManager(
+                config.replica, node_key.id, config.base.moniker,
+                self.block_store.height, self.block_store.base,
+                metrics=self.metrics.replica, ledger=self.incidents)
+            self.blockchain_reactor.attach_tree(self.replica_tree)
 
         # --- tx indexer (node/node.go:329-349) -----------------------
         if config.tx_index.indexer == "kv":
@@ -649,11 +661,20 @@ class Node:
         if state_sync:
             from ..statesync.restore import StateSyncer
 
+            # [replica] prefer_replicas: boot from replica-served
+            # snapshots (the tree manager knows which peers advertised
+            # replica mode), falling back to validators only when no
+            # replica qualifies
+            prefer = None
+            if (self.replica_tree is not None
+                    and config.replica.prefer_replicas):
+                prefer = self.replica_tree.is_replica_peer
             self.state_syncer = StateSyncer(
                 self.snapshot_reactor, genesis_doc, self.state_db,
                 self.block_store, self.proxy_app.query,
                 config.statesync, metrics=self.metrics.statesync,
-                on_complete=self._on_statesync_complete)
+                on_complete=self._on_statesync_complete,
+                peer_preference=prefer)
 
         # PEX reactor + address book (node/node.go:417-464)
         self.pex_reactor = None
@@ -840,6 +861,10 @@ class Node:
                 if peer_h > 0:
                     m.peer_lag_blocks.with_labels(p.id).set(
                         max(0, our_height - peer_h))
+        if self.replica_tree is not None:
+            # the fan-out tree's budget enforcement (lag/silence) and
+            # orphan re-attach ride the same telemetry cadence
+            self.replica_tree.evaluate()
 
     def _start_rpc(self) -> None:
         from ..rpc.cache import RPCCache
@@ -930,6 +955,7 @@ class Node:
                 "/debug/exec": lambda q: self._exec_status(),
                 "/debug/incidents": lambda q: self._incidents_status(),
                 "/debug/handel": lambda q: self._handel_status(),
+                "/debug/replica": lambda q: self._replica_status(),
             },
             identity={"node_id": self.node_key.id,
                       "moniker": self.config.base.moniker},
@@ -946,6 +972,15 @@ class Node:
         if self.consensus_state is None:
             return {"enabled": False, "mode": "replica"}
         return self.consensus_state.handel_status()
+
+    def _replica_status(self) -> dict:
+        """/debug/replica: the fan-out tree view (parent, depth, lag,
+        switch history, candidate scores). Registered in BOTH modes —
+        the fleettrace provider contract requires an identical route
+        surface — and reports {"enabled": false} on full nodes."""
+        if self.replica_tree is None:
+            return {"enabled": False, "mode": self.mode}
+        return self.replica_tree.status()
 
     def _incidents_status(self) -> dict:
         """/debug/incidents: the incident ledger (libs/incident.py).

@@ -218,6 +218,25 @@ def status(env: RPCEnvironment, params: dict) -> dict:
     catching_up = getattr(bcr, "catching_up", None)
     if catching_up is None:
         catching_up = getattr(bcr, "fast_sync", False)
+    sync_info = {
+        "latest_block_hash": enc.hexu(latest_hash),
+        "latest_app_hash": enc.hexu(latest_app_hash),
+        "latest_block_height": str(latest_height),
+        "latest_block_time": str(latest_time),
+        # lowest height with a full block on disk: > 1 on pruned or
+        # state-synced nodes (reference v0.34 earliest_* fields)
+        "earliest_block_height": str(env.block_store.base()),
+        "catching_up": catching_up,
+    }
+    tree = getattr(env.node, "replica_tree", None)
+    if tree is not None:
+        # fan-out tree position (replicas only; generational cache
+        # keeps these at most one block generation stale, same as
+        # latest_block_height)
+        ts = tree.status()
+        sync_info["replica_parent"] = ts["parent"]
+        sync_info["replica_tree_depth"] = ts["depth"]
+        sync_info["replica_lag_blocks"] = ts["lag_blocks"]
     return {
         "node_info": {
             "id": node_info.id,
@@ -232,16 +251,7 @@ def status(env: RPCEnvironment, params: dict) -> dict:
                 "app": str(node_info.protocol_version.app),
             },
         },
-        "sync_info": {
-            "latest_block_hash": enc.hexu(latest_hash),
-            "latest_app_hash": enc.hexu(latest_app_hash),
-            "latest_block_height": str(latest_height),
-            "latest_block_time": str(latest_time),
-            # lowest height with a full block on disk: > 1 on pruned or
-            # state-synced nodes (reference v0.34 earliest_* fields)
-            "earliest_block_height": str(env.block_store.base()),
-            "catching_up": catching_up,
-        },
+        "sync_info": sync_info,
         "validator_info": {
             "address": enc.hexu(env.pub_key.address()) if env.pub_key else "",
             "pub_key": (

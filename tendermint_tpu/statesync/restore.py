@@ -44,7 +44,8 @@ from ..abci import types as abci
 from ..libs import fail, tracing
 from ..lite.provider import MemProvider, Provider
 from ..lite.types import FullCommit
-from ..lite.verifier import BaseVerifier, DynamicVerifier, ErrLiteVerification
+from ..lite.verifier import (BaseVerifier, DynamicVerifier,
+                             ErrLiteVerification, certify_many)
 from ..state import store as sm_store
 from ..state.state import State
 from ..types.validator_set import ValidatorSet
@@ -96,7 +97,13 @@ class _PeerSource(Provider):
 class StateSyncer:
     def __init__(self, reactor, genesis_doc, state_db, block_store,
                  app_conn, statesync_config, metrics=None,
-                 on_complete=None):
+                 on_complete=None, peer_preference=None):
+        """peer_preference: optional predicate(peer_id) -> bool marking
+        PREFERRED snapshot sources ([replica] prefer_replicas: replicas
+        that advertised replica mode in the blockchain status exchange).
+        Preferred peers rank first in candidate selection, anchor
+        fetches, and chunk workers, so a joining replica boots from the
+        fan-out tree and validators serve O(fan-in)."""
         self.reactor = reactor
         self.genesis_doc = genesis_doc
         self.state_db = state_db
@@ -105,6 +112,7 @@ class StateSyncer:
         self.cfg = statesync_config
         self.metrics = metrics
         self.on_complete = on_complete
+        self.peer_preference = peer_preference
         self.chain_id = genesis_doc.chain_id
 
         self._thread: Optional[threading.Thread] = None
@@ -287,16 +295,31 @@ class StateSyncer:
                 entry[1].append(pid)
         ranked = sorted(
             by_key.values(),
-            key=lambda sp: (sp[0].height, len(sp[1])), reverse=True)
-        return ranked
+            key=lambda sp: (self._pref_count(sp[1]) > 0, sp[0].height,
+                            len(sp[1])), reverse=True)
+        return [(s, self._order_peers(pids)) for s, pids in ranked]
+
+    def _pref_count(self, peer_ids: List[str]) -> int:
+        if self.peer_preference is None:
+            return 0
+        return sum(1 for p in peer_ids if self.peer_preference(p))
+
+    def _order_peers(self, peer_ids: List[str]) -> List[str]:
+        """Stable: preferred (replica) sources first, original order
+        within each class — validators only serve when no replica can."""
+        if self.peer_preference is None:
+            return list(peer_ids)
+        return sorted(peer_ids,
+                      key=lambda p: not self.peer_preference(p))
 
     # -- verify --------------------------------------------------------
 
     def _live_peers(self, peer_ids: List[str]) -> List[str]:
         sw = self.reactor.switch
-        return [p for p in peer_ids
-                if p not in self._banned
-                and (sw is None or sw.peers.has(p))]
+        return self._order_peers(
+            [p for p in peer_ids
+             if p not in self._banned
+             and (sw is None or sw.peers.has(p))])
 
     def _verify_anchor(self, snap: abci.Snapshot, peer_ids: List[str]):
         """Light-verify headers H and H+1; returns (fc_H, fc_H1,
@@ -330,8 +353,24 @@ class StateSyncer:
                     fc.validate_full(self.chain_id)
                 except ValueError as e:
                     raise ErrLiteVerification(str(e))
-                verifier.verify(fc.signed_header)
-                trusted.save_full_commit(fc)
+            if fc_h.next_validators is None:
+                raise ErrLiteVerification("anchor missing next "
+                                          "validators at H")
+            # resolve H's valset via the bisection walk, then collapse
+            # BOTH terminal certificates — H against its own set, H+1
+            # against H's next set (hash-checked inside certify_many) —
+            # into ONE multi-pair product check instead of two
+            # sequential pairing contexts (ROADMAP 2a tail)
+            vals_h = verifier.resolve_valset(fc_h.signed_header)
+            errs = certify_many(self.chain_id, [
+                (vals_h, fc_h.signed_header),
+                (fc_h.next_validators, fc_h1.signed_header),
+            ])
+            for err in errs:
+                if err is not None:
+                    raise err
+            trusted.save_full_commit(fc_h)
+            trusted.save_full_commit(fc_h1)
         except ErrLiteVerification as e:
             raise RestoreError(f"anchor light-verification failed: {e}")
 

@@ -42,6 +42,12 @@ A session whose frontier level sat past its timeout surfaces as a
 `[HANDEL STUCK lvl=k]` CLI tag and drops network health to "moderate" —
 the round still commits over the flat-certificate fallback, but the
 O(log n) overlay is limping on a silent subtree.
+
+And /debug/replica: the replica fan-out tree (blockchain/replica_tree.py).
+A replica whose switch counter advanced since the last poll gets a
+`[REPARENTED reason=..]` tag; one with no parent at all gets
+`[REPLICA ORPHANED]` and drops network health — it keeps answering
+/status, but at a height nothing is feeding any more.
 """
 
 from __future__ import annotations
@@ -220,6 +226,21 @@ class NodeStatus:
     handel_enabled: bool = False
     handel_stuck_level: int = 0
     handel_sessions: int = 0
+    # replica fan-out tree view (from /debug/replica,
+    # blockchain/replica_tree.py): parent/depth/lag position plus the
+    # switch counter — a replica with NO parent is serving ever-staler
+    # reads while still answering /status at its frozen height
+    replica_enabled: bool = False
+    replica_parent: str = ""
+    replica_orphaned: bool = False
+    replica_depth: int = 0
+    replica_lag_blocks: int = 0
+    replica_switches: int = 0
+    replica_last_reason: str = ""
+    # switches advanced during THIS poll interval -> [REPARENTED] tag;
+    # -1 = no baseline yet (first poll never tags)
+    replica_reparented: bool = False
+    _replica_prev_switches: int = -1
 
     RESTORE_STUCK_S = 30.0
     # ingest queue occupancy past this fraction of capacity counts as
@@ -296,6 +317,26 @@ class NodeStatus:
         """Some Handel session's frontier sat past its level timeout —
         aggregation is limping on the flat-gossip fallback."""
         return self.handel_enabled and self.handel_stuck_level > 0
+
+    @property
+    def replica_orphan(self) -> bool:
+        """A tree replica with no parent: it keeps answering /status
+        (at a freezing height) but nothing feeds its tail."""
+        return self.replica_enabled and self.replica_orphaned
+
+    def note_replica(self, data: dict) -> None:
+        self.replica_enabled = bool(data.get("enabled", False))
+        self.replica_parent = str(data.get("parent", ""))
+        self.replica_orphaned = bool(data.get("orphaned", False))
+        self.replica_depth = int(data.get("depth", 0))
+        self.replica_lag_blocks = int(data.get("lag_blocks", 0))
+        switches = int(data.get("switches", 0))
+        self.replica_last_reason = str(data.get("last_reason", ""))
+        self.replica_reparented = (
+            self._replica_prev_switches >= 0
+            and switches > self._replica_prev_switches)
+        self._replica_prev_switches = switches
+        self.replica_switches = switches
 
     @property
     def abci_degraded(self) -> bool:
@@ -420,6 +461,15 @@ class NodeStatus:
         self.handel_enabled = False
         self.handel_stuck_level = 0
         self.handel_sessions = 0
+        self.replica_enabled = False
+        self.replica_parent = ""
+        self.replica_orphaned = False
+        self.replica_depth = 0
+        self.replica_lag_blocks = 0
+        self.replica_switches = 0
+        self.replica_last_reason = ""
+        self.replica_reparented = False
+        self._replica_prev_switches = -1
 
     def mark_online(self) -> None:
         now = time.time()
@@ -722,6 +772,21 @@ class Monitor:
             ns.handel_sessions = 0
         try:
             with urllib.request.urlopen(
+                    f"http://{daddr}/debug/replica", timeout=2.0) as r:
+                rep = json.load(r)
+            ns.note_replica(rep)
+        except Exception:  # noqa: BLE001 - older nodes lack the route
+            ns.replica_enabled = False
+            ns.replica_parent = ""
+            ns.replica_orphaned = False
+            ns.replica_depth = 0
+            ns.replica_lag_blocks = 0
+            ns.replica_switches = 0
+            ns.replica_last_reason = ""
+            ns.replica_reparented = False
+            ns._replica_prev_switches = -1
+        try:
+            with urllib.request.urlopen(
                     f"http://{daddr}/debug/rpc", timeout=2.0) as r:
                 rp = json.load(r)
             ns.note_rpc(rp.get("ws") or {}, rp.get("cache") or {})
@@ -797,6 +862,9 @@ class Monitor:
                 # a stuck Handel frontier means aggregation fell back
                 # to flat certificate gossip — alive, but not "full"
                 and not any(n.handel_stuck for n in online)
+                # an orphaned tree replica answers /status at a
+                # freezing height: nothing feeds its tail
+                and not any(n.replica_orphan for n in online)
                 and max((n.max_peer_lag for n in online), default=0) <= 1):
             return HEALTH_FULL
         return HEALTH_MODERATE
@@ -891,6 +959,14 @@ class Monitor:
                     "handel_stuck_level": n.handel_stuck_level,
                     "handel_sessions": n.handel_sessions,
                     "handel_stuck": n.handel_stuck,
+                    "replica_enabled": n.replica_enabled,
+                    "replica_parent": n.replica_parent,
+                    "replica_orphaned": n.replica_orphaned,
+                    "replica_depth": n.replica_depth,
+                    "replica_lag_blocks": n.replica_lag_blocks,
+                    "replica_switches": n.replica_switches,
+                    "replica_last_reason": n.replica_last_reason,
+                    "replica_reparented": n.replica_reparented,
                 }
                 for n in self.nodes.values()
             ],
@@ -963,6 +1039,14 @@ def main(argv=None) -> int:
                     if n["handel_stuck"]:
                         line += (f" [HANDEL STUCK"
                                  f" lvl={n['handel_stuck_level']}]")
+                    if n["replica_enabled"]:
+                        line += (f" tree=d{n['replica_depth']}"
+                                 f" rlag={n['replica_lag_blocks']}")
+                    if n["replica_reparented"]:
+                        line += (" [REPARENTED reason="
+                                 f"{n['replica_last_reason']}]")
+                    if n["replica_orphaned"] and n["replica_enabled"]:
+                        line += " [REPLICA ORPHANED]"
                     if n["abci_degraded"]:
                         bad = ",".join(
                             f"{k}={v}" for k, v in n["abci_conns"].items()

@@ -46,6 +46,17 @@ Catalog (run one with `python -m tendermint_tpu.tools.scenarios NAME
                            judged by the fleet-stitched incident report
                            (every phase attributed, MTTD/MTTR
                            published, seeded ledger byte-replayable)
+  fleet_heal               MULTI-PROCESS: a replica fan-out tree (one
+                           validator, two tier-1 replicas, deeper
+                           replicas tailing replicas) under composed
+                           chaos — SIGKILL one tier-1 parent AND
+                           config-loaded [chaos] partition of the
+                           other from the validator; every orphan must
+                           re-parent, the fleet must agree on one
+                           hash, no replica may serve a tip past the
+                           lag budget at the end, and each replica's
+                           incident ledger must attribute the orphan
+                           MTTD/MTTR
 
 The fault timeline is a pure function of the seed (see p2p/netchaos.py);
 `bench.py chaosnet` reports partition_heal's recovery latency as a
@@ -1349,6 +1360,324 @@ def incident(seed: int = 9, n: int = 4, tmp_root: str = "",
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
         for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+@_scenario
+def fleet_heal(seed: int = 11, replicas: int = 4, tmp_root: str = "",
+               fault_s: float = 8.0, chaos_at_s: float = 25.0,
+               lag_budget: int = 6) -> dict:
+    """The self-healing replica fan-out tree under composed chaos, over
+    REAL node subprocesses: one validator produces blocks; rep0 and
+    rep1 tail it at depth 1; every deeper replica dials ONLY the tier-1
+    replicas ([replica] prefer_replicas keeps it parented inside the
+    tree, never on the validator). Two faults compose: the orchestrator
+    SIGKILLs whichever tier-1 replica actually fathered the deep
+    replicas (their first eligible status wins adoption, so which of
+    rep0/rep1 gets the children is connection-order dependent — the
+    kill follows the tree, guaranteeing real orphans), and BOTH tier-1
+    replicas boot with a config-loaded [chaos] plan partitioning them
+    from the validator for `fault_s` seconds on their own fault clocks,
+    so the SURVIVING tier-1 parent also loses its upstream mid-run (it
+    must classify the dead feed, ride out the window — its only visible
+    candidates are its own adopted children, which the cycle check
+    forbids — and re-adopt the validator after the heal). Oracle: every
+    orphan re-parents (no replica ends the run orphaned, nobody still
+    claims the killed parent, the survivor is back on the validator),
+    the validator and every live replica agree on ONE block hash at a
+    common height, no replica serves a tip more than `lag_budget`
+    blocks stale at the end, and each orphaned replica's own incident
+    ledger attributes the event (a replica_orphan detection — matched
+    to the seeded net: injection on the partitioned survivor, to its
+    own replica: incident elsewhere — and a recovery with MTTR,
+    nothing left open)."""
+    import shutil
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    from ..p2p import NodeKey
+    from ..privval import load_or_gen_file_pv
+
+    n_rep = max(3, replicas)
+    own_tmp = None
+    if not tmp_root:
+        own_tmp = tempfile.TemporaryDirectory(prefix="fleet_heal_")
+        tmp_root = own_tmp.name
+    out_dir = os.path.join(tmp_root, "net")
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    env = dict(os.environ, TM_TPU_CRYPTO_BACKEND="cpu",
+               JAX_PLATFORMS="cpu", TM_TPU_WARMUP="0")
+    # ports[0] = validator, ports[1..] = replicas; (rpc, p2p, prof)
+    ports = [(free_port(), free_port(), free_port())
+             for _ in range(1 + n_rep)]
+    subprocess.run(
+        [sys.executable, "-m", "tendermint_tpu.cmd.main", "testnet",
+         "--v", "1", "--o", out_dir, "--chain-id", "fleetnet",
+         "--starting-port", "1"],
+        check=True, env=env, capture_output=True)
+
+    val_home = os.path.join(out_dir, "node0")
+    val_id = NodeKey.load(
+        os.path.join(val_home, "config", "node_key.json")).id
+    c = cfg.Config.load(os.path.join(val_home, "config", "config.toml"))
+    c.set_root(val_home)
+    c.base.db_backend = "filedb"
+    c.consensus = cfg.test_config().consensus
+    c.consensus.timeout_commit = 0.3
+    c.consensus.skip_timeout_commit = False
+    c.consensus.wal_path = "data/cs.wal/wal"
+    c.rpc.laddr = f"tcp://127.0.0.1:{ports[0][0]}"
+    c.p2p.laddr = f"tcp://127.0.0.1:{ports[0][1]}"
+    c.p2p.pex = False
+    c.base.prof_laddr = f"tcp://127.0.0.1:{ports[0][2]}"
+    c.save(os.path.join(val_home, "config", "config.toml"))
+
+    # replica homes: keys first (peer strings need every id), then
+    # configs. rep0/rep1 are tier-1 (dial the validator); the rest dial
+    # ONLY the two tier-1 replicas — rep1 is every orphan's alternate.
+    rep_ids = []
+    for i in range(n_rep):
+        home = os.path.join(out_dir, f"rep{i}")
+        rc = cfg.test_config()
+        rc.set_root(home)
+        cfg.ensure_root(home)
+        rep_ids.append(NodeKey.load_or_gen(
+            rc.base.node_key_path()).id)
+        load_or_gen_file_pv(rc.base.priv_validator_path())
+        shutil.copy(os.path.join(val_home, "config", "genesis.json"),
+                    rc.base.genesis_path())
+    for i in range(n_rep):
+        home = os.path.join(out_dir, f"rep{i}")
+        rc = cfg.test_config()
+        rc.set_root(home)
+        rc.base.mode = "replica"
+        rc.base.moniker = f"rep{i}"
+        rc.base.db_backend = "filedb"
+        rc.rpc.laddr = f"tcp://127.0.0.1:{ports[1 + i][0]}"
+        rc.p2p.laddr = f"tcp://127.0.0.1:{ports[1 + i][1]}"
+        rc.p2p.pex = False
+        rc.base.prof_laddr = f"tcp://127.0.0.1:{ports[1 + i][2]}"
+        rc.statesync.enable = False
+        rc.statesync.snapshot_interval = 0
+        rc.replica.prefer_replicas = True
+        rc.replica.lag_budget_blocks = lag_budget
+        rc.replica.silence_budget_s = 2.0
+        rc.replica.reparent_backoff_base_s = 0.25
+        rc.replica.reparent_backoff_max_s = 2.0
+        if i < 2:
+            rc.p2p.persistent_peers = f"{val_id}@127.0.0.1:{ports[0][1]}"
+        else:
+            rc.p2p.persistent_peers = ",".join(
+                f"{rep_ids[j]}@127.0.0.1:{ports[1 + j][1]}"
+                for j in range(2))
+        if i < 2:
+            plan = netchaos.FaultPlan(seed=seed)
+            plan.add(chaos_at_s, chaos_at_s + fault_s,
+                     netchaos.partition(frozenset([rep_ids[i]]),
+                                        frozenset([val_id])))
+            _write_chaos_plan(home, plan, rc)
+        rc.save(os.path.join(home, "config", "config.toml"))
+
+    def start_node(home: str):
+        log = open(os.path.join(home, "node.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tendermint_tpu.cmd.main",
+             "--home", home, "node", "--proxy_app", "kvstore"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        log.close()
+        return proc
+
+    from ..rpc.client import HTTPClient
+
+    def height_of(slot: int) -> int:
+        try:
+            st = HTTPClient(f"127.0.0.1:{ports[slot][0]}",
+                            timeout=2.0).status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:  # noqa: BLE001 - down/booting
+            return -1
+
+    def block_hash(slot: int, h: int):
+        try:
+            b = HTTPClient(f"127.0.0.1:{ports[slot][0]}",
+                           timeout=2.0).block(h)
+            return b["block_meta"]["block_id"]["hash"]
+        except Exception:  # noqa: BLE001
+            return None
+
+    def replica_view(i: int) -> dict:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports[1 + i][2]}/debug/replica",
+                    timeout=2.0) as r:
+                return json.load(r)
+        except Exception:  # noqa: BLE001 - down/booting
+            return {}
+
+    result = {"scenario": "fleet_heal", "seed": seed,
+              "replicas": n_rep, "fault_s": fault_s,
+              "chaos_at_s": chaos_at_s, "lag_budget": lag_budget}
+    procs: Dict[str, subprocess.Popen] = {}
+    try:
+        procs["val"] = start_node(val_home)
+        tier1_boot = {}
+        for i in range(n_rep):
+            procs[f"rep{i}"] = start_node(
+                os.path.join(out_dir, f"rep{i}"))
+            if i < 2:
+                tier1_boot[i] = time.time()
+
+        # warm: every replica parented and the fleet tailing
+        deadline = time.time() + WARM_TIMEOUT + chaos_at_s
+        warmed = False
+        while time.time() < deadline:
+            views = [replica_view(i) for i in range(n_rep)]
+            if (height_of(0) >= 3
+                    and all(v.get("parent") for v in views)):
+                warmed = True
+                break
+            time.sleep(0.25)
+        if not warmed:
+            result.update(converged=False, ok=False,
+                          error="tree never warmed/parented")
+            return result
+        parents_before = {i: replica_view(i).get("parent", "")
+                          for i in range(n_rep)}
+
+        # fault 1: SIGKILL the tier-1 replica that fathered the deep
+        # replicas (the kill follows the tree so the orphan set is
+        # never empty); the other tier-1 replica survives to catch them
+        children = {0: [i for i in range(2, n_rep)
+                        if parents_before[i] == rep_ids[0]],
+                    1: [i for i in range(2, n_rep)
+                        if parents_before[i] == rep_ids[1]]}
+        kill = 0 if len(children[0]) >= len(children[1]) else 1
+        surv = 1 - kill
+        procs[f"rep{kill}"].send_signal(signal.SIGKILL)
+        procs[f"rep{kill}"].wait(timeout=10)
+        orphans = children[kill]
+        live = [i for i in range(n_rep) if i != kill]
+
+        # fault 2 rides the survivor's own fault clock ([chaos] plan
+        # armed at boot): wait out its partition window plus slack
+        heal_at = tier1_boot[surv] + chaos_at_s + fault_s
+        while time.time() < heal_at + 2.0:
+            time.sleep(0.5)
+
+        # every orphan re-parents: nobody still claims the killed
+        # parent, nobody ends orphaned, the surviving tier-1 replica is
+        # back on the validator
+        deadline = time.time() + CONVERGE_TIMEOUT
+        healed = False
+        views = {}
+        while time.time() < deadline:
+            views = {i: replica_view(i) for i in live}
+            if (all(v.get("parent")
+                    and v["parent"] != rep_ids[kill]
+                    and not v.get("orphaned", True)
+                    for v in views.values())
+                    and views[surv].get("parent") == val_id):
+                healed = True
+                break
+            time.sleep(0.5)
+
+        # convergence + freshness: live replicas within the lag budget
+        # of the validator tip, one hash at a common height
+        stale = []
+        h_common = None
+        hashes = set()
+        if healed:
+            deadline = time.time() + CONVERGE_TIMEOUT
+            while time.time() < deadline:
+                vh = height_of(0)
+                lags = {i: max(0, vh - height_of(1 + i)) for i in live}
+                if vh > 0 and all(lag <= lag_budget
+                                  for lag in lags.values()):
+                    stale = []
+                    break
+                stale = [f"rep{i}" for i, lag in lags.items()
+                         if lag > lag_budget]
+                time.sleep(0.5)
+            h_common = min(height_of(1 + i) for i in live) - 1
+            h_common = min(h_common, height_of(0) - 1)
+            hashes = {block_hash(0, h_common)} | {
+                block_hash(1 + i, h_common) for i in live}
+        safety_ok = len(hashes) == 1 and None not in hashes
+
+        # each orphaned replica's own ledger attributes the event
+        attribution = {}
+        mttd_all, mttr_all = [], []
+        for i in live:
+            st = _scrape_incidents(ports[1 + i][2])
+            # the manager IS the detector: its detection entries carry
+            # kind replica_orphan; on the partitioned survivor they
+            # match the seeded net: injection (cross-attribution — the
+            # tree classified the injected fault), elsewhere their own
+            # replica: incident
+            det = [e for e in st.get("entries", [])
+                   if e["category"] == "detection"
+                   and e.get("kind") == "replica_orphan"]
+            rec = [e for e in st.get("entries", [])
+                   if e["category"] == "recovery"
+                   and str(e.get("uid", "")).startswith("replica:")]
+            mttd_all.extend(e["detail"].get("mttd_s") for e in det)
+            mttr_all.extend(e["detail"].get("mttr_s") for e in rec)
+            attribution[f"rep{i}"] = {
+                "detections": len(det), "recoveries": len(rec),
+                "open": len(st.get("open", []))}
+        was_orphaned = sorted(set(
+            [f"rep{i}" for i in orphans] + [f"rep{surv}"]))
+        attributed_ok = all(
+            attribution.get(r, {}).get("detections", 0) >= 1
+            and attribution.get(r, {}).get("recoveries", 0) >= 1
+            and attribution.get(r, {}).get("open", 1) == 0
+            for r in was_orphaned)
+
+        result.update(
+            converged=healed and not stale,
+            reparented_ok=healed,
+            killed=f"rep{kill}", survivor=f"rep{surv}",
+            killed_parent_children=[f"rep{i}" for i in orphans],
+            parents_before={f"rep{i}": p[:8]
+                            for i, p in parents_before.items()},
+            parents_after={f"rep{i}": v.get("parent", "")[:8]
+                           for i, v in views.items()},
+            switches={f"rep{i}": v.get("switches")
+                      for i, v in views.items()},
+            stale_tips=stale,
+            common_height=h_common,
+            safety_ok=safety_ok,
+            attributed_ok=attributed_ok,
+            attribution=attribution,
+            mttd_s=[round(v, 3) for v in mttd_all if v is not None],
+            mttr_s=[round(v, 3) for v in mttr_all if v is not None],
+            heights=[height_of(s) for s in range(1 + n_rep)],
+            classified_ok=attributed_ok,
+            ok=bool(healed and not stale and safety_ok
+                    and attributed_ok))
+        return result
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
             try:
                 p.wait(timeout=15)
             except subprocess.TimeoutExpired:

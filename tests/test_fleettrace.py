@@ -410,7 +410,7 @@ def test_flight_recorder_metrics_sink():
 
 _DEBUG_ROUTES = ("consensus", "statesync", "abci", "mempool", "crypto",
                  "rpc", "lockdep", "recovery", "determinism", "exec",
-                 "incidents", "handel")
+                 "incidents", "handel", "replica")
 
 
 def _scrape(addr, path):
@@ -448,6 +448,24 @@ def _assert_provider_contract(addr, node_id, mode):
                                  "recent"}
     assert set(ex["retry"]) == {"retry_rounds_p99", "retried_txs",
                                 "steals", "steal_ratio"}
+
+    rep = first["replica"]
+    if mode == "replica":
+        # the fan-out tree view: full payload, even with no peers yet
+        assert set(rep) == {"enabled", "mode", "parent", "orphaned",
+                            "depth", "chain", "lag_blocks", "switches",
+                            "last_reason", "behind_horizon",
+                            "prefer_replicas", "max_depth",
+                            "lag_budget_blocks", "candidates"}, (
+            mode, sorted(rep))
+        assert rep["enabled"] is True and rep["mode"] == "replica"
+        assert rep["orphaned"] is True and rep["parent"] == ""
+        assert rep["candidates"] == []
+    else:
+        # full/validator nodes answer the route but stay disabled, so
+        # fleet scrapers never special-case node modes
+        assert rep["enabled"] is False
+        assert "mode" in rep
 
     clk = _scrape(addr, "/debug/clock")
     assert set(clk) == {"wall_s", "mono_ns", "identity"}

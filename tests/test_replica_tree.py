@@ -1,0 +1,518 @@
+"""Replica fan-out tree tests (PR 20): ReplicaTreeManager selection /
+budgets / failover / backoff with a fake clock, reactor wire + pool
+gating, incident-ledger attribution, the [replica] config roundtrip,
+certify_many equivalence against sequential BaseVerifier.verify, and
+(slow) the multi-process fleet_heal chaos scenario.
+"""
+
+import os
+import types
+
+os.environ.setdefault("TM_TPU_CRYPTO_BACKEND", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+from tendermint_tpu.blockchain.replica_tree import ReplicaTreeManager
+from tendermint_tpu.config import Config, ReplicaConfig
+from tendermint_tpu.libs.incident import IncidentLedger
+from tendermint_tpu.lite import (
+    BaseVerifier,
+    ErrLiteVerification,
+    ErrUnknownValidators,
+    SignedHeader,
+)
+from tendermint_tpu.lite.verifier import certify_many
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_mgr(clock=None, ledger=None, height=5, base=1, **cfg_kw):
+    cfg_kw.setdefault("prefer_replicas", True)
+    cfg_kw.setdefault("max_depth", 4)
+    cfg_kw.setdefault("lag_budget_blocks", 8)
+    cfg_kw.setdefault("silence_budget_s", 10.0)
+    cfg_kw.setdefault("reparent_backoff_base_s", 0.5)
+    cfg_kw.setdefault("reparent_backoff_max_s", 8.0)
+    cfg = ReplicaConfig(**cfg_kw)
+    clock = clock or FakeClock()
+    h = {"height": height}
+    mgr = ReplicaTreeManager(
+        cfg, "self-node", "rep-test",
+        store_height_fn=lambda: h["height"],
+        store_base_fn=lambda: base,
+        ledger=ledger, clock=clock)
+    return mgr, clock, h
+
+
+def meta(mode="replica", depth=0, chain=None, base=1, peer="p"):
+    return {"mode": mode, "depth": depth,
+            "chain": chain if chain is not None else [peer], "base": base}
+
+
+# --- selection ---------------------------------------------------------
+
+
+def test_first_status_adopts_immediately():
+    mgr, clock, _ = make_mgr()
+    fed = mgr.note_status("val-a", 10, None)  # 2-element wire form
+    assert fed is True  # adopted inline, heights feed the pool
+    s = mgr.status()
+    assert s["parent"] == "val-a" and s["orphaned"] is False
+    assert s["depth"] == 1 and s["last_reason"] == "attach"
+    assert s["switches"] == 1
+
+
+def test_adoption_deterministic_score_depth_peer_order():
+    # same score: shallower depth wins; same depth: lexical peer id
+    mgr, clock, _ = make_mgr()
+    mgr.note_status("val-a", 10, meta(depth=1, peer="val-a"))
+    clock.t += 2.0  # past the attach backoff
+    # register a shallower and a lexically-smaller same-depth candidate
+    mgr.note_status("rep-z", 10, meta(depth=0, peer="rep-z"))
+    mgr.note_status("rep-b", 10, meta(depth=1, peer="rep-b"))
+    mgr.on_peer_removed("val-a")  # hard death: immediate failover
+    assert mgr.status()["parent"] == "rep-z"  # depth 0 beats depth 1
+    clock.t += 10.0
+    mgr.on_peer_removed("rep-z")
+    assert mgr.status()["parent"] == "rep-b"  # only candidate left
+
+    # score dominates depth: garbage-scored shallow loses to clean deep
+    mgr2, clock2, _ = make_mgr()
+    mgr2.note_status("shallow", 10, meta(depth=0, peer="shallow"))
+    assert mgr2.status()["parent"] == "shallow"
+    mgr2.note_garbage("shallow")  # -4 < 0
+    mgr2.on_peer_removed("nobody")  # no-op: not the parent
+    clock2.t += 11.0  # shallow past the 10s silence budget ...
+    mgr2.note_status("deep", 12, meta(depth=2, peer="deep"))  # ... deep fresh
+    mgr2.evaluate()
+    s = mgr2.status()
+    assert s["parent"] == "deep" and s["last_reason"] == "silence"
+
+
+def test_prefer_replicas_and_validator_fallback():
+    # a replica candidate wins over a full node even when deeper ...
+    mgr, clock, _ = make_mgr(prefer_replicas=True)
+    mgr.note_status("val-a", 10, meta(mode="full", depth=0, peer="val-a"))
+    clock.t += 2.0
+    mgr.note_status("rep-a", 10, meta(mode="replica", depth=1, peer="rep-a"))
+    mgr.on_peer_removed("val-a")
+    mgr.note_status("val-a", 10, meta(mode="full", depth=0, peer="val-a"))
+    assert mgr.status()["parent"] == "rep-a"
+
+    # ... but when every replica candidate is our own child (cycle) the
+    # filter falls back to the validator — the fleet_heal re-adoption
+    clock.t += 10.0
+    mgr.note_status(
+        "rep-child", 10,
+        meta(mode="replica", depth=2,
+             chain=["rep-child", "self-node", "val-a"], peer="rep-child"))
+    mgr.on_peer_removed("rep-a")
+    s = mgr.status()
+    assert s["parent"] == "val-a" and s["last_reason"] == "peer_down"
+
+
+def test_cycle_and_depth_budget_exclusion():
+    mgr, clock, _ = make_mgr(max_depth=2, prefer_replicas=False)
+    # cycle: our node id in the candidate's parent chain
+    mgr.note_status("loop", 10, meta(chain=["loop", "self-node"], peer="loop"))
+    assert mgr.status()["orphaned"] is True
+    # depth: candidate at depth 2 would put us at 3 > max_depth 2
+    clock.t += 10.0
+    mgr.note_status("deep", 10, meta(depth=2, peer="deep"))
+    assert mgr.status()["orphaned"] is True
+    # a depth-1 candidate is fine
+    clock.t += 10.0
+    mgr.note_status("ok", 10, meta(depth=1, peer="ok"))
+    s = mgr.status()
+    assert s["parent"] == "ok" and s["depth"] == 2
+
+
+# --- budgets + failover ------------------------------------------------
+
+
+def test_unattached_replica_advertises_unadoptable_depth():
+    from tendermint_tpu.blockchain.replica_tree import UNADOPTABLE_DEPTH
+    mgr, clock, _ = make_mgr()
+    # no parent: our own meta must not look adoptable (a child would
+    # tail a frozen store) ...
+    assert mgr.local_meta()["depth"] == UNADOPTABLE_DEPTH
+    # ... and an unattached replica peer is never adopted, even with
+    # prefer_replicas on: the validator fallback wins
+    mgr.note_status("orphan-rep", 10,
+                    meta(depth=UNADOPTABLE_DEPTH, peer="orphan-rep"))
+    assert mgr.status()["orphaned"] is True
+    clock.t += 10.0
+    mgr.note_status("val", 10, meta(mode="full", depth=0, peer="val"))
+    assert mgr.status()["parent"] == "val"
+    assert mgr.local_meta()["depth"] == 1  # parented: advertise truth
+
+
+def test_cycle_on_current_parent_is_broken():
+    # both ends adopted each other before either's chain propagated;
+    # the next status exchange reveals the loop and evaluate() breaks it
+    mgr, clock, _ = make_mgr(prefer_replicas=False)
+    mgr.note_status("p", 10, meta(peer="p"))
+    assert mgr.status()["parent"] == "p"
+    clock.t += 2.0
+    mgr.note_status("q", 10, meta(peer="q"))
+    mgr.note_status("p", 10, meta(chain=["p", "self-node"], peer="p"))
+    mgr.evaluate()
+    s = mgr.status()
+    assert s["parent"] == "q" and s["last_reason"] == "cycle"
+
+
+def test_lag_budget_orphans_and_readopts():
+    mgr, clock, _ = make_mgr(lag_budget_blocks=8, height=5)
+    mgr.note_status("laggy", 10, meta(peer="laggy"))
+    assert mgr.status()["parent"] == "laggy"
+    clock.t += 2.0
+    # a fresher fleet tip appears: laggy is now 12 blocks behind
+    mgr.note_status("fresh", 22, meta(peer="fresh"))
+    assert mgr.status()["lag_blocks"] == 17  # vs our own height 5
+    mgr.evaluate()
+    s = mgr.status()
+    assert s["parent"] == "fresh" and s["last_reason"] == "lag_budget"
+    assert s["switches"] == 2
+
+
+def test_peer_down_fires_on_switch_callback():
+    mgr, clock, _ = make_mgr()
+    fired = []
+    mgr.on_switch = lambda *a: fired.append(a)
+    mgr.note_status("a", 10, meta(peer="a"))
+    clock.t += 2.0
+    mgr.note_status("b", 15, meta(peer="b"))
+    mgr.on_peer_removed("a")
+    assert fired[0] == (None, "a", "attach", 10)
+    assert fired[1] == ("a", "b", "peer_down", 15)
+
+
+def test_backoff_bounded_exponential_and_streak_decay():
+    mgr, clock, _ = make_mgr(reparent_backoff_base_s=0.5,
+                             reparent_backoff_max_s=8.0)
+    # no candidates at all: each evaluate() arms a growing backoff
+    delays = []
+    for _ in range(8):
+        before = clock.t
+        mgr.evaluate()
+        delays.append(mgr._cooldown_until - before)
+        clock.t = mgr._cooldown_until + 0.01
+    assert delays[0] == 0.5 and delays[1] == 1.0 and delays[2] == 2.0
+    assert max(delays) == 8.0 and delays[-1] == 8.0  # clamped at max
+    # a stable stretch (> 4x backoff_max after a switch) forgives it
+    mgr.note_status("a", 10, meta(peer="a"))
+    assert mgr.status()["parent"] == "a"
+    clock.t += 4 * 8.0 + 1.0
+    mgr.note_status("a", 11, meta(peer="a"))
+    mgr.evaluate()
+    assert mgr._streak <= 1  # decayed, then re-armed at most once
+
+
+def test_behind_horizon_flag():
+    mgr, clock, _ = make_mgr(height=5)
+    # parent's store base is past our next height: tail cannot resume
+    # by block transfer, statesync bisection required
+    mgr.note_status("pruned", 100, meta(base=50, peer="pruned"))
+    s = mgr.status()
+    assert s["parent"] == "pruned" and s["behind_horizon"] is True
+    clock.t += 2.0
+    mgr.note_status("deep-store", 100, meta(base=1, peer="deep-store"))
+    mgr.on_peer_removed("pruned")
+    assert mgr.status()["behind_horizon"] is False
+
+
+# --- payloads ----------------------------------------------------------
+
+
+def test_status_and_local_meta_payloads():
+    mgr, clock, _ = make_mgr()
+    s = mgr.status()
+    assert set(s) == {"enabled", "mode", "parent", "orphaned", "depth",
+                      "chain", "lag_blocks", "switches", "last_reason",
+                      "behind_horizon", "prefer_replicas", "max_depth",
+                      "lag_budget_blocks", "candidates"}
+    assert s["enabled"] is True and s["mode"] == "replica"
+    assert s["orphaned"] is True and s["chain"] == ["self-node"]
+    mgr.note_status("v", 9, meta(depth=1, chain=["v", "root"], peer="v"))
+    m = mgr.local_meta()
+    assert m == {"mode": "replica", "depth": 2,
+                 "chain": ["self-node", "v", "root"], "base": 1}
+    cands = mgr.status()["candidates"]
+    assert [c["peer"] for c in cands] == ["v"]
+    assert set(cands[0]) == {"peer", "mode", "depth", "height", "score",
+                             "age_s"}
+    assert mgr.is_replica_peer("v") is True
+    assert mgr.is_replica_peer("ghost") is False
+
+
+def test_incident_ledger_attribution_detection_heal_recovery():
+    ledger = IncidentLedger()
+    mgr, clock, h = make_mgr(ledger=ledger, silence_budget_s=2.0)
+    mgr.note_status("a", 10, meta(peer="a"))
+    clock.t += 2.0
+    mgr.note_status("b", 10, meta(peer="b"))
+    mgr.on_peer_removed("a")  # orphan -> detection -> immediate re-adopt
+    assert mgr.status()["parent"] == "b"
+    ents = ledger.entries()
+    inj = [e for e in ents if e["category"] == "injection"]
+    det = [e for e in ents if e["category"] == "detection"]
+    heal = [e for e in ents if e["category"] == "heal"]
+    assert inj and inj[0]["uid"] == "replica:rep-test:1"
+    assert inj[0]["kind"] == "replica_orphan"
+    assert det and det[0]["detail"]["matched_uid"] == "replica:rep-test:1"
+    assert "mttd_s" in det[0]["detail"]
+    assert heal and heal[0]["uid"] == "replica:rep-test:1"
+    assert heal[0]["detail"]["new_parent"] == "b"
+    # still open until a commit lands at a height past the heal point
+    assert [o["uid"] for o in ledger.open_incidents()] \
+        == ["replica:rep-test:1"]
+    h["height"] += 1  # the tail applied a fresh block
+    clock.t += 1.0
+    mgr.evaluate()  # evaluate() feeds note_commit(store_height)
+    assert ledger.open_incidents() == []
+    rec = [e for e in ledger.entries() if e["category"] == "recovery"]
+    assert rec and rec[0]["uid"] == "replica:rep-test:1"
+    assert "mttr_s" in rec[0]["detail"]
+
+
+# --- reactor wire + gating ---------------------------------------------
+
+
+class _PoolRecorder:
+    def __init__(self):
+        self.calls = []
+
+    def set_peer_height(self, peer_id, height):
+        self.calls.append(("set", peer_id, height))
+
+    def remove_peer(self, peer_id):
+        self.calls.append(("remove", peer_id))
+
+
+class _Peer:
+    def __init__(self, pid):
+        self.id = pid
+        self.sent = []
+
+    def is_running(self):
+        return True
+
+    def try_send(self, ch, payload):
+        self.sent.append((ch, payload))
+        return True
+
+
+def _bare_reactor(height=7):
+    from tendermint_tpu.blockchain.reactor import BlockchainReactor
+    br = BlockchainReactor.__new__(BlockchainReactor)
+    br.tree = None
+    br.switch = None
+    br.store = types.SimpleNamespace(height=lambda: height)
+    br.pool = _PoolRecorder()
+    return br
+
+
+def test_reactor_status_msg_wire_forms():
+    from tendermint_tpu.types import serde
+    br = _bare_reactor(height=7)
+    assert list(serde.unpack(br._status_msg())) == ["status_response", 7]
+    from tendermint_tpu.blockchain.replica_tree import UNADOPTABLE_DEPTH
+    mgr, _, _ = make_mgr()
+    br.attach_tree(mgr)
+    assert mgr.on_switch == br._on_tree_switch
+    obj = serde.unpack(br._status_msg())
+    assert list(obj[:2]) == ["status_response", 7]
+    assert dict(obj[2]) == {"mode": "replica", "depth": UNADOPTABLE_DEPTH,
+                            "chain": ["self-node"], "base": 1}
+
+
+def test_reactor_tree_gates_pool_and_rewires_on_switch():
+    from tendermint_tpu.types import serde
+    br = _bare_reactor()
+    mgr, clock, _ = make_mgr()
+    br.attach_tree(mgr)
+    parent, other = _Peer("aa-parent"), _Peer("zz-other")
+    # first status adopts the sender: its height feeds the pool
+    br.receive(0x40, parent, serde.pack(
+        ["status_response", 12, meta(peer="aa-parent")]))
+    assert ("set", "aa-parent", 12) in br.pool.calls
+    # a non-parent peer is a scored candidate only — pool never told
+    br.receive(0x40, other, serde.pack(
+        ["status_response", 40, meta(peer="zz-other")]))
+    assert ("set", "zz-other", 40) not in br.pool.calls
+    # parent death: pool drops the old upstream, seeds the new one
+    clock.t += 2.0
+    br.pool.calls.clear()
+    mgr.on_peer_removed("aa-parent")
+    assert br.pool.calls == [("remove", "aa-parent"),
+                             ("set", "zz-other", 40)]
+
+
+def test_config_replica_roundtrip():
+    from tendermint_tpu.config import test_config
+    c = test_config()
+    c.replica.prefer_replicas = True
+    c.replica.max_depth = 3
+    c.replica.lag_budget_blocks = 5
+    c.replica.silence_budget_s = 2.5
+    c.replica.reparent_backoff_base_s = 0.25
+    c.replica.reparent_backoff_max_s = 4.0
+    c2 = Config.from_toml(c.to_toml())
+    assert c2.replica.prefer_replicas is True
+    assert c2.replica.max_depth == 3
+    assert c2.replica.lag_budget_blocks == 5
+    assert c2.replica.silence_budget_s == 2.5
+    assert c2.replica.reparent_backoff_base_s == 0.25
+    assert c2.replica.reparent_backoff_max_s == 4.0
+    assert ReplicaConfig().prefer_replicas is False  # flat PR-9 default
+
+
+# --- certify_many equivalence ------------------------------------------
+
+LANE = "replica-lane"
+
+
+def _bls_header_pair(vs, sks, height, app_hash=b"\x01" * 20):
+    """A SignedHeader whose AggregateCommit certifies the header's own
+    hash (certify_many's validate_basic demands commit.block_id.hash ==
+    header.hash()), signed by every validator in vs."""
+    from tendermint_tpu.crypto import merkle
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+    )
+    from tendermint_tpu.types.block import Header
+    from tendermint_tpu.types.vote_set import VoteSet
+
+    h = Header(
+        chain_id=LANE, height=height,
+        time=1_700_000_000_000_000_000 + height,
+        num_txs=0, total_txs=0,
+        last_commit_hash=b"\x02" * 32,
+        data_hash=merkle.hash_from_byte_slices([]),
+        validators_hash=vs.hash(), next_validators_hash=vs.hash(),
+        consensus_hash=b"\x03" * 32, app_hash=app_hash,
+        last_results_hash=b"",
+        evidence_hash=merkle.hash_from_byte_slices([]),
+        proposer_address=vs.validators[0].address,
+    )
+    bid = BlockID(hash=h.hash(), parts_header=PartSetHeader(1, b"\x04" * 32))
+    votes = VoteSet(LANE, height, 0, VOTE_TYPE_PRECOMMIT, vs)
+    for i, sk in enumerate(sks):
+        addr, _ = vs.get_by_index(i)
+        v = Vote(addr, i, height, 0, 0, VOTE_TYPE_PRECOMMIT, bid)
+        v.signature = sk.sign(v.sign_bytes(LANE))
+        votes.add_vote(v)
+    return SignedHeader(header=h, commit=votes.make_commit())
+
+
+def _sequential_verify(pairs):
+    out = []
+    for vs, sh in pairs:
+        try:
+            BaseVerifier(LANE, sh.height, vs).verify(sh)
+            out.append(None)
+        except ErrLiteVerification as e:
+            out.append(e)
+    return out
+
+
+def test_certify_many_matches_sequential_verify():
+    from tendermint_tpu.types.block import AggregateCommit
+    from tendermint_tpu.types.validator_set import random_bls_validator_set
+
+    vs_a, sks_a = random_bls_validator_set(3, seed=b"tree-a")
+    vs_b, sks_b = random_bls_validator_set(3, seed=b"tree-b")
+    sh5 = _bls_header_pair(vs_a, sks_a, 5)
+    sh6 = _bls_header_pair(vs_b, sks_b, 6)  # heterogeneous valsets
+    assert isinstance(sh5.commit, AggregateCommit)
+    pairs = [(vs_a, sh5), (vs_b, sh6)]
+    batched = certify_many(LANE, pairs)
+    assert batched == [None, None]
+    assert _sequential_verify(pairs) == [None, None]
+
+    # tampered aggregate: graft sh6's (valid-point, wrong-message) sig
+    # onto sh5 — batched flags exactly that index, sequential agrees
+    sh5_bad = _bls_header_pair(vs_a, sks_a, 5)
+    sh5_bad.commit.agg_sig = sh6.commit.agg_sig
+    res = certify_many(LANE, [(vs_a, sh5_bad), (vs_b, sh6)])
+    assert res[1] is None
+    assert isinstance(res[0], ErrLiteVerification)
+    assert "height 5" in str(res[0])
+    seq = _sequential_verify([(vs_a, sh5_bad), (vs_b, sh6)])
+    assert isinstance(seq[0], ErrLiteVerification) and seq[1] is None
+
+    # unknown valset: both paths say ErrUnknownValidators
+    res = certify_many(LANE, [(vs_b, sh5)])
+    assert isinstance(res[0], ErrUnknownValidators)
+    with pytest.raises(ErrUnknownValidators):
+        BaseVerifier(LANE, sh5.height, vs_b).verify(sh5)
+
+
+def test_certify_many_ed25519_fallback_and_structural_errors():
+    from tendermint_tpu.crypto import merkle  # noqa: F401  (helper dep)
+    from tendermint_tpu.types.block import AggregateCommit, Commit
+    from tendermint_tpu.types.validator_set import (
+        random_bls_validator_set,
+        random_validator_set,
+    )
+    import tests.test_lite as tl
+
+    # an ed25519 pair rides the per-pair BaseVerifier fallback and
+    # coexists with an aggregate pair in one call
+    e_vals, e_keys = random_validator_set(3, 10)
+    eh = tl.make_header(4, e_vals, e_vals)
+    eh.chain_id = LANE  # sign under our lane, not test_lite's chain
+    from tendermint_tpu.types.basic import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        Vote,
+    )
+    bid = BlockID(hash=eh.hash(), parts_header=PartSetHeader(1, b"\x04" * 32))
+    pres = [None] * len(e_vals)
+    for key in e_keys:
+        addr = key.pub_key().address()
+        idx, _ = e_vals.get_by_address(addr)
+        v = Vote(addr, idx, 4, 0, eh.time + 1, VOTE_TYPE_PRECOMMIT, bid)
+        v.signature = key.sign(v.sign_bytes(LANE))
+        pres[idx] = v
+    esh = SignedHeader(header=eh, commit=Commit(block_id=bid,
+                                                precommits=pres))
+    assert isinstance(esh.commit, Commit)
+    assert not isinstance(esh.commit, AggregateCommit)
+
+    vs_a, sks_a = random_bls_validator_set(3, seed=b"tree-a")
+    agg = _bls_header_pair(vs_a, sks_a, 9)
+    res = certify_many(LANE, [(e_vals, esh), (vs_a, agg)])
+    assert res == [None, None]
+
+    # structural failure (commit signs a different header) surfaces as
+    # ErrLiteVerification without touching the batch crypto
+    broken = _bls_header_pair(vs_a, sks_a, 9)
+    broken.header.app_hash = b"\xff" * 20  # hash changes under the commit
+    res = certify_many(LANE, [(vs_a, broken), (vs_a, agg)])
+    assert isinstance(res[0], ErrLiteVerification)
+    assert res[1] is None
+
+
+# --- the chaos scenario (slow) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_heal_scenario():
+    from tendermint_tpu.tools import scenarios
+
+    res = scenarios.run("fleet_heal")
+    assert res["ok"], res
+    assert res["safety_ok"] and res["attributed_ok"], res
+    assert res["stale_tips"] == 0, res
